@@ -1,0 +1,52 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the capabilities
+of PaddlePaddle Fluid (reference at /root/reference), built on JAX/XLA/
+Pallas. The public surface mirrors `paddle.fluid` so reference programs
+port by changing the import; execution is whole-program XLA compilation on
+TPU (see core/engine.py) with SPMD data/model parallelism over
+jax.sharding meshes (see parallel/).
+"""
+from __future__ import annotations
+
+# ops must register before any program building
+from . import ops as _ops  # noqa: F401
+
+from . import framework
+from .framework import (  # noqa: F401
+    Program, Block, Operator, Variable, Parameter,
+    default_main_program, default_startup_program, program_guard,
+    unique_name, name_scope, in_dygraph_mode,
+)
+from . import layers  # noqa: F401
+from . import initializer  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import clip  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import backward  # noqa: F401
+from .backward import append_backward, gradients  # noqa: F401
+from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
+from .executor import Executor, global_scope, scope_guard  # noqa: F401
+from .core.place import (  # noqa: F401
+    CPUPlace, TPUPlace, CUDAPlace, is_compiled_with_tpu, default_place,
+)
+from .core.scope import (  # noqa: F401
+    Scope, LoDTensor, create_lod_tensor,
+)
+from .core import scope as core  # compatibility alias module-ish
+from .compiler import (  # noqa: F401
+    CompiledProgram, BuildStrategy, ExecutionStrategy,
+)
+from . import io  # noqa: F401
+from . import metrics  # noqa: F401
+from . import profiler  # noqa: F401
+from . import reader  # noqa: F401
+from .reader.decorators import DataFeeder  # noqa: F401
+from . import dygraph  # noqa: F401
+from . import parallel  # noqa: F401
+
+# fluid-compatible helpers
+def is_compiled_with_cuda():
+    """Reference-compat: reports accelerator availability (TPU here)."""
+    return is_compiled_with_tpu()
+
+
+__version__ = "0.1.0"

@@ -1,0 +1,108 @@
+"""Gradient clipping (reference python/paddle/fluid/clip.py:
+GradientClipByValue, GradientClipByNorm, GradientClipByGlobalNorm,
+ErrorClipByValue)."""
+from __future__ import annotations
+
+from .layer_helper import LayerHelper
+from . import layers
+
+__all__ = ["GradientClipByValue", "GradientClipByNorm",
+           "GradientClipByGlobalNorm", "ErrorClipByValue",
+           "set_gradient_clip", "append_gradient_clip_ops"]
+
+_clip_attr = [None]
+
+
+class BaseGradientClipAttr:
+    def _process(self, params_grads):
+        raise NotImplementedError
+
+
+class ErrorClipByValue:
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = min if min is not None else -max
+
+
+class GradientClipByValue(BaseGradientClipAttr):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def _process(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, layers.clip(g, self.min, self.max)))
+        return out
+
+
+class GradientClipByNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _process(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, layers.clip_by_norm(g, self.clip_norm)))
+        return out
+
+
+class GradientClipByGlobalNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _process(self, params_grads):
+        sq_sums = []
+        for p, g in params_grads:
+            if g is None:
+                continue
+            helper = LayerHelper("global_norm")
+            sq = helper.create_variable_for_type_inference(g.dtype)
+            g.block.append_op("squared_l2_norm", inputs={"X": g},
+                              outputs={"Out": sq}, infer_shape=False)
+            sq_sums.append(sq)
+        if not sq_sums:
+            return params_grads
+        total = layers.tensor.sums(sq_sums)
+        global_norm = layers.sqrt(total)
+        clip_var = layers.tensor.fill_constant([1], "float32",
+                                               self.clip_norm)
+        scale = layers.elementwise_div(
+            clip_var,
+            layers.elementwise_max(global_norm, clip_var))
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, layers.elementwise_mul(g, scale)))
+        return out
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    _clip_attr[0] = clip
+
+
+def append_gradient_clip_ops(params_grads):
+    clip = _clip_attr[0]
+    # per-param clip attrs take precedence (reference clip.py:331)
+    per_param = [getattr(p, "gradient_clip_attr", None)
+                 for p, _ in params_grads]
+    if clip is None and not any(per_param):
+        return params_grads
+    if clip is not None:
+        return clip._process(params_grads)
+    out = []
+    for (p, g), attr in zip(params_grads, per_param):
+        if attr is None or g is None:
+            out.append((p, g))
+        else:
+            out.append(attr._process([(p, g)])[0])
+    return out

@@ -1,0 +1,78 @@
+"""Places: device handles for the TPU-native runtime.
+
+Parity: reference Place variant (/root/reference/paddle/fluid/platform/
+place.h:79) with CPUPlace/CUDAPlace/CUDAPinnedPlace. Here the accelerator
+place is TPUPlace backed by a PJRT device obtained from JAX; CPUPlace maps
+to the host platform. DeviceContextPool's role (per-device streams,
+device_context.h:243) is subsumed by PJRT/JAX's async dispatch — a Place
+just resolves to a jax.Device.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+class Place:
+    _platforms = ()  # jax platform names, in preference order
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = int(device_id)
+
+    def jax_device(self):
+        devs = _devices_for(self._platforms)
+        if not devs:
+            raise RuntimeError(
+                f"no device for platforms {self._platforms}; available: "
+                f"{[d.platform for d in jax.devices()]}")
+        return devs[self.device_id % len(devs)]
+
+    def __eq__(self, other):
+        return (type(self) is type(other)
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.device_id))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.device_id})"
+
+
+@functools.lru_cache(maxsize=None)
+def _devices_for(platforms):
+    for p in platforms:
+        try:
+            devs = jax.devices(p)
+        except RuntimeError:
+            devs = []
+        if devs:
+            return tuple(devs)
+    # final fallback: whatever the default backend exposes
+    return tuple(jax.devices())
+
+
+class CPUPlace(Place):
+    _platforms = ("cpu",)
+
+
+class TPUPlace(Place):
+    """First-class accelerator place (north-star: fluid.TPUPlace(0))."""
+    # "axon" is the tunneled single-chip platform in this environment
+    _platforms = ("tpu", "axon")
+
+
+# Alias so code written against the reference's GPU naming keeps working.
+CUDAPlace = TPUPlace
+
+
+def is_compiled_with_tpu() -> bool:
+    try:
+        return bool(_devices_for(TPUPlace._platforms)) and \
+            _devices_for(TPUPlace._platforms)[0].platform != "cpu"
+    except RuntimeError:
+        return False
+
+
+def default_place() -> Place:
+    return TPUPlace(0) if is_compiled_with_tpu() else CPUPlace(0)

@@ -1,0 +1,360 @@
+"""Operator registry: one registration per op gives lowering (JAX), shape
+inference (via abstract eval of the lowering), and gradient definition.
+
+Parity: reference op registry / OpInfo
+(/root/reference/paddle/fluid/framework/op_registry.h:197-268, op_info.h:80)
+and GradOpDescMaker (grad_op_desc_maker.h). TPU-first twists:
+
+* An op "kernel" is a pure JAX lowering traced into whole-block XLA
+  computations — there is no per-op dispatch at run time.
+* Shape/dtype inference does not exist as a separate contract: we abstractly
+  evaluate the lowering with jax.eval_shape, so the lowering is the single
+  source of truth (replaces InferShape/InferVarType,
+  reference operator.cc:935-993).
+* The default gradient is derived mechanically from the forward lowering via
+  jax.vjp — one grad registry serves graph mode (append_backward) and
+  dygraph (tracer tape), preserving the reference's single-grad-source
+  property (reference backward.py:431 + imperative/tracer.cc:239).
+* Randomness is explicit: ops draw keys derived from a per-op uid and the
+  step's threaded PRNG state, so forward and vjp-recomputed forward see
+  identical randomness inside one compiled step.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+# Attr names used internally by the framework (filtered from user attrs).
+OP_UID_ATTR = "__op_uid__"
+FWD_TYPE_ATTR = "__fwd_type__"
+GRAD_SUFFIX = "@GRAD"
+RENAME_SEP = "@RENAME@"
+
+
+class OpInfo:
+    __slots__ = ("type", "lowering", "grad_maker", "no_grad_slots",
+                 "infer_shape", "intermediate_outputs", "is_grad_op",
+                 "stateful_outputs")
+
+    def __init__(self, type, lowering, grad_maker=None, no_grad_slots=(),
+                 infer_shape=None, intermediate_outputs=(), is_grad_op=False,
+                 stateful_outputs=()):
+        self.type = type
+        self.lowering = lowering
+        self.grad_maker = grad_maker
+        self.no_grad_slots = frozenset(no_grad_slots)
+        self.infer_shape = infer_shape
+        # outputs only consumed by this op's grad (e.g. softmax saved output)
+        self.intermediate_outputs = frozenset(intermediate_outputs)
+        self.is_grad_op = is_grad_op
+        self.stateful_outputs = frozenset(stateful_outputs)
+
+
+class OpInfoMap:
+    """Global op registry (reference OpInfoMap, op_info.h:80)."""
+
+    def __init__(self):
+        self._map: Dict[str, OpInfo] = {}
+
+    def insert(self, info: OpInfo):
+        if info.type in self._map:
+            raise ValueError(f"op '{info.type}' registered twice")
+        self._map[info.type] = info
+
+    def get(self, op_type: str) -> OpInfo:
+        try:
+            return self._map[op_type]
+        except KeyError:
+            raise NotImplementedError(
+                f"op '{op_type}' is not registered; registered ops: "
+                f"{len(self._map)}") from None
+
+    def has(self, op_type: str) -> bool:
+        return op_type in self._map
+
+    def types(self):
+        return sorted(self._map)
+
+
+OPS = OpInfoMap()
+
+
+def register_op(op_type: str, *, no_grad_slots: Sequence[str] = (),
+                grad_maker=None, infer_shape=None,
+                intermediate_outputs: Sequence[str] = (),
+                stateful_outputs: Sequence[str] = ()):
+    """Decorator registering a forward lowering.
+
+    The lowering has signature ``lowering(ctx)`` where ``ctx`` is an
+    ExecContext; it reads inputs/attrs and sets outputs. Registration also
+    creates ``<type>_grad`` with the generic vjp lowering unless the op
+    opts out via ``grad_maker=None`` explicitly passed as False-y sentinel
+    or registers its own grad op.
+    """
+    def deco(fn):
+        info = OpInfo(op_type, fn, grad_maker=grad_maker,
+                      no_grad_slots=no_grad_slots, infer_shape=infer_shape,
+                      intermediate_outputs=intermediate_outputs,
+                      stateful_outputs=stateful_outputs)
+        OPS.insert(info)
+        grad_type = op_type + "_grad"
+        if not OPS.has(grad_type):
+            OPS.insert(OpInfo(grad_type, _make_generic_grad_lowering(op_type),
+                              is_grad_op=True))
+        return fn
+    return deco
+
+
+def register_no_grad_op(op_type: str, **kw):
+    """Register an op that has no gradient (metrics, readers, assign-likes)."""
+    def deco(fn):
+        OPS.insert(OpInfo(op_type, fn, **kw))
+        return fn
+    return deco
+
+
+class ExecContext:
+    """Per-op view during block tracing (reference ExecutionContext,
+    operator.h:230). Values are JAX tracers/arrays; `env` maps var name to
+    value. Missing optional inputs return None."""
+
+    __slots__ = ("op", "env", "rng_ctx", "block_runner", "lod_env")
+
+    def __init__(self, op, env, rng_ctx=None, block_runner=None,
+                 lod_env=None):
+        self.op = op          # framework.Operator-like (inputs/outputs/attrs)
+        self.env = env
+        self.rng_ctx = rng_ctx
+        self.block_runner = block_runner  # callable for control-flow sub-blocks
+        # host-side LoD metadata: var name -> list of offset vectors. Static
+        # per trace (part of the executor's compile-cache key), the
+        # XLA-friendly encoding of ragged batches.
+        self.lod_env = lod_env if lod_env is not None else {}
+
+    # ---- inputs / outputs -------------------------------------------------
+    def input_names(self, slot: str) -> List[str]:
+        return self.op.input(slot)
+
+    def output_names(self, slot: str) -> List[str]:
+        return self.op.output(slot)
+
+    def has_input(self, slot: str) -> bool:
+        names = self.op.input(slot)
+        return bool(names)
+
+    def has_output(self, slot: str) -> bool:
+        return bool(self.op.output(slot))
+
+    def input(self, slot: str):
+        names = self.op.input(slot)
+        if not names:
+            return None
+        if len(names) != 1:
+            raise ValueError(
+                f"op {self.op.type} input slot {slot} is multi-arg; "
+                f"use inputs()")
+        return self.env[names[0]]
+
+    def inputs(self, slot: str):
+        return [self.env[n] for n in self.op.input(slot)]
+
+    def set_output(self, slot: str, value):
+        names = self.op.output(slot)
+        if not names:
+            return  # optional output not bound
+        assert len(names) == 1, f"{self.op.type}.{slot} is multi-arg"
+        self.env[names[0]] = value
+
+    def set_outputs(self, slot: str, values):
+        names = self.op.output(slot)
+        assert len(names) == len(values), (
+            f"{self.op.type}.{slot}: {len(names)} names vs "
+            f"{len(values)} values")
+        for n, v in zip(names, values):
+            self.env[n] = v
+
+    # ---- attrs ------------------------------------------------------------
+    def attr(self, name: str, default=None):
+        return self.op.attr(name, default)
+
+    def has_attr(self, name: str) -> bool:
+        return self.op.has_attr(name)
+
+    # ---- LoD (ragged metadata, host side) --------------------------------
+    def get_lod(self, slot_or_name: str):
+        names = self.op.input(slot_or_name)
+        name = names[0] if names else slot_or_name
+        return self.lod_env.get(name, [])
+
+    def set_lod(self, slot_or_name: str, lod):
+        names = self.op.output(slot_or_name)
+        name = names[0] if names else slot_or_name
+        self.lod_env[name] = [list(map(int, lv)) for lv in lod]
+
+    # ---- randomness -------------------------------------------------------
+    def rng(self) -> jax.Array:
+        """Deterministic per-op key: fold the op uid (shared between a
+        forward op and its grad op) into the step key, honoring a nonzero
+        `seed` attr the way reference random kernels do."""
+        uid = self.op.attr(OP_UID_ATTR, 0)
+        seed = self.op.attr("seed", 0) or 0
+        if self.rng_ctx is None or seed:
+            base = jax.random.PRNGKey(seed)
+        else:
+            base = self.rng_ctx.step_key()
+        return jax.random.fold_in(base, uid)
+
+
+class _RngCtx:
+    """Carries the step's base PRNG key during tracing."""
+
+    __slots__ = ("key",)
+
+    def __init__(self, key):
+        self.key = key
+
+    def step_key(self):
+        return self.key
+
+
+# ---------------------------------------------------------------------------
+# Generic gradient via jax.vjp of the forward lowering
+# ---------------------------------------------------------------------------
+
+class _SlotView:
+    """Minimal op-view used to re-run a forward lowering inside a grad
+    lowering: same attrs, inputs/outputs remapped to local names."""
+
+    __slots__ = ("type", "_inputs", "_outputs", "_attrs")
+
+    def __init__(self, type, inputs, outputs, attrs):
+        self.type = type
+        self._inputs = inputs
+        self._outputs = outputs
+        self._attrs = attrs
+
+    def input(self, slot):
+        return self._inputs.get(slot, [])
+
+    def output(self, slot):
+        return self._outputs.get(slot, [])
+
+    def attr(self, name, default=None):
+        return self._attrs.get(name, default)
+
+    def has_attr(self, name):
+        return name in self._attrs
+
+
+def _zeros_like_abstract(v):
+    return jnp.zeros(jnp.shape(v), jnp.result_type(v))
+
+
+def _make_generic_grad_lowering(fwd_type: str):
+    """Build the lowering for `<fwd_type>_grad`.
+
+    The grad op's desc (built by the default grad maker in backward.py) binds:
+      inputs:  every forward input slot S -> same names; every forward output
+               slot O -> fwd output names; every O+"@GRAD" -> cotangents
+               (possibly missing -> zero).
+      outputs: S+"@GRAD" for each forward input slot needing grad.
+      attrs:   copy of the forward attrs (incl. the forward op uid so rng
+               replays identically).
+    The lowering reconstructs the pure forward function of the
+    differentiated inputs and applies jax.vjp. XLA CSE dedupes the forward
+    recomputation against the forward pass inside the same compiled step.
+    """
+
+    def grad_lowering(ctx: ExecContext):
+        fwd_info = OPS.get(fwd_type)
+        op = ctx.op
+
+        # forward output slots = grad-op input slots that carry "@GRAD"
+        out_slots = sorted({s[:-len(GRAD_SUFFIX)] for s in op.input_slots()
+                            if s.endswith(GRAD_SUFFIX)})
+        # forward input slots = every non-@GRAD grad-op input that is not a
+        # forward output slot
+        fwd_in_slots = [s for s in op.input_slots()
+                        if not s.endswith(GRAD_SUFFIX) and s not in out_slots]
+        # differentiated slots: those with a bound X@GRAD output
+        diff_slots = [s for s in fwd_in_slots if op.output(s + GRAD_SUFFIX)]
+        const_slots = [s for s in fwd_in_slots if s not in diff_slots]
+
+        diff_vals = {s: ctx.inputs(s) for s in diff_slots}
+        const_vals = {s: ctx.inputs(s) for s in const_slots}
+        flat_names = [(s, i) for s in diff_slots
+                      for i in range(len(diff_vals[s]))]
+
+        def fwd_fn(*flat_args):
+            local_env = {}
+            local_lod = {}
+            inputs_map = {}
+            for s in const_slots:
+                names = [f"__c_{s}_{i}" for i in range(len(const_vals[s]))]
+                inputs_map[s] = names
+                for n, v, orig in zip(names, const_vals[s], op.input(s)):
+                    local_env[n] = v
+                    if orig in ctx.lod_env:
+                        local_lod[n] = ctx.lod_env[orig]
+            for (s, i), v in zip(flat_names, flat_args):
+                inputs_map.setdefault(s, [None] * len(diff_vals[s]))
+                name = f"__d_{s}_{i}"
+                inputs_map[s][i] = name
+                local_env[name] = v
+                orig = op.input(s)[i]
+                if orig in ctx.lod_env:
+                    local_lod[name] = ctx.lod_env[orig]
+            outputs_map = {}
+            for s in out_slots:
+                n_out = max(len(op.input(s)), 1)
+                outputs_map[s] = [f"__o_{s}_{i}" for i in range(n_out)]
+            view = _SlotView(fwd_type, inputs_map, outputs_map,
+                             dict(op._all_attrs()))
+            sub = ExecContext(view, local_env, ctx.rng_ctx,
+                              ctx.block_runner, local_lod)
+            fwd_info.lowering(sub)
+            outs = []
+            for s in out_slots:
+                for n in outputs_map[s]:
+                    outs.append(local_env.get(n))
+            return tuple(outs)
+
+        flat_primals = [diff_vals[s][i] for (s, i) in flat_names]
+        primals_out, vjp_fn = jax.vjp(fwd_fn, *flat_primals)
+
+        # cotangents aligned with fwd_fn outputs
+        cts = []
+        k = 0
+        for s in out_slots:
+            n_out = len(op.input(s)) if op.input(s) else 1
+            g_names = op.input(s + GRAD_SUFFIX)
+            for i in range(n_out):
+                primal = primals_out[k]; k += 1
+                if i < len(g_names) and g_names[i] in ctx.env and \
+                        ctx.env[g_names[i]] is not None:
+                    g = ctx.env[g_names[i]]
+                    if jnp.result_type(g) != jnp.result_type(primal):
+                        g = g.astype(jnp.result_type(primal))
+                    cts.append(g)
+                else:
+                    cts.append(_zeros_like_abstract(primal))
+        grads = vjp_fn(tuple(cts))
+
+        # scatter grads back to X@GRAD outputs
+        by_slot: Dict[str, list] = {}
+        for (s, i), g in zip(flat_names, grads):
+            by_slot.setdefault(s, []).append(g)
+        for s in diff_slots:
+            names = op.output(s + GRAD_SUFFIX)
+            vals = by_slot.get(s, [])
+            for n, v in zip(names, vals):
+                if n:  # empty name = grad not needed
+                    ctx.env[n] = v
+
+    grad_lowering.__name__ = f"{fwd_type}_grad_lowering"
+    return grad_lowering

@@ -1,0 +1,179 @@
+"""Variable containers and hierarchical scopes.
+
+Parity: reference Variable/Scope
+(/root/reference/paddle/fluid/framework/variable.h:26, scope.h:46). Values
+are jax.Arrays (device-resident), LoDTensor wrappers, TensorArrays, or
+arbitrary Python payloads (readers, rng state).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+class LoDTensor:
+    """Dense tensor + level-of-detail offsets (ragged-batch metadata).
+
+    Parity: reference LoDTensor (lod_tensor.h:110). TPU-first: the payload is
+    always a dense, statically-shaped jax.Array; `lod` is host-side metadata
+    (list of offset vectors) consumed by sequence ops to build masks/segment
+    ids. This keeps XLA shapes static while passing the sequence-op suite.
+    """
+
+    __slots__ = ("_array", "_lod")
+
+    def __init__(self, array=None, lod=None):
+        self._array = array
+        self._lod = [list(map(int, level)) for level in (lod or [])]
+
+    # -- fluid-compatible surface -----------------------------------------
+    def set(self, array, place=None):
+        arr = np.asarray(array)
+        if place is not None and getattr(place, "jax_device", None):
+            self._array = jax.device_put(arr, place.jax_device())
+        else:
+            self._array = jnp.asarray(arr)
+
+    def set_lod(self, lod):
+        self._lod = [list(map(int, level)) for level in lod]
+
+    def lod(self):
+        return self._lod
+
+    def recursive_sequence_lengths(self):
+        return [[b - a for a, b in zip(level[:-1], level[1:])]
+                for level in self._lod]
+
+    def set_recursive_sequence_lengths(self, lengths):
+        self._lod = []
+        for level in lengths:
+            offs = [0]
+            for l in level:
+                offs.append(offs[-1] + int(l))
+            self._lod.append(offs)
+
+    def shape(self):
+        return tuple(self._array.shape) if self._array is not None else ()
+
+    @property
+    def array(self):
+        return self._array
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._array)
+        return a.astype(dtype) if dtype else a
+
+    def __repr__(self):
+        return f"LoDTensor(shape={self.shape()}, lod={self._lod})"
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    t = LoDTensor()
+    t.set(data, place)
+    t.set_recursive_sequence_lengths(recursive_seq_lens)
+    return t
+
+
+class TensorArray(list):
+    """LoDTensorArray analog (lod_tensor_array.h)."""
+    pass
+
+
+class Variable:
+    """Type-erased runtime variable (reference variable.h:26)."""
+
+    __slots__ = ("name", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = None
+
+    def get_value(self):
+        return self._value
+
+    def set_value(self, v):
+        self._value = v
+
+    # fluid calls this get_tensor(); returns the LoDTensor view
+    def get_tensor(self) -> LoDTensor:
+        if isinstance(self._value, LoDTensor):
+            return self._value
+        t = LoDTensor(self._value)
+        self._value = t
+        return t
+
+    def is_initialized(self):
+        v = self._value
+        if isinstance(v, LoDTensor):
+            return v.array is not None
+        return v is not None
+
+
+class Scope:
+    """Hierarchical name->Variable map (reference scope.h:46)."""
+
+    def __init__(self, parent: Optional["Scope"] = None):
+        self._vars: Dict[str, Variable] = {}
+        self._parent = parent
+        self._kids = []
+
+    def var(self, name: str) -> Variable:
+        v = self._vars.get(name)
+        if v is None:
+            v = Variable(name)
+            self._vars[name] = v
+        return v
+
+    def find_var(self, name: str) -> Optional[Variable]:
+        s = self
+        while s is not None:
+            v = s._vars.get(name)
+            if v is not None:
+                return v
+            s = s._parent
+        return None
+
+    def new_scope(self) -> "Scope":
+        kid = Scope(self)
+        self._kids.append(kid)
+        return kid
+
+    def drop_kids(self):
+        self._kids.clear()
+
+    def local_var_names(self):
+        return list(self._vars)
+
+    def erase(self, names):
+        for n in names:
+            self._vars.pop(n, None)
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+class _ScopeGuard:
+    def __init__(self, scope):
+        self._scope = scope
+
+    def __enter__(self):
+        global _global_scope
+        self._old = _global_scope
+        _global_scope = self._scope
+
+    def __exit__(self, *exc):
+        global _global_scope
+        _global_scope = self._old
+
+
+def scope_guard(scope: Scope):
+    """`with scope_guard(scope):` — swap the global scope (executor.py parity)."""
+    return _ScopeGuard(scope)

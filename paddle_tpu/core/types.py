@@ -1,0 +1,81 @@
+"""Core dtype / var-kind enums and numpy<->jax dtype mapping.
+
+Parity: reference framework.proto VarType (framework.proto:97-142) and
+data_type.{h,cc}. TPU-first: dtypes are exactly the XLA-supported set, with
+bfloat16 first-class; LoD is metadata, not a distinct runtime type.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..proto import framework_pb2 as fpb
+
+DataType = fpb.DataType
+VarKind = fpb.VarKind
+AttrType = fpb.AttrType
+
+# proto DataType <-> numpy dtype
+_DT_TO_NP = {
+    fpb.DT_BOOL: np.dtype("bool"),
+    fpb.DT_INT8: np.dtype("int8"),
+    fpb.DT_UINT8: np.dtype("uint8"),
+    fpb.DT_INT16: np.dtype("int16"),
+    fpb.DT_INT32: np.dtype("int32"),
+    fpb.DT_INT64: np.dtype("int64"),
+    fpb.DT_FLOAT16: np.dtype("float16"),
+    fpb.DT_BFLOAT16: np.dtype(jnp.bfloat16),
+    fpb.DT_FLOAT32: np.dtype("float32"),
+    fpb.DT_FLOAT64: np.dtype("float64"),
+    fpb.DT_COMPLEX64: np.dtype("complex64"),
+    fpb.DT_UINT32: np.dtype("uint32"),
+    fpb.DT_UINT64: np.dtype("uint64"),
+}
+_NP_TO_DT = {v: k for k, v in _DT_TO_NP.items()}
+
+# Fluid-style string names accepted by the public API ("float32", "int64", ...)
+_STR_TO_DT = {
+    "bool": fpb.DT_BOOL,
+    "int8": fpb.DT_INT8,
+    "uint8": fpb.DT_UINT8,
+    "int16": fpb.DT_INT16,
+    "int32": fpb.DT_INT32,
+    "int64": fpb.DT_INT64,
+    "float16": fpb.DT_FLOAT16,
+    "bfloat16": fpb.DT_BFLOAT16,
+    "float32": fpb.DT_FLOAT32,
+    "float64": fpb.DT_FLOAT64,
+    "complex64": fpb.DT_COMPLEX64,
+    "uint32": fpb.DT_UINT32,
+    "uint64": fpb.DT_UINT64,
+}
+_DT_TO_STR = {v: k for k, v in _STR_TO_DT.items()}
+
+
+def convert_dtype(dtype):
+    """Normalize any dtype spec (str, np.dtype, jnp dtype, proto enum) to the
+    proto DataType enum."""
+    if isinstance(dtype, int):  # already a proto enum value
+        return dtype
+    if isinstance(dtype, str):
+        if dtype not in _STR_TO_DT:
+            raise ValueError(f"unsupported dtype string: {dtype!r}")
+        return _STR_TO_DT[dtype]
+    npdt = np.dtype(dtype)
+    if npdt not in _NP_TO_DT:
+        raise ValueError(f"unsupported dtype: {dtype!r}")
+    return _NP_TO_DT[npdt]
+
+
+def dtype_to_np(dtype) -> np.dtype:
+    return _DT_TO_NP[convert_dtype(dtype)]
+
+
+def dtype_to_str(dtype) -> str:
+    return _DT_TO_STR[convert_dtype(dtype)]
+
+
+def is_float_dtype(dtype) -> bool:
+    return convert_dtype(dtype) in (
+        fpb.DT_FLOAT16, fpb.DT_BFLOAT16, fpb.DT_FLOAT32, fpb.DT_FLOAT64)

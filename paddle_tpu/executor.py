@@ -1,0 +1,102 @@
+"""Public Executor: the fluid.Executor-compatible entry point.
+
+Parity: reference python/paddle/fluid/executor.py (Executor :295, run :537)
+and C++ Executor (executor.cc:172). Differences are the TPU-native execution
+model: `run` compiles the whole block to one XLA executable per feed
+signature (see core/engine.py) instead of interpreting ops, and `place` is
+a TPUPlace backed by PJRT.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from . import framework
+from .core.engine import Engine
+from .core.place import CPUPlace, TPUPlace, Place, default_place
+from .core.scope import LoDTensor, Scope, global_scope, scope_guard
+
+__all__ = ["Executor", "global_scope", "scope_guard"]
+
+
+def _to_name_str(fetch):
+    if isinstance(fetch, str):
+        return fetch
+    if isinstance(fetch, framework.Variable):
+        return fetch.name
+    raise TypeError(f"fetch target must be Variable or str, got "
+                    f"{type(fetch)}")
+
+
+class Executor:
+    def __init__(self, place: Optional[Place] = None):
+        self.place = place if place is not None else default_place()
+        self._engine = Engine()
+        self._closed = False
+
+    def close(self):
+        self._closed = True
+        self._engine = Engine()
+
+    def run(self, program=None, feed=None, fetch_list=None, feed_var_name="feed",
+            fetch_var_name="fetch", scope=None, return_numpy=True,
+            use_program_cache=True):
+        """Run a Program (or a CompiledProgram built from one)."""
+        if self._closed:
+            raise RuntimeError("Executor is closed")
+        if program is None:
+            program = framework.default_main_program()
+        scope = scope or global_scope()
+        fetch_list = fetch_list or []
+        fetch_names = [_to_name_str(f) for f in fetch_list]
+
+        # CompiledProgram path (data-parallel / distributed)
+        from . import compiler as _compiler
+        if isinstance(program, _compiler.CompiledProgram):
+            return program._run(self, feed, fetch_names, scope, return_numpy)
+
+        feed = self._canonical_feed(feed, program)
+        return self._engine.run(program, scope, self.place, feed,
+                                fetch_names, return_numpy=return_numpy)
+
+    def _canonical_feed(self, feed, program):
+        if feed is None:
+            return {}
+        if isinstance(feed, (list, tuple)):
+            # list-of-dicts is the multi-device feed form; merge by concat
+            # along batch is the ParallelExecutor contract — handled by
+            # CompiledProgram; a single executor takes dict only.
+            if len(feed) == 1:
+                feed = feed[0]
+            else:
+                raise TypeError(
+                    "list feed is only valid for CompiledProgram "
+                    "with_data_parallel")
+        out = {}
+        for k, v in feed.items():
+            if isinstance(v, LoDTensor):
+                out[k] = v
+            else:
+                arr = np.asarray(v)
+                var = program.global_block()._find_var_recursive(k)
+                if var is not None and arr.dtype != \
+                        framework.dtype_to_np(var.dtype):
+                    arr = arr.astype(framework.dtype_to_np(var.dtype))
+                out[k] = arr
+        return out
+
+    # ---- dataset training loop (train_from_dataset parity) ---------------
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        from .reader.dataset import run_from_dataset
+        return run_from_dataset(self, program, dataset, scope, fetch_list,
+                                fetch_info, print_period, train=True)
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        from .reader.dataset import run_from_dataset
+        return run_from_dataset(self, program, dataset, scope, fetch_list,
+                                fetch_info, print_period, train=False)

@@ -1,0 +1,217 @@
+"""Checkpoint save/load + inference-model export.
+
+Parity: reference python/paddle/fluid/io.py (save_vars :109, save_params
+:244, save_persistables :477, load_vars :529, load_persistables :718,
+save_inference_model :925, load_inference_model :1116) and the save/load
+ops (save_op.cc / load_op.cc / save_combine / load_combine). TPU-native:
+tensors are serialized from device as .npy payloads inside a single
+combine file or one file per var; the inference model is the pruned
+serialized ProgramDesc proto + persistables, so a saved model round-trips
+through Program.parse_from_string.
+"""
+from __future__ import annotations
+
+import io as _io
+import os
+import pickle
+import struct
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from . import framework
+from .framework import Program, Variable, default_main_program
+from .core.scope import LoDTensor, Scope, global_scope
+from .core.types import dtype_to_np
+
+__all__ = [
+    "save_vars", "save_params", "save_persistables", "load_vars",
+    "load_params", "load_persistables", "save_inference_model",
+    "load_inference_model", "get_program_parameter",
+]
+
+_MAGIC = b"PTCK"
+
+
+def _is_persistable(var: Variable) -> bool:
+    return var.persistable and var.kind not in (
+        framework.fpb.VK_FEED_MINIBATCH, framework.fpb.VK_FETCH_LIST,
+        framework.fpb.VK_READER, framework.fpb.VK_RAW)
+
+
+def _is_parameter(var: Variable) -> bool:
+    return isinstance(var, framework.Parameter)
+
+
+def _serialize_tensor(buf, name: str, value) -> None:
+    arr = np.asarray(value.array if isinstance(value, LoDTensor) else value)
+    lod = value.lod() if isinstance(value, LoDTensor) else []
+    payload = _io.BytesIO()
+    np.save(payload, arr, allow_pickle=False)
+    meta = pickle.dumps({"name": name, "lod": lod})
+    buf.write(_MAGIC)
+    buf.write(struct.pack("<II", len(meta), payload.getbuffer().nbytes))
+    buf.write(meta)
+    buf.write(payload.getvalue())
+
+
+def _deserialize_tensors(buf):
+    out = {}
+    while True:
+        head = buf.read(4)
+        if not head:
+            break
+        assert head == _MAGIC, "corrupt checkpoint chunk"
+        meta_len, data_len = struct.unpack("<II", buf.read(8))
+        meta = pickle.loads(buf.read(meta_len))
+        arr = np.load(_io.BytesIO(buf.read(data_len)),
+                      allow_pickle=False)
+        out[meta["name"]] = (arr, meta["lod"])
+    return out
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    main_program = main_program or default_main_program()
+    if vars is None:
+        vars = [v for v in main_program.list_vars()
+                if predicate is None or predicate(v)]
+    scope = global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    if filename is not None:
+        with open(os.path.join(dirname, filename), "wb") as f:
+            for v in vars:
+                sv = scope.find_var(v.name)
+                if sv is None or not sv.is_initialized():
+                    continue
+                _serialize_tensor(f, v.name, sv.get_value())
+    else:
+        for v in vars:
+            sv = scope.find_var(v.name)
+            if sv is None or not sv.is_initialized():
+                continue
+            with open(os.path.join(dirname, v.name), "wb") as f:
+                _serialize_tensor(f, v.name, sv.get_value())
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=_is_parameter, filename=filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=_is_persistable, filename=filename)
+
+
+def _restore(scope, name, arr, lod, place):
+    import jax
+    import jax.numpy as jnp
+    dev = place.jax_device() if place is not None else None
+    val = jax.device_put(arr, dev) if dev is not None else jnp.asarray(arr)
+    if lod:
+        scope.var(name).set_value(LoDTensor(val, lod))
+    else:
+        scope.var(name).set_value(val)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    main_program = main_program or default_main_program()
+    if vars is None:
+        vars = [v for v in main_program.list_vars()
+                if predicate is None or predicate(v)]
+    scope = global_scope()
+    place = executor.place if executor is not None else None
+    wanted = {v.name for v in vars}
+    if filename is not None:
+        with open(os.path.join(dirname, filename), "rb") as f:
+            tensors = _deserialize_tensors(f)
+        for name, (arr, lod) in tensors.items():
+            if name in wanted:
+                _restore(scope, name, arr, lod, place)
+    else:
+        for v in vars:
+            path = os.path.join(dirname, v.name)
+            if not os.path.exists(path):
+                continue
+            with open(path, "rb") as f:
+                tensors = _deserialize_tensors(f)
+            for name, (arr, lod) in tensors.items():
+                _restore(scope, name, arr, lod, place)
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program,
+                     predicate=_is_parameter, filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program,
+                     predicate=_is_persistable, filename=filename)
+
+
+def get_program_parameter(program):
+    return program.all_parameters()
+
+
+# ---------------------------------------------------------------------------
+# inference model export (prune to feed/fetch + serialize proto)
+# ---------------------------------------------------------------------------
+
+def _prune_program(program: Program, feed_names: Sequence[str],
+                   fetch_names: Sequence[str]) -> Program:
+    """Keep only ops needed to compute fetch_names from feed_names +
+    persistables (reference Program._prune / save_inference_model)."""
+    pruned = program.clone(for_test=True)
+    block = pruned.global_block()
+    needed = set(fetch_names)
+    keep = []
+    for op in reversed(block.ops):
+        outs = {n for s in op.output_slots() for n in op.output(s)}
+        if outs & needed:
+            keep.append(op)
+            for s in op.input_slots():
+                needed.update(op.input(s))
+    keep.reverse()
+    # drop backward/optimizer ops and anything not on the needed path
+    block.ops = [op for op in keep
+                 if op.attr("op_role", "forward") == "forward"]
+    return pruned
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, export_for_deployment=True,
+                         program_only=False):
+    main_program = main_program or default_main_program()
+    fetch_names = [v.name if isinstance(v, Variable) else v
+                   for v in target_vars]
+    pruned = _prune_program(main_program, feeded_var_names, fetch_names)
+    os.makedirs(dirname, exist_ok=True)
+    model_path = os.path.join(dirname, model_filename or "__model__")
+    meta = {"feed": list(feeded_var_names), "fetch": fetch_names}
+    with open(model_path, "wb") as f:
+        f.write(struct.pack("<I", 1))  # format version
+        meta_b = pickle.dumps(meta)
+        f.write(struct.pack("<I", len(meta_b)))
+        f.write(meta_b)
+        f.write(pruned.serialize_to_string())
+    if not program_only:
+        save_persistables(executor, dirname, pruned,
+                          filename=params_filename)
+    return fetch_names
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None, pserver_endpoints=None):
+    model_path = os.path.join(dirname, model_filename or "__model__")
+    with open(model_path, "rb") as f:
+        (_ver,) = struct.unpack("<I", f.read(4))
+        (meta_len,) = struct.unpack("<I", f.read(4))
+        meta = pickle.loads(f.read(meta_len))
+        program = Program.parse_from_string(f.read())
+    load_persistables(executor, dirname, program,
+                      filename=params_filename)
+    fetch_vars = [program.global_block().var(n) for n in meta["fetch"]]
+    return program, meta["feed"], fetch_vars

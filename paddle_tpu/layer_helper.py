@@ -1,0 +1,128 @@
+"""LayerHelper: parameter creation + op appending shared by all layers.
+
+Parity: reference python/paddle/fluid/layer_helper.py — creates parameters
+in the startup+main programs with default initializers, appends ops, applies
+activations and bias. Also serves dygraph via LayerObjectHelper-style reuse.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from . import framework
+from .framework import default_main_program, default_startup_program, \
+    unique_name, in_dygraph_mode, _dygraph_tracer
+from . import initializer as init_mod
+from .param_attr import ParamAttr
+
+
+class LayerHelper:
+    def __init__(self, layer_type: str, **kwargs):
+        self.kwargs = kwargs
+        self.layer_type = layer_type
+        name = kwargs.get("name")
+        self.name = name or unique_name.generate(layer_type)
+
+    @property
+    def main_program(self):
+        return default_main_program()
+
+    @property
+    def startup_program(self):
+        return default_startup_program()
+
+    # ---- variables --------------------------------------------------------
+    def create_variable_for_type_inference(self, dtype, stop_gradient=False):
+        if in_dygraph_mode():
+            from .dygraph.tracer import VarBase
+            return VarBase(None, stop_gradient=stop_gradient)
+        return self.main_program.current_block().create_var(
+            name=unique_name.generate(f"{self.name}.tmp"),
+            dtype=dtype, stop_gradient=stop_gradient)
+
+    create_tmp_variable = create_variable_for_type_inference
+
+    def create_variable(self, **kwargs):
+        return self.main_program.current_block().create_var(**kwargs)
+
+    def create_global_variable(self, persistable=False, **kwargs):
+        return self.main_program.global_block().create_var(
+            persistable=persistable, **kwargs)
+
+    def create_parameter(self, attr, shape, dtype, is_bias=False,
+                         default_initializer=None):
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        if not attr.name:
+            attr.name = unique_name.generate(
+                f"{self.name}.b" if is_bias else f"{self.name}.w")
+        initializer = attr.initializer or default_initializer
+        if initializer is None:
+            initializer = init_mod.Constant(0.0) if is_bias else \
+                init_mod.Xavier()
+
+        if in_dygraph_mode():
+            return _dygraph_tracer().create_parameter(
+                attr, shape, dtype, initializer, is_bias)
+
+        shape = [int(d) for d in shape]
+        param = self.main_program.global_block().create_parameter(
+            name=attr.name, shape=shape, dtype=dtype,
+            trainable=attr.trainable,
+            regularizer=attr.regularizer,
+            optimize_attr={"learning_rate": attr.learning_rate},
+            gradient_clip_attr=getattr(attr, "gradient_clip", None),
+            do_model_average=getattr(attr, "do_model_average", None))
+        # mirror into startup program + init op there
+        sb = self.startup_program.global_block()
+        sv = sb.create_parameter(
+            name=attr.name, shape=shape, dtype=dtype,
+            trainable=attr.trainable, initializer=initializer)
+        initializer(sv, sb)
+        return param
+
+    # ---- ops --------------------------------------------------------------
+    def append_op(self, type, inputs=None, outputs=None, attrs=None):
+        if in_dygraph_mode():
+            return _dygraph_tracer().trace_op(type, inputs or {},
+                                              outputs or {}, attrs or {})
+        return self.main_program.current_block().append_op(
+            type, inputs=inputs, outputs=outputs, attrs=attrs)
+
+    # ---- common patterns --------------------------------------------------
+    def input(self, input_param_name="input"):
+        return self.kwargs[input_param_name]
+
+    def input_dtype(self, input_param_name="input"):
+        return self.kwargs[input_param_name].dtype
+
+    def append_bias_op(self, input_var, dim_start=1, dim_end=None):
+        bias_attr = self.kwargs.get("bias_attr")
+        if bias_attr is False:
+            return input_var
+        size = list(input_var.shape[dim_start:dim_end])
+        b = self.create_parameter(bias_attr or ParamAttr(), size,
+                                  input_var.dtype, is_bias=True)
+        if b is None:
+            return input_var
+        out = self.create_variable_for_type_inference(input_var.dtype)
+        self.append_op("elementwise_add",
+                       inputs={"X": input_var, "Y": b},
+                       outputs={"Out": out},
+                       attrs={"axis": dim_start})
+        return out
+
+    def append_activation(self, input_var):
+        act = self.kwargs.get("act")
+        if act is None:
+            return input_var
+        if isinstance(act, dict):
+            act_type = act.pop("type")
+            attrs = act
+        else:
+            act_type = act
+            attrs = {}
+        out = self.create_variable_for_type_inference(input_var.dtype)
+        self.append_op(act_type, inputs={"X": input_var},
+                       outputs={"Out": out}, attrs=attrs)
+        return out

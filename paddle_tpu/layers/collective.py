@@ -1,0 +1,16 @@
+"""Collective layers (reference layers/collective.py: _allreduce)."""
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+__all__ = ["_allreduce"]
+
+
+def _allreduce(x, out=None, reduce_type="sum", sync_mode=False):
+    helper = LayerHelper("allreduce")
+    if out is None:
+        out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("c_allreduce_" + reduce_type,
+                     inputs={"X": x}, outputs={"Out": out},
+                     attrs={"ring_id": 0, "use_calc_stream": True})
+    return out

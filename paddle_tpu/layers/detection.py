@@ -1,0 +1,4 @@
+"""Detection layers (reference layers/detection.py) — later milestone."""
+from __future__ import annotations
+
+__all__ = []

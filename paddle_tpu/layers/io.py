@@ -1,0 +1,24 @@
+"""Data-entry layers: data() placeholder + py_reader bindings.
+
+Parity: reference layers/io.py (data :25, py_reader :629).
+"""
+from __future__ import annotations
+
+from .. import framework
+from ..framework import default_main_program, default_startup_program
+from ..core.types import convert_dtype
+from ..proto import framework_pb2 as fpb
+
+__all__ = ["data"]
+
+
+def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
+         type=None, stop_gradient=True):
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    block = default_main_program().current_block()
+    var = block.create_var(
+        name=name, shape=shape, dtype=convert_dtype(dtype),
+        lod_level=lod_level, stop_gradient=stop_gradient, is_data=True)
+    return var

@@ -1,0 +1,157 @@
+"""LR schedulers as graph ops over a persistable step counter.
+
+Parity: reference layers/learning_rate_scheduler.py (noam_decay,
+exponential_decay, natural_exp_decay, inverse_time_decay,
+polynomial_decay, piecewise_decay, cosine_decay, linear_lr_warmup).
+The reference implements these as graph ops over a global step var —
+here too: a persistable @LR_STEP@ counter is incremented each step and the
+decay formula is traced into the same XLA program.
+"""
+from __future__ import annotations
+
+import math
+
+from ..layer_helper import LayerHelper
+from . import tensor
+from . import nn as nn_layers
+from .. import framework
+
+__all__ = [
+    "exponential_decay", "natural_exp_decay", "inverse_time_decay",
+    "polynomial_decay", "piecewise_decay", "noam_decay", "cosine_decay",
+    "linear_lr_warmup",
+]
+
+_STEP_VAR = "@LR_GLOBAL_STEP@"
+
+
+def _global_step():
+    helper = LayerHelper("global_step")
+    block = helper.main_program.global_block()
+    if block.has_var(_STEP_VAR):
+        counter = block.vars[_STEP_VAR]
+        # already incremented this program
+        return counter
+    counter = tensor.create_global_var([1], 0.0, "float32",
+                                       persistable=True, name=_STEP_VAR)
+    helper.append_op("increment", inputs={"X": counter},
+                     outputs={"Out": counter}, attrs={"step": 1.0})
+    return counter
+
+
+def noam_decay(d_model, warmup_steps):
+    step = _global_step()
+    a = step ** -0.5
+    b = step * float(warmup_steps) ** -1.5
+    from .math_ops import elementwise_binary_sugar
+    lr = (float(d_model) ** -0.5) * nn_layers.elementwise_min(a, b)
+    return lr
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    step = _global_step()
+    div = step / float(decay_steps)
+    if staircase:
+        div = nn_layers.floor(div)
+    return learning_rate * (float(decay_rate) ** 1.0) ** div if False else \
+        tensor.scale(_pow_scalar(float(decay_rate), div),
+                     scale=float(learning_rate))
+
+
+def _pow_scalar(base, exp_var):
+    """base ** exp_var via exp(exp_var * ln base)."""
+    ln = math.log(base)
+    return nn_layers.exp(tensor.scale(exp_var, scale=ln))
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    step = _global_step()
+    div = step / float(decay_steps)
+    if staircase:
+        div = nn_layers.floor(div)
+    return tensor.scale(nn_layers.exp(tensor.scale(div,
+                                                   scale=-decay_rate)),
+                        scale=float(learning_rate))
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    step = _global_step()
+    div = step / float(decay_steps)
+    if staircase:
+        div = nn_layers.floor(div)
+    denom = tensor.scale(div, scale=float(decay_rate), bias=1.0,
+                         bias_after_scale=True)
+    one = tensor.fill_constant([1], "float32", learning_rate)
+    return nn_layers.elementwise_div(one, denom)
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
+                     power=1.0, cycle=False):
+    step = _global_step()
+    if cycle:
+        div_res = nn_layers.ceil(step / float(decay_steps))
+        # avoid zero on step 0
+        zero = tensor.fill_constant([1], "float32", 0.0)
+        one = tensor.fill_constant([1], "float32", 1.0)
+        from .math_ops import equal
+        div_res = nn_layers.elementwise_max(div_res, one)
+        decay_steps_var = tensor.scale(div_res, scale=float(decay_steps))
+        ratio = nn_layers.elementwise_div(step, decay_steps_var)
+    else:
+        ratio = tensor.scale(nn_layers.elementwise_min(
+            step, tensor.fill_constant([1], "float32", decay_steps)),
+            scale=1.0 / decay_steps)
+    one_minus = tensor.scale(ratio, scale=-1.0, bias=1.0)
+    pw = nn_layers.pow(one_minus, factor=float(power))
+    return tensor.scale(pw, scale=float(learning_rate -
+                                        end_learning_rate),
+                        bias=float(end_learning_rate))
+
+
+def piecewise_decay(boundaries, values):
+    step = _global_step()
+    lr = tensor.fill_constant([1], "float32", values[-1])
+    from .math_ops import less_than
+    # build nested selection: smallest boundary first
+    for b, v in zip(reversed(boundaries), reversed(values[:-1])):
+        bvar = tensor.fill_constant([1], "float32", float(b))
+        cond = less_than(step, bvar)
+        vvar = tensor.fill_constant([1], "float32", float(v))
+        # lr = cond ? v : lr  via arithmetic select
+        c = tensor.cast(cond, "float32")
+        lr = nn_layers.elementwise_add(
+            nn_layers.elementwise_mul(c, vvar),
+            nn_layers.elementwise_mul(tensor.scale(c, -1.0, 1.0), lr))
+    return lr
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    step = _global_step()
+    epoch = nn_layers.floor(tensor.scale(step,
+                                         scale=1.0 / step_each_epoch))
+    inner = tensor.scale(epoch, scale=math.pi / epochs)
+    return tensor.scale(nn_layers.cos(inner), scale=0.5 * learning_rate,
+                        bias=0.5 * learning_rate,
+                        bias_after_scale=False) if False else \
+        tensor.scale(tensor.scale(nn_layers.cos(inner), scale=1.0,
+                                  bias=1.0),
+                     scale=0.5 * learning_rate)
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    step = _global_step()
+    from .math_ops import less_than
+    warm = tensor.fill_constant([1], "float32", float(warmup_steps))
+    cond = tensor.cast(less_than(step, warm), "float32")
+    ramp = tensor.scale(step, scale=(end_lr - start_lr) / warmup_steps,
+                        bias=start_lr)
+    if isinstance(learning_rate, float):
+        learning_rate = tensor.fill_constant([1], "float32",
+                                             learning_rate)
+    return nn_layers.elementwise_add(
+        nn_layers.elementwise_mul(cond, ramp),
+        nn_layers.elementwise_mul(tensor.scale(cond, -1.0, 1.0),
+                                  learning_rate))

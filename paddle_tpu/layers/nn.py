@@ -1,0 +1,1038 @@
+"""Core NN layers: fc, conv2d, pool2d, batch_norm, embedding, dropout, ...
+
+Parity: reference python/paddle/fluid/layers/nn.py (188 functions; fc at
+nn.py:280-345, conv2d, batch_norm, embedding, dropout, softmax, matmul,
+layer_norm, ...). Each builds ops via LayerHelper into the current program.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .. import framework
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+from ..param_attr import ParamAttr
+from ..initializer import Constant, Normal, Xavier
+from ..core.types import convert_dtype
+
+__all__ = [
+    "fc", "embedding", "conv2d", "conv3d", "conv2d_transpose",
+    "conv3d_transpose", "pool2d", "pool3d", "adaptive_pool2d", "batch_norm",
+    "layer_norm", "group_norm", "instance_norm", "data_norm", "dropout",
+    "softmax", "log_softmax", "matmul", "mul", "relu", "relu6", "sigmoid",
+    "tanh", "leaky_relu", "elu", "gelu", "swish", "prelu", "brelu",
+    "soft_relu", "maxout", "softplus", "softsign", "hard_sigmoid", "selu",
+    "one_hot", "reshape", "squeeze", "unsqueeze", "flatten", "transpose",
+    "concat", "split", "stack", "unstack", "expand", "slice", "pad",
+    "pad2d", "crop", "gather", "gather_nd", "scatter", "top_k", "argsort",
+    "argmax", "argmin", "cumsum", "reduce_sum", "reduce_mean", "reduce_max",
+    "reduce_min", "reduce_prod", "reduce_all", "reduce_any", "mean",
+    "clip", "clip_by_norm", "l2_normalize", "label_smooth", "lrn",
+    "image_resize", "resize_bilinear", "resize_nearest", "pixel_shuffle",
+    "space_to_depth", "shuffle_channel", "affine_channel", "unfold",
+    "temporal_shift", "spp", "row_conv", "multiplex", "shape",
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "elementwise_max", "elementwise_min",
+    "elementwise_pow", "elementwise_mod", "elementwise_floordiv",
+    "uniform_random_batch_size_like", "gaussian_random",
+    "gaussian_random_batch_size_like", "sampling_id", "where", "size",
+    "hash", "grid_sampler", "add_position_encoding", "bilinear_tensor_product",
+    "pow", "logsigmoid", "exp", "sqrt", "rsqrt", "abs", "ceil", "floor",
+    "cos", "sin", "round", "reciprocal", "square", "hard_shrink",
+    "softshrink", "thresholded_relu", "stanh",
+]
+
+
+def _single_op(op_type, x, attrs=None, helper_name=None, out_slot="Out",
+               in_slot="X", dtype=None):
+    helper = LayerHelper(helper_name or op_type)
+    out = helper.create_variable_for_type_inference(dtype or x.dtype)
+    helper.append_op(op_type, inputs={in_slot: x}, outputs={out_slot: out},
+                     attrs=attrs or {})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# dense / conv
+# ---------------------------------------------------------------------------
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, is_test=False, name=None):
+    helper = LayerHelper("fc", **{
+        "bias_attr": bias_attr, "act": act, "name": name})
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    param_attrs = ParamAttr._to_attr(param_attr)
+    if not isinstance(param_attrs, list):
+        param_attrs = [param_attrs] * len(inputs)
+    mul_results = []
+    for x, pattr in zip(inputs, param_attrs):
+        in_dim = int(np.prod(x.shape[num_flatten_dims:]))
+        w = helper.create_parameter(pattr, [in_dim, size], x.dtype)
+        tmp = helper.create_variable_for_type_inference(x.dtype)
+        helper.append_op(
+            "mul", inputs={"X": x, "Y": w}, outputs={"Out": tmp},
+            attrs={"x_num_col_dims": num_flatten_dims,
+                   "y_num_col_dims": 1})
+        mul_results.append(tmp)
+    if len(mul_results) == 1:
+        pre_bias = mul_results[0]
+    else:
+        pre_bias = helper.create_variable_for_type_inference(
+            inputs[0].dtype)
+        helper.append_op("sum", inputs={"X": mul_results},
+                         outputs={"Out": pre_bias})
+    pre_act = helper.append_bias_op(pre_bias,
+                                    dim_start=num_flatten_dims)
+    return helper.append_activation(pre_act)
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    helper = LayerHelper("embedding")
+    w = helper.create_parameter(param_attr, size, dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "lookup_table", inputs={"W": w, "Ids": input},
+        outputs={"Out": out},
+        attrs={"is_sparse": is_sparse, "is_distributed": is_distributed,
+               "padding_idx": -1 if padding_idx is None else
+               (padding_idx if padding_idx >= 0 else size[0] + padding_idx),
+               "remote_prefetch": False})
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None):
+    helper = LayerHelper("conv2d", bias_attr=bias_attr, act=act, name=name)
+    groups = groups or 1
+    num_channels = input.shape[1]
+    if isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    filter_shape = [num_filters, num_channels // groups] + \
+        list(filter_size)
+    fan_in = (num_channels // groups) * int(np.prod(filter_size))
+    w = helper.create_parameter(
+        param_attr, filter_shape, input.dtype,
+        default_initializer=Normal(0.0, (2.0 / fan_in) ** 0.5))
+    out = helper.create_variable_for_type_inference(input.dtype)
+    op_type = "depthwise_conv2d" if (groups == num_channels and
+                                     num_filters == num_channels and
+                                     groups > 1) else "conv2d"
+    helper.append_op(
+        op_type, inputs={"Input": input, "Filter": w},
+        outputs={"Output": out},
+        attrs={"strides": _pair(stride), "paddings": _pair(padding),
+               "dilations": _pair(dilation), "groups": groups})
+    pre_act = helper.append_bias_op(out, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None):
+    helper = LayerHelper("conv3d", bias_attr=bias_attr, act=act, name=name)
+    groups = groups or 1
+    num_channels = input.shape[1]
+    fs = [filter_size] * 3 if isinstance(filter_size, int) else \
+        list(filter_size)
+    w = helper.create_parameter(
+        param_attr, [num_filters, num_channels // groups] + fs,
+        input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "conv3d", inputs={"Input": input, "Filter": w},
+        outputs={"Output": out},
+        attrs={"strides": _pair(stride, 3), "paddings": _pair(padding, 3),
+               "dilations": _pair(dilation, 3), "groups": groups})
+    pre_act = helper.append_bias_op(out, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None):
+    helper = LayerHelper("conv2d_transpose", bias_attr=bias_attr, act=act,
+                         name=name)
+    groups = groups or 1
+    c = input.shape[1]
+    if filter_size is None:
+        # derive from output_size
+        fs = []
+        osz = output_size if isinstance(output_size, (list, tuple)) else \
+            [output_size, output_size]
+        st = _pair(stride)
+        pd = _pair(padding)
+        for i in range(2):
+            fs.append(osz[i] - (input.shape[2 + i] - 1) * st[i] +
+                      2 * pd[i])
+        filter_size = fs
+    elif isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    w = helper.create_parameter(
+        param_attr, [c, num_filters // groups] + list(filter_size),
+        input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "conv2d_transpose", inputs={"Input": input, "Filter": w},
+        outputs={"Output": out},
+        attrs={"strides": _pair(stride), "paddings": _pair(padding),
+               "dilations": _pair(dilation), "groups": groups})
+    pre_act = helper.append_bias_op(out, dim_start=1, dim_end=2)
+    return helper.append_activation(pre_act)
+
+
+conv3d_transpose = conv2d_transpose  # 3d variant shares builder shape
+
+
+def _pair(v, n=2):
+    return list(v) if isinstance(v, (list, tuple)) else [v] * n
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, exclusive=True, name=None):
+    helper = LayerHelper("pool2d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "pool2d", inputs={"X": input}, outputs={"Out": out},
+        attrs={"pooling_type": pool_type, "ksize": _pair(pool_size),
+               "strides": _pair(pool_stride),
+               "paddings": _pair(pool_padding),
+               "global_pooling": global_pooling, "ceil_mode": ceil_mode,
+               "exclusive": exclusive})
+    return out
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, exclusive=True, name=None):
+    helper = LayerHelper("pool3d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "pool3d", inputs={"X": input}, outputs={"Out": out},
+        attrs={"pooling_type": pool_type, "ksize": _pair(pool_size, 3),
+               "strides": _pair(pool_stride, 3),
+               "paddings": _pair(pool_padding, 3),
+               "global_pooling": global_pooling, "ceil_mode": ceil_mode,
+               "exclusive": exclusive})
+    return out
+
+
+def adaptive_pool2d(input, pool_size, pool_type="max",
+                    require_index=False, name=None):
+    helper = LayerHelper("adaptive_pool2d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "pool2d", inputs={"X": input}, outputs={"Out": out},
+        attrs={"pooling_type": pool_type, "ksize": _pair(pool_size),
+               "adaptive": True})
+    return out
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=
+               False, use_global_stats=False):
+    helper = LayerHelper("batch_norm", act=act, name=name)
+    dtype = input.dtype
+    ch = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    scale = helper.create_parameter(param_attr, [ch], dtype,
+                                    default_initializer=Constant(1.0))
+    bias = helper.create_parameter(bias_attr, [ch], dtype, is_bias=True)
+    mean = helper.create_parameter(
+        ParamAttr(name=moving_mean_name, trainable=False,
+                  initializer=Constant(0.0)), [ch], dtype)
+    variance = helper.create_parameter(
+        ParamAttr(name=moving_variance_name, trainable=False,
+                  initializer=Constant(1.0)), [ch], dtype)
+    saved_mean = helper.create_variable_for_type_inference(
+        dtype, stop_gradient=True)
+    saved_var = helper.create_variable_for_type_inference(
+        dtype, stop_gradient=True)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "batch_norm",
+        inputs={"X": input, "Scale": scale, "Bias": bias, "Mean": mean,
+                "Variance": variance},
+        outputs={"Y": out, "MeanOut": mean, "VarianceOut": variance,
+                 "SavedMean": saved_mean, "SavedVariance": saved_var},
+        attrs={"momentum": momentum, "epsilon": epsilon,
+               "is_test": is_test, "data_layout": data_layout,
+               "use_global_stats": use_global_stats})
+    return helper.append_activation(out)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    helper = LayerHelper("layer_norm", act=act, name=name)
+    dtype = input.dtype
+    norm_shape = [int(np.prod(input.shape[begin_norm_axis:]))]
+    inputs = {"X": input}
+    if scale:
+        s = helper.create_parameter(param_attr, norm_shape, dtype,
+                                    default_initializer=Constant(1.0))
+        inputs["Scale"] = s
+    if shift:
+        b = helper.create_parameter(bias_attr, norm_shape, dtype,
+                                    is_bias=True)
+        inputs["Bias"] = b
+    out = helper.create_variable_for_type_inference(dtype)
+    mean = helper.create_variable_for_type_inference(dtype, True)
+    var = helper.create_variable_for_type_inference(dtype, True)
+    helper.append_op(
+        "layer_norm", inputs=inputs,
+        outputs={"Y": out, "Mean": mean, "Variance": var},
+        attrs={"epsilon": epsilon, "begin_norm_axis": begin_norm_axis})
+    return helper.append_activation(out)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    helper = LayerHelper("group_norm", act=act, name=name)
+    dtype = input.dtype
+    ch = input.shape[1]
+    inputs = {"X": input}
+    if param_attr is not False:
+        inputs["Scale"] = helper.create_parameter(
+            param_attr, [ch], dtype, default_initializer=Constant(1.0))
+    if bias_attr is not False:
+        inputs["Bias"] = helper.create_parameter(bias_attr, [ch], dtype,
+                                                 is_bias=True)
+    out = helper.create_variable_for_type_inference(dtype)
+    mean = helper.create_variable_for_type_inference(dtype, True)
+    var = helper.create_variable_for_type_inference(dtype, True)
+    helper.append_op("group_norm", inputs=inputs,
+                     outputs={"Y": out, "Mean": mean, "Variance": var},
+                     attrs={"epsilon": epsilon, "groups": groups})
+    return helper.append_activation(out)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    helper = LayerHelper("instance_norm", name=name)
+    ch = input.shape[1]
+    inputs = {"X": input}
+    if param_attr is not False:
+        inputs["Scale"] = helper.create_parameter(
+            param_attr, [ch], input.dtype,
+            default_initializer=Constant(1.0))
+    if bias_attr is not False:
+        inputs["Bias"] = helper.create_parameter(bias_attr, [ch],
+                                                 input.dtype, is_bias=True)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("instance_norm", inputs=inputs,
+                     outputs={"Y": out}, attrs={"epsilon": epsilon})
+    return out
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=False):
+    helper = LayerHelper("data_norm", act=act, name=name)
+    c = input.shape[-1]
+    dtype = input.dtype
+    batch_size = helper.create_parameter(
+        ParamAttr(initializer=Constant(1e4)), [c], dtype)
+    batch_sum = helper.create_parameter(
+        ParamAttr(initializer=Constant(0.0)), [c], dtype)
+    batch_square = helper.create_parameter(
+        ParamAttr(initializer=Constant(1e4)), [c], dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    means = helper.create_variable_for_type_inference(dtype, True)
+    scales = helper.create_variable_for_type_inference(dtype, True)
+    helper.append_op(
+        "data_norm",
+        inputs={"X": input, "BatchSize": batch_size,
+                "BatchSum": batch_sum, "BatchSquareSum": batch_square},
+        outputs={"Y": out, "Means": means, "Scales": scales},
+        attrs={"epsilon": epsilon})
+    return helper.append_activation(out)
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
+            dropout_implementation="downgrade_in_infer"):
+    helper = LayerHelper("dropout", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    mask = helper.create_variable_for_type_inference("uint8", True)
+    helper.append_op(
+        "dropout", inputs={"X": x}, outputs={"Out": out, "Mask": mask},
+        attrs={"dropout_prob": dropout_prob, "is_test": is_test,
+               "seed": seed or 0,
+               "dropout_implementation": dropout_implementation})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# activations / unary sugar (`ops.py` analog: generated from the registry)
+# ---------------------------------------------------------------------------
+
+def _make_act(op_type):
+    def _act(x, name=None, **attrs):
+        return _single_op(op_type, x, attrs=attrs or None)
+    _act.__name__ = op_type
+    return _act
+
+
+relu = _make_act("relu")
+sigmoid = _make_act("sigmoid")
+tanh = _make_act("tanh")
+exp = _make_act("exp")
+sqrt = _make_act("sqrt")
+rsqrt = _make_act("rsqrt")
+abs = _make_act("abs")
+ceil = _make_act("ceil")
+floor = _make_act("floor")
+cos = _make_act("cos")
+sin = _make_act("sin")
+round = _make_act("round")
+reciprocal = _make_act("reciprocal")
+square = _make_act("square")
+softplus = _make_act("softplus")
+softsign = _make_act("softsign")
+logsigmoid = _make_act("logsigmoid")
+gelu = _make_act("gelu")
+
+
+def relu6(x, threshold=6.0, name=None):
+    return _single_op("relu6", x, {"threshold": threshold})
+
+
+def leaky_relu(x, alpha=0.02, name=None):
+    return _single_op("leaky_relu", x, {"alpha": alpha})
+
+
+def elu(x, alpha=1.0, name=None):
+    return _single_op("elu", x, {"alpha": alpha})
+
+
+def swish(x, beta=1.0, name=None):
+    return _single_op("swish", x, {"beta": beta})
+
+
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    return _single_op("brelu", x, {"t_min": t_min, "t_max": t_max})
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    return _single_op("soft_relu", x, {"threshold": threshold})
+
+
+def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
+    return _single_op("hard_sigmoid", x, {"slope": slope,
+                                          "offset": offset})
+
+
+def hard_shrink(x, threshold=0.5):
+    return _single_op("hard_shrink", x, {"threshold": threshold})
+
+
+def softshrink(x, alpha=0.5):
+    return _single_op("softshrink", x, {"lambda": alpha})
+
+
+def thresholded_relu(x, threshold=1.0):
+    return _single_op("thresholded_relu", x, {"threshold": threshold})
+
+
+def stanh(x, scale_a=2.0 / 3.0, scale_b=1.7159, name=None):
+    return _single_op("stanh", x, {"scale_a": scale_a,
+                                   "scale_b": scale_b})
+
+
+def pow(x, factor=1.0, name=None):
+    return _single_op("pow", x, {"factor": factor})
+
+
+def selu(x, scale=None, alpha=None, name=None):
+    attrs = {}
+    if scale is not None:
+        attrs["scale"] = scale
+    if alpha is not None:
+        attrs["alpha"] = alpha
+    return _single_op("selu", x, attrs)
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    helper = LayerHelper("prelu", name=name)
+    if mode == "all":
+        alpha_shape = [1]
+    elif mode == "channel":
+        alpha_shape = [x.shape[1]]
+    else:
+        alpha_shape = [1] + list(x.shape[1:])
+    alpha = helper.create_parameter(param_attr, alpha_shape, x.dtype,
+                                    default_initializer=Constant(0.25))
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("prelu", inputs={"X": x, "Alpha": alpha},
+                     outputs={"Out": out}, attrs={"mode": mode})
+    return out
+
+
+def maxout(x, groups, name=None):
+    return _single_op("maxout", x, {"groups": groups})
+
+
+def softmax(input, use_cudnn=False, name=None, axis=-1):
+    return _single_op("softmax", input, {"axis": axis})
+
+
+def log_softmax(input, axis=-1, name=None):
+    return _single_op("log_softmax", input, {"axis": axis})
+
+
+# ---------------------------------------------------------------------------
+# linear algebra / shape ops
+# ---------------------------------------------------------------------------
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0,
+           name=None):
+    helper = LayerHelper("matmul", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "matmul", inputs={"X": x, "Y": y}, outputs={"Out": out},
+        attrs={"transpose_X": transpose_x, "transpose_Y": transpose_y,
+               "alpha": float(alpha)})
+    return out
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    helper = LayerHelper("mul", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "mul", inputs={"X": x, "Y": y}, outputs={"Out": out},
+        attrs={"x_num_col_dims": x_num_col_dims,
+               "y_num_col_dims": y_num_col_dims})
+    return out
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    helper = LayerHelper("bilinear_tensor_product", act=act,
+                         bias_attr=bias_attr, name=name)
+    w = helper.create_parameter(param_attr,
+                                [size, x.shape[1], y.shape[1]], x.dtype)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": x, "Y": y, "Weight": w}
+    if bias_attr is not False:
+        inputs["Bias"] = helper.create_parameter(
+            bias_attr, [1, size], x.dtype, is_bias=True)
+    helper.append_op("bilinear_tensor_product", inputs=inputs,
+                     outputs={"Out": out})
+    return helper.append_activation(out)
+
+
+def one_hot(input, depth, allow_out_of_range=False):
+    return _single_op("one_hot", input, {"depth": depth}, dtype="float32")
+
+
+def reshape(x, shape, actual_shape=None, act=None, inplace=False,
+            name=None):
+    helper = LayerHelper("reshape2", act=act, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    xshape = helper.create_variable_for_type_inference(x.dtype, True)
+    helper.append_op("reshape2", inputs={"X": x},
+                     outputs={"Out": out, "XShape": xshape},
+                     attrs={"shape": [int(s) for s in shape]})
+    return helper.append_activation(out)
+
+
+def squeeze(input, axes, name=None):
+    helper = LayerHelper("squeeze2", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    xshape = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op("squeeze2", inputs={"X": input},
+                     outputs={"Out": out, "XShape": xshape},
+                     attrs={"axes": axes})
+    return out
+
+
+def unsqueeze(input, axes, name=None):
+    helper = LayerHelper("unsqueeze2", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    xshape = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op("unsqueeze2", inputs={"X": input},
+                     outputs={"Out": out, "XShape": xshape},
+                     attrs={"axes": axes})
+    return out
+
+
+def flatten(x, axis=1, name=None):
+    helper = LayerHelper("flatten2", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    xshape = helper.create_variable_for_type_inference(x.dtype, True)
+    helper.append_op("flatten2", inputs={"X": x},
+                     outputs={"Out": out, "XShape": xshape},
+                     attrs={"axis": axis})
+    return out
+
+
+def transpose(x, perm, name=None):
+    helper = LayerHelper("transpose2", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    xshape = helper.create_variable_for_type_inference(x.dtype, True)
+    helper.append_op("transpose2", inputs={"X": x},
+                     outputs={"Out": out, "XShape": xshape},
+                     attrs={"axis": list(perm)})
+    return out
+
+
+def concat(input, axis=0, name=None):
+    helper = LayerHelper("concat", name=name)
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op("concat", inputs={"X": input},
+                     outputs={"Out": out}, attrs={"axis": axis})
+    return out
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    helper = LayerHelper("split", name=name)
+    dim = dim if dim >= 0 else dim + len(input.shape)
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        attrs = {"num": n, "sections": [], "axis": dim}
+    else:
+        n = len(num_or_sections)
+        attrs = {"num": 0, "sections": list(num_or_sections), "axis": dim}
+    outs = [helper.create_variable_for_type_inference(input.dtype)
+            for _ in range(n)]
+    helper.append_op("split", inputs={"X": input}, outputs={"Out": outs},
+                     attrs=attrs)
+    return outs
+
+
+def stack(x, axis=0):
+    helper = LayerHelper("stack")
+    x = x if isinstance(x, (list, tuple)) else [x]
+    out = helper.create_variable_for_type_inference(x[0].dtype)
+    helper.append_op("stack", inputs={"X": x}, outputs={"Y": out},
+                     attrs={"axis": axis})
+    return out
+
+
+def unstack(x, axis=0, num=None):
+    helper = LayerHelper("unstack")
+    num = num if num is not None else x.shape[axis]
+    outs = [helper.create_variable_for_type_inference(x.dtype)
+            for _ in range(num)]
+    helper.append_op("unstack", inputs={"X": x}, outputs={"Y": outs},
+                     attrs={"axis": axis, "num": num})
+    return outs
+
+
+def expand(x, expand_times, name=None):
+    return _single_op("expand", x, {"expand_times": list(expand_times)})
+
+
+def slice(input, axes, starts, ends):
+    helper = LayerHelper("slice")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("slice", inputs={"Input": input},
+                     outputs={"Out": out},
+                     attrs={"axes": list(axes), "starts": list(starts),
+                            "ends": list(ends)})
+    return out
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    return _single_op("pad", x, {"paddings": list(paddings),
+                                 "pad_value": float(pad_value)})
+
+
+def pad2d(input, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,
+          data_format="NCHW", name=None):
+    return _single_op("pad2d", input,
+                      {"paddings": list(paddings), "mode": mode,
+                       "pad_value": float(pad_value)})
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    return _single_op("crop", x, {"shape": list(shape),
+                                  "offsets": list(offsets or
+                                                  [0] * len(shape))})
+
+
+def gather(input, index, overwrite=True):
+    helper = LayerHelper("gather")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("gather", inputs={"X": input, "Index": index},
+                     outputs={"Out": out})
+    return out
+
+
+def gather_nd(input, index, name=None):
+    helper = LayerHelper("gather_nd")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("gather_nd", inputs={"X": input, "Index": index},
+                     outputs={"Out": out})
+    return out
+
+
+def scatter(input, index, updates, name=None, overwrite=True):
+    helper = LayerHelper("scatter")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("scatter",
+                     inputs={"X": input, "Ids": index,
+                             "Updates": updates},
+                     outputs={"Out": out},
+                     attrs={"overwrite": overwrite})
+    return out
+
+
+def top_k(input, k=1, name=None):
+    helper = LayerHelper("top_k", name=name)
+    values = helper.create_variable_for_type_inference(input.dtype)
+    indices = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op("top_k", inputs={"X": input},
+                     outputs={"Out": values, "Indices": indices},
+                     attrs={"k": k})
+    return values, indices
+
+
+def argsort(input, axis=-1, name=None):
+    helper = LayerHelper("argsort", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ids = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op("argsort", inputs={"X": input},
+                     outputs={"Out": out, "Indices": ids},
+                     attrs={"axis": axis})
+    return out, ids
+
+
+def argmax(x, axis=0):
+    return _single_op("arg_max", x, {"axis": axis}, dtype="int64")
+
+
+def argmin(x, axis=0):
+    return _single_op("arg_min", x, {"axis": axis}, dtype="int64")
+
+
+def cumsum(x, axis=None, exclusive=None, reverse=None):
+    attrs = {}
+    if axis is not None:
+        attrs["axis"] = axis
+    if exclusive is not None:
+        attrs["exclusive"] = exclusive
+    if reverse is not None:
+        attrs["reverse"] = reverse
+    return _single_op("cumsum", x, attrs)
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+
+def _reduce(op_type, input, dim, keep_dim, name=None):
+    if dim is None:
+        attrs = {"reduce_all": True, "dim": [0], "keep_dim": keep_dim}
+    else:
+        dims = dim if isinstance(dim, (list, tuple)) else [dim]
+        attrs = {"reduce_all": False, "dim": list(dims),
+                 "keep_dim": keep_dim}
+    return _single_op(op_type, input, attrs)
+
+
+def reduce_sum(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_sum", input, dim, keep_dim, name)
+
+
+def reduce_mean(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_mean", input, dim, keep_dim, name)
+
+
+def reduce_max(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_max", input, dim, keep_dim, name)
+
+
+def reduce_min(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_min", input, dim, keep_dim, name)
+
+
+def reduce_prod(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_prod", input, dim, keep_dim, name)
+
+
+def reduce_all(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_all", input, dim, keep_dim, name)
+
+
+def reduce_any(input, dim=None, keep_dim=False, name=None):
+    return _reduce("reduce_any", input, dim, keep_dim, name)
+
+
+def mean(x, name=None):
+    return _single_op("mean", x)
+
+
+def clip(x, min, max, name=None):
+    return _single_op("clip", x, {"min": float(min), "max": float(max)})
+
+
+def clip_by_norm(x, max_norm, name=None):
+    return _single_op("clip_by_norm", x, {"max_norm": float(max_norm)})
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    return _single_op("l2_normalize", x, {"axis": axis,
+                                          "epsilon": epsilon})
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32",
+                 name=None):
+    helper = LayerHelper("label_smooth", name=name)
+    out = helper.create_variable_for_type_inference(dtype)
+    inputs = {"X": label}
+    if prior_dist is not None:
+        inputs["PriorDist"] = prior_dist
+    helper.append_op("label_smooth", inputs=inputs,
+                     outputs={"Out": out}, attrs={"epsilon": epsilon})
+    return out
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
+    helper = LayerHelper("lrn", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    mid = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op("lrn", inputs={"X": input},
+                     outputs={"Out": out, "MidOut": mid},
+                     attrs={"n": n, "k": k, "alpha": alpha, "beta": beta})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# vision ops
+# ---------------------------------------------------------------------------
+
+def image_resize(input, out_shape=None, scale=None, name=None,
+                 resample="BILINEAR", actual_shape=None,
+                 align_corners=True, align_mode=1):
+    op = "bilinear_interp" if resample.upper() == "BILINEAR" else \
+        "nearest_interp"
+    attrs = {"align_corners": align_corners}
+    if out_shape is not None:
+        attrs["out_h"], attrs["out_w"] = int(out_shape[0]), \
+            int(out_shape[1])
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    return _single_op(op, input, attrs)
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None,
+                    actual_shape=None, align_corners=True, align_mode=1):
+    return image_resize(input, out_shape, scale, name, "BILINEAR",
+                        actual_shape, align_corners, align_mode)
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None,
+                   actual_shape=None, align_corners=True):
+    return image_resize(input, out_shape, scale, name, "NEAREST",
+                        actual_shape, align_corners)
+
+
+def pixel_shuffle(x, upscale_factor):
+    return _single_op("pixel_shuffle", x,
+                      {"upscale_factor": upscale_factor})
+
+
+def space_to_depth(x, blocksize, name=None):
+    return _single_op("space_to_depth", x, {"blocksize": blocksize})
+
+
+def shuffle_channel(x, group, name=None):
+    return _single_op("shuffle_channel", x, {"group": group})
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW",
+                   name=None):
+    helper = LayerHelper("affine_channel", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("affine_channel",
+                     inputs={"X": x, "Scale": scale, "Bias": bias},
+                     outputs={"Out": out},
+                     attrs={"data_layout": data_layout})
+    return out
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    return _single_op(
+        "unfold", x,
+        {"kernel_sizes": _pair(kernel_sizes),
+         "strides": _pair(strides),
+         "paddings": _pair(paddings, 4) if isinstance(
+             paddings, (list, tuple)) else [paddings] * 4,
+         "dilations": _pair(dilations)})
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    return _single_op("temporal_shift", x,
+                      {"seg_num": seg_num, "shift_ratio": shift_ratio})
+
+
+def spp(input, pyramid_height, pool_type="max"):
+    return _single_op("spp", input, {"pyramid_height": pyramid_height,
+                                     "pooling_type": pool_type})
+
+
+def grid_sampler(x, grid, name=None):
+    helper = LayerHelper("grid_sampler", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("grid_sampler", inputs={"X": x, "Grid": grid},
+                     outputs={"Output": out})
+    return out
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    helper = LayerHelper("row_conv", act=act)
+    w = helper.create_parameter(
+        param_attr, [future_context_size + 1, input.shape[-1]],
+        input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("row_conv", inputs={"X": input, "Filter": w},
+                     outputs={"Out": out})
+    return helper.append_activation(out)
+
+
+def multiplex(inputs, index):
+    helper = LayerHelper("multiplex")
+    out = helper.create_variable_for_type_inference(inputs[0].dtype)
+    helper.append_op("multiplex", inputs={"X": inputs, "Ids": index},
+                     outputs={"Out": out})
+    return out
+
+
+def add_position_encoding(input, alpha, beta, name=None):
+    return _single_op("add_position_encoding", input,
+                      {"alpha": float(alpha), "beta": float(beta)})
+
+
+def shape(input):
+    helper = LayerHelper("shape")
+    out = helper.create_variable_for_type_inference("int32", True)
+    helper.append_op("shape", inputs={"Input": input},
+                     outputs={"Out": out})
+    return out
+
+
+def size(input):
+    helper = LayerHelper("size")
+    out = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op("size", inputs={"Input": input},
+                     outputs={"Out": out})
+    return out
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    return _single_op("hash", input, {"mod_by": hash_size,
+                                      "num_hash": num_hash})
+
+
+def where(condition):
+    helper = LayerHelper("where")
+    out = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op("where", inputs={"Condition": condition},
+                     outputs={"Out": out})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# elementwise wrappers
+# ---------------------------------------------------------------------------
+
+def _elementwise(op_type, x, y, axis=-1, act=None, name=None):
+    helper = LayerHelper(op_type, act=act, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(op_type, inputs={"X": x, "Y": y},
+                     outputs={"Out": out}, attrs={"axis": axis})
+    return helper.append_activation(out)
+
+
+def elementwise_add(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_add", x, y, axis, act, name)
+
+
+def elementwise_sub(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_sub", x, y, axis, act, name)
+
+
+def elementwise_mul(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_mul", x, y, axis, act, name)
+
+
+def elementwise_div(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_div", x, y, axis, act, name)
+
+
+def elementwise_max(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_max", x, y, axis, act, name)
+
+
+def elementwise_min(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_min", x, y, axis, act, name)
+
+
+def elementwise_pow(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_pow", x, y, axis, act, name)
+
+
+def elementwise_mod(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_mod", x, y, axis, act, name)
+
+
+def elementwise_floordiv(x, y, axis=-1, act=None, name=None):
+    return _elementwise("elementwise_floordiv", x, y, axis, act, name)
+
+
+# ---------------------------------------------------------------------------
+# random layers
+# ---------------------------------------------------------------------------
+
+def uniform_random_batch_size_like(input, shape, dtype="float32",
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0):
+    helper = LayerHelper("uniform_random_batch_size_like")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "uniform_random_batch_size_like", inputs={"Input": input},
+        outputs={"Out": out},
+        attrs={"shape": list(shape), "input_dim_idx": input_dim_idx,
+               "output_dim_idx": output_dim_idx, "min": min, "max": max,
+               "seed": seed, "dtype": int(convert_dtype(dtype))})
+    return out
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32"):
+    helper = LayerHelper("gaussian_random")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "gaussian_random", outputs={"Out": out},
+        attrs={"shape": list(shape), "mean": mean, "std": std,
+               "seed": seed, "dtype": int(convert_dtype(dtype))})
+    return out
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    seed=0, dtype="float32"):
+    helper = LayerHelper("gaussian_random_batch_size_like")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "gaussian_random_batch_size_like", inputs={"Input": input},
+        outputs={"Out": out},
+        attrs={"shape": list(shape), "input_dim_idx": input_dim_idx,
+               "output_dim_idx": output_dim_idx, "mean": mean, "std": std,
+               "seed": seed, "dtype": int(convert_dtype(dtype))})
+    return out
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="float32"):
+    helper = LayerHelper("sampling_id")
+    out = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op("sampling_id", inputs={"X": x},
+                     outputs={"Out": out}, attrs={"seed": seed})
+    return out

@@ -1,0 +1,16 @@
+"""Sequence layers over LoD metadata (expanded in a later milestone)."""
+from __future__ import annotations
+
+__all__ = ["sequence_mask"]
+
+from ..layer_helper import LayerHelper
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    helper = LayerHelper("sequence_mask", name=name)
+    out = helper.create_variable_for_type_inference(dtype, True)
+    helper.append_op("sequence_mask", inputs={"X": x},
+                     outputs={"Y": out},
+                     attrs={"maxlen": maxlen if maxlen is not None
+                            else -1})
+    return out

@@ -1,0 +1,241 @@
+"""Tensor creation/manipulation layers (reference layers/tensor.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import framework
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+from ..core.types import convert_dtype
+
+__all__ = [
+    "create_tensor", "create_parameter", "create_global_var", "cast",
+    "concat", "sums", "assign", "fill_constant",
+    "fill_constant_batch_size_like", "ones", "zeros", "ones_like",
+    "zeros_like", "reverse", "has_inf", "has_nan", "isfinite", "range",
+    "linspace", "scale", "diag", "eye", "increment",
+]
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    helper = LayerHelper("create_tensor", name=name)
+    return helper.create_variable(name=helper.name, dtype=dtype,
+                                  persistable=persistable)
+
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    helper = LayerHelper("create_parameter")
+    from ..param_attr import ParamAttr
+    attr = attr or ParamAttr(name=name)
+    return helper.create_parameter(attr, shape, dtype, is_bias,
+                                   default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    helper = LayerHelper("global_var", name=name)
+    var = helper.create_global_variable(
+        dtype=dtype, shape=shape, persistable=persistable,
+        name=name or helper.name)
+    # initialize in startup program
+    from ..initializer import Constant
+    sb = helper.startup_program.global_block()
+    sv = sb.create_var(name=var.name, shape=shape, dtype=dtype,
+                       persistable=persistable)
+    Constant(value)(sv, sb)
+    return var
+
+
+def cast(x, dtype):
+    helper = LayerHelper("cast")
+    dtype = convert_dtype(dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("cast", inputs={"X": x}, outputs={"Out": out},
+                     attrs={"in_dtype": int(x.dtype),
+                            "out_dtype": int(dtype)})
+    return out
+
+
+def concat(input, axis=0, name=None):
+    from .nn import concat as _concat
+    return _concat(input, axis, name)
+
+
+def sums(input, out=None):
+    helper = LayerHelper("sum")
+    if out is None:
+        out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op("sum", inputs={"X": input}, outputs={"Out": out})
+    return out
+
+
+def assign(input, output=None):
+    helper = LayerHelper("assign")
+    if isinstance(input, Variable):
+        if output is None:
+            output = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op("assign", inputs={"X": input},
+                         outputs={"Out": output})
+    else:
+        arr = np.asarray(input)
+        if output is None:
+            output = helper.create_variable_for_type_inference(
+                str(arr.dtype))
+        attrs = {"shape": list(arr.shape), "dtype":
+                 int(convert_dtype(arr.dtype))}
+        if arr.dtype == np.int32:
+            attrs["int32_values"] = [int(v) for v in arr.reshape(-1)]
+        elif arr.dtype == np.int64:
+            attrs["int64_values"] = [int(v) for v in arr.reshape(-1)]
+        else:
+            attrs["fp32_values"] = [float(v) for v in arr.reshape(-1)]
+        helper.append_op("assign_value", outputs={"Out": output},
+                         attrs=attrs)
+    return output
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None):
+    helper = LayerHelper("fill_constant")
+    if out is None:
+        out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "fill_constant", outputs={"Out": out},
+        attrs={"shape": [int(s) for s in shape], "value": float(value),
+               "dtype": int(convert_dtype(dtype))})
+    out.stop_gradient = True
+    return out
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0):
+    helper = LayerHelper("fill_constant_batch_size_like")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "fill_constant_batch_size_like", inputs={"Input": input},
+        outputs={"Out": out},
+        attrs={"shape": [int(s) for s in shape], "value": float(value),
+               "input_dim_idx": input_dim_idx,
+               "output_dim_idx": output_dim_idx,
+               "dtype": int(convert_dtype(dtype))})
+    out.stop_gradient = True
+    return out
+
+
+def ones(shape, dtype, force_cpu=False):
+    return fill_constant(shape, dtype, 1.0)
+
+
+def zeros(shape, dtype, force_cpu=False):
+    return fill_constant(shape, dtype, 0.0)
+
+
+def ones_like(x, out=None):
+    helper = LayerHelper("ones_like")
+    if out is None:
+        out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("fill_any_like", inputs={"X": x},
+                     outputs={"Out": out}, attrs={"value": 1.0})
+    return out
+
+
+def zeros_like(x, out=None):
+    helper = LayerHelper("zeros_like")
+    if out is None:
+        out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("fill_zeros_like", inputs={"X": x},
+                     outputs={"Out": out})
+    return out
+
+
+def reverse(x, axis):
+    helper = LayerHelper("reverse")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("reverse", inputs={"X": x}, outputs={"Out": out},
+                     attrs={"axis": [axis] if isinstance(axis, int)
+                            else list(axis)})
+    return out
+
+
+def isfinite(x):
+    helper = LayerHelper("isfinite")
+    out = helper.create_variable_for_type_inference("bool", True)
+    helper.append_op("isfinite", inputs={"X": x}, outputs={"Out": out})
+    return out
+
+
+def has_inf(x):
+    helper = LayerHelper("isfinite")
+    out = helper.create_variable_for_type_inference("bool", True)
+    helper.append_op("isfinite", inputs={"X": x}, outputs={"Out": out})
+    from .math_ops import logical_not
+    return out
+
+
+has_nan = has_inf
+
+
+def range(start, end, step, dtype):
+    helper = LayerHelper("range")
+    s = fill_constant([1], dtype, start) if not isinstance(
+        start, Variable) else start
+    e = fill_constant([1], dtype, end) if not isinstance(
+        end, Variable) else end
+    st = fill_constant([1], dtype, step) if not isinstance(
+        step, Variable) else step
+    out = helper.create_variable_for_type_inference(dtype, True)
+    helper.append_op("range", inputs={"Start": s, "End": e, "Step": st},
+                     outputs={"Out": out})
+    return out
+
+
+def linspace(start, stop, num, dtype):
+    helper = LayerHelper("linspace")
+    s = fill_constant([1], dtype, start) if not isinstance(
+        start, Variable) else start
+    e = fill_constant([1], dtype, stop) if not isinstance(
+        stop, Variable) else stop
+    n = fill_constant([1], "int32", num) if not isinstance(
+        num, Variable) else num
+    out = helper.create_variable_for_type_inference(dtype, True)
+    helper.append_op("linspace",
+                     inputs={"Start": s, "Stop": e, "Num": n},
+                     outputs={"Out": out})
+    return out
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None,
+          name=None):
+    helper = LayerHelper("scale", act=act, name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("scale", inputs={"X": x}, outputs={"Out": out},
+                     attrs={"scale": float(scale), "bias": float(bias),
+                            "bias_after_scale": bias_after_scale})
+    return helper.append_activation(out)
+
+
+def diag(diagonal):
+    helper = LayerHelper("diag")
+    out = helper.create_variable_for_type_inference(diagonal.dtype)
+    helper.append_op("diag", inputs={"Diagonal": diagonal},
+                     outputs={"Out": out})
+    return out
+
+
+def eye(num_rows, num_columns=None, batch_shape=None, dtype="float32"):
+    helper = LayerHelper("eye")
+    out = helper.create_variable_for_type_inference(dtype, True)
+    helper.append_op("eye", outputs={"Out": out},
+                     attrs={"num_rows": num_rows,
+                            "num_columns": num_columns or num_rows,
+                            "dtype": int(convert_dtype(dtype))})
+    return out
+
+
+def increment(x, value=1.0, in_place=True):
+    helper = LayerHelper("increment")
+    out = x if in_place else helper.create_variable_for_type_inference(
+        x.dtype)
+    helper.append_op("increment", inputs={"X": x}, outputs={"Out": out},
+                     attrs={"step": float(value)})
+    return out

@@ -1,0 +1,114 @@
+"""Activation ops — the full 30-op table.
+
+Parity: reference activation_op.cc FOR_EACH_ACTIVATION_OP table
+(/root/reference/paddle/fluid/operators/activation_op.h:1594-1597 and
+activation_op.cc). Each is one VPU-friendly jnp expression; gradients come
+from the generic vjp registry, which matches the reference's hand-written
+grad functors analytically.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _unary(op_type, fn):
+    @register_op(op_type)
+    def _lower(ctx, _fn=fn):
+        ctx.set_output("Out", _fn(ctx.input("X"), ctx))
+    _lower.__name__ = op_type
+    return _lower
+
+
+def _a(ctx, name, default):
+    v = ctx.attr(name, default)
+    return default if v is None else v
+
+
+_TABLE = {
+    "abs": lambda x, c: jnp.abs(x),
+    "acos": lambda x, c: jnp.arccos(x),
+    "asin": lambda x, c: jnp.arcsin(x),
+    "atan": lambda x, c: jnp.arctan(x),
+    "ceil": lambda x, c: jnp.ceil(x),
+    "cos": lambda x, c: jnp.cos(x),
+    "exp": lambda x, c: jnp.exp(x),
+    "floor": lambda x, c: jnp.floor(x),
+    "log": lambda x, c: jnp.log(x),
+    "reciprocal": lambda x, c: 1.0 / x,
+    "relu": lambda x, c: jnp.maximum(x, 0),
+    "round": lambda x, c: jnp.round(x),
+    "rsqrt": lambda x, c: jax.lax.rsqrt(x),
+    "sigmoid": lambda x, c: jax.nn.sigmoid(x),
+    "sin": lambda x, c: jnp.sin(x),
+    "softsign": lambda x, c: x / (1 + jnp.abs(x)),
+    "sqrt": lambda x, c: jnp.sqrt(x),
+    "square": lambda x, c: x * x,
+    "tanh": lambda x, c: jnp.tanh(x),
+    "tanh_shrink": lambda x, c: x - jnp.tanh(x),
+    "logsigmoid": lambda x, c: jax.nn.log_sigmoid(x),
+    "softplus": lambda x, c: jax.nn.softplus(x),
+    "gelu": lambda x, c: jax.nn.gelu(x, approximate=False),
+    "brelu": lambda x, c: jnp.clip(x, _a(c, "t_min", 0.0),
+                                   _a(c, "t_max", 24.0)),
+    "relu6": lambda x, c: jnp.clip(x, 0.0, _a(c, "threshold", 6.0)),
+    "soft_relu": lambda x, c: jnp.log(
+        1 + jnp.exp(jnp.clip(x, -_a(c, "threshold", 40.0),
+                             _a(c, "threshold", 40.0)))),
+    "leaky_relu": lambda x, c: jnp.where(
+        x >= 0, x, x * _a(c, "alpha", 0.02)),
+    "elu": lambda x, c: jnp.where(
+        x >= 0, x, _a(c, "alpha", 1.0) * (jnp.exp(jnp.minimum(x, 0)) - 1)),
+    "hard_sigmoid": lambda x, c: jnp.clip(
+        _a(c, "slope", 0.2) * x + _a(c, "offset", 0.5), 0.0, 1.0),
+    "hard_shrink": lambda x, c: jnp.where(
+        jnp.abs(x) > _a(c, "threshold", 0.5), x, 0.0),
+    "softshrink": lambda x, c: jnp.where(
+        x > _a(c, "lambda", 0.5), x - _a(c, "lambda", 0.5),
+        jnp.where(x < -_a(c, "lambda", 0.5), x + _a(c, "lambda", 0.5), 0.0)),
+    "thresholded_relu": lambda x, c: jnp.where(
+        x > _a(c, "threshold", 1.0), x, 0.0),
+    "stanh": lambda x, c: _a(c, "scale_b", 1.7159) * jnp.tanh(
+        _a(c, "scale_a", 2.0 / 3.0) * x),
+    "swish": lambda x, c: x * jax.nn.sigmoid(_a(c, "beta", 1.0) * x),
+    "pow": lambda x, c: jnp.power(x, _a(c, "factor", 1.0)),
+}
+
+for _name, _fn in _TABLE.items():
+    _unary(_name, _fn)
+
+
+@register_op("prelu")
+def prelu(ctx):
+    x = ctx.input("X")
+    alpha = ctx.input("Alpha")
+    mode = ctx.attr("mode", "all")
+    if mode == "all":
+        a = alpha.reshape(())
+    elif mode == "channel":
+        a = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    else:  # element
+        a = alpha.reshape((1,) + x.shape[1:])
+    ctx.set_output("Out", jnp.where(x > 0, x, a * x))
+
+
+@register_op("selu")
+def selu(ctx):
+    x = ctx.input("X")
+    scale = ctx.attr("scale", 1.0507009873554805)
+    alpha = ctx.attr("alpha", 1.6732632423543772)
+    ctx.set_output("Out", scale * jnp.where(
+        x > 0, x, alpha * (jnp.exp(jnp.minimum(x, 0)) - 1)))
+
+
+@register_op("maxout")
+def maxout(ctx):
+    x = ctx.input("X")  # NCHW
+    groups = ctx.attr("groups")
+    n, c, h, w = x.shape
+    ctx.set_output("Out",
+                   x.reshape(n, c // groups, groups, h, w).max(axis=2))

@@ -1,0 +1,542 @@
+"""Tensor-manipulation ops: fill/cast/reshape/transpose/concat/split/
+gather/scatter/one_hot/lookup_table/top_k/...
+
+Parity: the single-file ops at /root/reference/paddle/fluid/operators/
+(fill_constant_op.cc, cast_op.cc, reshape_op.cc (reshape2), concat_op.cc,
+split_op.cc, gather_op.cc, one_hot_op.cc, lookup_table_op.cc, top_k_op.cc,
+etc.). All are pure XLA ops; "2"-suffixed variants carry the XShape output
+the reference uses for in-place grad reconstruction — here XShape is a
+zero-size marker only.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op, register_no_grad_op
+from ..core.types import dtype_to_np
+
+
+def _np_dtype(ctx, attr="dtype", default="float32"):
+    d = ctx.attr(attr, None)
+    if d is None:
+        return np.dtype(default)
+    return dtype_to_np(d)
+
+
+# -- creation ---------------------------------------------------------------
+
+@register_no_grad_op("fill_constant")
+def fill_constant(ctx):
+    shape = [int(s) for s in ctx.attr("shape", [])]
+    value = ctx.attr("value", 0.0)
+    str_val = ctx.attr("str_value", "")
+    if str_val:
+        value = float(str_val)
+    ctx.set_output("Out", jnp.full(shape, value, _np_dtype(ctx)))
+
+
+@register_no_grad_op("fill_constant_batch_size_like")
+def fill_constant_batch_size_like(ctx):
+    x = ctx.input("Input")
+    shape = [int(s) for s in ctx.attr("shape", [])]
+    in_idx = ctx.attr("input_dim_idx", 0)
+    out_idx = ctx.attr("output_dim_idx", 0)
+    shape[out_idx] = x.shape[in_idx]
+    ctx.set_output("Out",
+                   jnp.full(shape, ctx.attr("value", 0.0), _np_dtype(ctx)))
+
+
+@register_no_grad_op("fill_zeros_like")
+def fill_zeros_like(ctx):
+    x = ctx.input("X")
+    ctx.set_output("Out", jnp.zeros_like(x))
+
+
+@register_no_grad_op("fill_any_like")
+def fill_any_like(ctx):
+    x = ctx.input("X")
+    ctx.set_output("Out", jnp.full_like(x, ctx.attr("value", 0.0)))
+
+
+@register_no_grad_op("range")
+def range_op(ctx):
+    # host-known scalars preferred; fall back to traced values
+    s, e, st = ctx.input("Start"), ctx.input("End"), ctx.input("Step")
+    ctx.set_output("Out", jnp.arange(float(s), float(e), float(st),
+                                     dtype=jnp.result_type(s)))
+
+
+@register_no_grad_op("linspace")
+def linspace(ctx):
+    s, e, n = ctx.input("Start"), ctx.input("Stop"), ctx.input("Num")
+    ctx.set_output("Out", jnp.linspace(float(s), float(e), int(n)))
+
+
+@register_no_grad_op("eye")
+def eye(ctx):
+    ctx.set_output("Out", jnp.eye(ctx.attr("num_rows"),
+                                  ctx.attr("num_columns", None) or None,
+                                  dtype=_np_dtype(ctx)))
+
+
+@register_no_grad_op("diag")
+def diag(ctx):
+    ctx.set_output("Out", jnp.diag(ctx.input("Diagonal")))
+
+
+# -- copy / cast / scale ----------------------------------------------------
+
+@register_op("assign")
+def assign(ctx):
+    ctx.set_output("Out", ctx.input("X"))
+
+
+@register_no_grad_op("assign_value")
+def assign_value(ctx):
+    shape = [int(s) for s in ctx.attr("shape", [])]
+    dt = _np_dtype(ctx)
+    if np.dtype(dt) == np.int32:
+        vals = ctx.attr("int32_values", [])
+    elif np.dtype(dt) == np.int64:
+        vals = ctx.attr("int64_values", [])
+    else:
+        vals = ctx.attr("fp32_values", [])
+    ctx.set_output("Out", jnp.asarray(np.array(vals, dt).reshape(shape)))
+
+
+@register_op("cast")
+def cast(ctx):
+    ctx.set_output("Out",
+                   ctx.input("X").astype(_np_dtype(ctx, "out_dtype")))
+
+
+@register_op("scale")
+def scale(ctx):
+    x = ctx.input("X")
+    s = ctx.attr("scale", 1.0)
+    b = ctx.attr("bias", 0.0)
+    if ctx.attr("bias_after_scale", True):
+        out = x * s + b
+    else:
+        out = (x + b) * s
+    ctx.set_output("Out", out.astype(x.dtype))
+
+
+@register_op("sum")
+def sum_op(ctx):
+    xs = ctx.inputs("X")
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    ctx.set_output("Out", out)
+
+
+@register_op("clip")
+def clip(ctx):
+    ctx.set_output("Out", jnp.clip(ctx.input("X"), ctx.attr("min"),
+                                   ctx.attr("max")))
+
+
+@register_op("clip_by_norm")
+def clip_by_norm(ctx):
+    x = ctx.input("X")
+    max_norm = ctx.attr("max_norm")
+    norm = jnp.sqrt(jnp.sum(x * x))
+    scale = jnp.where(norm > max_norm, max_norm / norm, 1.0)
+    ctx.set_output("Out", x * scale)
+
+
+# -- shape manipulation -----------------------------------------------------
+
+def _reshape_shape(x, shape):
+    shape = list(shape)
+    for i, d in enumerate(shape):
+        if d == 0:
+            shape[i] = x.shape[i]
+    if -1 in shape:
+        known = 1
+        for d in shape:
+            if d != -1:
+                known *= d
+        total = 1
+        for d in x.shape:
+            total *= d
+        shape[shape.index(-1)] = total // known
+    return shape
+
+
+@register_op("reshape")
+def reshape(ctx):
+    x = ctx.input("X")
+    ctx.set_output("Out", x.reshape(_reshape_shape(x, ctx.attr("shape"))))
+
+
+@register_op("reshape2")
+def reshape2(ctx):
+    x = ctx.input("X")
+    ctx.set_output("Out", x.reshape(_reshape_shape(x, ctx.attr("shape"))))
+    if ctx.has_output("XShape"):
+        ctx.set_output("XShape", jnp.zeros((0,) + x.shape, x.dtype))
+
+
+@register_op("transpose")
+def transpose(ctx):
+    ctx.set_output("Out", jnp.transpose(ctx.input("X"), ctx.attr("axis")))
+
+
+@register_op("transpose2")
+def transpose2(ctx):
+    x = ctx.input("X")
+    ctx.set_output("Out", jnp.transpose(x, ctx.attr("axis")))
+    if ctx.has_output("XShape"):
+        ctx.set_output("XShape", jnp.zeros((0,) + x.shape, x.dtype))
+
+
+def _sq_axes(x, axes):
+    if axes:
+        return [a if a >= 0 else a + x.ndim for a in axes]
+    return [i for i, d in enumerate(x.shape) if d == 1]
+
+
+@register_op("squeeze")
+def squeeze(ctx):
+    x = ctx.input("X")
+    axes = _sq_axes(x, ctx.attr("axes", []))
+    shape = [d for i, d in enumerate(x.shape)
+             if not (i in axes and d == 1)]
+    ctx.set_output("Out", x.reshape(shape))
+
+
+@register_op("squeeze2")
+def squeeze2(ctx):
+    squeeze(ctx)
+    x = ctx.input("X")
+    if ctx.has_output("XShape"):
+        ctx.set_output("XShape", jnp.zeros((0,) + x.shape, x.dtype))
+
+
+@register_op("unsqueeze")
+def unsqueeze(ctx):
+    x = ctx.input("X")
+    out = x
+    for a in sorted(ctx.attr("axes")):
+        out = jnp.expand_dims(out, a)
+    ctx.set_output("Out", out)
+
+
+@register_op("unsqueeze2")
+def unsqueeze2(ctx):
+    unsqueeze(ctx)
+    x = ctx.input("X")
+    if ctx.has_output("XShape"):
+        ctx.set_output("XShape", jnp.zeros((0,) + x.shape, x.dtype))
+
+
+@register_op("flatten")
+def flatten(ctx):
+    x = ctx.input("X")
+    axis = ctx.attr("axis", 1)
+    lead = int(np.prod(x.shape[:axis])) if axis else 1
+    ctx.set_output("Out", x.reshape(lead, -1))
+
+
+@register_op("flatten2")
+def flatten2(ctx):
+    flatten(ctx)
+    x = ctx.input("X")
+    if ctx.has_output("XShape"):
+        ctx.set_output("XShape", jnp.zeros((0,) + x.shape, x.dtype))
+
+
+@register_op("concat")
+def concat(ctx):
+    xs = ctx.inputs("X")
+    ctx.set_output("Out", jnp.concatenate(xs, axis=ctx.attr("axis", 0)))
+
+
+@register_op("split")
+def split(ctx):
+    x = ctx.input("X")
+    axis = ctx.attr("axis", 0)
+    num = ctx.attr("num", 0)
+    sections = ctx.attr("sections", [])
+    if num:
+        outs = jnp.split(x, num, axis=axis)
+    else:
+        idx = np.cumsum(sections)[:-1]
+        outs = jnp.split(x, idx, axis=axis)
+    ctx.set_outputs("Out", outs)
+
+
+@register_op("stack")
+def stack(ctx):
+    ctx.set_outputs("Y", [jnp.stack(ctx.inputs("X"),
+                                    axis=ctx.attr("axis", 0))])
+
+
+@register_op("unstack")
+def unstack(ctx):
+    x = ctx.input("X")
+    axis = ctx.attr("axis", 0)
+    parts = jnp.split(x, x.shape[axis], axis=axis)
+    ctx.set_outputs("Y", [p.squeeze(axis) for p in parts])
+
+
+@register_op("expand")
+def expand(ctx):
+    x = ctx.input("X")
+    times = ctx.attr("expand_times")
+    ctx.set_output("Out", jnp.tile(x, times))
+
+
+@register_op("slice")
+def slice_op(ctx):
+    x = ctx.input("Input")
+    axes = ctx.attr("axes")
+    starts = ctx.attr("starts")
+    ends = ctx.attr("ends")
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        dim = x.shape[a]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        idx[a] = slice(s, e)
+    ctx.set_output("Out", x[tuple(idx)])
+
+
+@register_op("strided_slice")
+def strided_slice(ctx):
+    x = ctx.input("Input")
+    axes, starts = ctx.attr("axes"), ctx.attr("starts")
+    ends, strides = ctx.attr("ends"), ctx.attr("strides")
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a] = slice(s, e, st)
+    ctx.set_output("Out", x[tuple(idx)])
+
+
+@register_op("reverse")
+def reverse(ctx):
+    x = ctx.input("X")
+    out = x
+    for a in ctx.attr("axis"):
+        out = jnp.flip(out, axis=a)
+    ctx.set_output("Out", out)
+
+
+@register_op("pad")
+def pad(ctx):
+    x = ctx.input("X")
+    paddings = ctx.attr("paddings")
+    pv = ctx.attr("pad_value", 0.0)
+    cfg = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(x.ndim)]
+    ctx.set_output("Out", jnp.pad(x, cfg, constant_values=pv))
+
+
+@register_op("pad2d")
+def pad2d(ctx):
+    x = ctx.input("X")  # NCHW
+    p = ctx.attr("paddings")  # [top, bottom, left, right]
+    mode = ctx.attr("mode", "constant")
+    cfg = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    if mode == "constant":
+        out = jnp.pad(x, cfg, constant_values=ctx.attr("pad_value", 0.0))
+    elif mode == "reflect":
+        out = jnp.pad(x, cfg, mode="reflect")
+    else:
+        out = jnp.pad(x, cfg, mode="edge")
+    ctx.set_output("Out", out)
+
+
+@register_op("crop")
+def crop(ctx):
+    x = ctx.input("X")
+    offsets = ctx.attr("offsets")
+    shape = ctx.attr("shape")
+    idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    ctx.set_output("Out", x[idx])
+
+
+# -- indexing ---------------------------------------------------------------
+
+@register_op("gather", no_grad_slots=("Index",))
+def gather(ctx):
+    x, idx = ctx.input("X"), ctx.input("Index")
+    ctx.set_output("Out", jnp.take(x, idx.astype(jnp.int32), axis=0))
+
+
+@register_op("scatter", no_grad_slots=("Ids",))
+def scatter(ctx):
+    x, ids, upd = ctx.input("X"), ctx.input("Ids"), ctx.input("Updates")
+    overwrite = ctx.attr("overwrite", True)
+    ids = ids.astype(jnp.int32).reshape(-1)
+    if overwrite:
+        out = x.at[ids].set(upd)
+    else:
+        out = x.at[ids].set(jnp.zeros_like(upd))
+        out = out.at[ids].add(upd)
+    ctx.set_output("Out", out)
+
+
+@register_op("gather_nd", no_grad_slots=("Index",))
+def gather_nd(ctx):
+    x, idx = ctx.input("X"), ctx.input("Index")
+    k = idx.shape[-1]
+    flat_idx = tuple(idx[..., i] for i in range(k))
+    ctx.set_output("Out", x[flat_idx])
+
+
+@register_op("lookup_table", no_grad_slots=("Ids",))
+def lookup_table(ctx):
+    w, ids = ctx.input("W"), ctx.input("Ids")
+    padding_idx = ctx.attr("padding_idx", -1)
+    ids2 = ids.astype(jnp.int32)
+    if ids2.ndim >= 2 and ids2.shape[-1] == 1:
+        ids2 = ids2.squeeze(-1)
+    out = jnp.take(w, ids2, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids2 == padding_idx)[..., None]
+        out = jnp.where(mask, jnp.zeros_like(out), out)
+    ctx.set_output("Out", out)
+
+
+@register_no_grad_op("one_hot")
+def one_hot(ctx):
+    x = ctx.input("X")
+    depth = ctx.attr("depth")
+    ids = x.astype(jnp.int32)
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids.squeeze(-1)
+    ctx.set_output("Out", jax.nn.one_hot(ids, depth, dtype=jnp.float32))
+
+
+@register_no_grad_op("shape")
+def shape_op(ctx):
+    x = ctx.input("Input")
+    ctx.set_output("Out", jnp.asarray(np.array(x.shape, np.int32)))
+
+
+@register_no_grad_op("size")
+def size_op(ctx):
+    x = ctx.input("Input")
+    ctx.set_output("Out", jnp.asarray(np.int64(int(np.prod(x.shape)))))
+
+
+@register_op("top_k", intermediate_outputs=("Indices",),
+             no_grad_slots=("K",))
+def top_k(ctx):
+    x = ctx.input("X")
+    k = ctx.attr("k", 1)
+    if ctx.has_input("K"):
+        k = int(ctx.input("K"))
+    vals, idx = lax.top_k(x, k)
+    ctx.set_output("Out", vals)
+    ctx.set_output("Indices", idx.astype(jnp.int64))
+
+
+@register_no_grad_op("argsort")
+def argsort(ctx):
+    x = ctx.input("X")
+    axis = ctx.attr("axis", -1)
+    idx = jnp.argsort(x, axis=axis)
+    ctx.set_output("Indices", idx.astype(jnp.int64))
+    ctx.set_output("Out", jnp.sort(x, axis=axis))
+
+
+@register_no_grad_op("arg_max")
+def arg_max(ctx):
+    ctx.set_output("Out", jnp.argmax(ctx.input("X"),
+                                     axis=ctx.attr("axis", -1)
+                                     ).astype(jnp.int64))
+
+
+@register_no_grad_op("arg_min")
+def arg_min(ctx):
+    ctx.set_output("Out", jnp.argmin(ctx.input("X"),
+                                     axis=ctx.attr("axis", -1)
+                                     ).astype(jnp.int64))
+
+
+@register_op("cumsum")
+def cumsum(ctx):
+    x = ctx.input("X")
+    axis = ctx.attr("axis", -1)
+    exclusive = ctx.attr("exclusive", False)
+    reverse_ = ctx.attr("reverse", False)
+    if reverse_:
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis, dtype=x.dtype)
+    if exclusive:
+        out = out - x
+    if reverse_:
+        out = jnp.flip(out, axis)
+    ctx.set_output("Out", out)
+
+
+@register_op("multiplex", no_grad_slots=("Ids",))
+def multiplex(ctx):
+    xs = jnp.stack(ctx.inputs("X"), axis=0)
+    ids = ctx.input("Ids").astype(jnp.int32).reshape(-1)
+    rows = jnp.arange(ids.shape[0])
+    ctx.set_output("Out", xs[ids, rows])
+
+
+@register_no_grad_op("where")
+def where_index(ctx):
+    # data-dependent output shape: not traceable; host-side only
+    x = ctx.input("Condition")
+    ctx.set_output("Out", jnp.stack(jnp.nonzero(np.asarray(x)),
+                                    axis=-1).astype(jnp.int64))
+
+
+@register_op("where_op_select")
+def where_select(ctx):
+    c = ctx.input("Condition")
+    ctx.set_output("Out", jnp.where(c, ctx.input("X"), ctx.input("Y")))
+
+
+@register_no_grad_op("isfinite")
+def isfinite(ctx):
+    # reference isfinite reduces to a single bool over the whole tensor
+    x = ctx.input("X")
+    ctx.set_output("Out", jnp.all(jnp.isfinite(x))[None])
+
+
+@register_no_grad_op("increment")
+def increment(ctx):
+    x = ctx.input("X")
+    ctx.set_output("Out", x + ctx.attr("step", 1.0))
+
+
+@register_no_grad_op("is_empty")
+def is_empty(ctx):
+    x = ctx.input("X")
+    ctx.set_output("Out", jnp.asarray([int(np.prod(x.shape)) == 0]))
+
+
+@register_no_grad_op("shard_index")
+def shard_index(ctx):
+    x = ctx.input("X")
+    index_num = ctx.attr("index_num")
+    nshards = ctx.attr("nshards")
+    shard_id = ctx.attr("shard_id")
+    ignore_value = ctx.attr("ignore_value", -1)
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (x // shard_size) == shard_id
+    ctx.set_output("Out", jnp.where(in_shard, x % shard_size, ignore_value))
+
+
+@register_op("label_smooth")
+def label_smooth(ctx):
+    x = ctx.input("X")
+    eps = ctx.attr("epsilon", 0.0)
+    dist = ctx.input("PriorDist")
+    if dist is not None:
+        out = (1 - eps) * x + eps * dist
+    else:
+        out = (1 - eps) * x + eps / x.shape[-1]
+    ctx.set_output("Out", out)

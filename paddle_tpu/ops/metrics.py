@@ -1,0 +1,126 @@
+"""Metric ops.
+
+Parity: /root/reference/paddle/fluid/operators/metrics/ (accuracy_op.cc,
+auc_op.cc, precision_recall_op.cc) + mean_iou, chunk_eval (host-side).
+Stateful metric accumulators (AUC stat batches) are persistable vars
+updated functionally, same pattern as batch-norm stats.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_no_grad_op
+
+
+@register_no_grad_op("accuracy")
+def accuracy(ctx):
+    out = ctx.input("Out")        # top-k values' indices input
+    indices = ctx.input("Indices")
+    label = ctx.input("Label")
+    lbl = label.astype(jnp.int64)
+    if lbl.ndim == 2 and lbl.shape[-1] == 1:
+        lbl = lbl
+    else:
+        lbl = lbl[:, None]
+    correct_k = jnp.any(indices == lbl, axis=-1)
+    num_correct = jnp.sum(correct_k.astype(jnp.float32))
+    n = indices.shape[0]
+    ctx.set_output("Correct", num_correct.astype(jnp.int32))
+    ctx.set_output("Total", jnp.asarray(np.int32(n)))
+    ctx.set_output("Accuracy", (num_correct / n).reshape(1))
+
+
+@register_no_grad_op("auc")
+def auc(ctx):
+    """Streaming AUC via threshold-bucketed stats, matching the reference's
+    StatPos/StatNeg accumulator design (metrics/auc_op.h)."""
+    predict = ctx.input("Predict")  # [N, 2] probs
+    label = ctx.input("Label")
+    stat_pos = ctx.input("StatPos")
+    stat_neg = ctx.input("StatNeg")
+    num_thresholds = ctx.attr("num_thresholds", 4095)
+    pos_prob = predict[:, 1]
+    lbl = label.reshape(-1).astype(jnp.int32)
+    bucket = jnp.clip((pos_prob * num_thresholds).astype(jnp.int32), 0,
+                      num_thresholds)
+    pos_hist = jnp.zeros_like(stat_pos).at[bucket].add(
+        (lbl == 1).astype(stat_pos.dtype))
+    neg_hist = jnp.zeros_like(stat_neg).at[bucket].add(
+        (lbl == 0).astype(stat_neg.dtype))
+    new_pos = stat_pos + pos_hist
+    new_neg = stat_neg + neg_hist
+    # AUC = sum over buckets (descending) of trapezoid area
+    pos_desc = jnp.cumsum(new_pos[::-1])
+    neg_desc = jnp.cumsum(new_neg[::-1])
+    tot_pos = pos_desc[-1]
+    tot_neg = neg_desc[-1]
+    pos_prev = jnp.concatenate([jnp.zeros(1, pos_desc.dtype),
+                                pos_desc[:-1]])
+    neg_prev = jnp.concatenate([jnp.zeros(1, neg_desc.dtype),
+                                neg_desc[:-1]])
+    area = jnp.sum((neg_desc - neg_prev) * (pos_desc + pos_prev) / 2.0)
+    auc_val = jnp.where(tot_pos * tot_neg > 0,
+                        area / (tot_pos * tot_neg), 0.0)
+    ctx.set_output("AUC", auc_val.reshape(()))
+    ctx.set_output("StatPosOut", new_pos)
+    ctx.set_output("StatNegOut", new_neg)
+
+
+@register_no_grad_op("mean_iou")
+def mean_iou(ctx):
+    pred = ctx.input("Predictions").reshape(-1).astype(jnp.int32)
+    label = ctx.input("Labels").reshape(-1).astype(jnp.int32)
+    num_classes = ctx.attr("num_classes")
+    conf = jnp.zeros((num_classes, num_classes), jnp.float32
+                     ).at[label, pred].add(1.0)
+    inter = jnp.diag(conf)
+    union = conf.sum(0) + conf.sum(1) - inter
+    valid = union > 0
+    iou = jnp.where(valid, inter / jnp.maximum(union, 1e-9), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid), 1)
+    ctx.set_output("OutMeanIou", miou.reshape(()))
+    ctx.set_output("OutWrong", (conf.sum(1) - inter).astype(jnp.int32))
+    ctx.set_output("OutCorrect", inter.astype(jnp.int32))
+
+
+@register_no_grad_op("precision_recall")
+def precision_recall(ctx):
+    max_probs = ctx.input("MaxProbs")
+    indices = ctx.input("Indices").reshape(-1).astype(jnp.int32)
+    labels = ctx.input("Labels").reshape(-1).astype(jnp.int32)
+    states = ctx.input("StatesInfo")
+    cls_num = ctx.attr("class_number")
+    weights = ctx.input("Weights")
+    w = weights.reshape(-1) if weights is not None else \
+        jnp.ones_like(labels, jnp.float32)
+    tp = jnp.zeros(cls_num, jnp.float32).at[labels].add(
+        w * (indices == labels))
+    fp = jnp.zeros(cls_num, jnp.float32).at[indices].add(
+        w * (indices != labels))
+    fn = jnp.zeros(cls_num, jnp.float32).at[labels].add(
+        w * (indices != labels))
+    batch_states = jnp.stack(
+        [tp, fp, fn, jnp.zeros(cls_num, jnp.float32)], axis=1)
+    acc_states = states + batch_states if states is not None else \
+        batch_states
+
+    def _metrics(st):
+        tp_, fp_, fn_ = st[:, 0], st[:, 1], st[:, 2]
+        prec = jnp.where(tp_ + fp_ > 0, tp_ / (tp_ + fp_), 0.0)
+        rec = jnp.where(tp_ + fn_ > 0, tp_ / (tp_ + fn_), 0.0)
+        f1 = jnp.where(prec + rec > 0, 2 * prec * rec / (prec + rec), 0.0)
+        macro = jnp.stack([prec.mean(), rec.mean(), f1.mean()])
+        tps, fps, fns = tp_.sum(), fp_.sum(), fn_.sum()
+        mprec = jnp.where(tps + fps > 0, tps / (tps + fps), 0.0)
+        mrec = jnp.where(tps + fns > 0, tps / (tps + fns), 0.0)
+        mf1 = jnp.where(mprec + mrec > 0,
+                        2 * mprec * mrec / (mprec + mrec), 0.0)
+        micro = jnp.stack([mprec, mrec, mf1])
+        return jnp.concatenate([macro, micro])
+
+    ctx.set_output("BatchMetrics", _metrics(batch_states))
+    ctx.set_output("AccumMetrics", _metrics(acc_states))
+    ctx.set_output("AccumStatesInfo", acc_states)

@@ -1,0 +1,366 @@
+"""Dense NN ops: softmax family, losses, normalization, dropout.
+
+Parity: reference softmax_op.cc, cross_entropy_op.cc,
+softmax_with_cross_entropy_op.cc, batch_norm_op.cc, layer_norm_op.cc,
+group_norm_op.cc, dropout_op.cc, sigmoid_cross_entropy_with_logits_op.cc,
+huber_loss_op.cc, log_loss_op.cc, hinge_loss_op.cc, rank_loss_op.cc,
+data_norm, lrn. All lower to fused XLA; batch_norm's running-stat update is
+expressed functionally (MeanOut/VarianceOut persistables).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op, register_no_grad_op
+
+
+@register_op("softmax")
+def softmax(ctx):
+    x = ctx.input("X")
+    ctx.set_output("Out", jax.nn.softmax(x, axis=-1))
+
+
+@register_op("log_softmax")
+def log_softmax(ctx):
+    ctx.set_output("Out", jax.nn.log_softmax(ctx.input("X"), axis=-1))
+
+
+@register_op("cross_entropy", no_grad_slots=("Label",))
+def cross_entropy(ctx):
+    x, label = ctx.input("X"), ctx.input("Label")
+    soft = ctx.attr("soft_label", False)
+    ignore_index = ctx.attr("ignore_index", -100)
+    eps = 1e-12
+    if soft:
+        out = -jnp.sum(label * jnp.log(x + eps), axis=-1, keepdims=True)
+    else:
+        ids = label.astype(jnp.int32)
+        if ids.ndim == x.ndim:
+            ids = ids.squeeze(-1)
+        picked = jnp.take_along_axis(x, ids[..., None], axis=-1)
+        out = -jnp.log(picked + eps)
+        mask = (ids[..., None] != ignore_index)
+        out = jnp.where(mask, out, 0.0)
+    ctx.set_output("Y", out)
+
+
+@register_op("cross_entropy2", no_grad_slots=("Label",))
+def cross_entropy2(ctx):
+    x, label = ctx.input("X"), ctx.input("Label")
+    ids = label.astype(jnp.int32)
+    if ids.ndim == x.ndim:
+        ids = ids.squeeze(-1)
+    picked = jnp.take_along_axis(x, ids[..., None], axis=-1)
+    y = -jnp.log(picked + 1e-12)
+    ctx.set_output("Y", y)
+    ctx.set_output("XShape", jnp.zeros((0,) + x.shape, x.dtype))
+    ctx.set_output("MatchX", picked)
+
+
+@register_op("softmax_with_cross_entropy", no_grad_slots=("Label",),
+             intermediate_outputs=("Softmax",))
+def softmax_with_cross_entropy(ctx):
+    logits, label = ctx.input("Logits"), ctx.input("Label")
+    soft = ctx.attr("soft_label", False)
+    ignore_index = ctx.attr("ignore_index", -100)
+    log_p = jax.nn.log_softmax(logits, axis=-1)
+    if soft:
+        loss = -jnp.sum(label * log_p, axis=-1, keepdims=True)
+    else:
+        ids = label.astype(jnp.int32)
+        if ids.ndim == logits.ndim:
+            ids = ids.squeeze(-1)
+        loss = -jnp.take_along_axis(log_p, ids[..., None], axis=-1)
+        loss = jnp.where(ids[..., None] != ignore_index, loss, 0.0)
+    ctx.set_output("Softmax", jnp.exp(log_p))
+    ctx.set_output("Loss", loss)
+
+
+@register_op("sigmoid_cross_entropy_with_logits",
+             no_grad_slots=("Label",))
+def sigmoid_cross_entropy_with_logits(ctx):
+    x, label = ctx.input("X"), ctx.input("Label")
+    ignore_index = ctx.attr("ignore_index", -100)
+    normalize = ctx.attr("normalize", False)
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    mask = (label != ignore_index)
+    loss = jnp.where(mask, loss, 0.0)
+    if normalize:
+        loss = loss / jnp.maximum(jnp.sum(mask.astype(x.dtype)), 1.0)
+    ctx.set_output("Out", loss)
+
+
+@register_op("log_loss", no_grad_slots=("Labels",))
+def log_loss(ctx):
+    p, y = ctx.input("Predicted"), ctx.input("Labels")
+    eps = ctx.attr("epsilon", 1e-4)
+    ctx.set_output("Loss",
+                   -y * jnp.log(p + eps) - (1 - y) * jnp.log(1 - p + eps))
+
+
+@register_op("huber_loss", no_grad_slots=("Y",))
+def huber_loss(ctx):
+    x, y = ctx.input("X"), ctx.input("Y")
+    delta = ctx.attr("delta", 1.0)
+    r = y - x
+    ar = jnp.abs(r)
+    loss = jnp.where(ar <= delta, 0.5 * r * r,
+                     delta * (ar - 0.5 * delta))
+    ctx.set_output("Residual", r)
+    ctx.set_output("Out", loss)
+
+
+@register_op("smooth_l1_loss", no_grad_slots=("Y",))
+def smooth_l1_loss(ctx):
+    x, y = ctx.input("X"), ctx.input("Y")
+    sigma = ctx.attr("sigma", 1.0)
+    s2 = sigma * sigma
+    d = x - y
+    in_w, out_w = ctx.input("InsideWeight"), ctx.input("OutsideWeight")
+    if in_w is not None:
+        d = d * in_w
+    ad = jnp.abs(d)
+    loss = jnp.where(ad < 1.0 / s2, 0.5 * d * d * s2, ad - 0.5 / s2)
+    if out_w is not None:
+        loss = loss * out_w
+    ctx.set_output("Diff", d)
+    ctx.set_output("Out", jnp.sum(loss, axis=tuple(range(1, x.ndim)),
+                                  keepdims=False)[:, None])
+
+
+@register_op("hinge_loss", no_grad_slots=("Labels",))
+def hinge_loss(ctx):
+    logits, labels = ctx.input("Logits"), ctx.input("Labels")
+    ctx.set_output("Loss",
+                   jnp.maximum(1.0 - (2.0 * labels - 1.0) * logits, 0.0))
+
+
+@register_op("rank_loss", no_grad_slots=("Label",))
+def rank_loss(ctx):
+    label = ctx.input("Label")
+    left, right = ctx.input("Left"), ctx.input("Right")
+    d = left - right
+    ctx.set_output("Out",
+                   jnp.log1p(jnp.exp(d)) - label * d)
+
+
+@register_op("margin_rank_loss", no_grad_slots=("Label",))
+def margin_rank_loss(ctx):
+    label = ctx.input("Label")
+    x1, x2 = ctx.input("X1"), ctx.input("X2")
+    margin = ctx.attr("margin", 0.0)
+    out = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    ctx.set_output("Activated", (out > 0).astype(x1.dtype))
+    ctx.set_output("Out", out)
+
+
+@register_op("kldiv_loss", no_grad_slots=("Target",))
+def kldiv_loss(ctx):
+    x, target = ctx.input("X"), ctx.input("Target")
+    reduction = ctx.attr("reduction", "mean")
+    loss = jnp.where(target > 0, target * (jnp.log(target) - x), 0.0)
+    if reduction == "mean":
+        loss = jnp.mean(loss)
+    elif reduction == "sum":
+        loss = jnp.sum(loss)
+    elif reduction == "batchmean":
+        loss = jnp.sum(loss) / x.shape[0]
+    ctx.set_output("Loss", loss)
+
+
+@register_op("bpr_loss", no_grad_slots=("Label",))
+def bpr_loss(ctx):
+    x, label = ctx.input("X"), ctx.input("Label")
+    ids = label.astype(jnp.int32)
+    if ids.ndim == x.ndim:
+        ids = ids.squeeze(-1)
+    pos = jnp.take_along_axis(x, ids[..., None], axis=-1)
+    # mean over negatives of log(sigmoid(pos - neg)); exclude the positive
+    diff = pos - x
+    loss = -jnp.log(jax.nn.sigmoid(diff) + 1e-12)
+    n = x.shape[-1]
+    mask = jax.nn.one_hot(ids, n, dtype=x.dtype)
+    loss = jnp.sum(loss * (1 - mask), axis=-1, keepdims=True) / (n - 1)
+    ctx.set_output("Y", loss)
+
+
+# -- dropout ----------------------------------------------------------------
+
+@register_op("dropout", intermediate_outputs=("Mask",))
+def dropout(ctx):
+    x = ctx.input("X")
+    prob = ctx.attr("dropout_prob", 0.5)
+    is_test = ctx.attr("is_test", False)
+    impl = ctx.attr("dropout_implementation", "downgrade_in_infer")
+    if is_test:
+        out = x if impl == "upscale_in_train" else x * (1.0 - prob)
+        ctx.set_output("Out", out)
+        ctx.set_output("Mask", jnp.ones_like(x, dtype=jnp.uint8))
+        return
+    keep = jax.random.bernoulli(ctx.rng(), 1.0 - prob, x.shape)
+    if impl == "upscale_in_train":
+        out = jnp.where(keep, x / max(1.0 - prob, 1e-8), 0.0)
+    else:
+        out = jnp.where(keep, x, 0.0)
+    ctx.set_output("Out", out)
+    ctx.set_output("Mask", keep.astype(jnp.uint8))
+
+
+# -- normalization ----------------------------------------------------------
+
+@register_op("batch_norm", no_grad_slots=("Mean", "Variance"),
+             stateful_outputs=("MeanOut", "VarianceOut"))
+def batch_norm(ctx):
+    x = ctx.input("X")
+    scale, bias = ctx.input("Scale"), ctx.input("Bias")
+    mean_in, var_in = ctx.input("Mean"), ctx.input("Variance")
+    eps = ctx.attr("epsilon", 1e-5)
+    momentum = ctx.attr("momentum", 0.9)
+    is_test = ctx.attr("is_test", False)
+    use_global = ctx.attr("use_global_stats", False) or is_test
+    layout = ctx.attr("data_layout", "NCHW")
+    ch_axis = 1 if layout == "NCHW" else x.ndim - 1
+    red_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+
+    if use_global:
+        mean, var = mean_in, var_in
+        saved_mean = jnp.zeros_like(mean_in)
+        saved_var = jnp.zeros_like(var_in)
+        mean_out, var_out = mean_in, var_in
+    else:
+        mean = jnp.mean(x, axis=red_axes)
+        var = jnp.var(x, axis=red_axes)
+        mean_out = mean_in * momentum + mean * (1 - momentum)
+        var_out = var_in * momentum + var * (1 - momentum)
+        saved_mean = mean
+        saved_var = 1.0 / jnp.sqrt(var + eps)
+
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+    xhat = (x - mean.reshape(shape)) * lax.rsqrt(var.reshape(shape) + eps)
+    y = xhat * scale.reshape(shape) + bias.reshape(shape)
+    ctx.set_output("Y", y)
+    ctx.set_output("MeanOut", mean_out)
+    ctx.set_output("VarianceOut", var_out)
+    ctx.set_output("SavedMean", saved_mean)
+    ctx.set_output("SavedVariance", saved_var)
+
+
+@register_op("layer_norm")
+def layer_norm(ctx):
+    x = ctx.input("X")
+    scale, bias = ctx.input("Scale"), ctx.input("Bias")
+    eps = ctx.attr("epsilon", 1e-5)
+    begin = ctx.attr("begin_norm_axis", 1)
+    axes = tuple(range(begin, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    xhat = (x - mean) * lax.rsqrt(var + eps)
+    y = xhat
+    norm_shape = x.shape[begin:]
+    if scale is not None:
+        y = y * scale.reshape((1,) * begin + norm_shape)
+    if bias is not None:
+        y = y + bias.reshape((1,) * begin + norm_shape)
+    ctx.set_output("Y", y)
+    ctx.set_output("Mean", mean.reshape(x.shape[:begin]))
+    ctx.set_output("Variance", var.reshape(x.shape[:begin]))
+
+
+@register_op("group_norm")
+def group_norm(ctx):
+    x = ctx.input("X")  # NCHW
+    scale, bias = ctx.input("Scale"), ctx.input("Bias")
+    eps = ctx.attr("epsilon", 1e-5)
+    groups = ctx.attr("groups")
+    n, c = x.shape[0], x.shape[1]
+    g = x.reshape(n, groups, c // groups, *x.shape[2:])
+    axes = tuple(range(2, g.ndim))
+    mean = jnp.mean(g, axis=axes, keepdims=True)
+    var = jnp.var(g, axis=axes, keepdims=True)
+    xhat = ((g - mean) * lax.rsqrt(var + eps)).reshape(x.shape)
+    shape = [1, c] + [1] * (x.ndim - 2)
+    y = xhat
+    if scale is not None:
+        y = y * scale.reshape(shape)
+    if bias is not None:
+        y = y + bias.reshape(shape)
+    ctx.set_output("Y", y)
+    ctx.set_output("Mean", mean.reshape(n, groups))
+    ctx.set_output("Variance", var.reshape(n, groups))
+
+
+@register_op("instance_norm")
+def instance_norm(ctx):
+    x = ctx.input("X")
+    scale, bias = ctx.input("Scale"), ctx.input("Bias")
+    eps = ctx.attr("epsilon", 1e-5)
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + eps)
+    c = x.shape[1]
+    shape = [1, c] + [1] * (x.ndim - 2)
+    if scale is not None:
+        y = y * scale.reshape(shape)
+    if bias is not None:
+        y = y + bias.reshape(shape)
+    ctx.set_output("Y", y)
+
+
+@register_op("lrn")
+def lrn(ctx):
+    x = ctx.input("X")  # NCHW
+    n = ctx.attr("n", 5)
+    alpha = ctx.attr("alpha", 1e-4)
+    beta = ctx.attr("beta", 0.75)
+    k = ctx.attr("k", 1.0)
+    sq = x * x
+    half = n // 2
+    pad = jnp.pad(sq, [(0, 0), (half, half), (0, 0), (0, 0)])
+    acc = jnp.zeros_like(x)
+    for i in range(n):
+        acc = acc + pad[:, i:i + x.shape[1]]
+    mid = k + alpha * acc
+    ctx.set_output("MidOut", mid)
+    ctx.set_output("Out", x / jnp.power(mid, beta))
+
+
+@register_op("l2_normalize")
+def l2_normalize(ctx):
+    x = ctx.input("X")
+    axis = ctx.attr("axis", -1)
+    eps = ctx.attr("epsilon", 1e-10)
+    ctx.set_output("Out", x * lax.rsqrt(
+        jnp.sum(x * x, axis=axis, keepdims=True) + eps))
+
+
+@register_op("data_norm")
+def data_norm(ctx):
+    x = ctx.input("X")
+    size = ctx.input("BatchSize")
+    bsum = ctx.input("BatchSum")
+    bsq = ctx.input("BatchSquareSum")
+    means = bsum / size
+    scales = jnp.sqrt(size / bsq)
+    ctx.set_output("Means", means)
+    ctx.set_output("Scales", scales)
+    ctx.set_output("Y", (x - means) * scales)
+
+
+@register_op("add_position_encoding")
+def add_position_encoding(ctx):
+    x = ctx.input("X")  # [B, T, D]
+    alpha = ctx.attr("alpha", 1.0)
+    beta = ctx.attr("beta", 1.0)
+    b, t, d = x.shape
+    pos = np.arange(t)[:, None]
+    i = np.arange(d // 2)[None, :]
+    angle = pos / np.power(10000.0, 2.0 * i / d)
+    enc = np.concatenate([np.sin(angle), np.cos(angle)], axis=-1)
+    ctx.set_output("Out", alpha * x + beta * jnp.asarray(
+        enc, x.dtype)[None, :, :])

@@ -1,0 +1,273 @@
+"""Optimizer update ops — all 13 reference rules.
+
+Parity: /root/reference/paddle/fluid/operators/optimizers/ (sgd, momentum,
+lars_momentum, adam, adamax, adagrad, decayed_adagrad, proximal_adagrad,
+proximal_gd, adadelta, rmsprop, ftrl, lamb). Updates are functional writes
+to ParamOut/...Out names (which alias the inputs by name), so the engine's
+buffer donation makes them in-place at the XLA level. Gradients never flow
+through updates (register_no_grad_op).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_no_grad_op
+
+
+@register_no_grad_op("sgd")
+def sgd(ctx):
+    p, g, lr = ctx.input("Param"), ctx.input("Grad"), \
+        ctx.input("LearningRate")
+    ctx.set_output("ParamOut", p - lr.reshape(()).astype(p.dtype) * g)
+
+
+@register_no_grad_op("momentum")
+def momentum(ctx):
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    v = ctx.input("Velocity")
+    lr = ctx.input("LearningRate").reshape(()).astype(p.dtype)
+    mu = ctx.attr("mu")
+    use_nesterov = ctx.attr("use_nesterov", False)
+    v_new = mu * v + g
+    if use_nesterov:
+        p_new = p - (g + mu * v_new) * lr
+    else:
+        p_new = p - lr * v_new
+    ctx.set_output("ParamOut", p_new)
+    ctx.set_output("VelocityOut", v_new)
+
+
+@register_no_grad_op("lars_momentum")
+def lars_momentum(ctx):
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    v = ctx.input("Velocity")
+    lr = ctx.input("LearningRate").reshape(()).astype(p.dtype)
+    mu = ctx.attr("mu")
+    coeff = ctx.attr("lars_coeff", 0.001)
+    decay = ctx.attr("lars_weight_decay", 0.0005)
+    p_norm = jnp.sqrt(jnp.sum(p * p))
+    g_norm = jnp.sqrt(jnp.sum(g * g))
+    local_lr = lr * coeff * p_norm / (g_norm + decay * p_norm + 1e-18)
+    v_new = mu * v + local_lr * (g + decay * p)
+    ctx.set_output("ParamOut", p - v_new)
+    ctx.set_output("VelocityOut", v_new)
+
+
+@register_no_grad_op("adam")
+def adam(ctx):
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    m, v = ctx.input("Moment1"), ctx.input("Moment2")
+    lr = ctx.input("LearningRate").reshape(()).astype(p.dtype)
+    b1p = ctx.input("Beta1Pow").reshape(()).astype(p.dtype)
+    b2p = ctx.input("Beta2Pow").reshape(()).astype(p.dtype)
+    b1 = ctx.attr("beta1", 0.9)
+    b2 = ctx.attr("beta2", 0.999)
+    eps = ctx.attr("epsilon", 1e-8)
+    m_new = b1 * m + (1 - b1) * g
+    v_new = b2 * v + (1 - b2) * g * g
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    p_new = p - lr_t * m_new / (jnp.sqrt(v_new) + eps)
+    ctx.set_output("ParamOut", p_new)
+    ctx.set_output("Moment1Out", m_new)
+    ctx.set_output("Moment2Out", v_new)
+    # reference updates beta pows in a separate scale op; we fold them here
+    # when the Out slots are bound (python optimizer binds them).
+    ctx.set_output("Beta1PowOut", (b1p * b1).reshape(
+        ctx.input("Beta1Pow").shape))
+    ctx.set_output("Beta2PowOut", (b2p * b2).reshape(
+        ctx.input("Beta2Pow").shape))
+
+
+@register_no_grad_op("adamax")
+def adamax(ctx):
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    m, inf = ctx.input("Moment"), ctx.input("InfNorm")
+    lr = ctx.input("LearningRate").reshape(()).astype(p.dtype)
+    b1p = ctx.input("Beta1Pow").reshape(()).astype(p.dtype)
+    b1, b2 = ctx.attr("beta1", 0.9), ctx.attr("beta2", 0.999)
+    eps = ctx.attr("epsilon", 1e-8)
+    m_new = b1 * m + (1 - b1) * g
+    inf_new = jnp.maximum(b2 * inf, jnp.abs(g))
+    p_new = p - (lr / (1 - b1p)) * m_new / (inf_new + eps)
+    ctx.set_output("ParamOut", p_new)
+    ctx.set_output("MomentOut", m_new)
+    ctx.set_output("InfNormOut", inf_new)
+
+
+@register_no_grad_op("adagrad")
+def adagrad(ctx):
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    mom = ctx.input("Moment")
+    lr = ctx.input("LearningRate").reshape(()).astype(p.dtype)
+    eps = ctx.attr("epsilon", 1e-6)
+    m_new = mom + g * g
+    ctx.set_output("ParamOut", p - lr * g / (jnp.sqrt(m_new) + eps))
+    ctx.set_output("MomentOut", m_new)
+
+
+@register_no_grad_op("decayed_adagrad")
+def decayed_adagrad(ctx):
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    mom = ctx.input("Moment")
+    lr = ctx.input("LearningRate").reshape(()).astype(p.dtype)
+    decay = ctx.attr("decay", 0.95)
+    eps = ctx.attr("epsilon", 1e-6)
+    m_new = decay * mom + (1 - decay) * g * g
+    ctx.set_output("ParamOut", p - lr * g / (jnp.sqrt(m_new) + eps))
+    ctx.set_output("MomentOut", m_new)
+
+
+@register_no_grad_op("proximal_adagrad")
+def proximal_adagrad(ctx):
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    mom = ctx.input("Moment")
+    lr = ctx.input("LearningRate").reshape(()).astype(p.dtype)
+    l1 = ctx.attr("l1", 0.0)
+    l2 = ctx.attr("l2", 0.0)
+    m_new = mom + g * g
+    lr_t = lr / jnp.sqrt(m_new)
+    prox = p - lr_t * g
+    p_new = jnp.sign(prox) * jnp.maximum(
+        jnp.abs(prox) - lr_t * l1, 0.0) / (1.0 + lr_t * l2)
+    ctx.set_output("ParamOut", p_new)
+    ctx.set_output("MomentOut", m_new)
+
+
+@register_no_grad_op("proximal_gd")
+def proximal_gd(ctx):
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    lr = ctx.input("LearningRate").reshape(()).astype(p.dtype)
+    l1 = ctx.attr("l1", 0.0)
+    l2 = ctx.attr("l2", 0.0)
+    prox = p - lr * g
+    p_new = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1,
+                                         0.0) / (1.0 + lr * l2)
+    ctx.set_output("ParamOut", p_new)
+
+
+@register_no_grad_op("adadelta")
+def adadelta(ctx):
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    avg_sq_g = ctx.input("AvgSquaredGrad")
+    avg_sq_u = ctx.input("AvgSquaredUpdate")
+    rho = ctx.attr("rho", 0.95)
+    eps = ctx.attr("epsilon", 1e-6)
+    g2 = rho * avg_sq_g + (1 - rho) * g * g
+    upd = -jnp.sqrt((avg_sq_u + eps) / (g2 + eps)) * g
+    u2 = rho * avg_sq_u + (1 - rho) * upd * upd
+    ctx.set_output("ParamOut", p + upd)
+    ctx.set_output("AvgSquaredGradOut", g2)
+    ctx.set_output("AvgSquaredUpdateOut", u2)
+
+
+@register_no_grad_op("rmsprop")
+def rmsprop(ctx):
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    ms = ctx.input("MeanSquare")
+    mom = ctx.input("Moment")
+    lr = ctx.input("LearningRate").reshape(()).astype(p.dtype)
+    eps = ctx.attr("epsilon", 1e-10)
+    decay = ctx.attr("decay", 0.9)
+    momentum_c = ctx.attr("momentum", 0.0)
+    centered = ctx.attr("centered", False)
+    ms_new = decay * ms + (1 - decay) * g * g
+    if centered:
+        mg = ctx.input("MeanGrad")
+        mg_new = decay * mg + (1 - decay) * g
+        denom = ms_new - mg_new * mg_new + eps
+        ctx.set_output("MeanGradOut", mg_new)
+    else:
+        denom = ms_new + eps
+    mom_new = momentum_c * mom + lr * g / jnp.sqrt(denom)
+    ctx.set_output("ParamOut", p - mom_new)
+    ctx.set_output("MeanSquareOut", ms_new)
+    ctx.set_output("MomentOut", mom_new)
+
+
+@register_no_grad_op("ftrl")
+def ftrl(ctx):
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    sq_acc = ctx.input("SquaredAccumulator")
+    lin_acc = ctx.input("LinearAccumulator")
+    lr = ctx.input("LearningRate").reshape(()).astype(p.dtype)
+    l1 = ctx.attr("l1", 0.0)
+    l2 = ctx.attr("l2", 0.0)
+    power = ctx.attr("lr_power", -0.5)
+    new_sq = sq_acc + g * g
+    if power == -0.5:
+        sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq_acc)) / lr
+    else:
+        sigma = (jnp.power(new_sq, -power) -
+                 jnp.power(sq_acc, -power)) / lr
+    new_lin = lin_acc + g - sigma * p
+    if power == -0.5:
+        x = l2 + jnp.sqrt(new_sq) / lr
+    else:
+        x = l2 + jnp.power(new_sq, -power) / lr
+    pre = jnp.clip(new_lin, -l1, l1) - new_lin
+    p_new = pre / x
+    ctx.set_output("ParamOut", p_new)
+    ctx.set_output("SquaredAccumOut", new_sq)
+    ctx.set_output("LinearAccumOut", new_lin)
+
+
+@register_no_grad_op("lamb")
+def lamb(ctx):
+    p, g = ctx.input("Param"), ctx.input("Grad")
+    m, v = ctx.input("Moment1"), ctx.input("Moment2")
+    lr = ctx.input("LearningRate").reshape(()).astype(p.dtype)
+    b1p = ctx.input("Beta1Pow").reshape(()).astype(p.dtype)
+    b2p = ctx.input("Beta2Pow").reshape(()).astype(p.dtype)
+    b1 = ctx.attr("beta1", 0.9)
+    b2 = ctx.attr("beta2", 0.999)
+    eps = ctx.attr("epsilon", 1e-6)
+    wd = ctx.attr("weight_decay", 0.0)
+    m_new = b1 * m + (1 - b1) * g
+    v_new = b2 * v + (1 - b2) * g * g
+    m_hat = m_new / (1 - b1p)
+    v_hat = v_new / (1 - b2p)
+    r = m_hat / (jnp.sqrt(v_hat) + eps) + wd * p
+    p_norm = jnp.sqrt(jnp.sum(p * p))
+    r_norm = jnp.sqrt(jnp.sum(r * r))
+    trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+    ctx.set_output("ParamOut", p - lr * trust * r)
+    ctx.set_output("Moment1Out", m_new)
+    ctx.set_output("Moment2Out", v_new)
+    ctx.set_output("Beta1PowOut", (b1p * b1).reshape(
+        ctx.input("Beta1Pow").shape))
+    ctx.set_output("Beta2PowOut", (b2p * b2).reshape(
+        ctx.input("Beta2Pow").shape))
+
+
+@register_no_grad_op("average_accumulates")
+def average_accumulates(ctx):
+    """ModelAverage support: accumulate param sums over windows."""
+    p = ctx.input("param")
+    sum1 = ctx.input("in_sum_1")
+    sum2 = ctx.input("in_sum_2")
+    sum3 = ctx.input("in_sum_3")
+    num_acc = ctx.input("in_num_accumulates")
+    old_num = ctx.input("in_old_num_accumulates")
+    num_upd = ctx.input("in_num_updates")
+    avg_window = ctx.attr("average_window", 0.0)
+    max_avg_win = ctx.attr("max_average_window", 10000)
+    min_avg_win = ctx.attr("min_average_window", 10000)
+    num_acc_n = num_acc + 1
+    num_upd_n = num_upd + 1
+    sum1_n = sum1 + p
+    # window roll: reference moves sum1->sum2->sum3 when window exceeded
+    exceed = (num_upd_n / jnp.maximum(num_acc_n, 1) > avg_window) if \
+        avg_window > 0 else (num_acc_n >= max_avg_win)
+    exceed = exceed & (num_acc_n >= min_avg_win)
+    sum2_n = jnp.where(exceed, sum2 + sum1_n, sum2)
+    sum3_n = jnp.where(exceed, jnp.zeros_like(sum3), sum3)
+    sum1_n = jnp.where(exceed, jnp.zeros_like(sum1_n), sum1_n)
+    old_num_n = jnp.where(exceed, num_acc_n, old_num)
+    num_acc_n = jnp.where(exceed, jnp.zeros_like(num_acc_n), num_acc_n)
+    ctx.set_output("out_sum_1", sum1_n)
+    ctx.set_output("out_sum_2", sum2_n)
+    ctx.set_output("out_sum_3", sum3_n)
+    ctx.set_output("out_num_accumulates", num_acc_n)
+    ctx.set_output("out_old_num_accumulates", old_num_n)
+    ctx.set_output("out_num_updates", num_upd_n)

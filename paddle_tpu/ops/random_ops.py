@@ -1,0 +1,107 @@
+"""Random ops — explicit threaded PRNG (TPU-native determinism).
+
+Parity: reference uniform_random_op.cc, gaussian_random_op.cc,
+truncated_gaussian_random_op.cc, sampling_id_op.cc, random_crop_op.cc.
+Keys derive from (step key, op uid) via ctx.rng(), honoring the `seed`
+attr; a forward op and its grad op share a uid so vjp replay sees the same
+draw.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_no_grad_op, register_op
+from ..core.types import dtype_to_np
+
+
+def _shape(ctx):
+    if ctx.has_input("ShapeTensor"):
+        return [int(s) for s in np.asarray(ctx.input("ShapeTensor"))]
+    return [int(s) for s in ctx.attr("shape", [])]
+
+
+@register_no_grad_op("uniform_random")
+def uniform_random(ctx):
+    dt = dtype_to_np(ctx.attr("dtype", 9))
+    lo = ctx.attr("min", -1.0)
+    hi = ctx.attr("max", 1.0)
+    out = jax.random.uniform(ctx.rng(), _shape(ctx), jnp.float32, lo, hi)
+    ctx.set_output("Out", out.astype(dt))
+
+
+@register_no_grad_op("uniform_random_batch_size_like")
+def uniform_random_batch_size_like(ctx):
+    x = ctx.input("Input")
+    shape = [int(s) for s in ctx.attr("shape", [])]
+    shape[ctx.attr("output_dim_idx", 0)] = x.shape[ctx.attr(
+        "input_dim_idx", 0)]
+    out = jax.random.uniform(ctx.rng(), shape, jnp.float32,
+                             ctx.attr("min", -1.0), ctx.attr("max", 1.0))
+    ctx.set_output("Out", out.astype(dtype_to_np(ctx.attr("dtype", 9))))
+
+
+@register_no_grad_op("gaussian_random")
+def gaussian_random(ctx):
+    dt = dtype_to_np(ctx.attr("dtype", 9))
+    mean = ctx.attr("mean", 0.0)
+    std = ctx.attr("std", 1.0)
+    out = mean + std * jax.random.normal(ctx.rng(), _shape(ctx),
+                                         jnp.float32)
+    ctx.set_output("Out", out.astype(dt))
+
+
+@register_no_grad_op("gaussian_random_batch_size_like")
+def gaussian_random_batch_size_like(ctx):
+    x = ctx.input("Input")
+    shape = [int(s) for s in ctx.attr("shape", [])]
+    shape[ctx.attr("output_dim_idx", 0)] = x.shape[ctx.attr(
+        "input_dim_idx", 0)]
+    out = ctx.attr("mean", 0.0) + ctx.attr("std", 1.0) * \
+        jax.random.normal(ctx.rng(), shape, jnp.float32)
+    ctx.set_output("Out", out.astype(dtype_to_np(ctx.attr("dtype", 9))))
+
+
+@register_no_grad_op("truncated_gaussian_random")
+def truncated_gaussian_random(ctx):
+    dt = dtype_to_np(ctx.attr("dtype", 9))
+    mean = ctx.attr("mean", 0.0)
+    std = ctx.attr("std", 1.0)
+    out = mean + std * jax.random.truncated_normal(
+        ctx.rng(), -2.0, 2.0, _shape(ctx), jnp.float32)
+    ctx.set_output("Out", out.astype(dt))
+
+
+@register_no_grad_op("randint")
+def randint(ctx):
+    out = jax.random.randint(ctx.rng(), _shape(ctx),
+                             ctx.attr("low", 0), ctx.attr("high", 100))
+    ctx.set_output("Out", out.astype(dtype_to_np(ctx.attr("dtype", 5))))
+
+
+@register_no_grad_op("sampling_id")
+def sampling_id(ctx):
+    x = ctx.input("X")  # [batch, classes] probabilities
+    ids = jax.random.categorical(ctx.rng(), jnp.log(x + 1e-20), axis=-1)
+    ctx.set_output("Out", ids.astype(jnp.int64))
+
+
+@register_no_grad_op("random_crop")
+def random_crop(ctx):
+    x = ctx.input("X")
+    shape = ctx.attr("shape")
+    key = ctx.rng()
+    nd = len(shape)
+    starts = []
+    for i, s in enumerate(shape):
+        key, k = jax.random.split(key)
+        limit = x.shape[x.ndim - nd + i] - s
+        starts.append(jax.random.randint(k, (), 0, max(limit, 0) + 1))
+    idx = [slice(None)] * (x.ndim - nd)
+    out = jax.lax.dynamic_slice(
+        x, [0] * (x.ndim - nd) + [s for s in starts],
+        list(x.shape[:x.ndim - nd]) + list(shape))
+    ctx.set_output("Out", out)
+    ctx.set_output("SeedOut", jnp.zeros((1,), jnp.int64))

@@ -1,0 +1,100 @@
+"""Reduction ops.
+
+Parity: reference operators/reduce_ops/ (reduce_sum/mean/max/min/prod/
+all/any with dim/keep_dim/reduce_all attrs), mean_op.cc, norm ops.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op, register_no_grad_op
+
+
+def _axes(ctx, x):
+    if ctx.attr("reduce_all", False):
+        return None
+    dims = ctx.attr("dim", [0])
+    if isinstance(dims, int):
+        dims = [dims]
+    return tuple(d if d >= 0 else d + x.ndim for d in dims)
+
+
+def _reduce(op_type, fn, grad=True):
+    reg = register_op if grad else register_no_grad_op
+
+    @reg(op_type)
+    def _lower(ctx, _fn=fn):
+        x = ctx.input("X")
+        out = _fn(x, axis=_axes(ctx, x), keepdims=ctx.attr("keep_dim",
+                                                           False))
+        ctx.set_output("Out", out)
+    _lower.__name__ = op_type
+    return _lower
+
+
+_reduce("reduce_sum", jnp.sum)
+_reduce("reduce_mean", jnp.mean)
+_reduce("reduce_max", jnp.max)
+_reduce("reduce_min", jnp.min)
+_reduce("reduce_prod", jnp.prod)
+_reduce("reduce_all", jnp.all, grad=False)
+_reduce("reduce_any", jnp.any, grad=False)
+
+
+@register_op("mean")
+def mean(ctx):
+    ctx.set_output("Out", jnp.mean(ctx.input("X")))
+
+
+@register_op("squared_l2_norm")
+def squared_l2_norm(ctx):
+    x = ctx.input("X")
+    ctx.set_output("Out", jnp.sum(x * x))
+
+
+@register_op("squared_l2_distance")
+def squared_l2_distance(ctx):
+    x, y = ctx.input("X"), ctx.input("Y")
+    d = x - y
+    ctx.set_output("sub_result", d)
+    ctx.set_output("Out", jnp.sum(d * d, axis=-1, keepdims=True))
+
+
+@register_op("l1_norm")
+def l1_norm(ctx):
+    ctx.set_output("Out", jnp.sum(jnp.abs(ctx.input("X"))))
+
+
+@register_op("norm")
+def norm(ctx):
+    x = ctx.input("X")
+    axis = ctx.attr("axis", -1)
+    eps = ctx.attr("epsilon", 1e-10)
+    n = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True) + eps)
+    ctx.set_output("Norm", n)
+    ctx.set_output("Out", x / n)
+
+
+@register_op("frobenius_norm")
+def frobenius_norm(ctx):
+    x = ctx.input("X")
+    ctx.set_output("Out", jnp.sqrt(jnp.sum(
+        x * x, axis=_axes(ctx, x), keepdims=ctx.attr("keep_dim", False))))
+
+
+@register_op("minus")
+def minus(ctx):
+    ctx.set_output("Out", ctx.input("X") - ctx.input("Y"))
+
+
+@register_op("cos_sim")
+def cos_sim(ctx):
+    x, y = ctx.input("X"), ctx.input("Y")
+    xn = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, axis=-1, keepdims=True))
+    ctx.set_output("XNorm", xn)
+    ctx.set_output("YNorm", yn)
+    ctx.set_output("Out", jnp.sum(x * y, axis=-1, keepdims=True) /
+                   (xn * yn))

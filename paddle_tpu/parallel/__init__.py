@@ -1,0 +1,9 @@
+"""Distributed / multi-device runtime: meshes, collectives, DP/PP engines.
+
+Parity targets (SURVEY.md §2.2-2.3): ParallelExecutor -> DataParallelEngine
+(SPMD over a Mesh), NCCLCommunicator -> CommContext (named mesh axes +
+XLA collectives), transpiler/fleet APIs -> paddle_tpu.parallel.fleet /
+transpiler.
+"""
+from .mesh import CommContext, get_mesh, set_mesh  # noqa: F401
+from .data_parallel import DataParallelEngine  # noqa: F401

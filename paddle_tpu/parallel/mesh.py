@@ -1,0 +1,92 @@
+"""Mesh / communicator context: the NCCLCommunicator-equivalent.
+
+Parity: reference platform/nccl_helper.h (NCCLContextMap :90,
+NCCLCommunicator :179 with flat/multi-ring/hierarchical topologies) and
+collective_helper.h (NCCLCommContext singleton). TPU-native: a
+jax.sharding.Mesh over the chip grid with NAMED axes replaces comm maps;
+ring selection / hierarchical allreduce are subsumed by ICI torus routing
+in XLA's collective implementation, so the context only owns mesh
+construction and axis naming. Multi-host (DCN) uses
+jax.distributed.initialize + the same named-mesh interface (the
+gen_nccl_id TCP bootstrap is replaced by PJRT coordination service).
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["CommContext", "get_mesh", "set_mesh", "make_mesh",
+           "init_distributed_env"]
+
+_current_mesh: List[Optional[Mesh]] = [None]
+
+
+def make_mesh(axis_shapes: Dict[str, int] = None,
+              devices: Sequence = None) -> Mesh:
+    """Build a named mesh. axis_shapes e.g. {"dp": 4, "mp": 2}; -1 on one
+    axis means 'rest of the devices'."""
+    devices = list(devices if devices is not None else jax.devices())
+    if not axis_shapes:
+        axis_shapes = {"dp": len(devices)}
+    names = list(axis_shapes)
+    sizes = list(axis_shapes.values())
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = len(devices) // known
+    n = int(np.prod(sizes))
+    grid = np.array(devices[:n]).reshape(sizes)
+    return Mesh(grid, tuple(names))
+
+
+def get_mesh() -> Optional[Mesh]:
+    return _current_mesh[0]
+
+
+def set_mesh(mesh: Optional[Mesh]):
+    _current_mesh[0] = mesh
+
+
+def init_distributed_env():
+    """Multi-host bootstrap (reference gen_nccl_id/c_gen_nccl_id TCP
+    exchange -> PJRT coordination service)."""
+    coord = os.getenv("PADDLE_COORDINATOR", os.getenv(
+        "JAX_COORDINATOR_ADDRESS"))
+    nprocs = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+    rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+    if coord and nprocs > 1:
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=nprocs,
+                                   process_id=rank)
+    return rank, nprocs
+
+
+class CommContext:
+    """Owns the mesh + axis registry the way NCCLCommunicator owns comm
+    rings (nccl_helper.h:179-300)."""
+
+    _instance = None
+
+    def __init__(self):
+        self._meshes: Dict[int, Mesh] = {}
+
+    @classmethod
+    def instance(cls) -> "CommContext":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def create_comm(self, ring_id: int = 0, axis_shapes=None,
+                    devices=None) -> Mesh:
+        mesh = make_mesh(axis_shapes, devices)
+        self._meshes[ring_id] = mesh
+        return mesh
+
+    def get_comm(self, ring_id: int = 0) -> Mesh:
+        if ring_id not in self._meshes:
+            self.create_comm(ring_id)
+        return self._meshes[ring_id]

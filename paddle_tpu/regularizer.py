@@ -1,0 +1,69 @@
+"""Weight regularizers (reference python/paddle/fluid/regularizer.py)."""
+from __future__ import annotations
+
+__all__ = ["L1Decay", "L2Decay", "L1DecayRegularizer",
+           "L2DecayRegularizer", "append_regularization_ops"]
+
+from .layer_helper import LayerHelper
+
+
+class WeightDecayRegularizer:
+    def __call__(self, param, grad, block):
+        raise NotImplementedError
+
+
+class L2DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._coeff = regularization_coeff
+
+    def __call__(self, param, grad, block):
+        helper = LayerHelper("l2_decay")
+        decay = helper.create_variable_for_type_inference(param.dtype)
+        block.append_op("scale", inputs={"X": param},
+                        outputs={"Out": decay},
+                        attrs={"scale": self._coeff}, infer_shape=False)
+        return decay
+
+
+class L1DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._coeff = regularization_coeff
+
+    def __call__(self, param, grad, block):
+        helper = LayerHelper("l1_decay")
+        sign = helper.create_variable_for_type_inference(param.dtype)
+        block.append_op("sign", inputs={"X": param},
+                        outputs={"Out": sign}, infer_shape=False)
+        decay = helper.create_variable_for_type_inference(param.dtype)
+        block.append_op("scale", inputs={"X": sign},
+                        outputs={"Out": decay},
+                        attrs={"scale": self._coeff}, infer_shape=False)
+        return decay
+
+
+def append_regularization_ops(parameters_and_grads,
+                              regularization=None):
+    """Add weight-decay terms to grads (reference regularizer.py:24)."""
+    params_and_grads = []
+    helper = LayerHelper("regularization")
+    for param, grad in parameters_and_grads:
+        if grad is None:
+            params_and_grads.append((param, grad))
+            continue
+        regularization_term = None
+        reg = param.regularizer or regularization
+        if reg is not None:
+            regularization_term = reg(param, grad, grad.block)
+        if regularization_term is None:
+            params_and_grads.append((param, grad))
+            continue
+        new_grad = helper.create_variable_for_type_inference(grad.dtype)
+        grad.block.append_op(
+            "sum", inputs={"X": [grad, regularization_term]},
+            outputs={"Out": new_grad}, infer_shape=False)
+        params_and_grads.append((param, new_grad))
+    return params_and_grads
+
+
+L1Decay = L1DecayRegularizer
+L2Decay = L2DecayRegularizer

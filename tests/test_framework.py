@@ -1,0 +1,78 @@
+"""Program/Block/Operator semantics + proto round-trip
+(reference test_program.py / test_protobuf_descs.py analogs)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _fresh():
+    main, startup = fluid.Program(), fluid.Program()
+    return main, startup
+
+
+def test_program_build_and_shapes():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[13], dtype="float32")
+        y = layers.fc(x, size=7, act="relu")
+        assert y.shape == (-1, 7)
+        loss = layers.mean(y)
+        assert loss.shape == ()
+    ops = [op.type for op in main.global_block().ops]
+    assert "mul" in ops and "relu" in ops and "mean" in ops
+    # params live in global block of both programs
+    assert len(main.all_parameters()) == 2
+    assert len(startup.global_block().ops) == 2  # w init + b init
+
+
+def test_program_proto_roundtrip():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        h = layers.fc(x, size=3)
+        loss = layers.mean(h)
+    s = main.serialize_to_string()
+    clone = fluid.Program.parse_from_string(s)
+    assert [o.type for o in clone.global_block().ops] == \
+        [o.type for o in main.global_block().ops]
+    v = clone.global_block().var("x")
+    assert tuple(v.shape) == (-1, 4)
+    assert clone.serialize_to_string() == s
+
+
+def test_clone_for_test_marks_is_test():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        d = layers.dropout(x, 0.5)
+    test_prog = main.clone(for_test=True)
+    drop_ops = [op for op in test_prog.global_block().ops
+                if op.type == "dropout"]
+    assert drop_ops and drop_ops[0].attr("is_test") is True
+
+
+def test_backward_builds_grad_ops():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        y = layers.fc(x, size=3)
+        loss = layers.mean(y)
+        p_g = fluid.append_backward(loss)
+    assert len(p_g) == 2
+    types = [op.type for op in main.global_block().ops]
+    assert "mean_grad" in types and "mul_grad" in types
+    for p, g in p_g:
+        assert g.name == p.name + "@GRAD"
+
+
+def test_variable_operator_sugar():
+    main, startup = _fresh()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[4], dtype="float32")
+        y = layers.data(name="y", shape=[4], dtype="float32")
+        z = x + y
+        w = z * 2.0
+    types = [op.type for op in main.global_block().ops]
+    assert "elementwise_add" in types and "elementwise_mul" in types
