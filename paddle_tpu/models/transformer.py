@@ -1,0 +1,258 @@
+"""Transformer (base/big) encoder-decoder built with paddle_tpu.layers.
+
+Parity target: BASELINE config 3 ("Transformer-base / BERT-base") — the
+reference ships Transformer as a book/PaddleNLP model composed from fluid
+layers (multi-head attention from matmul/softmax primitives; there is no
+flash-attention kernel in the 2019 snapshot, SURVEY §5 "long-context").
+
+TPU-first design decisions:
+* Dense padded [batch, seq] int32 ids + additive float attention bias
+  [batch, 1, seq, seq] computed host-side from lengths — the XLA-friendly
+  replacement for LoD ragged tensors (static shapes, MXU-sized matmuls).
+* Every parameter gets an explicit, stable name so the SPMD sharding rules
+  in paddle_tpu.parallel.strategy can map it to a PartitionSpec by prefix
+  (tensor parallel: qkv/ffn1 column-split, out/ffn2 row-split over "mp";
+  embeddings vocab-split for the EP-style sharded-table path).
+* Optionally uses the fused Pallas flash-attention op when available
+  (attrs {"use_fused": True}); falls back to composed matmul/softmax.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import layers
+from ..param_attr import ParamAttr
+from ..initializer import Normal, Constant
+
+
+class TransformerConfig:
+    def __init__(self, src_vocab_size=32000, trg_vocab_size=32000,
+                 max_length=256, d_model=512, d_inner=2048, n_head=8,
+                 n_layer=6, dropout=0.1, label_smooth_eps=0.1,
+                 dtype="float32", fuse_attention=False):
+        self.src_vocab_size = src_vocab_size
+        self.trg_vocab_size = trg_vocab_size
+        self.max_length = max_length
+        self.d_model = d_model
+        self.d_inner = d_inner
+        self.n_head = n_head
+        self.n_layer = n_layer
+        self.dropout = dropout
+        self.label_smooth_eps = label_smooth_eps
+        self.dtype = dtype
+        self.fuse_attention = fuse_attention
+        assert d_model % n_head == 0
+        self.d_head = d_model // n_head
+
+
+def transformer_base(**kw):
+    return TransformerConfig(**kw)
+
+
+def transformer_big(**kw):
+    kw.setdefault("d_model", 1024)
+    kw.setdefault("d_inner", 4096)
+    kw.setdefault("n_head", 16)
+    return TransformerConfig(**kw)
+
+
+def _w(name):
+    return ParamAttr(name=name, initializer=Normal(0.0, 0.02))
+
+
+def _b(name):
+    return ParamAttr(name=name, initializer=Constant(0.0))
+
+
+def _linear(x, size, name, act=None):
+    return layers.fc(x, size, num_flatten_dims=2, act=act,
+                     param_attr=_w(name + ".w_0"),
+                     bias_attr=_b(name + ".b_0"))
+
+
+def multi_head_attention(q_in, kv_in, attn_bias, cfg: TransformerConfig,
+                         name, is_test=False, cache=None):
+    """Scaled dot-product multi-head attention.
+
+    q_in: [B, Sq, D]; kv_in: [B, Sk, D]; attn_bias: [B, 1, Sq, Sk]
+    additive mask (0 keep / -1e9 drop) or None.
+    """
+    h, dh = cfg.n_head, cfg.d_head
+    q = _linear(q_in, cfg.d_model, name + "_q")
+    k = _linear(kv_in, cfg.d_model, name + "_k")
+    v = _linear(kv_in, cfg.d_model, name + "_v")
+
+    def split_heads(x):
+        # [B, S, D] -> [B, H, S, dh]
+        x = layers.reshape(x, [0, 0, h, dh])
+        return layers.transpose(x, [0, 2, 1, 3])
+
+    q, k, v = split_heads(q), split_heads(k), split_heads(v)
+    if cache is not None:  # incremental decoding
+        k = layers.concat([cache["k"], k], axis=2)
+        v = layers.concat([cache["v"], v], axis=2)
+        cache["k"], cache["v"] = k, v
+
+    if cfg.fuse_attention:
+        ctx = layers.fused_attention(q, k, v, attn_bias,
+                                     scale=dh ** -0.5)
+    else:
+        scores = layers.matmul(q, k, transpose_y=True, alpha=dh ** -0.5)
+        if attn_bias is not None:
+            scores = layers.elementwise_add(scores, attn_bias)
+        weights = layers.softmax(scores)
+        if cfg.dropout and not is_test:
+            weights = layers.dropout(
+                weights, cfg.dropout, is_test=is_test,
+                dropout_implementation="upscale_in_train")
+        ctx = layers.matmul(weights, v)
+    ctx = layers.transpose(ctx, [0, 2, 1, 3])
+    ctx = layers.reshape(ctx, [0, 0, cfg.d_model])
+    return _linear(ctx, cfg.d_model, name + "_o")
+
+
+def _ffn(x, cfg: TransformerConfig, name, is_test=False):
+    hidden = _linear(x, cfg.d_inner, name + "_fc1", act="relu")
+    if cfg.dropout and not is_test:
+        hidden = layers.dropout(
+            hidden, cfg.dropout, is_test=is_test,
+            dropout_implementation="upscale_in_train")
+    return _linear(hidden, cfg.d_model, name + "_fc2")
+
+
+def _pre_post(x, residual, cfg, name, is_test):
+    """post-norm residual block tail: LN(residual + dropout(x))."""
+    if cfg.dropout and not is_test:
+        x = layers.dropout(x, cfg.dropout, is_test=is_test,
+                           dropout_implementation="upscale_in_train")
+    out = layers.elementwise_add(x, residual)
+    return layers.layer_norm(
+        out, begin_norm_axis=2,
+        param_attr=ParamAttr(name=name + "_ln.w_0",
+                             initializer=Constant(1.0)),
+        bias_attr=ParamAttr(name=name + "_ln.b_0",
+                            initializer=Constant(0.0)))
+
+
+def _embed(ids, vocab_size, cfg, name, pos=True):
+    emb = layers.embedding(
+        ids, size=[vocab_size, cfg.d_model],
+        param_attr=ParamAttr(name=name,
+                             initializer=Normal(0.0, cfg.d_model ** -0.5)),
+        dtype=cfg.dtype)
+    emb = layers.scale(emb, scale=cfg.d_model ** 0.5)
+    if pos:
+        emb = layers.add_position_encoding(emb, alpha=1.0, beta=1.0)
+    return emb
+
+
+def encoder(src_ids, src_bias, cfg: TransformerConfig, is_test=False):
+    x = _embed(src_ids, cfg.src_vocab_size, cfg, "src_word_emb.w_0")
+    if cfg.dropout and not is_test:
+        x = layers.dropout(x, cfg.dropout, is_test=is_test,
+                           dropout_implementation="upscale_in_train")
+    for i in range(cfg.n_layer):
+        p = f"enc_{i}"
+        attn = multi_head_attention(x, x, src_bias, cfg, p + "_attn",
+                                    is_test)
+        x = _pre_post(attn, x, cfg, p + "_attn", is_test)
+        ffn = _ffn(x, cfg, p + "_ffn", is_test)
+        x = _pre_post(ffn, x, cfg, p + "_ffn", is_test)
+    return x
+
+
+def decoder(trg_ids, trg_bias, enc_out, cross_bias, cfg, is_test=False,
+            caches=None):
+    x = _embed(trg_ids, cfg.trg_vocab_size, cfg, "trg_word_emb.w_0")
+    if cfg.dropout and not is_test:
+        x = layers.dropout(x, cfg.dropout, is_test=is_test,
+                           dropout_implementation="upscale_in_train")
+    for i in range(cfg.n_layer):
+        p = f"dec_{i}"
+        cache = caches[i] if caches is not None else None
+        self_attn = multi_head_attention(x, x, trg_bias, cfg,
+                                         p + "_self_attn", is_test, cache)
+        x = _pre_post(self_attn, x, cfg, p + "_self_attn", is_test)
+        cross = multi_head_attention(x, enc_out, cross_bias, cfg,
+                                     p + "_cross_attn", is_test)
+        x = _pre_post(cross, x, cfg, p + "_cross_attn", is_test)
+        ffn = _ffn(x, cfg, p + "_ffn", is_test)
+        x = _pre_post(ffn, x, cfg, p + "_ffn", is_test)
+    return x
+
+
+def _project_logits(dec_out, cfg):
+    return layers.fc(dec_out, cfg.trg_vocab_size, num_flatten_dims=2,
+                     param_attr=_w("trg_proj.w_0"), bias_attr=False)
+
+
+def transformer_train(cfg: TransformerConfig, is_test=False):
+    """Build the training graph. Feeds (all dense, host-prepared):
+      src_ids   int32 [B, S_src]
+      trg_ids   int32 [B, S_trg]        (decoder input, shifted right)
+      lbl_ids   int32 [B, S_trg]        (decoder target)
+      src_bias  f32   [B, 1, 1, S_src]  additive key-padding mask
+      trg_bias  f32   [B, 1, S_trg, S_trg]  causal+padding mask
+      lbl_w     f32   [B, S_trg]        per-token loss weight (non-pad=1)
+    Returns (avg_cost, logits, feed_names).
+    """
+    def _data(name, shape, dtype):
+        return layers.data(name, shape, append_batch_size=False,
+                           dtype=dtype)
+
+    src_ids = _data("src_ids", [-1, -1], "int32")
+    trg_ids = _data("trg_ids", [-1, -1], "int32")
+    lbl_ids = _data("lbl_ids", [-1, -1], "int32")
+    src_bias = _data("src_bias", [-1, 1, 1, -1], cfg.dtype)
+    trg_bias = _data("trg_bias", [-1, 1, -1, -1], cfg.dtype)
+    lbl_w = _data("lbl_w", [-1, -1], cfg.dtype)
+
+    enc_out = encoder(src_ids, src_bias, cfg, is_test)
+    dec_out = decoder(trg_ids, trg_bias, enc_out, src_bias, cfg, is_test)
+    logits = _project_logits(dec_out, cfg)
+
+    if cfg.label_smooth_eps:
+        oh = layers.one_hot(lbl_ids, cfg.trg_vocab_size)
+        soft = layers.label_smooth(oh, epsilon=cfg.label_smooth_eps)
+        cost = layers.softmax_with_cross_entropy(
+            logits, soft, soft_label=True)
+        cost = layers.squeeze(cost, axes=[-1]) \
+            if len(cost.shape) == 3 else cost
+    else:
+        lbl3 = layers.unsqueeze(lbl_ids, axes=[2])
+        cost = layers.softmax_with_cross_entropy(logits, lbl3)
+        cost = layers.squeeze(cost, axes=[2])
+    weighted = layers.elementwise_mul(cost, lbl_w)
+    sum_cost = layers.reduce_sum(weighted)
+    token_count = layers.reduce_sum(lbl_w)
+    avg_cost = layers.elementwise_div(sum_cost, token_count)
+    feeds = ["src_ids", "trg_ids", "lbl_ids", "src_bias", "trg_bias",
+             "lbl_w"]
+    return avg_cost, logits, feeds
+
+
+def make_batch(cfg, batch, s_src, s_trg, rng=None, src_lens=None,
+               trg_lens=None):
+    """Host-side dense batch builder (the LoD→padding+mask story)."""
+    rng = rng or np.random.default_rng(0)
+    src_lens = src_lens if src_lens is not None else \
+        np.full((batch,), s_src, np.int32)
+    trg_lens = trg_lens if trg_lens is not None else \
+        np.full((batch,), s_trg, np.int32)
+    src_ids = rng.integers(1, cfg.src_vocab_size, (batch, s_src),
+                           dtype=np.int32)
+    trg_ids = rng.integers(1, cfg.trg_vocab_size, (batch, s_trg),
+                           dtype=np.int32)
+    lbl_ids = rng.integers(1, cfg.trg_vocab_size, (batch, s_trg),
+                           dtype=np.int32)
+    src_mask = (np.arange(s_src)[None, :] < src_lens[:, None])
+    trg_mask = (np.arange(s_trg)[None, :] < trg_lens[:, None])
+    neg = np.float32(-1e9)
+    src_bias = np.where(src_mask, 0.0, neg).astype(np.float32)
+    src_bias = src_bias[:, None, None, :]
+    causal = np.tril(np.ones((s_trg, s_trg), np.bool_))
+    trg_ok = causal[None, :, :] & trg_mask[:, None, :]
+    trg_bias = np.where(trg_ok, 0.0, neg).astype(np.float32)[:, None]
+    lbl_w = trg_mask.astype(np.float32)
+    return {"src_ids": src_ids, "trg_ids": trg_ids, "lbl_ids": lbl_ids,
+            "src_bias": src_bias, "trg_bias": trg_bias, "lbl_w": lbl_w}
