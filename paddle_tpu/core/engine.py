@@ -96,7 +96,8 @@ def run_block_ops(block, env, rng_ctx, lod_env, block_runner):
 
 def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
                feed_lods: Dict[str, list], fetch_names: Sequence[str],
-               scope: Scope, mesh=None, data_axis: str = "dp") -> TracedStep:
+               scope: Scope, mesh=None, data_axis: str = "dp",
+               strategy=None) -> TracedStep:
     """Build + jit the step function for one (program, feed-sig) pair.
 
     With `mesh`, the step is compiled SPMD: feeds sharded on their batch
@@ -194,15 +195,38 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
         repl = NamedSharding(mesh, P())
+        dp_size = mesh.shape.get(data_axis, mesh.size) \
+            if hasattr(mesh.shape, "get") else mesh.size
         batch = NamedSharding(mesh, P(data_axis))
-        in_shardings = ({n: repl for n in donated},
-                        {n: repl for n in const},
-                        {n: (batch if len(feed_sig[n].shape) >= 1 and
-                             feed_sig[n].shape[0] % mesh.size == 0
-                             else repl) for n in feed_sig},
+
+        def param_sh(n):
+            if strategy is not None:
+                shape = params_sig[n].shape if n in params_sig else ()
+                spec = strategy.param_spec(n, shape)
+                if spec is not None:
+                    return NamedSharding(mesh, spec)
+            return repl
+
+        def feed_sh(n):
+            if strategy is not None:
+                spec = strategy.feed_spec(n, feed_sig[n].shape)
+                if spec is not None:
+                    return NamedSharding(mesh, spec)
+            if (len(feed_sig[n].shape) >= 1 and
+                    feed_sig[n].shape[0] % dp_size == 0):
+                return batch
+            return repl
+
+        in_shardings = ({n: param_sh(n) for n in donated},
+                        {n: param_sh(n) for n in const},
+                        {n: feed_sh(n) for n in feed_sig},
                         repl)
+        # fetches replicated; updated persistables keep their sharding
+        out_shardings = (tuple(repl for _ in fetch_names),
+                         {n: param_sh(n) for n in updated_names})
         fn = jax.jit(step2, donate_argnums=(0,),
-                     in_shardings=in_shardings, out_shardings=repl)
+                     in_shardings=in_shardings,
+                     out_shardings=out_shardings)
     else:
         fn = jax.jit(step2, donate_argnums=(0,))
     return TracedStep(fn, donated, const, sorted(feed_sig),
@@ -213,7 +237,11 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
 class Engine:
     """Compile cache + step dispatch for one (program, scope) pair."""
 
-    def __init__(self, mesh=None, data_axis: str = "dp"):
+    def __init__(self, mesh=None, data_axis: str = "dp", strategy=None):
+        if strategy is not None and mesh is None:
+            mesh = strategy.mesh
+            data_axis = strategy.data_axis
+        self.strategy = strategy
         self._cache: Dict[Any, TracedStep] = {}
         self.mesh = mesh
         self.data_axis = data_axis
@@ -253,7 +281,8 @@ class Engine:
                         for n, a in arrays.items()}
             traced = trace_step(program, block_idx, feed_sig, lods,
                                 fetch_names, scope, mesh=self.mesh,
-                                data_axis=self.data_axis)
+                                data_axis=self.data_axis,
+                                strategy=self.strategy)
             self._cache[key] = traced
 
         donated_params = {}

@@ -5,5 +5,9 @@ Parity targets (SURVEY.md §2.2-2.3): ParallelExecutor -> DataParallelEngine
 XLA collectives), transpiler/fleet APIs -> paddle_tpu.parallel.fleet /
 transpiler.
 """
-from .mesh import CommContext, get_mesh, set_mesh  # noqa: F401
+from .mesh import CommContext, get_mesh, set_mesh, make_mesh  # noqa: F401
 from .data_parallel import DataParallelEngine  # noqa: F401
+from .strategy import (  # noqa: F401
+    DistributedStrategy, ShardingRules, P,
+    transformer_rules, transformer_feed_rules, ctr_rules,
+)

@@ -1,0 +1,158 @@
+"""Sharding strategy: named-mesh PartitionSpec rules for model parallelism.
+
+This is the TPU-native replacement for the reference's distributed program
+transformations: instead of rewriting the program with send/recv or c_*
+collective ops (transpiler/distribute_transpiler.py:375,
+transpiler/collective.py:178), a DistributedStrategy declares a mesh
+(axes: dp / mp / sp / pp) and per-variable PartitionSpecs; the engine jits
+the SAME traced step under those shardings and XLA's SPMD partitioner
+inserts the collectives over ICI (all-reduce for dp grads, all-gather /
+reduce-scatter for mp matmuls, all-to-all-style exchange for vocab-sharded
+embedding lookups — the EP analog of the reference's remote parameter
+prefetch, operators/distributed/parameter_prefetch.h:26).
+
+Rules are ordered (substring-or-regex, PartitionSpec) pairs matched against
+variable names; optimizer accumulators (named "<param>_<acc>_<i>",
+optimizer.py) inherit their parameter's spec automatically when shapes
+match, so sharded params get sharded optimizer state (ZeRO-style for mp
+axes) for free.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import make_mesh
+
+__all__ = ["ShardingRules", "DistributedStrategy", "P",
+           "transformer_rules", "ctr_rules"]
+
+
+class ShardingRules:
+    """Ordered (pattern, PartitionSpec) rules; first match wins."""
+
+    def __init__(self, rules: Sequence[Tuple[str, P]] = ()):
+        self._rules: List[Tuple[re.Pattern, P]] = [
+            (re.compile(pat), spec) for pat, spec in rules]
+
+    def add(self, pattern: str, spec: P):
+        self._rules.append((re.compile(pattern), spec))
+        return self
+
+    def spec_for(self, name: str, shape: Sequence[int],
+                 mesh: Mesh) -> Optional[P]:
+        """Resolve a spec; returns None (caller default) if no rule hits or
+        the spec cannot legally apply to this shape on this mesh."""
+        for pat, spec in self._rules:
+            if pat.search(name):
+                return _legalize(spec, shape, mesh)
+        return None
+
+
+def _legalize(spec: Optional[P], shape, mesh: Mesh) -> Optional[P]:
+    """Drop axis assignments that don't divide the dim / exceed rank."""
+    if spec is None:
+        return None
+    parts = list(spec)
+    if len(parts) > len(shape):
+        parts = parts[:len(shape)]
+    out = []
+    for dim, ax in zip(shape, parts):
+        if ax is None:
+            out.append(None)
+            continue
+        axes = ax if isinstance(ax, tuple) else (ax,)
+        n = int(np.prod([mesh.shape[a] for a in axes]))
+        out.append(ax if dim % n == 0 else None)
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
+
+
+# optimizer accumulator suffix: "<param>_<accname>_<i>" (optimizer.py
+# _add_accumulator via unique_name.generate); params themselves end in
+# ".w_<i>" / ".b_<i>" so the param prefix is recoverable.
+_ACC_RE = re.compile(r"^(?P<param>.+\.[wb]_\d+)_[A-Za-z0-9_]+_\d+$")
+
+
+class DistributedStrategy:
+    """Mesh + rules + feed layout: everything the engine needs to compile a
+    program SPMD. Axis names: "dp" (data), "mp" (tensor/model), "sp"
+    (sequence), "pp" (pipeline, handled by PipelineOptimizer)."""
+
+    def __init__(self, axes: Dict[str, int] = None, rules: ShardingRules
+                 = None, devices=None, feed_rules: ShardingRules = None):
+        self.mesh = make_mesh(axes or {"dp": -1}, devices=devices)
+        self.rules = rules or ShardingRules()
+        self.feed_rules = feed_rules or ShardingRules()
+        self.data_axis = "dp" if "dp" in self.mesh.axis_names else \
+            self.mesh.axis_names[0]
+
+    def param_spec(self, name: str, shape) -> Optional[P]:
+        spec = self.rules.spec_for(name, shape, self.mesh)
+        if spec is not None:
+            return spec
+        m = _ACC_RE.match(name)
+        if m:  # accumulator inherits its param's sharding
+            return self.rules.spec_for(m.group("param"), shape, self.mesh)
+        return None
+
+    def feed_spec(self, name: str, shape) -> Optional[P]:
+        spec = self.feed_rules.spec_for(name, shape, self.mesh)
+        if spec is not None:
+            return spec
+        # default: batch dim over dp
+        if len(shape) >= 1 and shape[0] % self.mesh.shape[
+                self.data_axis] == 0:
+            return P(self.data_axis)
+        return P()
+
+    def sharding_table(self, names_shapes) -> Dict[str, P]:
+        return {n: self.param_spec(n, s) for n, s in names_shapes}
+
+
+def transformer_rules(mp_axis="mp", sp_axis=None) -> ShardingRules:
+    """Megatron-style TP for the models.transformer param naming:
+    column-split qkv/ffn1 (output dim over mp), row-split out-proj/ffn2
+    (input dim over mp), vocab-split embeddings + softmax projection."""
+    mp = mp_axis
+    r = ShardingRules([
+        (r"_(q|k|v)\.w_0$", P(None, mp)),
+        (r"_(q|k|v)\.b_0$", P(mp)),
+        (r"_fc1\.w_0$", P(None, mp)),
+        (r"_fc1\.b_0$", P(mp)),
+        (r"_o\.w_0$", P(mp, None)),
+        (r"_fc2\.w_0$", P(mp, None)),
+        (r"(src|trg)_word_emb\.w_0$", P(mp, None)),
+        (r"trg_proj\.w_0$", P(None, mp)),
+        (r"_ln\.(w|b)_0$", P()),
+    ])
+    return r
+
+
+def transformer_feed_rules(data_axis="dp", sp_axis=None) -> ShardingRules:
+    """Feeds: batch over dp; optionally sequence over sp (context/sequence
+    parallelism — activations sharded along seq, XLA gathers K/V for
+    attention)."""
+    sp = sp_axis
+    if sp is None:
+        return ShardingRules()
+    return ShardingRules([
+        (r"^(src_ids|trg_ids|lbl_ids|lbl_w)$", P(data_axis, sp)),
+        # biases: [B, 1, Sq, Sk] — shard query dim, keep key dim full
+        (r"^trg_bias$", P(data_axis, None, sp, None)),
+        (r"^src_bias$", P(data_axis, None, None, None)),
+    ])
+
+
+def ctr_rules(mp_axis="mp") -> ShardingRules:
+    """EP-style: big embedding tables split along vocab rows over mp (the
+    sharded distributed lookup table, SURVEY §2.3 parameter prefetch)."""
+    return ShardingRules([
+        (r"^(ctr_emb|ctr_wide|fm_emb|fm_first)\.w_0$", P(mp_axis, None)),
+    ])

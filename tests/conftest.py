@@ -1,6 +1,10 @@
 """Test config: force an 8-device virtual CPU mesh so multi-chip sharding
 paths are exercised without TPU hardware (the driver separately dry-runs
-the multichip path; bench.py runs on the real chip)."""
+the multichip path; bench.py runs on the real chip).
+
+The container's sitecustomize registers the `axon` PJRT backend and
+overrides JAX_PLATFORMS, so setting the env var is not enough — we also
+update jax.config before any backend is initialized."""
 import os
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -9,6 +13,11 @@ if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
-import sys
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import sys  # noqa: E402
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
