@@ -1,0 +1,80 @@
+"""Benchmark: Transformer-base training throughput (tokens/sec) on the
+attached TPU chip.
+
+Headline metric per BASELINE.json: "Transformer-base tokens/sec" with the
+north-star target of >= 0.8x the reference CUDA path per chip on V100.
+The reference snapshot publishes no numbers (BASELINE.md), so the
+comparison constant below is the public V100 FP32 Transformer-base
+training throughput ballpark (~15k target tokens/sec, fairseq/tensor2
+tensor-era reports); vs_baseline = measured / (0.8 * 15000) would be the
+pass ratio against the north star, but we report vs_baseline =
+measured / 15000 (i.e. 1.0 == V100 parity, 0.8 == the north-star bar).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+V100_TOKENS_PER_SEC = 15000.0
+
+BATCH = 48
+SRC_LEN = 128
+TRG_LEN = 128
+WARMUP = 3
+ITERS = 12
+
+
+def main():
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu import models
+    from paddle_tpu.core.engine import Engine
+    from paddle_tpu.core.scope import Scope
+
+    cfg = models.transformer.transformer_base(
+        src_vocab_size=32000, trg_vocab_size=32000, dropout=0.1)
+    fluid.framework.unique_name.reset()
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        cost, logits, feed_names = models.transformer_train(cfg)
+        opt = fluid.optimizer.AdamOptimizer(learning_rate=2e-4)
+        opt.minimize(cost)
+
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        eng = Engine()
+        batch = models.transformer.make_batch(cfg, BATCH, SRC_LEN, TRG_LEN)
+
+        for _ in range(WARMUP):
+            out = eng.run(main_prog, scope, None, batch, [cost.name])
+        jax.block_until_ready(out)
+
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            out = eng.run(main_prog, scope, None, batch, [cost.name])
+        jax.block_until_ready(
+            [np.asarray(out[0])])  # fetches come back as numpy already
+        dt = time.perf_counter() - t0
+
+    steps_per_sec = ITERS / dt
+    tokens_per_sec = steps_per_sec * BATCH * TRG_LEN
+    print(json.dumps({
+        "metric": "transformer_base_train_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(tokens_per_sec / V100_TOKENS_PER_SEC, 3),
+    }))
+    print(f"# loss={float(np.asarray(out[0])):.4f} "
+          f"steps/s={steps_per_sec:.3f} devices={jax.devices()}",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
