@@ -201,10 +201,11 @@ def ftrl(ctx):
         sigma = (jnp.power(new_sq, -power) -
                  jnp.power(sq_acc, -power)) / lr
     new_lin = lin_acc + g - sigma * p
+    # denominator uses 2*l2 (reference ftrl_op.h:89-96)
     if power == -0.5:
-        x = l2 + jnp.sqrt(new_sq) / lr
+        x = 2.0 * l2 + jnp.sqrt(new_sq) / lr
     else:
-        x = l2 + jnp.power(new_sq, -power) / lr
+        x = 2.0 * l2 + jnp.power(new_sq, -power) / lr
     pre = jnp.clip(new_lin, -l1, l1) - new_lin
     p_new = pre / x
     ctx.set_output("ParamOut", p_new)

@@ -1,0 +1,231 @@
+"""OpTest harness: per-op correctness + gradient checking.
+
+Parity: reference python/paddle/fluid/tests/unittests/op_test.py:134 —
+each op test declares op_type/inputs/outputs/attrs as numpy;
+check_output builds a one-op program and compares against the declared
+reference outputs; check_grad compares analytic gradients (via
+append_backward) against numeric central-difference gradients
+(get_numeric_gradient, op_test.py:45, delta≈0.005).
+
+TPU-native differences: the one-op program executes through the
+whole-block XLA engine (so this also exercises the compile path per op),
+and the numeric gradient re-runs the same compiled forward with perturbed
+feeds rather than mutating scope tensors in place.
+"""
+from __future__ import annotations
+
+import unittest
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import framework
+from paddle_tpu.core.registry import GRAD_SUFFIX
+from paddle_tpu.core.scope import LoDTensor, Scope
+
+
+def _as_items(slot_val):
+    """inputs slot -> list of (var_name, array|LoDTensor)."""
+    if isinstance(slot_val, (list, tuple)) and slot_val and \
+            isinstance(slot_val[0], (list, tuple)):
+        return list(slot_val)
+    return None  # single var, name chosen by slot
+
+
+class OpTest(unittest.TestCase):
+    """Subclass contract (same as reference):
+        self.op_type: str
+        self.inputs:  {slot: ndarray | (ndarray, lod) | [(name, arr), ...]}
+        self.outputs: {slot: ndarray | [(name, arr), ...]}
+        self.attrs:   {name: value}  (optional)
+    """
+
+    op_type: str = ""
+    inputs: Dict = {}
+    outputs: Dict = {}
+    attrs: Dict = {}
+
+    # ---- program building -------------------------------------------------
+
+    def _build(self):
+        fluid.framework.unique_name.reset()
+        main, startup = fluid.Program(), fluid.Program()
+        feed = {}
+        with fluid.program_guard(main, startup):
+            block = main.global_block()
+            in_vars = {}
+            for slot, val in self.inputs.items():
+                items = _as_items(val)
+                if items is None:
+                    items = [(slot.lower(), val)]
+                vs = []
+                for name, arr in items:
+                    lod = None
+                    if isinstance(arr, tuple):
+                        arr, lod = arr
+                    arr = np.asarray(arr)
+                    v = block.create_var(
+                        name=name, shape=list(arr.shape),
+                        dtype=str(arr.dtype), stop_gradient=False,
+                        is_data=True)
+                    feed[name] = LoDTensor(arr, lod) if lod else arr
+                    vs.append(v)
+                in_vars[slot] = vs if len(items) > 1 or \
+                    _as_items(val) is not None else vs[0]
+
+            out_vars = {}
+            self._out_names = {}
+            for slot, val in self.outputs.items():
+                items = _as_items(val)
+                if items is None:
+                    items = [(slot.lower() + "_out", val)]
+                vs = []
+                for name, arr in items:
+                    ref = np.asarray(arr[0] if isinstance(arr, tuple)
+                                     else arr)
+                    v = block.create_var(name=name,
+                                         dtype=str(ref.dtype))
+                    vs.append(v)
+                out_vars[slot] = vs if _as_items(val) is not None else vs[0]
+                self._out_names[slot] = [n for n, _ in items]
+
+            block.append_op(self.op_type, inputs=in_vars,
+                            outputs=out_vars,
+                            attrs=dict(self.attrs or {}))
+        return main, startup, feed, in_vars, out_vars
+
+    def _run(self, main, startup, feed, fetch_names, scope=None):
+        scope = scope or Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            outs = exe.run(main, feed=feed, fetch_list=list(fetch_names),
+                           return_numpy=False)
+        return outs
+
+    # ---- check_output -----------------------------------------------------
+
+    def check_output(self, atol=1e-5, rtol=1e-5, no_check_set=None,
+                     check_lod=True):
+        main, startup, feed, _, _ = self._build()
+        fetch, refs, lods = [], [], []
+        for slot, val in self.outputs.items():
+            if no_check_set and slot in no_check_set:
+                continue
+            items = _as_items(val)
+            if items is None:
+                items = [(self._out_names[slot][0], val)]
+            for name, arr in items:
+                lod = None
+                if isinstance(arr, tuple):
+                    arr, lod = arr
+                fetch.append(name)
+                refs.append(np.asarray(arr))
+                lods.append(lod)
+        outs = self._run(main, startup, feed, fetch)
+        for name, ref, lod, got in zip(fetch, refs, lods, outs):
+            got_arr = np.asarray(got)
+            if ref.dtype == np.bool_ or np.issubdtype(ref.dtype,
+                                                      np.integer):
+                np.testing.assert_array_equal(
+                    got_arr, ref, err_msg=f"output {name}")
+            else:
+                np.testing.assert_allclose(
+                    got_arr, ref.astype(got_arr.dtype), atol=atol,
+                    rtol=rtol, err_msg=f"output {name}")
+            if check_lod and lod and isinstance(got, LoDTensor):
+                self.assertEqual(got.lod(), [list(l) for l in lod],
+                                 f"lod of {name}")
+
+    # ---- check_grad -------------------------------------------------------
+
+    def check_grad(self, inputs_to_check: Sequence[str],
+                   output_names, max_relative_error=0.005,
+                   no_grad_set=None, numeric_grad_delta=0.005,
+                   in_place=False, user_defined_grads=None):
+        if isinstance(output_names, str):
+            output_names = [output_names]
+        main, startup, feed, in_vars, out_vars = self._build()
+
+        # scalar loss = sum_i mean(out_i) appended to the same program
+        with fluid.program_guard(main, startup):
+            block = main.global_block()
+            loss_parts = []
+            for oname in output_names:
+                ovar = None
+                for slot, names in self._out_names.items():
+                    if oname in names:
+                        vs = out_vars[slot]
+                        vs = vs if isinstance(vs, list) else [vs]
+                        ovar = vs[names.index(oname)]
+                if ovar is None:
+                    raise KeyError(f"output {oname} not declared")
+                loss_parts.append(fluid.layers.reduce_mean(
+                    fluid.layers.cast(ovar, "float32")))
+            loss = loss_parts[0]
+            for p in loss_parts[1:]:
+                loss = fluid.layers.elementwise_add(loss, p)
+            fluid.backward.append_backward(
+                loss, no_grad_set=set(no_grad_set or ()))
+
+        # map input var name -> feed name (they are identical here)
+        grad_fetch = [n + GRAD_SUFFIX for n in inputs_to_check]
+        outs = self._run(main, startup, feed, grad_fetch + [loss.name])
+        analytic = [np.asarray(o) for o in outs[:-1]]
+
+        if user_defined_grads is not None:
+            numeric = [np.asarray(g) for g in user_defined_grads]
+        else:
+            numeric = [self._numeric_grad(main, startup, feed, loss.name,
+                                          n, numeric_grad_delta)
+                       for n in inputs_to_check]
+
+        for name, a, n in zip(inputs_to_check, analytic, numeric):
+            self._compare_grad(a, n, max_relative_error, name)
+
+    def _numeric_grad(self, main, startup, feed, loss_name, in_name,
+                      delta):
+        base = feed[in_name]
+        base_arr = np.asarray(base.array if isinstance(base, LoDTensor)
+                              else base).astype(np.float64)
+        lod = base.lod() if isinstance(base, LoDTensor) else None
+        flat = base_arr.reshape(-1)
+        grad = np.zeros_like(flat)
+        scope = Scope()
+        orig_dtype = np.asarray(base.array if isinstance(base, LoDTensor)
+                                else base).dtype
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+
+        def loss_at(x):
+            f = dict(feed)
+            arr = x.reshape(base_arr.shape).astype(orig_dtype)
+            f[in_name] = LoDTensor(arr, lod) if lod else arr
+            with fluid.scope_guard(scope):
+                out = exe.run(main, feed=f, fetch_list=[loss_name])
+            return float(np.asarray(out[0]))
+
+        for i in range(flat.size):
+            x = flat.copy()
+            x[i] += delta
+            lp = loss_at(x)
+            x[i] -= 2 * delta
+            lm = loss_at(x)
+            grad[i] = (lp - lm) / (2 * delta)
+        return grad.reshape(base_arr.shape)
+
+    def _compare_grad(self, analytic, numeric, max_rel, name):
+        analytic = analytic.astype(np.float64)
+        numeric = np.asarray(numeric, np.float64)
+        self.assertEqual(analytic.shape, numeric.shape,
+                         f"grad shape of {name}")
+        abs_a = np.abs(analytic).max()
+        denom = max(abs_a, np.abs(numeric).max(), 1e-3)
+        diff = np.abs(analytic - numeric).max() / denom
+        self.assertLessEqual(
+            diff, max_rel,
+            f"gradient of {name}: max relative diff {diff:.5f} > "
+            f"{max_rel} (analytic={analytic.flatten()[:5]}, "
+            f"numeric={numeric.flatten()[:5]})")

@@ -1,0 +1,92 @@
+"""Activation op family (reference activation_op.cc's ~30 registrations,
+tested per test_activation_op.py)."""
+import numpy as np
+
+from op_test import OpTest
+from scipy import special
+
+
+def _rng(seed):
+    return np.random.default_rng(seed)
+
+
+def _mk(op_type, ref_fn, low=-1.0, high=1.0, seed=0, grad=True,
+        max_rel=0.005, attrs=None):
+    """Build an OpTest subclass for a unary activation."""
+
+    class _T(OpTest):
+        def setUp(self):
+            self.op_type = op_type
+            x = _rng(seed).uniform(low, high, (4, 5)).astype(np.float32)
+            self.inputs = {"X": x}
+            self.outputs = {"Out": ref_fn(x.astype(np.float64)).astype(
+                np.float32)}
+            self.attrs = dict(attrs or {})
+
+        def test_output(self):
+            self.check_output(atol=1e-5)
+
+        if grad:
+            def test_grad(self):
+                self.check_grad(["x"], "out_out",
+                                max_relative_error=max_rel)
+
+    _T.__name__ = "Test" + "".join(w.title() for w in op_type.split("_"))
+    return _T
+
+
+TestRelu = _mk("relu", lambda x: np.maximum(x, 0), low=0.1, high=1)
+TestSigmoid = _mk("sigmoid", special.expit)
+TestTanh = _mk("tanh", np.tanh)
+TestExp = _mk("exp", np.exp)
+TestLog = _mk("log", np.log, low=0.5, high=2)
+TestSqrt = _mk("sqrt", np.sqrt, low=0.5, high=2)
+TestRsqrt = _mk("rsqrt", lambda x: 1 / np.sqrt(x), low=0.5, high=2)
+TestSquare = _mk("square", np.square)
+TestAbs = _mk("abs", np.abs, low=0.2, high=1)
+TestReciprocal = _mk("reciprocal", lambda x: 1 / x, low=0.5, high=2)
+TestCeil = _mk("ceil", np.ceil, grad=False)
+TestFloor = _mk("floor", np.floor, grad=False)
+TestRound = _mk("round", np.round, grad=False)
+TestSin = _mk("sin", np.sin)
+TestCos = _mk("cos", np.cos)
+TestAsin = _mk("asin", np.arcsin, low=-0.8, high=0.8)
+TestAcos = _mk("acos", np.arccos, low=-0.8, high=0.8)
+TestAtan = _mk("atan", np.arctan)
+TestGelu = _mk("gelu", lambda x: 0.5 * x * (1 + special.erf(
+    x / np.sqrt(2))))
+TestSoftplus = _mk("softplus", lambda x: np.log1p(np.exp(x)))
+TestSoftsign = _mk("softsign", lambda x: x / (1 + np.abs(x)))
+TestLogsigmoid = _mk("logsigmoid", lambda x: np.log(special.expit(x)))
+TestSwish = _mk("swish", lambda x: x * special.expit(x),
+                attrs={"beta": 1.0})
+TestStanh = _mk("stanh", lambda x: 1.7159 * np.tanh(0.66667 * x),
+                attrs={"scale_a": 0.66667, "scale_b": 1.7159})
+TestLeakyRelu = _mk("leaky_relu", lambda x: np.where(x > 0, x, 0.02 * x),
+                    low=0.1, attrs={"alpha": 0.02})
+TestElu = _mk("elu", lambda x: np.where(x > 0, x, np.expm1(x)),
+              low=0.1, attrs={"alpha": 1.0})
+TestRelu6 = _mk("relu6", lambda x: np.clip(x, 0, 6), low=0.1, high=1,
+                attrs={"threshold": 6.0})
+TestBrelu = _mk("brelu", lambda x: np.clip(x, 0.1, 0.8),
+                low=-0.5, high=1.5, grad=False,
+                attrs={"t_min": 0.1, "t_max": 0.8})
+TestHardSigmoid = _mk(
+    "hard_sigmoid", lambda x: np.clip(0.2 * x + 0.5, 0, 1),
+    grad=False, attrs={"slope": 0.2, "offset": 0.5})
+TestHardShrink = _mk(
+    "hard_shrink", lambda x: np.where(np.abs(x) > 0.5, x, 0),
+    grad=False, attrs={"threshold": 0.5})
+TestSoftShrink = _mk(
+    "softshrink",
+    lambda x: np.where(x > 0.3, x - 0.3, np.where(x < -0.3, x + 0.3, 0)),
+    grad=False, attrs={"lambda": 0.3})
+TestThresholdedRelu = _mk(
+    "thresholded_relu", lambda x: np.where(x > 0.4, x, 0),
+    grad=False, attrs={"threshold": 0.4})
+TestTanhShrink = _mk("tanh_shrink", lambda x: x - np.tanh(x))
+TestSoftRelu = _mk("soft_relu",
+                   lambda x: np.log1p(np.exp(np.clip(x, -2.0, 2.0))),
+                   grad=False, attrs={"threshold": 2.0})
+TestPowAct = _mk("pow", lambda x: np.power(x, 2.0), low=0.5, high=2,
+                 attrs={"factor": 2.0})

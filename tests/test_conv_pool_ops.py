@@ -1,0 +1,174 @@
+"""Conv / pooling op tests (reference test_conv2d_op.py, test_pool2d_op.py).
+Reference outputs computed with torch (CPU) where closed forms are
+impractical."""
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+from op_test import OpTest
+
+
+class TestConv2d(OpTest):
+    def setUp(self):
+        self.op_type = "conv2d"
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 3, 7, 7)).astype(np.float32)
+        w = rng.standard_normal((4, 3, 3, 3)).astype(np.float32)
+        out = F.conv2d(torch.from_numpy(x), torch.from_numpy(w),
+                       stride=2, padding=1).numpy()
+        self.inputs = {"Input": x, "Filter": w}
+        self.outputs = {"Output": out}
+        self.attrs = {"strides": [2, 2], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 1}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["input", "filter"], "output_out",
+                        max_relative_error=0.02)
+
+
+class TestConv2dGroups(OpTest):
+    def setUp(self):
+        self.op_type = "conv2d"
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((1, 4, 5, 5)).astype(np.float32)
+        w = rng.standard_normal((6, 2, 3, 3)).astype(np.float32)
+        out = F.conv2d(torch.from_numpy(x), torch.from_numpy(w),
+                       padding=1, groups=2).numpy()
+        self.inputs = {"Input": x, "Filter": w}
+        self.outputs = {"Output": out}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 2}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestDepthwiseConv2d(OpTest):
+    def setUp(self):
+        self.op_type = "depthwise_conv2d"
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((1, 3, 5, 5)).astype(np.float32)
+        w = rng.standard_normal((3, 1, 3, 3)).astype(np.float32)
+        out = F.conv2d(torch.from_numpy(x), torch.from_numpy(w),
+                       padding=1, groups=3).numpy()
+        self.inputs = {"Input": x, "Filter": w}
+        self.outputs = {"Output": out}
+        self.attrs = {"strides": [1, 1], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 3}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestConv2dTranspose(OpTest):
+    def setUp(self):
+        self.op_type = "conv2d_transpose"
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((1, 3, 4, 4)).astype(np.float32)
+        w = rng.standard_normal((3, 2, 3, 3)).astype(np.float32)
+        out = F.conv_transpose2d(torch.from_numpy(x),
+                                 torch.from_numpy(w),
+                                 stride=2, padding=1).numpy()
+        self.inputs = {"Input": x, "Filter": w}
+        self.outputs = {"Output": out}
+        self.attrs = {"strides": [2, 2], "paddings": [1, 1],
+                      "dilations": [1, 1], "groups": 1,
+                      "output_size": []}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestConv3d(OpTest):
+    def setUp(self):
+        self.op_type = "conv3d"
+        rng = np.random.default_rng(4)
+        x = rng.standard_normal((1, 2, 5, 5, 5)).astype(np.float32)
+        w = rng.standard_normal((3, 2, 3, 3, 3)).astype(np.float32)
+        out = F.conv3d(torch.from_numpy(x), torch.from_numpy(w),
+                       padding=1).numpy()
+        self.inputs = {"Input": x, "Filter": w}
+        self.outputs = {"Output": out}
+        self.attrs = {"strides": [1, 1, 1], "paddings": [1, 1, 1],
+                      "dilations": [1, 1, 1], "groups": 1}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestPool2dMax(OpTest):
+    def setUp(self):
+        self.op_type = "pool2d"
+        rng = np.random.default_rng(5)
+        # well-separated values: numeric-grad perturbation (±0.005) must
+        # not flip which element is the window max
+        x = (rng.permutation(2 * 3 * 6 * 6).reshape(2, 3, 6, 6) * 0.05) \
+            .astype(np.float32)
+        out = F.max_pool2d(torch.from_numpy(x), 2, 2).numpy()
+        self.inputs = {"X": x}
+        self.outputs = {"Out": out}
+        self.attrs = {"pooling_type": "max", "ksize": [2, 2],
+                      "strides": [2, 2], "paddings": [0, 0],
+                      "global_pooling": False, "adaptive": False,
+                      "exclusive": True, "ceil_mode": False}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"], "out_out", max_relative_error=0.02)
+
+
+class TestPool2dAvg(OpTest):
+    def setUp(self):
+        self.op_type = "pool2d"
+        rng = np.random.default_rng(6)
+        x = rng.standard_normal((2, 3, 6, 6)).astype(np.float32)
+        out = F.avg_pool2d(torch.from_numpy(x), 3, 2, 1,
+                           count_include_pad=False).numpy()
+        self.inputs = {"X": x}
+        self.outputs = {"Out": out}
+        self.attrs = {"pooling_type": "avg", "ksize": [3, 3],
+                      "strides": [2, 2], "paddings": [1, 1],
+                      "global_pooling": False, "adaptive": False,
+                      "exclusive": True, "ceil_mode": False}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestPool2dGlobal(OpTest):
+    def setUp(self):
+        self.op_type = "pool2d"
+        rng = np.random.default_rng(7)
+        x = rng.standard_normal((2, 3, 5, 5)).astype(np.float32)
+        out = x.mean(axis=(2, 3), keepdims=True)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": out.astype(np.float32)}
+        self.attrs = {"pooling_type": "avg", "ksize": [1, 1],
+                      "strides": [1, 1], "paddings": [0, 0],
+                      "global_pooling": True, "adaptive": False,
+                      "exclusive": True, "ceil_mode": False}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestPool3d(OpTest):
+    def setUp(self):
+        self.op_type = "pool3d"
+        rng = np.random.default_rng(8)
+        x = rng.standard_normal((1, 2, 4, 4, 4)).astype(np.float32)
+        out = F.max_pool3d(torch.from_numpy(x), 2, 2).numpy()
+        self.inputs = {"X": x}
+        self.outputs = {"Out": out}
+        self.attrs = {"pooling_type": "max", "ksize": [2, 2, 2],
+                      "strides": [2, 2, 2], "paddings": [0, 0, 0],
+                      "global_pooling": False, "adaptive": False,
+                      "exclusive": True, "ceil_mode": False}
+
+    def test_output(self):
+        self.check_output()

@@ -1,0 +1,145 @@
+"""Elementwise op family tests (reference test_elementwise_*_op.py)."""
+import numpy as np
+
+from op_test import OpTest
+
+
+class TestElementwiseAdd(OpTest):
+    def setUp(self):
+        self.op_type = "elementwise_add"
+        rng = np.random.default_rng(7)
+        x = rng.uniform(0.1, 1, (3, 4)).astype(np.float32)
+        y = rng.uniform(0.1, 1, (3, 4)).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x + y}
+        self.attrs = {"axis": -1}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x", "y"], "out_out")
+
+
+class TestElementwiseAddBroadcast(OpTest):
+    """axis-broadcast: Y [4] added along dim 1 of X [3,4,2] (axis=1)."""
+
+    def setUp(self):
+        self.op_type = "elementwise_add"
+        rng = np.random.default_rng(8)
+        x = rng.uniform(0.1, 1, (3, 4, 2)).astype(np.float32)
+        y = rng.uniform(0.1, 1, (4,)).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x + y.reshape(1, 4, 1)}
+        self.attrs = {"axis": 1}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x", "y"], "out_out")
+
+
+class TestElementwiseMul(OpTest):
+    def setUp(self):
+        self.op_type = "elementwise_mul"
+        rng = np.random.default_rng(9)
+        x = rng.uniform(0.1, 1, (2, 5)).astype(np.float32)
+        y = rng.uniform(0.1, 1, (2, 5)).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x * y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x", "y"], "out_out")
+
+
+class TestElementwiseDiv(OpTest):
+    def setUp(self):
+        self.op_type = "elementwise_div"
+        rng = np.random.default_rng(10)
+        x = rng.uniform(0.5, 1, (2, 4)).astype(np.float32)
+        y = rng.uniform(0.5, 1, (2, 4)).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x / y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x", "y"], "out_out", max_relative_error=0.01)
+
+
+class TestElementwiseMax(OpTest):
+    def setUp(self):
+        self.op_type = "elementwise_max"
+        rng = np.random.default_rng(11)
+        x = rng.uniform(0.1, 1, (3, 4)).astype(np.float32)
+        # keep away from ties for a well-defined numeric gradient
+        y = x + rng.choice([-0.2, 0.2], (3, 4))
+        y = y.astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": np.maximum(x, y)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x", "y"], "out_out")
+
+
+class TestElementwiseSub(OpTest):
+    def setUp(self):
+        self.op_type = "elementwise_sub"
+        rng = np.random.default_rng(12)
+        x = rng.uniform(0.1, 1, (6,)).astype(np.float32)
+        y = rng.uniform(0.1, 1, (6,)).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x - y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x", "y"], "out_out")
+
+
+class TestElementwisePow(OpTest):
+    def setUp(self):
+        self.op_type = "elementwise_pow"
+        rng = np.random.default_rng(13)
+        x = rng.uniform(0.5, 2, (3, 3)).astype(np.float32)
+        y = rng.uniform(1, 2, (3, 3)).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": np.power(x, y)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestElementwiseFloorDiv(OpTest):
+    def setUp(self):
+        self.op_type = "elementwise_floordiv"
+        rng = np.random.default_rng(14)
+        x = rng.integers(1, 100, (4, 4)).astype(np.int32)
+        y = rng.integers(1, 10, (4, 4)).astype(np.int32)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x // y}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestElementwiseMod(OpTest):
+    def setUp(self):
+        self.op_type = "elementwise_mod"
+        rng = np.random.default_rng(15)
+        x = rng.integers(1, 100, (4, 4)).astype(np.int32)
+        y = rng.integers(1, 10, (4, 4)).astype(np.int32)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x % y}
+
+    def test_output(self):
+        self.check_output()

@@ -1,0 +1,257 @@
+"""Loss, softmax, and normalization op tests (reference
+test_softmax_op.py, test_cross_entropy_op.py, test_layer_norm_op.py...)."""
+import numpy as np
+from scipy import special
+
+from op_test import OpTest
+
+
+def _softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+class TestSoftmax(OpTest):
+    def setUp(self):
+        self.op_type = "softmax"
+        x = np.random.default_rng(0).standard_normal(
+            (3, 5)).astype(np.float32)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": _softmax(x)}
+        self.attrs = {"axis": -1}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"], "out_out")
+
+
+class TestCrossEntropy(OpTest):
+    def setUp(self):
+        self.op_type = "cross_entropy"
+        rng = np.random.default_rng(1)
+        prob = _softmax(rng.standard_normal((4, 5))).astype(np.float32)
+        label = rng.integers(0, 5, (4, 1)).astype(np.int64)
+        out = -np.log(prob[np.arange(4), label.ravel()]).reshape(4, 1)
+        self.inputs = {"X": prob, "Label": label}
+        self.outputs = {"Y": out.astype(np.float32)}
+        self.attrs = {"soft_label": False}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"], "y_out", max_relative_error=0.02)
+
+
+class TestSoftmaxWithCE(OpTest):
+    def setUp(self):
+        self.op_type = "softmax_with_cross_entropy"
+        rng = np.random.default_rng(2)
+        logits = rng.standard_normal((4, 6)).astype(np.float32)
+        label = rng.integers(0, 6, (4, 1)).astype(np.int64)
+        sm = _softmax(logits)
+        loss = -np.log(sm[np.arange(4), label.ravel()]).reshape(4, 1)
+        self.inputs = {"Logits": logits, "Label": label}
+        self.outputs = {"Softmax": sm.astype(np.float32),
+                        "Loss": loss.astype(np.float32)}
+        self.attrs = {"soft_label": False}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["logits"], "loss_out")
+
+
+class TestSoftmaxWithCESoft(OpTest):
+    def setUp(self):
+        self.op_type = "softmax_with_cross_entropy"
+        rng = np.random.default_rng(3)
+        logits = rng.standard_normal((4, 6)).astype(np.float32)
+        label = _softmax(rng.standard_normal((4, 6))).astype(np.float32)
+        sm = _softmax(logits)
+        loss = -(label * np.log(sm)).sum(1, keepdims=True)
+        self.inputs = {"Logits": logits, "Label": label}
+        self.outputs = {"Softmax": sm.astype(np.float32),
+                        "Loss": loss.astype(np.float32)}
+        self.attrs = {"soft_label": True}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSigmoidCE(OpTest):
+    def setUp(self):
+        self.op_type = "sigmoid_cross_entropy_with_logits"
+        rng = np.random.default_rng(4)
+        x = rng.standard_normal((4, 3)).astype(np.float32)
+        label = rng.integers(0, 2, (4, 3)).astype(np.float32)
+        out = np.maximum(x, 0) - x * label + np.log1p(np.exp(-np.abs(x)))
+        self.inputs = {"X": x, "Label": label}
+        self.outputs = {"Out": out.astype(np.float32)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"], "out_out")
+
+
+class TestLayerNorm(OpTest):
+    def setUp(self):
+        self.op_type = "layer_norm"
+        rng = np.random.default_rng(5)
+        x = rng.standard_normal((3, 8)).astype(np.float32)
+        scale = rng.uniform(0.5, 1.5, (8,)).astype(np.float32)
+        bias = rng.standard_normal((8,)).astype(np.float32)
+        mean = x.mean(1, keepdims=True)
+        var = x.var(1, keepdims=True)
+        y = (x - mean) / np.sqrt(var + 1e-5) * scale + bias
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.outputs = {"Y": y.astype(np.float32),
+                        "Mean": mean.ravel().astype(np.float32),
+                        "Variance": var.ravel().astype(np.float32)}
+        self.attrs = {"begin_norm_axis": 1, "epsilon": 1e-5}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["x", "scale", "bias"], "y_out",
+                        max_relative_error=0.02)
+
+
+class TestBatchNormInference(OpTest):
+    def setUp(self):
+        self.op_type = "batch_norm"
+        rng = np.random.default_rng(6)
+        x = rng.standard_normal((2, 3, 4, 4)).astype(np.float32)
+        scale = rng.uniform(0.5, 1.5, (3,)).astype(np.float32)
+        bias = rng.standard_normal((3,)).astype(np.float32)
+        mean = rng.standard_normal((3,)).astype(np.float32)
+        var = rng.uniform(0.5, 1.5, (3,)).astype(np.float32)
+        y = (x - mean.reshape(1, 3, 1, 1)) / np.sqrt(
+            var.reshape(1, 3, 1, 1) + 1e-5)
+        y = y * scale.reshape(1, 3, 1, 1) + bias.reshape(1, 3, 1, 1)
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias,
+                       "Mean": mean, "Variance": var}
+        self.outputs = {"Y": y.astype(np.float32)}
+        self.attrs = {"is_test": True, "epsilon": 1e-5,
+                      "momentum": 0.9, "data_layout": "NCHW"}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)
+
+
+class TestHuberLoss(OpTest):
+    def setUp(self):
+        self.op_type = "huber_loss"
+        rng = np.random.default_rng(7)
+        x = rng.standard_normal((5, 1)).astype(np.float32)
+        y = rng.standard_normal((5, 1)).astype(np.float32)
+        d = y - x
+        delta = 1.0
+        loss = np.where(np.abs(d) <= delta, 0.5 * d * d,
+                        delta * (np.abs(d) - 0.5 * delta))
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": loss.astype(np.float32),
+                        "Residual": d.astype(np.float32)}
+        self.attrs = {"delta": delta}
+
+    def test_output(self):
+        self.check_output(no_check_set={"Residual"})
+
+
+class TestLogLoss(OpTest):
+    def setUp(self):
+        self.op_type = "log_loss"
+        rng = np.random.default_rng(8)
+        pred = rng.uniform(0.1, 0.9, (5, 1)).astype(np.float32)
+        label = rng.integers(0, 2, (5, 1)).astype(np.float32)
+        eps = 1e-4
+        loss = -label * np.log(pred + eps) - \
+            (1 - label) * np.log(1 - pred + eps)
+        self.inputs = {"Predicted": pred, "Labels": label}
+        self.outputs = {"Loss": loss.astype(np.float32)}
+        self.attrs = {"epsilon": eps}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestKLDivLoss(OpTest):
+    def setUp(self):
+        self.op_type = "kldiv_loss"
+        rng = np.random.default_rng(9)
+        x = np.log(_softmax(rng.standard_normal((4, 5)))).astype(
+            np.float32)
+        target = _softmax(rng.standard_normal((4, 5))).astype(np.float32)
+        loss = target * (np.log(target) - x)
+        loss[target <= 0] = 0
+        self.inputs = {"X": x, "Target": target}
+        self.outputs = {"Loss": loss.astype(np.float32)}
+        self.attrs = {"reduction": "none"}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestLabelSmooth(OpTest):
+    def setUp(self):
+        self.op_type = "label_smooth"
+        oh = np.eye(4, dtype=np.float32)[np.array([0, 2, 1])]
+        eps = 0.1
+        out = oh * (1 - eps) + eps / 4
+        self.inputs = {"X": oh}
+        self.outputs = {"Out": out.astype(np.float32)}
+        self.attrs = {"epsilon": eps}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestDropoutInference(OpTest):
+    def setUp(self):
+        self.op_type = "dropout"
+        x = np.random.default_rng(10).standard_normal(
+            (4, 5)).astype(np.float32)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x,
+                        "Mask": np.ones_like(x, np.uint8)}
+        self.attrs = {"dropout_prob": 0.5, "is_test": True,
+                      "dropout_implementation": "upscale_in_train"}
+
+    def test_output(self):
+        self.check_output(no_check_set={"Mask"})
+
+
+class TestL2Normalize(OpTest):
+    def setUp(self):
+        self.op_type = "l2_normalize"
+        x = np.random.default_rng(11).standard_normal(
+            (3, 6)).astype(np.float32)
+        norm = np.sqrt((x * x).sum(1, keepdims=True) + 1e-10)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": (x / norm).astype(np.float32),
+                        "Norm": norm.astype(np.float32)}
+        self.attrs = {"axis": 1, "epsilon": 1e-10}
+
+    def test_output(self):
+        self.check_output(no_check_set={"Norm"})
+
+
+class TestMeanOp(OpTest):
+    def setUp(self):
+        self.op_type = "mean"
+        x = np.random.default_rng(12).standard_normal(
+            (3, 4)).astype(np.float32)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.asarray(x.mean(), np.float32)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"], "out_out")

@@ -1,0 +1,110 @@
+"""matmul / mul / fc-substrate tests (reference test_matmul_op.py,
+test_mul_op.py)."""
+import numpy as np
+
+from op_test import OpTest
+
+
+class TestMatmul(OpTest):
+    def setUp(self):
+        self.op_type = "matmul"
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((3, 4)).astype(np.float32)
+        y = rng.standard_normal((4, 5)).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x @ y}
+        self.attrs = {"transpose_X": False, "transpose_Y": False,
+                      "alpha": 1.0}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x", "y"], "out_out", max_relative_error=0.01)
+
+
+class TestMatmulTransY(OpTest):
+    def setUp(self):
+        self.op_type = "matmul"
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((3, 4)).astype(np.float32)
+        y = rng.standard_normal((5, 4)).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x @ y.T}
+        self.attrs = {"transpose_X": False, "transpose_Y": True,
+                      "alpha": 1.0}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x", "y"], "out_out", max_relative_error=0.01)
+
+
+class TestMatmulBatchedAlpha(OpTest):
+    def setUp(self):
+        self.op_type = "matmul"
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((2, 3, 4)).astype(np.float32)
+        y = rng.standard_normal((2, 4, 2)).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": 0.5 * np.matmul(x, y)}
+        self.attrs = {"transpose_X": False, "transpose_Y": False,
+                      "alpha": 0.5}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x", "y"], "out_out", max_relative_error=0.01)
+
+
+class TestMul(OpTest):
+    def setUp(self):
+        self.op_type = "mul"
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((3, 2, 2)).astype(np.float32)
+        y = rng.standard_normal((4, 5)).astype(np.float32)
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x.reshape(3, 4) @ y}
+        self.attrs = {"x_num_col_dims": 1, "y_num_col_dims": 1}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x", "y"], "out_out", max_relative_error=0.01)
+
+
+class TestSum(OpTest):
+    def setUp(self):
+        self.op_type = "sum"
+        rng = np.random.default_rng(4)
+        a = rng.standard_normal((3, 4)).astype(np.float32)
+        b = rng.standard_normal((3, 4)).astype(np.float32)
+        c = rng.standard_normal((3, 4)).astype(np.float32)
+        self.inputs = {"X": [("a", a), ("b", b), ("c", c)]}
+        self.outputs = {"Out": a + b + c}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["a", "b", "c"], "out_out")
+
+
+class TestBilinearTensorProduct(OpTest):
+    def setUp(self):
+        self.op_type = "bilinear_tensor_product"
+        rng = np.random.default_rng(5)
+        B, M, N, K = 3, 4, 3, 5
+        x = rng.standard_normal((B, M)).astype(np.float32)
+        y = rng.standard_normal((B, N)).astype(np.float32)
+        w = rng.standard_normal((K, M, N)).astype(np.float32)
+        bias = rng.standard_normal((1, K)).astype(np.float32)
+        out = np.einsum("bm,kmn,bn->bk", x, w, y) + bias
+        self.inputs = {"X": x, "Y": y, "Weight": w, "Bias": bias}
+        self.outputs = {"Out": out.astype(np.float32)}
+
+    def test_output(self):
+        self.check_output(atol=1e-4)

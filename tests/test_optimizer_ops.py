@@ -1,0 +1,227 @@
+"""Optimizer update-rule op tests (reference operators/optimizers/:
+test_sgd_op.py, test_momentum_op.py, test_adam_op.py, ...).
+Each checks one update step against the numpy closed form."""
+import numpy as np
+
+from op_test import OpTest
+
+
+class TestSGD(OpTest):
+    def setUp(self):
+        self.op_type = "sgd"
+        rng = np.random.default_rng(0)
+        p = rng.standard_normal((4, 3)).astype(np.float32)
+        g = rng.standard_normal((4, 3)).astype(np.float32)
+        lr = np.array([0.1], np.float32)
+        self.inputs = {"Param": p, "Grad": g, "LearningRate": lr}
+        self.outputs = {"ParamOut": p - 0.1 * g}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestMomentum(OpTest):
+    def setUp(self):
+        self.op_type = "momentum"
+        rng = np.random.default_rng(1)
+        p = rng.standard_normal((4, 3)).astype(np.float32)
+        g = rng.standard_normal((4, 3)).astype(np.float32)
+        v = rng.standard_normal((4, 3)).astype(np.float32)
+        lr = np.array([0.1], np.float32)
+        mu = 0.9
+        v_out = mu * v + g
+        p_out = p - 0.1 * v_out
+        self.inputs = {"Param": p, "Grad": g, "Velocity": v,
+                       "LearningRate": lr}
+        self.outputs = {"ParamOut": p_out, "VelocityOut": v_out}
+        self.attrs = {"mu": mu, "use_nesterov": False}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestMomentumNesterov(OpTest):
+    def setUp(self):
+        self.op_type = "momentum"
+        rng = np.random.default_rng(2)
+        p = rng.standard_normal((4,)).astype(np.float32)
+        g = rng.standard_normal((4,)).astype(np.float32)
+        v = rng.standard_normal((4,)).astype(np.float32)
+        lr = np.array([0.05], np.float32)
+        mu = 0.9
+        v_out = mu * v + g
+        p_out = p - 0.05 * (g + mu * v_out)
+        self.inputs = {"Param": p, "Grad": g, "Velocity": v,
+                       "LearningRate": lr}
+        self.outputs = {"ParamOut": p_out, "VelocityOut": v_out}
+        self.attrs = {"mu": mu, "use_nesterov": True}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestAdam(OpTest):
+    def setUp(self):
+        self.op_type = "adam"
+        rng = np.random.default_rng(3)
+        p = rng.standard_normal((4, 2)).astype(np.float32)
+        g = rng.standard_normal((4, 2)).astype(np.float32)
+        m1 = rng.standard_normal((4, 2)).astype(np.float32)
+        m2 = rng.uniform(0.1, 1, (4, 2)).astype(np.float32)
+        b1, b2, eps = 0.9, 0.999, 1e-8
+        b1p = np.array([b1 ** 3], np.float32)
+        b2p = np.array([b2 ** 3], np.float32)
+        lr = np.array([0.01], np.float32)
+        m1o = b1 * m1 + (1 - b1) * g
+        m2o = b2 * m2 + (1 - b2) * g * g
+        lr_t = 0.01 * np.sqrt(1 - b2p) / (1 - b1p)
+        p_out = p - lr_t * m1o / (np.sqrt(m2o) + eps)
+        self.inputs = {"Param": p, "Grad": g, "Moment1": m1,
+                       "Moment2": m2, "Beta1Pow": b1p, "Beta2Pow": b2p,
+                       "LearningRate": lr}
+        self.outputs = {"ParamOut": p_out.astype(np.float32),
+                        "Moment1Out": m1o, "Moment2Out": m2o,
+                        "Beta1PowOut": b1p * b1, "Beta2PowOut": b2p * b2}
+        self.attrs = {"beta1": b1, "beta2": b2, "epsilon": eps}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestAdagrad(OpTest):
+    def setUp(self):
+        self.op_type = "adagrad"
+        rng = np.random.default_rng(4)
+        p = rng.standard_normal((4,)).astype(np.float32)
+        g = rng.standard_normal((4,)).astype(np.float32)
+        moment = rng.uniform(0.1, 1, (4,)).astype(np.float32)
+        lr = np.array([0.1], np.float32)
+        eps = 1e-6
+        m_out = moment + g * g
+        p_out = p - 0.1 * g / (np.sqrt(m_out) + eps)
+        self.inputs = {"Param": p, "Grad": g, "Moment": moment,
+                       "LearningRate": lr}
+        self.outputs = {"ParamOut": p_out.astype(np.float32),
+                        "MomentOut": m_out}
+        self.attrs = {"epsilon": eps}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestRmsprop(OpTest):
+    def setUp(self):
+        self.op_type = "rmsprop"
+        rng = np.random.default_rng(5)
+        p = rng.standard_normal((4,)).astype(np.float32)
+        g = rng.standard_normal((4,)).astype(np.float32)
+        ms = rng.uniform(0.1, 1, (4,)).astype(np.float32)
+        mom = rng.standard_normal((4,)).astype(np.float32)
+        mg = np.zeros((4,), np.float32)
+        lr = np.array([0.01], np.float32)
+        rho, eps, momentum = 0.95, 1e-6, 0.9
+        ms_out = rho * ms + (1 - rho) * g * g
+        mom_out = momentum * mom + 0.01 * g / np.sqrt(ms_out + eps)
+        p_out = p - mom_out
+        self.inputs = {"Param": p, "Grad": g, "MeanSquare": ms,
+                       "Moment": mom, "MeanGrad": mg,
+                       "LearningRate": lr}
+        self.outputs = {"ParamOut": p_out, "MomentOut": mom_out,
+                        "MeanSquareOut": ms_out, "MeanGradOut": mg}
+        self.attrs = {"decay": rho, "epsilon": eps,
+                      "momentum": momentum, "centered": False}
+
+    def test_output(self):
+        self.check_output(no_check_set={"MeanGradOut"}, atol=1e-5)
+
+
+class TestAdadelta(OpTest):
+    def setUp(self):
+        self.op_type = "adadelta"
+        rng = np.random.default_rng(6)
+        p = rng.standard_normal((4,)).astype(np.float32)
+        g = rng.standard_normal((4,)).astype(np.float32)
+        asg = rng.uniform(0.1, 1, (4,)).astype(np.float32)
+        asu = rng.uniform(0.1, 1, (4,)).astype(np.float32)
+        rho, eps = 0.95, 1e-6
+        asg_out = rho * asg + (1 - rho) * g * g
+        upd = -np.sqrt((asu + eps) / (asg_out + eps)) * g
+        asu_out = rho * asu + (1 - rho) * upd * upd
+        p_out = p + upd
+        self.inputs = {"Param": p, "Grad": g, "AvgSquaredGrad": asg,
+                       "AvgSquaredUpdate": asu}
+        self.outputs = {"ParamOut": p_out.astype(np.float32),
+                        "AvgSquaredGradOut": asg_out,
+                        "AvgSquaredUpdateOut": asu_out}
+        self.attrs = {"rho": rho, "epsilon": eps}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestFtrl(OpTest):
+    def setUp(self):
+        self.op_type = "ftrl"
+        rng = np.random.default_rng(7)
+        p = rng.standard_normal((4,)).astype(np.float32)
+        g = rng.standard_normal((4,)).astype(np.float32)
+        sq = rng.uniform(0.1, 1, (4,)).astype(np.float32)
+        lin = rng.standard_normal((4,)).astype(np.float32)
+        lr = np.array([0.1], np.float32)
+        l1, l2, power = 0.1, 0.2, -0.5
+        new_acc = sq + g * g
+        if power == -0.5:
+            sigma = (np.sqrt(new_acc) - np.sqrt(sq)) / 0.1
+        else:
+            sigma = (new_acc ** -power - sq ** -power) / 0.1
+        lin_out = lin + g - sigma * p
+        x = l1 * np.sign(lin_out) - lin_out
+        if power == -0.5:
+            y = np.sqrt(new_acc) / 0.1 + 2 * l2
+        else:
+            y = new_acc ** -power / 0.1 + 2 * l2
+        p_out = np.where(np.abs(lin_out) > l1, x / y,
+                         np.zeros_like(p))
+        self.inputs = {"Param": p, "Grad": g, "SquaredAccumulator": sq,
+                       "LinearAccumulator": lin, "LearningRate": lr}
+        self.outputs = {"ParamOut": p_out.astype(np.float32),
+                        "SquaredAccumOut": new_acc,
+                        "LinearAccumOut": lin_out}
+        self.attrs = {"l1": l1, "l2": l2, "lr_power": power}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+
+class TestLamb(OpTest):
+    def setUp(self):
+        self.op_type = "lamb"
+        rng = np.random.default_rng(8)
+        p = rng.standard_normal((4, 2)).astype(np.float32)
+        g = rng.standard_normal((4, 2)).astype(np.float32)
+        m1 = rng.standard_normal((4, 2)).astype(np.float32)
+        m2 = rng.uniform(0.1, 1, (4, 2)).astype(np.float32)
+        b1, b2, eps, wd = 0.9, 0.999, 1e-6, 0.01
+        b1p = np.array([b1], np.float32)
+        b2p = np.array([b2], np.float32)
+        lr = np.array([0.01], np.float32)
+        m1o = b1 * m1 + (1 - b1) * g
+        m2o = b2 * m2 + (1 - b2) * g * g
+        m1h = m1o / (1 - b1p)
+        m2h = m2o / (1 - b2p)
+        r = m1h / (np.sqrt(m2h) + eps) + wd * p
+        p_norm = np.sqrt((p * p).sum())
+        r_norm = np.sqrt((r * r).sum())
+        ratio = np.where(p_norm > 0, np.where(
+            r_norm > 0, p_norm / r_norm, 1.0), 1.0)
+        p_out = p - 0.01 * ratio * r
+        self.inputs = {"Param": p, "Grad": g, "Moment1": m1,
+                       "Moment2": m2, "Beta1Pow": b1p, "Beta2Pow": b2p,
+                       "LearningRate": lr}
+        self.outputs = {"ParamOut": p_out.astype(np.float32),
+                        "Moment1Out": m1o, "Moment2Out": m2o}
+        self.attrs = {"beta1": b1, "beta2": b2, "epsilon": eps,
+                      "weight_decay": wd}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)

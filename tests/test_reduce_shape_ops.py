@@ -1,0 +1,433 @@
+"""Reduction + shape-manipulation op tests (reference reduce_ops/,
+test_reshape_op.py, test_transpose_op.py, test_concat_op.py, ...)."""
+import numpy as np
+
+from op_test import OpTest
+
+
+class TestReduceSum(OpTest):
+    def setUp(self):
+        self.op_type = "reduce_sum"
+        x = np.random.default_rng(0).uniform(
+            0.1, 1, (3, 4, 2)).astype(np.float32)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x.sum(axis=1)}
+        self.attrs = {"dim": [1], "keep_dim": False, "reduce_all": False}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"], "out_out")
+
+
+class TestReduceMeanAll(OpTest):
+    def setUp(self):
+        self.op_type = "reduce_mean"
+        x = np.random.default_rng(1).uniform(
+            0.1, 1, (3, 4)).astype(np.float32)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.asarray(x.mean(), np.float32)}
+        self.attrs = {"dim": [0], "keep_dim": False, "reduce_all": True}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"], "out_out")
+
+
+class TestReduceMaxKeepdim(OpTest):
+    def setUp(self):
+        self.op_type = "reduce_max"
+        x = np.random.default_rng(2).permutation(
+            24).reshape(2, 3, 4).astype(np.float32)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x.max(axis=2, keepdims=True)}
+        self.attrs = {"dim": [2], "keep_dim": True, "reduce_all": False}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"], "out_out")
+
+
+class TestReduceProd(OpTest):
+    def setUp(self):
+        self.op_type = "reduce_prod"
+        x = np.random.default_rng(3).uniform(
+            0.5, 1.5, (3, 4)).astype(np.float32)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x.prod(axis=1)}
+        self.attrs = {"dim": [1], "keep_dim": False, "reduce_all": False}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"], "out_out", max_relative_error=0.01)
+
+
+class TestReduceAll(OpTest):
+    def setUp(self):
+        self.op_type = "reduce_all"
+        x = np.random.default_rng(4).integers(
+            0, 2, (3, 4)).astype(bool)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x.all(axis=1)}
+        self.attrs = {"dim": [1], "keep_dim": False, "reduce_all": False}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestReshape2(OpTest):
+    def setUp(self):
+        self.op_type = "reshape2"
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x.reshape(2, 12),
+                        "XShape": np.zeros((0,), np.float32)}
+        self.attrs = {"shape": [2, 12]}
+
+    def test_output(self):
+        self.check_output(no_check_set={"XShape"})
+
+    def test_grad(self):
+        self.check_grad(["x"], "out_out")
+
+
+class TestReshapeMinusOneZero(OpTest):
+    def setUp(self):
+        self.op_type = "reshape2"
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x.reshape(2, 12),
+                        "XShape": np.zeros((0,), np.float32)}
+        self.attrs = {"shape": [0, -1]}
+
+    def test_output(self):
+        self.check_output(no_check_set={"XShape"})
+
+
+class TestTranspose2(OpTest):
+    def setUp(self):
+        self.op_type = "transpose2"
+        x = np.random.default_rng(5).standard_normal(
+            (2, 3, 4)).astype(np.float32)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x.transpose(0, 2, 1),
+                        "XShape": np.zeros((0,), np.float32)}
+        self.attrs = {"axis": [0, 2, 1]}
+
+    def test_output(self):
+        self.check_output(no_check_set={"XShape"})
+
+    def test_grad(self):
+        self.check_grad(["x"], "out_out")
+
+
+class TestConcat(OpTest):
+    def setUp(self):
+        self.op_type = "concat"
+        rng = np.random.default_rng(6)
+        a = rng.standard_normal((2, 3)).astype(np.float32)
+        b = rng.standard_normal((2, 4)).astype(np.float32)
+        self.inputs = {"X": [("ca", a), ("cb", b)]}
+        self.outputs = {"Out": np.concatenate([a, b], axis=1)}
+        self.attrs = {"axis": 1}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["ca", "cb"], "out_out")
+
+
+class TestSplit(OpTest):
+    def setUp(self):
+        self.op_type = "split"
+        x = np.random.default_rng(7).standard_normal(
+            (4, 6)).astype(np.float32)
+        parts = np.split(x, [2, 5], axis=1)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": [("s0", parts[0]), ("s1", parts[1]),
+                                ("s2", parts[2])]}
+        self.attrs = {"axis": 1, "sections": [2, 3, 1], "num": 0}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"], ["s0", "s1", "s2"])
+
+
+class TestStack(OpTest):
+    def setUp(self):
+        self.op_type = "stack"
+        rng = np.random.default_rng(8)
+        a = rng.standard_normal((3, 4)).astype(np.float32)
+        b = rng.standard_normal((3, 4)).astype(np.float32)
+        self.inputs = {"X": [("sa", a), ("sb", b)]}
+        self.outputs = {"Y": np.stack([a, b], axis=1)}
+        self.attrs = {"axis": 1}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["sa", "sb"], "y_out")
+
+
+class TestSlice(OpTest):
+    def setUp(self):
+        self.op_type = "slice"
+        x = np.random.default_rng(9).standard_normal(
+            (5, 6)).astype(np.float32)
+        self.inputs = {"Input": x}
+        self.outputs = {"Out": x[1:4, 2:5]}
+        self.attrs = {"axes": [0, 1], "starts": [1, 2], "ends": [4, 5]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["input"], "out_out")
+
+
+class TestExpand(OpTest):
+    def setUp(self):
+        self.op_type = "expand"
+        x = np.random.default_rng(10).standard_normal(
+            (2, 3)).astype(np.float32)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.tile(x, (2, 2))}
+        self.attrs = {"expand_times": [2, 2]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"], "out_out")
+
+
+class TestPad(OpTest):
+    def setUp(self):
+        self.op_type = "pad"
+        x = np.random.default_rng(11).standard_normal(
+            (2, 3)).astype(np.float32)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.pad(x, ((0, 1), (2, 0)),
+                                      constant_values=0.5)}
+        self.attrs = {"paddings": [0, 1, 2, 0], "pad_value": 0.5}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"], "out_out")
+
+
+class TestGather(OpTest):
+    def setUp(self):
+        self.op_type = "gather"
+        x = np.random.default_rng(12).standard_normal(
+            (5, 3)).astype(np.float32)
+        idx = np.array([1, 3, 4], np.int32)
+        self.inputs = {"X": x, "Index": idx}
+        self.outputs = {"Out": x[idx]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"], "out_out")
+
+
+class TestScatter(OpTest):
+    def setUp(self):
+        self.op_type = "scatter"
+        x = np.random.default_rng(13).standard_normal(
+            (5, 3)).astype(np.float32)
+        idx = np.array([1, 3], np.int32)
+        upd = np.random.default_rng(14).standard_normal(
+            (2, 3)).astype(np.float32)
+        out = x.copy()
+        out[idx] = upd
+        self.inputs = {"X": x, "Ids": idx, "Updates": upd}
+        self.outputs = {"Out": out}
+        self.attrs = {"overwrite": True}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestCumsum(OpTest):
+    def setUp(self):
+        self.op_type = "cumsum"
+        x = np.random.default_rng(15).standard_normal(
+            (3, 4)).astype(np.float32)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.cumsum(x, axis=1)}
+        self.attrs = {"axis": 1, "exclusive": False, "reverse": False}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"], "out_out")
+
+
+class TestSqueeze2(OpTest):
+    def setUp(self):
+        self.op_type = "squeeze2"
+        x = np.random.default_rng(16).standard_normal(
+            (3, 1, 4)).astype(np.float32)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x.reshape(3, 4),
+                        "XShape": np.zeros((0,), np.float32)}
+        self.attrs = {"axes": [1]}
+
+    def test_output(self):
+        self.check_output(no_check_set={"XShape"})
+
+    def test_grad(self):
+        self.check_grad(["x"], "out_out")
+
+
+class TestUnsqueeze2(OpTest):
+    def setUp(self):
+        self.op_type = "unsqueeze2"
+        x = np.random.default_rng(17).standard_normal(
+            (3, 4)).astype(np.float32)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x.reshape(3, 1, 4),
+                        "XShape": np.zeros((0,), np.float32)}
+        self.attrs = {"axes": [1]}
+
+    def test_output(self):
+        self.check_output(no_check_set={"XShape"})
+
+
+class TestFlatten2(OpTest):
+    def setUp(self):
+        self.op_type = "flatten2"
+        x = np.random.default_rng(18).standard_normal(
+            (2, 3, 4)).astype(np.float32)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x.reshape(2, 12),
+                        "XShape": np.zeros((0,), np.float32)}
+        self.attrs = {"axis": 1}
+
+    def test_output(self):
+        self.check_output(no_check_set={"XShape"})
+
+
+class TestUnstack(OpTest):
+    def setUp(self):
+        self.op_type = "unstack"
+        x = np.random.default_rng(19).standard_normal(
+            (2, 3)).astype(np.float32)
+        self.inputs = {"X": x}
+        self.outputs = {"Y": [("u0", x[0]), ("u1", x[1])]}
+        self.attrs = {"axis": 0, "num": 2}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestTopK(OpTest):
+    def setUp(self):
+        self.op_type = "top_k"
+        x = np.random.default_rng(20).permutation(
+            20).reshape(4, 5).astype(np.float32)
+        srt = np.sort(x, axis=1)[:, ::-1][:, :3]
+        idx = np.argsort(-x, axis=1)[:, :3]
+        self.inputs = {"X": x}
+        self.outputs = {"Out": srt.copy(), "Indices": idx.astype(np.int64)}
+        self.attrs = {"k": 3}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestOneHot(OpTest):
+    def setUp(self):
+        self.op_type = "one_hot"
+        ids = np.array([[1], [0], [3]], np.int64)
+        out = np.zeros((3, 4), np.float32)
+        out[np.arange(3), ids.ravel()] = 1
+        self.inputs = {"X": ids}
+        self.outputs = {"Out": out}
+        self.attrs = {"depth": 4}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestCast(OpTest):
+    def setUp(self):
+        self.op_type = "cast"
+        x = np.random.default_rng(21).standard_normal(
+            (3, 4)).astype(np.float32)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x.astype(np.int32)}
+        self.attrs = {"in_dtype": 5, "out_dtype": 2}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestClip(OpTest):
+    def setUp(self):
+        self.op_type = "clip"
+        x = np.random.default_rng(22).uniform(
+            -1, 1, (3, 4)).astype(np.float32)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.clip(x, -0.4, 0.4)}
+        self.attrs = {"min": -0.4, "max": 0.4}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestWhereSelect(OpTest):
+    """`where` as tensor-select (cond ? x : y)."""
+
+    def setUp(self):
+        self.op_type = "where_op_select"
+        rng = np.random.default_rng(23)
+        cond = rng.integers(0, 2, (3, 4)).astype(bool)
+        x = rng.standard_normal((3, 4)).astype(np.float32)
+        y = rng.standard_normal((3, 4)).astype(np.float32)
+        self.inputs = {"Condition": cond, "X": x, "Y": y}
+        self.outputs = {"Out": np.where(cond, x, y)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestArgMax(OpTest):
+    def setUp(self):
+        self.op_type = "arg_max"
+        x = np.random.default_rng(24).permutation(
+            12).reshape(3, 4).astype(np.float32)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": x.argmax(axis=1).astype(np.int64)}
+        self.attrs = {"axis": 1}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestGatherNd(OpTest):
+    def setUp(self):
+        self.op_type = "gather_nd"
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        idx = np.array([[0, 2], [1, 1]], np.int32)
+        self.inputs = {"X": x, "Index": idx}
+        self.outputs = {"Out": x[idx[:, 0], idx[:, 1]]}
+
+    def test_output(self):
+        self.check_output()
