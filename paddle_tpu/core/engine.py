@@ -181,7 +181,21 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
         params_sig[n] = jax.ShapeDtypeStruct(jnp.shape(arr),
                                              jnp.result_type(arr))
     key_sig = jax.ShapeDtypeStruct((2,), jnp.uint32)
-    jax.eval_shape(step, params_sig, feed_sig, key_sig)
+    try:
+        jax.eval_shape(step, params_sig, feed_sig, key_sig)
+    except NotImplementedError:
+        # Block contains value-dependent-shape ops (sequence_erase,
+        # edit_distance, ...): fall back to the eager interpreter path —
+        # the TPU-native analog of the reference's per-op CPU executor
+        # for ops XLA cannot express with static shapes (SURVEY §7
+        # "interpreter as fallback").
+        def eager_fn(donated_params, const_params, feeds, key):
+            params = dict(const_params)
+            params.update(donated_params)
+            return step(params, feeds, key)
+
+        return TracedStep(eager_fn, [], avail, sorted(feed_sig),
+                          list(fetch_names), [], fetch_lod_box, True)
     updated_names = list(updated_box)
     donated = [n for n in avail if n in updated_names]
     const = [n for n in avail if n not in updated_names]

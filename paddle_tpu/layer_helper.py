@@ -82,12 +82,14 @@ class LayerHelper:
         return param
 
     # ---- ops --------------------------------------------------------------
-    def append_op(self, type, inputs=None, outputs=None, attrs=None):
+    def append_op(self, type, inputs=None, outputs=None, attrs=None,
+                  infer_shape=True):
         if in_dygraph_mode():
             return _dygraph_tracer().trace_op(type, inputs or {},
                                               outputs or {}, attrs or {})
         return self.main_program.current_block().append_op(
-            type, inputs=inputs, outputs=outputs, attrs=attrs)
+            type, inputs=inputs, outputs=outputs, attrs=attrs,
+            infer_shape=infer_shape)
 
     # ---- common patterns --------------------------------------------------
     def input(self, input_param_name="input"):

@@ -1,9 +1,24 @@
-"""Sequence layers over LoD metadata (expanded in a later milestone)."""
+"""Sequence layers over LoD metadata.
+
+Parity: reference python/paddle/fluid/layers/nn.py sequence_* functions
+(sequence_pool, sequence_conv, sequence_expand, sequence_pad, ...) built
+over the static-lod lowerings in paddle_tpu/ops/sequence.py (gathers /
+segment reductions — see that module's docstring for the dense-vs-ragged
+design)."""
 from __future__ import annotations
 
-__all__ = ["sequence_mask"]
-
 from ..layer_helper import LayerHelper
+from ..param_attr import ParamAttr
+
+__all__ = [
+    "sequence_mask", "sequence_pool", "sequence_first_step",
+    "sequence_last_step", "sequence_softmax", "sequence_expand",
+    "sequence_expand_as", "sequence_concat", "sequence_reverse",
+    "sequence_reshape", "sequence_pad", "sequence_unpad",
+    "sequence_conv", "sequence_enumerate", "sequence_erase",
+    "sequence_slice", "sequence_scatter", "im2sequence",
+    "edit_distance",
+]
 
 
 def sequence_mask(x, maxlen=None, dtype="int64", name=None):
@@ -12,5 +27,185 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
     helper.append_op("sequence_mask", inputs={"X": x},
                      outputs={"Y": out},
                      attrs={"maxlen": maxlen if maxlen is not None
-                            else -1})
+                            else -1, "out_dtype": dtype})
     return out
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0):
+    helper = LayerHelper("sequence_pool")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    max_index = helper.create_variable_for_type_inference("int32", True)
+    helper.append_op("sequence_pool", inputs={"X": input},
+                     outputs={"Out": out, "MaxIndex": max_index},
+                     attrs={"pooltype": pool_type.upper(),
+                            "is_test": is_test,
+                            "pad_value": pad_value},
+                     infer_shape=False)
+    return out
+
+
+def sequence_first_step(input):
+    return sequence_pool(input, "first")
+
+
+def sequence_last_step(input):
+    return sequence_pool(input, "last")
+
+
+def sequence_softmax(input, use_cudnn=False, name=None):
+    helper = LayerHelper("sequence_softmax", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("sequence_softmax", inputs={"X": input},
+                     outputs={"Out": out}, infer_shape=False)
+    return out
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    helper = LayerHelper("sequence_expand", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("sequence_expand", inputs={"X": x, "Y": y},
+                     outputs={"Out": out},
+                     attrs={"ref_level": ref_level}, infer_shape=False)
+    return out
+
+
+def sequence_expand_as(x, y, name=None):
+    helper = LayerHelper("sequence_expand_as", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("sequence_expand_as", inputs={"X": x, "Y": y},
+                     outputs={"Out": out}, infer_shape=False)
+    return out
+
+
+def sequence_concat(input, name=None):
+    helper = LayerHelper("sequence_concat", name=name)
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op("sequence_concat", inputs={"X": input},
+                     outputs={"Out": out}, infer_shape=False)
+    return out
+
+
+def sequence_reverse(x, name=None):
+    helper = LayerHelper("sequence_reverse", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("sequence_reverse", inputs={"X": x},
+                     outputs={"Y": out}, infer_shape=False)
+    return out
+
+
+def sequence_reshape(input, new_dim):
+    helper = LayerHelper("sequence_reshape")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("sequence_reshape", inputs={"X": input},
+                     outputs={"Out": out},
+                     attrs={"new_dim": new_dim}, infer_shape=False)
+    return out
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    helper = LayerHelper("sequence_pad", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    length = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op("sequence_pad",
+                     inputs={"X": x, "PadValue": pad_value},
+                     outputs={"Out": out, "Length": length},
+                     attrs={"padded_length": maxlen if maxlen else -1},
+                     infer_shape=False)
+    return out, length
+
+
+def sequence_unpad(x, length, name=None):
+    helper = LayerHelper("sequence_unpad", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("sequence_unpad",
+                     inputs={"X": x, "Length": length},
+                     outputs={"Out": out}, infer_shape=False)
+    return out
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=None, bias_attr=None, param_attr=None, act=None,
+                  name=None):
+    helper = LayerHelper("sequence_conv", bias_attr=bias_attr, act=act,
+                         name=name)
+    filter_shape = [filter_size * input.shape[-1], num_filters]
+    filter_param = helper.create_parameter(param_attr, filter_shape,
+                                           input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "sequence_conv", inputs={"X": input, "Filter": filter_param},
+        outputs={"Out": out},
+        attrs={"contextStride": filter_stride,
+               "contextStart": -int(filter_size // 2),
+               "contextLength": filter_size}, infer_shape=False)
+    pre_act = helper.append_bias_op(out)
+    return helper.append_activation(pre_act)
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    helper = LayerHelper("sequence_enumerate", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op("sequence_enumerate", inputs={"X": input},
+                     outputs={"Out": out},
+                     attrs={"win_size": win_size,
+                            "pad_value": pad_value}, infer_shape=False)
+    return out
+
+
+def sequence_erase(input, tokens, name=None):
+    helper = LayerHelper("sequence_erase", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op("sequence_erase", inputs={"X": input},
+                     outputs={"Out": out},
+                     attrs={"tokens": list(tokens)}, infer_shape=False)
+    return out
+
+
+def sequence_slice(input, offset, length, name=None):
+    helper = LayerHelper("sequence_slice", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("sequence_slice",
+                     inputs={"X": input, "Offset": offset,
+                             "Length": length},
+                     outputs={"Out": out}, infer_shape=False)
+    return out
+
+
+def sequence_scatter(input, index, updates, name=None):
+    helper = LayerHelper("sequence_scatter", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("sequence_scatter",
+                     inputs={"X": input, "Ids": index,
+                             "Updates": updates},
+                     outputs={"Out": out}, infer_shape=False)
+    return out
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0,
+                input_image_size=None, out_stride=1, name=None):
+    helper = LayerHelper("im2sequence", name=name)
+    if isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    if isinstance(stride, int):
+        stride = [stride, stride]
+    if isinstance(padding, int):
+        padding = [padding] * 4
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("im2sequence", inputs={"X": input},
+                     outputs={"Out": out},
+                     attrs={"kernels": filter_size, "strides": stride,
+                            "paddings": padding}, infer_shape=False)
+    return out
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  name=None):
+    helper = LayerHelper("edit_distance", name=name)
+    out = helper.create_variable_for_type_inference("float32", True)
+    seq_num = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op("edit_distance",
+                     inputs={"Hyps": input, "Refs": label},
+                     outputs={"Out": out, "SequenceNum": seq_num},
+                     attrs={"normalized": normalized},
+                     infer_shape=False)
+    return out, seq_num

@@ -1,0 +1,438 @@
+"""Sequence (LoD) op family — dense/static lowering of ragged-batch ops.
+
+Parity: /root/reference/paddle/fluid/operators/sequence_ops/ (20+ LoD-aware
+ops: sequence_pool_op.cc, sequence_softmax_op.cc, sequence_expand_op.cc,
+sequence_conv_op.cc, sequence_pad_op.cc, ...) plus im2sequence_op.cc and
+edit_distance_op.cc at operators/ root.
+
+TPU-first design (SURVEY §5 "long-context"): LoD offsets are HOST-SIDE
+STATIC metadata per trace (part of the engine's compile cache key), so
+every ragged op lowers to static gathers / segment reductions that XLA can
+fuse and tile — no dynamic shapes. Data stays packed [total_tokens, D]
+exactly like the reference's LoDTensor rows. Ops whose output shape
+depends on runtime VALUES (sequence_erase, sequence_slice with tensor
+offsets, edit_distance) execute eagerly (dygraph / concrete inputs only),
+mirroring the reference's CPU-only registration for most of them.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op, register_no_grad_op
+
+
+# ---------------------------------------------------------------------------
+# lod helpers (host-side, static)
+# ---------------------------------------------------------------------------
+
+def _last_level(lod) -> List[int]:
+    if not lod:
+        raise ValueError("sequence op requires a LoD; feed a LoDTensor "
+                         "(dense padding+masking is the alternative path)")
+    return [int(v) for v in lod[-1]]
+
+
+def _lengths(offsets: Sequence[int]) -> np.ndarray:
+    off = np.asarray(offsets, np.int64)
+    return off[1:] - off[:-1]
+
+
+def _segment_ids(offsets) -> np.ndarray:
+    lens = _lengths(offsets)
+    return np.repeat(np.arange(len(lens)), lens)
+
+
+def _is_concrete(*vals) -> bool:
+    return not any(isinstance(v, jax.core.Tracer) for v in vals)
+
+
+def _eager_only(ctx, name):
+    raise NotImplementedError(
+        f"{name} has value-dependent output shape; it runs eagerly "
+        "(dygraph) only — the reference registers it CPU-side for the "
+        "same reason")
+
+
+# ---------------------------------------------------------------------------
+# pooling / softmax / reverse / reshape
+# ---------------------------------------------------------------------------
+
+@register_op("sequence_pool", no_grad_slots=("MaxIndex",))
+def sequence_pool(ctx):
+    x = ctx.input("X")
+    off = _last_level(ctx.get_lod("X"))
+    seg = jnp.asarray(_segment_ids(off))
+    n = len(off) - 1
+    ptype = str(ctx.attr("pooltype", "AVERAGE")).upper()
+    pad_value = ctx.attr("pad_value", 0.0)
+    lens = jnp.asarray(_lengths(off)).reshape((-1,) + (1,) *
+                                              (x.ndim - 1))
+    if ptype in ("AVERAGE", "SUM", "SQRT"):
+        s = jax.ops.segment_sum(x, seg, num_segments=n)
+        if ptype == "AVERAGE":
+            out = s / jnp.maximum(lens, 1).astype(x.dtype)
+        elif ptype == "SQRT":
+            out = s / jnp.sqrt(jnp.maximum(lens, 1).astype(x.dtype))
+        else:
+            out = s
+    elif ptype == "MAX":
+        out = jax.ops.segment_max(x, seg, num_segments=n)
+        mi = jnp.zeros((n,) + x.shape[1:], jnp.int32)
+        ctx.set_output("MaxIndex", mi)
+    elif ptype == "LAST":
+        idx = jnp.asarray(np.asarray(off[1:], np.int64) - 1)
+        out = x[idx]
+    elif ptype == "FIRST":
+        idx = jnp.asarray(np.asarray(off[:-1], np.int64))
+        out = x[idx]
+    else:
+        raise ValueError(f"unknown pooltype {ptype}")
+    empty = (lens == 0)
+    out = jnp.where(empty, jnp.asarray(pad_value, x.dtype), out)
+    ctx.set_output("Out", out)
+    ctx.set_lod("Out", [])
+
+
+@register_op("sequence_softmax")
+def sequence_softmax(ctx):
+    x = ctx.input("X")
+    off = _last_level(ctx.get_lod("X"))
+    seg = jnp.asarray(_segment_ids(off))
+    n = len(off) - 1
+    flat = x.reshape(-1)
+    mx = jax.ops.segment_max(flat, seg, num_segments=n)
+    e = jnp.exp(flat - mx[seg])
+    denom = jax.ops.segment_sum(e, seg, num_segments=n)
+    out = (e / denom[seg]).reshape(x.shape)
+    ctx.set_output("Out", out)
+    ctx.set_lod("Out", ctx.get_lod("X"))
+
+
+@register_op("sequence_reverse")
+def sequence_reverse(ctx):
+    x = ctx.input("X")
+    off = np.asarray(_last_level(ctx.get_lod("X")), np.int64)
+    idx = np.concatenate([np.arange(a, b)[::-1]
+                          for a, b in zip(off[:-1], off[1:])]) \
+        if len(off) > 1 else np.arange(0)
+    ctx.set_output("Y", x[jnp.asarray(idx)])
+    ctx.set_lod("Y", ctx.get_lod("X"))
+
+
+@register_op("sequence_reshape")
+def sequence_reshape(ctx):
+    x = ctx.input("X")
+    new_dim = int(ctx.attr("new_dim"))
+    off = np.asarray(_last_level(ctx.get_lod("X")), np.int64)
+    old_dim = x.shape[-1]
+    out = x.reshape(-1, new_dim)
+    new_off = off * old_dim // new_dim
+    ctx.set_output("Out", out)
+    ctx.set_lod("Out", [list(map(int, new_off))])
+
+
+# ---------------------------------------------------------------------------
+# expand / concat
+# ---------------------------------------------------------------------------
+
+@register_op("sequence_expand", no_grad_slots=("Y",))
+def sequence_expand(ctx):
+    x = ctx.input("X")
+    x_lod = ctx.get_lod("X")
+    y_lod = ctx.get_lod("Y")
+    ref_level = int(ctx.attr("ref_level", -1))
+    if not y_lod:
+        raise ValueError("sequence_expand needs Y lod")
+    ref = y_lod[ref_level if ref_level >= 0 else len(y_lod) - 1]
+    rep = _lengths(ref)
+    if x_lod:
+        x_off = np.asarray(_last_level(x_lod), np.int64)
+        idx, out_off = [], [0]
+        for i, r in enumerate(rep):
+            seq = np.arange(x_off[i], x_off[i + 1])
+            for _ in range(int(r)):
+                idx.append(seq)
+                out_off.append(out_off[-1] + len(seq))
+        idx = np.concatenate(idx) if idx else np.arange(0)
+        ctx.set_output("Out", x[jnp.asarray(idx)])
+        ctx.set_lod("Out", [list(map(int, out_off))])
+    else:
+        idx = np.repeat(np.arange(x.shape[0]), rep)
+        ctx.set_output("Out", x[jnp.asarray(idx)])
+        ctx.set_lod("Out", [])
+
+
+@register_op("sequence_expand_as", no_grad_slots=("Y",))
+def sequence_expand_as(ctx):
+    x = ctx.input("X")
+    y_off = _last_level(ctx.get_lod("Y"))
+    rep = _lengths(y_off)
+    assert x.shape[0] == len(rep), (x.shape, len(rep))
+    idx = np.repeat(np.arange(x.shape[0]), rep)
+    ctx.set_output("Out", x[jnp.asarray(idx)])
+    ctx.set_lod("Out", [list(map(int, y_off))])
+
+
+@register_op("sequence_concat")
+def sequence_concat(ctx):
+    xs = ctx.inputs("X")
+    lods = [np.asarray(_last_level(ctx.get_lod(n)), np.int64)
+            for n in ctx.op.input("X")]
+    n_seq = len(lods[0]) - 1
+    base = 0
+    bases = []
+    for x in xs:
+        bases.append(base)
+        base += x.shape[0]
+    big = jnp.concatenate(xs, axis=0)
+    idx, out_off = [], [0]
+    for i in range(n_seq):
+        total = 0
+        for off, b in zip(lods, bases):
+            idx.append(np.arange(off[i], off[i + 1]) + b)
+            total += int(off[i + 1] - off[i])
+        out_off.append(out_off[-1] + total)
+    idx = np.concatenate(idx) if idx else np.arange(0)
+    ctx.set_output("Out", big[jnp.asarray(idx)])
+    ctx.set_lod("Out", [list(map(int, out_off))])
+
+
+# ---------------------------------------------------------------------------
+# pad / unpad / mask
+# ---------------------------------------------------------------------------
+
+@register_op("sequence_pad", no_grad_slots=("PadValue", "Length"))
+def sequence_pad(ctx):
+    x = ctx.input("X")
+    pad_value = ctx.input("PadValue")
+    off = np.asarray(_last_level(ctx.get_lod("X")), np.int64)
+    lens = _lengths(off)
+    padded_len = int(ctx.attr("padded_length", -1))
+    if padded_len <= 0:
+        padded_len = int(lens.max()) if len(lens) else 0
+    n = len(lens)
+    feat = x.shape[1:]
+    # gather indices: row j of seq i -> off[i]+j (clamped), mask pads
+    j = np.arange(padded_len)
+    gather = off[:-1, None] + np.minimum(j[None, :],
+                                         np.maximum(lens[:, None] - 1, 0))
+    mask = j[None, :] < lens[:, None]
+    out = x[jnp.asarray(gather.reshape(-1))].reshape(
+        (n, padded_len) + feat)
+    pv = jnp.broadcast_to(pad_value.astype(x.dtype).reshape(
+        (1, 1) + (1,) * len(feat)), out.shape)
+    m = jnp.asarray(mask).reshape((n, padded_len) + (1,) * len(feat))
+    out = jnp.where(m, out, pv)
+    ctx.set_output("Out", out)
+    ctx.set_output("Length", jnp.asarray(lens, jnp.int64))
+    # host metadata so sequence_unpad can invert statically
+    ctx.set_lod(ctx.op.output("Out")[0], [])
+    if ctx.op.output("Length"):
+        ctx.set_lod(ctx.op.output("Length")[0], [list(map(int, off))])
+
+
+@register_op("sequence_unpad", no_grad_slots=("Length",))
+def sequence_unpad(ctx):
+    x = ctx.input("X")
+    lod = ctx.get_lod("Length") or ctx.get_lod("X")
+    if not lod:
+        _eager_only(ctx, "sequence_unpad (without static Length lod)")
+    off = np.asarray(_last_level(lod), np.int64)
+    lens = _lengths(off)
+    padded_len = x.shape[1]
+    idx = np.concatenate([i * padded_len + np.arange(l)
+                          for i, l in enumerate(lens)]) \
+        if len(lens) else np.arange(0)
+    flat = x.reshape((-1,) + x.shape[2:])
+    ctx.set_output("Out", flat[jnp.asarray(idx)])
+    ctx.set_lod("Out", [list(map(int, off))])
+
+
+@register_no_grad_op("sequence_mask")
+def sequence_mask(ctx):
+    x = ctx.input("X")
+    maxlen = int(ctx.attr("maxlen", -1))
+    if maxlen <= 0:
+        if _is_concrete(x):
+            maxlen = int(np.max(np.asarray(x))) if x.size else 0
+        else:
+            raise ValueError(
+                "sequence_mask with maxlen=-1 needs concrete lengths "
+                "(dygraph) — pass maxlen explicitly under jit (static "
+                "shapes; reference sequence_mask_op.h computes it "
+                "dynamically on CPU)")
+    from .basic import _np_dtype
+    dt = _np_dtype(ctx, "out_dtype", "int64")
+    rng = jnp.arange(maxlen)
+    out = (rng[None, :] < x.reshape(-1, 1)).astype(dt)
+    out = out.reshape(tuple(x.shape) + (maxlen,))
+    ctx.set_output("Y", out)
+
+
+# ---------------------------------------------------------------------------
+# conv / enumerate / im2sequence
+# ---------------------------------------------------------------------------
+
+@register_op("sequence_conv", no_grad_slots=("PaddingData",))
+def sequence_conv(ctx):
+    x = ctx.input("X")
+    filt = ctx.input("Filter")
+    ctx_len = int(ctx.attr("contextLength"))
+    ctx_start = int(ctx.attr("contextStart", -ctx_len // 2))
+    ctx_stride = int(ctx.attr("contextStride", 1))
+    assert ctx_stride == 1, "contextStride>1 unsupported (ref too)"
+    off = np.asarray(_last_level(ctx.get_lod("X")), np.int64)
+    T, D = x.shape
+    cols = []
+    masks = []
+    starts = np.repeat(off[:-1], _lengths(off))
+    ends = np.repeat(off[1:], _lengths(off))
+    pos = np.arange(T)
+    for c in range(ctx_len):
+        src = pos + ctx_start + c
+        ok = (src >= starts) & (src < ends)
+        cols.append(np.clip(src, 0, max(T - 1, 0)))
+        masks.append(ok)
+    col = x[jnp.asarray(np.stack(cols, 1).reshape(-1))].reshape(
+        T, ctx_len, D)
+    m = jnp.asarray(np.stack(masks, 1))[:, :, None]
+    col = jnp.where(m, col, jnp.zeros((), x.dtype))
+    out = col.reshape(T, ctx_len * D) @ filt
+    ctx.set_output("Out", out)
+    ctx.set_lod("Out", ctx.get_lod("X"))
+
+
+@register_no_grad_op("sequence_enumerate")
+def sequence_enumerate(ctx):
+    x = ctx.input("X")
+    win = int(ctx.attr("win_size"))
+    pad = ctx.attr("pad_value", 0)
+    off = np.asarray(_last_level(ctx.get_lod("X")), np.int64)
+    T = x.shape[0]
+    ends = np.repeat(off[1:], _lengths(off))
+    pos = np.arange(T)
+    flat = x.reshape(T)
+    outs = []
+    for c in range(win):
+        src = pos + c
+        ok = src < ends
+        v = flat[jnp.asarray(np.clip(src, 0, max(T - 1, 0)))]
+        outs.append(jnp.where(jnp.asarray(ok), v,
+                              jnp.asarray(pad, x.dtype)))
+    out = jnp.stack(outs, axis=1)
+    ctx.set_output("Out", out)
+    ctx.set_lod("Out", ctx.get_lod("X"))
+
+
+@register_op("im2sequence")
+def im2sequence(ctx):
+    x = ctx.input("X")
+    kernels = [int(k) for k in ctx.attr("kernels")]
+    strides = [int(s) for s in ctx.attr("strides", [1, 1])]
+    paddings = [int(p) for p in ctx.attr("paddings", [0, 0, 0, 0])]
+    N, C, H, W = x.shape
+    kh, kw = kernels
+    ph0, pw0, ph1, pw1 = paddings[0], paddings[1], \
+        paddings[2] if len(paddings) > 2 else paddings[0], \
+        paddings[3] if len(paddings) > 3 else paddings[1]
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph0, ph1), (pw0, pw1)))
+    oh = (H + ph0 + ph1 - kh) // strides[0] + 1
+    ow = (W + pw0 + pw1 - kw) // strides[1] + 1
+    patches = lax.conv_general_dilated_patches(
+        xp, (kh, kw), tuple(strides), "VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    # [N, C*kh*kw, oh, ow] -> rows [N*oh*ow, C*kh*kw]
+    out = patches.transpose(0, 2, 3, 1).reshape(N * oh * ow,
+                                                C * kh * kw)
+    ctx.set_output("Out", out)
+    step = oh * ow
+    ctx.set_lod("Out", [[i * step for i in range(N + 1)]])
+
+
+# ---------------------------------------------------------------------------
+# eager-only (value-dependent shapes)
+# ---------------------------------------------------------------------------
+
+@register_no_grad_op("sequence_erase")
+def sequence_erase(ctx):
+    x = ctx.input("X")
+    if not _is_concrete(x):
+        _eager_only(ctx, "sequence_erase")
+    tokens = set(int(t) for t in ctx.attr("tokens", []))
+    off = np.asarray(_last_level(ctx.get_lod("X")), np.int64)
+    arr = np.asarray(x).reshape(-1)
+    keep = ~np.isin(arr, list(tokens))
+    out_off = [0]
+    for a, b in zip(off[:-1], off[1:]):
+        out_off.append(out_off[-1] + int(keep[a:b].sum()))
+    out = arr[keep].reshape(-1, *x.shape[1:])
+    ctx.set_output("Out", jnp.asarray(out))
+    ctx.set_lod("Out", [out_off])
+
+
+@register_op("sequence_slice", no_grad_slots=("Offset", "Length"))
+def sequence_slice(ctx):
+    x, offset, length = ctx.input("X"), ctx.input("Offset"), \
+        ctx.input("Length")
+    if not _is_concrete(offset, length):
+        _eager_only(ctx, "sequence_slice")
+    off = np.asarray(_last_level(ctx.get_lod("X")), np.int64)
+    o = np.asarray(offset).reshape(-1)
+    ln = np.asarray(length).reshape(-1)
+    idx, out_off = [], [0]
+    for i in range(len(off) - 1):
+        start = off[i] + int(o[i])
+        idx.append(np.arange(start, start + int(ln[i])))
+        out_off.append(out_off[-1] + int(ln[i]))
+    idx = np.concatenate(idx) if idx else np.arange(0)
+    ctx.set_output("Out", x[jnp.asarray(idx)])
+    ctx.set_lod("Out", [out_off])
+
+
+@register_op("sequence_scatter", no_grad_slots=("Ids",))
+def sequence_scatter(ctx):
+    x = ctx.input("X")
+    ids = ctx.input("Ids")
+    upd = ctx.input("Updates")
+    off = np.asarray(_last_level(ctx.get_lod("Ids")), np.int64)
+    # row r of updates goes to x[seq_of(r), ids[r]] += updates[r]
+    seg = _segment_ids(off)
+    out = x.at[(jnp.asarray(seg), ids.reshape(-1))].add(
+        upd.reshape(-1).astype(x.dtype))
+    ctx.set_output("Out", out)
+
+
+@register_no_grad_op("edit_distance")
+def edit_distance(ctx):
+    hyp, ref = ctx.input("Hyps"), ctx.input("Refs")
+    if not _is_concrete(hyp, ref):
+        _eager_only(ctx, "edit_distance")
+    normalized = ctx.attr("normalized", False)
+    h_off = np.asarray(_last_level(ctx.get_lod("Hyps")), np.int64)
+    r_off = np.asarray(_last_level(ctx.get_lod("Refs")), np.int64)
+    h = np.asarray(hyp).reshape(-1)
+    r = np.asarray(ref).reshape(-1)
+    n = len(h_off) - 1
+    out = np.zeros((n, 1), np.float32)
+    for i in range(n):
+        a = h[h_off[i]:h_off[i + 1]]
+        b = r[r_off[i]:r_off[i + 1]]
+        dp = np.arange(len(b) + 1, dtype=np.float32)
+        for x_tok in a:
+            prev = dp.copy()
+            dp[0] += 1
+            for j in range(1, len(b) + 1):
+                dp[j] = min(prev[j] + 1, dp[j - 1] + 1,
+                            prev[j - 1] + (x_tok != b[j - 1]))
+        d = dp[-1]
+        if normalized:
+            d = d / max(len(b), 1)
+        out[i, 0] = d
+    ctx.set_output("Out", jnp.asarray(out))
+    ctx.set_output("SequenceNum", jnp.asarray([n], np.int64))

@@ -1,0 +1,358 @@
+"""Sequence (LoD) op tests (reference test_sequence_*.py suite).
+LoD inputs use the harness's (array, lod) tuple form."""
+import numpy as np
+
+from op_test import OpTest
+
+
+LOD = [[0, 2, 5, 6]]  # 3 seqs: lens 2, 3, 1
+
+
+def _x(seed=0, d=3, total=6):
+    return np.random.default_rng(seed).uniform(
+        0.1, 1, (total, d)).astype(np.float32)
+
+
+class TestSeqPoolSum(OpTest):
+    def setUp(self):
+        self.op_type = "sequence_pool"
+        x = _x(0)
+        out = np.stack([x[0:2].sum(0), x[2:5].sum(0), x[5:6].sum(0)])
+        self.inputs = {"X": (x, LOD)}
+        self.outputs = {"Out": out.astype(np.float32)}
+        self.attrs = {"pooltype": "SUM"}
+
+    def test_output(self):
+        self.check_output(no_check_set={"MaxIndex"})
+
+    def test_grad(self):
+        self.check_grad(["x"], "out_out")
+
+
+class TestSeqPoolMean(OpTest):
+    def setUp(self):
+        self.op_type = "sequence_pool"
+        x = _x(1)
+        out = np.stack([x[0:2].mean(0), x[2:5].mean(0), x[5:6].mean(0)])
+        self.inputs = {"X": (x, LOD)}
+        self.outputs = {"Out": out.astype(np.float32)}
+        self.attrs = {"pooltype": "AVERAGE"}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"], "out_out")
+
+
+class TestSeqPoolMax(OpTest):
+    def setUp(self):
+        self.op_type = "sequence_pool"
+        x = (np.random.default_rng(2).permutation(18).reshape(6, 3) *
+             0.1).astype(np.float32)
+        out = np.stack([x[0:2].max(0), x[2:5].max(0), x[5:6].max(0)])
+        self.inputs = {"X": (x, LOD)}
+        self.outputs = {"Out": out.astype(np.float32)}
+        self.attrs = {"pooltype": "MAX"}
+
+    def test_output(self):
+        self.check_output(no_check_set={"MaxIndex"})
+
+    def test_grad(self):
+        self.check_grad(["x"], "out_out")
+
+
+class TestSeqPoolSqrtLastFirst(OpTest):
+    def setUp(self):
+        self.op_type = "sequence_pool"
+        x = _x(3)
+        out = np.stack([x[0:2].sum(0) / np.sqrt(2),
+                        x[2:5].sum(0) / np.sqrt(3),
+                        x[5:6].sum(0) / np.sqrt(1)])
+        self.inputs = {"X": (x, LOD)}
+        self.outputs = {"Out": out.astype(np.float32)}
+        self.attrs = {"pooltype": "SQRT"}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSeqSoftmax(OpTest):
+    def setUp(self):
+        self.op_type = "sequence_softmax"
+        x = np.random.default_rng(4).standard_normal((6, 1)).astype(
+            np.float32)
+
+        def sm(seg):
+            e = np.exp(seg - seg.max())
+            return e / e.sum()
+        out = np.concatenate([sm(x[0:2]), sm(x[2:5]), sm(x[5:6])])
+        self.inputs = {"X": (x, LOD)}
+        self.outputs = {"Out": (out.astype(np.float32), LOD)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"], "out_out")
+
+
+class TestSeqReverse(OpTest):
+    def setUp(self):
+        self.op_type = "sequence_reverse"
+        x = _x(5)
+        out = np.concatenate([x[0:2][::-1], x[2:5][::-1], x[5:6][::-1]])
+        self.inputs = {"X": (x, LOD)}
+        self.outputs = {"Y": (out.astype(np.float32), LOD)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"], "y_out")
+
+
+class TestSeqExpand(OpTest):
+    def setUp(self):
+        self.op_type = "sequence_expand"
+        x = _x(6, d=2, total=3)  # 3 seqs of len 1 -> lod [[0,1,2,3]]
+        y = np.zeros((6, 1), np.float32)
+        # y lod level 0: [0,2,5,6]: x seq i repeated len_y(i) times
+        out = np.concatenate([np.repeat(x[0:1], 2, 0),
+                              np.repeat(x[1:2], 3, 0),
+                              np.repeat(x[2:3], 1, 0)])
+        self.inputs = {"X": (x, [[0, 1, 2, 3]]), "Y": (y, LOD)}
+        self.outputs = {"Out": out.astype(np.float32)}
+        self.attrs = {"ref_level": 0}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"], "out_out")
+
+
+class TestSeqExpandAs(OpTest):
+    def setUp(self):
+        self.op_type = "sequence_expand_as"
+        x = _x(7, d=2, total=3)
+        y = np.zeros((6, 1), np.float32)
+        out = np.concatenate([np.repeat(x[0:1], 2, 0),
+                              np.repeat(x[1:2], 3, 0),
+                              np.repeat(x[2:3], 1, 0)])
+        self.inputs = {"X": x, "Y": (y, LOD)}
+        self.outputs = {"Out": (out.astype(np.float32), LOD)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSeqConcat(OpTest):
+    def setUp(self):
+        self.op_type = "sequence_concat"
+        a = _x(8)
+        b = _x(9, total=4)
+        b_lod = [[0, 1, 3, 4]]
+        out = np.concatenate([a[0:2], b[0:1], a[2:5], b[1:3],
+                              a[5:6], b[3:4]])
+        self.inputs = {"X": [("sca", (a, LOD)), ("scb", (b, b_lod))]}
+        self.outputs = {"Out": (out.astype(np.float32),
+                                [[0, 3, 8, 10]])}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["sca", "scb"], "out_out")
+
+
+class TestSeqReshape(OpTest):
+    def setUp(self):
+        self.op_type = "sequence_reshape"
+        x = np.arange(24, dtype=np.float32).reshape(4, 6)
+        lod = [[0, 2, 4]]
+        out = x.reshape(8, 3)
+        self.inputs = {"X": (x, lod)}
+        self.outputs = {"Out": (out, [[0, 4, 8]])}
+        self.attrs = {"new_dim": 3}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSeqPad(OpTest):
+    def setUp(self):
+        self.op_type = "sequence_pad"
+        x = _x(10)
+        pad = np.zeros((1,), np.float32)
+        out = np.zeros((3, 3, 3), np.float32)
+        out[0, :2] = x[0:2]
+        out[1, :3] = x[2:5]
+        out[2, :1] = x[5:6]
+        self.inputs = {"X": (x, LOD), "PadValue": pad}
+        self.outputs = {"Out": out,
+                        "Length": np.array([2, 3, 1], np.int64)}
+        self.attrs = {"padded_length": 3}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"], "out_out")
+
+
+class TestSeqUnpad(OpTest):
+    def setUp(self):
+        self.op_type = "sequence_unpad"
+        rng = np.random.default_rng(11)
+        x = rng.uniform(0.1, 1, (3, 3, 2)).astype(np.float32)
+        length = (np.array([2, 3, 1], np.int64), [[0, 2, 5, 6]])
+        out = np.concatenate([x[0, :2], x[1, :3], x[2, :1]])
+        self.inputs = {"X": x, "Length": length}
+        self.outputs = {"Out": (out.astype(np.float32), LOD)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"], "out_out")
+
+
+class TestSeqMask(OpTest):
+    def setUp(self):
+        self.op_type = "sequence_mask"
+        lens = np.array([2, 0, 3], np.int64)
+        out = np.zeros((3, 4), np.int64)
+        out[0, :2] = 1
+        out[2, :3] = 1
+        self.inputs = {"X": lens}
+        self.outputs = {"Y": out}
+        self.attrs = {"maxlen": 4, "out_dtype": "int64"}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSeqConv(OpTest):
+    def setUp(self):
+        self.op_type = "sequence_conv"
+        rng = np.random.default_rng(12)
+        x = rng.uniform(0.1, 1, (6, 2)).astype(np.float32)
+        filt = rng.uniform(-0.5, 0.5, (6, 4)).astype(np.float32)
+        # contextLength=3, contextStart=-1: rows [t-1, t, t+1]
+        padded = {}
+        off = LOD[0]
+        col = np.zeros((6, 3, 2), np.float32)
+        for i in range(len(off) - 1):
+            for t in range(off[i], off[i + 1]):
+                for c in range(3):
+                    src = t - 1 + c
+                    if off[i] <= src < off[i + 1]:
+                        col[t, c] = x[src]
+        out = col.reshape(6, 6) @ filt
+        self.inputs = {"X": (x, LOD), "Filter": filt}
+        self.outputs = {"Out": (out.astype(np.float32), LOD)}
+        self.attrs = {"contextLength": 3, "contextStart": -1,
+                      "contextStride": 1}
+
+    def test_output(self):
+        self.check_output(atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(["x", "filter"], "out_out",
+                        max_relative_error=0.01)
+
+
+class TestSeqEnumerate(OpTest):
+    def setUp(self):
+        self.op_type = "sequence_enumerate"
+        x = np.array([[1], [2], [3], [4], [5], [6]], np.int32)
+        out = np.array([[1, 2], [2, 0], [3, 4], [4, 5], [5, 0],
+                        [6, 0]], np.int32)
+        self.inputs = {"X": (x, LOD)}
+        self.outputs = {"Out": (out, LOD)}
+        self.attrs = {"win_size": 2, "pad_value": 0}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSeqErase(OpTest):
+    def setUp(self):
+        self.op_type = "sequence_erase"
+        x = np.array([[1], [2], [3], [2], [5], [2]], np.int32)
+        out = np.array([[1], [3], [5]], np.int32)
+        self.inputs = {"X": (x, LOD)}
+        # seqs [1,2],[3,2,5],[2] -> [1],[3,5],[] : lod [0,1,3,3]
+        self.outputs = {"Out": (out, [[0, 1, 3, 3]])}
+        self.attrs = {"tokens": [2]}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSeqSlice(OpTest):
+    def setUp(self):
+        self.op_type = "sequence_slice"
+        x = _x(13)
+        offset = np.array([[0], [1], [0]], np.int64)
+        length = np.array([[1], [2], [1]], np.int64)
+        out = np.concatenate([x[0:1], x[3:5], x[5:6]])
+        self.inputs = {"X": (x, LOD), "Offset": offset,
+                       "Length": length}
+        self.outputs = {"Out": (out.astype(np.float32),
+                                [[0, 1, 3, 4]])}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSeqScatter(OpTest):
+    def setUp(self):
+        self.op_type = "sequence_scatter"
+        x = np.zeros((3, 5), np.float32)
+        ids = np.array([[1], [3], [0], [1], [4], [2]], np.int32)
+        upd = np.arange(1, 7, dtype=np.float32).reshape(6, 1)
+        out = x.copy()
+        seqs = [(0, [0, 1]), (1, [2, 3, 4]), (2, [5])]
+        for row, items in seqs:
+            for k in items:
+                out[row, ids[k, 0]] += upd[k, 0]
+        self.inputs = {"X": x, "Ids": (ids, LOD), "Updates": (upd, LOD)}
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestEditDistance(OpTest):
+    def setUp(self):
+        self.op_type = "edit_distance"
+        hyp = np.array([[1], [2], [3], [1], [5], [6]], np.int64)
+        ref = np.array([[1], [2], [4], [1], [5]], np.int64)
+        # seq0: [1,2,3] vs [1,2,4] -> 1; seq1: [1,5,6] vs [1,5] -> 1
+        self.inputs = {"Hyps": (hyp, [[0, 3, 6]]),
+                       "Refs": (ref, [[0, 3, 5]])}
+        self.outputs = {"Out": np.array([[1.0], [1.0]], np.float32),
+                        "SequenceNum": np.array([2], np.int64)}
+        self.attrs = {"normalized": False}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestIm2Sequence(OpTest):
+    def setUp(self):
+        self.op_type = "im2sequence"
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        # 2x2 kernel stride 2 -> 4 patches
+        out = np.stack([
+            x[0, 0, 0:2, 0:2].ravel(), x[0, 0, 0:2, 2:4].ravel(),
+            x[0, 0, 2:4, 0:2].ravel(), x[0, 0, 2:4, 2:4].ravel()])
+        self.inputs = {"X": x}
+        self.outputs = {"Out": (out.astype(np.float32), [[0, 4]])}
+        self.attrs = {"kernels": [2, 2], "strides": [2, 2],
+                      "paddings": [0, 0, 0, 0]}
+
+    def test_output(self):
+        self.check_output()
