@@ -43,6 +43,9 @@ def main():
     with fluid.program_guard(main_prog, startup):
         cost, logits, feed_names = models.transformer_train(cfg)
         opt = fluid.optimizer.AdamOptimizer(learning_rate=2e-4)
+        # bf16 MXU compute with fp32 master weights (the production
+        # recipe; reference trains transformer fp16 on V100 the same way)
+        opt = fluid.contrib.mixed_precision.decorate(opt)
         opt.minimize(cost)
 
     scope = Scope()

@@ -42,6 +42,7 @@ from . import reader  # noqa: F401
 from .reader.decorators import DataFeeder  # noqa: F401
 from . import dygraph  # noqa: F401
 from . import parallel  # noqa: F401
+from . import contrib  # noqa: F401
 
 # fluid-compatible helpers
 def is_compiled_with_cuda():

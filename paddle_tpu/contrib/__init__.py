@@ -1,0 +1,5 @@
+"""contrib: mixed precision, slim (quantization), extensions.
+
+Parity: reference python/paddle/fluid/contrib/ (SURVEY §2.6 row contrib).
+"""
+from . import mixed_precision  # noqa: F401

@@ -1,0 +1,3 @@
+"""Mixed-precision training (reference contrib/mixed_precision)."""
+from .decorator import decorate, OptimizerWithMixedPrecision  # noqa: F401
+from .fp16_lists import AutoMixedPrecisionLists  # noqa: F401

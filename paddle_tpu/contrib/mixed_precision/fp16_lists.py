@@ -1,0 +1,37 @@
+"""Op lists controlling which ops compute in reduced precision.
+
+Parity: reference contrib/mixed_precision/fp16_lists.py (white/black/gray
+lists). On TPU only MXU ops benefit from reduced precision and XLA fuses
+the casts, so the white list is exactly the matmul/conv family; black_list
+entries are honored by skipping the amp cast for that op type.
+"""
+from __future__ import annotations
+
+white_list = {"conv2d", "matmul", "mul"}
+
+black_list = {
+    "exp", "square", "log", "mean", "sum", "cos_sim",
+    "softmax", "softmax_with_cross_entropy", "sigmoid_cross_entropy_with_logits",
+    "cross_entropy", "cross_entropy2",
+}
+
+gray_list = {
+    "elementwise_add", "elementwise_mul", "elementwise_sub", "relu",
+    "batch_norm", "layer_norm", "pool2d", "dropout", "concat", "reshape2",
+    "transpose2", "scale", "slice", "stack",
+}
+
+
+class AutoMixedPrecisionLists:
+    """Custom white/black list container (fp16_lists.py:20)."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None):
+        self.white_list = set(white_list)
+        self.black_list = set(black_list)
+        self.gray_list = set(gray_list)
+        if custom_white_list:
+            self.white_list |= set(custom_white_list)
+            self.black_list -= set(custom_white_list)
+        if custom_black_list:
+            self.black_list |= set(custom_black_list)
+            self.white_list -= set(custom_black_list)

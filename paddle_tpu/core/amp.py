@@ -1,0 +1,64 @@
+"""Automatic mixed precision state (TPU-native bf16-first).
+
+Parity: reference contrib/mixed_precision (decorator.py:27
+OptimizerWithMixedPrecison — fp16 compute + fp32 master weights + loss
+scaling; white/black op lists in fp16_lists.py). TPU-first differences:
+bf16 shares fp32's exponent range, so no loss scaling is needed and
+master weights can stay fp32 with casts only at MXU op boundaries — the
+engine keeps ALL variables fp32 and the matmul/conv lowerings cast their
+operands to the amp dtype with fp32 accumulation (preferred_element_type),
+which is exactly how XLA wants mixed precision expressed (cast-fuse into
+the conv/dot)."""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "cfg"):
+        _state.cfg = {"enabled": False, "dtype": jnp.bfloat16,
+                      "black": frozenset()}
+    return _state.cfg
+
+
+def amp_enabled() -> bool:
+    return _st()["enabled"]
+
+
+def amp_dtype():
+    return _st()["dtype"]
+
+
+def amp_black_ops():
+    return _st()["black"]
+
+
+@contextlib.contextmanager
+def amp_guard(enabled=True, dtype=jnp.bfloat16, black_ops=()):
+    old = dict(_st())
+    _st().update(enabled=enabled, dtype=dtype,
+                 black=frozenset(black_ops))
+    try:
+        yield
+    finally:
+        _st().update(old)
+
+
+def amp_cast(op_type, *vals):
+    """Cast fp32 operands of an MXU op to the amp dtype (no-op when amp is
+    off or the op is black-listed)."""
+    cfg = _st()
+    if not cfg["enabled"] or op_type in cfg["black"]:
+        return vals
+    dt = cfg["dtype"]
+    out = []
+    for v in vals:
+        if v is not None and jnp.result_type(v) == jnp.float32:
+            v = v.astype(dt)
+        out.append(v)
+    return tuple(out)

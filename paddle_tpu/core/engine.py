@@ -144,6 +144,8 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
             uses_rng_box[0] = True
             return super().step_key()
 
+    amp_cfg = getattr(program, "_amp", None)
+
     def step(params, feeds, key):
         env = _TrackingDict()
         env.update(params)
@@ -157,7 +159,13 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
                           rng_ctx, lod_env, block_runner)
             return sub_env if sub_env is not None else env
 
-        run_block_ops(block, env, rng_ctx, lod_env, block_runner)
+        if amp_cfg:
+            from .amp import amp_guard
+            with amp_guard(True, amp_cfg.get("dtype", jnp.bfloat16),
+                           amp_cfg.get("black_ops", ())):
+                run_block_ops(block, env, rng_ctx, lod_env, block_runner)
+        else:
+            run_block_ops(block, env, rng_ctx, lod_env, block_runner)
 
         updated = sorted(n for n in env.written if n in persistable_all)
         updated_box.clear()

@@ -15,6 +15,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core.registry import register_op
+from ..core.amp import amp_cast
 
 
 def _pair(v, n=2):
@@ -37,13 +38,15 @@ def _conv_nd(ctx, nd, depthwise=False):
     dn = lax.conv_dimension_numbers(
         x.shape, w.shape,
         (f"NC{spatial}", f"OI{spatial}", f"NC{spatial}"))
+    res_t = jnp.result_type(x)
+    x, w = amp_cast("conv2d", x, w)
     acc = jnp.float32 if jnp.result_type(x) in (jnp.bfloat16,
                                                 jnp.float16) else None
     out = lax.conv_general_dilated(
         x, w, window_strides=strides, padding=pad_cfg,
         rhs_dilation=dilations, dimension_numbers=dn,
-        feature_group_count=groups, preferred_element_type=acc)
-    ctx.set_output("Output", out.astype(jnp.result_type(x)))
+        feature_group_count=groups, preferred_element_type=acc or res_t)
+    ctx.set_output("Output", out.astype(res_t))
 
 
 @register_op("conv2d")
@@ -83,11 +86,14 @@ def _conv_transpose_nd(ctx, nd):
         w_t = jnp.concatenate(
             jnp.split(w_t, groups, axis=1), axis=0)
     w_t = jnp.flip(w_t, axis=tuple(range(2, 2 + nd)))
+    res_t = jnp.result_type(x)
+    x, w_t = amp_cast("conv2d_transpose", x, w_t)
     out = lax.conv_general_dilated(
         x, w_t, window_strides=[1] * nd, padding=pad_cfg,
         lhs_dilation=strides, rhs_dilation=dilations,
-        dimension_numbers=dn, feature_group_count=groups)
-    ctx.set_output("Output", out)
+        dimension_numbers=dn, feature_group_count=groups,
+        preferred_element_type=res_t)
+    ctx.set_output("Output", out.astype(res_t))
 
 
 @register_op("conv2d_transpose")

@@ -15,6 +15,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core.registry import register_op
+from ..core.amp import amp_cast
 
 
 def _flat2d(x, num_col_dims):
@@ -40,9 +41,12 @@ def mul(ctx):
     xn = ctx.attr("x_num_col_dims", 1)
     yn = ctx.attr("y_num_col_dims", 1)
     out_shape = tuple(x.shape[:xn]) + tuple(y.shape[yn:])
+    res_t = jnp.result_type(x, y)
     x2, y2 = _flat2d(x, xn), _flat2d(y, yn)
-    out = jnp.matmul(x2, y2, preferred_element_type=_acc_type(x2, y2))
-    out = out.astype(jnp.result_type(x, y))
+    x2, y2 = amp_cast("mul", x2, y2)
+    out = jnp.matmul(x2, y2,
+                     preferred_element_type=_acc_type(x2, y2) or res_t)
+    out = out.astype(res_t)
     ctx.set_output("Out", out.reshape(out_shape))
 
 
@@ -60,8 +64,11 @@ def matmul(ctx):
         x = jnp.swapaxes(x, -1, -2)
     if ty:
         y = jnp.swapaxes(y, -1, -2)
-    out = jnp.matmul(x, y, preferred_element_type=_acc_type(x, y))
-    out = out.astype(jnp.result_type(x, y))
+    res_t = jnp.result_type(x, y)
+    x, y = amp_cast("matmul", x, y)
+    out = jnp.matmul(x, y,
+                     preferred_element_type=_acc_type(x, y) or res_t)
+    out = out.astype(res_t)
     if alpha != 1.0:
         out = out * alpha
     ctx.set_output("Out", out)

@@ -1,0 +1,49 @@
+"""Mixed-precision decorator tests (reference
+test_image_classification_fp16.py pattern: decorated optimizer trains and
+loss decreases; numerics stay close to fp32)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import models
+from paddle_tpu.core.scope import Scope
+
+
+def _train(decorate_fn=None, steps=4):
+    fluid.framework.unique_name.reset()
+    cfg = models.transformer.TransformerConfig(
+        src_vocab_size=64, trg_vocab_size=64, d_model=32, d_inner=64,
+        n_head=4, n_layer=2, dropout=0.0)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        cost, logits, feeds = models.transformer_train(cfg)
+        opt = fluid.optimizer.AdamOptimizer(learning_rate=1e-2)
+        if decorate_fn:
+            opt = decorate_fn(opt)
+            scaled_loss, _ = opt.minimize(cost)
+        else:
+            opt.minimize(cost)
+    batch = models.transformer.make_batch(
+        cfg, 4, 8, 8, rng=np.random.default_rng(0))
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        losses = [float(np.asarray(
+            exe.run(main, feed=batch, fetch_list=[cost])[0]))
+            for _ in range(steps)]
+    return losses
+
+
+def test_bf16_amp_trains_close_to_fp32():
+    fp32 = _train()
+    amp = _train(lambda o: fluid.contrib.mixed_precision.decorate(o))
+    assert amp[-1] < amp[0], amp
+    # bf16 matmuls: same trend, modest numeric gap
+    np.testing.assert_allclose(fp32, amp, rtol=0.1, atol=0.05)
+
+
+def test_fp16_static_loss_scaling():
+    amp = _train(lambda o: fluid.contrib.mixed_precision.decorate(
+        o, init_loss_scaling=128.0, dtype="float16"))
+    assert np.isfinite(amp).all()
+    assert amp[-1] < amp[0], amp
