@@ -37,7 +37,8 @@ def main():
     from paddle_tpu.core.scope import Scope
 
     cfg = models.transformer.transformer_base(
-        src_vocab_size=32000, trg_vocab_size=32000, dropout=0.1)
+        src_vocab_size=32000, trg_vocab_size=32000, dropout=0.1,
+        fuse_attention=True)
     fluid.framework.unique_name.reset()
     main_prog, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_prog, startup):

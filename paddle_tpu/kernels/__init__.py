@@ -1,0 +1,9 @@
+"""Pallas TPU kernels — the custom-kernel slot.
+
+Parity: the reference fills this slot with runtime x86 codegen
+(operators/jit/, Xbyak: act/blas/lstm/gru/seqpool kernels dispatched from
+a kernel pool, jit/README.md). On TPU the same role — hand-written
+kernels for ops the compiler doesn't fuse optimally — is filled by
+Pallas (pallas_call over VMEM blocks feeding the MXU/VPU).
+"""
+from .flash_attention import flash_attention  # noqa: F401

@@ -1,0 +1,153 @@
+"""Blockwise (flash) attention forward kernel in Pallas for TPU.
+
+The reference composes attention from matmul/softmax primitives (no fused
+attention kernel exists in the 2019 snapshot — SURVEY §5 "long-context");
+this kernel is the TPU-native upgrade for that hot path: online-softmax
+over KV blocks so the [Sq, Sk] score matrix never materializes in HBM —
+O(S) memory instead of O(S^2), with the QK^T and PV matmuls running on
+the MXU from VMEM tiles.
+
+Backward currently recomputes attention via the composed jnp formulation
+under jax.vjp (correct, matmul-bound; a dedicated dq/dk/dv kernel is a
+later optimization).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+# test hook: run pallas_call in interpreter mode (CPU correctness tests)
+_INTERPRET = False
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref,
+               m_scr, l_scr, acc_scr, *, scale, n_kv):
+    kv_idx = pl.program_id(2)
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0]                                   # [bq, D]
+    k = k_ref[0]                                   # [bk, D]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale  # [bq, bk]
+    if bias_ref is not None:
+        s = s + bias_ref[0].astype(jnp.float32)
+
+    m_prev = m_scr[:, :1]                          # [bq, 1]
+    l_prev = l_scr[:, :1]
+    m_curr = jnp.max(s, axis=-1, keepdims=True)
+    m_next = jnp.maximum(m_prev, m_curr)
+    corr = jnp.exp(m_prev - m_next)
+    p = jnp.exp(s - m_next)                        # [bq, bk]
+    l_next = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_scr[:] = jnp.broadcast_to(m_next, m_scr.shape)
+    l_scr[:] = jnp.broadcast_to(l_next, l_scr.shape)
+
+    @pl.when(kv_idx == n_kv - 1)
+    def _finish():
+        denom = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+
+
+def _fa_forward(q, k, v, bias, scale, block_q, block_k):
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    bq = min(block_q, Sq)
+    bk = min(block_k, Sk)
+    assert Sq % bq == 0 and Sk % bk == 0, (Sq, Sk, bq, bk)
+    n_kv = Sk // bk
+    qr = q.reshape(B * H, Sq, D)
+    kr = k.reshape(B * H, Sk, D)
+    vr = v.reshape(B * H, Sk, D)
+
+    in_specs = [
+        pl.BlockSpec((1, bq, D), lambda bh, qi, ki: (bh, qi, 0)),
+        pl.BlockSpec((1, bk, D), lambda bh, qi, ki: (bh, ki, 0)),
+        pl.BlockSpec((1, bk, D), lambda bh, qi, ki: (bh, ki, 0)),
+    ]
+    args = [qr, kr, vr]
+    if bias is not None:
+        # bias [B, 1|H, 1|Sq, Sk]: head and query dims may broadcast
+        per_head = bias.shape[1] != 1
+        per_q = bias.shape[2] != 1
+        bqs = bq if per_q else 1
+        br = bias.reshape((B * H if per_head else B,
+                           Sq if per_q else 1, Sk))
+        if per_head:
+            def bias_map(bh, qi, ki):
+                return (bh, qi if per_q else 0, ki)
+        else:
+            def bias_map(bh, qi, ki):
+                return (bh // H, qi if per_q else 0, ki)
+        in_specs.append(pl.BlockSpec((1, bqs, bk), bias_map))
+        args.append(br)
+        kern = functools.partial(_fa_kernel, scale=scale, n_kv=n_kv)
+    else:
+        def kern(q_ref, k_ref, v_ref, o_ref, m, l, a):
+            return _fa_kernel(q_ref, k_ref, v_ref, None, o_ref, m, l, a,
+                              scale=scale, n_kv=n_kv)
+
+    out = pl.pallas_call(
+        kern,
+        grid=(B * H, Sq // bq, n_kv),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, bq, D),
+                               lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_INTERPRET,
+    )(*args)
+    return out.reshape(B, H, Sq, D)
+
+
+def _attn_reference(q, k, v, bias, scale):
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def flash_attention(q, k, v, bias=None, scale=1.0, block_q=128,
+                    block_k=128):
+    """q [B,H,Sq,D], k/v [B,H,Sk,D], bias [B,1|H,Sq,Sk] additive."""
+    return _fa_forward(q, k, v, bias, scale, block_q, block_k)
+
+
+def _fa_fwd(q, k, v, bias, scale, block_q, block_k):
+    out = _fa_forward(q, k, v, bias, scale, block_q, block_k)
+    return out, (q, k, v, bias)
+
+
+def _fa_bwd(scale, block_q, block_k, res, g):
+    q, k, v, bias = res
+    def f(q, k, v, bias):
+        return _attn_reference(q, k, v, bias, scale)
+    _, vjp = jax.vjp(f, q, k, v, bias)
+    dq, dk, dv, dbias = vjp(g)
+    return dq, dk, dv, None if bias is None else dbias
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)

@@ -21,7 +21,8 @@ __all__ = [
     "fc", "embedding", "conv2d", "conv3d", "conv2d_transpose",
     "conv3d_transpose", "pool2d", "pool3d", "adaptive_pool2d", "batch_norm",
     "layer_norm", "group_norm", "instance_norm", "data_norm", "dropout",
-    "softmax", "log_softmax", "matmul", "mul", "relu", "relu6", "sigmoid",
+    "softmax", "log_softmax", "matmul", "mul", "fused_attention",
+    "relu", "relu6", "sigmoid",
     "tanh", "leaky_relu", "elu", "gelu", "swish", "prelu", "brelu",
     "soft_relu", "maxout", "softplus", "softsign", "hard_sigmoid", "selu",
     "one_hot", "reshape", "squeeze", "unsqueeze", "flatten", "transpose",
@@ -903,6 +904,24 @@ def multiplex(inputs, index):
     out = helper.create_variable_for_type_inference(inputs[0].dtype)
     helper.append_op("multiplex", inputs={"X": inputs, "Ids": index},
                      outputs={"Out": out})
+    return out
+
+
+def fused_attention(q, k, v, bias=None, scale=None, block_q=128,
+                    block_k=128, name=None):
+    """Fused multi-head attention via the Pallas flash kernel
+    (paddle_tpu/kernels/flash_attention.py). q/k/v: [B, H, S, D];
+    bias: [B, 1|H, Sq, Sk] additive mask or None."""
+    helper = LayerHelper("fused_attention", name=name)
+    out = helper.create_variable_for_type_inference(q.dtype)
+    inputs = {"Q": q, "K": k, "V": v}
+    if bias is not None:
+        inputs["BiasQK"] = bias
+    helper.append_op("fused_attention", inputs=inputs,
+                     outputs={"Out": out},
+                     attrs={"scale": -1.0 if scale is None else
+                            float(scale),
+                            "block_q": block_q, "block_k": block_k})
     return out
 
 

@@ -17,3 +17,4 @@ from . import optimizer_ops  # noqa: F401
 from . import metrics  # noqa: F401
 from . import control_flow  # noqa: F401
 from . import sequence  # noqa: F401
+from . import fused  # noqa: F401

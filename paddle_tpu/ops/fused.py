@@ -1,0 +1,39 @@
+"""Fused ops (reference operators/fused/: fused_elemwise_activation,
+fused_embedding_seq_pool, fusion_lstm/gru, ...). On TPU XLA fuses the
+elementwise families automatically, so the ops here are the ones that
+need a real kernel: fused multi-head attention via the Pallas flash
+kernel (kernels/flash_attention.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from ..core.amp import amp_cast
+
+
+@register_op("fused_attention")
+def fused_attention(ctx):
+    """Q/K/V: [B, H, S, D]; optional BiasQK [B, 1|H, Sq, Sk] additive.
+    attrs: scale (default d^-0.5), block_q, block_k."""
+    from ..kernels.flash_attention import flash_attention, \
+        _attn_reference
+    q, k, v = ctx.input("Q"), ctx.input("K"), ctx.input("V")
+    bias = ctx.input("BiasQK") if ctx.has_input("BiasQK") else None
+    scale = ctx.attr("scale", None)
+    if scale is None or scale <= 0:
+        scale = float(q.shape[-1]) ** -0.5
+    res_t = jnp.result_type(q)
+    q, k, v = amp_cast("fused_attention", q, k, v)
+    bq = int(ctx.attr("block_q", 128))
+    bk = int(ctx.attr("block_k", 128))
+    Sq, Sk = q.shape[2], k.shape[2]
+    use_pallas = (jax.default_backend() != "cpu"
+                  and Sq % min(bq, Sq) == 0 and Sk % min(bk, Sk) == 0
+                  and q.shape[-1] % 8 == 0)
+    if use_pallas:
+        out = flash_attention(q, k, v, bias, scale, bq, bk)
+    else:
+        # CPU / odd-shape fallback: composed formulation (same math)
+        out = _attn_reference(q, k, v, bias, scale)
+    ctx.set_output("Out", out.astype(res_t))
