@@ -26,7 +26,7 @@ _NEG_INF = -1e30
 _INTERPRET = False
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref,
+def _fa_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
                m_scr, l_scr, acc_scr, *, scale, n_kv):
     kv_idx = pl.program_id(2)
 
@@ -61,9 +61,13 @@ def _fa_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref,
     def _finish():
         denom = jnp.maximum(l_scr[:, :1], 1e-30)
         o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+        if lse_ref is not None:
+            lse_ref[0] = (m_scr[:] + jnp.log(
+                jnp.maximum(l_scr[:], 1e-30))).astype(lse_ref.dtype)
 
 
-def _fa_forward(q, k, v, bias, scale, block_q, block_k):
+def _fa_forward(q, k, v, bias, scale, block_q, block_k,
+                return_lse=False):
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
     bq = min(block_q, Sq)
@@ -95,19 +99,49 @@ def _fa_forward(q, k, v, bias, scale, block_q, block_k):
                 return (bh // H, qi if per_q else 0, ki)
         in_specs.append(pl.BlockSpec((1, bqs, bk), bias_map))
         args.append(br)
-        kern = functools.partial(_fa_kernel, scale=scale, n_kv=n_kv)
+        has_bias = True
     else:
-        def kern(q_ref, k_ref, v_ref, o_ref, m, l, a):
-            return _fa_kernel(q_ref, k_ref, v_ref, None, o_ref, m, l, a,
-                              scale=scale, n_kv=n_kv)
+        has_bias = False
 
-    out = pl.pallas_call(
+    if return_lse:
+        if has_bias:
+            def kern(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
+                     m, l, a):
+                return _fa_kernel(q_ref, k_ref, v_ref, b_ref, o_ref,
+                                  lse_ref, m, l, a, scale=scale,
+                                  n_kv=n_kv)
+        else:
+            def kern(q_ref, k_ref, v_ref, o_ref, lse_ref, m, l, a):
+                return _fa_kernel(q_ref, k_ref, v_ref, None, o_ref,
+                                  lse_ref, m, l, a, scale=scale,
+                                  n_kv=n_kv)
+        out_specs = [
+            pl.BlockSpec((1, bq, D), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, 128), lambda bh, qi, ki: (bh, qi, 0)),
+        ]
+        out_shape = [
+            jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Sq, 128), jnp.float32),
+        ]
+    else:
+        if has_bias:
+            def kern(q_ref, k_ref, v_ref, b_ref, o_ref, m, l, a):
+                return _fa_kernel(q_ref, k_ref, v_ref, b_ref, o_ref,
+                                  None, m, l, a, scale=scale, n_kv=n_kv)
+        else:
+            def kern(q_ref, k_ref, v_ref, o_ref, m, l, a):
+                return _fa_kernel(q_ref, k_ref, v_ref, None, o_ref,
+                                  None, m, l, a, scale=scale, n_kv=n_kv)
+        out_specs = pl.BlockSpec((1, bq, D),
+                                 lambda bh, qi, ki: (bh, qi, 0))
+        out_shape = jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype)
+
+    res = pl.pallas_call(
         kern,
         grid=(B * H, Sq // bq, n_kv),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, bq, D),
-                               lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((bq, 128), jnp.float32),
             pltpu.VMEM((bq, 128), jnp.float32),
@@ -117,7 +151,11 @@ def _fa_forward(q, k, v, bias, scale, block_q, block_k):
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_INTERPRET,
     )(*args)
-    return out.reshape(B, H, Sq, D)
+    if return_lse:
+        out, lse = res
+        return (out.reshape(B, H, Sq, D),
+                lse[:, :, 0].reshape(B, H, Sq))
+    return res.reshape(B, H, Sq, D)
 
 
 def _attn_reference(q, k, v, bias, scale):
@@ -127,6 +165,22 @@ def _attn_reference(q, k, v, bias, scale):
         s = s + bias.astype(jnp.float32)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def _attn_reference_lse(q, k, v, bias, scale):
+    """Composed attention that also returns logsumexp over keys —
+    the CPU/odd-shape counterpart of the kernel's return_lse mode."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - m)
+    l = jnp.sum(e, axis=-1, keepdims=True)
+    p = (e / jnp.maximum(l, 1e-30)).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    lse = (m + jnp.log(jnp.maximum(l, 1e-30)))[..., 0]
+    return out, lse
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))

@@ -1,0 +1,67 @@
+"""Ring attention: exact attention over a sequence-sharded (sp/cp) axis.
+
+The reference has NO sequence/context parallelism (SURVEY §2.3 item 9 —
+2019 snapshot); this is the TPU-native long-context capability the build
+treats as first-class: q/k/v sharded along the sequence dim over the
+"sp" mesh axis, K/V blocks rotated around the ring with
+lax.ppermute (ICI neighbor exchange) while each device accumulates its
+queries' attention over every block with online-softmax (logsumexp)
+merging — O(S/n) memory per chip, compute/communication overlapped by
+XLA since each ppermute is independent of the local block matmul.
+
+Use under shard_map with q/k/v PartitionSpec'd as [B, H, S/sp, D] (and
+batch over dp): `ring_attention(q, k, v, bias, axis_name="sp")`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _block_attn(q, k, v, bias, scale):
+    from ..kernels.flash_attention import (_fa_forward,
+                                           _attn_reference_lse)
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    if (jax.default_backend() != "cpu" and Sq % 128 == 0
+            and Sk % 128 == 0 and D % 8 == 0):
+        return _fa_forward(q, k, v, bias, scale, 128, 128,
+                           return_lse=True)
+    return _attn_reference_lse(q, k, v, bias, scale)
+
+
+def ring_attention(q, k, v, bias=None, axis_name="sp", scale=None):
+    """q, k, v: per-device blocks [B, H, S_local, D] of a sequence
+    sharded over `axis_name`. bias: [B, 1|H, Sq_local, Sk_GLOBAL]
+    additive mask (query rows local, key columns global) or None.
+    Returns the exact global attention output for the local queries."""
+    if scale is None:
+        scale = float(q.shape[-1]) ** -0.5
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    s_local = k.shape[2]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    out = None
+    lse = None
+    for step in range(n):
+        src = (my - step) % n  # whose K/V block we currently hold
+        if bias is not None:
+            b = lax.dynamic_slice_in_dim(bias, src * s_local, s_local,
+                                         axis=3)
+        else:
+            b = None
+        o_i, lse_i = _block_attn(q, k, v, b, scale)
+        if out is None:
+            out, lse = o_i.astype(jnp.float32), lse_i
+        else:
+            new_lse = jnp.logaddexp(lse, lse_i)
+            w_old = jnp.exp(lse - new_lse)[..., None]
+            w_new = jnp.exp(lse_i - new_lse)[..., None]
+            out = out * w_old + o_i.astype(jnp.float32) * w_new
+            lse = new_lse
+        if step != n - 1:
+            k = lax.ppermute(k, axis_name, perm)
+            v = lax.ppermute(v, axis_name, perm)
+    return out.astype(q.dtype)
