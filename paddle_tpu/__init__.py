@@ -43,6 +43,12 @@ from .reader.decorators import DataFeeder  # noqa: F401
 from . import dygraph  # noqa: F401
 from . import parallel  # noqa: F401
 from . import contrib  # noqa: F401
+from . import transpiler  # noqa: F401
+from .transpiler import (  # noqa: F401
+    DistributeTranspiler, DistributeTranspilerConfig, HashName,
+    RoundRobin, memory_optimize, release_memory,
+)
+from . import incubate  # noqa: F401
 
 # fluid-compatible helpers
 def is_compiled_with_cuda():

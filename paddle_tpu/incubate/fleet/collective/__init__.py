@@ -1,0 +1,121 @@
+"""Collective fleet: data-parallel training over the device mesh.
+
+Parity: reference incubate/fleet/collective/__init__.py (:25
+DistributedStrategy, :80-215 Collective fleet + CollectiveOptimizer
+wrapping CompiledProgram.with_data_parallel + the nccl2 transpile).
+
+TPU-native: minimize() marks the program for SPMD compilation over the
+mesh (CompiledProgram.with_data_parallel path — the engine shards the
+batch over "dp" and XLA inserts grad all-reduces over ICI); multi-host
+uses jax.distributed.initialize via init_worker() (PJRT coordination
+replaces gen_nccl_id TCP exchange)."""
+from __future__ import annotations
+
+import os
+
+from .... import compiler as _compiler
+from .... import framework
+from ....compiler import BuildStrategy, ExecutionStrategy
+from ..base.fleet_base import Fleet, DistributedOptimizer, Mode
+
+
+class DistributedStrategy:
+    """Knobs (reference collective/__init__.py:25)."""
+
+    def __init__(self):
+        self.use_local_sgd = False
+        self.use_dist_fc = False
+        self.local_sgd_frequency = 1
+        self.mode = "collective"
+        self.collective_mode = "grad_allreduce"
+        self.nccl_comm_num = 1
+        self.forward_recompute = False
+        self.recompute_checkpoints = []
+        self.use_amp = False
+        self.amp_loss_scaling = 2 ** 15
+        self.exec_strategy = ExecutionStrategy()
+        self.build_strategy = BuildStrategy()
+
+
+class Collective(Fleet):
+    def __init__(self):
+        super().__init__(Mode.COLLECTIVE)
+        self._local_ip = 0
+        self.startup_program = None
+        self._origin_program = None
+        self._transpiled_program = None
+        self.main_program = None
+
+    def init_worker(self):
+        """Multi-host bootstrap: jax.distributed.initialize from the
+        fleet env (PADDLE_TRAINER_ID / PADDLE_TRAINER_ENDPOINTS)."""
+        import jax
+        if self.worker_num() > 1 and os.getenv(
+                "PADDLE_TPU_MULTIHOST", "0") == "1":
+            eps = self.worker_endpoints()
+            jax.distributed.initialize(
+                coordinator_address=eps[0],
+                num_processes=self.worker_num(),
+                process_id=self.worker_index())
+
+    def init_server(self, model_dir=None):
+        pass  # no pservers in collective mode
+
+    def run_server(self):
+        raise NotImplementedError(
+            "collective mode has no servers (reference raises too)")
+
+    def stop_worker(self):
+        pass
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        self._optimizer = CollectiveOptimizer(optimizer, strategy)
+        return self._optimizer
+
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None,
+                             export_for_deployment=True):
+        from .... import io
+        io.save_inference_model(dirname, feeded_var_names, target_vars,
+                                executor, main_program or
+                                self.main_program)
+
+    def save_persistables(self, executor, dirname, main_program=None):
+        from .... import io
+        io.save_persistables(executor, dirname,
+                             main_program or self.main_program)
+
+
+fleet = Collective()
+
+
+class CollectiveOptimizer(DistributedOptimizer):
+    """minimize() = inner minimize + mark program for mesh-SPMD
+    (reference CollectiveOptimizer transpiles nccl2 + CompiledProgram)."""
+
+    def __init__(self, optimizer, strategy=None):
+        super().__init__(optimizer, strategy or DistributedStrategy())
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return self._optimizer.backward(loss, startup_program,
+                                        parameter_list, no_grad_set,
+                                        callbacks)
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        optimize_ops, params_grads = self._optimizer.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+        main = loss.block.program
+        fleet._origin_program = main
+        fleet.main_program = _compiler.CompiledProgram(
+            main).with_data_parallel(
+                loss_name=loss.name,
+                build_strategy=self._strategy.build_strategy,
+                exec_strategy=self._strategy.exec_strategy)
+        fleet.startup_program = startup_program or \
+            framework.default_startup_program()
+        return optimize_ops, params_grads

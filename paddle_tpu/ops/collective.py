@@ -1,0 +1,142 @@
+"""Collective ops (c_*) — XLA collectives over ICI named mesh axes.
+
+Parity: /root/reference/paddle/fluid/operators/collective/ (c_allreduce_op
+.h:105 -> ncclAllReduce, c_allgather, c_reducescatter, c_broadcast,
+c_comm_init / c_gen_nccl_id rank bootstrap, c_sync_{calc,comm}_stream) and
+operators/distributed_ops/{allreduce,broadcast}_op.cc (dygraph variants).
+
+TPU-native semantics: the engine compiles programs SPMD over a named mesh
+(global-view semantics), so a grad tensor inside the compiled step is
+ALREADY the global value — the partitioner inserted the all-reduce. The
+c_* ops therefore have two lowerings:
+
+* under an explicit per-device axis context (shard_map / multi-process
+  jax.distributed, entered via `collective_axis_guard`): real
+  lax.psum / all_gather / psum_scatter / axis-broadcast over the axis
+  name — matching the reference's per-device program view;
+* otherwise: identity (the global-view program already has global
+  values; matches how the reference's ops behave with world_size=1).
+
+Stream-sync ops are no-ops by construction: XLA orders collectives by
+data dependence (no separate comm stream to sync).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op, register_no_grad_op
+
+_axis_state = threading.local()
+
+
+def _axis():
+    return getattr(_axis_state, "name", None)
+
+
+@contextlib.contextmanager
+def collective_axis_guard(axis_name):
+    """Activate per-device collective semantics (inside shard_map /
+    multi-process SPMD) for the ops below."""
+    old = getattr(_axis_state, "name", None)
+    _axis_state.name = axis_name
+    try:
+        yield
+    finally:
+        _axis_state.name = old
+
+
+def _ring_id_axis(ctx):
+    """ring_id attr selects the comm ring in the reference
+    (nccl_comm_num); here rings map to mesh axes via the guard."""
+    return _axis()
+
+
+def _c_allreduce(ctx, op):
+    x = ctx.input("X")
+    ax = _ring_id_axis(ctx)
+    out = op(x, ax) if ax else x
+    ctx.set_output("Out", out)
+
+
+for _name, _red in [
+        ("c_allreduce_sum", lambda x, ax: lax.psum(x, ax)),
+        ("c_allreduce_max", lambda x, ax: lax.pmax(x, ax)),
+        ("c_allreduce_min", lambda x, ax: lax.pmin(x, ax)),
+        ("c_allreduce_prod",
+         lambda x, ax: jnp.exp(lax.psum(jnp.log(x), ax)))]:
+    def _mk(red):
+        def lowering(ctx):
+            _c_allreduce(ctx, red)
+        return lowering
+    register_no_grad_op(_name)(_mk(_red))
+
+
+@register_no_grad_op("allreduce")
+def allreduce(ctx):
+    x = ctx.input("X")
+    ax = _axis()
+    red = int(ctx.attr("reduce_type", 0))  # 0 sum 1 prod 2 max 3 min
+    if ax:
+        if red == 0:
+            x = lax.psum(x, ax)
+        elif red == 1:
+            x = jnp.exp(lax.psum(jnp.log(x), ax))
+        elif red == 2:
+            x = lax.pmax(x, ax)
+        else:
+            x = lax.pmin(x, ax)
+    ctx.set_output("Out", x)
+
+
+@register_no_grad_op("c_allgather")
+def c_allgather(ctx):
+    x = ctx.input("X")
+    ax = _axis()
+    if ax:
+        out = lax.all_gather(x, ax, axis=0, tiled=True)
+    else:
+        out = x
+    ctx.set_output("Out", out)
+
+
+@register_no_grad_op("c_reducescatter")
+def c_reducescatter(ctx):
+    x = ctx.input("X")
+    ax = _axis()
+    if ax:
+        out = lax.psum_scatter(x, ax, scatter_dimension=0, tiled=True)
+    else:
+        out = x
+    ctx.set_output("Out", out)
+
+
+def _bcast(ctx):
+    x = ctx.input("X")
+    ax = _axis()
+    if ax:
+        root = int(ctx.attr("root", 0))
+        idx = lax.axis_index(ax)
+        x = lax.psum(jnp.where(idx == root, x, jnp.zeros_like(x)), ax)
+    ctx.set_output("Out", x)
+
+
+register_no_grad_op("c_broadcast")(_bcast)
+register_no_grad_op("broadcast")(_bcast)
+
+
+# bootstrap / stream ops: subsumed by PJRT + XLA (no-ops that preserve
+# program structure for transpiled graphs)
+for _nop in ["c_comm_init", "c_gen_nccl_id", "gen_nccl_id",
+             "c_sync_calc_stream", "c_sync_comm_stream",
+             "c_wait_comm", "c_wait_compute"]:
+    def _mk_nop(name):
+        def lowering(ctx):
+            if ctx.has_input("X") and ctx.has_output("Out"):
+                ctx.set_output("Out", ctx.input("X"))
+        return lowering
+    register_no_grad_op(_nop)(_mk_nop(_nop))

@@ -1,0 +1,96 @@
+"""Distributed (pserver-era) ops.
+
+Parity: /root/reference/paddle/fluid/operators/distributed_ops/ (send,
+recv, send_barrier, fetch_barrier, listen_and_serv listen_and_serv_op.cc
+:330, prefetch, checkpoint_notify, fake_init, merge_ids, split_ids,
+split_byref, ref_by_trainer_id).
+
+TPU-native: the pserver RPC path is replaced by the collective SPMD path
+(north star "pserver-to-collective", SURVEY §2.3) — send/recv/barrier
+ops become structure-preserving no-ops so transpiled legacy programs
+still execute, while the id-dispatch ops (split_ids/merge_ids — the
+sharded-embedding building blocks) keep their real semantics because the
+EP-style vocab-sharded embedding path uses them.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_no_grad_op
+
+
+def _identity(ctx):
+    if ctx.has_input("X") and ctx.has_output("Out"):
+        xs = ctx.inputs("X")
+        names = ctx.op.output("Out")
+        for n, v in zip(names, xs):
+            ctx.env[n] = v
+
+
+for _t in ["send", "recv", "send_barrier", "fetch_barrier", "prefetch",
+           "checkpoint_notify", "ref_by_trainer_id"]:
+    register_no_grad_op(_t)(_identity)
+
+
+@register_no_grad_op("listen_and_serv")
+def listen_and_serv(ctx):
+    """Pserver event loop (reference listen_and_serv_op.cc:109 RunSyncLoop).
+    No pservers exist on TPU: exits immediately (the transpiler emits it
+    with attr noop=True for launcher compatibility)."""
+    return
+
+
+@register_no_grad_op("fake_init")
+def fake_init(ctx):
+    from .basic import _np_dtype
+    shape = [int(s) for s in ctx.attr("shape", [1])]
+    ctx.set_output("Out", jnp.zeros(shape, _np_dtype(ctx)))
+
+
+@register_no_grad_op("split_ids")
+def split_ids(ctx):
+    """Partition ids round-robin by id % n_parts (reference
+    split_ids_op.h — the pserver shard dispatch). Static output shapes
+    require eager (concrete) execution, like the other value-dependent
+    ops."""
+    ids = ctx.inputs("Ids")[0]
+    n_out = len(ctx.op.output("Out"))
+    if isinstance(ids, jax.core.Tracer):
+        raise NotImplementedError(
+            "split_ids has value-dependent output shapes; runs eagerly")
+    flat = np.asarray(ids).reshape(-1)
+    outs = [jnp.asarray(flat[flat % n_out == i]) for i in range(n_out)]
+    ctx.set_outputs("Out", outs)
+
+
+@register_no_grad_op("merge_ids")
+def merge_ids(ctx):
+    """Inverse of split_ids: reassemble rows so row j of the output is
+    the embedding row for the j-th original id (merge_ids_op.h)."""
+    ids_parts = [np.asarray(v) for v in ctx.inputs("Ids")]
+    rows_parts = ctx.inputs("X")
+    if any(isinstance(v, jax.core.Tracer) for v in rows_parts):
+        raise NotImplementedError("merge_ids runs eagerly")
+    all_ids = np.concatenate([p.reshape(-1) for p in ids_parts])
+    all_rows = jnp.concatenate([jnp.atleast_2d(r) for r in rows_parts],
+                               axis=0)
+    order = np.argsort(np.argsort(all_ids, kind="stable"), kind="stable")
+    n_out = len(ctx.op.output("Out"))
+    ctx.set_outputs("Out", [all_rows] if n_out == 1 else
+                    [all_rows[order]])
+
+
+@register_no_grad_op("split_byref")
+def split_byref(ctx):
+    x = ctx.input("X")
+    n = len(ctx.op.output("Out"))
+    sections = ctx.attr("sections", None)
+    if sections:
+        idx = np.cumsum(sections[:-1])
+        parts = jnp.split(x, [int(i) for i in idx], axis=0)
+    else:
+        parts = jnp.split(x, n, axis=0)
+    ctx.set_outputs("Out", list(parts))

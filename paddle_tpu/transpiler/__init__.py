@@ -1,0 +1,22 @@
+"""Transpilers (reference python/paddle/fluid/transpiler/).
+
+memory_optimize / release_memory are no-ops with a deprecation note —
+XLA's buffer liveness + the engine's donation subsume the legacy
+var-reuse transpiler (reference memory_optimization_transpiler.py).
+"""
+from .distribute_transpiler import (  # noqa: F401
+    DistributeTranspiler, DistributeTranspilerConfig,
+)
+from .ps_dispatcher import HashName, RoundRobin, PSDispatcher  # noqa: F401
+from . import collective  # noqa: F401
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0, skip_grads=True):
+    """No-op: XLA buffer reuse + engine donation replace this pass."""
+    return None
+
+
+def release_memory(input_program, skip_opt_set=None):
+    """No-op (see memory_optimize)."""
+    return None

