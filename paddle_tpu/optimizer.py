@@ -31,7 +31,7 @@ __all__ = [
     "AdamOptimizer", "AdamaxOptimizer", "DecayedAdagradOptimizer",
     "AdadeltaOptimizer", "RMSPropOptimizer", "FtrlOptimizer",
     "LambOptimizer", "LarsMomentumOptimizer", "ModelAverage",
-    "ExponentialMovingAverage",
+    "ExponentialMovingAverage", "PipelineOptimizer", "DGCMomentumOptimizer",
 ]
 
 
@@ -573,3 +573,79 @@ RMSProp = RMSPropOptimizer
 Ftrl = FtrlOptimizer
 Lamb = LambOptimizer
 LarsMomentum = LarsMomentumOptimizer
+
+
+class PipelineOptimizer:
+    """Pipeline-parallel optimizer wrapper.
+
+    Parity: reference optimizer.py:2664 PipelineOptimizer(optimizer,
+    cut_list, place_list, concurrency_list, queue_size, sync_steps) — the
+    program is split into device-pinned sections connected by queues and
+    run by PipelineTrainer/SectionWorker. TPU-native: minimize() builds a
+    separate optimizer-ops program from the inner optimizer (the GPipe
+    engine replays those update lowerings functionally after jax.grad of
+    the pipelined forward); the schedule itself lives in
+    parallel/pipeline.py (ppermute ring over the "pp" mesh axis).
+    """
+
+    def __init__(self, optimizer, cut_list=None, place_list=None,
+                 concurrency_list=None, queue_size=30, sync_steps=1,
+                 start_cpu_core_id=0, num_microbatches=4):
+        self._optimizer = optimizer
+        self._cut_list = cut_list or []
+        self._place_list = place_list
+        self._concurrency_list = concurrency_list
+        self._queue_size = queue_size
+        self._sync_steps = sync_steps
+        self.num_microbatches = num_microbatches
+        self.opt_program = None
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from .framework import Program, program_guard, \
+            default_startup_program
+        main = loss.block.program
+        self.opt_program = Program()
+        startup = startup_program or default_startup_program()
+        params = main.all_parameters()
+        if parameter_list:
+            names = set(parameter_list)
+            params = [p for p in params if p.name in names]
+        with program_guard(self.opt_program, startup):
+            block = self.opt_program.global_block()
+            params_grads = []
+            for p in params:
+                g = block.create_var(name=p.name + "@GRAD",
+                                     dtype=p.dtype, shape=p.shape)
+                params_grads.append((p, g))
+            optimize_ops = self._optimizer.apply_gradients(params_grads)
+        return optimize_ops, params_grads
+
+    def cut_vars(self):
+        """Variable names at which the forward block is split (from
+        cut_list: reference passes Variables; we accept names too)."""
+        out = []
+        for c in self._cut_list:
+            items = c if isinstance(c, (list, tuple)) else [c]
+            for v in items:
+                out.append(v if isinstance(v, str) else v.name)
+        return out
+
+
+class DGCMomentumOptimizer(MomentumOptimizer):
+    """API parity with reference optimizer.py:787 (Deep Gradient
+    Compression: top-k sparse allreduce). Sparse collectives rarely win
+    over ICI (SURVEY §2.3 row DGC — documented non-goal), so this trains
+    as dense Momentum; the rampup/sparsity args are accepted and
+    recorded."""
+
+    def __init__(self, learning_rate, momentum, rampup_begin_step=0,
+                 rampup_step=1, sparsity=(0.999,), use_nesterov=False,
+                 local_grad_clip_norm=None, num_trainers=None,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, momentum,
+                         use_nesterov=use_nesterov,
+                         regularization=regularization, name=name)
+        self._rampup_begin_step = rampup_begin_step
+        self._rampup_step = rampup_step
+        self._sparsity = list(sparsity)

@@ -1,0 +1,256 @@
+"""Pipeline parallelism: GPipe schedule over a "pp" mesh axis.
+
+Parity: reference PipelineOptimizer (python optimizer.py:2664 — splits a
+program into sections at cut variables) + PipelineTrainer/SectionWorker
+(framework/pipeline_trainer.cc:35-48, section_worker.cc:141 — one thread
+pool per section, tensors passed via queues, sync_steps coordination).
+
+TPU-native redesign: the whole pipeline is ONE jitted SPMD step.
+* The forward block is split at cut variables into N uniform stages
+  (program ops replayed through the same lowering registry the engine
+  uses — no second interpreter).
+* Under shard_map over the "pp" axis every device runs the same tick
+  loop; device s executes stage s (lax.switch) on microbatch (t - s) and
+  hands its activation to device s+1 with lax.ppermute — the ICI
+  neighbor-exchange equivalent of the reference's inter-section queues.
+* Backward needs no hand-written schedule: jax.grad differentiates
+  through the tick loop and ppermute, yielding the reverse pipeline
+  automatically (transposed ppermute = reverse edge).
+* Parameter updates reuse the program's registered optimizer-op
+  lowerings (sgd/momentum/adam...) run functionally on (param, grad,
+  state) — one update source of truth with the graph path.
+
+Current scope: stage activations must share one shape (uniform
+transformer-style stages); params are replicated across pp ranks (the
+schedule, not param placement, is what PP buys here — per-stage param
+sharding composes later via the strategy rules).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.registry import OPS, ExecContext, _RngCtx
+from ..core.engine import run_block_ops, _collect_persistable_inputs
+from ..core.scope import LoDTensor, Scope
+
+
+def _producer_index(ops, name):
+    for i, op in enumerate(ops):
+        for slot in op.output_slots():
+            if name in op.output(slot):
+                return i
+    raise ValueError(f"no op produces {name!r}")
+
+
+class PipelineEngine:
+    """Compile + run a GPipe step for (program, loss, cut_vars)."""
+
+    def __init__(self, program, loss_name: str, cut_vars: Sequence[str],
+                 optimizer_program=None, mesh: Mesh = None,
+                 pp_axis: str = "pp", num_microbatches: int = 4):
+        self.program = program
+        self.loss_name = loss_name
+        self.cut_vars = list(cut_vars)
+        self.mesh = mesh
+        self.pp_axis = pp_axis
+        self.n_stages = len(cut_vars) + 1
+        self.n_micro = num_microbatches
+        self._step_fn = None
+        self._opt_program = optimizer_program
+
+    # -- program splitting --------------------------------------------------
+    def _split(self):
+        block = self.program.block(0)
+        ops = [op for op in block.ops if op.type not in ("feed", "fetch")]
+        cuts = [_producer_index(ops, v) + 1 for v in self.cut_vars]
+        bounds = [0] + cuts + [len(ops)]
+        stages = [ops[a:b] for a, b in zip(bounds[:-1], bounds[1:])]
+        return block, stages
+
+    @staticmethod
+    def _stage_io(stages, cut_vars, persistable, feed_names):
+        """Which feeds each stage consumes."""
+        stage_feeds = []
+        produced = set()
+        for s, ops in enumerate(stages):
+            used = set()
+            for op in ops:
+                for slot in op.input_slots():
+                    used.update(op.input(slot))
+            stage_feeds.append(sorted(
+                n for n in used if n in feed_names))
+            for op in ops:
+                for slot in op.output_slots():
+                    produced.update(op.output(slot))
+        return stage_feeds
+
+    # -- public run ---------------------------------------------------------
+    def run(self, scope: Scope, feed: Dict[str, np.ndarray]):
+        """One pipelined training step over the global batch `feed`
+        (split into num_microbatches along dim 0). Returns mean loss."""
+        micro = {}
+        for n in sorted(feed):
+            arr = np.asarray(feed[n])
+            assert arr.shape[0] % self.n_micro == 0, \
+                (n, arr.shape, self.n_micro)
+            micro[n] = jnp.asarray(arr.reshape(
+                (self.n_micro, arr.shape[0] // self.n_micro)
+                + arr.shape[1:]))
+        if self._step_fn is None:
+            feed_sig = {n: jax.ShapeDtypeStruct(a.shape[1:], a.dtype)
+                        for n, a in micro.items()}
+            self._params, self._opt_state = self.build(scope, feed_sig)
+        loss, self._params, self._opt_state = self._step_fn(
+            self._params, self._opt_state, micro)
+        return float(np.asarray(loss))
+
+    def sync_to_scope(self, scope: Scope):
+        for n, v in {**self._params, **self._opt_state}.items():
+            scope.var(n).set_value(v)
+
+    # -- step construction --------------------------------------------------
+    def build(self, scope: Scope, feed_sig: Dict[str, jax.ShapeDtypeStruct]):
+        block, stages = self._split()
+        program = self.program
+        n_stages, n_micro = self.n_stages, self.n_micro
+        axis = self.pp_axis
+        feed_names = sorted(feed_sig)
+
+        def _scope_val(n):
+            v = scope.find_var(n)
+            if v is None or not v.is_initialized():
+                return None
+            val = v.get_value()
+            arr = val.array if isinstance(val, LoDTensor) else val
+            return jnp.asarray(np.asarray(arr))
+
+        # trainable params = Parameter vars of the forward program;
+        # everything else the step touches (optimizer accumulators, LR,
+        # bn stats) is opt_state.
+        param_names = {p.name for p in program.all_parameters()}
+        persist = set(_collect_persistable_inputs(program, block, scope))
+        opt_ops_all = [] if self._opt_program is None else \
+            list(self._opt_program.block(0).ops)
+        for op in opt_ops_all:
+            for slot in op.input_slots():
+                persist.update(n for n in op.input(slot)
+                               if not n.endswith("@GRAD"))
+            for slot in op.output_slots():
+                persist.update(n for n in op.output(slot)
+                               if not n.endswith("@GRAD"))
+        params0, opt_state0 = {}, {}
+        for n in sorted(persist):
+            val = _scope_val(n)
+            if val is None:
+                continue
+            if n in param_names:
+                params0[n] = val
+            else:
+                opt_state0[n] = val
+        stage_feeds = self._stage_io(stages, self.cut_vars,
+                                     set(params0), set(feed_names))
+        cut_in = [None] + self.cut_vars  # stage s>0 reads cut_in[s]
+
+        def run_stage(s, params, env):
+            rng = _RngCtx(jax.random.PRNGKey(0))
+
+            def block_runner(idx, sub_env=None):
+                e = sub_env if sub_env is not None else env
+                run_block_ops(program.block(idx), e, rng, {},
+                              block_runner)
+                return e
+            for op in stages[s]:
+                info = OPS.get(op.type)
+                info.lowering(ExecContext(op, env, rng, block_runner, {}))
+            return env
+
+        loss_name = self.loss_name
+
+        def stage_fn(s, params, act_in, mb_feeds):
+            """Returns (act_out, loss_scalar)."""
+            env = dict(params)
+            env.update({n: mb_feeds[n] for n in stage_feeds[s]})
+            if s > 0:
+                env[cut_in[s]] = act_in
+            env = run_stage(s, params, env)
+            if s == n_stages - 1:
+                return act_in * 0.0, env[loss_name]
+            return env[self.cut_vars[s]], jnp.zeros((), jnp.float32)
+
+        def per_device(params, micro_feeds):
+            """shard_map body over pp axis. micro_feeds: name -> [M, ...]
+            (replicated). Returns mean loss (psum'd from last stage)."""
+            stage = lax.axis_index(axis)
+            T = n_micro + n_stages - 1
+            # activation buffer shape = cut var shape for microbatch
+            act_shape = None
+            # probe stage-0 output shape abstractly is awkward inside
+            # trace; instead run stage 0 on microbatch 0 to get shape
+            probe_feeds = {n: micro_feeds[n][0] for n in micro_feeds}
+            probe, _ = stage_fn(0, params, jnp.zeros(()), probe_feeds)
+            act = jnp.zeros_like(probe)
+            total_loss = jnp.zeros((), jnp.float32)
+            perm = [(i, i + 1) for i in range(n_stages - 1)]
+            branches = [
+                (lambda s: lambda p, a, f: stage_fn(s, p, a, f))(s)
+                for s in range(n_stages)]
+            for t in range(T):
+                mb = t - stage  # my microbatch index this tick
+                mb_c = jnp.clip(mb, 0, n_micro - 1)
+                feeds_t = {n: micro_feeds[n][mb_c] for n in micro_feeds}
+                out, loss = lax.switch(stage, branches, params, act,
+                                       feeds_t)
+                active = jnp.logical_and(mb >= 0, mb < n_micro)
+                out = jnp.where(active, out, jnp.zeros_like(out))
+                loss = jnp.where(active, loss, 0.0)
+                total_loss = total_loss + loss
+                if t != T - 1:
+                    act = lax.ppermute(out, axis, perm)
+            # only last stage accumulated loss; share it
+            total_loss = lax.psum(total_loss, axis)
+            return total_loss / n_micro
+
+        mesh = self.mesh
+        repl = P()
+
+        smapped = shard_map(
+            per_device, mesh=mesh,
+            in_specs=(repl, repl), out_specs=repl,
+            check_rep=False)
+
+        def loss_fn(params, state, micro_feeds):
+            merged = dict(state)
+            merged.update(params)
+            return smapped(merged, micro_feeds)
+
+        opt_ops = opt_ops_all
+
+        def step(params, opt_state, micro_feeds):
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, opt_state, micro_feeds)
+            env = dict(params)
+            env.update(opt_state)
+            for pname, g in grads.items():
+                env[pname + "@GRAD"] = g
+            rng = _RngCtx(jax.random.PRNGKey(0))
+            for op in opt_ops:
+                info = OPS.get(op.type)
+                info.lowering(ExecContext(op, env, rng, None, {}))
+            new_params = {n: env[n] for n in params}
+            new_state = {n: env[n] for n in opt_state}
+            return loss, new_params, new_state
+
+        self._step_fn = jax.jit(step, donate_argnums=(0, 1))
+        return params0, opt_state0
+
+    def __repr__(self):
+        return (f"PipelineEngine(stages={self.n_stages}, "
+                f"micro={self.n_micro}, axis={self.pp_axis!r})")
