@@ -224,7 +224,7 @@ class PipelineEngine:
         smapped = shard_map(
             per_device, mesh=mesh,
             in_specs=(repl, repl), out_specs=repl,
-            check_rep=False)
+            check_vma=False)
 
         def loss_fn(params, state, micro_feeds):
             merged = dict(state)
