@@ -1,0 +1,25 @@
+"""Build libpaddle_tpu_native.so (g++; no pybind11 in the image — the C
+ABI binds via ctypes, see SURVEY §2.8 pybind row)."""
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "libpaddle_tpu_native.so")
+_SRCS = ["recordio.cc", "data_feed.cc"]
+_lock = threading.Lock()
+
+
+def lib_path() -> str:
+    with _lock:
+        srcs = [os.path.join(_HERE, s) for s in _SRCS]
+        if os.path.exists(_SO) and all(
+                os.path.getmtime(_SO) >= os.path.getmtime(s)
+                for s in srcs):
+            return _SO
+        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+               "-pthread", "-o", _SO] + srcs
+        subprocess.run(cmd, check=True, capture_output=True)
+        return _SO

@@ -1,0 +1,255 @@
+// Multi-threaded file -> blocking-queue data feed.
+//
+// Parity: /root/reference/paddle/fluid/framework/data_feed.cc (~1.1k LoC:
+// MultiSlotDataFeed parses slot-formatted text files on reader threads
+// into a channel) + operators/reader/lod_tensor_blocking_queue.h (bounded
+// queue feeding the exec thread) + reader/buffered_reader.cc
+// (double-buffer prefetch). TPU-native: one C++ library provides the
+// bounded byte-batch queue + N reader threads over recordio shards; the
+// Python side wraps batches as numpy without copies (ctypes buffer) and
+// jax.device_put overlaps host->HBM transfer with the previous step.
+//
+// Record payload = one sample, fixed binary layout:
+//   u32 n_slots, then per slot: u32 dtype(0=f32,1=i64,2=i32),
+//   u32 ndim, u64 dims[ndim], data bytes.
+// Batches concatenate samples along a new leading dim (all samples in a
+// file must agree on slot shapes — the dense-padding contract).
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* recordio_scanner_open(const char* path);
+int64_t recordio_next(void* s, const uint8_t** out);
+void recordio_scanner_close(void* s);
+}
+
+namespace {
+
+struct Batch {
+  // concatenated slot buffers + geometry
+  std::vector<std::vector<uint8_t>> slot_data;
+  std::vector<uint32_t> slot_dtype;
+  std::vector<std::vector<uint64_t>> slot_dims;  // per-sample dims
+  uint64_t batch_size = 0;
+};
+
+class BlockingQueue {
+ public:
+  explicit BlockingQueue(size_t cap) : cap_(cap) {}
+
+  bool push(Batch&& b) {
+    std::unique_lock<std::mutex> lk(mu_);
+    not_full_.wait(lk, [&] { return q_.size() < cap_ || closed_; });
+    if (closed_) return false;
+    q_.push_back(std::move(b));
+    not_empty_.notify_one();
+    return true;
+  }
+
+  bool pop(Batch* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    not_empty_.wait(lk, [&] { return !q_.empty() || (closed_ && done_); });
+    if (q_.empty()) return false;
+    *out = std::move(q_.front());
+    q_.pop_front();
+    not_full_.notify_one();
+    return true;
+  }
+
+  void close() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = true;
+    not_full_.notify_all();
+    not_empty_.notify_all();
+  }
+
+  void set_done() {
+    std::lock_guard<std::mutex> lk(mu_);
+    done_ = true;
+    closed_ = true;
+    not_empty_.notify_all();
+  }
+
+  size_t size() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return q_.size();
+  }
+
+ private:
+  std::mutex mu_;
+  std::condition_variable not_full_, not_empty_;
+  std::deque<Batch> q_;
+  size_t cap_;
+  bool closed_ = false;
+  bool done_ = false;
+};
+
+struct Sample {
+  std::vector<uint32_t> dtype;
+  std::vector<std::vector<uint64_t>> dims;
+  std::vector<std::vector<uint8_t>> data;
+};
+
+size_t dtype_size(uint32_t dt) { return dt == 0 ? 4 : dt == 1 ? 8 : 4; }
+
+bool parse_sample(const uint8_t* p, int64_t len, Sample* s) {
+  const uint8_t* end = p + len;
+  if (p + 4 > end) return false;
+  uint32_t n_slots;
+  memcpy(&n_slots, p, 4);
+  p += 4;
+  for (uint32_t i = 0; i < n_slots; i++) {
+    if (p + 8 > end) return false;
+    uint32_t dt, ndim;
+    memcpy(&dt, p, 4);
+    memcpy(&ndim, p + 4, 4);
+    p += 8;
+    std::vector<uint64_t> dims(ndim);
+    if (p + 8 * ndim > end) return false;
+    memcpy(dims.data(), p, 8 * ndim);
+    p += 8 * ndim;
+    uint64_t numel = 1;
+    for (auto d : dims) numel *= d;
+    uint64_t bytes = numel * dtype_size(dt);
+    if (p + bytes > end) return false;
+    s->dtype.push_back(dt);
+    s->dims.push_back(std::move(dims));
+    s->data.emplace_back(p, p + bytes);
+    p += bytes;
+  }
+  return true;
+}
+
+class Feeder {
+ public:
+  Feeder(std::vector<std::string> files, uint64_t batch_size,
+         int n_threads, size_t queue_cap)
+      : files_(std::move(files)),
+        batch_size_(batch_size),
+        queue_(queue_cap),
+        next_file_(0),
+        live_threads_(n_threads) {
+    for (int t = 0; t < n_threads; t++)
+      threads_.emplace_back([this] { this->worker(); });
+  }
+
+  ~Feeder() {
+    queue_.close();
+    for (auto& t : threads_)
+      if (t.joinable()) t.join();
+  }
+
+  bool next(Batch* out) { return queue_.pop(out); }
+
+ private:
+  void worker() {
+    std::vector<Sample> pending;
+    for (;;) {
+      size_t idx = next_file_.fetch_add(1);
+      if (idx >= files_.size()) break;
+      void* sc = recordio_scanner_open(files_[idx].c_str());
+      if (!sc) continue;
+      const uint8_t* rec;
+      int64_t len;
+      while ((len = recordio_next(sc, &rec)) >= 0) {
+        Sample s;
+        if (!parse_sample(rec, len, &s)) break;
+        pending.push_back(std::move(s));
+        if (pending.size() == batch_size_) {
+          if (!emit(&pending)) {
+            recordio_scanner_close(sc);
+            return;
+          }
+        }
+      }
+      recordio_scanner_close(sc);
+    }
+    if (!pending.empty()) emit(&pending);  // final partial batch
+    if (live_threads_.fetch_sub(1) == 1) queue_.set_done();
+  }
+
+  bool emit(std::vector<Sample>* pending) {
+    Batch b;
+    b.batch_size = pending->size();
+    size_t n_slots = (*pending)[0].dtype.size();
+    for (size_t sl = 0; sl < n_slots; sl++) {
+      b.slot_dtype.push_back((*pending)[0].dtype[sl]);
+      b.slot_dims.push_back((*pending)[0].dims[sl]);
+      std::vector<uint8_t> buf;
+      for (auto& s : *pending)
+        buf.insert(buf.end(), s.data[sl].begin(), s.data[sl].end());
+      b.slot_data.push_back(std::move(buf));
+    }
+    pending->clear();
+    return queue_.push(std::move(b));
+  }
+
+  std::vector<std::string> files_;
+  uint64_t batch_size_;
+  BlockingQueue queue_;
+  std::atomic<size_t> next_file_;
+  std::atomic<int> live_threads_;
+  std::vector<std::thread> threads_;
+};
+
+struct FeederHandle {
+  Feeder* feeder;
+  Batch current;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* feeder_create(const char** files, int n_files, uint64_t batch_size,
+                    int n_threads, uint64_t queue_cap) {
+  std::vector<std::string> fs(files, files + n_files);
+  return new FeederHandle{
+      new Feeder(std::move(fs), batch_size, n_threads, queue_cap), {}};
+}
+
+// pops the next batch; returns batch_size or 0 at end of data.
+uint64_t feeder_next(void* h) {
+  FeederHandle* fh = static_cast<FeederHandle*>(h);
+  if (!fh->feeder->next(&fh->current)) return 0;
+  return fh->current.batch_size;
+}
+
+uint32_t feeder_num_slots(void* h) {
+  return static_cast<FeederHandle*>(h)->current.slot_data.size();
+}
+
+uint32_t feeder_slot_dtype(void* h, uint32_t slot) {
+  return static_cast<FeederHandle*>(h)->current.slot_dtype[slot];
+}
+
+uint32_t feeder_slot_ndim(void* h, uint32_t slot) {
+  return static_cast<FeederHandle*>(h)->current.slot_dims[slot].size();
+}
+
+void feeder_slot_dims(void* h, uint32_t slot, uint64_t* out) {
+  auto& d = static_cast<FeederHandle*>(h)->current.slot_dims[slot];
+  memcpy(out, d.data(), d.size() * 8);
+}
+
+const uint8_t* feeder_slot_data(void* h, uint32_t slot, uint64_t* nbytes) {
+  auto& buf = static_cast<FeederHandle*>(h)->current.slot_data[slot];
+  *nbytes = buf.size();
+  return buf.data();
+}
+
+void feeder_destroy(void* h) {
+  FeederHandle* fh = static_cast<FeederHandle*>(h);
+  delete fh->feeder;
+  delete fh;
+}
+
+}  // extern "C"

@@ -1,0 +1,102 @@
+// recordio: chunked binary record format (writer + scanner).
+//
+// Parity: /root/reference/paddle/fluid/recordio/ (chunk.{h,cc} with
+// snappy compression, header.{h,cc} magic+len+crc32, writer.cc,
+// scanner.cc — 713 LoC). TPU-native simplifications: no snappy dependency
+// (XLA hosts are CPU-rich; callers can pre-compress payloads), same
+// chunked layout with crc32 integrity, plus a C ABI so Python binds via
+// ctypes instead of pybind11 (not in the image).
+//
+// On-disk layout per record: [u32 magic][u32 len][u32 crc32][len bytes]
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x50545232;  // "PTR2"
+
+uint32_t crc32(const uint8_t* data, size_t n) {
+  static uint32_t table[256];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    init = true;
+  }
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; i++) c = table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+struct Writer {
+  FILE* f;
+};
+
+struct Scanner {
+  FILE* f;
+  std::vector<uint8_t> buf;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* recordio_writer_open(const char* path) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return nullptr;
+  return new Writer{f};
+}
+
+int recordio_write(void* w, const uint8_t* data, uint64_t len) {
+  Writer* wr = static_cast<Writer*>(w);
+  uint32_t hdr[3] = {kMagic, static_cast<uint32_t>(len),
+                     crc32(data, len)};
+  if (fwrite(hdr, sizeof(hdr), 1, wr->f) != 1) return -1;
+  if (len && fwrite(data, 1, len, wr->f) != len) return -1;
+  return 0;
+}
+
+void recordio_writer_close(void* w) {
+  Writer* wr = static_cast<Writer*>(w);
+  if (wr) {
+    fclose(wr->f);
+    delete wr;
+  }
+}
+
+void* recordio_scanner_open(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  return new Scanner{f, {}};
+}
+
+// returns record length (>=0), -100 on EOF, -1..-3 on corruption
+int64_t recordio_next(void* s, const uint8_t** out) {
+  Scanner* sc = static_cast<Scanner*>(s);
+  uint32_t hdr[3];
+  if (fread(hdr, sizeof(hdr), 1, sc->f) != 1) return -100;  // EOF
+  if (hdr[0] != kMagic) return -1;
+  sc->buf.resize(hdr[1]);
+  if (hdr[1] && fread(sc->buf.data(), 1, hdr[1], sc->f) != hdr[1])
+    return -2;
+  if (crc32(sc->buf.data(), hdr[1]) != hdr[2]) return -3;
+  *out = sc->buf.data();
+  return static_cast<int64_t>(hdr[1]);
+}
+
+void recordio_scanner_close(void* s) {
+  Scanner* sc = static_cast<Scanner*>(s);
+  if (sc) {
+    fclose(sc->f);
+    delete sc;
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,150 @@
+"""Python bindings for the native data pipeline (ctypes over the C ABI).
+
+Parity: reference PyReader/LoDTensorBlockingQueue plumbing
+(python/paddle/fluid/reader.py:47 + operators/reader/
+lod_tensor_blocking_queue.h) and recordio_writer.py. The hot path —
+file parsing, batch assembly, queueing — runs in C++ threads
+(native/data_feed.cc); Python only wraps the popped batch as numpy
+(zero-copy view then one copy into a jax-ready array).
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, Iterator, List, Sequence
+
+import numpy as np
+
+_DTYPES = {0: np.float32, 1: np.int64, 2: np.int32}
+_DTYPE_CODES = {np.dtype(np.float32): 0, np.dtype(np.int64): 1,
+                np.dtype(np.int32): 2}
+
+
+def _lib():
+    from ..native.build import lib_path
+    lib = ctypes.CDLL(lib_path())
+    lib.recordio_writer_open.restype = ctypes.c_void_p
+    lib.recordio_writer_open.argtypes = [ctypes.c_char_p]
+    lib.recordio_write.restype = ctypes.c_int
+    lib.recordio_write.argtypes = [ctypes.c_void_p,
+                                   ctypes.POINTER(ctypes.c_uint8),
+                                   ctypes.c_uint64]
+    lib.recordio_writer_close.argtypes = [ctypes.c_void_p]
+    lib.recordio_scanner_open.restype = ctypes.c_void_p
+    lib.recordio_scanner_open.argtypes = [ctypes.c_char_p]
+    lib.recordio_next.restype = ctypes.c_int64
+    lib.recordio_next.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))]
+    lib.recordio_scanner_close.argtypes = [ctypes.c_void_p]
+    lib.feeder_create.restype = ctypes.c_void_p
+    lib.feeder_create.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_uint64,
+        ctypes.c_int, ctypes.c_uint64]
+    lib.feeder_next.restype = ctypes.c_uint64
+    lib.feeder_next.argtypes = [ctypes.c_void_p]
+    lib.feeder_num_slots.restype = ctypes.c_uint32
+    lib.feeder_num_slots.argtypes = [ctypes.c_void_p]
+    lib.feeder_slot_dtype.restype = ctypes.c_uint32
+    lib.feeder_slot_dtype.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.feeder_slot_ndim.restype = ctypes.c_uint32
+    lib.feeder_slot_ndim.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.feeder_slot_dims.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
+                                     ctypes.POINTER(ctypes.c_uint64)]
+    lib.feeder_slot_data.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.feeder_slot_data.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
+                                     ctypes.POINTER(ctypes.c_uint64)]
+    lib.feeder_destroy.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+_cached_lib = None
+
+
+def get_lib():
+    global _cached_lib
+    if _cached_lib is None:
+        _cached_lib = _lib()
+    return _cached_lib
+
+
+class RecordIOWriter:
+    """Write samples (lists of numpy arrays) to a recordio shard."""
+
+    def __init__(self, path: str):
+        self._lib = get_lib()
+        self._h = self._lib.recordio_writer_open(path.encode())
+        if not self._h:
+            raise IOError(f"cannot open {path}")
+
+    def write_sample(self, arrays: Sequence[np.ndarray]):
+        parts = [np.array([len(arrays)], np.uint32).tobytes()]
+        for a in arrays:
+            a = np.ascontiguousarray(a)
+            code = _DTYPE_CODES[a.dtype]
+            parts.append(np.array([code, a.ndim], np.uint32).tobytes())
+            parts.append(np.array(a.shape, np.uint64).tobytes())
+            parts.append(a.tobytes())
+        payload = b"".join(parts)
+        buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
+        rc = self._lib.recordio_write(self._h, buf, len(payload))
+        if rc != 0:
+            raise IOError("recordio write failed")
+
+    def close(self):
+        if self._h:
+            self._lib.recordio_writer_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+class NativeDataFeeder:
+    """Threaded recordio -> batch queue (C++), iterated from Python.
+
+    Yields dicts name -> np.ndarray batched on a new leading dim."""
+
+    def __init__(self, files: List[str], slot_names: Sequence[str],
+                 batch_size: int, n_threads: int = 2,
+                 queue_capacity: int = 8):
+        self._lib = get_lib()
+        arr = (ctypes.c_char_p * len(files))(
+            *[f.encode() for f in files])
+        self._h = self._lib.feeder_create(arr, len(files), batch_size,
+                                          n_threads, queue_capacity)
+        self._slot_names = list(slot_names)
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        while True:
+            bs = self._lib.feeder_next(self._h)
+            if bs == 0:
+                break
+            out = {}
+            n_slots = self._lib.feeder_num_slots(self._h)
+            for s in range(n_slots):
+                dt = _DTYPES[self._lib.feeder_slot_dtype(self._h, s)]
+                ndim = self._lib.feeder_slot_ndim(self._h, s)
+                dims = (ctypes.c_uint64 * max(ndim, 1))()
+                self._lib.feeder_slot_dims(self._h, s, dims)
+                shape = (int(bs),) + tuple(int(dims[i])
+                                           for i in range(ndim))
+                nbytes = ctypes.c_uint64()
+                ptr = self._lib.feeder_slot_data(self._h, s,
+                                                 ctypes.byref(nbytes))
+                raw = ctypes.string_at(ptr, nbytes.value)
+                out[self._slot_names[s]] = np.frombuffer(
+                    raw, dtype=dt).reshape(shape).copy()
+            yield out
+
+    def close(self):
+        if self._h:
+            self._lib.feeder_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
