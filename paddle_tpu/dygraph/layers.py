@@ -87,17 +87,30 @@ class Layer:
             p.clear_gradient()
 
     # -- state dict ---------------------------------------------------------
+    def _stable_named_parameters(self, prefix=""):
+        """Structural keys: attribute path + creation ordinal — stable
+        across instances (unique param names are not, since the global
+        name counter keeps running)."""
+        for i, (_, p) in enumerate(self._parameters.items()):
+            yield f"{prefix}p{i}", p
+        for lname, l in self._sub_layers.items():
+            yield from l._stable_named_parameters(f"{prefix}{lname}.")
+
     def state_dict(self, destination=None, include_sublayers=True,
                    prefix=""):
+        # keyed by structural path so a freshly built model instance
+        # (whose unique param names differ) can load it; the p.name key
+        # is kept as an alias for reference compat
         dest = destination if destination is not None else OrderedDict()
-        for name, p in self.named_parameters():
-            dest[p.name] = p
+        for key, p in self._stable_named_parameters():
+            dest[key] = p
+            dest.setdefault(p.name, p)
         return dest
 
     def set_dict(self, state_dict, include_sublayers=True):
-        for name, p in self.named_parameters():
-            if p.name in state_dict:
-                v = state_dict[p.name]
+        for key, p in self._stable_named_parameters():
+            v = state_dict.get(key, state_dict.get(p.name))
+            if v is not None:
                 p.set_value(v.value if isinstance(v, VarBase) else v)
 
     load_dict = set_dict
@@ -110,6 +123,7 @@ class Layer:
         tracer = framework._dygraph_tracer()
         if tracer is not None:
             tracer._layer_stack.append(self)
+            self._param_create_idx = 0  # restart lazy-param ordinals
         try:
             return self.forward(*inputs, **kwargs)
         finally:

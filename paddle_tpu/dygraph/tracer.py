@@ -171,6 +171,22 @@ class Tracer:
                        stop_gradient=False)
 
     def create_parameter(self, attr, shape, dtype, initializer, is_bias):
+        # Per-layer ordinal memoization: layers create params lazily in
+        # forward() (reference _build_once pattern); the Nth
+        # create_parameter of a Layer instance's call always returns the
+        # SAME VarBase, so repeated forwards reuse weights even though
+        # the helper generates a fresh unique name each call.
+        layer = self._layer_stack[-1] if self._layer_stack else None
+        if layer is not None and (not attr.name or
+                                  getattr(attr, "_generated", False)):
+            idx = getattr(layer, "_param_create_idx", 0)
+            existing = list(layer._parameters.values())
+            if idx < len(existing) and \
+                    tuple(existing[idx].shape) == tuple(
+                        int(s) for s in shape):
+                layer._param_create_idx = idx + 1
+                return existing[idx]
+            layer._param_create_idx = idx + 1
         name = attr.name or unique_name.generate("dy_param")
         if name in self._params:
             return self._params[name]

@@ -56,6 +56,8 @@ class LayerHelper:
         if not attr.name:
             attr.name = unique_name.generate(
                 f"{self.name}.b" if is_bias else f"{self.name}.w")
+            # dygraph lazy-create memo keys on this (tracer.py)
+            attr._generated = True
         initializer = attr.initializer or default_initializer
         if initializer is None:
             initializer = init_mod.Constant(0.0) if is_bias else \

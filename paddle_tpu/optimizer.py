@@ -17,7 +17,7 @@ import numpy as np
 
 from . import framework
 from .framework import Variable, default_main_program, \
-    default_startup_program, program_guard, unique_name
+    default_startup_program, program_guard, unique_name, in_dygraph_mode
 from .backward import append_backward
 from .initializer import Constant
 from .layer_helper import LayerHelper
@@ -45,8 +45,50 @@ class Optimizer:
             defaultdict(dict)
         self.helper = None
 
+    # ---- dygraph (eager) path --------------------------------------------
+    # Reference parity: in dygraph mode optimizer ops run eagerly per
+    # param (reference optimizer.py dispatches through the same
+    # _append_optimize_op with an imperative block). Here the eager
+    # "block" routes append_op to the tracer, so graph and dygraph share
+    # one update-rule source (the registered optimizer-op lowerings).
+    class _EagerBlock:
+        def append_op(self, type=None, inputs=None, outputs=None,
+                      attrs=None, infer_shape=True, **kw):
+            from .framework import _dygraph_tracer
+            return _dygraph_tracer().trace_op(type, inputs or {},
+                                              outputs or {}, attrs or {})
+
+    def _dygraph_params_grads(self, parameter_list=None):
+        from .framework import _dygraph_tracer
+        tracer = _dygraph_tracer()
+        from .dygraph.tracer import VarBase
+        pgs = []
+        for p in tracer._params.values():
+            if parameter_list is not None and p.name not in set(
+                    v if isinstance(v, str) else v.name
+                    for v in parameter_list):
+                continue
+            if not p.trainable or p.grad is None:
+                continue
+            g = p.grad if isinstance(p.grad, VarBase) else \
+                VarBase(p.grad, stop_gradient=True)
+            pgs.append((p, g))
+        return pgs
+
     # ---- learning rate ----------------------------------------------------
     def _create_global_learning_rate(self):
+        if in_dygraph_mode():
+            if "dygraph" not in self._learning_rate_map:
+                if isinstance(self._learning_rate, Variable):
+                    self._learning_rate_map["dygraph"] = \
+                        self._learning_rate
+                else:
+                    from .dygraph.tracer import VarBase
+                    import jax.numpy as jnp
+                    self._learning_rate_map["dygraph"] = VarBase(
+                        jnp.asarray([float(self._learning_rate)],
+                                    jnp.float32), stop_gradient=True)
+            return
         prog = default_main_program()
         lr = self._learning_rate_map.get(id(prog))
         if lr is not None:
@@ -60,12 +102,15 @@ class Optimizer:
             persistable=True)
 
     def _global_learning_rate(self, program=None):
+        if in_dygraph_mode():
+            return self._learning_rate_map.get("dygraph")
         program = program or default_main_program()
         return self._learning_rate_map.get(id(program))
 
     def _create_param_lr(self, param_and_grad):
         param = param_and_grad[0]
-        param_lr = (param.optimize_attr or {}).get("learning_rate", 1.0)
+        param_lr = (getattr(param, "optimize_attr", None) or
+                    {}).get("learning_rate", 1.0)
         base = self._global_learning_rate()
         if param_lr == 1.0:
             return base
@@ -76,8 +121,17 @@ class Optimizer:
                          shape=None):
         if param.name in self._accumulators[name]:
             return self._accumulators[name][param.name]
-        assert self.helper is not None
         shape = shape if shape is not None else list(param.shape)
+        if in_dygraph_mode():
+            import jax.numpy as jnp
+            from .dygraph.tracer import VarBase
+            from .core.types import dtype_to_np
+            acc = VarBase(jnp.full(shape, float(fill_value),
+                                   dtype_to_np(dtype or param.dtype)),
+                          stop_gradient=True)
+            self._accumulators[name][param.name] = acc
+            return acc
+        assert self.helper is not None
         var_name = unique_name.generate(f"{param.name}_{name}")
         var = self.helper.create_global_variable(
             name=var_name, persistable=True,
@@ -105,8 +159,11 @@ class Optimizer:
 
     # ---- the pass ---------------------------------------------------------
     def _create_optimization_pass(self, parameters_and_grads):
-        prog = default_main_program()
-        block = prog.global_block()
+        if in_dygraph_mode():
+            block = Optimizer._EagerBlock()
+        else:
+            prog = default_main_program()
+            block = prog.global_block()
         self.helper = LayerHelper(self.__class__.__name__)
         self._create_global_learning_rate()
         self._create_accumulators(
@@ -123,6 +180,10 @@ class Optimizer:
 
     def backward(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None, callbacks=None):
+        if in_dygraph_mode():
+            # loss.backward() has populated VarBase.grad on the tape's
+            # params (reference dygraph flow); collect them.
+            return self._dygraph_params_grads(parameter_list)
         with program_guard(loss.block.program,
                            startup_program or
                            default_startup_program()):
@@ -130,6 +191,8 @@ class Optimizer:
                                    callbacks)
 
     def apply_gradients(self, params_grads):
+        if in_dygraph_mode():
+            return self._create_optimization_pass(params_grads)
         # grad clipping + regularization (reference optimizer.py:499-535)
         from .clip import append_gradient_clip_ops
         from .regularizer import append_regularization_ops
@@ -139,6 +202,8 @@ class Optimizer:
         return self._create_optimization_pass(params_grads)
 
     def apply_optimize(self, loss, startup_program, params_grads):
+        if in_dygraph_mode():
+            return self.apply_gradients(params_grads)
         with program_guard(loss.block.program,
                            startup_program or
                            default_startup_program()):
