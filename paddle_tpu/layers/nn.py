@@ -22,6 +22,7 @@ __all__ = [
     "conv3d_transpose", "pool2d", "pool3d", "adaptive_pool2d", "batch_norm",
     "layer_norm", "group_norm", "instance_norm", "data_norm", "dropout",
     "softmax", "log_softmax", "matmul", "mul", "fused_attention",
+    "dynamic_lstm", "dynamic_gru", "lstm_unit", "gru_unit",
     "relu", "relu6", "sigmoid",
     "tanh", "leaky_relu", "elu", "gelu", "swish", "prelu", "brelu",
     "soft_relu", "maxout", "softplus", "softsign", "hard_sigmoid", "selu",
@@ -1055,3 +1056,112 @@ def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="float32"):
     helper.append_op("sampling_id", inputs={"X": x},
                      outputs={"Out": out}, attrs={"seed": seed})
     return out
+
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
+                 bias_attr=None, use_peepholes=True, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", dtype="float32", name=None):
+    """LoD-aware LSTM (reference layers/nn.py dynamic_lstm over
+    lstm_op.cc). `input` is the pre-projected [T, 4*hidden] LoDTensor;
+    size = 4*hidden."""
+    helper = LayerHelper("lstm", name=name)
+    hidden = size // 4
+    weight = helper.create_parameter(param_attr, [hidden, 4 * hidden],
+                                     dtype)
+    bias_size = [1, 7 * hidden] if use_peepholes else [1, 4 * hidden]
+    bias = helper.create_parameter(bias_attr, bias_size, dtype,
+                                   is_bias=True)
+    h = helper.create_variable_for_type_inference(dtype)
+    c = helper.create_variable_for_type_inference(dtype)
+    batch_gate = helper.create_variable_for_type_inference(dtype, True)
+    batch_cell = helper.create_variable_for_type_inference(dtype, True)
+    inputs = {"Input": input, "Weight": weight, "Bias": bias}
+    if h_0 is not None:
+        inputs["H0"] = h_0
+    if c_0 is not None:
+        inputs["C0"] = c_0
+    helper.append_op(
+        "lstm", inputs=inputs,
+        outputs={"Hidden": h, "Cell": c, "BatchGate": batch_gate,
+                 "BatchCellPreAct": batch_cell},
+        attrs={"use_peepholes": use_peepholes, "is_reverse": is_reverse,
+               "gate_activation": gate_activation,
+               "cell_activation": cell_activation,
+               "candidate_activation": candidate_activation},
+        infer_shape=False)
+    return h, c
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", h_0=None,
+                origin_mode=False, name=None):
+    """LoD-aware GRU (reference layers/nn.py dynamic_gru over gru_op.cc);
+    input is [T, 3*size] pre-projections."""
+    helper = LayerHelper("gru", name=name)
+    dtype = input.dtype
+    weight = helper.create_parameter(param_attr, [size, 3 * size], dtype)
+    bias = helper.create_parameter(bias_attr, [1, 3 * size], dtype,
+                                   is_bias=True)
+    h = helper.create_variable_for_type_inference(dtype)
+    bg = helper.create_variable_for_type_inference(dtype, True)
+    brh = helper.create_variable_for_type_inference(dtype, True)
+    bh = helper.create_variable_for_type_inference(dtype, True)
+    inputs = {"Input": input, "Weight": weight, "Bias": bias}
+    if h_0 is not None:
+        inputs["H0"] = h_0
+    helper.append_op(
+        "gru", inputs=inputs,
+        outputs={"Hidden": h, "BatchGate": bg,
+                 "BatchResetHiddenPrev": brh, "BatchHidden": bh},
+        attrs={"is_reverse": is_reverse, "origin_mode": origin_mode,
+               "gate_activation": gate_activation,
+               "activation": candidate_activation}, infer_shape=False)
+    return h
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """Single LSTM step (reference layers/nn.py lstm_unit): projects
+    concat([x_t, h_prev]) then applies lstm_unit op."""
+    helper = LayerHelper("lstm_unit", name=name)
+    size = cell_t_prev.shape[-1]
+    concat_in = concat([x_t, hidden_t_prev], axis=-1)
+    fc_out = fc(concat_in, 4 * size, param_attr=param_attr,
+                bias_attr=bias_attr)
+    c = helper.create_variable_for_type_inference(x_t.dtype)
+    h = helper.create_variable_for_type_inference(x_t.dtype)
+    helper.append_op("lstm_unit",
+                     inputs={"X": fc_out, "C_prev": cell_t_prev},
+                     outputs={"C": c, "H": h},
+                     attrs={"forget_bias": float(forget_bias)})
+    return h, c
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid",
+             origin_mode=False, name=None):
+    """Single GRU step (reference layers/nn.py gru_unit); input is the
+    [N, 3*hidden] projection, size = 3*hidden."""
+    helper = LayerHelper("gru_unit", name=name)
+    dtype = input.dtype
+    hidden_dim = size // 3
+    weight = helper.create_parameter(param_attr,
+                                     [hidden_dim, 3 * hidden_dim], dtype)
+    bias = helper.create_parameter(bias_attr, [1, 3 * hidden_dim], dtype,
+                                   is_bias=True)
+    act_codes = {"identity": 0, "sigmoid": 1, "tanh": 2, "relu": 3}
+    gate = helper.create_variable_for_type_inference(dtype)
+    reset_h = helper.create_variable_for_type_inference(dtype)
+    updated = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "gru_unit",
+        inputs={"Input": input, "HiddenPrev": hidden, "Weight": weight,
+                "Bias": bias},
+        outputs={"Gate": gate, "ResetHiddenPrev": reset_h,
+                 "Hidden": updated},
+        attrs={"activation": act_codes[activation],
+               "gate_activation": act_codes[gate_activation],
+               "origin_mode": origin_mode})
+    return updated, reset_h, gate

@@ -20,3 +20,4 @@ from . import sequence  # noqa: F401
 from . import fused  # noqa: F401
 from . import collective  # noqa: F401
 from . import distributed_ops  # noqa: F401
+from . import rnn  # noqa: F401

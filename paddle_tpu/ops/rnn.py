@@ -1,0 +1,255 @@
+"""Recurrent ops: lstm, gru, lstm_unit, gru_unit.
+
+Parity: /root/reference/paddle/fluid/operators/lstm_op.cc (+ math/detail/
+lstm_kernel.h — gate buffer layout [c̃, i, f, o] lstm_cpu_kernel.h:51-54,
+peephole connections from Bias[4D:7D]), gru_op.cc (+ gru_kernel.h:60-69 —
+h = (1-u)*h_prev + u*c̃ in default mode, origin_mode flips), lstm_unit_op.h
+:63-68 ([i, f, o, g] with forget_bias) and gru_unit_op.h:115-120.
+
+TPU-first: the reference reorders ragged sequences into "batch" form with
+LoDTensor2BatchFunctor and runs a fused per-timestep kernel; here the
+static lod converts packed rows to a dense padded [N, maxT, D] block
+(static gathers), the time loop is a lax.scan (XLA unrolls/pipelines it
+on-chip), and padding steps are masked so states freeze past each
+sequence's end. Gradients come from the generic vjp of this lowering —
+scan transposes to the reverse-time pass automatically.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+from .sequence import _last_level, _lengths
+
+_ACT = {
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "identity": lambda x: x,
+}
+
+
+def _act(name):
+    return _ACT[str(name or "identity")]
+
+
+def _pack_to_padded(x, off):
+    """[T_total, D] + offsets -> [N, maxT, D], mask [N, maxT]."""
+    lens = _lengths(off)
+    n, maxT = len(lens), int(lens.max()) if len(lens) else 0
+    j = np.arange(maxT)
+    gather = off[:-1, None] + np.minimum(j[None, :],
+                                         np.maximum(lens[:, None] - 1, 0))
+    mask = j[None, :] < lens[:, None]
+    padded = x[jnp.asarray(gather.reshape(-1))].reshape(
+        (n, maxT) + x.shape[1:])
+    return padded, jnp.asarray(mask), lens
+
+
+def _padded_to_pack(padded, off):
+    lens = _lengths(off)
+    maxT = padded.shape[1]
+    idx = np.concatenate([i * maxT + np.arange(l)
+                          for i, l in enumerate(lens)]) \
+        if len(lens) else np.arange(0)
+    flat = padded.reshape((-1,) + padded.shape[2:])
+    return flat[jnp.asarray(idx)]
+
+
+@register_op("lstm", no_grad_slots=("C0",))
+def lstm(ctx):
+    x = ctx.input("Input")          # [T, 4D] x-projections
+    w = ctx.input("Weight")         # [D, 4D]
+    bias = ctx.input("Bias")        # [1, 4D] or [1, 7D] w/ peepholes
+    h0 = ctx.input("H0")
+    c0 = ctx.input("C0")
+    off = np.asarray(_last_level(ctx.get_lod("Input")), np.int64)
+    D = w.shape[0]
+    use_peep = bool(ctx.attr("use_peepholes", True))
+    is_reverse = bool(ctx.attr("is_reverse", False))
+    act_g = _act(ctx.attr("gate_activation", "sigmoid"))
+    act_c = _act(ctx.attr("cell_activation", "tanh"))
+    act_n = _act(ctx.attr("candidate_activation", "tanh"))
+
+    padded, mask, lens = _pack_to_padded(x, off)   # [N, maxT, 4D]
+    N, maxT = padded.shape[0], padded.shape[1]
+    if is_reverse:
+        # reverse valid region of each row
+        j = np.arange(maxT)
+        rev = np.where(j[None, :] < lens[:, None],
+                       np.maximum(lens[:, None] - 1 - j[None, :], 0),
+                       j[None, :])
+        padded = jnp.take_along_axis(
+            padded, jnp.asarray(rev)[:, :, None], axis=1)
+
+    b = bias.reshape(-1) if bias is not None else jnp.zeros((4 * D,),
+                                                            x.dtype)
+    gate_b = b[:4 * D]
+    w_ic = b[4 * D:5 * D] if use_peep and b.shape[0] >= 7 * D else None
+    w_fc = b[5 * D:6 * D] if use_peep and b.shape[0] >= 7 * D else None
+    w_oc = b[6 * D:7 * D] if use_peep and b.shape[0] >= 7 * D else None
+
+    h_init = h0 if h0 is not None else jnp.zeros((N, D), x.dtype)
+    c_init = c0 if c0 is not None else jnp.zeros((N, D), x.dtype)
+
+    xs = jnp.swapaxes(padded, 0, 1)      # [maxT, N, 4D]
+    ms = jnp.swapaxes(mask, 0, 1)        # [maxT, N]
+
+    def step(carry, inp):
+        h_prev, c_prev = carry
+        xt, mt = inp
+        gates = xt + h_prev @ w + gate_b        # [N, 4D]
+        g_in = gates[:, 0 * D:1 * D]            # c̃ (input node)
+        g_i = gates[:, 1 * D:2 * D]
+        g_f = gates[:, 2 * D:3 * D]
+        g_o = gates[:, 3 * D:4 * D]
+        if w_ic is not None:
+            g_i = g_i + w_ic * c_prev
+            g_f = g_f + w_fc * c_prev
+        i = act_g(g_i)
+        f = act_g(g_f)
+        cand = act_n(g_in)
+        c = cand * i + c_prev * f
+        if w_oc is not None:
+            g_o = g_o + w_oc * c
+        o = act_g(g_o)
+        h = act_c(c) * o
+        m = mt[:, None]
+        h = jnp.where(m, h, h_prev)
+        c = jnp.where(m, c, c_prev)
+        return (h, c), (h, c)
+
+    _, (hs, cs) = lax.scan(step, (h_init, c_init), (xs, ms))
+    hs = jnp.swapaxes(hs, 0, 1)   # [N, maxT, D]
+    cs = jnp.swapaxes(cs, 0, 1)
+    if is_reverse:
+        j = np.arange(maxT)
+        rev = np.where(j[None, :] < lens[:, None],
+                       np.maximum(lens[:, None] - 1 - j[None, :], 0),
+                       j[None, :])
+        hs = jnp.take_along_axis(hs, jnp.asarray(rev)[:, :, None], 1)
+        cs = jnp.take_along_axis(cs, jnp.asarray(rev)[:, :, None], 1)
+    lod = ctx.get_lod("Input")
+    ctx.set_output("Hidden", _padded_to_pack(hs, off))
+    ctx.set_output("Cell", _padded_to_pack(cs, off))
+    ctx.set_lod("Hidden", lod)
+    ctx.set_lod("Cell", lod)
+    # batch reorder intermediates (reference exposes them; dense here)
+    if ctx.has_output("BatchGate"):
+        ctx.set_output("BatchGate", jnp.zeros_like(x))
+    if ctx.has_output("BatchCellPreAct"):
+        ctx.set_output("BatchCellPreAct",
+                       jnp.zeros((x.shape[0], D), x.dtype))
+
+
+@register_op("gru", no_grad_slots=("H0",))
+def gru(ctx):
+    x = ctx.input("Input")         # [T, 3D]
+    w = ctx.input("Weight")        # [D, 3D]: [:, :2D] u,r ; [:, 2D:] c
+    bias = ctx.input("Bias")       # [1, 3D]
+    h0 = ctx.input("H0")
+    off = np.asarray(_last_level(ctx.get_lod("Input")), np.int64)
+    D = w.shape[0]
+    origin = bool(ctx.attr("origin_mode", False))
+    is_reverse = bool(ctx.attr("is_reverse", False))
+    act_g = _act(ctx.attr("gate_activation", "sigmoid"))
+    act_n = _act(ctx.attr("activation", "tanh"))
+
+    padded, mask, lens = _pack_to_padded(x, off)
+    N, maxT = padded.shape[0], padded.shape[1]
+    if is_reverse:
+        j = np.arange(maxT)
+        rev = np.where(j[None, :] < lens[:, None],
+                       np.maximum(lens[:, None] - 1 - j[None, :], 0),
+                       j[None, :])
+        padded = jnp.take_along_axis(
+            padded, jnp.asarray(rev)[:, :, None], axis=1)
+
+    b = bias.reshape(-1) if bias is not None else jnp.zeros((3 * D,),
+                                                            x.dtype)
+    w_ur = w[:, :2 * D]
+    w_c = w[:, 2 * D:]
+    h_init = h0 if h0 is not None else jnp.zeros((N, D), x.dtype)
+
+    xs = jnp.swapaxes(padded, 0, 1)
+    ms = jnp.swapaxes(mask, 0, 1)
+
+    def step(h_prev, inp):
+        xt, mt = inp
+        g_ur = xt[:, :2 * D] + h_prev @ w_ur + b[:2 * D]
+        u = act_g(g_ur[:, :D])
+        r = act_g(g_ur[:, D:])
+        g_c = xt[:, 2 * D:] + (r * h_prev) @ w_c + b[2 * D:]
+        c = act_n(g_c)
+        if origin:
+            h = (1.0 - u) * c + u * h_prev
+        else:
+            h = (1.0 - u) * h_prev + u * c
+        h = jnp.where(mt[:, None], h, h_prev)
+        return h, h
+
+    _, hs = lax.scan(step, h_init, (xs, ms))
+    hs = jnp.swapaxes(hs, 0, 1)
+    if is_reverse:
+        j = np.arange(maxT)
+        rev = np.where(j[None, :] < lens[:, None],
+                       np.maximum(lens[:, None] - 1 - j[None, :], 0),
+                       j[None, :])
+        hs = jnp.take_along_axis(hs, jnp.asarray(rev)[:, :, None], 1)
+    ctx.set_output("Hidden", _padded_to_pack(hs, off))
+    ctx.set_lod("Hidden", ctx.get_lod("Input"))
+    for aux in ("BatchGate", "BatchResetHiddenPrev", "BatchHidden"):
+        if ctx.has_output(aux):
+            shape = x.shape if aux == "BatchGate" else (x.shape[0], D)
+            ctx.set_output(aux, jnp.zeros(shape, x.dtype))
+
+
+@register_op("lstm_unit")
+def lstm_unit(ctx):
+    x = ctx.input("X")              # [N, 4D] order [i, f, o, g]
+    c_prev = ctx.input("C_prev")
+    forget_bias = float(ctx.attr("forget_bias", 0.0))
+    D = c_prev.shape[-1]
+    i = jax.nn.sigmoid(x[:, :D])
+    f = jax.nn.sigmoid(x[:, D:2 * D] + forget_bias)
+    o = jax.nn.sigmoid(x[:, 2 * D:3 * D])
+    g = jnp.tanh(x[:, 3 * D:])
+    c = f * c_prev + i * g
+    h = o * jnp.tanh(c)
+    ctx.set_output("C", c)
+    ctx.set_output("H", h)
+
+
+@register_op("gru_unit")
+def gru_unit(ctx):
+    x = ctx.input("Input")          # [N, 3D]
+    h_prev = ctx.input("HiddenPrev")
+    w = ctx.input("Weight")         # [D, 3D]
+    bias = ctx.input("Bias")
+    D = h_prev.shape[-1]
+    origin = bool(ctx.attr("origin_mode", False))
+    act_g = _ACT[{0: "identity", 1: "sigmoid", 2: "tanh", 3: "relu"}[
+        int(ctx.attr("gate_activation", 1))]]
+    act_n = _ACT[{0: "identity", 1: "sigmoid", 2: "tanh", 3: "relu"}[
+        int(ctx.attr("activation", 2))]]
+    b = bias.reshape(-1) if bias is not None else jnp.zeros((3 * D,),
+                                                            x.dtype)
+    g_ur = x[:, :2 * D] + h_prev @ w[:, :2 * D] + b[:2 * D]
+    u = act_g(g_ur[:, :D])
+    r = act_g(g_ur[:, D:])
+    reset_h = r * h_prev
+    g_c = x[:, 2 * D:] + reset_h @ w[:, 2 * D:] + b[2 * D:]
+    c = act_n(g_c)
+    if origin:
+        h = c + u * (h_prev - c)
+    else:
+        h = u * (c - h_prev) + h_prev
+    ctx.set_output("Gate", jnp.concatenate([u, r, c], axis=1))
+    ctx.set_output("ResetHiddenPrev", reset_h)
+    ctx.set_output("Hidden", h)
