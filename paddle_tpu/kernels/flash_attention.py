@@ -77,6 +77,12 @@ def _fa_forward(q, k, v, bias, scale, block_q, block_k,
     qr = q.reshape(B * H, Sq, D)
     kr = k.reshape(B * H, Sk, D)
     vr = v.reshape(B * H, Sk, D)
+    # under shard_map, outputs inherit the inputs' varying-mesh-axes
+    # set (JAX >= 0.9 checks vma on pallas_call out_shapes)
+    vma = getattr(jax.typeof(q), "vma", frozenset())
+
+    def _sds(shape, dtype):
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
 
     in_specs = [
         pl.BlockSpec((1, bq, D), lambda bh, qi, ki: (bh, qi, 0)),
@@ -120,8 +126,8 @@ def _fa_forward(q, k, v, bias, scale, block_q, block_k,
             pl.BlockSpec((1, bq, 128), lambda bh, qi, ki: (bh, qi, 0)),
         ]
         out_shape = [
-            jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, Sq, 128), jnp.float32),
+            _sds((B * H, Sq, D), q.dtype),
+            _sds((B * H, Sq, 128), jnp.float32),
         ]
     else:
         if has_bias:
@@ -134,7 +140,7 @@ def _fa_forward(q, k, v, bias, scale, block_q, block_k,
                                   None, m, l, a, scale=scale, n_kv=n_kv)
         out_specs = pl.BlockSpec((1, bq, D),
                                  lambda bh, qi, ki: (bh, qi, 0))
-        out_shape = jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype)
+        out_shape = _sds((B * H, Sq, D), q.dtype)
 
     res = pl.pallas_call(
         kern,
@@ -205,3 +211,46 @@ def _fa_bwd(scale, block_q, block_k, res, g):
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+def _lse_dispatch(q, k, v, bias, scale, block_q, block_k):
+    """Kernel when the shapes tile onto the MXU (or interpret mode is
+    forced for CPU tests), composed formulation otherwise."""
+    Sq, Sk = q.shape[2], k.shape[2]
+    use_kernel = (Sq % block_q == 0 and Sk % block_k == 0
+                  and q.shape[3] % 8 == 0
+                  and (_INTERPRET or jax.default_backend() != "cpu"))
+    if use_kernel:
+        return _fa_forward(q, k, v, bias, scale, block_q, block_k,
+                           return_lse=True)
+    return _attn_reference_lse(q, k, v, bias, scale)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def flash_attention_lse(q, k, v, bias=None, scale=1.0, block_q=128,
+                        block_k=128):
+    """Flash attention returning (out, lse) — the block primitive for
+    ring attention's online-softmax merge. Differentiable on every
+    backend: the backward recomputes through the composed lse-emitting
+    formulation (handles nonzero cotangents on BOTH outputs, since the
+    ring merge arithmetic uses lse downstream)."""
+    return _lse_dispatch(q, k, v, bias, scale, block_q, block_k)
+
+
+def _fal_fwd(q, k, v, bias, scale, block_q, block_k):
+    out = _lse_dispatch(q, k, v, bias, scale, block_q, block_k)
+    return out, (q, k, v, bias)
+
+
+def _fal_bwd(scale, block_q, block_k, res, g):
+    q, k, v, bias = res
+
+    def f(q, k, v, bias):
+        return _attn_reference_lse(q, k, v, bias, scale)
+
+    _, vjp = jax.vjp(f, q, k, v, bias)
+    dq, dk, dv, dbias = vjp(g)
+    return dq, dk, dv, None if bias is None else dbias
+
+
+flash_attention_lse.defvjp(_fal_fwd, _fal_bwd)

@@ -149,6 +149,8 @@ class Feeder {
 
   bool next(Batch* out) { return queue_.pop(out); }
 
+  uint64_t error_count() const { return error_count_.load(); }
+
  private:
   void worker() {
     std::vector<Sample> pending;
@@ -156,12 +158,24 @@ class Feeder {
       size_t idx = next_file_.fetch_add(1);
       if (idx >= files_.size()) break;
       void* sc = recordio_scanner_open(files_[idx].c_str());
-      if (!sc) continue;
+      if (!sc) {
+        error_count_.fetch_add(1);
+        fprintf(stderr, "[data_feed] cannot open %s\n",
+                files_[idx].c_str());
+        continue;
+      }
       const uint8_t* rec;
       int64_t len;
+      bool parse_failed = false;
       while ((len = recordio_next(sc, &rec)) >= 0) {
         Sample s;
-        if (!parse_sample(rec, len, &s)) break;
+        if (!parse_sample(rec, len, &s)) {
+          error_count_.fetch_add(1);
+          fprintf(stderr, "[data_feed] malformed sample in %s\n",
+                  files_[idx].c_str());
+          parse_failed = true;
+          break;
+        }
         pending.push_back(std::move(s));
         if (pending.size() == batch_size_) {
           if (!emit(&pending)) {
@@ -169,6 +183,14 @@ class Feeder {
             return;
           }
         }
+      }
+      // -100 is clean EOF; -1..-4 are corruption (bad magic / short
+      // body / crc mismatch / truncated header) — count + log instead
+      // of silently truncating the shard
+      if (!parse_failed && len != -100) {
+        error_count_.fetch_add(1);
+        fprintf(stderr, "[data_feed] corrupt record (code %lld) in %s\n",
+                static_cast<long long>(len), files_[idx].c_str());
       }
       recordio_scanner_close(sc);
     }
@@ -197,6 +219,7 @@ class Feeder {
   BlockingQueue queue_;
   std::atomic<size_t> next_file_;
   std::atomic<int> live_threads_;
+  std::atomic<uint64_t> error_count_{0};
   std::vector<std::thread> threads_;
 };
 
@@ -244,6 +267,11 @@ const uint8_t* feeder_slot_data(void* h, uint32_t slot, uint64_t* nbytes) {
   auto& buf = static_cast<FeederHandle*>(h)->current.slot_data[slot];
   *nbytes = buf.size();
   return buf.data();
+}
+
+// number of open/parse/corruption errors seen so far (0 = clean)
+uint64_t feeder_error_count(void* h) {
+  return static_cast<FeederHandle*>(h)->feeder->error_count();
 }
 
 void feeder_destroy(void* h) {

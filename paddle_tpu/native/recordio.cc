@@ -18,18 +18,23 @@ namespace {
 
 constexpr uint32_t kMagic = 0x50545232;  // "PTR2"
 
-uint32_t crc32(const uint8_t* data, size_t n) {
-  static uint32_t table[256];
-  static bool init = false;
-  if (!init) {
+struct Crc32Table {
+  uint32_t t[256];
+  Crc32Table() {
     for (uint32_t i = 0; i < 256; i++) {
       uint32_t c = i;
       for (int k = 0; k < 8; k++)
         c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
-      table[i] = c;
+      t[i] = c;
     }
-    init = true;
   }
+};
+
+uint32_t crc32(const uint8_t* data, size_t n) {
+  // magic-static: thread-safe one-time init (the old lazily-set bool
+  // was a data race with multi-threaded feed workers)
+  static const Crc32Table table_holder;
+  const uint32_t* table = table_holder.t;
   uint32_t c = 0xFFFFFFFFu;
   for (size_t i = 0; i < n; i++) c = table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
   return c ^ 0xFFFFFFFFu;
@@ -77,11 +82,14 @@ void* recordio_scanner_open(const char* path) {
   return new Scanner{f, {}};
 }
 
-// returns record length (>=0), -100 on EOF, -1..-3 on corruption
+// returns record length (>=0), -100 on clean EOF, -1..-4 on corruption
+// (-1 bad magic, -2 short body, -3 crc mismatch, -4 truncated header)
 int64_t recordio_next(void* s, const uint8_t** out) {
   Scanner* sc = static_cast<Scanner*>(s);
   uint32_t hdr[3];
-  if (fread(hdr, sizeof(hdr), 1, sc->f) != 1) return -100;  // EOF
+  size_t got = fread(hdr, 1, sizeof(hdr), sc->f);
+  if (got == 0) return -100;          // clean EOF at a record boundary
+  if (got < sizeof(hdr)) return -4;   // writer died mid-header
   if (hdr[0] != kMagic) return -1;
   sc->buf.resize(hdr[1]);
   if (hdr[1] && fread(sc->buf.data(), 1, hdr[1], sc->f) != hdr[1])

@@ -56,10 +56,39 @@ def _ring_id_axis(ctx):
     return _axis()
 
 
+def _psum_prod(x, ax):
+    """Product reduction via sign/abs decomposition (XLA has no
+    product collective): magnitude = exp(psum(log|x|)) with zeros
+    masked to 1, sign from the parity of negative counts, and any
+    zero anywhere forcing the result to 0 — matching ncclProd
+    semantics for all reals, unlike a raw exp(psum(log(x)))."""
+    is_zero = x == 0
+    any_zero = lax.pmax(is_zero.astype(jnp.float32), ax) > 0
+    safe = jnp.where(is_zero, jnp.ones_like(x), x)
+    mag = jnp.exp(lax.psum(jnp.log(jnp.abs(safe)), ax))
+    neg = lax.psum((safe < 0).astype(jnp.float32), ax)
+    sign = 1.0 - 2.0 * jnp.mod(neg, 2.0)
+    prod = sign * mag
+    if jnp.issubdtype(x.dtype, jnp.integer):
+        prod = jnp.round(prod)  # exp/log round-trip must not truncate
+    return jnp.where(any_zero, jnp.zeros_like(x),
+                     prod.astype(x.dtype))
+
+
 def _c_allreduce(ctx, op):
     x = ctx.input("X")
     ax = _ring_id_axis(ctx)
-    out = op(x, ax) if ax else x
+    # `scale` is applied on the reduced value only in per-device mode:
+    # the transpiler folds the 1/nranks grad averaging here so that the
+    # SAME program is semantics-preserving when run on the global-view
+    # engine (where the op is identity and values are already global).
+    scale = ctx.attr("scale", None)
+    if ax:
+        out = op(x, ax)
+        if scale is not None:
+            out = out * jnp.asarray(scale, out.dtype)
+    else:
+        out = x
     ctx.set_output("Out", out)
 
 
@@ -67,8 +96,7 @@ for _name, _red in [
         ("c_allreduce_sum", lambda x, ax: lax.psum(x, ax)),
         ("c_allreduce_max", lambda x, ax: lax.pmax(x, ax)),
         ("c_allreduce_min", lambda x, ax: lax.pmin(x, ax)),
-        ("c_allreduce_prod",
-         lambda x, ax: jnp.exp(lax.psum(jnp.log(x), ax)))]:
+        ("c_allreduce_prod", _psum_prod)]:
     def _mk(red):
         def lowering(ctx):
             _c_allreduce(ctx, red)
@@ -85,7 +113,7 @@ def allreduce(ctx):
         if red == 0:
             x = lax.psum(x, ax)
         elif red == 1:
-            x = jnp.exp(lax.psum(jnp.log(x), ax))
+            x = _psum_prod(x, ax)
         elif red == 2:
             x = lax.pmax(x, ax)
         else:

@@ -68,19 +68,29 @@ def split_ids(ctx):
 
 @register_no_grad_op("merge_ids")
 def merge_ids(ctx):
-    """Inverse of split_ids: reassemble rows so row j of the output is
-    the embedding row for the j-th original id (merge_ids_op.h)."""
-    ids_parts = [np.asarray(v) for v in ctx.inputs("Ids")]
-    rows_parts = ctx.inputs("X")
-    if any(isinstance(v, jax.core.Tracer) for v in rows_parts):
+    """Inverse of split_ids (reference merge_ids_op.h): given the
+    ORIGINAL id tensors (Ids, one per output), the per-shard id lists
+    (Rows — what split_ids produced), and the per-shard looked-up rows
+    (X), gather rows back into original id order: row j of Out[i] is
+    the embedding row for Ids[i][j], found via an id->(concat row)
+    lookup over the shard tables."""
+    ids_orig = [np.asarray(v).reshape(-1) for v in ctx.inputs("Ids")]
+    rows_parts = [np.asarray(v).reshape(-1) for v in ctx.inputs("Rows")]
+    x_parts = ctx.inputs("X")
+    if any(isinstance(v, jax.core.Tracer) for v in x_parts):
         raise NotImplementedError("merge_ids runs eagerly")
-    all_ids = np.concatenate([p.reshape(-1) for p in ids_parts])
-    all_rows = jnp.concatenate([jnp.atleast_2d(r) for r in rows_parts],
+    all_vals = jnp.concatenate([jnp.atleast_2d(v) for v in x_parts],
                                axis=0)
-    order = np.argsort(np.argsort(all_ids, kind="stable"), kind="stable")
-    n_out = len(ctx.op.output("Out"))
-    ctx.set_outputs("Out", [all_rows] if n_out == 1 else
-                    [all_rows[order]])
+    shard_ids = np.concatenate(rows_parts) if rows_parts else \
+        np.zeros((0,), np.int64)
+    lut = {}
+    for row, idv in enumerate(shard_ids.tolist()):
+        lut.setdefault(idv, row)
+    outs = []
+    for ids in ids_orig:
+        idx = np.asarray([lut[i] for i in ids.tolist()], np.int32)
+        outs.append(all_vals[idx])
+    ctx.set_outputs("Out", outs)
 
 
 @register_no_grad_op("split_byref")

@@ -6,11 +6,18 @@ treats as first-class: q/k/v sharded along the sequence dim over the
 "sp" mesh axis, K/V blocks rotated around the ring with
 lax.ppermute (ICI neighbor exchange) while each device accumulates its
 queries' attention over every block with online-softmax (logsumexp)
-merging — O(S/n) memory per chip, compute/communication overlapped by
-XLA since each ppermute is independent of the local block matmul.
+merging — O(S/n) activation memory per chip on the FORWARD pass,
+compute/communication overlapped by XLA since each ppermute is
+independent of the local block matmul. The current backward saves each
+rotated K/V block as a residual (O(S) per chip while grads flow); a
+re-permuting recompute backward that restores O(S/n) end-to-end is the
+planned upgrade alongside the fused dq/dk/dv kernel.
 
 Use under shard_map with q/k/v PartitionSpec'd as [B, H, S/sp, D] (and
 batch over dp): `ring_attention(q, k, v, bias, axis_name="sp")`.
+Pass `check_vma=False` to shard_map when the Pallas kernel path is
+active (jax 0.9's vma tracking doesn't thread through pallas_call +
+ppermute compositions yet).
 """
 from __future__ import annotations
 
@@ -20,15 +27,11 @@ from jax import lax
 
 
 def _block_attn(q, k, v, bias, scale):
-    from ..kernels.flash_attention import (_fa_forward,
-                                           _attn_reference_lse)
-    B, H, Sq, D = q.shape
-    Sk = k.shape[2]
-    if (jax.default_backend() != "cpu" and Sq % 128 == 0
-            and Sk % 128 == 0 and D % 8 == 0):
-        return _fa_forward(q, k, v, bias, scale, 128, 128,
-                           return_lse=True)
-    return _attn_reference_lse(q, k, v, bias, scale)
+    # custom_vjp wrapper: kernel forward where shapes allow, composed
+    # recompute backward — differentiable on TPU (training path), not
+    # just on the CPU fallback.
+    from ..kernels.flash_attention import flash_attention_lse
+    return flash_attention_lse(q, k, v, bias, scale, 128, 128)
 
 
 def ring_attention(q, k, v, bias=None, axis_name="sp", scale=None):

@@ -52,6 +52,8 @@ def _lib():
     lib.feeder_slot_data.restype = ctypes.POINTER(ctypes.c_uint8)
     lib.feeder_slot_data.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
                                      ctypes.POINTER(ctypes.c_uint64)]
+    lib.feeder_error_count.restype = ctypes.c_uint64
+    lib.feeder_error_count.argtypes = [ctypes.c_void_p]
     lib.feeder_destroy.argtypes = [ctypes.c_void_p]
     return lib
 
@@ -138,8 +140,20 @@ class NativeDataFeeder:
                     raw, dtype=dt).reshape(shape).copy()
             yield out
 
+    @property
+    def error_count(self) -> int:
+        """Open/parse/corruption errors seen by the reader threads
+        (clean EOF is not an error; nonzero means data was skipped).
+        After close(), returns the final count."""
+        if self._h:
+            self._last_errors = int(
+                self._lib.feeder_error_count(self._h))
+        return getattr(self, "_last_errors", 0)
+
     def close(self):
         if self._h:
+            self._last_errors = int(
+                self._lib.feeder_error_count(self._h))
             self._lib.feeder_destroy(self._h)
             self._h = None
 

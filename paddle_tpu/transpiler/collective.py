@@ -70,8 +70,14 @@ class Collective:
 
 
 class GradAllReduce(Collective):
-    """Scale loss-grad by 1/nranks and allreduce every param grad
-    (reference collective.py:178-267)."""
+    """Allreduce-average every param grad (reference
+    collective.py:178-267 inserts scale(1/nranks) + c_allreduce_sum).
+
+    TPU-native twist: the 1/nranks averaging is folded into the
+    c_allreduce_sum op as a `scale` attr (applied by the lowering only
+    in per-device axis mode) so the transpiled program is
+    semantics-preserving when run on the global-view engine, where the
+    collective is identity and grads are already global values."""
 
     def __init__(self, nrings=1):
         super().__init__(nrings)
@@ -92,15 +98,11 @@ class GradAllReduce(Collective):
                         continue
                     if name[:-len("@GRAD")] not in params:
                         continue
-                    op_scale = framework.Operator(
-                        block, "scale", inputs={"X": [name]},
-                        outputs={"Out": [name]},
-                        attrs={"scale": 1.0 / self.nranks})
                     op_ar = framework.Operator(
                         block, "c_allreduce_sum",
                         inputs={"X": [name]}, outputs={"Out": [name]},
-                        attrs={"ring_id": ring})
-                    new_ops.append(op_scale)
+                        attrs={"ring_id": ring,
+                               "scale": 1.0 / self.nranks})
                     new_ops.append(op_ar)
                     ring = (ring + 1) % self.nrings
         block.ops[:] = new_ops
@@ -108,19 +110,51 @@ class GradAllReduce(Collective):
 
 
 class LocalSGD(Collective):
-    """Local training + periodic parameter averaging
-    (reference collective.py:269+): snapshot params, train locally, every
-    step allreduce (param - snapshot) deltas and apply averaged."""
+    """Local training + periodic parameter averaging (reference
+    collective.py:269+ snapshot scheme): each param gets a @SNAPSHOT
+    copy initialized at startup; every step the program computes
+    delta = snapshot - param, allreduce-averages the delta, applies
+    param = snapshot - avg_delta, and refreshes the snapshot.
+
+    In identity (global-view / world_size=1) mode the allreduce leaves
+    delta unchanged and param = snapshot - (snapshot - param) = param:
+    the transpiled program is semantics-preserving in either mode."""
+
+    SNAPSHOT_SUFFIX = "@SNAPSHOT"
+
+    def _transpile_startup_program(self):
+        super()._transpile_startup_program()
+        block = self.startup_program.global_block()
+        main_block = self.main_program.global_block()
+        for p in self.main_program.all_parameters():
+            snap = p.name + self.SNAPSHOT_SUFFIX
+            for b in (block, main_block):
+                b.create_var(name=snap, shape=p.shape, dtype=p.dtype,
+                             persistable=True)
+            if p.name in block.vars:
+                block.append_op(
+                    "assign", inputs={"X": [p.name]},
+                    outputs={"Out": [snap]}, infer_shape=False)
 
     def _transpile_main_program(self):
         block = self.main_program.global_block()
         for p in self.main_program.all_parameters():
+            snap = p.name + self.SNAPSHOT_SUFFIX
+            delta = block.create_var(
+                name=p.name + "@DELTA", shape=p.shape, dtype=p.dtype)
             block.append_op(
-                "scale", inputs={"X": [p.name]},
-                outputs={"Out": [p.name]},
-                attrs={"scale": 1.0 / self.nranks}, infer_shape=False)
+                "elementwise_sub", inputs={"X": [snap], "Y": [p.name]},
+                outputs={"Out": [delta.name]}, infer_shape=False)
             block.append_op(
-                "c_allreduce_sum", inputs={"X": [p.name]},
-                outputs={"Out": [p.name]},
-                attrs={"ring_id": 0}, infer_shape=False)
+                "c_allreduce_sum", inputs={"X": [delta.name]},
+                outputs={"Out": [delta.name]},
+                attrs={"ring_id": 0, "scale": 1.0 / self.nranks},
+                infer_shape=False)
+            block.append_op(
+                "elementwise_sub",
+                inputs={"X": [snap], "Y": [delta.name]},
+                outputs={"Out": [p.name]}, infer_shape=False)
+            block.append_op(
+                "assign", inputs={"X": [p.name]},
+                outputs={"Out": [snap]}, infer_shape=False)
         self.main_program._bump_version()

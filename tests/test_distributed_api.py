@@ -120,6 +120,133 @@ class TestCollectiveOpsShardMap:
             np.asarray(out), np.tile(expect, 4))
 
 
+class TestCollectiveProd:
+    def test_c_allreduce_prod_signs_and_zeros(self):
+        """Product reduction must match ncclProd for negatives and
+        zeros (not exp(psum(log)) which NaNs)."""
+        from paddle_tpu.ops.collective import collective_axis_guard
+        from paddle_tpu.core.registry import OPS, ExecContext
+
+        mesh = Mesh(np.array(jax.devices()[:4]), ("dp",))
+
+        class FakeOp:
+            type = "c_allreduce_prod"
+
+            def input(self, slot):
+                return ["x"] if slot == "X" else []
+
+            def output(self, slot):
+                return ["out"] if slot == "Out" else []
+
+            def attr(self, name, default=None):
+                return default
+
+            def has_attr(self, name):
+                return False
+
+        def f(x):
+            env = {"x": x}
+            with collective_axis_guard("dp"):
+                OPS.get("c_allreduce_prod").lowering(
+                    ExecContext(FakeOp(), env))
+            return env["out"]
+
+        fm = shard_map(f, mesh=mesh, in_specs=P("dp"),
+                       out_specs=P("dp"))
+        x = jnp.asarray([[2., -3., 0.5],    # col products:
+                         [-1., -2., 4.],    # 2*-1*5*-0.5 = 5
+                         [5., 1., 0.],      # -3*-2*1*2 = 12
+                         [-0.5, 2., 8.]])   # 0.5*4*0*8 = 0
+        out = jax.jit(fm)(x)
+        expect = np.prod(np.asarray(x), axis=0)
+        np.testing.assert_allclose(
+            np.asarray(out).reshape(4, 3),
+            np.tile(expect, (4, 1)), rtol=1e-6)
+
+
+class TestMergeIds:
+    def test_merge_ids_restores_original_order(self):
+        from paddle_tpu.core.registry import OPS, ExecContext
+
+        # 2 shards by id % 2; original ids deliberately unsorted + dup
+        orig = np.array([5, 2, 9, 2, 4], np.int64)
+        shard0 = np.array([2, 4], np.int64)   # even ids
+        shard1 = np.array([5, 9], np.int64)   # odd ids
+        table = np.arange(20, dtype=np.float32).reshape(10, 2)
+        x0, x1 = table[shard0], table[shard1]
+
+        class FakeOp:
+            type = "merge_ids"
+
+            def input(self, slot):
+                return {"Ids": ["ids"], "Rows": ["r0", "r1"],
+                        "X": ["x0", "x1"]}.get(slot, [])
+
+            def output(self, slot):
+                return ["out"] if slot == "Out" else []
+
+            def attr(self, name, default=None):
+                return default
+
+            def has_attr(self, name):
+                return False
+
+        env = {"ids": orig, "r0": shard0, "r1": shard1,
+               "x0": jnp.asarray(x0), "x1": jnp.asarray(x1)}
+        OPS.get("merge_ids").lowering(ExecContext(FakeOp(), env))
+        np.testing.assert_array_equal(np.asarray(env["out"]),
+                                      table[orig])
+
+
+class TestLocalSGD:
+    def test_localsgd_identity_mode_preserves_training(self):
+        """LocalSGD-transpiled program in identity (1-process) mode:
+        param = snapshot - (snapshot - param) — training unchanged."""
+        main, startup, cost = _simple_net()
+        ref_main, ref_startup, ref_cost = _simple_net()
+
+        from paddle_tpu.transpiler.collective import LocalSGD
+        LocalSGD().transpile(startup, main, rank=0,
+                             endpoints=["a:1", "b:2"],
+                             current_endpoint="a:1")
+        rng = np.random.default_rng(0)
+        feed = {"x": rng.standard_normal((8, 4)).astype(np.float32),
+                "y": rng.standard_normal((8, 1)).astype(np.float32)}
+
+        param_names = [p.name for p in ref_main.all_parameters()]
+
+        def run(mainp, startp, costv, init_from=None):
+            scope = Scope()
+            with fluid.scope_guard(scope):
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startp)
+                if init_from is not None:
+                    for n, a in init_from.items():
+                        scope.var(n).get_tensor().set(a)
+                        snap = n + "@SNAPSHOT"
+                        if scope.find_var(snap) is not None:
+                            scope.var(snap).get_tensor().set(a)
+                losses = [float(np.asarray(exe.run(
+                    mainp, feed=feed, fetch_list=[costv])[0]))
+                    for _ in range(4)]
+                params = {n: np.asarray(
+                    scope.var(n).get_tensor()._array)
+                    for n in param_names}
+                return losses, params
+
+        init = {}
+        scope0 = Scope()
+        with fluid.scope_guard(scope0):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(ref_startup)
+            init = {n: np.asarray(scope0.var(n).get_tensor()._array)
+                    for n in param_names}
+
+        ref, _ = run(ref_main, ref_startup, ref_cost, init_from=init)
+        got, _ = run(main, startup, cost, init_from=init)
+        np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
 class TestFleetCollective:
     def test_fleet_minimize_and_run(self, monkeypatch):
         from paddle_tpu.incubate.fleet.collective import fleet, \

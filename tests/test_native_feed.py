@@ -74,6 +74,30 @@ def test_feeder_batches_all_samples(shards):
                                rtol=1e-5)
 
 
+def test_feeder_reports_corruption(tmp_path):
+    """A corrupted shard is counted + logged, not silently treated as
+    EOF; clean shards still feed through."""
+    rng = np.random.default_rng(3)
+    good, bad = str(tmp_path / "good.rio"), str(tmp_path / "bad.rio")
+    for path in (good, bad):
+        with RecordIOWriter(path) as w:
+            for _ in range(6):
+                w.write_sample(
+                    [rng.standard_normal((2,)).astype(np.float32)])
+    # flip a payload byte mid-file -> crc mismatch on that record
+    data = bytearray(open(bad, "rb").read())
+    data[len(data) // 2] ^= 0xFF
+    open(bad, "wb").write(bytes(data))
+
+    feeder = NativeDataFeeder([good, bad], ["x"], batch_size=2,
+                              n_threads=1)
+    seen = sum(b["x"].shape[0] for b in feeder)
+    errors = feeder.error_count
+    feeder.close()
+    assert errors >= 1
+    assert 6 <= seen < 12  # good shard intact, bad shard truncated
+
+
 def test_feeder_single_thread_order(shards):
     files, all_samples = shards
     feeder = NativeDataFeeder(files[:1], ["img", "label"], batch_size=5,
