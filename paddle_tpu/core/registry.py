@@ -118,6 +118,21 @@ def register_no_grad_op(op_type: str, **kw):
     return deco
 
 
+def override_grad_lowering(fwd_type: str):
+    """Replace the auto-derived `<fwd_type>_grad` lowering with a custom
+    one (the analog of a hand-written grad kernel next to the reference's
+    GradOpMaker). The custom lowering can delegate to the generic vjp via
+    `generic_grad_lowering(fwd_type)(ctx)`."""
+    def deco(fn):
+        OPS.get(fwd_type + "_grad").lowering = fn
+        return fn
+    return deco
+
+
+def generic_grad_lowering(fwd_type: str):
+    return _make_generic_grad_lowering(fwd_type)
+
+
 class ExecContext:
     """Per-op view during block tracing (reference ExecutionContext,
     operator.h:230). Values are JAX tracers/arrays; `env` maps var name to

@@ -1,0 +1,103 @@
+"""SelectedRows: the sparse row-slice gradient value.
+
+Parity: /root/reference/paddle/fluid/framework/selected_rows.h:32 (row
+indices + value tensor + height) — the representation lookup_table's
+is_sparse gradient and the sparse optimizer kernels
+(operators/optimizers/adam_op.h:361) exchange.
+
+TPU-native design: XLA has no dynamic-size sparse tensors, but it doesn't
+need them — the number of looked-up ids per step is STATIC (batch x
+seq), so a SelectedRows is a pytree of two fixed-shape arrays:
+
+  rows   [n]     int32 row indices; duplicates allowed; indices == height
+                 mark masked-out slots (padding_idx rows, merge slack)
+  values [n, d]  the per-row gradient slices
+
+Optimizer scatter updates use XLA's out-of-bounds-drop semantics
+(`.at[rows].add(..., mode="drop")`) so masked slots cost nothing, and
+`merge_rows` dedupes duplicates with a sort + segment-sum at the SAME
+static length — the reference's scatter::MergeAdd without dynamic
+shapes. The dense [height, d] gradient is never materialized anywhere on
+this path: that is the memory win that makes million-row vocab training
+feasible (reference lookup_table_op.cc:119 sparse grad path).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+class SelectedRows:
+    __slots__ = ("rows", "values", "height")
+
+    def __init__(self, rows, values, height: int):
+        self.rows = rows
+        self.values = values
+        self.height = int(height)
+
+    def tree_flatten(self):
+        return (self.rows, self.values), self.height
+
+    @classmethod
+    def tree_unflatten(cls, height, children):
+        rows, values = children
+        return cls(rows, values, height)
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def dense_shape(self):
+        return (self.height,) + tuple(self.values.shape[1:])
+
+    def astype(self, dtype):
+        return SelectedRows(self.rows, self.values.astype(dtype),
+                            self.height)
+
+    def map_values(self, fn):
+        return SelectedRows(self.rows, fn(self.values), self.height)
+
+    def to_dense(self):
+        """Scatter-add into a dense [height, ...] tensor (masked slots
+        dropped). Only for fallback paths — the sparse pipeline never
+        calls this on the hot path."""
+        out = jnp.zeros(self.dense_shape, self.values.dtype)
+        return out.at[self.rows].add(self.values, mode="drop")
+
+    def merged(self) -> "SelectedRows":
+        rows, values = merge_rows(self.rows, self.values, self.height)
+        return SelectedRows(rows, values, self.height)
+
+    def __repr__(self):
+        return (f"SelectedRows(rows={self.rows.shape}, "
+                f"values={self.values.shape}, height={self.height})")
+
+
+def merge_rows(rows, values, height):
+    """Dedupe duplicate row indices by summing their value slices —
+    reference math::scatter::MergeAdd — at static length: sort rows,
+    segment-sum runs of equal ids, and park unused slots at index
+    `height` so downstream scatters drop them."""
+    n = rows.shape[0]
+    order = jnp.argsort(rows)
+    r = rows[order]
+    v = values[order]
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), r[1:] != r[:-1]])
+    seg = jnp.cumsum(first) - 1                      # [n] segment index
+    merged_vals = jax.ops.segment_sum(v, seg, num_segments=n)
+    merged_rows = jnp.full((n,), height, r.dtype).at[seg].set(
+        r, mode="drop")
+    # rows that were masked (== height) must stay masked even as
+    # segment representatives
+    return merged_rows, merged_vals
+
+
+def is_selected_rows(v) -> bool:
+    return isinstance(v, SelectedRows)
+
+
+def maybe_to_dense(v):
+    return v.to_dense() if isinstance(v, SelectedRows) else v

@@ -71,6 +71,11 @@ class _UniqueNameNS:
     @staticmethod
     def reset():
         _name_gen.reset()
+        # also reset the op uid counter so two identically-built
+        # programs replay identical per-op randomness (fixed-seed
+        # initializers match across builds, like the reference's
+        # seeded random kernels)
+        _uid_counter[0] = 0
 
     @staticmethod
     @contextlib.contextmanager

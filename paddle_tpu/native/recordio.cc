@@ -47,6 +47,7 @@ struct Writer {
 struct Scanner {
   FILE* f;
   std::vector<uint8_t> buf;
+  uint64_t file_size;
 };
 
 }  // namespace
@@ -79,7 +80,12 @@ void recordio_writer_close(void* w) {
 void* recordio_scanner_open(const char* path) {
   FILE* f = fopen(path, "rb");
   if (!f) return nullptr;
-  return new Scanner{f, {}};
+  Scanner* sc = new Scanner{f, {}, 0};
+  fseek(f, 0, SEEK_END);
+  long size = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  sc->file_size = size < 0 ? 0 : static_cast<uint64_t>(size);
+  return sc;
 }
 
 // returns record length (>=0), -100 on clean EOF, -1..-4 on corruption
@@ -91,6 +97,10 @@ int64_t recordio_next(void* s, const uint8_t** out) {
   if (got == 0) return -100;          // clean EOF at a record boundary
   if (got < sizeof(hdr)) return -4;   // writer died mid-header
   if (hdr[0] != kMagic) return -1;
+  // a corrupted length field must not drive a multi-GiB resize (which
+  // would bad_alloc + terminate the worker thread): no valid record
+  // can be longer than the file itself
+  if (hdr[1] > sc->file_size) return -2;
   sc->buf.resize(hdr[1]);
   if (hdr[1] && fread(sc->buf.data(), 1, hdr[1], sc->f) != hdr[1])
     return -2;

@@ -16,7 +16,11 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..core.registry import register_op, register_no_grad_op
+from ..core.registry import (register_op, register_no_grad_op,
+                             override_grad_lowering,
+                             generic_grad_lowering)
+from ..core.selected_rows import SelectedRows, is_selected_rows, \
+    maybe_to_dense
 from ..core.types import dtype_to_np
 
 
@@ -119,6 +123,13 @@ def scale(ctx):
     x = ctx.input("X")
     s = ctx.attr("scale", 1.0)
     b = ctx.attr("bias", 0.0)
+    if is_selected_rows(x):
+        # scale a sparse grad rowwise (reference scale_op SelectedRows
+        # path); bias on absent rows would densify — reject it
+        assert b == 0.0, "scale with bias not defined for SelectedRows"
+        ctx.set_output("Out", x.map_values(
+            lambda v: (v * s).astype(v.dtype)))
+        return
     if ctx.attr("bias_after_scale", True):
         out = x * s + b
     else:
@@ -129,6 +140,17 @@ def scale(ctx):
 @register_op("sum")
 def sum_op(ctx):
     xs = ctx.inputs("X")
+    if any(is_selected_rows(x) for x in xs):
+        if all(is_selected_rows(x) for x in xs):
+            # sum of sparse grads = concatenated (rows, values) —
+            # reference sum_op SelectedRows branch; duplicates merge
+            # later in the optimizer
+            rows = jnp.concatenate([x.rows for x in xs])
+            vals = jnp.concatenate([x.values for x in xs])
+            ctx.set_output("Out", SelectedRows(rows, vals,
+                                               xs[0].height))
+            return
+        xs = [maybe_to_dense(x) for x in xs]
     out = xs[0]
     for x in xs[1:]:
         out = out + x
@@ -402,6 +424,55 @@ def lookup_table(ctx):
         mask = (ids2 == padding_idx)[..., None]
         out = jnp.where(mask, jnp.zeros_like(out), out)
     ctx.set_output("Out", out)
+
+
+@override_grad_lowering("lookup_table")
+def lookup_table_grad(ctx):
+    """is_sparse=True emits a SelectedRows gradient — rows are exactly
+    the looked-up ids, values the output cotangent slices; the dense
+    [vocab, d] grad tensor is never built (reference
+    lookup_table_op.cc:119 SelectedRows grad kernel). Dense mode
+    delegates to the generic vjp."""
+    g_names = ctx.op.input("Out" + "@GRAD")
+    if (not ctx.attr("is_sparse", False) or not g_names
+            or not g_names[0] or ctx.env.get(g_names[0]) is None):
+        # dense mode, or missing/pruned cotangent (generic path emits
+        # the zero grad)
+        return generic_grad_lowering("lookup_table")(ctx)
+    w = ctx.input("W")
+    ids = ctx.input("Ids")
+    g = ctx.env[g_names[0]]
+    height = w.shape[0]
+    ids2 = ids.astype(jnp.int32)
+    if ids2.ndim >= 2 and ids2.shape[-1] == 1:
+        ids2 = ids2.squeeze(-1)
+    rows = ids2.reshape(-1)
+    vals = g.reshape((-1,) + tuple(w.shape[1:])).astype(w.dtype)
+    padding_idx = ctx.attr("padding_idx", -1)
+    if padding_idx is not None and padding_idx >= 0:
+        # forward zeroed these rows; mask their grad slots out
+        rows = jnp.where(rows == padding_idx, height, rows)
+    out_names = ctx.op.output("W" + "@GRAD")
+    if out_names and out_names[0]:
+        ctx.env[out_names[0]] = SelectedRows(rows, vals, height)
+
+
+@register_no_grad_op("merge_selected_rows")
+def merge_selected_rows(ctx):
+    """Dedupe duplicate rows by summing (reference
+    merge_selected_rows_op / math::scatter::MergeAdd)."""
+    x = ctx.input("X")
+    assert is_selected_rows(x), "merge_selected_rows needs SelectedRows"
+    ctx.set_output("Out", x.merged())
+
+
+@register_no_grad_op("get_tensor_from_selected_rows")
+def get_tensor_from_selected_rows(ctx):
+    """Extract the dense value tensor (reference
+    get_tensor_from_selected_rows_op.cc)."""
+    x = ctx.input("X")
+    assert is_selected_rows(x)
+    ctx.set_output("Out", x.values)
 
 
 @register_no_grad_op("one_hot")

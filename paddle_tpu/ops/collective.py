@@ -83,6 +83,23 @@ def _c_allreduce(ctx, op):
     # SAME program is semantics-preserving when run on the global-view
     # engine (where the op is identity and values are already global).
     scale = ctx.attr("scale", None)
+    from ..core.selected_rows import SelectedRows, is_selected_rows
+    if is_selected_rows(x):
+        # sparse grads reduce by ALLGATHER of (rows, values) — each
+        # rank contributes different rows (reference
+        # multi_devices_graph_pass sparse-grad path uses
+        # Reduce/AllGather, never elementwise allreduce, which would
+        # corrupt the row indices)
+        if ax:
+            rows = lax.all_gather(x.rows, ax, axis=0, tiled=True)
+            vals = lax.all_gather(x.values, ax, axis=0, tiled=True)
+            if scale is not None:
+                vals = (vals * scale).astype(vals.dtype)
+            out = SelectedRows(rows, vals, x.height)
+        else:
+            out = x
+        ctx.set_output("Out", out)
+        return
     if ax:
         out = op(x, ax)
         if scale is not None:

@@ -13,13 +13,28 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core.registry import register_no_grad_op
+from ..core.selected_rows import is_selected_rows
+
+
+def _sparse_gather(t, rows):
+    """Gather rows of optimizer state; masked slots (row == height,
+    out of bounds) read as zero and are dropped on scatter-back."""
+    return t.at[rows].get(mode="fill", fill_value=0)
 
 
 @register_no_grad_op("sgd")
 def sgd(ctx):
     p, g, lr = ctx.input("Param"), ctx.input("Grad"), \
         ctx.input("LearningRate")
-    ctx.set_output("ParamOut", p - lr.reshape(()).astype(p.dtype) * g)
+    lr = lr.reshape(()).astype(p.dtype)
+    if is_selected_rows(g):
+        # sparse SGD is linear in g: scatter-add directly, duplicates
+        # and masked rows handled by XLA add/drop semantics (reference
+        # sgd_op.h SelectedRows branch)
+        ctx.set_output("ParamOut", p.at[g.rows].add(
+            -lr * g.values, mode="drop"))
+        return
+    ctx.set_output("ParamOut", p - lr * g)
 
 
 @register_no_grad_op("momentum")
@@ -29,6 +44,22 @@ def momentum(ctx):
     lr = ctx.input("LearningRate").reshape(()).astype(p.dtype)
     mu = ctx.attr("mu")
     use_nesterov = ctx.attr("use_nesterov", False)
+    if is_selected_rows(g):
+        # nonlinear in g -> merge duplicate rows first, then update
+        # only the touched rows (reference momentum_op.h
+        # SparseMomentumFunctor: absent rows keep stale velocity)
+        m = g.merged()
+        rows, gv = m.rows, m.values
+        v_r = _sparse_gather(v, rows)
+        v_new_r = mu * v_r + gv
+        if use_nesterov:
+            upd = (gv + mu * v_new_r) * lr
+        else:
+            upd = lr * v_new_r
+        ctx.set_output("ParamOut", p.at[rows].add(-upd, mode="drop"))
+        ctx.set_output("VelocityOut", v.at[rows].set(
+            v_new_r, mode="drop"))
+        return
     v_new = mu * v + g
     if use_nesterov:
         p_new = p - (g + mu * v_new) * lr
@@ -64,13 +95,30 @@ def adam(ctx):
     b1 = ctx.attr("beta1", 0.9)
     b2 = ctx.attr("beta2", 0.999)
     eps = ctx.attr("epsilon", 1e-8)
-    m_new = b1 * m + (1 - b1) * g
-    v_new = b2 * v + (1 - b2) * g * g
     lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
-    p_new = p - lr_t * m_new / (jnp.sqrt(v_new) + eps)
-    ctx.set_output("ParamOut", p_new)
-    ctx.set_output("Moment1Out", m_new)
-    ctx.set_output("Moment2Out", v_new)
+    if is_selected_rows(g):
+        # reference SparseAdamFunctor (adam_op.h:361): merge duplicate
+        # grad rows, then update moments + param for touched rows only
+        # (absent rows keep stale moments — same semantics)
+        mg = g.merged()
+        rows, gv = mg.rows, mg.values
+        m_r = _sparse_gather(m, rows)
+        v_r = _sparse_gather(v, rows)
+        m_new_r = b1 * m_r + (1 - b1) * gv
+        v_new_r = b2 * v_r + (1 - b2) * gv * gv
+        upd = lr_t * m_new_r / (jnp.sqrt(v_new_r) + eps)
+        ctx.set_output("ParamOut", p.at[rows].add(-upd, mode="drop"))
+        ctx.set_output("Moment1Out", m.at[rows].set(
+            m_new_r, mode="drop"))
+        ctx.set_output("Moment2Out", v.at[rows].set(
+            v_new_r, mode="drop"))
+    else:
+        m_new = b1 * m + (1 - b1) * g
+        v_new = b2 * v + (1 - b2) * g * g
+        p_new = p - lr_t * m_new / (jnp.sqrt(v_new) + eps)
+        ctx.set_output("ParamOut", p_new)
+        ctx.set_output("Moment1Out", m_new)
+        ctx.set_output("Moment2Out", v_new)
     # reference updates beta pows in a separate scale op; we fold them here
     # when the Out slots are bound (python optimizer binds them).
     ctx.set_output("Beta1PowOut", (b1p * b1).reshape(
@@ -101,6 +149,16 @@ def adagrad(ctx):
     mom = ctx.input("Moment")
     lr = ctx.input("LearningRate").reshape(()).astype(p.dtype)
     eps = ctx.attr("epsilon", 1e-6)
+    if is_selected_rows(g):
+        mg = g.merged()
+        rows, gv = mg.rows, mg.values
+        mom_r = _sparse_gather(mom, rows)
+        m_new_r = mom_r + gv * gv
+        upd = lr * gv / (jnp.sqrt(m_new_r) + eps)
+        ctx.set_output("ParamOut", p.at[rows].add(-upd, mode="drop"))
+        ctx.set_output("MomentOut", mom.at[rows].set(
+            m_new_r, mode="drop"))
+        return
     m_new = mom + g * g
     ctx.set_output("ParamOut", p - lr * g / (jnp.sqrt(m_new) + eps))
     ctx.set_output("MomentOut", m_new)
