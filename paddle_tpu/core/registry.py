@@ -350,6 +350,12 @@ def _make_generic_grad_lowering(fwd_type: str):
             g_names = op.input(s + GRAD_SUFFIX)
             for i in range(n_out):
                 primal = primals_out[k]; k += 1
+                if primal is None:
+                    # optional output the forward never bound (e.g.
+                    # sequence_pool's MaxIndex outside MAX mode):
+                    # cotangent structure must mirror it
+                    cts.append(None)
+                    continue
                 if i < len(g_names) and g_names[i] in ctx.env and \
                         ctx.env[g_names[i]] is not None:
                     g = ctx.env[g_names[i]]

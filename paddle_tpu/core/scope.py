@@ -83,6 +83,42 @@ class TensorArray(list):
     pass
 
 
+class LoDRankTable:
+    """Sequence rank table (reference lod_rank_table.h): sequence
+    indices sorted by length, descending. Host-side static metadata in
+    the TPU build (LoD is static per compiled step), driving
+    DynamicRNN's sort/pad/unsort plumbing. Indexable as (index, length)
+    pairs for parity with the reference's items()."""
+
+    __slots__ = ("items", "offsets")
+
+    def __init__(self, offsets):
+        lengths = [int(offsets[i + 1]) - int(offsets[i])
+                   for i in range(len(offsets) - 1)]
+        order = sorted(range(len(lengths)),
+                       key=lambda i: (-lengths[i], i))
+        self.items = [(i, lengths[i]) for i in order]
+        self.offsets = [int(o) for o in offsets]
+
+    def __getitem__(self, i):
+        return self.items[i]
+
+    def __len__(self):
+        return len(self.items)
+
+    @property
+    def indices(self):
+        return [i for i, _ in self.items]
+
+    @property
+    def lengths(self):
+        return [l for _, l in self.items]
+
+    @property
+    def max_len(self):
+        return self.items[0][1] if self.items else 0
+
+
 class Variable:
     """Type-erased runtime variable (reference variable.h:26)."""
 

@@ -3,6 +3,7 @@ from . import nn
 from . import tensor
 from . import math_ops
 from . import control_flow
+from . import rnn  # noqa: F401
 from . import detection  # noqa: F401
 from . import io
 from . import metric_op
@@ -15,6 +16,7 @@ from .nn import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
 from .math_ops import *  # noqa: F401,F403
 from .control_flow import *  # noqa: F401,F403
+from .rnn import *  # noqa: F401,F403
 from .io import *  # noqa: F401,F403
 from .metric_op import *  # noqa: F401,F403
 from .learning_rate_scheduler import *  # noqa: F401,F403

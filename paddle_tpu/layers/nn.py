@@ -44,6 +44,7 @@ __all__ = [
     "pow", "logsigmoid", "exp", "sqrt", "rsqrt", "abs", "ceil", "floor",
     "cos", "sin", "round", "reciprocal", "square", "hard_shrink",
     "softshrink", "thresholded_relu", "stanh",
+    "beam_search", "beam_search_decode",
 ]
 
 
@@ -67,7 +68,12 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
     inputs = input if isinstance(input, (list, tuple)) else [input]
     param_attrs = ParamAttr._to_attr(param_attr)
     if not isinstance(param_attrs, list):
-        param_attrs = [param_attrs] * len(inputs)
+        import copy
+        # one ParamAttr per input: sharing the object would freeze the
+        # generated name after the first weight (multi-input fc has a
+        # separate weight per input, reference nn.py fc)
+        param_attrs = [copy.copy(param_attrs)
+                       for _ in range(len(inputs))]
     mul_results = []
     for x, pattr in zip(inputs, param_attrs):
         in_dim = int(np.prod(x.shape[num_flatten_dims:]))
@@ -1165,3 +1171,49 @@ def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
                "gate_activation": act_codes[gate_activation],
                "origin_mode": origin_mode})
     return updated, reset_h, gate
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, is_accumulated=True, name=None,
+                return_parent_idx=False):
+    """Per-source top-`beam_size` selection over beam x candidate
+    scores (reference nn.py beam_search over beam_search_op.cc).
+    Finished beams are frozen rather than pruned (static shapes; see
+    ops/beam_search.py)."""
+    helper = LayerHelper("beam_search", name=name)
+    sel_ids = helper.create_variable_for_type_inference(pre_ids.dtype)
+    sel_scores = helper.create_variable_for_type_inference(
+        pre_scores.dtype)
+    parent_idx = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        "beam_search",
+        inputs={"pre_ids": pre_ids, "pre_scores": pre_scores,
+                "ids": ids, "scores": scores},
+        outputs={"selected_ids": sel_ids,
+                 "selected_scores": sel_scores,
+                 "parent_idx": parent_idx},
+        attrs={"beam_size": beam_size, "end_id": end_id,
+               "level": level, "is_accumulated": is_accumulated},
+        infer_shape=False)
+    if return_parent_idx:
+        return sel_ids, sel_scores, parent_idx
+    return sel_ids, sel_scores
+
+
+def beam_search_decode(ids, scores, parent_idx, beam_size, end_id,
+                       name=None):
+    """Backtrack stacked beam selections ([T, B*K] tensors or arrays
+    stacked by the caller) into padded hypotheses [B*K, T_max]
+    (reference nn.py beam_search_decode over beam_search_decode_op.cc;
+    padding with end_id replaces the reference's 2-level LoD)."""
+    helper = LayerHelper("beam_search_decode", name=name)
+    sent_ids = helper.create_variable_for_type_inference(ids.dtype)
+    sent_scores = helper.create_variable_for_type_inference("float32")
+    helper.append_op(
+        "beam_search_decode",
+        inputs={"Ids": ids, "Scores": scores, "ParentIdx": parent_idx},
+        outputs={"SentenceIds": sent_ids,
+                 "SentenceScores": sent_scores},
+        attrs={"beam_size": beam_size, "end_id": end_id},
+        infer_shape=False)
+    return sent_ids, sent_scores

@@ -21,3 +21,4 @@ from . import fused  # noqa: F401
 from . import collective  # noqa: F401
 from . import distributed_ops  # noqa: F401
 from . import rnn  # noqa: F401
+from . import beam_search  # noqa: F401

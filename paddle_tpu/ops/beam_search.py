@@ -1,0 +1,133 @@
+"""beam_search / beam_search_decode — the seq2seq decoding ops.
+
+Parity: /root/reference/paddle/fluid/operators/beam_search_op.cc (per-
+source top-k over beam x candidate score matrix with end-token beam
+freezing) and beam_search_decode_op.cc (parent-pointer backtrack into
+full hypotheses).
+
+TPU-native redesign: the reference prunes finished beams out of the LoD
+(shrinking rows); XLA needs static shapes, so every source keeps exactly
+`beam_size` rows throughout and finished beams are FROZEN — they carry
+one candidate (end_id, unchanged score) and -inf for everything else,
+which selects them back verbatim. This is numerically identical to the
+reference's pruning for the surviving hypotheses. The backtrack in
+beam_search_decode is a reverse lax.scan over the stacked parent
+pointers — fully traced, so whole decode programs compile to one XLA
+executable instead of a host loop.
+
+Grouping: rows are contiguous per source. The source count comes from
+pre_ids' LoD when present (the reference contract — decode feeds seed
+ids with lod), else every row is its own source (step 0 layout).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_no_grad_op
+
+_NEG_INF = -1e9
+
+
+@register_no_grad_op("beam_search")
+def beam_search(ctx):
+    pre_ids = ctx.input("pre_ids")
+    pre_scores = ctx.input("pre_scores")
+    ids = ctx.input("ids")
+    scores = ctx.input("scores")
+    K = int(ctx.attr("beam_size"))
+    end_id = int(ctx.attr("end_id"))
+    is_accumulated = bool(ctx.attr("is_accumulated", True))
+
+    rows = int(scores.shape[0])
+    n_cand = int(scores.shape[1])
+    lod = ctx.get_lod("pre_ids")
+    if lod:
+        offsets = lod[0]
+        B = len(offsets) - 1
+        Kg = rows // B  # uniform group width (beam layout is static)
+    else:
+        B, Kg = rows, 1
+
+    pids = pre_ids.reshape(rows).astype(jnp.int32)
+    pscores = pre_scores.reshape(rows).astype(jnp.float32)
+    cand_ids = ids.reshape(rows, n_cand).astype(jnp.int32)
+    cand_sc = scores.reshape(rows, n_cand).astype(jnp.float32)
+    if not is_accumulated:
+        # candidates are probabilities in this mode: accumulate in log
+        # space (reference math/beam_search.cc pre_score + log(score))
+        cand_sc = jnp.log(jnp.maximum(cand_sc, 1e-30)) + \
+            pscores[:, None]
+
+    finished = pids == end_id
+    # frozen beam: candidate 0 re-emits (end_id, pre_score); the rest
+    # are -inf so they never win a slot
+    first = jnp.zeros((rows, n_cand), bool).at[:, 0].set(True)
+    cand_sc = jnp.where(finished[:, None],
+                        jnp.where(first, pscores[:, None], _NEG_INF),
+                        cand_sc)
+    cand_ids = jnp.where(finished[:, None], end_id, cand_ids)
+
+    # per-source top-K over the Kg x n_cand candidate matrix
+    flat_sc = cand_sc.reshape(B, Kg * n_cand)
+    flat_ids = cand_ids.reshape(B, Kg * n_cand)
+    top_sc, top_pos = lax.top_k(flat_sc, K)          # [B, K]
+    sel_ids = jnp.take_along_axis(flat_ids, top_pos, axis=1)
+    # parent row (global index into the pre rows)
+    parent_local = top_pos // n_cand                  # [B, K] in-group
+    parent = parent_local + (jnp.arange(B) * Kg)[:, None]
+
+    sel_ids = sel_ids.reshape(B * K, 1).astype(pre_ids.dtype)
+    sel_sc = top_sc.reshape(B * K, 1)
+    ctx.set_output("selected_ids", sel_ids)
+    ctx.set_output("selected_scores", sel_sc)
+    if ctx.has_output("parent_idx"):
+        ctx.set_output("parent_idx",
+                       parent.reshape(B * K).astype(jnp.int32))
+    group_off = [i * K for i in range(B + 1)]
+    ctx.set_lod(ctx.op.output("selected_ids")[0], [group_off])
+    ctx.set_lod(ctx.op.output("selected_scores")[0], [group_off])
+
+
+@register_no_grad_op("beam_search_decode")
+def beam_search_decode(ctx):
+    """Backtrack stacked per-step selections into full hypotheses.
+
+    Inputs: Ids / Scores / ParentIdx each [T, B*K(, 1)] (stacked step
+    outputs). Outputs padded hypotheses SentenceIds [B*K, T] (positions
+    after each sequence's end token hold end_id) and SentenceScores
+    [B*K, 1] — the static-shape stand-in for the reference's 2-level
+    LoD sentences; trailing end_ids are the pad."""
+    ids = ctx.input("Ids")
+    scores = ctx.input("Scores")
+    parents = ctx.input("ParentIdx")
+    end_id = int(ctx.attr("end_id"))
+
+    if ids.ndim == 3:
+        ids = ids[..., 0]
+    if scores.ndim == 3:
+        scores = scores[..., 0]
+    T, n = ids.shape
+
+    def back(ptr, step):
+        step_ids, step_parents = step
+        tok = step_ids[ptr]
+        ptr_next = step_parents[ptr]
+        return ptr_next, tok
+
+    init_ptr = jnp.arange(n, dtype=jnp.int32)
+    _, toks = lax.scan(back, init_ptr,
+                       (ids.astype(jnp.int32),
+                        parents.astype(jnp.int32)),
+                       reverse=True)
+    sent = toks.T                                     # [n, T]
+    # freeze everything after the first end_id to end_id (frozen beams
+    # re-emit end_id so this is usually already true; enforce anyway)
+    seen_end = jnp.cumsum((sent == end_id).astype(jnp.int32),
+                          axis=1) > 0
+    ended_before = jnp.concatenate(
+        [jnp.zeros((n, 1), bool), seen_end[:, :-1]], axis=1)
+    sent = jnp.where(ended_before, end_id, sent)
+    ctx.set_output("SentenceIds", sent.astype(jnp.int32))
+    ctx.set_output("SentenceScores",
+                   scores[-1].reshape(n, 1).astype(jnp.float32))

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core.registry import register_no_grad_op, register_op
-from ..core.scope import TensorArray
+from ..core.scope import TensorArray, LoDRankTable
 
 
 @register_no_grad_op("print")
@@ -115,6 +115,194 @@ def max_sequence_len(ctx):
     rank_table = ctx.input("RankTable")
     ctx.set_output("Out", jnp.asarray(np.int64(rank_table[0][1]
                                                if rank_table else 0)))
+
+
+# -- dynamic-RNN machinery ---------------------------------------------------
+#
+# Parity: reference recurrent_op.cc (sub-block over time with step
+# scopes), lod_rank_table_op.cc, lod_tensor_to_array_op.cc,
+# array_to_lod_tensor_op.cc, reorder_lod_tensor_by_rank_op.cc,
+# shrink_rnn_memory_op.cc. TPU-native redesign: LoD is static host
+# metadata, so the sort/pad/unsort steps are trace-time gathers over
+# statically-shaped dense tensors, and the time loop is ONE lax.scan —
+# differentiable through the generic vjp grad (no recurrent_grad op
+# needed), with XLA unrolling/fusing the step body instead of the
+# reference's per-step scope creation. Variable-length sequences use a
+# lengths vector + in-scan masking, which is numerically identical to
+# the reference's shrinking-batch execution for memories and outputs.
+
+def _table(ctx, slot="RankTable"):
+    t = ctx.input(slot)
+    assert isinstance(t, LoDRankTable), f"{slot} must be a LoDRankTable"
+    return t
+
+
+@register_no_grad_op("lod_rank_table")
+def lod_rank_table(ctx):
+    lod = ctx.get_lod("X")
+    level = int(ctx.attr("level", 0))
+    x = ctx.input("X")
+    if lod:
+        offsets = lod[level]
+    else:
+        # no lod: every row is a length-1 sequence (reference behavior
+        # for plain tensors)
+        offsets = list(range(int(x.shape[0]) + 1))
+    ctx.set_output("Out", LoDRankTable(offsets))
+
+
+@register_op("lod_tensor_to_array", no_grad_slots=("RankTable",))
+def lod_tensor_to_array(ctx):
+    """Packed [sum_len, d] -> padded time-major [T, n_seq, d], sequences
+    sorted by descending length (rank-table order), padded positions
+    zero. The reference emits a shrinking-batch LoDTensorArray; the
+    dense padded layout is the static-shape equivalent (the recurrent
+    lowering masks by the table's lengths)."""
+    x = ctx.input("X")
+    table = _table(ctx)
+    T = table.max_len
+    oob = int(x.shape[0])  # out-of-bounds pad slot -> fill with zero
+    idx = []
+    for i, (seq, length) in enumerate(table.items):
+        start = table.offsets[seq]
+        for t in range(T):
+            idx.append(start + t if t < length else oob)
+    gather = jnp.asarray(np.asarray(idx, np.int32).reshape(
+        len(table), T).T)  # [T, n_seq]
+    out = x.at[gather].get(mode="fill", fill_value=0)
+    ctx.set_output("Out", out)
+
+
+@register_op("array_to_lod_tensor", no_grad_slots=("RankTable",))
+def array_to_lod_tensor(ctx):
+    """Inverse of lod_tensor_to_array: padded [T, n_seq, d] (rank-table
+    order) -> packed [sum_len, d] in ORIGINAL sequence order, restoring
+    the LoD offsets."""
+    x = ctx.input("X")
+    table = _table(ctx)
+    T = int(x.shape[0])
+    n = len(table)
+    flat = x.reshape((T * n,) + tuple(x.shape[2:]))
+    # packed row j of original sequence seq at step t reads padded slot
+    # t * n + rank_of(seq)
+    rank_of = {seq: r for r, (seq, _) in enumerate(table.items)}
+    gather = []
+    new_off = [0]
+    for seq in range(n):
+        length = table.offsets[seq + 1] - table.offsets[seq]
+        for t in range(length):
+            gather.append(t * n + rank_of[seq])
+        new_off.append(new_off[-1] + length)
+    out = flat[jnp.asarray(np.asarray(gather, np.int32))]
+    ctx.set_output("Out", out)
+    ctx.set_lod(ctx.op.output("Out")[0], [new_off])
+
+
+@register_op("reorder_lod_tensor_by_rank", no_grad_slots=("RankTable",))
+def reorder_lod_tensor_by_rank(ctx):
+    """Reorder batch rows into rank-table order (used to align
+    DynamicRNN memory boot values with the sorted sequences)."""
+    x = ctx.input("X")
+    table = _table(ctx)
+    ctx.set_output("Out", x[jnp.asarray(
+        np.asarray(table.indices, np.int32))])
+
+
+@register_op("shrink_rnn_memory", no_grad_slots=("I", "RankTable"))
+def shrink_rnn_memory(ctx):
+    """Reference shrinks the memory batch to sequences still alive at
+    step I; the dense design keeps the full batch (masking happens in
+    the recurrent scan), so this is an identity kept for program
+    parity."""
+    ctx.set_output("Out", ctx.input("X"))
+
+
+@register_op("expand_to_rank_table_batch", no_grad_slots=("RankTable",))
+def expand_to_rank_table_batch(ctx):
+    """Broadcast a [1, ...] boot value to [n_sequences, ...] in
+    rank-table order (DynamicRNN zero-init memories)."""
+    x = ctx.input("X")
+    table = _table(ctx)
+    ctx.set_output("Out", jnp.broadcast_to(
+        x, (len(table),) + tuple(x.shape[1:])))
+
+
+@register_op("split_lod_tensor", no_grad_slots=("Mask",))
+def split_lod_tensor(ctx):
+    """Dense-masked variant of the reference's row split: both outputs
+    keep the full batch, with non-selected rows zeroed; merge_lod_tensor
+    selects per-row — numerically identical for row-wise branches, and
+    static-shape friendly."""
+    x, mask = ctx.input("X"), ctx.input("Mask")
+    m = mask.reshape((-1,) + (1,) * (x.ndim - 1)).astype(bool)
+    ctx.set_output("OutTrue", jnp.where(m, x, jnp.zeros_like(x)))
+    ctx.set_output("OutFalse", jnp.where(m, jnp.zeros_like(x), x))
+
+
+@register_op("merge_lod_tensor", no_grad_slots=("Mask", "X"))
+def merge_lod_tensor(ctx):
+    t, f, mask = ctx.input("InTrue"), ctx.input("InFalse"), \
+        ctx.input("Mask")
+    m = mask.reshape((-1,) + (1,) * (t.ndim - 1)).astype(bool)
+    ctx.set_output("Out", jnp.where(m, t, f))
+
+
+@register_op("recurrent",
+             no_grad_slots=("SequenceLengths",),
+             intermediate_outputs=())
+def recurrent(ctx):
+    """The framework-level RNN over a sub-block (reference
+    recurrent_op.cc): step inputs are time-major [T, B, ...]; states
+    carry across steps; `parameters` binds every outer var the
+    sub-block reads so the generic vjp grad reaches the weights. One
+    lax.scan; optional SequenceLengths gives masked variable-length
+    semantics (memories hold, outputs zero past each sequence's end)."""
+    block_attr = ctx.attr("sub_block")
+    block_idx = getattr(block_attr, "idx", block_attr)
+    in_names = list(ctx.attr("input_names", []) or [])
+    state_names = list(ctx.attr("state_names", []) or [])
+    state_out_names = list(ctx.attr("state_out_names", []) or [])
+    output_names = list(ctx.attr("output_names", []) or [])
+    param_names = list(ctx.attr("param_names", []) or [])
+    reverse = bool(ctx.attr("reverse", False))
+
+    xs = ctx.inputs("inputs")
+    states = ctx.inputs("initial_states")
+    params = ctx.inputs("parameters")
+    lengths = ctx.input("SequenceLengths")
+    if isinstance(lengths, LoDRankTable):
+        lengths = jnp.asarray(np.asarray(lengths.lengths, np.int32))
+
+    T = int(xs[0].shape[0]) if xs else int(ctx.attr("max_len"))
+    runner = ctx.block_runner
+
+    def step(carry, scanned):
+        t, x_slices = scanned
+        env = {}
+        env.update(zip(param_names, params))
+        env.update(zip(state_names, carry))
+        env.update(zip(in_names, x_slices))
+        runner(block_idx, env)
+        new_states = [env[n] for n in state_out_names]
+        outs = [env[n] for n in output_names]
+        if lengths is not None:
+            live = t < lengths  # [B]
+
+            def sel(new, old):
+                m = live.reshape((-1,) + (1,) * (new.ndim - 1))
+                return jnp.where(m, new, old)
+
+            new_states = [sel(n, o) for n, o in zip(new_states, carry)]
+            outs = [sel(o, jnp.zeros_like(o)) for o in outs]
+        return tuple(new_states), tuple(outs)
+
+    ts = jnp.arange(T)
+    carry, ys = lax.scan(step, tuple(states), (ts, tuple(xs)),
+                         reverse=reverse)
+    if output_names:
+        ctx.set_outputs("outputs", list(ys))
+    if ctx.has_output("final_states"):
+        ctx.set_outputs("final_states", list(carry))
 
 
 @register_no_grad_op("delete_var")

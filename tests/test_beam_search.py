@@ -1,0 +1,169 @@
+"""beam_search / beam_search_decode vs a numpy beam-search golden.
+
+Reference semantics: beam_search_op.cc (per-source top-k with end-token
+beam freezing), beam_search_decode_op.cc (parent backtrack).
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.scope import Scope, LoDTensor
+
+
+def manual_beam_search(probs_per_step, K, end_id, bos):
+    """Full numpy beam search over given per-step probability tables
+    (functions of prefix last token), for ONE source sequence."""
+    beams = [([bos], 0.0)]
+    for probs in probs_per_step:
+        cands = []
+        for toks, sc in beams:
+            if toks[-1] == end_id:
+                cands.append((toks + [end_id], sc))
+                continue
+            p = probs[toks[-1]]
+            for tok in np.argsort(-p)[:K]:
+                cands.append((toks + [int(tok)], sc + np.log(p[tok])))
+        cands.sort(key=lambda c: -c[1])
+        beams = cands[:K]
+    return beams
+
+
+class TestBeamSearchOps:
+    def _build(self, B, K, V):
+        fluid.framework.unique_name.reset()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            pre_ids = layers.data("pre_ids", [1], dtype="int64",
+                                  lod_level=2)
+            pre_scores = layers.data("pre_scores", [1],
+                                     dtype="float32")
+            ids = layers.data("ids", [K], dtype="int64")
+            scores = layers.data("scores", [K], dtype="float32")
+            sel_ids, sel_scores, parent = layers.beam_search(
+                pre_ids, pre_scores, ids, scores, beam_size=K,
+                end_id=0, return_parent_idx=True)
+        return main, startup, (sel_ids, sel_scores, parent)
+
+    def test_single_step_topk_across_beams(self):
+        """2 sources x 2 beams, 3 candidates each: selection must rank
+        across a source's beams, track parents, freeze finished."""
+        B, K = 2, 2
+        main, startup, outs = self._build(B, K, V=3)
+        # source 0: beam0 (live, id 5), beam1 FINISHED (id 0)
+        # source 1: two live beams
+        pre_ids = np.array([[5], [0], [7], [8]], np.int64)
+        pre_scores = np.array([[-1.0], [-0.5], [-2.0], [-0.1]],
+                              np.float32)
+        cand_ids = np.array([[3, 4], [9, 9], [1, 2], [2, 3]], np.int64)
+        # accumulated scores for live beams
+        cand_scores = np.array([[-1.2, -3.0], [0.0, 0.0],
+                                [-2.5, -2.6], [-0.2, -4.0]],
+                               np.float32)
+        lod = [[0, 2, 4], [0, 1, 2, 3, 4]]
+        feed = {"pre_ids": LoDTensor(pre_ids, lod),
+                "pre_scores": pre_scores, "ids": cand_ids,
+                "scores": cand_scores}
+        scope = Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            si, ss, par = exe.run(main, feed=feed,
+                                  fetch_list=list(outs))
+        si = np.asarray(si.array if hasattr(si, "array") else si
+                        ).reshape(-1)
+        ss = np.asarray(ss.array if hasattr(ss, "array") else ss
+                        ).reshape(-1)
+        par = np.asarray(par).reshape(-1)
+        # source 0 candidates: live (3,-1.2), (4,-3.0); frozen (0,-0.5)
+        # top2: (0,-0.5) then (3,-1.2)
+        assert si[0] == 0 and abs(ss[0] - (-0.5)) < 1e-6
+        assert par[0] == 1
+        assert si[1] == 3 and abs(ss[1] - (-1.2)) < 1e-6
+        assert par[1] == 0
+        # source 1: (2,-0.2) from beam3, then (1,-2.5) from beam2
+        assert si[2] == 2 and par[2] == 3
+        assert si[3] == 1 and par[3] == 2
+
+    def test_full_decode_matches_manual_beam_search(self):
+        """3-step decode over a fixed transition table equals the
+        numpy beam search hypotheses and scores."""
+        V, K, T, end_id, bos = 6, 3, 3, 0, 1
+        rng = np.random.default_rng(7)
+        # per-prev-token next-token distributions (shared all steps)
+        table = rng.dirichlet(np.ones(V), size=V).astype(np.float32)
+
+        golden = manual_beam_search([table] * T, K, end_id, bos)
+
+        # drive the ops step by step (eager-style, one step per run)
+        fluid.framework.unique_name.reset()
+        pre_ids = np.full((1, 1), bos, np.int64)
+        pre_scores = np.zeros((1, 1), np.float32)
+        lod = [[0, 1], [0, 1]]
+        ids_hist, par_hist, score_hist = [], [], []
+        for t in range(T):
+            rows = pre_ids.shape[0]
+            probs = table[pre_ids.reshape(-1)]          # [rows, V]
+            topk_idx = np.argsort(-probs, 1)[:, :K]
+            topk_p = np.take_along_axis(probs, topk_idx, 1)
+            acc = np.log(np.maximum(topk_p, 1e-30)) + pre_scores
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                pi = layers.data("pi", [1], dtype="int64", lod_level=2)
+                ps = layers.data("ps", [1], dtype="float32")
+                ci = layers.data("ci", [K], dtype="int64")
+                cs = layers.data("cs", [K], dtype="float32")
+                si, ss, par = layers.beam_search(
+                    pi, ps, ci, cs, beam_size=K, end_id=end_id,
+                    return_parent_idx=True)
+            scope = Scope()
+            with fluid.scope_guard(scope):
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                siv, ssv, parv = exe.run(
+                    main, feed={"pi": LoDTensor(pre_ids, lod),
+                                "ps": pre_scores,
+                                "ci": topk_idx.astype(np.int64),
+                                "cs": acc.astype(np.float32)},
+                    fetch_list=[si, ss, par])
+            pre_ids = np.asarray(
+                siv.array if hasattr(siv, "array") else siv)
+            pre_scores = np.asarray(
+                ssv.array if hasattr(ssv, "array") else ssv)
+            lod = [[0, K], [0] + list(range(1, K + 1))]
+            ids_hist.append(pre_ids.reshape(-1))
+            par_hist.append(np.asarray(parv).reshape(-1))
+            score_hist.append(pre_scores.reshape(-1))
+
+        # decode via the op
+        fluid.framework.unique_name.reset()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            idv = layers.data("idv", [T, K], dtype="int64",
+                              append_batch_size=False)
+            scv = layers.data("scv", [T, K], dtype="float32",
+                              append_batch_size=False)
+            prv = layers.data("prv", [T, K], dtype="int32",
+                              append_batch_size=False)
+            sent, sscore = layers.beam_search_decode(
+                idv, scv, prv, beam_size=K, end_id=end_id)
+        scope = Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            sentv, sscorev = exe.run(
+                main, feed={"idv": np.stack(ids_hist),
+                            "scv": np.stack(score_hist),
+                            "prv": np.stack(par_hist).astype(np.int32)},
+                fetch_list=[sent, sscore])
+        sentv = np.asarray(sentv)
+        sscorev = np.asarray(sscorev).reshape(-1)
+
+        got = sorted(
+            (tuple(sentv[i]), round(float(sscorev[i]), 5))
+            for i in range(K))
+        want = sorted(
+            (tuple(t[1:] + [end_id] * (T + 1 - len(t))), round(s, 5))
+            for t, s in golden)
+        for (gt, gs), (wt, ws) in zip(got, want):
+            assert gt == wt, (got, want)
+            assert abs(gs - ws) < 1e-4

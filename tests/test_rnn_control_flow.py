@@ -1,0 +1,251 @@
+"""StaticRNN / DynamicRNN / IfElse + recurrent-op stack.
+
+Reference: layers/control_flow.py StaticRNN :280 / DynamicRNN :1725 /
+IfElse over recurrent_op.cc; tested the reference way — numpy
+step-by-step loops as golden, plus training (grads through lax.scan).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.scope import Scope, LoDTensor
+
+
+def _run(main, startup, feed, fetch, steps=1):
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        outs = None
+        for _ in range(steps):
+            outs = exe.run(main, feed=feed, fetch_list=fetch)
+    return outs, scope
+
+
+class TestStaticRNN:
+    def test_matches_numpy_loop(self):
+        """h_t = relu(W [x_t, h_{t-1}] + b), outputs stacked [T,B,H]."""
+        T, B, D, H = 5, 3, 4, 6
+        fluid.framework.unique_name.reset()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", [T, B, D], dtype="float32",
+                            append_batch_size=False)
+            rnn = layers.StaticRNN()
+            with rnn.step():
+                word = rnn.step_input(x)
+                prev = rnn.memory(shape=[-1, H], batch_ref=word,
+                                  init_value=0.0)
+                hidden = layers.fc([word, prev], H, act="relu",
+                                   param_attr=[
+                                       fluid.ParamAttr(name="rnn_wx"),
+                                       fluid.ParamAttr(name="rnn_wh")],
+                                   bias_attr=fluid.ParamAttr(
+                                       name="rnn_b"))
+                rnn.update_memory(prev, hidden)
+                rnn.step_output(hidden)
+            out = rnn()
+            loss = layers.mean(out)
+
+        rng = np.random.default_rng(0)
+        xv = rng.standard_normal((T, B, D)).astype(np.float32)
+        (lv, ov), scope = _run(main, startup, {"x": xv},
+                               [loss, out])
+        # numpy golden using the untrained initial weights
+        wx = np.asarray(scope.var("rnn_wx").get_tensor()._array)
+        wh = np.asarray(scope.var("rnn_wh").get_tensor()._array)
+        b = np.asarray(scope.var("rnn_b").get_tensor()._array)
+        h = np.zeros((B, H), np.float32)
+        outs = []
+        for t in range(T):
+            h = np.maximum(xv[t] @ wx + h @ wh + b, 0)
+            outs.append(h)
+        golden = np.stack(outs)
+        np.testing.assert_allclose(np.asarray(ov), golden,
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(float(np.asarray(lv)),
+                                   golden.mean(), rtol=1e-5)
+
+    def test_trains(self):
+        """Gradients flow through the scan into the fc weights."""
+        T, B, D, H = 4, 2, 3, 5
+        fluid.framework.unique_name.reset()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", [T, B, D], dtype="float32",
+                            append_batch_size=False)
+            y = layers.data("y", [T, B, H], dtype="float32",
+                            append_batch_size=False)
+            rnn = layers.StaticRNN()
+            with rnn.step():
+                word = rnn.step_input(x)
+                prev = rnn.memory(shape=[-1, H], batch_ref=word)
+                hidden = layers.fc([word, prev], H, act="tanh")
+                rnn.update_memory(prev, hidden)
+                rnn.step_output(hidden)
+            out = rnn()
+            loss = layers.mean(layers.square(out - y))
+            fluid.optimizer.AdamOptimizer(0.05).minimize(loss)
+
+        rng = np.random.default_rng(1)
+        feed = {"x": rng.standard_normal((T, B, D)).astype(np.float32),
+                "y": rng.standard_normal((T, B, H)).astype(np.float32)}
+        scope = Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            losses = [float(np.asarray(exe.run(
+                main, feed=feed, fetch_list=[loss])[0]))
+                for _ in range(25)]
+        assert losses[-1] < 0.5 * losses[0], losses[::6]
+
+
+def _packed(seqs):
+    """list of [len_i, D] -> (packed [sum, D], lod offsets)."""
+    off = [0]
+    for s in seqs:
+        off.append(off[-1] + len(s))
+    return np.concatenate(seqs, 0).astype(np.float32), [off]
+
+
+class TestDynamicRNN:
+    def test_matches_per_sequence_loop(self):
+        """Ragged batch: h_t = tanh(W [x_t, h_{t-1}] + b) per sequence;
+        packed output must equal per-sequence numpy recurrence, in the
+        ORIGINAL sequence order."""
+        D, H = 3, 4
+        rng = np.random.default_rng(2)
+        lens = [2, 5, 3]   # deliberately unsorted
+        seqs = [rng.standard_normal((l, D)) for l in lens]
+        xv, lod = _packed(seqs)
+
+        fluid.framework.unique_name.reset()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", [D], dtype="float32", lod_level=1)
+            drnn = layers.DynamicRNN()
+            with drnn.block():
+                word = drnn.step_input(x)
+                prev = drnn.memory(shape=[H], value=0.0)
+                hidden = layers.fc([word, prev], H, act="tanh",
+                                   param_attr=[
+                                       fluid.ParamAttr(name="dwx"),
+                                       fluid.ParamAttr(name="dwh")],
+                                   bias_attr=fluid.ParamAttr(
+                                       name="db"))
+                drnn.update_memory(prev, hidden)
+                drnn.output(hidden)
+            out = drnn()
+            last = layers.sequence_last_step(out)
+
+        feed = {"x": LoDTensor(xv, lod)}
+        (ov, lastv), scope = _run(main, startup, feed, [out, last])
+        wx = np.asarray(scope.var("dwx").get_tensor()._array)
+        wh = np.asarray(scope.var("dwh").get_tensor()._array)
+        b = np.asarray(scope.var("db").get_tensor()._array)
+
+        golden_rows = []
+        golden_last = []
+        for s in seqs:
+            h = np.zeros((H,), np.float32)
+            for t in range(len(s)):
+                h = np.tanh(s[t] @ wx + h @ wh + b)
+                golden_rows.append(h.copy())
+            golden_last.append(h.copy())
+        ov_arr = np.asarray(ov.array if hasattr(ov, "array") else ov)
+        np.testing.assert_allclose(ov_arr, np.stack(golden_rows),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(lastv),
+                                   np.stack(golden_last),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_trains_on_ragged_batch(self):
+        D, H = 3, 4
+        rng = np.random.default_rng(3)
+        seqs = [rng.standard_normal((l, D)) for l in (4, 2, 6, 3)]
+        xv, lod = _packed(seqs)
+        tgt = rng.standard_normal((4, H)).astype(np.float32)
+
+        fluid.framework.unique_name.reset()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", [D], dtype="float32", lod_level=1)
+            y = layers.data("y", [H], dtype="float32")
+            drnn = layers.DynamicRNN()
+            with drnn.block():
+                word = drnn.step_input(x)
+                prev = drnn.memory(shape=[H], value=0.0)
+                hidden = layers.fc([word, prev], H, act="tanh")
+                drnn.update_memory(prev, hidden)
+                drnn.output(hidden)
+            last = layers.sequence_last_step(drnn())
+            loss = layers.mean(layers.square(last - y))
+            fluid.optimizer.AdamOptimizer(0.1).minimize(loss)
+
+        scope = Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            feed = {"x": LoDTensor(xv, lod), "y": tgt}
+            losses = [float(np.asarray(exe.run(
+                main, feed=feed, fetch_list=[loss])[0]))
+                for _ in range(30)]
+        assert losses[-1] < 0.3 * losses[0], losses[::8]
+
+    def test_static_input_reordered(self):
+        """static_input rows must align with the sorted sequences and
+        flow into every step."""
+        D = 2
+        rng = np.random.default_rng(4)
+        seqs = [rng.standard_normal((l, D)) for l in (1, 3)]
+        xv, lod = _packed(seqs)
+        sv = np.asarray([[10.0, 0.0], [20.0, 0.0]], np.float32)
+
+        fluid.framework.unique_name.reset()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", [D], dtype="float32", lod_level=1)
+            s = layers.data("s", [D], dtype="float32")
+            drnn = layers.DynamicRNN()
+            with drnn.block():
+                word = drnn.step_input(x)
+                stat = drnn.static_input(s)
+                drnn.output(word + stat)
+            out = drnn()
+
+        (ov,), _ = _run(main, startup,
+                        {"x": LoDTensor(xv, lod), "s": sv}, [out])
+        ov_arr = np.asarray(ov.array if hasattr(ov, "array") else ov)
+        golden = xv.copy()
+        golden[0:1] += sv[0]    # seq 0 rows
+        golden[1:4] += sv[1]    # seq 1 rows
+        np.testing.assert_allclose(ov_arr, golden, rtol=1e-5)
+
+
+class TestIfElse:
+    def test_rowwise_branch_merge(self):
+        B, D = 6, 3
+        rng = np.random.default_rng(5)
+        xv = rng.standard_normal((B, D)).astype(np.float32)
+
+        fluid.framework.unique_name.reset()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", [D], dtype="float32")
+            limit = layers.fill_constant([1], "float32", 0.0)
+            row_sum = layers.reduce_sum(x, dim=1, keep_dim=True)
+            cond = layers.less_than(row_sum, limit)  # [B,1] bool
+            ie = layers.IfElse(cond)
+            with ie.true_block():
+                d = ie.input(x)
+                ie.output(d * 2.0)
+            with ie.false_block():
+                d = ie.input(x)
+                ie.output(d - 1.0)
+            out = ie()[0]
+
+        (ov,), _ = _run(main, startup, {"x": xv}, [out])
+        mask = xv.sum(1, keepdims=True) < 0
+        golden = np.where(mask, xv * 2.0, xv - 1.0)
+        np.testing.assert_allclose(np.asarray(ov), golden, rtol=1e-5)
