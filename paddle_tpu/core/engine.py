@@ -78,6 +78,56 @@ def _collect_persistable_inputs(program, block, scope: Scope):
     return names
 
 
+# Row-preserving ops that share their first LoD input's offsets with
+# same-row-count outputs — the opt-in analog of the reference's per-op
+# ShareLoD calls (a blanket row-count heuristic would mis-tag e.g.
+# transpose of a square tensor). Covers the common token-wise pipeline:
+# embedding -> fc/mul -> activation -> norm -> emission.
+_LOD_SHARING_OPS = frozenset({
+    "lookup_table", "mul", "sum", "scale", "cast", "clip", "dropout",
+    "softmax", "log_softmax", "layer_norm", "elementwise_add",
+    "elementwise_sub", "elementwise_mul", "elementwise_div",
+    "elementwise_max", "elementwise_min", "elementwise_pow", "assign",
+    "relu", "relu6", "sigmoid", "tanh", "exp", "log", "sqrt", "rsqrt",
+    "abs", "square", "gelu", "swish", "softplus", "softsign",
+    "leaky_relu", "elu", "brelu", "soft_relu", "hard_sigmoid", "selu",
+    "stanh", "logsigmoid", "pow", "concat", "row_conv",
+})
+
+
+def _share_lod(op, env, lod_env):
+    """Default LoD propagation (reference ShareLoD in InferShape): for
+    row-preserving ops, an output that kept the row count of a
+    LoD-carrying input inherits its offsets, unless the lowering set
+    one explicitly. This is what lets `emission = fc(embedding(word))`
+    stay per-sequence for the CRF."""
+    if op.type not in _LOD_SHARING_OPS:
+        return
+    src = None
+    for slot in op.input_slots():
+        for n in op.input(slot):
+            if lod_env.get(n):
+                src = n
+                break
+        if src:
+            break
+    if src is None:
+        return
+    sv = env.get(src)
+    src_rows = sv.shape[0] if hasattr(sv, "shape") and \
+        getattr(sv, "shape", None) else None
+    if src_rows is None:
+        return
+    for slot in op.output_slots():
+        for n in op.output(slot):
+            if n in lod_env:
+                continue
+            v = env.get(n)
+            shape = getattr(v, "shape", None)
+            if shape and shape[0] == src_rows:
+                lod_env[n] = lod_env[src]
+
+
 def run_block_ops(block, env, rng_ctx, lod_env, block_runner):
     """Trace all ops of a block into the env (shared by executor + control
     flow sub-blocks)."""
@@ -88,10 +138,13 @@ def run_block_ops(block, env, rng_ctx, lod_env, block_runner):
                 src = op.input("X")[0]
                 dst = op.output("Out")[0]
                 env[dst] = env[src]
+                if src in lod_env and dst not in lod_env:
+                    lod_env[dst] = lod_env[src]
             continue
         info = OPS.get(op.type)
         ctx = ExecContext(op, env, rng_ctx, block_runner, lod_env)
         info.lowering(ctx)
+        _share_lod(op, env, lod_env)
 
 
 def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],

@@ -370,6 +370,9 @@ def _encode_attr(a: fpb.Attr, val):
             raise TypeError(f"unsupported list attr: {val!r}")
     elif isinstance(val, Block):
         a.type = fpb.AT_BLOCK; a.block_idx = val.idx
+    elif isinstance(val, _BlockRef):
+        # round-tripping a deserialized program (clone/prune/save)
+        a.type = fpb.AT_BLOCK; a.block_idx = val.idx
     elif val is None:
         a.type = fpb.AT_NONE
     else:

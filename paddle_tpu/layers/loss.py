@@ -8,6 +8,8 @@ __all__ = [
     "sigmoid_cross_entropy_with_logits", "square_error_cost", "log_loss",
     "huber_loss", "kldiv_loss", "smooth_l1", "margin_rank_loss",
     "rank_loss", "hinge_loss", "bpr_loss", "mse_loss",
+    "linear_chain_crf", "crf_decoding", "warpctc", "ctc_greedy_decoder",
+    "nce", "hsigmoid", "sampled_softmax_with_cross_entropy",
 ]
 
 
@@ -146,3 +148,180 @@ def bpr_loss(input, label, name=None):
     helper.append_op("bpr_loss", inputs={"X": input, "Label": label},
                      outputs={"Y": out})
     return out
+
+
+def linear_chain_crf(input, label, param_attr=None):
+    """Linear-chain CRF negative log-likelihood (reference nn.py
+    linear_chain_crf over linear_chain_crf_op.cc); creates the
+    transition parameter [n_tags+2, n_tags] (rows 0/1 = start/stop)."""
+    helper = LayerHelper("linear_chain_crf")
+    size = input.shape[-1]
+    transition = helper.create_parameter(
+        param_attr, [size + 2, size], input.dtype)
+    alpha = helper.create_variable_for_type_inference(input.dtype)
+    em_exps = helper.create_variable_for_type_inference(input.dtype)
+    tr_exps = helper.create_variable_for_type_inference(input.dtype)
+    ll = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "linear_chain_crf",
+        inputs={"Emission": input, "Transition": transition,
+                "Label": label},
+        outputs={"Alpha": alpha, "EmissionExps": em_exps,
+                 "TransitionExps": tr_exps, "LogLikelihood": ll},
+        infer_shape=False)
+    return ll
+
+
+def crf_decoding(input, param_attr, label=None):
+    """Viterbi decode with the CRF transition parameter; with `label`
+    bound, outputs per-position correctness flags (reference
+    crf_decoding_op.cc)."""
+    helper = LayerHelper("crf_decoding")
+    block = helper.main_program.global_block()
+    if param_attr.name and \
+            block._find_var_recursive(param_attr.name) is not None:
+        transition = block.var(param_attr.name)
+    else:
+        # inference program built fresh: declare the transition param —
+        # its trained value must come from the scope / loaded
+        # persistables (a typo'd name fails loudly at run time as an
+        # uninitialized persistable, since this program's startup is
+        # not meant to be run)
+        import warnings
+        warnings.warn(
+            f"crf_decoding: transition parameter "
+            f"{param_attr.name!r} not found in this program; declaring "
+            f"it — its value must already exist in the scope")
+        size = input.shape[-1]
+        transition = helper.create_parameter(
+            param_attr, [size + 2, size], input.dtype)
+    path = helper.create_variable_for_type_inference("int32")
+    inputs = {"Emission": input, "Transition": transition}
+    if label is not None:
+        inputs["Label"] = label
+    helper.append_op("crf_decoding", inputs=inputs,
+                     outputs={"ViterbiPath": path}, infer_shape=False)
+    return path
+
+
+def warpctc(input, label, blank=0, norm_by_times=False):
+    """CTC loss over LoD sequences (reference warpctc_op.cc; the DP
+    runs in-graph, log-space, so the grad is jax.vjp of the DP)."""
+    helper = LayerHelper("warpctc")
+    loss = helper.create_variable_for_type_inference(input.dtype)
+    grad = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "warpctc", inputs={"Logits": input, "Label": label},
+        outputs={"Loss": loss, "WarpCTCGrad": grad},
+        attrs={"blank": blank, "norm_by_times": norm_by_times},
+        infer_shape=False)
+    return loss
+
+
+def ctc_greedy_decoder(input, blank):
+    """argmax + ctc_align (reference nn.py ctc_greedy_decoder)."""
+    from . import nn as nn_layers
+    helper = LayerHelper("ctc_greedy_decoder")
+    _, topk_indices = nn_layers.top_k(input, k=1)
+    out = helper.create_variable_for_type_inference("int32")
+    helper.append_op("ctc_align", inputs={"Input": topk_indices},
+                     outputs={"Output": out},
+                     attrs={"blank": blank, "merge_repeated": True},
+                     infer_shape=False)
+    return out
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=10,
+        name=None, sampler="uniform", custom_dist=None, seed=0,
+        is_sparse=False):
+    """Noise-contrastive estimation loss (reference nn.py nce)."""
+    import numpy as _np
+    helper = LayerHelper("nce", name=name)
+    dim = input.shape[-1]
+    num_true = label.shape[-1] if len(label.shape) > 1 else 1
+    w = helper.create_parameter(param_attr,
+                                [num_total_classes, dim], input.dtype)
+    b = helper.create_parameter(bias_attr, [num_total_classes, 1],
+                                input.dtype, is_bias=True)
+    cost = helper.create_variable_for_type_inference(input.dtype)
+    sample_logits_v = helper.create_variable_for_type_inference(
+        input.dtype)
+    sample_labels_v = helper.create_variable_for_type_inference("int32")
+    sampler_code = {"uniform": 0, "log_uniform": 1,
+                    "custom_dist": 2}[sampler]
+    inputs = {"Input": input, "Label": label, "Weight": w, "Bias": b}
+    if sample_weight is not None:
+        inputs["SampleWeight"] = sample_weight
+    if custom_dist is not None:
+        from . import tensor as tensor_layers
+        probs = tensor_layers.assign(
+            _np.asarray(custom_dist, _np.float32))
+        inputs["CustomDistProbs"] = probs
+    helper.append_op(
+        "nce", inputs=inputs,
+        outputs={"Cost": cost, "SampleLogits": sample_logits_v,
+                 "SampleLabels": sample_labels_v},
+        attrs={"num_total_classes": num_total_classes,
+               "num_neg_samples": num_neg_samples,
+               "sampler": sampler_code, "seed": seed,
+               "is_sparse": is_sparse},
+        infer_shape=False)
+    return cost
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None,
+             is_custom=False, is_sparse=False):
+    """Hierarchical sigmoid over the complete-binary-tree SimpleCode
+    (reference nn.py hsigmoid)."""
+    if is_custom or path_table is not None or path_code is not None:
+        raise NotImplementedError(
+            "hsigmoid custom trees (path_table/path_code) are not "
+            "implemented; only the complete-binary-tree SimpleCode")
+    helper = LayerHelper("hierarchical_sigmoid", name=name)
+    dim = input.shape[-1]
+    w = helper.create_parameter(param_attr, [num_classes - 1, dim],
+                                input.dtype)
+    b = helper.create_parameter(bias_attr, [1, num_classes - 1],
+                                input.dtype, is_bias=True)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    pre_out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "hierarchical_sigmoid",
+        inputs={"Input": input, "W": w, "Label": label, "Bias": b},
+        outputs={"Out": out, "PreOut": pre_out},
+        attrs={"num_classes": num_classes}, infer_shape=False)
+    return out
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples,
+                                       num_true=1,
+                                       remove_accidental_hits=True,
+                                       use_customized_samples=False,
+                                       customized_samples=None,
+                                       customized_probabilities=None,
+                                       seed=0):
+    """Sampled softmax via sample_logits (reference nn.py)."""
+    helper = LayerHelper("sample_logits")
+    samples = helper.create_variable_for_type_inference("int32")
+    probabilities = helper.create_variable_for_type_inference(
+        logits.dtype)
+    sampled_logits = helper.create_variable_for_type_inference(
+        logits.dtype)
+    sampled_label = helper.create_variable_for_type_inference("int32")
+    inputs = {"Logits": logits, "Labels": label}
+    if use_customized_samples:
+        inputs["CustomizedSamples"] = customized_samples
+        inputs["CustomizedProbabilities"] = customized_probabilities
+    helper.append_op(
+        "sample_logits", inputs=inputs,
+        outputs={"SampledLogits": sampled_logits, "Samples": samples,
+                 "Probabilities": probabilities,
+                 "SampledLabels": sampled_label},
+        attrs={"num_samples": num_samples, "seed": seed,
+               "remove_accidental_hits": remove_accidental_hits},
+        infer_shape=False)
+    from . import loss as loss_layers
+    return loss_layers.softmax_with_cross_entropy(
+        sampled_logits, sampled_label)

@@ -9,6 +9,7 @@ from ..layer_helper import LayerHelper
 __all__ = [
     "less_than", "less_equal", "greater_than", "greater_equal", "equal",
     "not_equal", "logical_and", "logical_or", "logical_xor", "logical_not",
+    "cos_sim",
 ]
 
 
@@ -83,4 +84,15 @@ def elementwise_binary_sugar(x, other, op_type, reverse=False):
     out = helper.create_variable_for_type_inference(a.dtype)
     helper.append_op(op_type, inputs={"X": a, "Y": b},
                      outputs={"Out": out}, attrs={"axis": -1})
+    return out
+
+
+def cos_sim(X, Y):
+    """Row-wise cosine similarity (reference nn.py cos_sim)."""
+    helper = LayerHelper("cos_sim")
+    out = helper.create_variable_for_type_inference(X.dtype)
+    xn = helper.create_variable_for_type_inference(X.dtype)
+    yn = helper.create_variable_for_type_inference(X.dtype)
+    helper.append_op("cos_sim", inputs={"X": X, "Y": Y},
+                     outputs={"Out": out, "XNorm": xn, "YNorm": yn})
     return out

@@ -41,7 +41,8 @@ __all__ = [
     "uniform_random_batch_size_like", "gaussian_random",
     "gaussian_random_batch_size_like", "sampling_id", "where", "size",
     "hash", "grid_sampler", "add_position_encoding", "bilinear_tensor_product",
-    "pow", "logsigmoid", "exp", "sqrt", "rsqrt", "abs", "ceil", "floor",
+    "pow", "logsigmoid", "exp", "log", "sqrt", "rsqrt", "abs", "ceil",
+    "floor",
     "cos", "sin", "round", "reciprocal", "square", "hard_shrink",
     "softshrink", "thresholded_relu", "stanh",
     "beam_search", "beam_search_decode",
@@ -392,6 +393,7 @@ relu = _make_act("relu")
 sigmoid = _make_act("sigmoid")
 tanh = _make_act("tanh")
 exp = _make_act("exp")
+log = _make_act("log")
 sqrt = _make_act("sqrt")
 rsqrt = _make_act("rsqrt")
 abs = _make_act("abs")

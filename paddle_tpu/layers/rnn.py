@@ -52,6 +52,9 @@ def max_sequence_len(rank_table):
 def lod_tensor_to_array(x, table):
     helper = LayerHelper("lod_tensor_to_array")
     arr = helper.create_variable_for_type_inference(x.dtype)
+    # padded time-major [T, n_seq, *features]: keep feature dims so
+    # layers built on step slices see real widths
+    arr.shape = (-1, -1) + tuple(x.shape[1:])
     helper.append_op("lod_tensor_to_array",
                      inputs={"X": x, "RankTable": table},
                      outputs={"Out": arr}, infer_shape=False)
@@ -62,6 +65,7 @@ def array_to_lod_tensor(x, table):
     helper = LayerHelper("array_to_lod_tensor")
     out = helper.create_variable_for_type_inference(x.dtype)
     out.lod_level = 1
+    out.shape = (-1,) + tuple(x.shape[2:])
     helper.append_op("array_to_lod_tensor",
                      inputs={"X": x, "RankTable": table},
                      outputs={"Out": out}, infer_shape=False)
@@ -71,6 +75,7 @@ def array_to_lod_tensor(x, table):
 def reorder_lod_tensor_by_rank(x, rank_table):
     helper = LayerHelper("reorder_lod_tensor_by_rank")
     out = helper.create_variable_for_type_inference(x.dtype)
+    out.shape = tuple(x.shape)
     helper.append_op("reorder_lod_tensor_by_rank",
                      inputs={"X": x, "RankTable": rank_table},
                      outputs={"Out": out}, infer_shape=False)
@@ -401,7 +406,7 @@ class DynamicRNN(_RnnBase):
         for o in self.step_outputs:
             out = parent.create_var(
                 name=framework.unique_name.generate(f"{o.name}@padded"),
-                shape=o.shape, dtype=o.dtype)
+                shape=(-1, -1) + tuple(o.shape[1:]), dtype=o.dtype)
             padded_outs.append(out)
         parent.append_op(
             "recurrent",

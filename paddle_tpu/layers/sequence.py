@@ -34,6 +34,7 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
 def sequence_pool(input, pool_type, is_test=False, pad_value=0.0):
     helper = LayerHelper("sequence_pool")
     out = helper.create_variable_for_type_inference(input.dtype)
+    out.shape = (-1,) + tuple(input.shape[1:])  # one row per sequence
     max_index = helper.create_variable_for_type_inference("int32", True)
     helper.append_op("sequence_pool", inputs={"X": input},
                      outputs={"Out": out, "MaxIndex": max_index},

@@ -22,3 +22,4 @@ from . import collective  # noqa: F401
 from . import distributed_ops  # noqa: F401
 from . import rnn  # noqa: F401
 from . import beam_search  # noqa: F401
+from . import nlp  # noqa: F401

@@ -1,0 +1,404 @@
+"""Structured NLP ops: linear-chain CRF, Viterbi decoding, CTC, NCE,
+hierarchical sigmoid, sampled logits.
+
+Parity: /root/reference/paddle/fluid/operators/linear_chain_crf_op.cc
+(forward algorithm over LoD sequences; Transition row 0 = start, row 1 =
+stop, rows 2.. = [n_tags, n_tags] transitions; output is per-sequence
+negative log-likelihood), crf_decoding_op.cc (Viterbi; with Label bound
+the output flags per-position correctness), warpctc_op.cc (CTC loss via
+the external warp-ctc library), ctc_align_op.cc (merge repeats, drop
+blanks), nce_op.cc, hierarchical_sigmoid_op.cc (complete-binary-tree
+"SimpleCode" paths over num_classes), sample_logits_op.cc.
+
+TPU-native design: LoD is static host metadata, so sequence DPs
+(CRF forward, Viterbi, CTC alpha recursion) run as masked lax.scan /
+unrolled recursions over padded [B, T, ...] tensors — fully traced, and
+differentiable through the generic vjp grad (warp-ctc's hand-written
+gradient becomes jax.vjp of the log-space DP). ctc_align is
+value-dependent-shape and runs on the engine's eager fallback like
+sequence_erase.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op, register_no_grad_op
+
+_NEG = -1e30
+
+
+def _last_level(lod):
+    return lod[-1] if lod else None
+
+
+def _pad_seqs(x, off):
+    """Packed [sum, ...] + offsets -> padded [B, T, ...] and lengths."""
+    lens = [off[i + 1] - off[i] for i in range(len(off) - 1)]
+    T = max(lens)
+    idx = []
+    oob = int(x.shape[0])
+    for i, l in enumerate(lens):
+        for t in range(T):
+            idx.append(off[i] + t if t < l else oob)
+    g = jnp.asarray(np.asarray(idx, np.int32)).reshape(len(lens), T)
+    return (x.at[g].get(mode="fill", fill_value=0),
+            jnp.asarray(np.asarray(lens, np.int32)), T)
+
+
+def _unpad_rows(padded, off):
+    """Padded [B, T, ...] -> packed [sum, ...] by lod offsets."""
+    B, T = padded.shape[0], padded.shape[1]
+    flat = padded.reshape((B * T,) + tuple(padded.shape[2:]))
+    idx = []
+    for i in range(len(off) - 1):
+        for t in range(off[i + 1] - off[i]):
+            idx.append(i * T + t)
+    return flat[jnp.asarray(np.asarray(idx, np.int32))]
+
+
+@register_op("linear_chain_crf", no_grad_slots=("Label",),
+             intermediate_outputs=("Alpha", "EmissionExps",
+                                   "TransitionExps"))
+def linear_chain_crf(ctx):
+    em = ctx.input("Emission")          # [sum, n] packed
+    w = ctx.input("Transition")         # [n+2, n]
+    label = ctx.input("Label")          # [sum, 1] int
+    off = _last_level(ctx.get_lod("Emission"))
+    if off is None:
+        off = [0, int(em.shape[0])]
+    n = int(em.shape[1])
+    start, stop, trans = w[0], w[1], w[2:]
+
+    em_p, lens, T = _pad_seqs(em, off)              # [B, T, n]
+    lab_p, _, _ = _pad_seqs(label.reshape(-1, 1), off)
+    lab_p = lab_p[..., 0].astype(jnp.int32)          # [B, T]
+    B = em_p.shape[0]
+
+    # log partition: forward algorithm, masked past each length
+    def fwd(alpha, te):
+        t, e_t = te
+        nxt = jax.nn.logsumexp(
+            alpha[:, :, None] + trans[None], axis=1) + e_t
+        live = (t < lens)[:, None]
+        return jnp.where(live, nxt, alpha), None
+
+    alpha0 = start[None] + em_p[:, 0]
+    ts = jnp.arange(1, T)
+    alpha, _ = lax.scan(fwd, alpha0,
+                        (ts, jnp.moveaxis(em_p[:, 1:], 1, 0)))
+    logz = jax.nn.logsumexp(alpha + stop[None], axis=1)      # [B]
+
+    # gold path score
+    t_idx = jnp.arange(T)[None]
+    live = t_idx < lens[:, None]                              # [B, T]
+    em_score = jnp.sum(
+        jnp.where(live,
+                  jnp.take_along_axis(em_p, lab_p[..., None],
+                                      axis=2)[..., 0], 0.0), axis=1)
+    first = lab_p[:, 0]
+    last = jnp.take_along_axis(lab_p, (lens - 1)[:, None],
+                               axis=1)[:, 0]
+    pair_live = t_idx[:, 1:] < lens[:, None]
+    tr_score = jnp.sum(
+        jnp.where(pair_live, trans[lab_p[:, :-1], lab_p[:, 1:]], 0.0),
+        axis=1)
+    score = start[first] + em_score + tr_score + stop[last]
+
+    nll = (logz - score).reshape(B, 1)
+    ctx.set_output("LogLikelihood", nll)
+    ctx.set_output("EmissionExps", jnp.exp(em))
+    ctx.set_output("TransitionExps", jnp.exp(w))
+    ctx.set_output("Alpha", jnp.zeros_like(em))
+
+
+@register_no_grad_op("crf_decoding")
+def crf_decoding(ctx):
+    em = ctx.input("Emission")
+    w = ctx.input("Transition")
+    off = _last_level(ctx.get_lod("Emission"))
+    if off is None:
+        off = [0, int(em.shape[0])]
+    start, stop, trans = w[0], w[1], w[2:]
+    em_p, lens, T = _pad_seqs(em, off)
+    B, _, n = em_p.shape
+
+    # Viterbi: delta recursion keeping backpointers
+    def step(delta, te):
+        t, e_t = te
+        scores = delta[:, :, None] + trans[None]          # [B, n, n]
+        best = jnp.max(scores, axis=1) + e_t
+        ptr = jnp.argmax(scores, axis=1).astype(jnp.int32)
+        live = (t < lens)[:, None]
+        return (jnp.where(live, best, delta),
+                jnp.where(live, ptr,
+                          jnp.arange(n, dtype=jnp.int32)[None]))
+
+    delta0 = start[None] + em_p[:, 0]
+    ts = jnp.arange(1, T)
+    delta, ptrs = lax.scan(step, delta0,
+                           (ts, jnp.moveaxis(em_p[:, 1:], 1, 0)))
+    # ptrs: [T-1, B, n]; add stop at each sequence's true last step —
+    # since lengths differ, fold stop in via mask at selection time
+    final = delta + stop[None]
+    last_tag = jnp.argmax(final, axis=1).astype(jnp.int32)  # [B]
+
+    def back(tag, te):
+        t, p_t = te
+        # p_t maps tag at step t -> best tag at step t-1
+        prev = jnp.take_along_axis(p_t, tag[:, None], axis=1)[:, 0]
+        use = (t < lens)  # pointer from a live step
+        return jnp.where(use, prev, tag), tag
+
+    # reverse scan emits the tag AT each step t=1..T-1 and finishes
+    # with the carry = tag at step 0
+    tag0, tags_rev = lax.scan(back, last_tag, (ts, ptrs),
+                              reverse=True)
+    path = jnp.concatenate([tag0[None], tags_rev], axis=0)   # [T, B]
+    path = jnp.moveaxis(path, 0, 1)                          # [B, T]
+    packed = _unpad_rows(path[..., None], off)               # [sum, 1]
+
+    if ctx.has_input("Label"):
+        label = ctx.input("Label").reshape(-1, 1).astype(jnp.int32)
+        packed = (packed == label).astype(jnp.int32)
+    ctx.set_output("ViterbiPath", packed.astype(jnp.int32))
+    ctx.set_lod(ctx.op.output("ViterbiPath")[0], [list(off)])
+
+
+@register_op("warpctc", no_grad_slots=("Label",),
+             intermediate_outputs=("WarpCTCGrad",))
+def warpctc(ctx):
+    logits = ctx.input("Logits")        # [sum_t, C] packed
+    label = ctx.input("Label")          # [sum_l, 1] packed int
+    blank = int(ctx.attr("blank", 0))
+    norm_by_times = bool(ctx.attr("norm_by_times", False))
+    t_off = _last_level(ctx.get_lod("Logits"))
+    l_off = _last_level(ctx.get_lod("Label"))
+    assert t_off is not None and l_off is not None, \
+        "warpctc needs LoD on Logits and Label"
+    B = len(t_off) - 1
+
+    logp_all = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    label_flat = label.reshape(-1)
+
+    losses = []
+    for i in range(B):
+        T = t_off[i + 1] - t_off[i]
+        L = l_off[i + 1] - l_off[i]
+        lp = logp_all[t_off[i]:t_off[i + 1]]          # [T, C]
+        if L == 0:
+            # empty target: only the all-blank alignment exists
+            loss = -jnp.sum(lp[:, blank])
+            if norm_by_times:
+                loss = loss / T
+            losses.append(loss)
+            continue
+        lab = label_flat[l_off[i]:l_off[i + 1]]       # [L] traced
+        # extended label: blank l1 blank l2 ... blank lL blank
+        S = 2 * L + 1
+        ext = jnp.full((S,), blank, jnp.int32)
+        ext = ext.at[1::2].set(lab.astype(jnp.int32))
+        # alpha DP in log space: lax.scan over time (T/L static per
+        # sequence, constant graph size)
+        a0 = jnp.full((S,), _NEG)
+        a0 = a0.at[0].set(lp[0, blank])
+        a0 = a0.at[1].set(lp[0, ext[1]])
+        skip_ok = jnp.concatenate([
+            jnp.zeros((2,), bool),
+            (ext[2:] != blank) & (ext[2:] != ext[:-2])])
+
+        def dp(a, lp_t):
+            prev1 = jnp.concatenate([jnp.full((1,), _NEG), a[:-1]])
+            prev2 = jnp.concatenate([jnp.full((2,), _NEG), a[:-2]])
+            prev2 = jnp.where(skip_ok, prev2, _NEG)
+            a = jnp.logaddexp(jnp.logaddexp(a, prev1), prev2) + \
+                lp_t[ext]
+            return a, None
+
+        a, _ = lax.scan(dp, a0, lp[1:])
+        ll = jnp.logaddexp(a[S - 1], a[S - 2])
+        loss = -ll
+        if norm_by_times:
+            loss = loss / T
+        losses.append(loss)
+    ctx.set_output("Loss", jnp.stack(losses).reshape(B, 1))
+    ctx.set_output("WarpCTCGrad", jnp.zeros_like(logits))
+
+
+@register_no_grad_op("ctc_align")
+def ctc_align(ctx):
+    """Greedy CTC decode: merge repeats, drop blanks. Value-dependent
+    output shape -> eager fallback (like sequence_erase)."""
+    x = ctx.input("Input")
+    blank = int(ctx.attr("blank", 0))
+    off = _last_level(ctx.get_lod("Input"))
+    if isinstance(x, jax.core.Tracer):
+        raise NotImplementedError("ctc_align runs eagerly")
+    arr = np.asarray(x).reshape(-1)
+    if off is None:
+        off = [0, arr.shape[0]]
+    out, new_off = [], [0]
+    for i in range(len(off) - 1):
+        seq = arr[off[i]:off[i + 1]]
+        merged = [int(t) for j, t in enumerate(seq)
+                  if (j == 0 or t != seq[j - 1]) and t != blank]
+        out.extend(merged)
+        new_off.append(new_off[-1] + len(merged))
+    if not out:
+        out = [blank]
+        new_off = [0] + [1] * (len(off) - 1)
+    res = jnp.asarray(np.asarray(out, np.int32).reshape(-1, 1))
+    ctx.set_output("Output", res)
+    ctx.set_lod(ctx.op.output("Output")[0], [new_off])
+
+
+@register_op("nce", no_grad_slots=("Label", "SampleWeight",
+                                   "CustomDistProbs", "CustomDistAlias",
+                                   "CustomDistAliasProbs"),
+             intermediate_outputs=("SampleLogits", "SampleLabels"))
+def nce(ctx):
+    """Noise-contrastive estimation (reference nce_op.h): per sample,
+    logistic loss over the true class plus `num_neg_samples` sampled
+    noise classes, with the sampler-probability correction folded into
+    the logits."""
+    x = ctx.input("Input")              # [B, D]
+    label = ctx.input("Label")          # [B, num_true] int
+    w = ctx.input("Weight")             # [C, D]
+    bias = ctx.input("Bias")            # [C] or [1, C]
+    C = int(ctx.attr("num_total_classes"))
+    k = int(ctx.attr("num_neg_samples", 10))
+    sampler = int(ctx.attr("sampler", 0))
+    B = x.shape[0]
+    num_true = int(label.shape[1]) if label.ndim > 1 else 1
+    label = label.reshape(B, num_true).astype(jnp.int32)
+
+    key = ctx.rng()
+    if sampler == 1:
+        # log-uniform (Zipfian): P(c) ∝ log((c+2)/(c+1))
+        u = jax.random.uniform(key, (B, k))
+        neg = (jnp.exp(u * jnp.log(float(C + 1))) - 1.0).astype(
+            jnp.int32)
+        neg = jnp.clip(neg, 0, C - 1)
+        logq = jnp.log(jnp.log((neg + 2.0) / (neg + 1.0)) /
+                       jnp.log(float(C + 1)))
+        true_q = jnp.log(jnp.log((label + 2.0) / (label + 1.0)) /
+                         jnp.log(float(C + 1)))
+    elif sampler == 2:
+        probs = ctx.input("CustomDistProbs")
+        neg = jax.random.categorical(
+            key, jnp.log(jnp.maximum(probs.reshape(-1), 1e-30)),
+            shape=(B * k,)).reshape(B, k)
+        neg = neg.astype(jnp.int32)
+        logq = jnp.log(jnp.maximum(probs[neg], 1e-30))
+        true_q = jnp.log(jnp.maximum(probs[label], 1e-30))
+    else:
+        neg = jax.random.randint(key, (B, k), 0, C, jnp.int32)
+        logq = jnp.full((B, k), -jnp.log(float(C)))
+        true_q = jnp.full((B, num_true), -jnp.log(float(C)))
+
+    samples = jnp.concatenate([label, neg], axis=1)   # [B, nt+k]
+    w_s = w[samples]                                  # [B, nt+k, D]
+    logits = jnp.einsum("bd,bsd->bs", x, w_s)
+    if bias is not None:
+        logits = logits + bias.reshape(-1)[samples]
+    # NCE correction: subtract log(k * q(class))
+    logqk = jnp.concatenate([true_q, logq], axis=1) + jnp.log(float(k))
+    adj = logits - logqk
+    pos = jax.nn.softplus(-adj[:, :num_true]).sum(axis=1)
+    negc = jax.nn.softplus(adj[:, num_true:]).sum(axis=1)
+    cost = (pos + negc).reshape(B, 1)
+    sw = ctx.input("SampleWeight")
+    if sw is not None:
+        cost = cost * sw.reshape(B, 1)
+    ctx.set_output("Cost", cost)
+    ctx.set_output("SampleLogits", logits)
+    ctx.set_output("SampleLabels", samples)
+
+
+@register_op("hierarchical_sigmoid", no_grad_slots=("Label", "PathTable",
+                                                    "PathCode"),
+             intermediate_outputs=("PreOut",))
+def hierarchical_sigmoid(ctx):
+    """Complete-binary-tree hierarchical softmax (reference
+    hierarchical_sigmoid_op.cc SimpleCode: class c maps to node code
+    c + num_classes; internal node row = (code >> level) - 1)."""
+    x = ctx.input("Input")              # [B, D]
+    w = ctx.input("W")                  # [C-1, D]
+    label = ctx.input("Label").reshape(-1).astype(jnp.int32)  # [B]
+    bias = ctx.input("Bias")            # [1, C-1] or None
+    C = int(ctx.attr("num_classes"))
+    B = x.shape[0]
+    max_len = int(np.ceil(np.log2(max(C, 2))))
+
+    code = label + C                    # [B]
+    # path from just-below-root down to the leaf's parent: at step j we
+    # look at the node (code >> (len - j)), its child bit decides the
+    # sigmoid target. Compute per-sample code length = floor(log2(code)).
+    lengths = jnp.floor(jnp.log2(code.astype(jnp.float32))).astype(
+        jnp.int32)                       # path length per sample
+    js = jnp.arange(1, max_len + 1)[None]          # [1, max_len]
+    shift = lengths[:, None] - js                   # [B, max_len]
+    valid = shift >= 0
+    node = jnp.where(valid, code[:, None] >> jnp.maximum(shift, 0), 1)
+    bit = (node & 1).astype(jnp.float32)            # child bit
+    parent = (node >> 1) - 1                        # weight row
+    parent = jnp.where(valid, parent, 0)
+
+    w_rows = w[parent]                               # [B, L, D]
+    logit = jnp.einsum("bd,bld->bl", x, w_rows)
+    if bias is not None:
+        logit = logit + bias.reshape(-1)[parent]
+    # sigmoid CE with target bit: softplus(z) - bit * z
+    ce = jax.nn.softplus(logit) - bit * logit
+    cost = jnp.sum(jnp.where(valid, ce, 0.0), axis=1).reshape(B, 1)
+    ctx.set_output("Out", cost)
+    ctx.set_output("PreOut", logit)
+
+
+@register_op("sample_logits",
+             no_grad_slots=("Labels", "CustomizedSamples",
+                            "CustomizedProbabilities"),
+             intermediate_outputs=("Samples", "Probabilities",
+                                   "LogitsDim", "LabelsDim"))
+def sample_logits(ctx):
+    """Sampled-softmax support (reference sample_logits_op.cc): gather
+    logits at the true labels plus sampled classes; optionally remove
+    accidental hits and apply the log-q correction."""
+    logits = ctx.input("Logits")        # [B, C]
+    labels = ctx.input("Labels").astype(jnp.int32)   # [B, num_true]
+    B, C = logits.shape
+    num_true = labels.shape[1]
+    k = int(ctx.attr("num_samples", 10))
+    remove_accidental_hits = bool(
+        ctx.attr("remove_accidental_hits", True))
+    use_customized = ctx.has_input("CustomizedSamples")
+    if use_customized:
+        samples = ctx.input("CustomizedSamples").astype(jnp.int32)
+        probs = ctx.input("CustomizedProbabilities")
+    else:
+        # LogUniformSampler like the reference (sample_logits_op.h:203):
+        # P(c) = log((c+2)/(c+1)) / log(C+1), Zipfian-friendly
+        key = ctx.rng()
+        u = jax.random.uniform(key, (B, k))
+        neg = (jnp.exp(u * jnp.log(float(C + 1))) - 1.0).astype(
+            jnp.int32)
+        neg = jnp.clip(neg, 0, C - 1)
+        samples = jnp.concatenate([labels, neg], axis=1)
+        probs = jnp.log((samples + 2.0) / (samples + 1.0)) / \
+            jnp.log(float(C + 1))
+    sampled = jnp.take_along_axis(logits, samples, axis=1)
+    sampled = sampled - jnp.log(jnp.maximum(probs, 1e-30))
+    if remove_accidental_hits:
+        is_hit = (samples[:, None, :] == labels[:, :, None]).any(1)
+        is_hit = is_hit.at[:, :num_true].set(False)
+        sampled = jnp.where(is_hit, sampled + _NEG, sampled)
+    ctx.set_output("SampledLogits", sampled)
+    ctx.set_output("Samples", samples)
+    ctx.set_output("Probabilities", probs)
+    ctx.set_output("SampledLabels",
+                   jnp.broadcast_to(jnp.arange(num_true,
+                                               dtype=jnp.int32),
+                                    (B, num_true)))

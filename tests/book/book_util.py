@@ -1,0 +1,79 @@
+"""Shared harness for the book-model e2e suite.
+
+Mirrors the reference's tests/book pattern (train to a loss threshold,
+save_inference_model, reload, re-infer) with synthetic in-memory
+datasets instead of downloads (zero-egress environment).
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu.core.scope import Scope, LoDTensor  # noqa: E402
+
+
+def train_to_threshold(main, startup, feeder, loss, threshold,
+                       max_steps=300, scope=None, extra_fetch=()):
+    """Run steps from `feeder()` batches until float(loss) < threshold.
+    Returns (scope, history). Raises if the threshold is never hit —
+    the book contract (reference test_fit_a_line.py style)."""
+    scope = scope or Scope()
+    hist = []
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for step in range(max_steps):
+            feed = feeder(step)
+            outs = exe.run(main, feed=feed,
+                           fetch_list=[loss, *extra_fetch])
+            l = float(np.asarray(outs[0]))
+            hist.append(l)
+            if l < threshold:
+                return scope, hist
+    raise AssertionError(
+        f"loss never reached {threshold}; history tail {hist[-8:]}")
+
+
+def save_load_infer_roundtrip(tmp_path, scope, main, feed_names,
+                              targets, feed, atol=1e-5,
+                              test_program=None):
+    """save_inference_model -> load_inference_model in a FRESH scope ->
+    run -> compare against the live training scope's outputs (computed
+    on `test_program`, usually main.clone(for_test=True), so nothing
+    mutates)."""
+    d = str(tmp_path / "model")
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        fluid.io.save_inference_model(d, feed_names, targets, exe,
+                                      main_program=main)
+        prog_w = test_program
+        fetch_w = [t.name for t in targets]
+        if prog_w is None:
+            prog_w, _, fetch_w = fluid.io.load_inference_model(d, exe)
+        want = exe.run(prog_w, feed=feed, fetch_list=fetch_w)
+    inf_scope = Scope()
+    with fluid.scope_guard(inf_scope):
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        prog, feed_names2, fetch_targets = \
+            fluid.io.load_inference_model(d, exe2)
+        assert list(feed_names2) == list(feed_names)
+        got = exe2.run(prog, feed=feed,
+                       fetch_list=fetch_targets)
+    for w, g in zip(want, got):
+        np.testing.assert_allclose(
+            np.asarray(w).astype(np.float32),
+            np.asarray(g).astype(np.float32), atol=atol, rtol=1e-4)
+    return got
+
+
+def pack_lod(seqs, dtype=np.int64, col=1):
+    """list of 1-D sequences -> (packed [sum, col] array, lod)."""
+    off = [0]
+    for s in seqs:
+        off.append(off[-1] + len(s))
+    flat = np.concatenate([np.asarray(s) for s in seqs])
+    return LoDTensor(flat.reshape(-1, col).astype(dtype), [off])

@@ -1,0 +1,33 @@
+"""Book model 1: linear regression (reference
+tests/book/test_fit_a_line.py) on a synthetic housing-like dataset."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+from book_util import train_to_threshold, save_load_infer_roundtrip
+
+
+def test_fit_a_line(tmp_path):
+    rng = np.random.default_rng(0)
+    w_true = rng.standard_normal((13, 1)).astype(np.float32)
+
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [13], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        pred = layers.fc(x, 1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.SGDOptimizer(0.02).minimize(loss)
+
+    def feeder(step):
+        xb = rng.standard_normal((32, 13)).astype(np.float32)
+        return {"x": xb, "y": xb @ w_true +
+                0.01 * rng.standard_normal((32, 1)).astype(np.float32)}
+
+    scope, hist = train_to_threshold(main, startup, feeder, loss, 0.05,
+                                     max_steps=400)
+    xb = rng.standard_normal((8, 13)).astype(np.float32)
+    save_load_infer_roundtrip(tmp_path, scope, main, ["x"], [pred],
+                              {"x": xb})

@@ -1,0 +1,76 @@
+"""Book model 3: image classification, mini-VGG + residual variants
+(reference tests/book/test_image_classification.py) on synthetic
+channel-patterned 3x32x32 images."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+from book_util import train_to_threshold, save_load_infer_roundtrip
+
+N_CLASS = 4
+
+
+def _synth_batch(rng, n):
+    labels = rng.integers(0, N_CLASS, n)
+    imgs = 0.3 * rng.standard_normal((n, 3, 32, 32))
+    for i, c in enumerate(labels):
+        imgs[i, int(c) % 3, :, :] += 1.0 + 0.5 * (int(c) // 3)
+    return imgs.astype(np.float32), labels.reshape(-1, 1).astype(
+        np.int64)
+
+
+def _vgg(img):
+    def block(x, ch):
+        c = layers.conv2d(x, ch, 3, padding=1, act="relu")
+        c = layers.conv2d(c, ch, 3, padding=1, act="relu")
+        return layers.pool2d(c, 2, "max", 2)
+
+    h = block(img, 8)
+    h = block(h, 16)
+    h = layers.fc(h, 64, act="relu")
+    return layers.fc(h, N_CLASS, act="softmax")
+
+
+def _resnet(img):
+    def conv_bn(x, ch, stride=1, act="relu"):
+        c = layers.conv2d(x, ch, 3, stride=stride, padding=1,
+                          bias_attr=False)
+        return layers.batch_norm(c, act=act)
+
+    def basic(x, ch, stride=1):
+        c = conv_bn(x, ch, stride)
+        c = conv_bn(c, ch, act=None)
+        if stride != 1 or x.shape[1] != ch:
+            x = conv_bn(x, ch, stride, act=None)
+        return layers.relu(layers.elementwise_add(c, x))
+
+    h = conv_bn(img, 8)
+    h = basic(h, 8)
+    h = basic(h, 16, stride=2)
+    h = layers.pool2d(h, 4, "avg", 4)
+    return layers.fc(h, N_CLASS, act="softmax")
+
+
+@pytest.mark.parametrize("net", [_vgg, _resnet], ids=["vgg", "resnet"])
+def test_image_classification(tmp_path, net):
+    rng = np.random.default_rng(4)
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", [3, 32, 32], dtype="float32")
+        label = layers.data("label", [1], dtype="int64")
+        pred = net(img)
+        loss = layers.mean(layers.cross_entropy(pred, label))
+        fluid.optimizer.AdamOptimizer(2e-3).minimize(loss)
+
+    def feeder(step):
+        imgs, labels = _synth_batch(rng, 16)
+        return {"img": imgs, "label": labels}
+
+    scope, _ = train_to_threshold(main, startup, feeder, loss, 0.25,
+                                  max_steps=200)
+    imgs, _ = _synth_batch(rng, 4)
+    save_load_infer_roundtrip(tmp_path, scope, main, ["img"], [pred],
+                              {"img": imgs}, atol=1e-4)

@@ -1,0 +1,95 @@
+"""Book model 8: label semantic roles (reference
+tests/book/test_label_semantic_roles.py): token embeddings -> RNN ->
+per-token emissions -> linear_chain_crf cost; inference via
+crf_decoding, accuracy checked against the synthetic tag rule."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+from book_util import train_to_threshold, pack_lod
+
+VOCAB, N_TAG, EMB, HID = 16, 4, 16, 32
+
+
+def _emission_net(word):
+    emb = layers.embedding(word, [VOCAB, EMB],
+                           param_attr=fluid.ParamAttr(name="w_emb"))
+    drnn = layers.DynamicRNN()
+    with drnn.block():
+        w = drnn.step_input(emb)
+        prev = drnn.memory(shape=[HID], value=0.0)
+        h = layers.fc([w, prev], HID, act="tanh",
+                      param_attr=[fluid.ParamAttr(name="r_wx"),
+                                  fluid.ParamAttr(name="r_wh")],
+                      bias_attr=fluid.ParamAttr(name="r_b"))
+        drnn.update_memory(prev, h)
+        drnn.output(h)
+    return layers.fc(drnn(), N_TAG,
+                     param_attr=fluid.ParamAttr(name="em_w"),
+                     bias_attr=fluid.ParamAttr(name="em_b"))
+
+
+def _batch(rng, n):
+    """Tag rule representable by additive CRF potentials: tokens < 12
+    determine their tag directly (emission feature); tokens >= 12 are
+    ambiguous between tags 0 and 3 and the PREVIOUS tag disambiguates
+    (transition feature) — so Viterbi must actually use transitions."""
+    words, tags = [], []
+    for _ in range(n):
+        l = int(rng.integers(3, 7))
+        w = rng.integers(0, VOCAB, l)
+        t, prev = [], 0
+        for tok in w:
+            if int(tok) < 12:
+                cur = int(tok) % 3
+            else:
+                cur = 3 if prev == 0 else 0
+            t.append(cur)
+            prev = cur
+        words.append(w)
+        tags.append(np.asarray(t))
+    return words, tags
+
+
+def test_label_semantic_roles():
+    rng = np.random.default_rng(7)
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        word = layers.data("word", [1], dtype="int64", lod_level=1)
+        tag = layers.data("tag", [1], dtype="int64", lod_level=1)
+        emission = _emission_net(word)
+        crf_cost = layers.linear_chain_crf(
+            emission, tag,
+            param_attr=fluid.ParamAttr(name="crfw"))
+        loss = layers.mean(crf_cost)
+        fluid.optimizer.AdamOptimizer(0.01).minimize(loss)
+
+    pool = []
+    for _ in range(10):
+        words, tags = _batch(rng, 16)
+        pool.append({"word": pack_lod(words), "tag": pack_lod(tags)})
+
+    scope, _ = train_to_threshold(
+        main, startup, lambda s: pool[s % len(pool)], loss, 0.25,
+        max_steps=1200)
+
+    # decode program sharing the trained params (emission + crfw)
+    decode_prog = fluid.Program()
+    with fluid.program_guard(decode_prog, fluid.Program()):
+        word_d = layers.data("word", [1], dtype="int64", lod_level=1)
+        emission_d = _emission_net(word_d)
+        path = layers.crf_decoding(
+            emission_d, fluid.ParamAttr(name="crfw"))
+
+    words, tags = _batch(rng, 32)
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        out, = exe.run(decode_prog, feed={"word": pack_lod(words)},
+                       fetch_list=[path])
+    got = np.asarray(out.array if hasattr(out, "array") else out
+                     ).reshape(-1)
+    want = np.concatenate(tags)
+    acc = (got == want).mean()
+    assert acc > 0.9, f"viterbi accuracy {acc}"

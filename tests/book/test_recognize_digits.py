@@ -1,0 +1,61 @@
+"""Book model 2: digit recognition, MLP + conv variants (reference
+tests/book/test_recognize_digits.py) on synthetic class-patterned
+images."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+from book_util import train_to_threshold, save_load_infer_roundtrip
+
+N_CLASS = 10
+
+
+def _synth_batch(rng, n):
+    """Images whose 4x4 block means encode the label."""
+    labels = rng.integers(0, N_CLASS, n)
+    imgs = 0.3 * rng.standard_normal((n, 1, 28, 28))
+    for i, c in enumerate(labels):
+        r, col = divmod(int(c), 4)
+        imgs[i, 0, r * 7:(r + 1) * 7, col * 7:(col + 1) * 7] += 2.0
+    return imgs.astype(np.float32), labels.reshape(-1, 1).astype(
+        np.int64)
+
+
+def _mlp(img):
+    h = layers.fc(img, 64, act="relu")
+    h = layers.fc(h, 64, act="relu")
+    return layers.fc(h, N_CLASS, act="softmax")
+
+
+def _conv(img):
+    c1 = layers.conv2d(img, 8, 5, act="relu")
+    p1 = layers.pool2d(c1, 2, "max", 2)
+    c2 = layers.conv2d(p1, 16, 5, act="relu")
+    p2 = layers.pool2d(c2, 2, "max", 2)
+    return layers.fc(p2, N_CLASS, act="softmax")
+
+
+@pytest.mark.parametrize("net", [_mlp, _conv], ids=["mlp", "conv"])
+def test_recognize_digits(tmp_path, net):
+    rng = np.random.default_rng(1)
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", [1, 28, 28], dtype="float32")
+        label = layers.data("label", [1], dtype="int64")
+        pred = net(img)
+        loss = layers.mean(layers.cross_entropy(pred, label))
+        acc = layers.accuracy(pred, label)
+        fluid.optimizer.AdamOptimizer(2e-3).minimize(loss)
+
+    def feeder(step):
+        imgs, labels = _synth_batch(rng, 32)
+        return {"img": imgs, "label": labels}
+
+    scope, hist = train_to_threshold(main, startup, feeder, loss, 0.15,
+                                     max_steps=250)
+    imgs, _ = _synth_batch(rng, 8)
+    save_load_infer_roundtrip(tmp_path, scope, main, ["img"], [pred],
+                              {"img": imgs}, atol=1e-4)

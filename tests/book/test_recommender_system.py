@@ -1,0 +1,64 @@
+"""Book model 5: recommender (reference
+tests/book/test_recommender_system.py): user/item feature embeddings ->
+per-side fc towers -> cosine similarity scaled to a rating, square
+error loss."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+from book_util import train_to_threshold, save_load_infer_roundtrip
+
+N_USER, N_ITEM, N_JOB, N_AGE, N_CAT = 24, 30, 5, 7, 6
+
+
+def test_recommender_system(tmp_path):
+    rng = np.random.default_rng(3)
+    # latent ground truth driving synthetic ratings
+    u_lat = rng.standard_normal((N_USER, 4)).astype(np.float32)
+    i_lat = rng.standard_normal((N_ITEM, 4)).astype(np.float32)
+
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        uid = layers.data("uid", [1], dtype="int64")
+        job = layers.data("job", [1], dtype="int64")
+        age = layers.data("age", [1], dtype="int64")
+        mid = layers.data("mid", [1], dtype="int64")
+        cat = layers.data("cat", [1], dtype="int64")
+        score = layers.data("score", [1], dtype="float32")
+
+        u = layers.concat([
+            layers.embedding(uid, [N_USER, 16]),
+            layers.embedding(job, [N_JOB, 4]),
+            layers.embedding(age, [N_AGE, 4])], axis=1)
+        usr = layers.fc(u, 32, act="tanh")
+        m = layers.concat([
+            layers.embedding(mid, [N_ITEM, 16]),
+            layers.embedding(cat, [N_CAT, 4])], axis=1)
+        mov = layers.fc(m, 32, act="tanh")
+        sim = layers.cos_sim(usr, mov)
+        pred = layers.scale(sim, scale=5.0)
+        loss = layers.mean(layers.square_error_cost(pred, score))
+        fluid.optimizer.AdamOptimizer(5e-3).minimize(loss)
+
+    def feeder(step):
+        n = 64
+        us = rng.integers(0, N_USER, n)
+        it = rng.integers(0, N_ITEM, n)
+        rating = np.clip(
+            (u_lat[us] * i_lat[it]).sum(1) + 2.5, 0, 5)
+        return {"uid": us.reshape(-1, 1).astype(np.int64),
+                "job": (us % N_JOB).reshape(-1, 1).astype(np.int64),
+                "age": (us % N_AGE).reshape(-1, 1).astype(np.int64),
+                "mid": it.reshape(-1, 1).astype(np.int64),
+                "cat": (it % N_CAT).reshape(-1, 1).astype(np.int64),
+                "score": rating.reshape(-1, 1).astype(np.float32)}
+
+    scope, _ = train_to_threshold(main, startup, feeder, loss, 1.0,
+                                  max_steps=400)
+    feed = feeder(0)
+    feed.pop("score")
+    save_load_infer_roundtrip(
+        tmp_path, scope, main, ["uid", "job", "age", "mid", "cat"],
+        [pred], feed, atol=1e-4)

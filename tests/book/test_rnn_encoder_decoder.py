@@ -1,0 +1,78 @@
+"""Book model 7: RNN encoder-decoder seq2seq (reference
+tests/book/test_rnn_encoder_decoder.py): DynamicRNN encoder compresses
+the ragged source, decoder RNN with the encoder state as boot memory is
+teacher-forced over the target."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+from book_util import (train_to_threshold, save_load_infer_roundtrip,
+                       pack_lod)
+
+VOCAB, EMB, HID = 8, 16, 48
+BOS = 1
+
+
+def _model():
+    src = layers.data("src", [1], dtype="int64", lod_level=1)
+    tgt_in = layers.data("tgt_in", [1], dtype="int64", lod_level=1)
+    tgt_lab = layers.data("tgt_lab", [1], dtype="int64", lod_level=1)
+
+    src_emb = layers.embedding(src, [VOCAB, EMB],
+                               param_attr=fluid.ParamAttr(name="src_e"))
+    enc = layers.DynamicRNN()
+    with enc.block():
+        w = enc.step_input(src_emb)
+        prev = enc.memory(shape=[HID], value=0.0)
+        h = layers.fc([w, prev], HID, act="tanh")
+        enc.update_memory(prev, h)
+        enc.output(h)
+    enc_last = layers.sequence_last_step(enc())     # [B, HID]
+
+    tgt_emb = layers.embedding(tgt_in, [VOCAB, EMB],
+                               param_attr=fluid.ParamAttr(name="tgt_e"))
+    dec = layers.DynamicRNN()
+    with dec.block():
+        w = dec.step_input(tgt_emb)
+        prev = dec.memory(init=enc_last, need_reorder=True)
+        h = layers.fc([w, prev], HID, act="tanh")
+        dec.update_memory(prev, h)
+        dec.output(h)
+    dec_out = dec()                                  # [sum_tgt, HID]
+    logits = layers.fc(dec_out, VOCAB, act="softmax",
+                       param_attr=fluid.ParamAttr(name="out_w"),
+                       bias_attr=fluid.ParamAttr(name="out_b"))
+    loss = layers.mean(layers.cross_entropy(logits, tgt_lab))
+    return loss, logits
+
+
+def _batch(rng, n):
+    srcs, tins, tlabs = [], [], []
+    for _ in range(n):
+        l = int(rng.integers(2, 5))
+        s = rng.integers(2, VOCAB, l)       # 0 pad / 1 bos reserved
+        srcs.append(s)
+        tins.append(np.concatenate([[BOS], s[:-1]]))
+        tlabs.append(s)                     # copy task
+    return {"src": pack_lod(srcs), "tgt_in": pack_lod(tins),
+            "tgt_lab": pack_lod(tlabs)}
+
+
+def test_rnn_encoder_decoder(tmp_path):
+    rng = np.random.default_rng(5)
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        loss, logits = _model()
+        fluid.optimizer.AdamOptimizer(0.01).minimize(loss)
+
+    pool = [_batch(rng, 16) for _ in range(4)]
+    scope, hist = train_to_threshold(
+        main, startup, lambda s: pool[s % len(pool)], loss, 0.8,
+        max_steps=600)
+
+    feed = _batch(rng, 4)
+    save_load_infer_roundtrip(
+        tmp_path, scope, main, ["src", "tgt_in"], [logits],
+        {"src": feed["src"], "tgt_in": feed["tgt_in"]}, atol=1e-4)

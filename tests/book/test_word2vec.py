@@ -1,0 +1,48 @@
+"""Book model 4: word2vec N-gram model (reference
+tests/book/test_word2vec.py): 4 context embeddings (one shared sparse
+table) -> concat -> fc -> softmax over the vocab."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+from book_util import train_to_threshold, save_load_infer_roundtrip
+
+VOCAB, EMB = 32, 16
+
+
+def test_word2vec(tmp_path):
+    rng = np.random.default_rng(2)
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        words = [layers.data(f"w{i}", [1], dtype="int64")
+                 for i in range(4)]
+        target = layers.data("tgt", [1], dtype="int64")
+        embs = [layers.embedding(
+            w, size=[VOCAB, EMB], is_sparse=True,
+            param_attr=fluid.ParamAttr(name="shared_w"))
+            for w in words]
+        concat = layers.concat(embs, axis=1)
+        hidden = layers.fc(concat, 128, act="relu")
+        pred = layers.fc(hidden, VOCAB, act="softmax")
+        loss = layers.mean(layers.cross_entropy(pred, target))
+        fluid.optimizer.AdamOptimizer(0.01).minimize(loss)
+
+    def feeder(step):
+        # deterministic n-gram rule: next = (w0 + w1) % VOCAB, with
+        # w2/w3 as distractor context
+        ctx = rng.integers(0, VOCAB, (64, 4))
+        tgt = (ctx[:, 0] + ctx[:, 1]) % VOCAB
+        feed = {f"w{i}": ctx[:, i:i + 1].astype(np.int64)
+                for i in range(4)}
+        feed["tgt"] = tgt.reshape(-1, 1).astype(np.int64)
+        return feed
+
+    scope, _ = train_to_threshold(main, startup, feeder, loss, 2.0,
+                                  max_steps=600)
+    ctx = rng.integers(0, VOCAB, (8, 4))
+    feed = {f"w{i}": ctx[:, i:i + 1].astype(np.int64)
+            for i in range(4)}
+    save_load_infer_roundtrip(tmp_path, scope, main,
+                              ["w0", "w1", "w2", "w3"], [pred], feed)

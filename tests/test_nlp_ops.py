@@ -1,0 +1,270 @@
+"""Structured NLP ops: CRF, Viterbi, CTC, NCE, hsigmoid.
+
+Goldens are brute-force enumerations (all tag paths / all CTC
+alignments) — the strongest possible reference for small sizes — plus
+OpTest numeric-gradient checks, mirroring the reference's
+test_linear_chain_crf_op.py / test_warpctc_op.py strategy.
+"""
+import itertools
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.scope import LoDTensor, Scope
+
+from op_test import OpTest
+
+
+def crf_brute_force(em, trans_full, labels):
+    """All-paths enumeration. em [T, n]; trans_full [n+2, n];
+    labels [T]. Returns nll."""
+    T, n = em.shape
+    start, stop, trans = trans_full[0], trans_full[1], trans_full[2:]
+
+    def score(path):
+        s = start[path[0]] + stop[path[-1]]
+        s += sum(em[t, path[t]] for t in range(T))
+        s += sum(trans[path[t - 1], path[t]] for t in range(1, T))
+        return s
+
+    logz = np.logaddexp.reduce(
+        [score(p) for p in itertools.product(range(n), repeat=T)])
+    return logz - score(labels)
+
+
+def ctc_brute_force(logits, labels, blank):
+    """Sum of probabilities over every alignment that collapses to
+    `labels`. logits [T, C] unnormalized."""
+    T, C = logits.shape
+    logp = logits - np.logaddexp.reduce(logits, axis=1, keepdims=True)
+
+    def collapse(al):
+        out, prev = [], None
+        for a in al:
+            if a != prev and a != blank:
+                out.append(a)
+            prev = a
+        return tuple(out)
+
+    total = None
+    for al in itertools.product(range(C), repeat=T):
+        if collapse(al) != tuple(labels):
+            continue
+        s = sum(logp[t, al[t]] for t in range(T))
+        total = s if total is None else np.logaddexp(total, s)
+    return -total
+
+
+class TestLinearChainCRF(OpTest):
+    def setUp(self):
+        rng = np.random.default_rng(0)
+        self.n = 3
+        lens = [2, 3]
+        off = [0, 2, 5]
+        em = rng.standard_normal((5, self.n)).astype(np.float32)
+        w = rng.standard_normal((self.n + 2, self.n)).astype(np.float32)
+        lab = rng.integers(0, self.n, (5, 1)).astype(np.int64)
+        nll = np.array(
+            [[crf_brute_force(em[off[i]:off[i + 1]], w,
+                              lab[off[i]:off[i + 1], 0])]
+             for i in range(2)], np.float32)
+        self.op_type = "linear_chain_crf"
+        self.inputs = {"Emission": (em, [off]),
+                       "Transition": w, "Label": (lab, [off])}
+        self.outputs = {"LogLikelihood": nll,
+                        "Alpha": np.zeros_like(em),
+                        "EmissionExps": np.exp(em),
+                        "TransitionExps": np.exp(w)}
+
+    def test_output(self):
+        self.check_output(no_check_set={"Alpha"}, atol=1e-4, rtol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["emission", "transition"],
+                        ["loglikelihood_out"],
+                        max_relative_error=0.02)
+
+
+class TestCRFDecoding(OpTest):
+    def setUp(self):
+        rng = np.random.default_rng(1)
+        n = 3
+        off = [0, 3, 7]
+        em = rng.standard_normal((7, n)).astype(np.float32)
+        w = rng.standard_normal((n + 2, n)).astype(np.float32)
+        start, stop, trans = w[0], w[1], w[2:]
+
+        paths = []
+        for i in range(2):
+            e = em[off[i]:off[i + 1]]
+            T = e.shape[0]
+            best, best_s = None, -np.inf
+            for p in itertools.product(range(n), repeat=T):
+                s = start[p[0]] + stop[p[-1]] + \
+                    sum(e[t, p[t]] for t in range(T)) + \
+                    sum(trans[p[t - 1], p[t]] for t in range(1, T))
+                if s > best_s:
+                    best, best_s = p, s
+            paths.extend(best)
+        self.op_type = "crf_decoding"
+        self.inputs = {"Emission": (em, [off]), "Transition": w}
+        self.outputs = {"ViterbiPath": np.asarray(
+            paths, np.int32).reshape(-1, 1)}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestWarpCTC(OpTest):
+    def setUp(self):
+        rng = np.random.default_rng(2)
+        C, blank = 4, 0
+        t_off = [0, 4, 9]
+        l_off = [0, 2, 3]
+        logits = rng.standard_normal((9, C)).astype(np.float32)
+        labels = np.array([[1], [2], [3]], np.int64)
+        loss = np.array(
+            [[ctc_brute_force(logits[t_off[i]:t_off[i + 1]],
+                              labels[l_off[i]:l_off[i + 1], 0], blank)]
+             for i in range(2)], np.float32)
+        self.op_type = "warpctc"
+        self.inputs = {"Logits": (logits, [t_off]),
+                       "Label": (labels, [l_off])}
+        self.outputs = {"Loss": loss,
+                        "WarpCTCGrad": np.zeros_like(logits)}
+        self.attrs = {"blank": blank, "norm_by_times": False}
+
+    def test_output(self):
+        self.check_output(no_check_set={"WarpCTCGrad"},
+                          atol=1e-4, rtol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["logits"], ["loss_out"],
+                        max_relative_error=0.02)
+
+
+class TestCTCAlign(OpTest):
+    def setUp(self):
+        off = [0, 6, 10]
+        x = np.array([0, 1, 1, 0, 2, 2, 3, 0, 3, 3],
+                     np.int32).reshape(-1, 1)
+        self.op_type = "ctc_align"
+        self.inputs = {"Input": (x, [off])}
+        self.outputs = {"Output": (
+            np.array([1, 2, 3, 3], np.int32).reshape(-1, 1),
+            [[0, 2, 4]])}
+        self.attrs = {"blank": 0}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestHSigmoidNormalizes(OpTest):
+    """Hierarchical softmax must define a distribution: summing
+    exp(-cost) over every class gives 1."""
+
+    def runTest(self):
+        pass
+
+    def test_sums_to_one(self):
+        rng = np.random.default_rng(3)
+        for C in (4, 7, 8):   # power of two and not
+            B, D = 2, 5
+            fluid.framework.unique_name.reset()
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                x = layers.data("x", [D], dtype="float32")
+                lab = layers.data("lab", [1], dtype="int64")
+                cost = layers.hsigmoid(x, lab, C)
+            xv = rng.standard_normal((B, D)).astype(np.float32)
+            scope = Scope()
+            with fluid.scope_guard(scope):
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup)
+                total = np.zeros((B, 1))
+                for c in range(C):
+                    lv = np.full((B, 1), c, np.int64)
+                    o, = exe.run(main, feed={"x": xv, "lab": lv},
+                                 fetch_list=[cost])
+                    total += np.exp(-np.asarray(o))
+            np.testing.assert_allclose(total, np.ones((B, 1)),
+                                       rtol=1e-5)
+
+    def test_trains(self):
+        rng = np.random.default_rng(4)
+        B, D, C = 8, 6, 10
+        fluid.framework.unique_name.reset()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", [D], dtype="float32")
+            lab = layers.data("lab", [1], dtype="int64")
+            cost = layers.mean(layers.hsigmoid(x, lab, C))
+            fluid.optimizer.AdamOptimizer(0.1).minimize(cost)
+        xv = rng.standard_normal((B, D)).astype(np.float32)
+        lv = rng.integers(0, C, (B, 1)).astype(np.int64)
+        scope = Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            losses = [float(np.asarray(exe.run(
+                main, feed={"x": xv, "lab": lv},
+                fetch_list=[cost])[0])) for _ in range(30)]
+        assert losses[-1] < 0.5 * losses[0]
+
+
+class TestNCE(OpTest):
+    def runTest(self):
+        pass
+
+    def test_cost_matches_formula(self):
+        """Recompute the NCE cost in numpy from the op's own sampled
+        labels/logits (uniform sampler, fixed seed)."""
+        rng = np.random.default_rng(5)
+        B, D, C, k = 4, 6, 20, 5
+        fluid.framework.unique_name.reset()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", [D], dtype="float32")
+            lab = layers.data("lab", [1], dtype="int64")
+            cost = layers.nce(x, lab, C, num_neg_samples=k, seed=7)
+            # fetch the op's internals
+            block = main.global_block()
+            nce_op = [op for op in block.ops if op.type == "nce"][0]
+            logits_name = nce_op.output("SampleLogits")[0]
+            labels_name = nce_op.output("SampleLabels")[0]
+        xv = rng.standard_normal((B, D)).astype(np.float32)
+        lv = rng.integers(0, C, (B, 1)).astype(np.int64)
+        scope = Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            cv, slv, smv = exe.run(
+                main, feed={"x": xv, "lab": lv},
+                fetch_list=[cost.name, logits_name, labels_name])
+        cv, slv = np.asarray(cv), np.asarray(slv)
+        adj = slv - np.log(k * (1.0 / C))
+        sp = np.logaddexp(0, -adj[:, :1]).sum(1) + \
+            np.logaddexp(0, adj[:, 1:]).sum(1)
+        np.testing.assert_allclose(cv.reshape(-1), sp, rtol=1e-5)
+
+    def test_trains(self):
+        rng = np.random.default_rng(6)
+        B, D, C = 16, 8, 50
+        fluid.framework.unique_name.reset()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", [D], dtype="float32")
+            lab = layers.data("lab", [1], dtype="int64")
+            cost = layers.mean(layers.nce(x, lab, C, seed=11))
+            fluid.optimizer.AdamOptimizer(0.1).minimize(cost)
+        xv = rng.standard_normal((B, D)).astype(np.float32)
+        lv = rng.integers(0, C, (B, 1)).astype(np.int64)
+        scope = Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            losses = [float(np.asarray(exe.run(
+                main, feed={"x": xv, "lab": lv},
+                fetch_list=[cost])[0])) for _ in range(40)]
+        assert losses[-1] < losses[0]
