@@ -56,6 +56,11 @@ class BuildStrategy:
         self.collective_mode = ""
         self.nccl_comm_num = 1
         self.use_hierarchical_allreduce = False
+        # multi_batch_merge parity (reference ir/multi_batch_merge_pass
+        # .cc:72): run forward+backward this many times per step on
+        # equal feed slices, average the grads, apply the optimizer
+        # once. 1 = off.
+        self.gradient_accumulation_steps = 1
 
 
 class ExecutionStrategy:
@@ -92,6 +97,10 @@ class CompiledProgram:
 
     def _run(self, executor, feed, fetch_names, scope, return_numpy):
         from .parallel.data_parallel import DataParallelEngine
+        k = getattr(self._build_strategy,
+                    "gradient_accumulation_steps", 1) or 1
+        if k > 1:
+            self._program._gradient_accumulation_steps = k
         if not self._is_data_parallel:
             feed = executor._canonical_feed(feed, self._program)
             return executor._engine.run(

@@ -128,10 +128,10 @@ def _share_lod(op, env, lod_env):
                 lod_env[n] = lod_env[src]
 
 
-def run_block_ops(block, env, rng_ctx, lod_env, block_runner):
-    """Trace all ops of a block into the env (shared by executor + control
-    flow sub-blocks)."""
-    for op in block.ops:
+def run_block_ops(block, env, rng_ctx, lod_env, block_runner, ops=None):
+    """Trace ops (default: all of the block) into the env (shared by
+    executor + control flow sub-blocks)."""
+    for op in (block.ops if ops is None else ops):
         if op.type in _ENGINE_OPS:
             # feed: value is pre-seeded into env; fetch: alias out name
             if op.type == "fetch":
@@ -198,14 +198,14 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
             return super().step_key()
 
     amp_cfg = getattr(program, "_amp", None)
+    accum_k = int(getattr(program, "_gradient_accumulation_steps", 1)
+                  or 1)
+    if accum_k > 1 and feed_lods:
+        raise NotImplementedError(
+            "gradient accumulation slices feeds on the batch dim and "
+            "cannot split LoD (ragged) feeds")
 
-    def step(params, feeds, key):
-        env = _TrackingDict()
-        env.update(params)
-        env.update(feeds)
-        lod_env = {k: [list(l) for l in v] for k, v in feed_lods.items()}
-        rng_ctx = _Rng(key)
-
+    def _run_whole(env, rng_ctx, lod_env):
         def block_runner(idx, sub_env=None):
             run_block_ops(program.block(idx),
                           sub_env if sub_env is not None else env,
@@ -216,9 +216,89 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
             from .amp import amp_guard
             with amp_guard(True, amp_cfg.get("dtype", jnp.bfloat16),
                            amp_cfg.get("black_ops", ())):
-                run_block_ops(block, env, rng_ctx, lod_env, block_runner)
+                run_block_ops(block, env, rng_ctx, lod_env,
+                              block_runner)
         else:
             run_block_ops(block, env, rng_ctx, lod_env, block_runner)
+        return env
+
+    def _run_accumulated(params, feeds, key):
+        """multi_batch_merge parity (reference ir/multi_batch_merge_
+        pass.cc:72), TPU-native: re-trace the compute phase per feed
+        slice, average the grads the optimize phase consumes, run the
+        optimize phase once. Mean-of-slice-grads == full-batch grad for
+        mean losses, so the parameter trajectory matches big-batch."""
+        from .selected_rows import SelectedRows, is_selected_rows
+        compute_ops = [op for op in block.ops
+                       if op.attr("op_role", "forward") != "optimize"]
+        opt_ops = [op for op in block.ops
+                   if op.attr("op_role", "forward") == "optimize"]
+        grad_names = sorted({
+            n for op in opt_ops for slot in op.input_slots()
+            for n in op.input(slot) if n.endswith("@GRAD")})
+        g_acc = {}
+        env = None
+        for i in range(accum_k):
+            env = _TrackingDict()
+            env.update(params)
+            for n, arr in feeds.items():
+                if getattr(arr, "shape", None) and \
+                        arr.shape[0] % accum_k == 0:
+                    sz = arr.shape[0] // accum_k
+                    env[n] = arr[i * sz:(i + 1) * sz]
+                else:
+                    env[n] = arr
+            rng_ctx = _Rng(jax.random.fold_in(key, i))
+
+            def block_runner(idx, sub_env=None):
+                run_block_ops(program.block(idx),
+                              sub_env if sub_env is not None else env,
+                              rng_ctx, lod_env_i, block_runner)
+                return sub_env if sub_env is not None else env
+
+            lod_env_i = {}
+            run_block_ops(block, env, rng_ctx, lod_env_i, block_runner,
+                          ops=compute_ops)
+            for n in grad_names:
+                g = env.get(n)
+                if g is None:
+                    continue
+                prev = g_acc.get(n)
+                if prev is None:
+                    g_acc[n] = g
+                elif is_selected_rows(g):
+                    g_acc[n] = SelectedRows(
+                        jnp.concatenate([prev.rows, g.rows]),
+                        jnp.concatenate([prev.values, g.values]),
+                        g.height)
+                else:
+                    g_acc[n] = prev + g
+        inv = 1.0 / accum_k
+        for n, g in g_acc.items():
+            env[n] = g.map_values(lambda v: (v * inv).astype(v.dtype)) \
+                if is_selected_rows(g) else g * inv
+        rng_ctx = _Rng(key)
+
+        def block_runner2(idx, sub_env=None):
+            run_block_ops(program.block(idx),
+                          sub_env if sub_env is not None else env,
+                          rng_ctx, {}, block_runner2)
+            return sub_env if sub_env is not None else env
+
+        run_block_ops(block, env, rng_ctx, {}, block_runner2,
+                      ops=opt_ops)
+        return env
+
+    def step(params, feeds, key):
+        lod_env = {k: [list(l) for l in v] for k, v in feed_lods.items()}
+        rng_ctx = _Rng(key)
+        if accum_k > 1:
+            env = _run_accumulated(params, feeds, key)
+        else:
+            env = _TrackingDict()
+            env.update(params)
+            env.update(feeds)
+            env = _run_whole(env, rng_ctx, lod_env)
 
         updated = sorted(n for n in env.written if n in persistable_all)
         updated_box.clear()

@@ -196,10 +196,20 @@ class Optimizer:
         # grad clipping + regularization (reference optimizer.py:499-535)
         from .clip import append_gradient_clip_ops
         from .regularizer import append_regularization_ops
+        block = default_main_program().global_block()
+        start = len(block.ops)
         params_grads = append_gradient_clip_ops(params_grads)
         params_grads = append_regularization_ops(params_grads,
                                                  self.regularization)
-        return self._create_optimization_pass(params_grads)
+        ops = self._create_optimization_pass(params_grads)
+        # tag the whole optimize phase (clip + regularization + LR
+        # schedule + update rules) so the engine can split
+        # compute-vs-update for gradient accumulation
+        # (reference multi_batch_merge_pass works off the same role)
+        from .backward import OP_ROLE_ATTR
+        for op in block.ops[start:]:
+            op._attrs[OP_ROLE_ATTR] = "optimize"
+        return ops
 
     def apply_optimize(self, loss, startup_program, params_grads):
         if in_dygraph_mode():

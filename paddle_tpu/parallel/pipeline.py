@@ -20,10 +20,19 @@ TPU-native redesign: the whole pipeline is ONE jitted SPMD step.
   lowerings (sgd/momentum/adam...) run functionally on (param, grad,
   state) — one update source of truth with the graph path.
 
-Current scope: stage activations must share one shape (uniform
-transformer-style stages); params are replicated across pp ranks (the
-schedule, not param placement, is what PP buys here — per-stage param
-sharding composes later via the strategy rules).
+Parameter placement: params used by exactly one stage are STACKED into
+[n_stages, ...] arrays sharded over the pp axis — each device holds only
+its own stage's slice, so per-device param + optimizer-state memory is
+~1/n_stages of the model (the reference gets the same effect by pinning
+each section's vars to its own place, pipeline_trainer.cc:35-48).
+Requirements: structurally uniform stages (same per-stage param
+shapes, the transformer case) and elementwise update rules
+(sgd/momentum/adam/...; lars/lamb couple the whole tensor through a
+norm, which would mix stages in the stacked layout). Elementwise update
+rules run directly on the stacked arrays, so params, grads and moments
+stay sharded end to end. Shared (multi-stage) params and any
+non-conforming case fall back to replicated. Stage activations must
+share one shape (uniform transformer-style stages).
 """
 from __future__ import annotations
 
@@ -48,6 +57,18 @@ def _producer_index(ops, name):
             if name in op.output(slot):
                 return i
     raise ValueError(f"no op produces {name!r}")
+
+
+# update rules that act elementwise on (param, grad, moments) — safe to
+# run once on [n_stages, ...]-stacked arrays. lars_momentum/lamb compute
+# whole-tensor norms and would couple stages, so they force the
+# replicated fallback.
+_ELEMENTWISE_UPDATE_OPS = frozenset({
+    "sgd", "momentum", "adam", "adamax", "adagrad", "decayed_adagrad",
+    "proximal_adagrad", "proximal_gd", "adadelta", "rmsprop", "ftrl",
+})
+# update-op input slots that are shared scalars, not per-param state
+_SCALAR_SLOTS = frozenset({"LearningRate", "Beta1Pow", "Beta2Pow"})
 
 
 class PipelineEngine:
@@ -108,13 +129,22 @@ class PipelineEngine:
             feed_sig = {n: jax.ShapeDtypeStruct(a.shape[1:], a.dtype)
                         for n, a in micro.items()}
             self._params, self._opt_state = self.build(scope, feed_sig)
-        loss, self._params, self._opt_state = self._step_fn(
-            self._params, self._opt_state, micro)
+        loss, self._stacked, self._params, self._opt_state = \
+            self._step_fn(self._stacked, self._params, self._opt_state,
+                          micro)
         return float(np.asarray(loss))
 
     def sync_to_scope(self, scope: Scope):
         for n, v in {**self._params, **self._opt_state}.items():
             scope.var(n).set_value(v)
+        for j, slot in enumerate(self._stacked_slots):
+            arr = np.asarray(self._stacked[f"p{j}"])
+            for s, n in enumerate(slot["names"]):
+                scope.var(n).set_value(arr[s])
+            for sl, varnames in slot["state"].items():
+                sarr = np.asarray(self._stacked[f"s{j}.{sl}"])
+                for s, n in enumerate(varnames):
+                    scope.var(n).set_value(sarr[s])
 
     # -- step construction --------------------------------------------------
     def build(self, scope: Scope, feed_sig: Dict[str, jax.ShapeDtypeStruct]):

@@ -1,0 +1,98 @@
+"""Gradient accumulation (multi_batch_merge parity, reference
+ir/multi_batch_merge_pass.cc:72): k forward/backward passes on feed
+slices + one optimizer application must reproduce the big-batch
+parameter trajectory exactly (mean loss => mean of slice grads equals
+the full-batch grad)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.scope import Scope
+
+
+def _net(is_sparse=False):
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        if is_sparse:
+            ids = layers.data("x", [1], dtype="int64")
+            emb = layers.embedding(
+                ids, [50, 8], is_sparse=True,
+                param_attr=fluid.ParamAttr(name="emb_w"))
+            pred = layers.fc(emb, 1,
+                             param_attr=fluid.ParamAttr(name="w"))
+        else:
+            x = layers.data("x", [6], dtype="float32")
+            h = layers.fc(x, 16, act="relu",
+                          param_attr=fluid.ParamAttr(name="w0"))
+            pred = layers.fc(h, 1, param_attr=fluid.ParamAttr(name="w"))
+        y = layers.data("y", [1], dtype="float32")
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.AdamOptimizer(0.01).minimize(loss)
+    return main, startup, loss
+
+
+def _train(main, startup, loss, feeds, accumulation_steps=1,
+           param_names=("w",)):
+    scope = Scope()
+    prog = main
+    if accumulation_steps > 1:
+        bs = fluid.BuildStrategy()
+        bs.gradient_accumulation_steps = accumulation_steps
+        prog = fluid.CompiledProgram(main, build_strategy=bs)
+    losses = []
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for feed in feeds:
+            l, = exe.run(prog, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(l)))
+        params = {n: np.asarray(scope.var(n).get_tensor()._array)
+                  for n in param_names}
+    return losses, params
+
+
+def test_trajectory_matches_big_batch():
+    rng = np.random.default_rng(0)
+    feeds = []
+    for _ in range(6):
+        xb = rng.standard_normal((32, 6)).astype(np.float32)
+        feeds.append({"x": xb,
+                      "y": (xb.sum(1, keepdims=True) +
+                            0.1 * rng.standard_normal((32, 1))
+                            ).astype(np.float32)})
+    m1, s1, l1 = _net()
+    _, p_big = _train(m1, s1, l1, feeds, 1, ("w", "w0"))
+    m2, s2, l2 = _net()
+    _, p_acc = _train(m2, s2, l2, feeds, 4, ("w", "w0"))
+    for n in p_big:
+        np.testing.assert_allclose(p_acc[n], p_big[n],
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_grads_accumulate():
+    rng = np.random.default_rng(1)
+    feeds = []
+    for _ in range(5):
+        ids = rng.integers(0, 50, (24, 1)).astype(np.int64)
+        feeds.append({"x": ids,
+                      "y": (ids % 5).astype(np.float32)})
+    m1, s1, l1 = _net(is_sparse=True)
+    _, p_big = _train(m1, s1, l1, feeds, 1, ("emb_w", "w"))
+    m2, s2, l2 = _net(is_sparse=True)
+    _, p_acc = _train(m2, s2, l2, feeds, 4, ("emb_w", "w"))
+    for n in p_big:
+        np.testing.assert_allclose(p_acc[n], p_big[n],
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_loss_still_decreases_with_accumulation():
+    rng = np.random.default_rng(2)
+    feeds = []
+    for _ in range(30):
+        xb = rng.standard_normal((16, 6)).astype(np.float32)
+        feeds.append({"x": xb,
+                      "y": xb.sum(1, keepdims=True).astype(np.float32)})
+    m, s, l = _net()
+    losses, _ = _train(m, s, l, feeds, 2)
+    assert losses[-1] < 0.3 * losses[0]
