@@ -1,0 +1,80 @@
+"""Enforce-style error layer (reference platform/enforce.h:194).
+
+The reference wraps every kernel invocation in PADDLE_ENFORCE macros so a
+mis-built program fails with the op name, its inputs/outputs, and a
+stacktrace rather than a raw Eigen/CUDA error. Here the failure surface is
+trace time (lowerings run under jax.eval_shape / jit tracing), so the
+engine wraps each lowering call and re-raises trace errors as
+``EnforceNotMet`` carrying the op type, its slot->var-name map, and the
+traced shape/dtype of every input that is already in the env — which is
+what the raw JAX shape-mismatch message lacks.
+"""
+from __future__ import annotations
+
+__all__ = ["EnforceNotMet", "enforce", "format_op_context"]
+
+
+class EnforceNotMet(RuntimeError):
+    """Raised when tracing an op fails or a runtime check trips.
+
+    Mirrors the reference's EnforceNotMet (enforce.h:194): the message
+    always names the op and its variables so users debug the *program*,
+    not the XLA internals.
+    """
+
+    def __init__(self, message: str, op_type: str = None):
+        super().__init__(message)
+        self.op_type = op_type
+
+
+def enforce(condition, message: str, op_type: str = None):
+    """PADDLE_ENFORCE equivalent for host-side checks in lowerings."""
+    if not condition:
+        raise EnforceNotMet(message, op_type=op_type)
+
+
+def _shape_of(value):
+    try:
+        shape = tuple(value.shape)
+        dtype = getattr(value, "dtype", None)
+        return f"{dtype}{list(shape)}"
+    except Exception:
+        return type(value).__name__
+
+
+def format_op_context(op, env, op_index=None) -> str:
+    lines = []
+    where = f"op #{op_index} " if op_index is not None else "op "
+    lines.append(f"{where}type={op.type!r}")
+    for slot in op.input_slots():
+        names = op.input(slot)
+        if not names:
+            continue
+        rendered = []
+        for n in names:
+            if env is not None and n in env:
+                rendered.append(f"{n}:{_shape_of(env[n])}")
+            else:
+                rendered.append(f"{n}:<not traced>")
+        lines.append(f"  input  {slot}: " + ", ".join(rendered))
+    for slot in op.output_slots():
+        names = op.output(slot)
+        if names:
+            lines.append(f"  output {slot}: " + ", ".join(names))
+    attrs = getattr(op, "_attrs", None)
+    if isinstance(attrs, dict) and attrs:
+        small = {k: v for k, v in sorted(attrs.items())
+                 if isinstance(v, (int, float, bool, str))
+                 and not k.startswith("__")}
+        if small:
+            lines.append(f"  attrs: {small}")
+    return "\n".join(lines)
+
+
+def wrap_op_error(exc: Exception, op, env, op_index=None) -> EnforceNotMet:
+    ctx = format_op_context(op, env, op_index)
+    msg = (f"Error tracing operator {op.type!r}:\n{ctx}\n"
+           f"caused by: {type(exc).__name__}: {exc}")
+    err = EnforceNotMet(msg, op_type=op.type)
+    err.__cause__ = exc
+    return err

@@ -20,11 +20,20 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+import threading
+import time
+
+from .enforce import EnforceNotMet, wrap_op_error
+from .flags import FLAGS
 from .registry import OPS, ExecContext, _RngCtx
 from .scope import LoDTensor, Scope
 from .types import dtype_to_np
 
 RNG_STATE_VAR = "@RNG_STATE@"
+
+# active check_nan_inf collection for the trace on this thread (engine +
+# control-flow sub-blocks all append to the same list); None = off
+_nan_check_ctx = threading.local()
 
 # ops the tracing engine handles itself / skips
 _ENGINE_OPS = {"feed", "fetch"}
@@ -46,7 +55,8 @@ class TracedStep:
     """A compiled step: callable over (param_arrays, feed_arrays, key)."""
 
     def __init__(self, fn, donated_names, const_names, feed_names,
-                 fetch_names, updated_names, fetch_lods, uses_rng):
+                 fetch_names, updated_names, fetch_lods, uses_rng,
+                 nan_check_labels=()):
         self.fn = fn
         self.donated_names = donated_names
         self.const_names = const_names
@@ -55,6 +65,9 @@ class TracedStep:
         self.updated_names = updated_names
         self.fetch_lods = fetch_lods  # name -> lod (host metadata)
         self.uses_rng = uses_rng
+        # (op_type, var_name) per all-finite flag when check_nan_inf was
+        # on at trace time
+        self.nan_check_labels = nan_check_labels
 
 
 def _collect_persistable_inputs(program, block, scope: Scope):
@@ -131,7 +144,7 @@ def _share_lod(op, env, lod_env):
 def run_block_ops(block, env, rng_ctx, lod_env, block_runner, ops=None):
     """Trace ops (default: all of the block) into the env (shared by
     executor + control flow sub-blocks)."""
-    for op in (block.ops if ops is None else ops):
+    for i, op in enumerate(block.ops if ops is None else ops):
         if op.type in _ENGINE_OPS:
             # feed: value is pre-seeded into env; fetch: alias out name
             if op.type == "fetch":
@@ -141,10 +154,31 @@ def run_block_ops(block, env, rng_ctx, lod_env, block_runner, ops=None):
                 if src in lod_env and dst not in lod_env:
                     lod_env[dst] = lod_env[src]
             continue
-        info = OPS.get(op.type)
-        ctx = ExecContext(op, env, rng_ctx, block_runner, lod_env)
-        info.lowering(ctx)
+        try:
+            info = OPS.get(op.type)
+            ctx = ExecContext(op, env, rng_ctx, block_runner, lod_env)
+            info.lowering(ctx)
+        except (EnforceNotMet, NotImplementedError):
+            # already carries op context / handled by the eager fallback
+            raise
+        except Exception as exc:  # re-raise with op/var context (enforce.h)
+            raise wrap_op_error(exc, op, env, i) from exc
         _share_lod(op, env, lod_env)
+        checks = getattr(_nan_check_ctx, "items", None)
+        if checks is not None:
+            _append_nan_checks(checks, op, env)
+
+
+def _append_nan_checks(checks, op, env):
+    """check_nan_inf instrumentation (reference operator.cc:953-983):
+    record an all-finite flag per float output; the engine fetches the
+    stacked flags and raises on the first False, naming op and var."""
+    for slot in op.output_slots():
+        for n in op.output(slot):
+            v = env.get(n)
+            dt = getattr(v, "dtype", None)
+            if dt is not None and jnp.issubdtype(dt, jnp.floating):
+                checks.append((op.type, n, jnp.all(jnp.isfinite(v))))
 
 
 def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
@@ -204,6 +238,19 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
         raise NotImplementedError(
             "gradient accumulation slices feeds on the batch dim and "
             "cannot split LoD (ragged) feeds")
+    if accum_k > 1:
+        batch_dims = {n: (s.shape[0] if s.shape else None)
+                      for n, s in feed_sig.items()}
+        sizes = set(batch_dims.values())
+        if len(sizes) != 1 or None in sizes:
+            raise EnforceNotMet(
+                f"gradient_accumulation_steps={accum_k} requires every "
+                f"feed to share one leading batch dim; got {batch_dims}")
+        (b,) = sizes
+        if b % accum_k != 0:
+            raise EnforceNotMet(
+                f"batch size {b} is not divisible by "
+                f"gradient_accumulation_steps={accum_k}")
 
     def _run_whole(env, rng_ctx, lod_env):
         def block_runner(idx, sub_env=None):
@@ -242,12 +289,8 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
             env = _TrackingDict()
             env.update(params)
             for n, arr in feeds.items():
-                if getattr(arr, "shape", None) and \
-                        arr.shape[0] % accum_k == 0:
-                    sz = arr.shape[0] // accum_k
-                    env[n] = arr[i * sz:(i + 1) * sz]
-                else:
-                    env[n] = arr
+                sz = arr.shape[0] // accum_k  # validated above
+                env[n] = arr[i * sz:(i + 1) * sz]
             rng_ctx = _Rng(jax.random.fold_in(key, i))
 
             def block_runner(idx, sub_env=None):
@@ -289,16 +332,30 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
                       ops=opt_ops)
         return env
 
+    check_nan = bool(FLAGS.check_nan_inf)
+    nan_labels_box: List[Tuple[str, str]] = []
+
     def step(params, feeds, key):
         lod_env = {k: [list(l) for l in v] for k, v in feed_lods.items()}
         rng_ctx = _Rng(key)
-        if accum_k > 1:
-            env = _run_accumulated(params, feeds, key)
-        else:
-            env = _TrackingDict()
-            env.update(params)
-            env.update(feeds)
-            env = _run_whole(env, rng_ctx, lod_env)
+        if check_nan:
+            _nan_check_ctx.items = []
+        try:
+            if accum_k > 1:
+                env = _run_accumulated(params, feeds, key)
+            else:
+                env = _TrackingDict()
+                env.update(params)
+                env.update(feeds)
+                env = _run_whole(env, rng_ctx, lod_env)
+        finally:
+            checks = getattr(_nan_check_ctx, "items", None)
+            _nan_check_ctx.items = None
+        nan_flags = ()
+        if check_nan and checks:
+            nan_labels_box.clear()
+            nan_labels_box.extend((t, n) for t, n, _ in checks)
+            nan_flags = jnp.stack([f for _, _, f in checks])
 
         updated = sorted(n for n in env.written if n in persistable_all)
         updated_box.clear()
@@ -312,7 +369,7 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
                 raise KeyError(
                     f"fetch target {n!r} was not produced by the program")
             fetches.append(env[n])
-        return tuple(fetches), {n: env[n] for n in updated}
+        return tuple(fetches), {n: env[n] for n in updated}, nan_flags
 
     # --- phase 1: abstract trace to discover updated persistables ---------
     params_sig = {}
@@ -336,7 +393,8 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
             return step(params, feeds, key)
 
         return TracedStep(eager_fn, [], avail, sorted(feed_sig),
-                          list(fetch_names), [], fetch_lod_box, True)
+                          list(fetch_names), [], fetch_lod_box, True,
+                          nan_check_labels=tuple(nan_labels_box))
     updated_names = list(updated_box)
     donated = [n for n in avail if n in updated_names]
     const = [n for n in avail if n not in updated_names]
@@ -378,7 +436,8 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
                         repl)
         # fetches replicated; updated persistables keep their sharding
         out_shardings = (tuple(repl for _ in fetch_names),
-                         {n: param_sh(n) for n in updated_names})
+                         {n: param_sh(n) for n in updated_names},
+                         repl)
         fn = jax.jit(step2, donate_argnums=(0,),
                      in_shardings=in_shardings,
                      out_shardings=out_shardings)
@@ -386,7 +445,8 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
         fn = jax.jit(step2, donate_argnums=(0,))
     return TracedStep(fn, donated, const, sorted(feed_sig),
                       list(fetch_names), updated_names,
-                      fetch_lod_box, uses_rng_box[0])
+                      fetch_lod_box, uses_rng_box[0],
+                      nan_check_labels=tuple(nan_labels_box))
 
 
 class Engine:
@@ -429,7 +489,9 @@ class Engine:
         arrays, lods, feed_sig_key = self._normalize_feed(
             feed, None if self.mesh is not None else place)
         key = (program.fingerprint, block_idx, feed_sig_key,
-               tuple(fetch_names))
+               tuple(fetch_names), bool(FLAGS.check_nan_inf),
+               int(getattr(program, "_gradient_accumulation_steps", 1)
+                   or 1))
         traced = self._cache.get(key)
         if traced is None:
             feed_sig = {n: jax.ShapeDtypeStruct(a.shape, a.dtype)
@@ -449,11 +511,25 @@ class Engine:
 
         rng_key = _get_rng_state(scope, program)
         step_key, next_state = jax.random.split(rng_key)
-        fetches, updated = traced.fn(donated_params, const_params, arrays,
-                                     step_key)
+        t0 = time.perf_counter() if FLAGS.benchmark else None
+        fetches, updated, nan_flags = traced.fn(
+            donated_params, const_params, arrays, step_key)
         _set_rng_state(scope, next_state)
         for n, v in updated.items():
             scope.var(n).set_value(v)
+        if traced.nan_check_labels:
+            flags_host = np.asarray(nan_flags)
+            if not flags_host.all():
+                bad = int(np.argmin(flags_host))
+                op_type, var = traced.nan_check_labels[bad]
+                raise EnforceNotMet(
+                    f"Operator {op_type!r} output {var!r} contains NaN or "
+                    f"Inf (FLAGS_check_nan_inf; reference "
+                    f"operator.cc:953-983)", op_type=op_type)
+        if t0 is not None:
+            jax.block_until_ready(fetches)
+            print(f"[FLAGS_benchmark] step {time.perf_counter() - t0:.6f}s "
+                  f"program={program.fingerprint}")
 
         out = []
         for n, v in zip(traced.fetch_names, fetches):
@@ -474,7 +550,7 @@ def _scope_array(scope: Scope, name: str):
 def _get_rng_state(scope: Scope, program):
     v = scope.find_var(RNG_STATE_VAR)
     if v is None or not v.is_initialized():
-        seed = getattr(program, "_seed", 0) or 0
+        seed = getattr(program, "_seed", 0) or FLAGS.seed or 0
         state = jax.random.PRNGKey(seed)
         scope.var(RNG_STATE_VAR).set_value(state)
         return state

@@ -1,0 +1,157 @@
+"""Runtime flag system (gflags parity).
+
+The reference defines ~40 ``DEFINE_*`` gflags scattered across C++ modules
+(SURVEY Appendix C) and surfaces them to Python via env vars read in
+``python/paddle/fluid/__init__.py:124-221`` (``__bootstrap__`` →
+``core.init_gflags``). The TPU build keeps the same contract — every flag
+has a default here, ``FLAGS_<name>`` environment variables override it at
+import time, and ``get_flags``/``set_flags`` read/write at runtime — but
+the flag *set* is honest about what the XLA runtime subsumes:
+
+* flags with live behavior in this framework are marked ``live=True``
+  (e.g. ``check_nan_inf`` instruments every traced op,
+  ``benchmark`` forces per-step device sync + timing logs);
+* reference flags whose job XLA/PJRT performs automatically (allocator
+  tuning, eager deletion, cudnn knobs …) are registered ``live=False`` so
+  user programs that set them keep working, and ``flag_info()`` reports
+  exactly which category a flag is in. Setting an *unknown* flag raises —
+  silently accepting typos is how inert knobs are born.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict
+
+__all__ = ["get_flags", "set_flags", "flag_info", "Flag", "FLAGS"]
+
+
+class Flag:
+    __slots__ = ("name", "default", "type", "live", "help")
+
+    def __init__(self, name: str, default, live: bool, help: str = ""):
+        self.name = name
+        self.default = default
+        self.type = type(default)
+        self.live = live
+        self.help = help
+
+
+_REGISTRY: Dict[str, Flag] = {}
+_VALUES: Dict[str, Any] = {}
+_LOCK = threading.Lock()
+
+
+def _define(name: str, default, live: bool, help: str = ""):
+    _REGISTRY[name] = Flag(name, default, live, help)
+    _VALUES[name] = default
+
+
+# -- live flags: read by this framework's runtime ---------------------------
+_define("check_nan_inf", False, True,
+        "after every traced op, verify float outputs are finite and raise "
+        "EnforceNotMet naming the first offending op/var (reference "
+        "operator.cc:953-983)")
+_define("benchmark", False, True,
+        "block until device ready after every executor step and log step "
+        "latency (reference FLAGS_benchmark per-op sync, operator.cc:949)")
+_define("paddle_num_threads", 2, True,
+        "default reader worker threads for the native data feed")
+_define("seed", 0, True, "global default RNG seed when a Program sets none")
+
+# -- subsumed flags: accepted, validated, no effect under XLA/PJRT ----------
+for _name, _default, _help in [
+    ("eager_delete_tensor_gb", -1.0,
+     "XLA liveness-based freeing is always on"),
+    ("allocator_strategy", "naive_best_fit", "PJRT owns allocation"),
+    ("fraction_of_gpu_memory_to_use", 0.92, "PJRT owns device memory"),
+    ("initial_cpu_memory_in_mb", 500, "host allocator is malloc"),
+    ("fraction_of_cpu_memory_to_use", 1.0, "host allocator is malloc"),
+    ("init_allocated_mem", False, "XLA buffers are always defined"),
+    ("free_idle_memory", False, "PJRT owns freeing"),
+    ("fast_eager_deletion_mode", True, "XLA liveness subsumes GC"),
+    ("memory_fraction_of_eager_deletion", 1.0, "XLA liveness subsumes GC"),
+    ("use_pinned_memory", True, "PJRT owns host staging"),
+    ("use_mkldnn", False, "single XLA backend"),
+    ("use_ngraph", False, "single XLA backend"),
+    ("cudnn_deterministic", False, "XLA determinism instead"),
+    ("cudnn_exhaustive_search", False, "XLA autotuning instead"),
+    ("conv_workspace_size_limit", 4096, "XLA autotuning instead"),
+    ("cudnn_batchnorm_spatial_persistent", False, "XLA fusion instead"),
+    ("sync_nccl_allreduce", True, "XLA collectives are ordered"),
+    ("enable_parallel_graph", False, "SPMD partitioner instead"),
+    ("fuse_parameter_memory_size", -1, "XLA fusion instead"),
+    ("inner_op_parallelism", 0, "XLA runtime owns threading"),
+    ("rpc_deadline", 180000, "no RPC runtime (pserver->collective)"),
+    ("dist_threadpool_size", 0, "no RPC runtime (pserver->collective)"),
+]:
+    _define(_name, _default, False, "subsumed: " + _help)
+
+
+def _coerce(flag: Flag, value):
+    if flag.type is bool:
+        if isinstance(value, str):
+            return value.strip().lower() in ("1", "true", "yes", "on")
+        return bool(value)
+    return flag.type(value)
+
+
+def set_flags(flags: Dict[str, Any]):
+    """Set flags by name (``{"FLAGS_check_nan_inf": True}`` or bare name)."""
+    with _LOCK:
+        for raw, value in flags.items():
+            name = raw[6:] if raw.startswith("FLAGS_") else raw
+            flag = _REGISTRY.get(name)
+            if flag is None:
+                raise ValueError(
+                    f"unknown flag {raw!r}; known flags: "
+                    f"{sorted(_REGISTRY)}")
+            _VALUES[name] = _coerce(flag, value)
+
+
+def get_flags(names) -> Dict[str, Any]:
+    if isinstance(names, str):
+        names = [names]
+    out = {}
+    for raw in names:
+        name = raw[6:] if raw.startswith("FLAGS_") else raw
+        if name not in _REGISTRY:
+            raise ValueError(f"unknown flag {raw!r}")
+        out["FLAGS_" + name] = _VALUES[name]
+    return out
+
+
+def flag_info(name: str) -> Flag:
+    name = name[6:] if name.startswith("FLAGS_") else name
+    return _REGISTRY[name]
+
+
+class _FlagsView:
+    """Attribute access used by runtime code: ``FLAGS.check_nan_inf``."""
+
+    def __getattr__(self, name):
+        try:
+            return _VALUES[name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+
+FLAGS = _FlagsView()
+
+
+def __bootstrap__():
+    """Read FLAGS_* env vars once at import (reference __init__.py:124-221).
+
+    Unknown FLAGS_* env vars are ignored (the environment is shared with
+    other processes), unlike set_flags which raises on typos.
+    """
+    for env_name, value in os.environ.items():
+        if not env_name.startswith("FLAGS_"):
+            continue
+        name = env_name[6:]
+        flag = _REGISTRY.get(name)
+        if flag is not None:
+            _VALUES[name] = _coerce(flag, value)
+
+
+__bootstrap__()

@@ -14,6 +14,7 @@ Parity: reference python/paddle/fluid/framework.py (Program :2782, Block
 from __future__ import annotations
 
 import contextlib
+import itertools
 import threading
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -572,10 +573,16 @@ class Program:
     Maintains a version counter used by the executor's compile cache.
     """
 
+    # monotonically increasing program ids: id(self) can be reused after a
+    # Program is GC'd, which would let a stale Engine cache entry collide
+    # with a fresh Program of the same CPython address.
+    _next_program_uid = itertools.count()
+
     def __init__(self):
         self.blocks: List[Block] = [Block(self, 0)]
         self.current_block_idx = 0
         self._seed = 0
+        self._uid = next(Program._next_program_uid)
         self._version = 0
         self._is_test = False
         self.op_role = "forward"
@@ -588,7 +595,7 @@ class Program:
 
     @property
     def fingerprint(self):
-        return (id(self), self._version)
+        return (self._uid, self._version)
 
     # -- blocks -------------------------------------------------------------
     def global_block(self) -> Block:
@@ -629,6 +636,27 @@ class Program:
     def clone(self, for_test: bool = False) -> "Program":
         p = Program.from_proto(self.to_proto())
         p._seed = self._seed
+        # the proto schema has no parameter flag (same as the reference's
+        # framework.proto), so the round-trip demotes Parameters to plain
+        # Variables; restore the subclass so all_parameters() and passes
+        # that key off parameter-ness work on clones (the reference clone
+        # copies parameter info explicitly, framework.py:2881)
+        for sb, db in zip(self.blocks, p.blocks):
+            for name, v in sb.vars.items():
+                if isinstance(v, Parameter) and name in db.vars:
+                    old = db.vars[name]
+                    param = Parameter(
+                        db, shape=old.shape, dtype=old.dtype, name=name,
+                        lod_level=old.lod_level,
+                        persistable=old.persistable,
+                        trainable=v.trainable,
+                        optimize_attr=dict(v.optimize_attr),
+                        regularizer=v.regularizer,
+                        gradient_clip_attr=v.gradient_clip_attr,
+                        do_model_average=v.do_model_average)
+                    param.kind = old.kind
+                    param.dim_sharding = list(old.dim_sharding)
+                    db.vars[name] = param
         if for_test:
             p._is_test = True
             for b in p.blocks:

@@ -26,8 +26,16 @@ class DataParallelEngine:
     def __init__(self, program, build_strategy=None, places=None,
                  data_axis: str = "dp"):
         self._program = program
-        ndev = len(places) if places else len(jax.devices())
-        self.mesh = make_mesh({data_axis: ndev})
+        devices = None
+        if places:
+            # honor the executor's device platform: an Executor(CPUPlace)
+            # with_data_parallel must mesh over CPU devices even when the
+            # process default backend is TPU (mixing platforms between
+            # feed placement and mesh shardings is a hard error in jax)
+            devices = [p.jax_device() if hasattr(p, "jax_device") else p
+                       for p in places]
+        self.mesh = make_mesh({data_axis: len(devices)} if devices
+                              else None, devices=devices)
         self._engine = Engine(mesh=self.mesh, data_axis=data_axis)
 
     @property

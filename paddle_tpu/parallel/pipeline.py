@@ -113,6 +113,120 @@ class PipelineEngine:
                     produced.update(op.output(slot))
         return stage_feeds
 
+    def _plan_stacking(self, stages, params0, opt_state0, opt_ops):
+        """Group stage-exclusive params into stacked slots.
+
+        Slot j = one param per stage, aligned by per-stage name order,
+        with identical shape/dtype and the same elementwise update rule.
+        Returns (slots, stacked0) where stacked0 maps "p{j}" ->
+        [n_stages, ...] array and "s{j}.{StateSlot}" -> stacked optimizer
+        state. Params that don't align stay replicated (not in any slot).
+        """
+        from ..core.registry import OP_UID_ATTR
+        n_stages = self.n_stages
+        users: Dict[str, set] = {}
+        for s, ops_s in enumerate(stages):
+            for op in ops_s:
+                for slot in op.input_slots():
+                    for n in op.input(slot):
+                        if n in params0:
+                            users.setdefault(n, set()).add(s)
+        exclusive = [sorted(n for n, ss in users.items() if ss == {s})
+                     for s in range(n_stages)]
+        if not exclusive[0] or \
+                any(len(e) != len(exclusive[0]) for e in exclusive):
+            return [], {}
+
+        def _update_op(pname):
+            for op in opt_ops:
+                if "Param" in op.input_slots() and \
+                        op.input("Param") == [pname]:
+                    return op
+            return None
+
+        def _touched_by_other_ops(pname, uop):
+            """True if any opt op besides the update rule reads/writes
+            this param or its grad (grad clip, weight decay, ...): those
+            run in the generic env, which never holds stacked members'
+            grads — such params must stay replicated."""
+            targets = {pname, pname + "@GRAD"}
+            for op in opt_ops:
+                if op is uop:
+                    continue
+                for sl in op.input_slots():
+                    if targets & set(op.input(sl)):
+                        return True
+                for sl in op.output_slots():
+                    if targets & set(op.output(sl)):
+                        return True
+            return False
+
+        def _attr_sig(op):
+            return tuple(sorted(
+                (k, repr(v)) for k, v in op._attrs.items()
+                if k != OP_UID_ATTR))
+
+        slots, stacked0 = [], {}
+        for j in range(len(exclusive[0])):
+            names = [exclusive[s][j] for s in range(n_stages)]
+            vals = [params0[n] for n in names]
+            uops = [_update_op(n) for n in names]
+            if any(o is None for o in uops):
+                continue
+            if uops[0].type not in _ELEMENTWISE_UPDATE_OPS:
+                continue
+            if any(o.type != uops[0].type or
+                   _attr_sig(o) != _attr_sig(uops[0]) for o in uops):
+                continue
+            if any(v.shape != vals[0].shape or v.dtype != vals[0].dtype
+                   for v in vals):
+                continue
+            if any(_touched_by_other_ops(n, o)
+                   for n, o in zip(names, uops)):
+                continue
+            # per-stage optimizer state = input slots whose var names
+            # differ across members (shared vars like LearningRate keep
+            # one name for every member and stay replicated). Scalar-size
+            # accumulators (adam's beta1_pow_acc, shape [1]) cannot be
+            # stacked — their lowering squeezes to a scalar — but evolve
+            # identically on every stage, so they become "broadcast"
+            # state: the update runs on member 0's value and is written
+            # back to every member.
+            state: Dict[str, List[str]] = {}
+            bcast_state: Dict[str, List[str]] = {}
+            ok = True
+            for sl in uops[0].input_slots():
+                if sl in ("Param", "Grad") or not uops[0].input(sl):
+                    continue
+                snames = [o.input(sl)[0] if o.input(sl) else None
+                          for o in uops]
+                if any(n is None for n in snames):
+                    ok = False
+                    break
+                if len(set(snames)) == 1:
+                    continue  # shared (LearningRate)
+                svals = [opt_state0.get(n) for n in snames]
+                if any(v is None for v in svals) or \
+                        any(v.shape != svals[0].shape or
+                            v.dtype != svals[0].dtype for v in svals):
+                    ok = False
+                    break
+                if int(np.prod(svals[0].shape)) == 1:
+                    bcast_state[sl] = snames
+                else:
+                    state[sl] = snames
+            if not ok:
+                continue
+            k = len(slots)
+            stacked0[f"p{k}"] = jnp.stack(vals)
+            for sl, snames in state.items():
+                stacked0[f"s{k}.{sl}"] = jnp.stack(
+                    [opt_state0[n] for n in snames])
+            slots.append({"names": names, "state": state,
+                          "bcast_state": bcast_state,
+                          "rep_op": uops[0], "member_ops": uops})
+        return slots, stacked0
+
     # -- public run ---------------------------------------------------------
     def run(self, scope: Scope, feed: Dict[str, np.ndarray]):
         """One pipelined training step over the global batch `feed`
@@ -189,6 +303,19 @@ class PipelineEngine:
                                      set(params0), set(feed_names))
         cut_in = [None] + self.cut_vars  # stage s>0 reads cut_in[s]
 
+        # ---- per-stage param placement: stack stage-exclusive params ------
+        slots, stacked0 = self._plan_stacking(
+            stages, params0, opt_state0, opt_ops_all)
+        stacked_param_names = {n for sl in slots for n in sl["names"]}
+        stacked_state_names = {n for sl in slots
+                               for names in sl["state"].values()
+                               for n in names}
+        for n in stacked_param_names:
+            params0.pop(n, None)
+        for n in stacked_state_names:
+            opt_state0.pop(n, None)
+        self._stacked_slots = slots
+
         def run_stage(s, params, env):
             rng = _RngCtx(jax.random.PRNGKey(0))
 
@@ -215,9 +342,21 @@ class PipelineEngine:
                 return act_in * 0.0, env[loss_name]
             return env[self.cut_vars[s]], jnp.zeros((), jnp.float32)
 
-        def per_device(params, micro_feeds):
-            """shard_map body over pp axis. micro_feeds: name -> [M, ...]
-            (replicated). Returns mean loss (psum'd from last stage)."""
+        slots = self._stacked_slots
+
+        def per_device(stacked_local, params, micro_feeds):
+            """shard_map body over pp axis. stacked_local: "p{j}" ->
+            [1, ...] this device's stage slice of slot j. micro_feeds:
+            name -> [M, ...] (replicated). Returns mean loss (psum'd
+            from last stage)."""
+            # bind the local slice to every member name: branch s (the
+            # only one executed on device s) reads its own stage's param
+            local = {}
+            for j, sl in enumerate(slots):
+                pj = stacked_local[f"p{j}"][0]
+                for n in sl["names"]:
+                    local[n] = pj
+            params = {**params, **local}
             stage = lax.axis_index(axis)
             T = n_micro + n_stages - 1
             # activation buffer shape = cut var shape for microbatch
@@ -250,35 +389,94 @@ class PipelineEngine:
 
         mesh = self.mesh
         repl = P()
+        ax_spec = P(axis)
 
         smapped = shard_map(
             per_device, mesh=mesh,
-            in_specs=(repl, repl), out_specs=repl,
+            in_specs=(ax_spec, repl, repl), out_specs=repl,
             check_vma=False)
 
-        def loss_fn(params, state, micro_feeds):
+        def loss_fn(stacked, params, state, micro_feeds):
             merged = dict(state)
             merged.update(params)
-            return smapped(merged, micro_feeds)
+            return smapped(stacked, merged, micro_feeds)
 
         opt_ops = opt_ops_all
+        first_member = {id(sl["member_ops"][0]): j
+                        for j, sl in enumerate(slots)}
+        other_members = {id(o) for sl in slots
+                         for o in sl["member_ops"][1:]}
 
-        def step(params, opt_state, micro_feeds):
-            loss, grads = jax.value_and_grad(loss_fn)(
-                params, opt_state, micro_feeds)
+        def step(stacked, params, opt_state, micro_feeds):
+            loss, (g_stacked, g_params) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1))(stacked, params, opt_state,
+                                         micro_feeds)
             env = dict(params)
             env.update(opt_state)
-            for pname, g in grads.items():
+            for pname, g in g_params.items():
                 env[pname + "@GRAD"] = g
+            new_stacked = dict(stacked)
             rng = _RngCtx(jax.random.PRNGKey(0))
             for op in opt_ops:
-                info = OPS.get(op.type)
-                info.lowering(ExecContext(op, env, rng, None, {}))
+                oid = id(op)
+                if oid in other_members:
+                    continue  # whole slot updated by its first member
+                j = first_member.get(oid)
+                if j is None:
+                    info = OPS.get(op.type)
+                    info.lowering(ExecContext(op, env, rng, None, {}))
+                    continue
+                # run the slot's elementwise update rule ONCE on the
+                # [n_stages, ...]-stacked param/grad/state so everything
+                # stays sharded over the pp axis end to end
+                sl = slots[j]
+                op0 = sl["rep_op"]
+                env_j = {}
+                pname = op0.input("Param")[0]
+                gname = op0.input("Grad")[0]
+                env_j[pname] = new_stacked[f"p{j}"]
+                env_j[gname] = g_stacked[f"p{j}"]
+                for s_slot, snames in sl["state"].items():
+                    env_j[snames[0]] = new_stacked[f"s{j}.{s_slot}"]
+                for in_slot in op0.input_slots():
+                    for n in op0.input(in_slot):
+                        if n not in env_j:
+                            env_j[n] = env[n]  # shared (LearningRate)
+                info = OPS.get(op0.type)
+                info.lowering(ExecContext(op0, env_j, rng, None, {}))
+                new_stacked[f"p{j}"] = env_j[op0.output("ParamOut")[0]]
+                for s_slot, snames in sl["state"].items():
+                    out_slot = s_slot + "Out"
+                    if out_slot in op0.output_slots() and \
+                            op0.output(out_slot):
+                        new_stacked[f"s{j}.{s_slot}"] = \
+                            env_j[op0.output(out_slot)[0]]
+                    else:
+                        new_stacked[f"s{j}.{s_slot}"] = env_j[snames[0]]
+                for s_slot, snames in sl["bcast_state"].items():
+                    out_slot = s_slot + "Out"
+                    if out_slot in op0.output_slots() and \
+                            op0.output(out_slot):
+                        new_val = env_j[op0.output(out_slot)[0]]
+                    else:
+                        new_val = env_j[snames[0]]
+                    for n in snames:  # every stage's copy advances
+                        env[n] = new_val
             new_params = {n: env[n] for n in params}
             new_state = {n: env[n] for n in opt_state}
-            return loss, new_params, new_state
+            return loss, new_stacked, new_params, new_state
 
-        self._step_fn = jax.jit(step, donate_argnums=(0, 1))
+        if mesh is not None:
+            sh = NamedSharding(mesh, ax_spec)
+            rsh = NamedSharding(mesh, repl)
+            self._step_fn = jax.jit(
+                step, donate_argnums=(0, 1, 2),
+                in_shardings=(sh, rsh, rsh, rsh),
+                out_shardings=(rsh, sh, rsh, rsh))
+            stacked0 = jax.device_put(stacked0, sh) if stacked0 else {}
+        else:
+            self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2))
+        self._stacked = stacked0
         return params0, opt_state0
 
     def __repr__(self):

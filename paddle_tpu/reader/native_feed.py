@@ -109,8 +109,11 @@ class NativeDataFeeder:
     Yields dicts name -> np.ndarray batched on a new leading dim."""
 
     def __init__(self, files: List[str], slot_names: Sequence[str],
-                 batch_size: int, n_threads: int = 2,
+                 batch_size: int, n_threads: int = None,
                  queue_capacity: int = 8):
+        if n_threads is None:
+            from ..core.flags import FLAGS
+            n_threads = int(FLAGS.paddle_num_threads)
         self._lib = get_lib()
         arr = (ctypes.c_char_p * len(files))(
             *[f.encode() for f in files])

@@ -120,3 +120,36 @@ def test_pipeline_adam_state_updates():
         assert m1 is not None
         assert float(np.abs(np.asarray(m1.get_value())).max()) > 0
     assert losses[-1] < losses[0]
+
+
+def test_pipeline_params_sharded_per_stage():
+    """VERDICT r1 item 4 'done' criterion: per-device param (+ optimizer
+    state) memory ~ 1/n_stages — stage-exclusive params are stacked into
+    [n_stages, ...] arrays laid out P("pp"), so each device holds exactly
+    its own stage's slice."""
+    main, startup, loss, cut_names = _build()
+    opt = fluid.optimizer.PipelineOptimizer(
+        fluid.optimizer.AdamOptimizer(learning_rate=0.01),
+        cut_list=cut_names, num_microbatches=2)
+    with fluid.program_guard(main, startup):
+        opt.minimize(loss, startup_program=startup)
+    mesh = Mesh(np.array(jax.devices()[:4]), ("pp",))
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        eng = PipelineEngine(main, loss.name, cut_names,
+                             optimizer_program=opt.opt_program,
+                             mesh=mesh, num_microbatches=2)
+        eng.run(scope, _batch(np.random.default_rng(0)))
+    # all 8 fc params (4 stages x w+b) were stacked, none replicated
+    assert len(eng._stacked_slots) == 2  # one slot for w, one for b
+    assert not any(n.startswith("pfc_") for n in eng._params)
+    n_stages = 4
+    for k, arr in eng._stacked.items():
+        assert arr.shape[0] == n_stages
+        # each device's addressable slice covers exactly one stage
+        for shard in arr.addressable_shards:
+            assert shard.data.shape[0] == arr.shape[0] // n_stages
+    # adam moments are stacked state sharded the same way
+    assert any(k.startswith("s0.") for k in eng._stacked)
