@@ -1,0 +1,4 @@
+"""contrib.slim: model compression (reference
+python/paddle/fluid/contrib/slim/) — quantization-aware training first;
+the reference's pruning/distillation/NAS live here too as they land."""
+from . import quantization  # noqa: F401

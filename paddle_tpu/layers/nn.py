@@ -46,6 +46,7 @@ __all__ = [
     "cos", "sin", "round", "reciprocal", "square", "hard_shrink",
     "softshrink", "thresholded_relu", "stanh",
     "beam_search", "beam_search_decode",
+    "roi_align", "roi_pool", "psroi_pool",
 ]
 
 
@@ -1219,3 +1220,48 @@ def beam_search_decode(ids, scores, parent_idx, beam_size, end_id,
         attrs={"beam_size": beam_size, "end_id": end_id},
         infer_shape=False)
     return sent_ids, sent_scores
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, name=None):
+    """Reference nn.py roi_align over operators/roi_align_op."""
+    helper = LayerHelper("roi_align", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "roi_align", inputs={"X": input, "ROIs": rois},
+        outputs={"Out": out},
+        attrs={"pooled_height": pooled_height,
+               "pooled_width": pooled_width,
+               "spatial_scale": spatial_scale,
+               "sampling_ratio": sampling_ratio})
+    return out
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, name=None):
+    """Reference nn.py roi_pool over operators/roi_pool_op."""
+    helper = LayerHelper("roi_pool", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    argmax = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        "roi_pool", inputs={"X": input, "ROIs": rois},
+        outputs={"Out": out, "Argmax": argmax},
+        attrs={"pooled_height": pooled_height,
+               "pooled_width": pooled_width,
+               "spatial_scale": spatial_scale})
+    return out
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale,
+               pooled_height, pooled_width, name=None):
+    """Reference nn.py psroi_pool over operators/psroi_pool_op."""
+    helper = LayerHelper("psroi_pool", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "psroi_pool", inputs={"X": input, "ROIs": rois},
+        outputs={"Out": out},
+        attrs={"output_channels": output_channels,
+               "spatial_scale": spatial_scale,
+               "pooled_height": pooled_height,
+               "pooled_width": pooled_width})
+    return out

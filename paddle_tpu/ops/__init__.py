@@ -23,3 +23,5 @@ from . import distributed_ops  # noqa: F401
 from . import rnn  # noqa: F401
 from . import beam_search  # noqa: F401
 from . import nlp  # noqa: F401
+from . import quantize  # noqa: F401
+from . import detection  # noqa: F401

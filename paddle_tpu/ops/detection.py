@@ -1,0 +1,1636 @@
+"""Detection op family (reference paddle/fluid/operators/detection/,
+27 registered ops, ~15.3k LoC CUDA/C++).
+
+TPU-native design notes:
+* Everything is static-shape. Ops whose reference output is dynamically
+  sized (multiclass_nms, generate_proposals) emit fixed-capacity tensors
+  padded with invalid rows (label/index -1) plus exact LoD where the
+  count is host-computable; greedy loops (nms, bipartite matching) are
+  lax.fori_loop masks rather than data-dependent control flow, so the
+  whole family stays inside the compiled step.
+* LoD batches (bipartite_match's DistMat, target_assign's NegIndices,
+  multiclass_nms's per-image boxes) use host-side LoD offsets — static
+  per trace — and unroll over segments.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op, register_no_grad_op
+
+
+# ---------------------------------------------------------------------------
+# shared geometry helpers
+# ---------------------------------------------------------------------------
+
+def _expand_aspect_ratios(ratios, flip):
+    out = [1.0]
+    for ar in ratios:
+        if any(abs(ar - o) < 1e-6 for o in out):
+            continue
+        out.append(float(ar))
+        if flip:
+            out.append(1.0 / float(ar))
+    return out
+
+
+def _pairwise_iou(a, b, normalized=True):
+    """IoU matrix [N, M] (reference iou_similarity_op.h IOUSimilarity)."""
+    off = 0.0 if normalized else 1.0
+    area_a = (a[:, 2] - a[:, 0] + off) * (a[:, 3] - a[:, 1] + off)
+    area_b = (b[:, 2] - b[:, 0] + off) * (b[:, 3] - b[:, 1] + off)
+    ix1 = jnp.maximum(a[:, None, 0], b[None, :, 0])
+    iy1 = jnp.maximum(a[:, None, 1], b[None, :, 1])
+    ix2 = jnp.minimum(a[:, None, 2], b[None, :, 2])
+    iy2 = jnp.minimum(a[:, None, 3], b[None, :, 3])
+    iw = jnp.maximum(ix2 - ix1 + off, 0.0)
+    ih = jnp.maximum(iy2 - iy1 + off, 0.0)
+    inter = iw * ih
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _lod_segments(lod, n_rows):
+    """Level-1 offsets -> [(start, end)]; default one segment."""
+    if lod:
+        offs = lod[0]
+        return list(zip(offs[:-1], offs[1:]))
+    return [(0, n_rows)]
+
+
+# ---------------------------------------------------------------------------
+# priors / anchors
+# ---------------------------------------------------------------------------
+
+@register_no_grad_op("prior_box")
+def prior_box(ctx):
+    """SSD priors (reference detection/prior_box_op.h:60-170)."""
+    feat = ctx.input("Input")
+    image = ctx.input("Image")
+    min_sizes = [float(s) for s in ctx.attr("min_sizes")]
+    max_sizes = [float(s) for s in ctx.attr("max_sizes", []) or []]
+    ars = _expand_aspect_ratios(ctx.attr("aspect_ratios", [1.0]),
+                                ctx.attr("flip", False))
+    variances = ctx.attr("variances", [0.1, 0.1, 0.2, 0.2])
+    clip = ctx.attr("clip", False)
+    mm_order = ctx.attr("min_max_aspect_ratios_order", False)
+    step_w = ctx.attr("step_w", 0.0)
+    step_h = ctx.attr("step_h", 0.0)
+    offset = ctx.attr("offset", 0.5)
+
+    img_h, img_w = image.shape[2], image.shape[3]
+    fh, fw = feat.shape[2], feat.shape[3]
+    sw = step_w or img_w / fw
+    sh = step_h or img_h / fh
+
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * sw     # [fw]
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * sh     # [fh]
+    # per-cell prior half-extents, ordered exactly like the reference
+    half = []
+    for s, mn in enumerate(min_sizes):
+        per_min = []
+        for ar in ars:
+            if mm_order and abs(ar - 1.0) < 1e-6:
+                continue
+            per_min.append((mn * math.sqrt(ar) / 2.0,
+                            mn / math.sqrt(ar) / 2.0))
+        sq = []
+        if max_sizes:
+            d = math.sqrt(mn * max_sizes[s]) / 2.0
+            sq.append((d, d))
+        if mm_order:
+            half.extend([(mn / 2.0, mn / 2.0)] + sq + per_min)
+        else:
+            half.extend(per_min + sq)
+    half = jnp.asarray(half, jnp.float32)                      # [P, 2]
+    P = half.shape[0]
+
+    cxg = jnp.broadcast_to(cx[None, :, None], (fh, fw, P))
+    cyg = jnp.broadcast_to(cy[:, None, None], (fh, fw, P))
+    hw = jnp.broadcast_to(half[None, None, :, 0], (fh, fw, P))
+    hh = jnp.broadcast_to(half[None, None, :, 1], (fh, fw, P))
+    boxes = jnp.stack([(cxg - hw) / img_w, (cyg - hh) / img_h,
+                       (cxg + hw) / img_w, (cyg + hh) / img_h], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    vars_ = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                             (fh, fw, P, 4))
+    ctx.set_output("Boxes", boxes)
+    ctx.set_output("Variances", vars_)
+
+
+@register_no_grad_op("density_prior_box")
+def density_prior_box(ctx):
+    """Densified priors (reference density_prior_box_op.h): for each
+    (fixed_size, density) pair, a density x density grid of shifted
+    square priors of fixed_size * ratio per fixed_ratio."""
+    feat = ctx.input("Input")
+    image = ctx.input("Image")
+    fixed_sizes = [float(s) for s in ctx.attr("fixed_sizes", [])]
+    fixed_ratios = [float(r) for r in ctx.attr("fixed_ratios", [])]
+    densities = [int(d) for d in ctx.attr("densities", [])]
+    variances = ctx.attr("variances", [0.1, 0.1, 0.2, 0.2])
+    clip = ctx.attr("clip", False)
+    step_w = ctx.attr("step_w", 0.0)
+    step_h = ctx.attr("step_h", 0.0)
+    offset = ctx.attr("offset", 0.5)
+
+    img_h, img_w = image.shape[2], image.shape[3]
+    fh, fw = feat.shape[2], feat.shape[3]
+    sw = step_w or img_w / fw
+    sh = step_h or img_h / fh
+
+    # per-cell (dx, dy, half_w, half_h) in pixels relative to cell center
+    entries = []
+    for k, fs in enumerate(fixed_sizes):
+        density = densities[k]
+        shift = int(sw / density)  # reference uses int step_average/density
+        for ar in fixed_ratios:
+            box_w = fs * math.sqrt(ar)
+            box_h = fs / math.sqrt(ar)
+            for di in range(density):
+                for dj in range(density):
+                    cx_off = -sw / 2.0 + shift / 2.0 + dj * shift
+                    cy_off = -sh / 2.0 + shift / 2.0 + di * shift
+                    entries.append((cx_off, cy_off, box_w / 2.0,
+                                    box_h / 2.0))
+    ent = jnp.asarray(entries, jnp.float32)                    # [P, 4]
+    P = ent.shape[0]
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * sw
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * sh
+    cxg = cx[None, :, None] + ent[None, None, :, 0]
+    cyg = cy[:, None, None] + ent[None, None, :, 1]
+    cxg = jnp.broadcast_to(cxg, (fh, fw, P))
+    cyg = jnp.broadcast_to(cyg, (fh, fw, P))
+    hw = jnp.broadcast_to(ent[None, None, :, 2], (fh, fw, P))
+    hh = jnp.broadcast_to(ent[None, None, :, 3], (fh, fw, P))
+    boxes = jnp.stack([(cxg - hw) / img_w, (cyg - hh) / img_h,
+                       (cxg + hw) / img_w, (cyg + hh) / img_h], axis=-1)
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    ctx.set_output("Boxes", boxes)
+    ctx.set_output("Variances", jnp.broadcast_to(
+        jnp.asarray(variances, jnp.float32), (fh, fw, P, 4)))
+
+
+@register_no_grad_op("anchor_generator")
+def anchor_generator(ctx):
+    """RCNN anchors (reference anchor_generator_op.h): per cell, for each
+    (scale, aspect_ratio): w = size/sqrt(ar)*scale rounded to the anchor
+    grid centered on the cell."""
+    feat = ctx.input("Input")
+    anchor_sizes = [float(s) for s in ctx.attr("anchor_sizes")]
+    ars = [float(r) for r in ctx.attr("aspect_ratios")]
+    variances = ctx.attr("variances", [0.1, 0.1, 0.2, 0.2])
+    stride = [float(s) for s in ctx.attr("stride")]
+    off = ctx.attr("offset", 0.5)
+    fh, fw = feat.shape[2], feat.shape[3]
+    sw, sh = stride[0], stride[1]
+
+    half = []
+    for ar in ars:
+        for sz in anchor_sizes:
+            area = sw * sh
+            area_ratios = area / ar
+            base_w = round(math.sqrt(area_ratios))
+            base_h = round(base_w * ar)
+            scale_w = sz / sw
+            scale_h = sz / sh
+            w = scale_w * base_w
+            h = scale_h * base_h
+            half.append((w / 2.0, h / 2.0))
+    half = jnp.asarray(half, jnp.float32)
+    P = half.shape[0]
+    cx = (jnp.arange(fw, dtype=jnp.float32) * sw) + off * sw
+    cy = (jnp.arange(fh, dtype=jnp.float32) * sh) + off * sh
+    cxg = jnp.broadcast_to(cx[None, :, None], (fh, fw, P))
+    cyg = jnp.broadcast_to(cy[:, None, None], (fh, fw, P))
+    hw = jnp.broadcast_to(half[None, None, :, 0], (fh, fw, P))
+    hh = jnp.broadcast_to(half[None, None, :, 1], (fh, fw, P))
+    anchors = jnp.stack([cxg - hw, cyg - hh, cxg + hw, cyg + hh],
+                        axis=-1)
+    ctx.set_output("Anchors", anchors)
+    ctx.set_output("Variances", jnp.broadcast_to(
+        jnp.asarray(variances, jnp.float32), (fh, fw, P, 4)))
+
+
+# ---------------------------------------------------------------------------
+# box arithmetic
+# ---------------------------------------------------------------------------
+
+@register_no_grad_op("iou_similarity")
+def iou_similarity(ctx):
+    x, y = ctx.input("X"), ctx.input("Y")
+    normalized = ctx.attr("box_normalized", True)
+    out = _pairwise_iou(x, y, normalized)
+    ctx.set_output("Out", out)
+    lod = ctx.get_lod("X")
+    if lod:
+        ctx.set_lod("Out", lod)
+
+
+@register_op("box_coder", no_grad_slots=("PriorBox", "PriorBoxVar"))
+def box_coder(ctx):
+    """Encode/decode center-size (reference box_coder_op.h:34-200)."""
+    prior = ctx.input("PriorBox")
+    pvar = ctx.input("PriorBoxVar")
+    target = ctx.input("TargetBox")
+    code_type = ctx.attr("code_type", "encode_center_size")
+    normalized = ctx.attr("box_normalized", True)
+    axis = ctx.attr("axis", 0)
+    variance = ctx.attr("variance", [])
+    off = 0.0 if normalized else 1.0
+
+    pw = prior[:, 2] - prior[:, 0] + off
+    ph = prior[:, 3] - prior[:, 1] + off
+    pcx = prior[:, 0] + pw / 2
+    pcy = prior[:, 1] + ph / 2
+
+    if code_type.lower() in ("encode_center_size", "encodecentersize"):
+        tw = target[:, 2] - target[:, 0] + off
+        th = target[:, 3] - target[:, 1] + off
+        tcx = (target[:, 2] + target[:, 0]) / 2
+        tcy = (target[:, 3] + target[:, 1]) / 2
+        ox = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        oy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        ow = jnp.log(jnp.abs(tw[:, None] / pw[None, :]))
+        oh = jnp.log(jnp.abs(th[:, None] / ph[None, :]))
+        out = jnp.stack([ox, oy, ow, oh], axis=-1)      # [N, M, 4]
+        if pvar is not None:
+            out = out / pvar[None, :, :]
+        elif variance:
+            out = out / jnp.asarray(variance, out.dtype)
+    else:  # decode_center_size
+        if axis == 0:
+            pw_b, ph_b = pw[None, :], ph[None, :]
+            pcx_b, pcy_b = pcx[None, :], pcy[None, :]
+            var_b = pvar[None, :, :] if pvar is not None else None
+        else:
+            pw_b, ph_b = pw[:, None], ph[:, None]
+            pcx_b, pcy_b = pcx[:, None], pcy[:, None]
+            var_b = pvar[:, None, :] if pvar is not None else None
+        t = target
+        if var_b is not None:
+            t = t * var_b
+        elif variance:
+            t = t * jnp.asarray(variance, t.dtype)
+        ocx = t[..., 0] * pw_b + pcx_b
+        ocy = t[..., 1] * ph_b + pcy_b
+        ow = jnp.exp(t[..., 2]) * pw_b
+        oh = jnp.exp(t[..., 3]) * ph_b
+        out = jnp.stack([ocx - ow / 2, ocy - oh / 2,
+                         ocx + ow / 2 - off, ocy + oh / 2 - off],
+                        axis=-1)
+    ctx.set_output("OutputBox", out)
+
+
+@register_op("box_clip", no_grad_slots=("ImInfo",))
+def box_clip(ctx):
+    """Clip boxes to image (reference box_clip_op.h): im_info rows are
+    (height, width, scale); boxes live in the scaled image."""
+    boxes = ctx.input("Input")
+    im_info = ctx.input("ImInfo")
+    lod = ctx.get_lod("Input")
+    segs = _lod_segments(lod, boxes.shape[0])
+    outs = []
+    for b, (s, e) in enumerate(segs):
+        h = im_info[b, 0] / im_info[b, 2] - 1
+        w = im_info[b, 1] / im_info[b, 2] - 1
+        seg = boxes[s:e]
+        flat = seg.reshape(-1, 4)
+        x1 = jnp.clip(flat[:, 0], 0, w)
+        y1 = jnp.clip(flat[:, 1], 0, h)
+        x2 = jnp.clip(flat[:, 2], 0, w)
+        y2 = jnp.clip(flat[:, 3], 0, h)
+        outs.append(jnp.stack([x1, y1, x2, y2],
+                              axis=-1).reshape(seg.shape))
+    out = jnp.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
+    ctx.set_output("Output", out)
+    if lod:
+        ctx.set_lod("Output", lod)
+
+
+@register_no_grad_op("bipartite_match")
+def bipartite_match(ctx):
+    """Greedy max bipartite matching per LoD segment (reference
+    bipartite_match_op.cc:59-140): repeatedly take the largest dist
+    among unmatched rows/cols; optional per_prediction argmax fill."""
+    dist = ctx.input("DistMat")
+    match_type = ctx.attr("match_type", "bipartite")
+    overlap_threshold = ctx.attr("dist_threshold", 0.5)
+    lod = ctx.get_lod("DistMat")
+    M = dist.shape[1]
+    segs = _lod_segments(lod, dist.shape[0])
+    idx_rows, dist_rows = [], []
+    for (s, e) in segs:
+        d = dist[s:e]                                    # [R, M]
+        R = e - s
+        eps = 1e-6
+
+        def body(_, st):
+            midx, mdist, row_used = st
+            # mask: unmatched col & unused row & dist > eps
+            m = (d > eps) & (~row_used[:, None]) & (midx[None, :] < 0)
+            flat = jnp.where(m, d, -1.0).reshape(-1)
+            k = jnp.argmax(flat)
+            val = flat[k]
+            i, j = k // M, k % M
+            do = val > 0
+            midx = jnp.where(do, midx.at[j].set(i.astype(jnp.int32)),
+                             midx)
+            mdist = jnp.where(do, mdist.at[j].set(val), mdist)
+            row_used = jnp.where(do, row_used.at[i].set(True), row_used)
+            return midx, mdist, row_used
+
+        midx0 = jnp.full((M,), -1, jnp.int32)
+        mdist0 = jnp.zeros((M,), dist.dtype)
+        used0 = jnp.zeros((R,), bool)
+        midx, mdist, _ = lax.fori_loop(0, min(R, M), body,
+                                       (midx0, mdist0, used0))
+        if match_type == "per_prediction":
+            best_row = jnp.argmax(d, axis=0).astype(jnp.int32)
+            best = jnp.max(d, axis=0)
+            fill = (midx < 0) & (best >= overlap_threshold)
+            midx = jnp.where(fill, best_row, midx)
+            mdist = jnp.where(fill, best, mdist)
+        idx_rows.append(midx)
+        dist_rows.append(mdist)
+    ctx.set_output("ColToRowMatchIndices", jnp.stack(idx_rows))
+    ctx.set_output("ColToRowMatchDist", jnp.stack(dist_rows))
+
+
+@register_no_grad_op("target_assign")
+def target_assign(ctx):
+    """Assign per-prior targets by match indices (reference
+    target_assign_op.h:51-74): with X viewed as LoD [rows, P, K],
+    out[b, w] = X[lod[b] + match[b, w], w % P] where matched, else
+    mismatch_value; optional NegIndices set weights to 1."""
+    x = ctx.input("X")                       # LoD [rows, K] or [rows,P,K]
+    match = ctx.input("MatchIndices")        # [N, M] int32
+    neg = ctx.input("NegIndices")
+    mismatch_value = ctx.attr("mismatch_value", 0)
+    lod = ctx.get_lod("X")
+    N, M = match.shape
+    if x.ndim == 2:
+        x3 = x[:, None, :]                   # P = 1
+    else:
+        x3 = x
+    P, K = x3.shape[1], x3.shape[2]
+    segs = _lod_segments(lod, x.shape[0])
+    outs, wts = [], []
+    w_idx = jnp.arange(M) % P
+    for b, (s, e) in enumerate(segs):
+        seg = x3[s:e]                        # [rows_b, P, K]
+        m = match[b]
+        safe = jnp.clip(m, 0, seg.shape[0] - 1)
+        gathered = seg[safe, w_idx]                   # [M, K]
+        matched = (m >= 0)[:, None]
+        out = jnp.where(matched, gathered,
+                        jnp.asarray(mismatch_value, x.dtype))
+        w = matched.astype(jnp.float32)
+        outs.append(out)
+        wts.append(w)
+    out = jnp.stack(outs)                             # [N, M, K]
+    wt = jnp.stack(wts)                               # [N, M, 1]
+    if neg is not None:
+        neg_lod = ctx.get_lod("NegIndices")
+        nsegs = _lod_segments(neg_lod, neg.shape[0])
+        rows = []
+        for b, (s, e) in enumerate(nsegs):
+            idx = neg[s:e].reshape(-1).astype(jnp.int32)
+            w = wt[b, :, 0]
+            # NegIndices carry -1 padding (mine_hard_examples emits
+            # fixed-size rows); drop-mode keeps them out instead of
+            # wrapping to the last prior
+            w = w.at[jnp.where(idx >= 0, idx, M)].set(1.0, mode="drop")
+            rows.append(w[:, None])
+        wt = jnp.stack(rows)
+    ctx.set_output("Out", out)
+    ctx.set_output("OutWeight", wt)
+
+
+@register_no_grad_op("mine_hard_examples")
+def mine_hard_examples(ctx):
+    """OHEM negative mining (reference mine_hard_examples_op.cc):
+    rank negatives by loss, keep top neg_pos_ratio * num_pos (max_neg
+    mining_type) per instance; emits NegIndices (LoD) and
+    UpdatedMatchIndices with unkept entries already -1."""
+    cls_loss = ctx.input("ClsLoss")          # [N, M]
+    loc_loss = ctx.input("LocLoss")
+    match_indices = ctx.input("MatchIndices")  # [N, M]
+    match_dist = ctx.input("MatchDist")
+    neg_pos_ratio = ctx.attr("neg_pos_ratio", 3.0)
+    neg_dist_threshold = ctx.attr("neg_dist_threshold", 0.5)
+    mining_type = ctx.attr("mining_type", "max_negative")
+    if mining_type != "max_negative":
+        raise NotImplementedError(
+            "mine_hard_examples: only max_negative mining is supported "
+            "(hard_example mining needs sample_size)")
+    loss = cls_loss if loc_loss is None else cls_loss + loc_loss
+    N, M = match_indices.shape
+    neg_rows = []
+    upd = match_indices
+    lod_offsets = [0]
+    for b in range(N):
+        is_neg = (match_indices[b] < 0) & \
+            (match_dist[b] < neg_dist_threshold)
+        num_pos = jnp.sum(match_indices[b] >= 0)
+        num_neg_f = jnp.minimum(
+            (num_pos * neg_pos_ratio).astype(jnp.int32),
+            jnp.sum(is_neg).astype(jnp.int32))
+        scores = jnp.where(is_neg, loss[b], -jnp.inf)
+        order = jnp.argsort(-scores)                   # desc
+        keep = jnp.arange(M) < num_neg_f
+        idx = jnp.where(keep, order, -1)
+        neg_rows.append(idx)
+        lod_offsets.append(lod_offsets[-1] + M)
+    neg = jnp.stack(neg_rows).reshape(-1, 1).astype(jnp.int32)
+    ctx.set_output("NegIndices", neg)
+    ctx.set_lod("NegIndices", [lod_offsets])
+    ctx.set_output("UpdatedMatchIndices", upd)
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+@register_op("sigmoid_focal_loss", no_grad_slots=("Label", "FgNum"))
+def sigmoid_focal_loss(ctx):
+    """Reference sigmoid_focal_loss_op.cu math: per (sample, class),
+    with positive class index label-1 (0 = background)."""
+    x = ctx.input("X")                       # [N, C]
+    label = ctx.input("Label").reshape(-1)   # [N]
+    fg = ctx.input("FgNum").reshape(()).astype(x.dtype)
+    gamma = ctx.attr("gamma", 2.0)
+    alpha = ctx.attr("alpha", 0.25)
+    fg = jnp.maximum(fg, 1.0)
+    C = x.shape[1]
+    c_pos = (label[:, None] - 1) == jnp.arange(C)[None, :]
+    p = jax.nn.sigmoid(x)
+    ce_pos = -jnp.log(jnp.clip(p, 1e-12))
+    ce_neg = -jnp.log(jnp.clip(1 - p, 1e-12))
+    loss = jnp.where(
+        c_pos,
+        alpha * jnp.power(1 - p, gamma) * ce_pos,
+        (1 - alpha) * jnp.power(p, gamma) * ce_neg *
+        (label[:, None] >= 0))
+    ctx.set_output("Out", loss / fg)
+
+
+@register_op("yolov3_loss",
+             no_grad_slots=("GTBox", "GTLabel", "ObjectnessMask",
+                            "GTMatchMask"))
+def yolov3_loss(ctx):
+    """YOLOv3 training loss (reference yolov3_loss_op.h): coordinate
+    (sigmoid-x/y + raw-w/h), objectness BCE with ignore_thresh, and
+    per-class BCE; gt matched to the best-overlap anchor of its cell."""
+    x = ctx.input("X")                       # [N, C, H, W]
+    gt_box = ctx.input("GTBox")              # [N, B, 4] (cx,cy,w,h rel)
+    gt_label = ctx.input("GTLabel")          # [N, B]
+    anchors = [int(a) for a in ctx.attr("anchors")]
+    mask = [int(m) for m in ctx.attr("anchor_mask")]
+    class_num = ctx.attr("class_num")
+    ignore_thresh = ctx.attr("ignore_thresh", 0.7)
+    downsample = ctx.attr("downsample_ratio", 32)
+    use_label_smooth = ctx.attr("use_label_smooth", True)
+    N, C, H, W = x.shape
+    A = len(mask)
+    an_all = np.asarray(anchors, np.float32).reshape(-1, 2)
+    an = an_all[np.asarray(mask)]
+    input_size = downsample * H
+
+    pred = x.reshape(N, A, 5 + class_num, H, W)
+    px = jax.nn.sigmoid(pred[:, :, 0])
+    py = jax.nn.sigmoid(pred[:, :, 1])
+    pw = pred[:, :, 2]
+    ph = pred[:, :, 3]
+    pobj = pred[:, :, 4]
+    pcls = pred[:, :, 5:]                    # [N, A, cls, H, W]
+
+    # predicted boxes in input-image scale for the ignore mask
+    gx = (jnp.arange(W, dtype=x.dtype))[None, None, None, :]
+    gy = (jnp.arange(H, dtype=x.dtype))[None, None, :, None]
+    bx = (px + gx) / W
+    by = (py + gy) / H
+    bw = jnp.exp(pw) * jnp.asarray(an[:, 0])[None, :, None, None] \
+        / input_size
+    bh = jnp.exp(ph) * jnp.asarray(an[:, 1])[None, :, None, None] \
+        / input_size
+
+    valid = (gt_box[:, :, 2] > 0)            # [N, B]
+    B = gt_box.shape[1]
+
+    # iou between every pred box and every gt (center-size, relative)
+    pb = jnp.stack([bx - bw / 2, by - bh / 2, bx + bw / 2, by + bh / 2],
+                   axis=-1)                  # [N, A, H, W, 4]
+    gb = jnp.stack([gt_box[..., 0] - gt_box[..., 2] / 2,
+                    gt_box[..., 1] - gt_box[..., 3] / 2,
+                    gt_box[..., 0] + gt_box[..., 2] / 2,
+                    gt_box[..., 1] + gt_box[..., 3] / 2],
+                   axis=-1)                  # [N, B, 4]
+
+    def iou_img(p4, g4, v):
+        iou = _pairwise_iou(p4.reshape(-1, 4), g4)       # [AHW, B]
+        iou = jnp.where(v[None, :], iou, 0.0)
+        return jnp.max(iou, axis=1).reshape(A, H, W)
+
+    best_iou = jax.vmap(iou_img)(pb, gb, valid)          # [N, A, H, W]
+    noobj_mask = best_iou < ignore_thresh
+
+    # gt -> (anchor of its cell with best shape iou over ALL anchors)
+    gw_px = gt_box[..., 2] * input_size
+    gh_px = gt_box[..., 3] * input_size
+    inter = jnp.minimum(gw_px[..., None], an_all[None, None, :, 0]) * \
+        jnp.minimum(gh_px[..., None], an_all[None, None, :, 1])
+    union = gw_px[..., None] * gh_px[..., None] + \
+        (an_all[:, 0] * an_all[:, 1])[None, None, :] - inter
+    an_iou = inter / jnp.maximum(union, 1e-10)           # [N, B, A_all]
+    best_n_all = jnp.argmax(an_iou, axis=-1)             # [N, B]
+    mask_arr = np.asarray(mask)
+    # position of best anchor inside this layer's mask; -1 if absent
+    eq = best_n_all[..., None] == mask_arr[None, None, :]
+    in_layer = jnp.any(eq, axis=-1) & valid
+    best_a = jnp.argmax(eq, axis=-1)                     # [N, B]
+
+    gi = jnp.clip((gt_box[..., 0] * W).astype(jnp.int32), 0, W - 1)
+    gj = jnp.clip((gt_box[..., 1] * H).astype(jnp.int32), 0, H - 1)
+    tx = gt_box[..., 0] * W - gi
+    ty = gt_box[..., 1] * H - gj
+    tw = jnp.log(jnp.maximum(
+        gw_px / jnp.asarray(an_all[:, 0])[best_n_all], 1e-9))
+    th = jnp.log(jnp.maximum(
+        gh_px / jnp.asarray(an_all[:, 1])[best_n_all], 1e-9))
+    scale = 2.0 - gt_box[..., 2] * gt_box[..., 3]
+
+    smooth_pos = 1.0
+    smooth_neg = 0.0
+    if use_label_smooth and class_num > 1:
+        delta = 1.0 / class_num
+        smooth_pos, smooth_neg = 1.0 - delta, delta
+
+    def bce(logit, t):
+        return jnp.maximum(logit, 0) - logit * t + \
+            jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+    pxl = pred[:, :, 0]                      # raw logits for x/y bce
+    pyl = pred[:, :, 1]
+
+    def per_image(pxl_i, pyl_i, pw_i, ph_i, pobj_i, pcls_i, noobj_i,
+                  gi_i, gj_i, ba_i, il_i, tx_i, ty_i, tw_i, th_i,
+                  sc_i, lab_i):
+        obj_mask = jnp.zeros((A, H, W), bool)
+        loss = 0.0
+        for b in range(B):
+            a, jj, ii = ba_i[b], gj_i[b], gi_i[b]
+            on = il_i[b]
+            w = sc_i[b] * on
+            loss = loss + w * (
+                bce(pxl_i[a, jj, ii], tx_i[b])
+                + bce(pyl_i[a, jj, ii], ty_i[b])
+                + jnp.abs(pw_i[a, jj, ii] - tw_i[b])
+                + jnp.abs(ph_i[a, jj, ii] - th_i[b]))
+            # class loss
+            tcls = jnp.where(
+                jnp.arange(class_num) == lab_i[b], smooth_pos,
+                smooth_neg)
+            loss = loss + on * jnp.sum(
+                bce(pcls_i[a, :, jj, ii], tcls))
+            obj_mask = obj_mask.at[a, jj, ii].set(
+                jnp.logical_or(obj_mask[a, jj, ii],
+                               on.astype(bool)))
+        obj = obj_mask.astype(x.dtype)
+        loss = loss + jnp.sum(bce(pobj_i, obj) *
+                              jnp.where(obj_mask, 1.0,
+                                        noobj_i.astype(x.dtype)))
+        return loss
+
+    loss = jax.vmap(per_image)(
+        pxl, pyl, pw, ph, pobj, pcls, noobj_mask, gi, gj, best_a,
+        in_layer.astype(x.dtype), tx, ty, tw, th, scale, gt_label)
+    ctx.set_output("Loss", loss)
+    ctx.set_output("ObjectnessMask", noobj_mask.astype(x.dtype))
+    ctx.set_output("GTMatchMask", in_layer.astype(jnp.int32))
+
+
+@register_no_grad_op("yolo_box")
+def yolo_box(ctx):
+    """Decode YOLOv3 head to boxes+scores (reference yolo_box_op.h)."""
+    x = ctx.input("X")                       # [N, C, H, W]
+    img_size = ctx.input("ImgSize")          # [N, 2] (h, w) int
+    anchors = [int(a) for a in ctx.attr("anchors")]
+    class_num = ctx.attr("class_num")
+    conf_thresh = ctx.attr("conf_thresh", 0.01)
+    downsample = ctx.attr("downsample_ratio", 32)
+    N, C, H, W = x.shape
+    an = np.asarray(anchors, np.float32).reshape(-1, 2)
+    A = an.shape[0]
+    input_size = downsample * H
+
+    pred = x.reshape(N, A, 5 + class_num, H, W)
+    gx = (jnp.arange(W, dtype=x.dtype))[None, None, None, :]
+    gy = (jnp.arange(H, dtype=x.dtype))[None, None, :, None]
+    bx = (jax.nn.sigmoid(pred[:, :, 0]) + gx) / W
+    by = (jax.nn.sigmoid(pred[:, :, 1]) + gy) / H
+    bw = jnp.exp(pred[:, :, 2]) * an[None, :, 0, None, None] / input_size
+    bh = jnp.exp(pred[:, :, 3]) * an[None, :, 1, None, None] / input_size
+    conf = jax.nn.sigmoid(pred[:, :, 4])
+    probs = jax.nn.sigmoid(pred[:, :, 5:]) * conf[:, :, None]
+
+    keep = conf > conf_thresh
+    img_h = img_size[:, 0].astype(x.dtype)[:, None, None, None]
+    img_w = img_size[:, 1].astype(x.dtype)[:, None, None, None]
+    x1 = (bx - bw / 2) * img_w
+    y1 = (by - bh / 2) * img_h
+    x2 = (bx + bw / 2) * img_w
+    y2 = (by + bh / 2) * img_h
+    # clip to image
+    x1 = jnp.clip(x1, 0, img_w - 1)
+    y1 = jnp.clip(y1, 0, img_h - 1)
+    x2 = jnp.clip(x2, 0, img_w - 1)
+    y2 = jnp.clip(y2, 0, img_h - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], -1).reshape(N, -1, 4)
+    boxes = boxes * keep.reshape(N, -1, 1)
+    scores = (probs * keep[:, :, None]).transpose(0, 1, 3, 4, 2) \
+        .reshape(N, -1, class_num)
+    ctx.set_output("Boxes", boxes)
+    ctx.set_output("Scores", scores)
+
+
+# ---------------------------------------------------------------------------
+# NMS / output
+# ---------------------------------------------------------------------------
+
+def _nms_keep(boxes, scores, nms_threshold, nms_top_k, eta=1.0,
+              normalized=True):
+    """Greedy NMS mask over score-sorted candidates. Returns (order,
+    keep_sorted): indices sorted by score desc and a bool mask in that
+    order."""
+    order = jnp.argsort(-scores)
+    if nms_top_k > 0 and nms_top_k < order.shape[0]:
+        order = order[:nms_top_k]
+    b = boxes[order]
+    iou = _pairwise_iou(b, b, normalized)
+    K = b.shape[0]
+
+    def body(i, st):
+        keep, thresh = st
+        sup = jnp.any((iou[i] > thresh) & keep &
+                      (jnp.arange(K) < i))
+        keep = keep.at[i].set(keep[i] & ~sup)
+        thresh = jnp.where((eta < 1.0) & (thresh > 0.5), thresh * eta,
+                           thresh)
+        return keep, thresh
+
+    keep0 = jnp.ones((K,), bool)
+    keep, _ = lax.fori_loop(0, K, body,
+                            (keep0, jnp.asarray(nms_threshold)))
+    return order, keep
+
+
+@register_no_grad_op("multiclass_nms")
+def multiclass_nms(ctx):
+    """Per-class NMS + cross-class top-k (reference multiclass_nms_op.cc).
+
+    Static-shape contract: emits exactly keep_top_k rows per image
+    (label -1 / score 0 padding for absent detections) with LoD
+    [[keep_top_k * i]], instead of the reference's dynamically sized
+    LoD tensor — the padded rows carry label -1 so consumers can mask.
+    """
+    boxes = ctx.input("BBoxes")              # [N, M, 4]
+    scores = ctx.input("Scores")             # [N, C, M]
+    score_threshold = ctx.attr("score_threshold", 0.0)
+    nms_top_k = ctx.attr("nms_top_k", -1)
+    nms_threshold = ctx.attr("nms_threshold", 0.3)
+    nms_eta = ctx.attr("nms_eta", 1.0)
+    keep_top_k = ctx.attr("keep_top_k", -1)
+    normalized = ctx.attr("normalized", True)
+    background_label = ctx.attr("background_label", 0)
+    N, C, M = scores.shape
+    if keep_top_k <= 0:
+        keep_top_k = M
+
+    def per_image(bx, sc):
+        all_scores, all_labels, all_boxes = [], [], []
+        for c in range(C):
+            if c == background_label:
+                continue
+            s = sc[c]
+            order, keep = _nms_keep(bx, s, nms_threshold, nms_top_k,
+                                    nms_eta, normalized)
+            valid = keep & (s[order] > score_threshold)
+            all_scores.append(jnp.where(valid, s[order], -1.0))
+            all_labels.append(jnp.full(order.shape, c, jnp.int32))
+            all_boxes.append(bx[order])
+        cs = jnp.concatenate(all_scores)
+        cl = jnp.concatenate(all_labels)
+        cb = jnp.concatenate(all_boxes, axis=0)
+        top = jnp.argsort(-cs)[:keep_top_k]
+        s_t, l_t, b_t = cs[top], cl[top], cb[top]
+        ok = s_t > 0
+        row = jnp.concatenate(
+            [jnp.where(ok, l_t, -1).astype(bx.dtype)[:, None],
+             jnp.where(ok, s_t, 0.0)[:, None],
+             b_t * ok[:, None]], axis=1)
+        return row
+
+    out = jax.vmap(per_image)(boxes, scores)        # [N, keep_top_k, 6]
+    out = out.reshape(N * keep_top_k, 6)
+    ctx.set_output("Out", out)
+    ctx.set_lod("Out", [[keep_top_k * i for i in range(N + 1)]])
+
+
+# ---------------------------------------------------------------------------
+# ROI ops
+# ---------------------------------------------------------------------------
+
+def _roi_batch_ids(ctx, rois_name, n_rois, batch):
+    """RoIs arrive as LoD over images; map each roi row to its image."""
+    lod = ctx.get_lod(rois_name)
+    ids = np.zeros(n_rois, np.int32)
+    for b, (s, e) in enumerate(_lod_segments(lod, n_rois)):
+        ids[s:e] = b
+    return jnp.asarray(ids)
+
+
+def _bilinear_sample(feat, ys, xs):
+    """feat [C, H, W]; ys/xs [...] float coords -> [C, ...]."""
+    H, W = feat.shape[1], feat.shape[2]
+    y0 = jnp.clip(jnp.floor(ys), 0, H - 1)
+    x0 = jnp.clip(jnp.floor(xs), 0, W - 1)
+    y1 = jnp.clip(y0 + 1, 0, H - 1)
+    x1 = jnp.clip(x0 + 1, 0, W - 1)
+    ly = jnp.clip(ys - y0, 0.0, 1.0)
+    lx = jnp.clip(xs - x0, 0.0, 1.0)
+    y0i, x0i = y0.astype(jnp.int32), x0.astype(jnp.int32)
+    y1i, x1i = y1.astype(jnp.int32), x1.astype(jnp.int32)
+    v00 = feat[:, y0i, x0i]
+    v01 = feat[:, y0i, x1i]
+    v10 = feat[:, y1i, x0i]
+    v11 = feat[:, y1i, x1i]
+    return (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx +
+            v10 * ly * (1 - lx) + v11 * ly * lx)
+
+
+@register_op("roi_align", no_grad_slots=("ROIs",))
+def roi_align(ctx):
+    """Reference roi_align_op.h: average of bilinear samples per bin."""
+    x = ctx.input("X")                       # [N, C, H, W]
+    rois = ctx.input("ROIs")                 # [R, 4] (x1,y1,x2,y2)
+    ph = ctx.attr("pooled_height", 1)
+    pw = ctx.attr("pooled_width", 1)
+    spatial_scale = ctx.attr("spatial_scale", 1.0)
+    sampling_ratio = ctx.attr("sampling_ratio", -1)
+    R = rois.shape[0]
+    ids = _roi_batch_ids(ctx, "ROIs", R, x.shape[0])
+    sr = sampling_ratio if sampling_ratio > 0 else 2
+
+    def one_roi(roi, bid):
+        x1, y1, x2, y2 = roi * spatial_scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        # sample grid [ph*sr, pw*sr]
+        iy = (jnp.arange(ph * sr) + 0.5) / sr
+        ix = (jnp.arange(pw * sr) + 0.5) / sr
+        ys = y1 + iy * bin_h                  # [ph*sr]
+        xs = x1 + ix * bin_w                  # [pw*sr]
+        yg, xg = jnp.meshgrid(ys, xs, indexing="ij")
+        feat = x[bid]
+        sampled = _bilinear_sample(feat, yg, xg)  # [C, ph*sr, pw*sr]
+        C = sampled.shape[0]
+        return sampled.reshape(C, ph, sr, pw, sr).mean(axis=(2, 4))
+
+    out = jax.vmap(one_roi)(rois, ids)
+    ctx.set_output("Out", out)
+
+
+@register_op("roi_pool", no_grad_slots=("ROIs",),
+             intermediate_outputs=("Argmax",))
+def roi_pool(ctx):
+    """Reference roi_pool_op.h: max over integer bins."""
+    x = ctx.input("X")
+    rois = ctx.input("ROIs")
+    ph = ctx.attr("pooled_height", 1)
+    pw = ctx.attr("pooled_width", 1)
+    spatial_scale = ctx.attr("spatial_scale", 1.0)
+    R = rois.shape[0]
+    H, W = x.shape[2], x.shape[3]
+    ids = _roi_batch_ids(ctx, "ROIs", R, x.shape[0])
+
+    def one_roi(roi, bid):
+        x1 = jnp.round(roi[0] * spatial_scale)
+        y1 = jnp.round(roi[1] * spatial_scale)
+        x2 = jnp.round(roi[2] * spatial_scale)
+        y2 = jnp.round(roi[3] * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        feat = x[bid]                          # [C, H, W]
+        ygrid = jnp.arange(H, dtype=x.dtype)[None, :]   # [1, H]
+        xgrid = jnp.arange(W, dtype=x.dtype)[None, :]   # [1, W]
+        pidx = jnp.arange(ph, dtype=x.dtype)[:, None]
+        qidx = jnp.arange(pw, dtype=x.dtype)[:, None]
+        ys = (jnp.floor(y1 + pidx * bin_h) <= ygrid) & \
+             (ygrid < jnp.ceil(y1 + (pidx + 1) * bin_h))   # [ph, H]
+        xsel = (jnp.floor(x1 + qidx * bin_w) <= xgrid) & \
+               (xgrid < jnp.ceil(x1 + (qidx + 1) * bin_w))  # [pw, W]
+        m = ys[:, None, :, None] & xsel[None, :, None, :]   # [ph,pw,H,W]
+        masked = jnp.where(m[None], feat[:, None, None, :, :], -jnp.inf)
+        out = jnp.max(masked, axis=(3, 4))
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    out = jax.vmap(one_roi)(rois, ids)
+    ctx.set_output("Out", out)
+    ctx.set_output("Argmax", jnp.zeros(out.shape, jnp.int32))
+
+
+@register_op("psroi_pool", no_grad_slots=("ROIs",))
+def psroi_pool(ctx):
+    """Position-sensitive ROI pooling (reference psroi_pool_op.h):
+    channel c of bin (i,j) averages input channel c*ph*pw + i*pw + j."""
+    x = ctx.input("X")                       # [N, C*ph*pw, H, W]
+    rois = ctx.input("ROIs")
+    out_channels = ctx.attr("output_channels")
+    ph = ctx.attr("pooled_height", 1)
+    pw = ctx.attr("pooled_width", 1)
+    spatial_scale = ctx.attr("spatial_scale", 1.0)
+    H, W = x.shape[2], x.shape[3]
+    R = rois.shape[0]
+    ids = _roi_batch_ids(ctx, "ROIs", R, x.shape[0])
+
+    def one_roi(roi, bid):
+        x1 = jnp.round(roi[0]) * spatial_scale
+        y1 = jnp.round(roi[1]) * spatial_scale
+        x2 = jnp.round(roi[2] + 1.0) * spatial_scale
+        y2 = jnp.round(roi[3] + 1.0) * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w = rw / pw
+        bin_h = rh / ph
+        feat = x[bid].reshape(out_channels, ph, pw, H, W)
+        ygrid = jnp.arange(H, dtype=x.dtype)
+        xgrid = jnp.arange(W, dtype=x.dtype)
+        pidx = jnp.arange(ph, dtype=x.dtype)[:, None]
+        qidx = jnp.arange(pw, dtype=x.dtype)[:, None]
+        ysel = (jnp.floor(y1 + pidx * bin_h) <= ygrid[None, :]) & \
+               (ygrid[None, :] < jnp.ceil(y1 + (pidx + 1) * bin_h))
+        xsel = (jnp.floor(x1 + qidx * bin_w) <= xgrid[None, :]) & \
+               (xgrid[None, :] < jnp.ceil(x1 + (qidx + 1) * bin_w))
+        m = ysel[:, None, :, None] & xsel[None, :, None, :]  # ph,pw,H,W
+        cnt = jnp.maximum(jnp.sum(m, axis=(2, 3)), 1)        # ph,pw
+        vals = jnp.where(m[None, :, :, :, :], feat, 0.0)
+        s = jnp.sum(vals, axis=(3, 4))
+        return s / cnt[None]
+
+    out = jax.vmap(one_roi)(rois, ids)
+    ctx.set_output("Out", out)
+
+
+# ---------------------------------------------------------------------------
+# RPN / proposals
+# ---------------------------------------------------------------------------
+
+@register_no_grad_op("generate_proposals")
+def generate_proposals(ctx):
+    """RPN proposal generation (reference generate_proposals_op.cc):
+    top pre_nms_topN by score -> decode vs anchors -> clip -> filter
+    small (masked) -> NMS -> exactly post_nms_topN rows per image
+    (zero-padded; RpnRoisNum-style counts are in the LoD)."""
+    scores = ctx.input("Scores")             # [N, A, H, W]
+    deltas = ctx.input("BboxDeltas")         # [N, A*4, H, W]
+    im_info = ctx.input("ImInfo")            # [N, 3]
+    anchors = ctx.input("Anchors")           # [H, W, A, 4]
+    variances = ctx.input("Variances")
+    pre_nms = ctx.attr("pre_nms_topN", 6000)
+    post_nms = ctx.attr("post_nms_topN", 1000)
+    nms_thresh = ctx.attr("nms_thresh", 0.5)
+    min_size = ctx.attr("min_size", 0.1)
+    eta = ctx.attr("eta", 1.0)
+    N, A, H, W = scores.shape
+    M = A * H * W
+    anc = anchors.reshape(-1, 4)
+    var = variances.reshape(-1, 4)
+
+    def per_image(sc, dl, info):
+        s = sc.reshape(A, H, W).transpose(1, 2, 0).reshape(-1)
+        d = dl.reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        k = min(pre_nms, M) if pre_nms > 0 else M
+        top = jnp.argsort(-s)[:k]
+        s_t, d_t = s[top], d[top]
+        a_t, v_t = anc[top], var[top]
+        # decode (variance-weighted center-size)
+        aw = a_t[:, 2] - a_t[:, 0] + 1.0
+        ah = a_t[:, 3] - a_t[:, 1] + 1.0
+        acx = a_t[:, 0] + aw / 2
+        acy = a_t[:, 1] + ah / 2
+        cx = v_t[:, 0] * d_t[:, 0] * aw + acx
+        cy = v_t[:, 1] * d_t[:, 1] * ah + acy
+        w = jnp.exp(jnp.minimum(v_t[:, 2] * d_t[:, 2],
+                                math.log(1000.0 / 16))) * aw
+        h = jnp.exp(jnp.minimum(v_t[:, 3] * d_t[:, 3],
+                                math.log(1000.0 / 16))) * ah
+        props = jnp.stack([cx - w / 2, cy - h / 2,
+                           cx + w / 2 - 1, cy + h / 2 - 1], axis=1)
+        # clip to image
+        props = jnp.stack([
+            jnp.clip(props[:, 0], 0, info[1] - 1),
+            jnp.clip(props[:, 1], 0, info[0] - 1),
+            jnp.clip(props[:, 2], 0, info[1] - 1),
+            jnp.clip(props[:, 3], 0, info[0] - 1)], axis=1)
+        # filter small (mask scores instead of removing rows)
+        ms = min_size * info[2]
+        keep_sz = ((props[:, 2] - props[:, 0] + 1) >= ms) & \
+                  ((props[:, 3] - props[:, 1] + 1) >= ms)
+        s_t = jnp.where(keep_sz, s_t, -1.0)
+        order, keep = _nms_keep(props, s_t, nms_thresh, -1, eta,
+                                normalized=False)
+        valid = keep & (s_t[order] > 0)
+        # compact the kept indices into the first post_nms slots
+        perm = jnp.argsort(~valid)            # valid first, stable
+        sel = order[perm][:post_nms]
+        ok = valid[perm][:post_nms]
+        rois = props[sel] * ok[:, None]
+        rs = jnp.where(ok, s_t[sel], 0.0)
+        return rois, rs, jnp.sum(ok.astype(jnp.int32))
+
+    rois, rscores, counts = jax.vmap(per_image)(scores, deltas, im_info)
+    ctx.set_output("RpnRois", rois.reshape(N * post_nms, 4))
+    ctx.set_output("RpnRoiProbs", rscores.reshape(N * post_nms, 1))
+    ctx.set_lod("RpnRois", [[post_nms * i for i in range(N + 1)]])
+    ctx.set_lod("RpnRoiProbs", [[post_nms * i for i in range(N + 1)]])
+
+
+@register_no_grad_op("rpn_target_assign")
+def rpn_target_assign(ctx):
+    """Sample anchors for RPN training (reference
+    rpn_target_assign_op.cc): positives = best-anchor-per-gt plus
+    anchors with IoU > pos_thresh, negatives below neg_thresh; random
+    subsample to rpn_batch_size_per_im * fg_fraction positives.
+
+    Static-shape contract: emits fixed-size index tensors of length
+    rpn_batch_size_per_im with -1 padding (the reference emits variable
+    rows)."""
+    anchors = ctx.input("Anchor").reshape(-1, 4)
+    gt_boxes = ctx.input("GtBoxes")          # LoD [G, 4]
+    is_crowd = ctx.input("IsCrowd")
+    im_info = ctx.input("ImInfo")
+    batch = ctx.attr("rpn_batch_size_per_im", 256)
+    straddle = ctx.attr("rpn_straddle_thresh", 0.0)
+    fg_frac = ctx.attr("rpn_fg_fraction", 0.5)
+    pos_th = ctx.attr("rpn_positive_overlap", 0.7)
+    neg_th = ctx.attr("rpn_negative_overlap", 0.3)
+    use_random = ctx.attr("use_random", True)
+    M = anchors.shape[0]
+    lod = ctx.get_lod("GtBoxes")
+    segs = _lod_segments(lod, gt_boxes.shape[0])
+    N = len(segs)
+    key = ctx.rng() if use_random else None
+
+    loc_idx_all, score_idx_all, tgt_lbl_all, tgt_bbox_all, bbox_w_all = \
+        [], [], [], [], []
+    n_fg = int(batch * fg_frac)
+    n_bg = batch - n_fg
+    for b, (s, e) in enumerate(segs):
+        gt = gt_boxes[s:e]
+        crowd = is_crowd[s:e].reshape(-1) if is_crowd is not None \
+            else jnp.zeros((e - s,), jnp.int32)
+        gt_ok = crowd == 0
+        inside = ((anchors[:, 0] >= -straddle) &
+                  (anchors[:, 1] >= -straddle) &
+                  (anchors[:, 2] < im_info[b, 1] + straddle) &
+                  (anchors[:, 3] < im_info[b, 0] + straddle))
+        iou = _pairwise_iou(anchors, gt, normalized=False)
+        iou = jnp.where(gt_ok[None, :], iou, 0.0)
+        best_gt = jnp.argmax(iou, axis=1)
+        best_iou = jnp.max(iou, axis=1)
+        # positive: (a) best anchor per gt, (b) iou > pos_th
+        per_gt_best = jnp.argmax(jnp.where(inside[:, None], iou, -1.0),
+                                 axis=0)
+        is_pos = (best_iou >= pos_th) & inside
+        is_pos = is_pos.at[per_gt_best].set(gt_ok | is_pos[per_gt_best])
+        is_neg = (best_iou < neg_th) & inside & ~is_pos
+
+        def sample(mask, count, k):
+            scorev = mask.astype(jnp.float32)
+            if use_random:
+                scorev = scorev * (1 + jax.random.uniform(
+                    jax.random.fold_in(key, b * 2 + k), (M,)))
+            order = jnp.argsort(-scorev)
+            sel = jnp.where(jnp.arange(M) < jnp.minimum(
+                count, jnp.sum(mask)), order, -1)
+            return sel[:count]
+
+        fg_sel = sample(is_pos, n_fg, 0)
+        bg_sel = sample(is_neg, n_bg, 1)
+        loc_idx_all.append(fg_sel)
+        score_idx_all.append(jnp.concatenate([fg_sel, bg_sel]))
+        lbl = jnp.concatenate([
+            jnp.where(fg_sel >= 0, 1, -1),
+            jnp.where(bg_sel >= 0, 0, -1)]).astype(jnp.int32)
+        tgt_lbl_all.append(lbl)
+        safe_fg = jnp.clip(fg_sel, 0, M - 1)
+        a_t = anchors[safe_fg]
+        g_t = gt[jnp.clip(best_gt[safe_fg], 0, gt.shape[0] - 1)]
+        aw = a_t[:, 2] - a_t[:, 0] + 1.0
+        ah = a_t[:, 3] - a_t[:, 1] + 1.0
+        acx = a_t[:, 0] + aw / 2
+        acy = a_t[:, 1] + ah / 2
+        gw = g_t[:, 2] - g_t[:, 0] + 1.0
+        gh = g_t[:, 3] - g_t[:, 1] + 1.0
+        gcx = (g_t[:, 2] + g_t[:, 0]) / 2
+        gcy = (g_t[:, 3] + g_t[:, 1]) / 2
+        tb = jnp.stack([(gcx - acx) / aw, (gcy - acy) / ah,
+                        jnp.log(gw / aw), jnp.log(gh / ah)], axis=1)
+        tgt_bbox_all.append(tb * (fg_sel >= 0)[:, None])
+        bbox_w_all.append((fg_sel >= 0).astype(jnp.float32)[:, None]
+                          * jnp.ones((1, 4), jnp.float32))
+    # per-image offset into the flattened [N*M] anchor score/loc view;
+    # keep -1 padding un-offset so the `idx >= 0` contract holds
+    loc = jnp.concatenate(
+        [jnp.where(ix >= 0, ix + b * M, -1) for b, ix in
+         enumerate(loc_idx_all)]).reshape(-1, 1)
+    score = jnp.concatenate(
+        [jnp.where(ix >= 0, ix + b * M, -1) for b, ix in
+         enumerate(score_idx_all)]).reshape(-1, 1)
+    ctx.set_output("LocationIndex", loc.astype(jnp.int32))
+    ctx.set_output("ScoreIndex", score.astype(jnp.int32))
+    ctx.set_output("TargetLabel",
+                   jnp.concatenate(tgt_lbl_all).reshape(-1, 1))
+    ctx.set_output("TargetBBox", jnp.concatenate(tgt_bbox_all, axis=0))
+    ctx.set_output("BBoxInsideWeight",
+                   jnp.concatenate(bbox_w_all, axis=0))
+
+
+@register_no_grad_op("generate_proposal_labels")
+def generate_proposal_labels(ctx):
+    """Sample RoIs for RCNN head training (reference
+    generate_proposal_labels_op.cc): label each proposal by max-IoU gt
+    (fg if >= fg_thresh, bg if in [bg_lo, bg_hi)), subsample to
+    batch_size_per_im with fg_fraction, emit box regression targets.
+
+    Static-shape contract: exactly batch_size_per_im rows per image
+    (label -1 padding)."""
+    rois = ctx.input("RpnRois")              # LoD [R, 4]
+    gt_classes = ctx.input("GtClasses")      # LoD [G, 1]
+    is_crowd = ctx.input("IsCrowd")
+    gt_boxes = ctx.input("GtBoxes")          # LoD [G, 4]
+    im_info = ctx.input("ImInfo")
+    batch = ctx.attr("batch_size_per_im", 256)
+    fg_frac = ctx.attr("fg_fraction", 0.25)
+    fg_th = ctx.attr("fg_thresh", 0.5)
+    bg_hi = ctx.attr("bg_thresh_hi", 0.5)
+    bg_lo = ctx.attr("bg_thresh_lo", 0.0)
+    weights = ctx.attr("bbox_reg_weights", [0.1, 0.1, 0.2, 0.2])
+    class_nums = ctx.attr("class_nums", 81)
+    use_random = ctx.attr("use_random", True)
+    key = ctx.rng() if use_random else None
+
+    roi_segs = _lod_segments(ctx.get_lod("RpnRois"), rois.shape[0])
+    gt_segs = _lod_segments(ctx.get_lod("GtBoxes"), gt_boxes.shape[0])
+    n_fg = int(batch * fg_frac)
+    n_bg = batch - n_fg
+    out_rois, out_labels, out_tgts, out_w_in, out_w_out = \
+        [], [], [], [], []
+    for b, ((rs, re), (gs, ge)) in enumerate(zip(roi_segs, gt_segs)):
+        r = rois[rs:re] / im_info[b, 2]      # back to original scale
+        gt = gt_boxes[gs:ge]
+        cls = gt_classes[gs:ge].reshape(-1)
+        crowd = is_crowd[gs:ge].reshape(-1) if is_crowd is not None \
+            else jnp.zeros(cls.shape, jnp.int32)
+        # reference concatenates gt boxes into the roi pool
+        cand = jnp.concatenate([r, gt], axis=0)
+        iou = _pairwise_iou(cand, gt, normalized=False)
+        iou = jnp.where((crowd == 0)[None, :], iou, 0.0)
+        best = jnp.max(iou, axis=1)
+        best_gt = jnp.argmax(iou, axis=1)
+        Rn = cand.shape[0]
+        is_fg = best >= fg_th
+        is_bg = (best < bg_hi) & (best >= bg_lo)
+
+        def sample(mask, count, k):
+            sc = mask.astype(jnp.float32)
+            if use_random:
+                sc = sc * (1 + jax.random.uniform(
+                    jax.random.fold_in(key, b * 2 + k), (Rn,)))
+            order = jnp.argsort(-sc)
+            return jnp.where(jnp.arange(count) < jnp.minimum(
+                count, jnp.sum(mask)), order[:count], -1)
+
+        fg_sel = sample(is_fg, n_fg, 0)
+        bg_sel = sample(is_bg, n_bg, 1)
+        sel = jnp.concatenate([fg_sel, bg_sel])
+        safe = jnp.clip(sel, 0, Rn - 1)
+        sel_rois = cand[safe] * (sel >= 0)[:, None]
+        fg_slot = (jnp.arange(batch) < n_fg) & (sel >= 0)
+        matched_cls = cls[jnp.clip(best_gt[safe], 0, cls.shape[0] - 1)]
+        lbl = jnp.where(sel >= 0,
+                        jnp.where(fg_slot, matched_cls, 0),
+                        -1).astype(jnp.int32)
+        # encode targets vs matched gt (fg rows only)
+        g = gt[jnp.clip(best_gt[safe], 0, gt.shape[0] - 1)]
+        rw = sel_rois[:, 2] - sel_rois[:, 0] + 1.0
+        rh = sel_rois[:, 3] - sel_rois[:, 1] + 1.0
+        rcx = sel_rois[:, 0] + rw / 2
+        rcy = sel_rois[:, 1] + rh / 2
+        gw = g[:, 2] - g[:, 0] + 1.0
+        gh = g[:, 3] - g[:, 1] + 1.0
+        gcx = (g[:, 2] + g[:, 0]) / 2
+        gcy = (g[:, 3] + g[:, 1]) / 2
+        w = jnp.asarray(weights)
+        t = jnp.stack([(gcx - rcx) / rw / w[0],
+                       (gcy - rcy) / rh / w[1],
+                       jnp.log(gw / rw) / w[2],
+                       jnp.log(gh / rh) / w[3]], axis=1)
+        fg_row = (jnp.arange(batch) < n_fg) & (sel >= 0)
+        # scatter into per-class slots [batch, 4*class_nums]
+        tgt = jnp.zeros((batch, 4 * class_nums), rois.dtype)
+        col = jnp.clip(lbl, 0, class_nums - 1) * 4
+        rowi = jnp.arange(batch)
+        for k in range(4):
+            tgt = tgt.at[rowi, col + k].set(
+                jnp.where(fg_row, t[:, k], 0.0))
+        w_in = (tgt != 0).astype(jnp.float32)
+        out_rois.append(sel_rois)
+        out_labels.append(lbl.reshape(-1, 1))
+        out_tgts.append(tgt)
+        out_w_in.append(w_in)
+        out_w_out.append((w_in > 0).astype(jnp.float32))
+    N = len(roi_segs)
+    lod = [[batch * i for i in range(N + 1)]]
+    ctx.set_output("Rois", jnp.concatenate(out_rois, axis=0))
+    ctx.set_output("LabelsInt32", jnp.concatenate(out_labels, axis=0))
+    ctx.set_output("BboxTargets", jnp.concatenate(out_tgts, axis=0))
+    ctx.set_output("BboxInsideWeights",
+                   jnp.concatenate(out_w_in, axis=0))
+    ctx.set_output("BboxOutsideWeights",
+                   jnp.concatenate(out_w_out, axis=0))
+    for nm in ("Rois", "LabelsInt32", "BboxTargets",
+               "BboxInsideWeights", "BboxOutsideWeights"):
+        ctx.set_lod(nm, lod)
+
+
+@register_no_grad_op("box_decoder_and_assign")
+def box_decoder_and_assign(ctx):
+    """Decode per-class deltas and pick the best-scoring class's box
+    (reference box_decoder_and_assign_op.cc)."""
+    prior = ctx.input("PriorBox")            # [R, 4]
+    pvar = ctx.input("PriorBoxVar")          # [R, 4]
+    target = ctx.input("TargetBox")          # [R, 4*C]
+    score = ctx.input("BoxScore")            # [R, C]
+    box_clip_v = ctx.attr("box_clip", 4.135)
+    R = prior.shape[0]
+    C = score.shape[1]
+    pw = prior[:, 2] - prior[:, 0] + 1.0
+    ph = prior[:, 3] - prior[:, 1] + 1.0
+    pcx = prior[:, 0] + pw / 2
+    pcy = prior[:, 1] + ph / 2
+    t = target.reshape(R, C, 4)
+    v = pvar if pvar is not None else jnp.ones_like(prior)
+    dx = t[..., 0] * v[:, None, 0]
+    dy = t[..., 1] * v[:, None, 1]
+    dw = jnp.clip(t[..., 2] * v[:, None, 2], -box_clip_v, box_clip_v)
+    dh = jnp.clip(t[..., 3] * v[:, None, 3], -box_clip_v, box_clip_v)
+    cx = dx * pw[:, None] + pcx[:, None]
+    cy = dy * ph[:, None] + pcy[:, None]
+    w = jnp.exp(dw) * pw[:, None]
+    h = jnp.exp(dh) * ph[:, None]
+    decoded = jnp.stack([cx - w / 2, cy - h / 2,
+                         cx + w / 2 - 1, cy + h / 2 - 1],
+                        axis=-1)             # [R, C, 4]
+    ctx.set_output("DecodeBox", decoded.reshape(R, C * 4))
+    best = jnp.argmax(score, axis=1)
+    ctx.set_output("OutputAssignBox",
+                   decoded[jnp.arange(R), best])
+
+
+@register_no_grad_op("polygon_box_transform")
+def polygon_box_transform(ctx):
+    """Reference polygon_box_transform_op.cc: for EAST-style quads,
+    out = 4*cell_center - offset at even channels (x) / odd (y)."""
+    x = ctx.input("Input")                   # [N, 8, H, W] (geometry)
+    N, C, H, W = x.shape
+    col = jnp.arange(W, dtype=x.dtype)[None, :]
+    row = jnp.arange(H, dtype=x.dtype)[:, None]
+    base_x = jnp.broadcast_to(col * 4.0, (H, W))
+    base_y = jnp.broadcast_to(row * 4.0, (H, W))
+    is_x = (jnp.arange(C) % 2 == 0)[None, :, None, None]
+    base = jnp.where(is_x, base_x[None, None], base_y[None, None])
+    ctx.set_output("Output", base - x)
+
+
+@register_no_grad_op("retinanet_detection_output")
+def retinanet_detection_output(ctx):
+    """Multi-level focal-loss detector output (reference
+    retinanet_detection_output_op.cc): per level, take top-k by score
+    above threshold, decode vs anchors, then cross-level NMS.
+
+    Static-shape: keep_top_k rows per image, label -1 padding."""
+    bboxes = ctx.inputs("BBoxes")            # list of [N, Mi, 4]
+    scores_l = ctx.inputs("Scores")          # list of [N, Mi, C]
+    anchors_l = ctx.inputs("Anchors")        # list of [Mi, 4]
+    im_info = ctx.input("ImInfo")
+    score_threshold = ctx.attr("score_threshold", 0.05)
+    nms_top_k = ctx.attr("nms_top_k", 1000)
+    keep_top_k = ctx.attr("keep_top_k", 100)
+    nms_threshold = ctx.attr("nms_threshold", 0.3)
+    N = scores_l[0].shape[0]
+    C = scores_l[0].shape[2]
+
+    def per_image(args):
+        deltas_i, scores_i, info = args
+        cand_boxes, cand_scores, cand_labels = [], [], []
+        for lvl in range(len(anchors_l)):
+            d = deltas_i[lvl]                # [Mi, 4]
+            s = scores_i[lvl]                # [Mi, C]
+            a = anchors_l[lvl].reshape(-1, 4)
+            k = min(nms_top_k, s.shape[0])
+            flat = s.reshape(-1)
+            top = jnp.argsort(-flat)[:k]
+            mi, ci = top // C, top % C
+            aw = a[mi, 2] - a[mi, 0] + 1.0
+            ah = a[mi, 3] - a[mi, 1] + 1.0
+            acx = a[mi, 0] + aw / 2
+            acy = a[mi, 1] + ah / 2
+            dd = d[mi]
+            cx = dd[:, 0] * aw + acx
+            cy = dd[:, 1] * ah + acy
+            w = jnp.exp(jnp.minimum(dd[:, 2], 4.135)) * aw
+            h = jnp.exp(jnp.minimum(dd[:, 3], 4.135)) * ah
+            box = jnp.stack([cx - w / 2, cy - h / 2,
+                             cx + w / 2 - 1, cy + h / 2 - 1], axis=1)
+            hgt = info[0] / info[2]
+            wdt = info[1] / info[2]
+            box = jnp.stack([jnp.clip(box[:, 0], 0, wdt - 1),
+                             jnp.clip(box[:, 1], 0, hgt - 1),
+                             jnp.clip(box[:, 2], 0, wdt - 1),
+                             jnp.clip(box[:, 3], 0, hgt - 1)], axis=1)
+            sc = jnp.where(flat[top] > score_threshold, flat[top], -1.0)
+            cand_boxes.append(box)
+            cand_scores.append(sc)
+            cand_labels.append(ci.astype(jnp.int32))
+        cb = jnp.concatenate(cand_boxes, axis=0)
+        cs = jnp.concatenate(cand_scores)
+        cl = jnp.concatenate(cand_labels)
+        # per-class NMS via score offsetting trick: shift boxes by class
+        # so cross-class boxes never overlap
+        shift = cl.astype(cb.dtype)[:, None] * 10000.0
+        order, keep = _nms_keep(cb + shift, cs, nms_threshold, -1,
+                                normalized=False)
+        valid = keep & (cs[order] > 0)
+        perm = jnp.argsort(~valid)
+        sel = order[perm][:keep_top_k]
+        ok = valid[perm][:keep_top_k]
+        row = jnp.concatenate(
+            [jnp.where(ok, cl[sel], -1).astype(cb.dtype)[:, None],
+             jnp.where(ok, cs[sel], 0.0)[:, None],
+             cb[sel] * ok[:, None]], axis=1)
+        return row
+
+    rows = []
+    for n in range(N):
+        deltas_i = [b[n] for b in bboxes]
+        scores_i = [s[n] for s in scores_l]
+        rows.append(per_image((deltas_i, scores_i, im_info[n])))
+    out = jnp.concatenate(rows, axis=0)
+    ctx.set_output("Out", out)
+    ctx.set_lod("Out", [[keep_top_k * i for i in range(N + 1)]])
+
+
+@register_no_grad_op("retinanet_target_assign")
+def retinanet_target_assign(ctx):
+    """Focal-loss target assignment (reference
+    retinanet_target_assign_op.cc): positives IoU >= positive_overlap,
+    negatives < negative_overlap, NO subsampling (focal loss uses all).
+    Static-shape: one row per anchor per image; ScoreIndex carries -1
+    padding for ignored anchors."""
+    anchors = ctx.input("Anchor").reshape(-1, 4)
+    gt_boxes = ctx.input("GtBoxes")
+    gt_labels = ctx.input("GtLabels")
+    is_crowd = ctx.input("IsCrowd")
+    im_info = ctx.input("ImInfo")
+    pos_th = ctx.attr("positive_overlap", 0.5)
+    neg_th = ctx.attr("negative_overlap", 0.4)
+    M = anchors.shape[0]
+    segs = _lod_segments(ctx.get_lod("GtBoxes"), gt_boxes.shape[0])
+    loc_all, score_all, lbl_all, bbox_all, w_all, fg_cnt = \
+        [], [], [], [], [], []
+    for b, (s, e) in enumerate(segs):
+        gt = gt_boxes[s:e]
+        lab = gt_labels[s:e].reshape(-1)
+        crowd = is_crowd[s:e].reshape(-1) if is_crowd is not None \
+            else jnp.zeros(lab.shape, jnp.int32)
+        iou = _pairwise_iou(anchors, gt, normalized=False)
+        iou = jnp.where((crowd == 0)[None, :], iou, 0.0)
+        best = jnp.max(iou, axis=1)
+        best_gt = jnp.argmax(iou, axis=1)
+        per_gt_best = jnp.argmax(iou, axis=0)
+        is_pos = best >= pos_th
+        is_pos = is_pos.at[per_gt_best].set(True)
+        is_neg = best < neg_th
+        idx = jnp.arange(M, dtype=jnp.int32)
+        loc_all.append(jnp.where(is_pos, idx + b * M, -1))
+        score_all.append(jnp.where(is_pos | is_neg, idx + b * M, -1))
+        lbl = jnp.where(is_pos, lab[best_gt], 0)
+        lbl = jnp.where(is_pos | is_neg, lbl, -1)
+        lbl_all.append(lbl.astype(jnp.int32))
+        g = gt[best_gt]
+        aw = anchors[:, 2] - anchors[:, 0] + 1.0
+        ah = anchors[:, 3] - anchors[:, 1] + 1.0
+        acx = anchors[:, 0] + aw / 2
+        acy = anchors[:, 1] + ah / 2
+        gw = g[:, 2] - g[:, 0] + 1.0
+        gh = g[:, 3] - g[:, 1] + 1.0
+        gcx = (g[:, 2] + g[:, 0]) / 2
+        gcy = (g[:, 3] + g[:, 1]) / 2
+        tb = jnp.stack([(gcx - acx) / aw, (gcy - acy) / ah,
+                        jnp.log(gw / aw), jnp.log(gh / ah)], axis=1)
+        bbox_all.append(tb * is_pos[:, None])
+        w_all.append(is_pos.astype(jnp.float32)[:, None] *
+                     jnp.ones((1, 4), jnp.float32))
+        fg_cnt.append(jnp.sum(is_pos.astype(jnp.int32)))
+    ctx.set_output("LocationIndex",
+                   jnp.concatenate(loc_all).reshape(-1, 1))
+    ctx.set_output("ScoreIndex",
+                   jnp.concatenate(score_all).reshape(-1, 1))
+    ctx.set_output("TargetLabel",
+                   jnp.concatenate(lbl_all).reshape(-1, 1))
+    ctx.set_output("TargetBBox", jnp.concatenate(bbox_all, axis=0))
+    ctx.set_output("BBoxInsideWeight", jnp.concatenate(w_all, axis=0))
+    ctx.set_output("ForegroundNumber",
+                   jnp.stack(fg_cnt).reshape(-1, 1))
+
+
+@register_no_grad_op("distribute_fpn_proposals")
+def distribute_fpn_proposals(ctx):
+    """Route RoIs to FPN levels by scale (reference
+    distribute_fpn_proposals_op.h): lvl = floor(log2(sqrt(area) /
+    refer_scale) + refer_level), clipped to [min, max].
+
+    Static-shape: every level output has all R rows; rows not on that
+    level are zeroed and their index in RestoreIndex ordering puts real
+    rows first."""
+    rois = ctx.input("FpnRois")
+    min_level = ctx.attr("min_level", 2)
+    max_level = ctx.attr("max_level", 5)
+    refer_level = ctx.attr("refer_level", 4)
+    refer_scale = ctx.attr("refer_scale", 224)
+    R = rois.shape[0]
+    n_levels = max_level - min_level + 1
+    w = rois[:, 2] - rois[:, 0]
+    h = rois[:, 3] - rois[:, 1]
+    scale = jnp.sqrt(jnp.maximum(w * h, 1e-6))
+    lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-6)) + refer_level
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    # RestoreIndex: original row index of each emitted row, -1 padding
+    names = ctx.op.output("MultiFpnRois")
+    idx_rows = []
+    for li, nm in enumerate(names):
+        on = lvl == (min_level + li)
+        # stable-compact rows of this level to the front
+        perm = jnp.argsort(~on)
+        ctx.env[nm] = rois[perm] * on[perm][:, None]
+        idx_rows.append(jnp.where(on[perm], perm, -1))
+    ctx.set_output("RestoreIndex",
+                   jnp.concatenate(idx_rows).reshape(-1, 1))
+
+
+@register_no_grad_op("collect_fpn_proposals")
+def collect_fpn_proposals(ctx):
+    """Merge per-level RoIs, keep global top post_nms_topN by score
+    (reference collect_fpn_proposals_op.h)."""
+    rois_list = ctx.inputs("MultiLevelRois")
+    scores_list = ctx.inputs("MultiLevelScores")
+    post_nms = ctx.attr("post_nms_topN", 1000)
+    rois = jnp.concatenate(rois_list, axis=0)
+    scores = jnp.concatenate(
+        [s.reshape(-1) for s in scores_list])
+    k = min(post_nms, scores.shape[0])
+    top = jnp.argsort(-scores)[:k]
+    ctx.set_output("FpnRois", rois[top])
+
+
+@register_op("roi_perspective_transform", no_grad_slots=("ROIs",))
+def roi_perspective_transform(ctx):
+    """Perspective-warp quad RoIs to a fixed grid (reference
+    roi_perspective_transform_op.cc). RoIs are 8-value quads; output is
+    bilinear-sampled [R, C, out_h, out_w]."""
+    x = ctx.input("X")                       # [N, C, H, W]
+    rois = ctx.input("ROIs")                 # [R, 8] quad corners
+    out_h = ctx.attr("transformed_height", 1)
+    out_w = ctx.attr("transformed_width", 1)
+    spatial_scale = ctx.attr("spatial_scale", 1.0)
+    R = rois.shape[0]
+    ids = _roi_batch_ids(ctx, "ROIs", R, x.shape[0])
+
+    def one_roi(quad, bid):
+        q = quad.reshape(4, 2) * spatial_scale   # (x, y) x 4 corners
+        # bilinear interpolation of the quad edges (projective for
+        # rectangles; adequate warp for near-rectangular text quads)
+        u = (jnp.arange(out_w, dtype=x.dtype) + 0.5) / out_w
+        v = (jnp.arange(out_h, dtype=x.dtype) + 0.5) / out_h
+        ug, vg = jnp.meshgrid(u, v, indexing="xy")
+        top = q[0][None, None] * (1 - ug[..., None]) + \
+            q[1][None, None] * ug[..., None]
+        bot = q[3][None, None] * (1 - ug[..., None]) + \
+            q[2][None, None] * ug[..., None]
+        pts = top * (1 - vg[..., None]) + bot * vg[..., None]
+        return _bilinear_sample(x[bid], pts[..., 1], pts[..., 0])
+
+    out = jax.vmap(one_roi)(rois, ids)
+    ctx.set_output("Out", out)
+
+
+@register_no_grad_op("generate_mask_labels")
+def generate_mask_labels(ctx):
+    """Mask head targets (reference generate_mask_labels_op.cc):
+    rasterize the matched gt polygon (given here as its bounding box —
+    segmentation polygons are host data) into resolution x resolution
+    grids for fg RoIs."""
+    im_info = ctx.input("ImInfo")
+    gt_classes = ctx.input("GtClasses")
+    is_crowd = ctx.input("IsCrowd")
+    gt_segms = ctx.input("GtSegms")          # [S, 4] box-encoded masks
+    rois = ctx.input("Rois")
+    labels = ctx.input("LabelsInt32")
+    num_classes = ctx.attr("num_classes", 81)
+    resolution = ctx.attr("resolution", 14)
+    R = rois.shape[0]
+    lab = labels.reshape(-1)
+    seg = gt_segms.reshape(-1, 4)
+
+    iou = _pairwise_iou(rois, seg, normalized=False)
+    best = jnp.argmax(iou, axis=1)
+    g = seg[best]
+
+    ys = jnp.arange(resolution, dtype=rois.dtype)
+    xs = jnp.arange(resolution, dtype=rois.dtype)
+
+    def one(roi, gbox, l):
+        rw = jnp.maximum(roi[2] - roi[0], 1.0)
+        rh = jnp.maximum(roi[3] - roi[1], 1.0)
+        gx = roi[0] + (xs + 0.5) / resolution * rw
+        gy = roi[1] + (ys + 0.5) / resolution * rh
+        inside = ((gx[None, :] >= gbox[0]) & (gx[None, :] <= gbox[2]) &
+                  (gy[:, None] >= gbox[1]) & (gy[:, None] <= gbox[3]))
+        m = inside & (l > 0)
+        return m.astype(jnp.int32)
+
+    masks = jax.vmap(one)(rois, g, lab)      # [R, res, res]
+    # per-class layout [R, num_classes * res * res] like the reference
+    flat = masks.reshape(R, -1)
+    out = jnp.zeros((R, num_classes * resolution * resolution),
+                    jnp.int32)
+    col0 = jnp.clip(lab, 0, num_classes - 1) * resolution * resolution
+    cols = col0[:, None] + jnp.arange(resolution * resolution)[None, :]
+    out = out.at[jnp.arange(R)[:, None], cols].set(flat)
+    ctx.set_output("MaskRois", rois)
+    ctx.set_output("RoiHasMaskInt32",
+                   (lab > 0).astype(jnp.int32).reshape(-1, 1))
+    ctx.set_output("MaskInt32", out)
+
+
+# ---------------------------------------------------------------------------
+# detection mAP metric (eager: value-dependent accumulation, like the
+# reference's CPU-only registration, detection_map_op.cc)
+# ---------------------------------------------------------------------------
+
+def _np_iou(a, b):
+    ix1 = max(a[0], b[0]); iy1 = max(a[1], b[1])
+    ix2 = min(a[2], b[2]); iy2 = min(a[3], b[3])
+    iw = max(ix2 - ix1, 0.0); ih = max(iy2 - iy1, 0.0)
+    inter = iw * ih
+    ua = (a[2] - a[0]) * (a[3] - a[1]) + \
+        (b[2] - b[0]) * (b[3] - b[1]) - inter
+    return inter / ua if ua > 0 else 0.0
+
+
+@register_no_grad_op("detection_map")
+def detection_map(ctx):
+    """VOC mAP with accumulation state (reference detection_map_op.h).
+    Label rows: (label, difficult, x1, y1, x2, y2) or 5-col without
+    difficult; DetectRes rows: (label, score, x1, y1, x2, y2)."""
+    det = ctx.input("DetectRes")
+    label = ctx.input("Label")
+    if isinstance(det, jax.core.Tracer) or \
+            isinstance(label, jax.core.Tracer):
+        raise NotImplementedError(
+            "detection_map accumulates value-dependent per-class lists; "
+            "it runs eagerly (the reference registers it CPU-only)")
+    det = np.asarray(det)
+    label = np.asarray(label)
+    overlap_threshold = ctx.attr("overlap_threshold", 0.5)
+    evaluate_difficult = ctx.attr("evaluate_difficult", True)
+    ap_type = ctx.attr("ap_type", "integral")
+    class_num = ctx.attr("class_num")
+    det_segs = _lod_segments(ctx.get_lod("DetectRes"), det.shape[0])
+    lab_segs = _lod_segments(ctx.get_lod("Label"), label.shape[0])
+
+    pos_count = {c: 0 for c in range(class_num)}
+    true_pos = {c: [] for c in range(class_num)}
+    false_pos = {c: [] for c in range(class_num)}
+    has_state = ctx.input("HasState")
+    if has_state is not None and int(np.asarray(has_state).ravel()[0]):
+        pc = np.asarray(ctx.input("PosCount")).ravel()
+        for c in range(min(class_num, pc.shape[0])):
+            pos_count[c] = int(pc[c])
+        tp_in = np.asarray(ctx.input("TruePos")).reshape(-1, 2)
+        fp_in = np.asarray(ctx.input("FalsePos")).reshape(-1, 2)
+        for c, (s, e) in enumerate(
+                _lod_segments(ctx.get_lod("TruePos"), tp_in.shape[0])):
+            true_pos[c] = [list(r) for r in tp_in[s:e]]
+        for c, (s, e) in enumerate(
+                _lod_segments(ctx.get_lod("FalsePos"), fp_in.shape[0])):
+            false_pos[c] = [list(r) for r in fp_in[s:e]]
+
+    for (ds, de), (ls, le) in zip(det_segs, lab_segs):
+        gts = label[ls:le]
+        dets = det[ds:de]
+        per_class_gt = {}
+        for row in gts:
+            c = int(row[0])
+            if len(row) == 5:
+                difficult, box = 0.0, row[1:5]
+            else:
+                difficult, box = row[1], row[2:6]
+            if evaluate_difficult or not difficult:
+                pos_count[c] = pos_count.get(c, 0) + 1
+            per_class_gt.setdefault(c, []).append(
+                (list(map(float, box)), bool(difficult)))
+        order = np.argsort(-dets[:, 1], kind="stable")
+        matched = {c: [False] * len(v) for c, v in per_class_gt.items()}
+        for i in order:
+            c = int(dets[i, 0]); score = float(dets[i, 1])
+            box = dets[i, 2:6]
+            best, best_j = 0.0, -1
+            for j, (gb, diff) in enumerate(per_class_gt.get(c, [])):
+                ov = _np_iou(box, gb)
+                if ov > best:
+                    best, best_j = ov, j
+            if best >= overlap_threshold:
+                gb, diff = per_class_gt[c][best_j]
+                if not evaluate_difficult and diff:
+                    continue
+                if not matched[c][best_j]:
+                    matched[c][best_j] = True
+                    true_pos.setdefault(c, []).append([score, 1])
+                    false_pos.setdefault(c, []).append([score, 0])
+                else:
+                    true_pos.setdefault(c, []).append([score, 0])
+                    false_pos.setdefault(c, []).append([score, 1])
+            else:
+                true_pos.setdefault(c, []).append([score, 0])
+                false_pos.setdefault(c, []).append([score, 1])
+
+    m_ap, count = 0.0, 0
+    for c, npos in pos_count.items():
+        if npos == 0 or not true_pos.get(c):
+            continue
+        tps = sorted(true_pos[c], key=lambda r: -r[0])
+        fps = sorted(false_pos[c], key=lambda r: -r[0])
+        tp_acc = np.cumsum([r[1] for r in tps])
+        fp_acc = np.cumsum([r[1] for r in fps])
+        precision = tp_acc / np.maximum(tp_acc + fp_acc, 1e-12)
+        recall = tp_acc / npos
+        if ap_type == "11point":
+            # precision at recall >= j/10 (reference GetMaxPrecisions)
+            max_p = np.zeros(11)
+            for j in range(11):
+                mask = recall >= j / 10.0
+                if mask.any():
+                    max_p[j] = precision[mask].max()
+            m_ap += max_p.sum() / 11
+        else:
+            ap, prev_r = 0.0, 0.0
+            for r, p in zip(recall, precision):
+                if abs(r - prev_r) > 1e-6:
+                    ap += p * abs(r - prev_r)
+                    prev_r = r
+            m_ap += ap
+        count += 1
+    m_ap = m_ap / count if count else 0.0
+
+    ctx.set_output("MAP", jnp.asarray(m_ap, jnp.float32))
+    pc_rows = np.array([[pos_count.get(c, 0)] for c in range(class_num)],
+                       np.int32)
+    tp_rows, tp_lod = [], [0]
+    fp_rows, fp_lod = [], [0]
+    for c in range(class_num):
+        tp_rows += true_pos.get(c, [])
+        tp_lod.append(len(tp_rows))
+        fp_rows += false_pos.get(c, [])
+        fp_lod.append(len(fp_rows))
+    ctx.set_output("AccumPosCount", jnp.asarray(pc_rows))
+    ctx.set_output("AccumTruePos", jnp.asarray(
+        np.array(tp_rows, np.float32).reshape(-1, 2)))
+    ctx.set_output("AccumFalsePos", jnp.asarray(
+        np.array(fp_rows, np.float32).reshape(-1, 2)))
+    ctx.set_lod("AccumTruePos", [tp_lod])
+    ctx.set_lod("AccumFalsePos", [fp_lod])

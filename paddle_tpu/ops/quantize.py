@@ -1,0 +1,188 @@
+"""Quantization op family (reference operators/fake_quantize_op.cc:1,
+fake_dequantize_op.cc) — the substrate for contrib/slim QAT.
+
+All simulated-quantization lowerings bake the straight-through estimator
+into the forward expression (``smooth + stop_gradient(rounded - smooth)``)
+so the framework's generic vjp grads match the reference's pass-through
+gradient registrations without special grad ops. Running-scale state
+(window buffers, moving averages) is expressed functionally via stateful
+outputs, the same idiom as batch_norm's MeanOut/VarianceOut.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op, register_no_grad_op
+
+
+def _bin_cnt(bits: int) -> float:
+    return float((1 << (bits - 1)) - 1)
+
+
+def _ste(smooth, rounded):
+    return smooth + lax.stop_gradient(rounded - smooth)
+
+
+def _quant(x, scale, bin_cnt):
+    """Quantize to the integer grid (values stored float, reference
+    ClipAndFakeQuantFunctor): round(clip(x, -s, s) / s * bin_cnt)."""
+    s = jnp.maximum(scale, 1e-8)
+    lin = jnp.clip(x, -s, s) / s * bin_cnt
+    return _ste(lin, jnp.round(lin))
+
+
+def _quant_dequant(x, scale, bin_cnt):
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.round(jnp.clip(x, -s, s) / s * bin_cnt)
+    return _ste(x, q * s / bin_cnt)
+
+
+@register_op("fake_quantize_abs_max", intermediate_outputs=("OutScale",))
+def fake_quantize_abs_max(ctx):
+    x = ctx.input("X")
+    bits = ctx.attr("bit_length", 8)
+    scale = jnp.max(jnp.abs(x))
+    ctx.set_output("Out", _quant(x, scale, _bin_cnt(bits)))
+    ctx.set_output("OutScale", scale.reshape((1,)))
+
+
+@register_op("fake_quantize_dequantize_abs_max",
+             intermediate_outputs=("OutScale",))
+def fake_quantize_dequantize_abs_max(ctx):
+    x = ctx.input("X")
+    bits = ctx.attr("bit_length", 8)
+    scale = jnp.max(jnp.abs(x))
+    ctx.set_output("Out", _quant_dequant(x, scale, _bin_cnt(bits)))
+    ctx.set_output("OutScale", scale.reshape((1,)))
+
+
+@register_op("fake_channel_wise_quantize_abs_max",
+             intermediate_outputs=("OutScale",))
+def fake_channel_wise_quantize_abs_max(ctx):
+    x = ctx.input("X")
+    bits = ctx.attr("bit_length", 8)
+    # channel = dim 0 (reference fake_quantize_op.cc: conv filters
+    # [Cout, Cin, H, W] / fc weights transposed before the pass)
+    red = tuple(range(1, x.ndim))
+    scale = jnp.max(jnp.abs(x), axis=red)
+    bshape = (x.shape[0],) + (1,) * (x.ndim - 1)
+    ctx.set_output("Out", _quant(x, scale.reshape(bshape),
+                                 _bin_cnt(bits)))
+    ctx.set_output("OutScale", scale)
+
+
+@register_op("fake_dequantize_max_abs", no_grad_slots=("Scale",))
+def fake_dequantize_max_abs(ctx):
+    x, scale = ctx.input("X"), ctx.input("Scale")
+    max_range = ctx.attr("max_range", 127.0)
+    ctx.set_output("Out", x * scale.reshape(()) / max_range)
+
+
+@register_op("fake_channel_wise_dequantize_max_abs",
+             no_grad_slots=("Scales",))
+def fake_channel_wise_dequantize_max_abs(ctx):
+    x = ctx.input("X")
+    scales = ctx.inputs("Scales")
+    quant_bits = ctx.attr("quant_bits", [8])
+    out = x
+    # first scale: per-channel on dim 0; optional second: whole-tensor
+    s0 = scales[0]
+    bshape = (x.shape[0],) + (1,) * (x.ndim - 1)
+    out = out * s0.reshape(bshape) / _bin_cnt(quant_bits[0])
+    if len(scales) > 1:
+        out = out * scales[1].reshape(()) / _bin_cnt(
+            quant_bits[1] if len(quant_bits) > 1 else 8)
+    ctx.set_output("Out", out)
+
+
+@register_op("fake_quantize_range_abs_max",
+             no_grad_slots=("InScale", "Iter"),
+             intermediate_outputs=("OutScale",),
+             stateful_outputs=("OutScales", "IterOut"))
+def fake_quantize_range_abs_max(ctx):
+    """Windowed running max (reference FindRangeAbsMaxFunctor): circular
+    buffer OutScales[window], scale = max over the buffer."""
+    x = ctx.input("X")
+    bits = ctx.attr("bit_length", 8)
+    window = ctx.attr("window_size", 10000)
+    is_test = ctx.attr("is_test", False)
+    in_scale = ctx.input("InScale").reshape(())
+    if is_test:
+        ctx.set_output("Out", _quant(x, in_scale, _bin_cnt(bits)))
+        ctx.set_output("OutScale", in_scale.reshape((1,)))
+        return
+    cur = jnp.max(jnp.abs(x))
+    it = ctx.input("Iter")
+    buf = ctx.input("OutScales")
+    if buf is None:
+        buf = jnp.zeros((window,), x.dtype)
+    idx = (it.reshape(()) % window).astype(jnp.int32)
+    buf = buf.at[idx].set(cur)
+    scale = jnp.maximum(jnp.max(buf), 1e-8)
+    ctx.set_output("Out", _quant(x, scale, _bin_cnt(bits)))
+    ctx.set_output("OutScale", scale.reshape((1,)))
+    ctx.set_output("OutScales", buf)
+    ctx.set_output("IterOut", it + 1)
+
+
+def _moving_average_scale(ctx, x):
+    rho = ctx.attr("moving_rate", 0.9)
+    state = ctx.input("InState").reshape(())
+    accum = ctx.input("InAccum").reshape(())
+    cur = jnp.max(jnp.abs(x))
+    state_new = rho * state + 1.0
+    accum_new = rho * accum + cur
+    scale = accum_new / state_new
+    ctx.set_output("OutState", state_new.reshape((1,)))
+    ctx.set_output("OutAccum", accum_new.reshape((1,)))
+    ctx.set_output("OutScale", scale.reshape((1,)))
+    return scale
+
+
+@register_op("fake_quantize_moving_average_abs_max",
+             no_grad_slots=("InScale", "InAccum", "InState"),
+             intermediate_outputs=("OutScale",),
+             stateful_outputs=("OutAccum", "OutState"))
+def fake_quantize_moving_average_abs_max(ctx):
+    x = ctx.input("X")
+    bits = ctx.attr("bit_length", 8)
+    if ctx.attr("is_test", False):
+        scale = ctx.input("InScale").reshape(())
+        ctx.set_output("Out", _quant(x, scale, _bin_cnt(bits)))
+        ctx.set_output("OutScale", scale.reshape((1,)))
+        return
+    scale = _moving_average_scale(ctx, x)
+    ctx.set_output("Out", _quant(x, scale, _bin_cnt(bits)))
+
+
+@register_op("fake_quantize_dequantize_moving_average_abs_max",
+             no_grad_slots=("InScale", "InAccum", "InState"),
+             intermediate_outputs=("OutScale",),
+             stateful_outputs=("OutAccum", "OutState"))
+def fake_quantize_dequantize_moving_average_abs_max(ctx):
+    x = ctx.input("X")
+    bits = ctx.attr("bit_length", 8)
+    if ctx.attr("is_test", False):
+        scale = ctx.input("InScale").reshape(())
+        ctx.set_output("Out", _quant_dequant(x, scale, _bin_cnt(bits)))
+        ctx.set_output("OutScale", scale.reshape((1,)))
+        return
+    scale = _moving_average_scale(ctx, x)
+    ctx.set_output("Out", _quant_dequant(x, scale, _bin_cnt(bits)))
+
+
+@register_op("moving_average_abs_max_scale",
+             no_grad_slots=("InAccum", "InState"),
+             intermediate_outputs=("OutScale",),
+             stateful_outputs=("OutAccum", "OutState"))
+def moving_average_abs_max_scale(ctx):
+    x = ctx.input("X")
+    if ctx.attr("is_test", False):
+        ctx.set_output("Out", x)
+        return
+    _moving_average_scale(ctx, x)
+    ctx.set_output("Out", x)

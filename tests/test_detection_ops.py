@@ -1,0 +1,482 @@
+"""Detection op family vs numpy goldens (reference
+operators/detection/ + python tests test_prior_box_op.py,
+test_bipartite_match_op.py, test_multiclass_nms_op.py,
+test_detection_map_op.py)."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.layers import detection as det
+from paddle_tpu.core.scope import Scope, create_lod_tensor
+
+
+def _run(build, feeds, n_fetch=1):
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fetch = build()
+        if not isinstance(fetch, (list, tuple)):
+            fetch = [fetch]
+    with fluid.scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        return exe.run(main, feed=feeds,
+                       fetch_list=[f.name for f in fetch])
+
+
+# ------------------------------------------------------------- priors
+
+def test_prior_box_golden():
+    rng = np.random.RandomState(0)
+    feat = rng.rand(1, 8, 4, 4).astype(np.float32)
+    image = rng.rand(1, 3, 32, 32).astype(np.float32)
+
+    def build():
+        f = layers.data("feat", [8, 4, 4], dtype="float32")
+        im = layers.data("image", [3, 32, 32], dtype="float32")
+        boxes, var = det.prior_box(
+            f, im, min_sizes=[4.0], max_sizes=[8.0],
+            aspect_ratios=[2.0], flip=True, clip=True)
+        return boxes, var
+
+    boxes, var = _run(build, {"feat": feat, "image": image})
+    boxes = np.asarray(boxes)
+    assert boxes.shape == (4, 4, 4, 4)   # 1 + 1(max) + 2 ar = 4 priors
+    # golden for cell (0, 0): center (16/4 * 0.5) = 4 px
+    img_w = img_h = 32.0
+    sw = sh = 8.0
+    cx = cy = 0.5 * sw
+    exp = []
+    for (w2, h2) in [(2.0, 2.0),
+                     (4 * math.sqrt(2.0) / 2, 4 / math.sqrt(2.0) / 2),
+                     (4 * math.sqrt(0.5) / 2, 4 / math.sqrt(0.5) / 2),
+                     (math.sqrt(4.0 * 8.0) / 2,) * 2]:
+        exp.append([max((cx - w2) / img_w, 0), max((cy - h2) / img_h, 0),
+                    min((cx + w2) / img_w, 1), min((cy + h2) / img_h, 1)])
+    # order: [min, ar2, ar1/2, sqrt(min*max)] (non-mm-order: ars first
+    # incl 1.0 -> [1.0, 2.0, 0.5] then max)
+    got = boxes[0, 0]
+    exp_order = [exp[0], exp[1], exp[2], exp[3]]
+    np.testing.assert_allclose(got, exp_order, atol=1e-5)
+    v = np.asarray(var)
+    np.testing.assert_allclose(v[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+
+def test_anchor_generator_shapes():
+    rng = np.random.RandomState(0)
+    feat = rng.rand(1, 8, 3, 5).astype(np.float32)
+
+    def build():
+        f = layers.data("feat", [8, 3, 5], dtype="float32")
+        return det.anchor_generator(
+            f, anchor_sizes=[32.0, 64.0], aspect_ratios=[0.5, 1.0],
+            stride=[16.0, 16.0])
+
+    anchors, var = _run(build, {"feat": feat})
+    assert np.asarray(anchors).shape == (3, 5, 4, 4)
+    a = np.asarray(anchors)
+    # anchors centered on cell centers
+    centers_x = (a[..., 0] + a[..., 2]) / 2
+    np.testing.assert_allclose(centers_x[0, 0], [8.0] * 4, atol=1e-4)
+
+
+# ------------------------------------------------------------ box math
+
+def _iou_np(a, b):
+    ix1 = max(a[0], b[0]); iy1 = max(a[1], b[1])
+    ix2 = min(a[2], b[2]); iy2 = min(a[3], b[3])
+    iw = max(ix2 - ix1, 0); ih = max(iy2 - iy1, 0)
+    inter = iw * ih
+    ua = (a[2]-a[0])*(a[3]-a[1]) + (b[2]-b[0])*(b[3]-b[1]) - inter
+    return inter / ua if ua > 0 else 0.0
+
+
+def test_iou_similarity_golden():
+    x = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32)
+    y = np.array([[0, 0, 2, 2], [2, 2, 4, 4], [10, 10, 11, 11]],
+                 np.float32)
+
+    def build():
+        xv = layers.data("x", [4], dtype="float32")
+        yv = layers.data("y", [4], dtype="float32")
+        return det.iou_similarity(xv, yv)
+
+    out, = _run(build, {"x": x, "y": y})
+    ref = np.array([[_iou_np(a, b) for b in y] for a in x])
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
+
+
+def test_box_coder_encode_decode_roundtrip():
+    rng = np.random.RandomState(3)
+    prior = np.sort(rng.rand(5, 4).astype(np.float32) * 10, axis=-1)
+    pvar = np.full((5, 4), 0.5, np.float32)
+    target = np.sort(rng.rand(3, 4).astype(np.float32) * 10, axis=-1)
+
+    def build_enc():
+        p = layers.data("p", [4], dtype="float32")
+        v = layers.data("v", [4], dtype="float32")
+        t = layers.data("t", [4], dtype="float32")
+        return det.box_coder(p, v, t, code_type="encode_center_size")
+
+    enc, = _run(build_enc, {"p": prior, "v": pvar, "t": target})
+    enc = np.asarray(enc)
+    assert enc.shape == (3, 5, 4)
+
+    def build_dec():
+        p = layers.data("p", [4], dtype="float32")
+        v = layers.data("v", [4], dtype="float32")
+        t = layers.data("t", [5, 4], dtype="float32")
+        return det.box_coder(p, v, t, code_type="decode_center_size")
+
+    dec, = _run(build_dec, {"p": prior, "v": pvar, "t": enc})
+    # decode(encode(x)) == x for every prior pairing
+    ref = np.broadcast_to(target[:, None, :], (3, 5, 4))
+    np.testing.assert_allclose(np.asarray(dec), ref, atol=1e-3)
+
+
+def test_bipartite_match_golden():
+    # classic example from reference test_bipartite_match_op
+    dist = np.array([[0.1, 0.9, 0.3],
+                     [0.8, 0.2, 0.1]], np.float32)
+
+    def build():
+        d = layers.data("d", [3], dtype="float32")
+        return det.bipartite_match(d)
+
+    idx, mdist = _run(build, {"d": dist}, 2)
+    idx = np.asarray(idx)[0]
+    mdist = np.asarray(mdist)[0]
+    # greedy: max 0.9 at (0,1); then 0.8 at (1,0); col 2 unmatched
+    np.testing.assert_array_equal(idx, [1, 0, -1])
+    np.testing.assert_allclose(mdist, [0.8, 0.9, 0.0], atol=1e-6)
+
+
+def test_target_assign_3d_gathers_per_prior():
+    # encoded gt [num_gt=2, num_prior=3, 4]
+    enc = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    match = np.array([[1, -1, 0]], np.int32)
+
+    def build():
+        x = layers.data("x", [3, 4], dtype="float32")
+        m = layers.data("m", [3], dtype="int32")
+        return det.target_assign(x, m, mismatch_value=0)
+
+    out, w = _run(build, {"x": enc, "m": match}, 2)
+    out = np.asarray(out)[0]
+    np.testing.assert_allclose(out[0], enc[1, 0])   # match 1, prior 0
+    np.testing.assert_allclose(out[1], np.zeros(4))  # unmatched
+    np.testing.assert_allclose(out[2], enc[0, 2])   # match 0, prior 2
+    np.testing.assert_allclose(np.asarray(w)[0, :, 0], [1, 0, 1])
+
+
+def test_box_clip():
+    boxes = np.array([[[-5.0, -5.0, 20.0, 20.0]]], np.float32)
+    im_info = np.array([[10.0, 9.0, 1.0]], np.float32)
+
+    def build():
+        b = layers.data("b", [1, 4], dtype="float32")
+        i = layers.data("i", [3], dtype="float32")
+        return det.box_clip(b, i)
+
+    out, = _run(build, {"b": boxes, "i": im_info})
+    np.testing.assert_allclose(np.asarray(out)[0, 0],
+                               [0, 0, 8, 9], atol=1e-5)
+
+
+# ---------------------------------------------------------------- NMS
+
+def test_multiclass_nms_suppresses_overlaps():
+    boxes = np.array([[[0, 0, 10, 10],
+                       [0.5, 0.5, 10.5, 10.5],   # overlaps box 0
+                       [20, 20, 30, 30]]], np.float32)
+    scores = np.zeros((1, 2, 3), np.float32)
+    scores[0, 1] = [0.9, 0.85, 0.7]   # class 1 (class 0 = background)
+
+    def build():
+        b = layers.data("b", [3, 4], dtype="float32")
+        s = layers.data("s", [2, 3], dtype="float32")
+        return det.multiclass_nms(b, s, score_threshold=0.1,
+                                  nms_top_k=3, keep_top_k=3,
+                                  nms_threshold=0.5)
+
+    out, = _run(build, {"b": boxes, "s": scores})
+    rows = np.asarray(out.array if hasattr(out, "array") else out)
+    valid = rows[rows[:, 0] >= 0]
+    assert valid.shape[0] == 2          # overlap suppressed
+    np.testing.assert_allclose(sorted(valid[:, 1], reverse=True),
+                               [0.9, 0.7], atol=1e-5)
+
+
+def test_yolo_box_decodes():
+    rng = np.random.RandomState(0)
+    an = [10, 13, 16, 30]
+    x = rng.randn(1, 2 * (5 + 3), 4, 4).astype(np.float32)
+    img = np.array([[128, 128]], np.int32)
+
+    def build():
+        xv = layers.data("x", [2 * 8, 4, 4], dtype="float32")
+        iv = layers.data("img", [2], dtype="int32")
+        return det.yolo_box(xv, iv, an, 3, 0.01, 32)
+
+    boxes, scores = _run(build, {"x": x, "img": img}, 2)
+    boxes = np.asarray(boxes)
+    scores = np.asarray(scores)
+    assert boxes.shape == (1, 32, 4)
+    assert scores.shape == (1, 32, 3)
+    assert (boxes >= 0).all() and (boxes <= 127).all()
+
+
+# ---------------------------------------------------------------- ROI
+
+def test_roi_align_uniform_region():
+    # constant feature -> every pooled value equals the constant
+    x = np.full((1, 2, 8, 8), 3.5, np.float32)
+    rois = np.array([[0, 0, 7, 7]], np.float32)
+
+    def build():
+        xv = layers.data("x", [2, 8, 8], dtype="float32")
+        rv = layers.data("r", [4], dtype="float32")
+        helper_out = layers.roi_align(
+            xv, rv, pooled_height=2, pooled_width=2,
+            spatial_scale=1.0)
+        return helper_out
+
+    out, = _run(build, {"x": x, "r": rois})
+    np.testing.assert_allclose(np.asarray(out),
+                               np.full((1, 2, 2, 2), 3.5), atol=1e-5)
+
+
+def test_roi_pool_max():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 3, 3]], np.float32)
+
+    def build():
+        xv = layers.data("x", [1, 4, 4], dtype="float32")
+        rv = layers.data("r", [4], dtype="float32")
+        return layers.roi_pool(xv, rv, pooled_height=2,
+                               pooled_width=2, spatial_scale=1.0)
+
+    out = _run(build, {"x": x, "r": rois})[0]
+    np.testing.assert_allclose(np.asarray(out)[0, 0],
+                               [[5, 7], [13, 15]])
+
+
+def test_sigmoid_focal_loss_golden():
+    x = np.array([[0.5, -0.5]], np.float32)
+    label = np.array([[1]], np.int32)     # positive class index 1 -> c0
+    fg = np.array([1], np.int32)
+
+    def build():
+        xv = layers.data("x", [2], dtype="float32")
+        lv = layers.data("l", [1], dtype="int32")
+        fv = layers.data("f", [1], dtype="int32")
+        return det.sigmoid_focal_loss(xv, lv, fv, gamma=2.0,
+                                      alpha=0.25)
+
+    out, = _run(build, {"x": x, "l": label, "f": fg})
+    p = 1 / (1 + np.exp(-x[0]))
+    ref0 = 0.25 * (1 - p[0]) ** 2 * -np.log(p[0])          # pos class
+    ref1 = 0.75 * p[1] ** 2 * -np.log(1 - p[1])            # neg class
+    np.testing.assert_allclose(np.asarray(out)[0], [ref0, ref1],
+                               atol=1e-5)
+
+
+# ------------------------------------------------------ RPN pipeline
+
+def test_generate_proposals_smoke():
+    rng = np.random.RandomState(0)
+    H = W = 4
+    A = 3
+    scores = rng.rand(1, A, H, W).astype(np.float32)
+    deltas = (rng.randn(1, A * 4, H, W) * 0.1).astype(np.float32)
+    im_info = np.array([[64.0, 64.0, 1.0]], np.float32)
+    anchors = (rng.rand(H, W, A, 4) * 32).astype(np.float32)
+    anchors[..., 2:] += anchors[..., :2]   # valid boxes
+    variances = np.full((H, W, A, 4), 1.0, np.float32)
+
+    def build():
+        s = layers.data("s", [A, H, W], dtype="float32")
+        d = layers.data("d", [A * 4, H, W], dtype="float32")
+        i = layers.data("i", [3], dtype="float32")
+        a = layers.data("a", [W, A, 4], dtype="float32")
+        v = layers.data("v", [W, A, 4], dtype="float32")
+        rois, probs = det.generate_proposals(
+            s, d, i, a, v, pre_nms_top_n=20, post_nms_top_n=8,
+            nms_thresh=0.7, min_size=1.0)
+        return rois, probs
+
+    rois, probs = _run(build, {"s": scores, "d": deltas, "i": im_info,
+                               "a": anchors, "v": variances}, 2)
+    rois = np.asarray(rois.array if hasattr(rois, "array") else rois)
+    assert rois.shape == (8, 4)
+    # valid rois are inside the image
+    p = np.asarray(probs.array if hasattr(probs, "array") else probs)
+    valid = rois[p[:, 0] > 0]
+    assert (valid >= 0).all() and (valid <= 63).all()
+
+
+def test_distribute_collect_fpn_roundtrip():
+    rois = np.array([[0, 0, 20, 20],       # small -> low level
+                     [0, 0, 300, 300],     # big -> high level
+                     [0, 0, 60, 60]], np.float32)
+    scores = np.array([[0.3], [0.9], [0.5]], np.float32)
+
+    def build():
+        r = layers.data("r", [4], dtype="float32")
+        s = layers.data("s", [1], dtype="float32")
+        multi, restore = det.distribute_fpn_proposals(
+            r, min_level=2, max_level=5, refer_level=4,
+            refer_scale=224)
+        merged = det.collect_fpn_proposals(
+            multi, [s] * len(multi), 2, 5, post_nms_top_n=3)
+        return multi + [restore, merged]
+
+    outs = _run(build, {"r": rois, "s": scores}, 6)
+    restore = np.asarray(outs[4]).ravel()
+    # every original row appears exactly once among the levels
+    assert sorted([i for i in restore if i >= 0]) == [0, 1, 2]
+
+
+def test_detection_map_golden():
+    """The exact case from reference test_detection_map_op.py:80-99
+    (mAP integral = computed by the same golden algorithm)."""
+    label = np.array([[1, 0, 0.1, 0.1, 0.3, 0.3],
+                      [1, 1, 0.6, 0.6, 0.8, 0.8],
+                      [2, 0, 0.3, 0.3, 0.6, 0.5],
+                      [1, 0, 0.7, 0.1, 0.9, 0.3]], np.float32)
+    detect = np.array([
+        [1, 0.3, 0.1, 0.0, 0.4, 0.3], [1, 0.7, 0.0, 0.1, 0.2, 0.3],
+        [1, 0.9, 0.7, 0.6, 0.8, 0.8], [2, 0.8, 0.2, 0.1, 0.4, 0.4],
+        [2, 0.1, 0.4, 0.3, 0.7, 0.5], [1, 0.2, 0.8, 0.1, 1.0, 0.3],
+        [3, 0.2, 0.8, 0.1, 1.0, 0.3]], np.float32)
+
+    def build():
+        l = layers.data("l", [6], dtype="float32", lod_level=1)
+        d = layers.data("d", [6], dtype="float32", lod_level=1)
+        return det.detection_map(d, l, class_num=4,
+                                 overlap_threshold=0.3,
+                                 evaluate_difficult=True)
+
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        m = build()
+    with fluid.scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        out = exe.run(main, feed={
+            "l": create_lod_tensor(label, [[2, 2]]),
+            "d": create_lod_tensor(detect, [[3, 4]])},
+            fetch_list=[m.name])
+    # golden from the reference test's calc_map on tf_pos
+    got = float(np.asarray(out[0]))
+    assert 0.0 < got <= 1.0
+    np.testing.assert_allclose(got, 0.70833, atol=2e-3)
+
+
+def test_polygon_box_transform():
+    x = np.zeros((1, 8, 2, 2), np.float32)
+
+    def build():
+        xv = layers.data("x", [8, 2, 2], dtype="float32")
+        return det.polygon_box_transform(xv)
+
+    out, = _run(build, {"x": x})
+    out = np.asarray(out)
+    # offset 0 -> output is the 4*cell coordinate grid
+    np.testing.assert_allclose(out[0, 0], [[0, 4], [0, 4]])
+    np.testing.assert_allclose(out[0, 1], [[0, 0], [4, 4]])
+
+
+def test_target_assign_neg_indices_ignore_padding():
+    """-1 padding in NegIndices must not wrap to the last prior."""
+    x = np.zeros((1, 1), np.float32)
+    match = np.array([[-1, -1, -1, -1]], np.int32)
+    neg = np.array([[1], [-1], [-1], [-1]], np.int32)
+
+    def build():
+        xv = layers.data("x", [1], dtype="float32")
+        m = layers.data("m", [4], dtype="int32")
+        n = layers.data("n", [1], dtype="int32", lod_level=1)
+        return det.target_assign(xv, m, negative_indices=n,
+                                 mismatch_value=0)
+
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        out, w = build()
+    with fluid.scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        _, wv = exe.run(main, feed={
+            "x": x, "m": match,
+            "n": create_lod_tensor(neg, [[4]])},
+            fetch_list=[out.name, w.name])
+    np.testing.assert_allclose(np.asarray(wv)[0, :, 0], [0, 1, 0, 0])
+
+
+def test_generate_proposal_labels_runs_with_fg_fraction():
+    rng = np.random.RandomState(0)
+    rois = np.sort(rng.rand(12, 4).astype(np.float32) * 50, axis=-1)
+    gt_boxes = np.sort(rng.rand(3, 4).astype(np.float32) * 50, axis=-1)
+    gt_classes = rng.randint(1, 5, (3, 1)).astype(np.int32)
+    is_crowd = np.zeros((3, 1), np.int32)
+    im_info = np.array([[64.0, 64.0, 1.0]], np.float32)
+
+    def build():
+        r = layers.data("r", [4], dtype="float32", lod_level=1)
+        gc = layers.data("gc", [1], dtype="int32", lod_level=1)
+        cr = layers.data("cr", [1], dtype="int32", lod_level=1)
+        gb = layers.data("gb", [4], dtype="float32", lod_level=1)
+        ii = layers.data("ii", [3], dtype="float32")
+        return det.generate_proposal_labels(
+            r, gc, cr, gb, ii, batch_size_per_im=8, fg_fraction=0.25,
+            fg_thresh=0.1, class_nums=5, use_random=False)
+
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        outs = build()
+    with fluid.scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        got = exe.run(main, feed={
+            "r": create_lod_tensor(rois, [[12]]),
+            "gc": create_lod_tensor(gt_classes, [[3]]),
+            "cr": create_lod_tensor(is_crowd, [[3]]),
+            "gb": create_lod_tensor(gt_boxes, [[3]]),
+            "ii": im_info}, fetch_list=[o.name for o in outs])
+    rois_o = np.asarray(got[0].array if hasattr(got[0], "array")
+                        else got[0])
+    labels = np.asarray(got[1].array if hasattr(got[1], "array")
+                        else got[1]).ravel()
+    assert rois_o.shape == (8, 4)
+    assert labels.shape == (8,)
+    # fg labels (first 2 slots if matched) are in [1, 4]; padding -1
+    assert ((labels >= -1) & (labels < 5)).all()
+
+
+def test_multi_box_head_ratio_schedule():
+    rng = np.random.RandomState(0)
+    feats = [rng.rand(1, 4, s, s).astype(np.float32) for s in (8, 4, 2)]
+    image = rng.rand(1, 3, 64, 64).astype(np.float32)
+
+    def build():
+        im = layers.data("image", [3, 64, 64], dtype="float32")
+        fs = [layers.data(f"f{i}", list(f.shape[1:]), dtype="float32")
+              for i, f in enumerate(feats)]
+        locs, confs, boxes, vars_ = det.multi_box_head(
+            fs, im, base_size=64, num_classes=3,
+            aspect_ratios=[[2.0]] * 3, min_ratio=20, max_ratio=90)
+        return locs, confs, boxes, vars_
+
+    feeds = {"image": image}
+    feeds.update({f"f{i}": f for i, f in enumerate(feats)})
+    locs, confs, boxes, vars_ = _run(build, feeds, 4)
+    locs = np.asarray(locs)
+    boxes = np.asarray(boxes)
+    assert locs.shape[0] == 1 and locs.shape[2] == 4
+    assert boxes.shape[0] == locs.shape[1]   # one prior per loc row

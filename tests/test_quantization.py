@@ -1,0 +1,216 @@
+"""fake_quantize op family + contrib/slim QAT passes (reference
+operators/fake_quantize_op.cc:1,
+contrib/slim/quantization/quantization_pass.py:1,
+tests: test_fake_quantize_op.py / test_quantization_pass.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.contrib.slim.quantization import (
+    ConvertToInt8Pass, QuantizationFreezePass, QuantizationTransformPass)
+from paddle_tpu.core.scope import Scope
+
+
+def _run_op(op_type, inputs, outputs, attrs, feeds, fetch, scope=None):
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        block = main.global_block()
+        for n, arr in feeds.items():
+            block.create_var(name=n, shape=list(arr.shape),
+                             dtype=str(arr.dtype))
+        for n, shape, dtype in outputs:
+            block.create_var(name=n, shape=list(shape), dtype=dtype)
+        block.append_op(type=op_type, inputs=inputs,
+                        outputs={k: [v[0] for v in g] for k, g in
+                                 _group_outputs(outputs).items()},
+                        attrs=attrs, infer_shape=False)
+    sc = scope or Scope()
+    with fluid.scope_guard(sc):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        return exe.run(main, feed=feeds, fetch_list=fetch)
+
+
+def _group_outputs(outputs):
+    # outputs declared as (name, shape, dtype); slot name == var-name key
+    return {n: [(n, s, d)] for n, s, d in outputs}
+
+
+def _quant_ref(x, scale, bits=8):
+    bin_cnt = (1 << (bits - 1)) - 1
+    s = max(scale, 1e-8)
+    return np.round(np.clip(x, -s, s) / s * bin_cnt)
+
+
+def test_fake_quantize_abs_max_golden():
+    x = np.random.RandomState(0).uniform(-4, 4, (8, 5)).astype(np.float32)
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        b = main.global_block()
+        b.create_var(name="x", shape=[8, 5], dtype="float32")
+        b.create_var(name="out", shape=[8, 5], dtype="float32")
+        b.create_var(name="scale", shape=[1], dtype="float32")
+        b.append_op(type="fake_quantize_abs_max",
+                    inputs={"X": ["x"]},
+                    outputs={"Out": ["out"], "OutScale": ["scale"]},
+                    attrs={"bit_length": 8}, infer_shape=False)
+    with fluid.scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        out, scale = exe.run(main, feed={"x": x},
+                             fetch_list=["out", "scale"])
+    s = np.abs(x).max()
+    np.testing.assert_allclose(np.asarray(scale), [s], rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out), _quant_ref(x, s),
+                               atol=1e-4)
+
+
+def test_fake_channel_wise_quantize_golden():
+    w = np.random.RandomState(1).uniform(-2, 2, (4, 3, 2)).astype(
+        np.float32)
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        b = main.global_block()
+        b.create_var(name="w", shape=[4, 3, 2], dtype="float32")
+        b.create_var(name="out", shape=[4, 3, 2], dtype="float32")
+        b.create_var(name="scale", shape=[4], dtype="float32")
+        b.append_op(type="fake_channel_wise_quantize_abs_max",
+                    inputs={"X": ["w"]},
+                    outputs={"Out": ["out"], "OutScale": ["scale"]},
+                    attrs={"bit_length": 8}, infer_shape=False)
+    with fluid.scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        out, scale = exe.run(main, feed={"w": w},
+                             fetch_list=["out", "scale"])
+    s_ref = np.abs(w).max(axis=(1, 2))
+    np.testing.assert_allclose(np.asarray(scale), s_ref, rtol=1e-6)
+    ref = np.stack([_quant_ref(w[c], s_ref[c]) for c in range(4)])
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-4)
+
+
+def test_moving_average_state_and_ste_grad():
+    """Two runs evolve accum/state per the reference recursion, and the
+    straight-through estimator yields an identity gradient."""
+    rho = 0.9
+    x = np.random.RandomState(2).uniform(-1, 1, (6, 4)).astype(np.float32)
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        b = main.global_block()
+        xv = layers.data("x", [4], dtype="float32")
+        xv.stop_gradient = False
+        for n, shape in [("out", [-1, 4]), ("scale", [1]),
+                         ("accum", [1]), ("state", [1])]:
+            b.create_var(name=n, shape=shape, dtype="float32",
+                         persistable=n in ("scale", "accum", "state"))
+        b.append_op(
+            type="fake_quantize_dequantize_moving_average_abs_max",
+            inputs={"X": ["x"], "InScale": ["scale"],
+                    "InAccum": ["accum"], "InState": ["state"]},
+            outputs={"Out": ["out"], "OutScale": ["scale"],
+                     "OutAccum": ["accum"], "OutState": ["state"]},
+            attrs={"bit_length": 8, "moving_rate": rho,
+                   "is_test": False}, infer_shape=False)
+        loss = layers.reduce_sum(b.var("out"))
+        grads = fluid.gradients(loss, xv)
+    sc = Scope()
+    with fluid.scope_guard(sc):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        sc.var("scale").set_value(np.array([0.001], np.float32))
+        sc.var("accum").set_value(np.array([0.001], np.float32))
+        sc.var("state").set_value(np.array([1.0], np.float32))
+        g, = exe.run(main, feed={"x": x}, fetch_list=[grads[0].name])
+        accum1 = float(np.asarray(sc.find_var("accum").get_value())[0])
+        state1 = float(np.asarray(sc.find_var("state").get_value())[0])
+        exe.run(main, feed={"x": x}, fetch_list=["out"])
+        accum2 = float(np.asarray(sc.find_var("accum").get_value())[0])
+        state2 = float(np.asarray(sc.find_var("state").get_value())[0])
+    cur = float(np.abs(x).max())
+    assert np.isclose(accum1, rho * 0.001 + cur, rtol=1e-5)
+    assert np.isclose(state1, rho * 1.0 + 1.0, rtol=1e-6)
+    assert np.isclose(accum2, rho * accum1 + cur, rtol=1e-5)
+    assert np.isclose(state2, rho * state1 + 1.0, rtol=1e-6)
+    # STE: d sum(quant_dequant(x)) / dx == 1 inside the clip range
+    np.testing.assert_allclose(np.asarray(g), np.ones_like(x), atol=1e-6)
+
+
+def _blobs(n, seed):
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 4, size=(n, 1))
+    centers = np.array([[2, 2], [-2, 2], [2, -2], [-2, -2]], np.float32)
+    x = centers[y[:, 0]] + rng.normal(0, 0.6, (n, 2))
+    return x.astype(np.float32), y.astype(np.int64)
+
+
+def _classifier():
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [2], dtype="float32")
+        y = layers.data("y", [1], dtype="int64")
+        h = layers.fc(x, 32, act="relu")
+        logits = layers.fc(h, 4, act="softmax")
+        loss = layers.mean(layers.cross_entropy(logits, y))
+        acc = layers.accuracy(logits, y)
+    return main, startup, loss, acc, logits
+
+
+def _accuracy(exe, prog, acc_name, xs, ys):
+    return float(np.asarray(exe.run(
+        prog, feed={"x": xs, "y": ys}, fetch_list=[acc_name])[0]))
+
+
+@pytest.mark.parametrize("act_type", ["moving_average_abs_max",
+                                      "abs_max"])
+def test_qat_end_to_end(act_type):
+    """Reference QAT flow: transform -> train -> freeze -> accuracy holds
+    and weights land on the int8 grid."""
+    main, startup, loss, acc, _ = _classifier()
+    test_prog = main.clone(for_test=True)
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.AdamOptimizer(learning_rate=0.02).minimize(loss)
+
+    xs, ys = _blobs(256, 0)
+    sc = Scope()
+    with fluid.scope_guard(sc):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for _ in range(40):
+            exe.run(main, feed={"x": xs, "y": ys},
+                    fetch_list=[loss.name])
+        float_acc = _accuracy(exe, test_prog, acc.name, xs, ys)
+        assert float_acc > 0.9
+
+        tp = QuantizationTransformPass(
+            scope=sc, activation_quantize_type=act_type,
+            weight_quantize_type="abs_max")
+        tp.apply(main, for_test=False)
+        tp.apply(test_prog, for_test=act_type != "abs_max")
+        ops = [op.type for op in main.global_block().ops]
+        assert any(t.startswith("fake_quantize") for t in ops)
+        for _ in range(40):  # fine-tune with simulated quantization
+            exe.run(main, feed={"x": xs, "y": ys},
+                    fetch_list=[loss.name])
+        qat_acc = _accuracy(exe, test_prog, acc.name, xs, ys)
+        assert qat_acc > 0.9
+
+        QuantizationFreezePass(scope=sc).apply(test_prog)
+        frozen_acc = _accuracy(exe, test_prog, acc.name, xs, ys)
+        assert frozen_acc > 0.9
+        # weights are now on the int8 grid: w / (s/127) must be integers
+        w = np.asarray(sc.find_var("fc_0.w_0").get_value())
+        s = np.asarray(sc.find_var(
+            "fc_0.w_0.quant_scale").get_value()).reshape(())
+        grid = w / (s / 127.0)
+        np.testing.assert_allclose(grid, np.round(grid), atol=1e-3)
+
+        ConvertToInt8Pass(scope=sc).apply(test_prog)
+        w8 = np.asarray(sc.find_var("fc_0.w_0@int8").get_value())
+        assert w8.dtype == np.int8
+        np.testing.assert_allclose(w8, np.round(grid), atol=1.0)
