@@ -49,6 +49,7 @@ from .transpiler import (  # noqa: F401
     RoundRobin, memory_optimize, release_memory,
 )
 from . import incubate  # noqa: F401
+from . import inference  # noqa: F401
 from .core.flags import get_flags, set_flags  # noqa: F401
 from .core.enforce import EnforceNotMet, enforce  # noqa: F401
 

@@ -483,11 +483,54 @@ class Engine:
                         tuple(map(tuple, lods.get(name, [])))))
         return arrays, lods, tuple(sig)
 
+    def _is_multihost(self):
+        if self.mesh is None:
+            return False
+        procs = {d.process_index for d in self.mesh.devices.flat}
+        return procs != {jax.process_index()}
+
+    def _globalize(self, arrays):
+        """Multi-host SPMD (reference multi-trainer NCCL mode): each
+        process feeds its LOCAL batch shard; assemble global arrays
+        over the cross-process mesh so the one jitted step runs SPMD
+        with XLA collectives over the wire. Replicated inputs (params)
+        are globalized from identical per-process copies."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        nproc = jax.process_count()
+        batch = NamedSharding(self.mesh, P(self.data_axis))
+        out = {}
+        for n, a in arrays.items():
+            if a.ndim >= 1:
+                gshape = (a.shape[0] * nproc,) + tuple(a.shape[1:])
+                out[n] = jax.make_array_from_process_local_data(
+                    batch, np.asarray(a), gshape)
+            else:
+                out[n] = a
+        return out
+
+    def _globalize_replicated(self, params):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        repl = NamedSharding(self.mesh, P())
+        return {n: jax.make_array_from_process_local_data(
+                    repl, np.asarray(a), tuple(np.asarray(a).shape))
+                for n, a in params.items()}
+
     def run(self, program, scope: Scope, place, feed, fetch_names,
             block_idx: int = 0,
             return_numpy: bool = True) -> List[Any]:
         arrays, lods, feed_sig_key = self._normalize_feed(
             feed, None if self.mesh is not None else place)
+        multihost = self._is_multihost()
+        if multihost:
+            if lods:
+                raise NotImplementedError(
+                    "multihost SPMD cannot assemble LoD (ragged) feeds "
+                    "across processes; pad to dense first")
+            arrays = self._globalize(arrays)
+            feed_sig_key = tuple(
+                (n, tuple(arrays[n].shape), str(arrays[n].dtype),
+                 tuple(map(tuple, lods.get(n, []))))
+                for n in sorted(arrays))
         key = (program.fingerprint, block_idx, feed_sig_key,
                tuple(fetch_names), bool(FLAGS.check_nan_inf),
                int(getattr(program, "_gradient_accumulation_steps", 1)
@@ -508,6 +551,24 @@ class Engine:
             donated_params[n] = _scope_array(scope, n)
         for n in traced.const_names:
             const_params[n] = _scope_array(scope, n)
+        if multihost:
+            # params already produced by a previous multihost step are
+            # global arrays; only host-local values need assembling —
+            # and globalized const params are written back to the scope
+            # so the transfer happens once, not per step
+            def _as_global(n, v, write_back):
+                if isinstance(v, jax.Array) and \
+                        not v.is_fully_addressable:
+                    return v
+                g = self._globalize_replicated({n: v})[n]
+                if write_back:
+                    scope.var(n).set_value(g)
+                return g
+
+            donated_params = {n: _as_global(n, v, False)
+                              for n, v in donated_params.items()}
+            const_params = {n: _as_global(n, v, True)
+                            for n, v in const_params.items()}
 
         rng_key = _get_rng_state(scope, program)
         step_key, next_state = jax.random.split(rng_key)

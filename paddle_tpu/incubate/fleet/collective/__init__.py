@@ -48,10 +48,19 @@ class Collective(Fleet):
 
     def init_worker(self):
         """Multi-host bootstrap: jax.distributed.initialize from the
-        fleet env (PADDLE_TRAINER_ID / PADDLE_TRAINER_ENDPOINTS)."""
+        fleet env (PADDLE_TRAINER_ID / PADDLE_TRAINER_ENDPOINTS) —
+        PJRT's coordination service replaces the reference's
+        gen_nccl_id TCP exchange. On the CPU backend the gloo
+        collectives implementation links the processes (the harness
+        path, reference test_dist_base.py:449-502 subprocess
+        clusters)."""
         import jax
         if self.worker_num() > 1 and os.getenv(
                 "PADDLE_TPU_MULTIHOST", "0") == "1":
+            if os.getenv("JAX_PLATFORMS", "") == "cpu":
+                jax.config.update("jax_platforms", "cpu")
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo")
             eps = self.worker_endpoints()
             jax.distributed.initialize(
                 coordinator_address=eps[0],

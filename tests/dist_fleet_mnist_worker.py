@@ -1,0 +1,80 @@
+"""Worker body for the subprocess localhost cluster test (reference
+test_dist_base.py runtime_main / TestDistRunnerBase.run_trainer:
+each trainer process trains the same model on its batch shard and
+prints its losses for the driver to compare).
+
+Env contract (set by the driver): PADDLE_TRAINER_ID,
+PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ENDPOINTS, PADDLE_TPU_MULTIHOST=1,
+JAX_PLATFORMS=cpu.
+"""
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("XLA_FLAGS", None)   # one CPU device per process
+
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu import layers  # noqa: E402
+from paddle_tpu.core.scope import Scope  # noqa: E402
+from paddle_tpu.incubate.fleet.collective import (  # noqa: E402
+    DistributedStrategy, fleet)
+from paddle_tpu.incubate.fleet.base import role_maker  # noqa: E402
+
+
+def build():
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [8], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        h = layers.fc(x, 32, act="relu",
+                      param_attr=fluid.ParamAttr(name="w0"),
+                      bias_attr=fluid.ParamAttr(name="b0"))
+        pred = layers.fc(h, 1, param_attr=fluid.ParamAttr(name="w1"),
+                         bias_attr=fluid.ParamAttr(name="b1"))
+        loss = layers.mean(layers.square_error_cost(pred, y))
+    return main, startup, loss
+
+
+def main():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    nranks = int(os.environ["PADDLE_TRAINERS_NUM"])
+    fleet.init(role_maker.PaddleCloudRoleMaker(is_collective=True))
+    main_prog, startup, loss = build()
+    opt = fluid.optimizer.SGDOptimizer(learning_rate=0.1)
+    opt = fleet.distributed_optimizer(opt, DistributedStrategy())
+    with fluid.program_guard(main_prog, startup):
+        opt.minimize(loss)
+    fleet.init_worker()      # jax.distributed.initialize (THE bootstrap)
+    assert jax.process_count() == nranks, jax.process_count()
+
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        losses = []
+        for step in range(6):
+            # deterministic global batch; every rank takes its slice
+            rng = np.random.RandomState(100 + step)
+            gx = rng.rand(16, 8).astype(np.float32)
+            gy = gx.sum(1, keepdims=True).astype(np.float32) / 4
+            per = 16 // nranks
+            sl = slice(rank * per, (rank + 1) * per)
+            out = exe.run(fleet.main_program,
+                          feed={"x": gx[sl], "y": gy[sl]},
+                          fetch_list=[loss.name])
+            losses.append(float(np.asarray(out[0])))
+    print("LOSSES " + json.dumps(losses), flush=True)
+
+
+if __name__ == "__main__":
+    main()
