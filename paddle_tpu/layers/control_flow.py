@@ -12,7 +12,7 @@ from ..layer_helper import LayerHelper
 from ..proto import framework_pb2 as fpb
 from . import tensor as tensor_layers
 
-__all__ = ["While", "Switch", "array_write", "array_read",
+__all__ = ["While", "Switch", "py_func", "array_write", "array_read",
            "array_length", "create_array"]
 
 
@@ -131,4 +131,34 @@ def array_length(array):
     out = helper.create_variable_for_type_inference("int64", True)
     helper.append_op("lod_array_length", inputs={"X": array},
                      outputs={"Out": out})
+    return out
+
+
+# -- py_func (reference layers/nn.py py_func over py_func_op.cc) ----------
+py_func_registry = []
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    """Call a python function as an op (eager execution only — python
+    cannot live inside an XLA computation; the reference runs it on the
+    CPU thread for the same reason). `backward_func(*inputs, *outputs,
+    *out_grads)` supplies the custom gradient (py_func_grad op)."""
+    from ..layer_helper import LayerHelper
+    helper = LayerHelper("py_func")
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    py_func_registry.append(func)
+    attrs = {"forward_callable_id": len(py_func_registry) - 1}
+    if backward_func is not None:
+        py_func_registry.append(backward_func)
+        attrs["backward_callable_id"] = len(py_func_registry) - 1
+    if skip_vars_in_backward_input:
+        sk = skip_vars_in_backward_input
+        sk = sk if isinstance(sk, (list, tuple)) else [sk]
+        attrs["skip_vars_in_backward_input"] = [
+            v.name if hasattr(v, "name") else str(v) for v in sk]
+    helper.append_op(
+        "py_func", inputs={"X": list(xs)},
+        outputs={"Out": list(outs)}, attrs=attrs)
     return out

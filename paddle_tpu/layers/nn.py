@@ -16,6 +16,7 @@ from ..layer_helper import LayerHelper
 from ..param_attr import ParamAttr
 from ..initializer import Constant, Normal, Xavier
 from ..core.types import convert_dtype
+from .tensor import cast, scale, fill_constant
 
 __all__ = [
     "fc", "embedding", "conv2d", "conv3d", "conv2d_transpose",
@@ -46,7 +47,17 @@ __all__ = [
     "cos", "sin", "round", "reciprocal", "square", "hard_shrink",
     "softshrink", "thresholded_relu", "stanh",
     "beam_search", "beam_search_decode",
-    "roi_align", "roi_pool", "psroi_pool",
+    "roi_align", "roi_pool", "psroi_pool", "lod_reset",
+    "affine_grid", "deformable_conv", "spectral_norm",
+    "continuous_value_model", "fsp_matrix",
+    "similarity_focus", "center_loss", "unpool2d",
+    "adaptive_pool3d", "autoincreased_step_counter", "chunk_eval",
+    "deformable_roi_pooling", "dice_loss", "dynamic_lstmp",
+    "get_tensor_from_selected_rows", "image_resize_short",
+    "lod_append", "lstm", "mean_iou", "merge_selected_rows",
+    "npair_loss", "pad_constant_like", "random_crop", "rank",
+    "shard_index", "sign", "sum", "teacher_student_sigmoid_loss",
+    "topk", "tree_conv", "unique", "unique_with_counts",
 ]
 
 
@@ -1265,3 +1276,494 @@ def psroi_pool(input, rois, output_channels, spatial_scale,
                "pooled_height": pooled_height,
                "pooled_width": pooled_width})
     return out
+
+
+def lod_reset(x, y=None, target_lod=None):
+    """Reference nn.py lod_reset over lod_reset_op.cc."""
+    helper = LayerHelper("lod_reset")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": x}
+    attrs = {}
+    if y is not None:
+        inputs["Y"] = y
+    elif target_lod is not None:
+        attrs["target_lod"] = [int(v) for v in target_lod]
+    helper.append_op("lod_reset", inputs=inputs,
+                     outputs={"Out": out}, attrs=attrs)
+    return out
+
+
+def affine_grid(theta, out_shape=None, name=None):
+    """Reference nn.py affine_grid over affine_grid_op.cc."""
+    helper = LayerHelper("affine_grid", name=name)
+    out = helper.create_variable_for_type_inference(theta.dtype)
+    inputs = {"Theta": theta}
+    attrs = {}
+    from .. import framework as _fw
+    if isinstance(out_shape, _fw.Variable):
+        inputs["OutputShape"] = out_shape
+    else:
+        attrs["output_shape"] = [int(v) for v in out_shape]
+    helper.append_op("affine_grid", inputs=inputs,
+                     outputs={"Output": out}, attrs=attrs)
+    return out
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size,
+                    stride=1, padding=0, dilation=1, groups=1,
+                    deformable_groups=1, im2col_step=1,
+                    param_attr=None, bias_attr=None, name=None):
+    """Reference nn.py deformable_conv over deformable_conv_op.cc."""
+    helper = LayerHelper("deformable_conv", name=name,
+                         bias_attr=bias_attr)
+    ks = filter_size if isinstance(filter_size, (list, tuple)) \
+        else [filter_size] * 2
+    w = helper.create_parameter(
+        param_attr,
+        [num_filters, input.shape[1] // groups, ks[0], ks[1]],
+        input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "deformable_conv",
+        inputs={"Input": input, "Offset": offset, "Mask": mask,
+                "Filter": w},
+        outputs={"Output": out},
+        attrs={"strides": [stride] * 2 if isinstance(stride, int)
+               else list(stride),
+               "paddings": [padding] * 2 if isinstance(padding, int)
+               else list(padding),
+               "dilations": [dilation] * 2
+               if isinstance(dilation, int) else list(dilation),
+               "groups": groups,
+               "deformable_groups": deformable_groups,
+               "im2col_step": im2col_step})
+    return helper.append_bias_op(out, dim_start=1)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Reference nn.py spectral_norm over spectral_norm_op.cc."""
+    helper = LayerHelper("spectral_norm", name=name)
+    h = int(weight.shape[dim])
+    w = int(np.prod(weight.shape)) // h
+    import paddle_tpu.initializer as init
+    u = helper.create_parameter(None, [h], "float32")
+    v = helper.create_parameter(None, [w], "float32")
+    u.stop_gradient = True
+    v.stop_gradient = True
+    out = helper.create_variable_for_type_inference(weight.dtype)
+    helper.append_op(
+        "spectral_norm",
+        inputs={"Weight": weight, "U": u, "V": v},
+        outputs={"Out": out},
+        attrs={"dim": dim, "power_iters": power_iters, "eps": eps})
+    return out
+
+
+def continuous_value_model(input, cvm, use_cvm=True):
+    """Reference nn.py continuous_value_model over cvm_op.cc."""
+    helper = LayerHelper("cvm")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("cvm", inputs={"X": input, "CVM": cvm},
+                     outputs={"Y": out}, attrs={"use_cvm": use_cvm})
+    return out
+
+
+def fsp_matrix(x, y):
+    """Reference nn.py fsp_matrix over fsp_op.cc (distillation)."""
+    helper = LayerHelper("fsp")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("fsp", inputs={"X": x, "Y": y},
+                     outputs={"Out": out})
+    return out
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    """Reference nn.py similarity_focus."""
+    helper = LayerHelper("similarity_focus", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("similarity_focus", inputs={"X": input},
+                     outputs={"Out": out},
+                     attrs={"axis": axis,
+                            "indexes": [int(i) for i in indexes]})
+    return out
+
+
+def center_loss(input, label, num_classes, alpha,
+                param_attr=None, update_center=True):
+    """Reference nn.py center_loss over center_loss_op.cc."""
+    helper = LayerHelper("center_loss")
+    centers = helper.create_parameter(
+        param_attr, [num_classes, int(input.shape[-1])], input.dtype)
+    centers.stop_gradient = True
+    from . import tensor as _t
+    rate = _t.fill_constant([1], "float32", float(alpha))
+    loss = helper.create_variable_for_type_inference(input.dtype)
+    diff = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "center_loss",
+        inputs={"X": input, "Label": label, "Centers": centers,
+                "CenterUpdateRate": rate},
+        outputs={"Loss": loss, "CentersOut": centers,
+                 "SampleCenterDiff": diff},
+        attrs={"need_update": update_center})
+    return loss
+
+
+def unpool2d(input, indices, ksize, strides=None, paddings=None):
+    helper = LayerHelper("unpool")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "unpool", inputs={"X": input, "Indices": indices},
+        outputs={"Out": out},
+        attrs={"ksize": list(ksize),
+               "strides": list(strides or [1, 1]),
+               "paddings": list(paddings or [0, 0])})
+    return out
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max",
+                    require_index=False, name=None):
+    """Reference nn.py adaptive_pool3d: output bins of adaptive size."""
+    helper = LayerHelper("adaptive_pool3d", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ps = pool_size if isinstance(pool_size, (list, tuple)) \
+        else [pool_size] * 3
+    helper.append_op(
+        "pool3d", inputs={"X": input}, outputs={"Out": out},
+        attrs={"pooling_type": pool_type, "ksize": list(ps),
+               "adaptive": True})
+    return out
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """Reference nn.py: persistable counter incremented every step."""
+    from .tensor import fill_constant
+    helper = LayerHelper("global_step_counter")
+    name = counter_name or "@STEP_COUNTER@"
+    counter = helper.main_program.global_block()._find_var_recursive(
+        name)
+    if counter is None:
+        counter = helper.main_program.global_block().create_var(
+            name=name, dtype="int64", shape=[1], persistable=True)
+        helper.startup_program.global_block().create_var(
+            name=name, dtype="int64", shape=[1], persistable=True)
+        helper.startup_program.global_block().append_op(
+            "fill_constant", outputs={"Out": [name]},
+            attrs={"shape": [1], "dtype": counter.dtype,
+                   "value": float(begin - step)})
+    helper.append_op("increment", inputs={"X": [name]},
+                     outputs={"Out": [name]}, attrs={"step": float(step)})
+    counter.stop_gradient = True
+    return counter
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    """Reference nn.py chunk_eval over chunk_eval_op.cc."""
+    helper = LayerHelper("chunk_eval")
+    precision = helper.create_variable_for_type_inference("float32")
+    recall = helper.create_variable_for_type_inference("float32")
+    f1 = helper.create_variable_for_type_inference("float32")
+    n_infer = helper.create_variable_for_type_inference("int32")
+    n_label = helper.create_variable_for_type_inference("int32")
+    n_correct = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        "chunk_eval", inputs={"Inference": input, "Label": label},
+        outputs={"Precision": precision, "Recall": recall,
+                 "F1-Score": f1, "NumInferChunks": n_infer,
+                 "NumLabelChunks": n_label,
+                 "NumCorrectChunks": n_correct},
+        attrs={"num_chunk_types": num_chunk_types,
+               "chunk_scheme": chunk_scheme,
+               "excluded_chunk_types": excluded_chunk_types or []})
+    return precision, recall, f1, n_infer, n_label, n_correct
+
+
+def deformable_roi_pooling(input, rois, trans, no_trans=False,
+                           spatial_scale=1.0, group_size=(1, 1),
+                           pooled_height=1, pooled_width=1,
+                           part_size=None, sample_per_part=1,
+                           trans_std=0.1, position_sensitive=False,
+                           name=None):
+    helper = LayerHelper("deformable_psroi_pooling", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ph, pw = pooled_height, pooled_width
+    part = list(part_size) if part_size else [ph, pw]
+    out_dim = input.shape[1] // (group_size[0] * group_size[1]) \
+        if position_sensitive else input.shape[1]
+    helper.append_op(
+        "deformable_psroi_pooling",
+        inputs={"Input": input, "ROIs": rois, "Trans": trans},
+        outputs={"Output": out},
+        attrs={"no_trans": no_trans, "spatial_scale": spatial_scale,
+               "output_dim": int(out_dim),
+               "group_size": list(group_size),
+               "pooled_height": ph, "pooled_width": pw,
+               "part_size": part,
+               "sample_per_part": sample_per_part,
+               "trans_std": trans_std})
+    return out
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    """Reference nn.py dice_loss (composed, like the reference)."""
+    from . import math_ops as _m
+    label = one_hot(label, depth=input.shape[-1])
+    reduce_dims = list(range(1, len(input.shape)))
+    inse = reduce_sum(elementwise_mul(input, label), dim=reduce_dims)
+    dice_denominator = reduce_sum(input, dim=reduce_dims) + \
+        reduce_sum(label, dim=reduce_dims)
+    dice_score = 1 - inse * 2 / (dice_denominator + epsilon)
+    return mean(dice_score)
+
+
+def dynamic_lstmp(input, size, proj_size, param_attr=None,
+                  bias_attr=None, use_peepholes=True, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh", proj_activation="tanh",
+                  dtype="float32", name=None):
+    """Reference nn.py dynamic_lstmp over lstmp_op.cc."""
+    helper = LayerHelper("lstmp", name=name)
+    units = size // 4
+    w = helper.create_parameter(param_attr, [proj_size, 4 * units],
+                                dtype)
+    wp = helper.create_parameter(None, [units, proj_size], dtype)
+    bias_size = 7 * units if use_peepholes else 4 * units
+    b = helper.create_parameter(bias_attr, [1, bias_size], dtype)
+    proj = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        "lstmp",
+        inputs={"Input": input, "Weight": w, "ProjWeight": wp,
+                "Bias": b},
+        outputs={"Projection": proj, "Cell": cell},
+        attrs={"use_peepholes": use_peepholes,
+               "is_reverse": is_reverse,
+               "gate_activation": gate_activation,
+               "cell_activation": cell_activation,
+               "candidate_activation": candidate_activation,
+               "proj_activation": proj_activation})
+    return proj, cell
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    helper = LayerHelper("get_tensor_from_selected_rows", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("get_tensor_from_selected_rows",
+                     inputs={"X": x}, outputs={"Out": out})
+    return out
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """Reference nn.py image_resize_short: scale so the short side is
+    out_short_len."""
+    shape = input.shape
+    h, w = shape[2], shape[3]
+    short = min(h, w)
+    scale = out_short_len / float(short)
+    return image_resize(input,
+                        out_shape=[int(round(h * scale)),
+                                   int(round(w * scale))],
+                        resample=resample)
+
+
+def lod_append(x, level):
+    """Reference nn.py lod_append: append a finer lod level."""
+    helper = LayerHelper("lod_append")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": x}
+    attrs = {}
+    from .. import framework as _fw
+    if isinstance(level, _fw.Variable):
+        inputs["Y"] = level
+    else:
+        attrs["target_lod"] = [int(v) for v in level]
+    helper.append_op("lod_reset", inputs=inputs,
+                     outputs={"Out": out}, attrs=attrs)
+    return out
+
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
+         dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
+         default_initializer=None, seed=-1):
+    """Reference nn.py lstm (cudnn_lstm op): dense [B, T, D] batched
+    multi-layer LSTM."""
+    helper = LayerHelper("cudnn_lstm", name=name)
+    dtype = input.dtype
+    D = int(input.shape[-1])
+    num_dirs = 2 if is_bidirec else 1
+    weight_size = 0
+    for i in range(num_layers):
+        input_size = D if i == 0 else hidden_size * num_dirs
+        weight_size += (input_size + hidden_size) * hidden_size \
+            * 4 * num_dirs
+        weight_size += hidden_size * 8 * num_dirs
+    w = helper.create_parameter(default_initializer, [weight_size],
+                                dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    last_h = helper.create_variable_for_type_inference(dtype)
+    last_c = helper.create_variable_for_type_inference(dtype)
+    # lower via the scan lstm per layer (cudnn packing is an
+    # implementation detail of the reference's GPU path)
+    from . import rnn as _rnn_layers  # noqa: F401
+    helper.append_op(
+        "dense_lstm",
+        inputs={"Input": input, "InitH": init_h, "InitC": init_c,
+                "W": w},
+        outputs={"Out": out, "LastH": last_h, "LastC": last_c},
+        attrs={"hidden_size": hidden_size, "num_layers": num_layers,
+               "is_bidirec": is_bidirec,
+               "dropout_prob": dropout_prob, "is_test": is_test})
+    return out, last_h, last_c
+
+
+def mean_iou(input, label, num_classes):
+    helper = LayerHelper("mean_iou")
+    miou = helper.create_variable_for_type_inference("float32")
+    wrong = helper.create_variable_for_type_inference("int32")
+    correct = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        "mean_iou", inputs={"Predictions": input, "Labels": label},
+        outputs={"OutMeanIou": miou, "OutWrong": wrong,
+                 "OutCorrect": correct},
+        attrs={"num_classes": num_classes})
+    return miou, wrong, correct
+
+
+def merge_selected_rows(x, name=None):
+    helper = LayerHelper("merge_selected_rows", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("merge_selected_rows", inputs={"X": x},
+                     outputs={"Out": out})
+    return out
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """N-pair metric loss (reference nn.py npair_loss, composed the
+    same way): soft-label CE of anchor@positive^T against
+    same-label-normalized targets + L2 on the embeddings."""
+    from .loss import softmax_with_cross_entropy
+    from . import math_ops as _m
+    labels = cast(reshape(labels, [-1, 1]), "float32")
+    same = cast(_m.equal(labels, transpose(labels, perm=[1, 0])),
+                "float32")
+    targets = elementwise_div(
+        same, reduce_sum(same, dim=1, keep_dim=True))
+    similarity = matmul(anchor, positive, transpose_y=True)
+    ce = reduce_mean(softmax_with_cross_entropy(
+        similarity, targets, soft_label=True))
+    reg = scale(elementwise_add(
+        reduce_mean(reduce_sum(square(anchor), dim=1)),
+        reduce_mean(reduce_sum(square(positive), dim=1))),
+        scale=l2_reg * 0.25)
+    return elementwise_add(ce, reg)
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    helper = LayerHelper("pad_constant_like", name=name)
+    out = helper.create_variable_for_type_inference(y.dtype)
+    helper.append_op("pad_constant_like",
+                     inputs={"X": x, "Y": y}, outputs={"Out": out},
+                     attrs={"pad_value": float(pad_value)})
+    return out
+
+
+def random_crop(x, shape, seed=None):
+    helper = LayerHelper("random_crop")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("random_crop", inputs={"X": x},
+                     outputs={"Out": out},
+                     attrs={"shape": list(shape),
+                            "startup_seed": seed or 0})
+    return out
+
+
+def rank(input):
+    """Reference nn.py rank: ndim as a constant tensor."""
+    from .tensor import fill_constant
+    return fill_constant([1], "int32", len(input.shape))
+
+
+def shard_index(input, index_num, nshards, shard_id,
+                ignore_value=-1):
+    helper = LayerHelper("shard_index")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op("shard_index", inputs={"X": input},
+                     outputs={"Out": out},
+                     attrs={"index_num": index_num, "nshards": nshards,
+                            "shard_id": shard_id,
+                            "ignore_value": ignore_value})
+    return out
+
+
+def sign(x):
+    helper = LayerHelper("sign")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op("sign", inputs={"X": x}, outputs={"Out": out})
+    return out
+
+
+def sum(x):
+    helper = LayerHelper("sum")
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    out = helper.create_variable_for_type_inference(xs[0].dtype)
+    helper.append_op("sum", inputs={"X": list(xs)},
+                     outputs={"Out": out})
+    return out
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    helper = LayerHelper("teacher_student_sigmoid_loss")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "teacher_student_sigmoid_loss",
+        inputs={"X": input, "Label": label}, outputs={"Y": out},
+        attrs={"soft_max_up_bound": soft_max_up_bound,
+               "soft_max_lower_bound": soft_max_lower_bound})
+    return out
+
+
+def topk(input, k, name=None):
+    return top_k(input, k, name=name)
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
+              max_depth=2, act="tanh", param_attr=None,
+              bias_attr=None, name=None):
+    helper = LayerHelper("tree_conv", name=name,
+                         bias_attr=bias_attr, act=act)
+    feature_size = int(nodes_vector.shape[-1])
+    w = helper.create_parameter(
+        param_attr, [feature_size, 3, output_size, num_filters],
+        nodes_vector.dtype)
+    out = helper.create_variable_for_type_inference(
+        nodes_vector.dtype)
+    helper.append_op(
+        "tree_conv",
+        inputs={"NodesVector": nodes_vector, "EdgeSet": edge_set,
+                "Filter": w},
+        outputs={"Out": out}, attrs={"max_depth": max_depth})
+    return helper.append_activation(out)
+
+
+def unique(x, dtype="int32"):
+    helper = LayerHelper("unique")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    index = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("unique", inputs={"X": x},
+                     outputs={"Out": out, "Index": index},
+                     attrs={"dtype": dtype})
+    return out, index
+
+
+def unique_with_counts(x, dtype="int32"):
+    helper = LayerHelper("unique_with_counts")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    index = helper.create_variable_for_type_inference(dtype)
+    count = helper.create_variable_for_type_inference(dtype)
+    helper.append_op("unique_with_counts", inputs={"X": x},
+                     outputs={"Out": out, "Index": index,
+                              "Count": count},
+                     attrs={"dtype": dtype})
+    return out, index, count

@@ -25,3 +25,4 @@ from . import beam_search  # noqa: F401
 from . import nlp  # noqa: F401
 from . import quantize  # noqa: F401
 from . import detection  # noqa: F401
+from . import misc  # noqa: F401

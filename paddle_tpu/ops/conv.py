@@ -40,12 +40,14 @@ def _conv_nd(ctx, nd, depthwise=False):
         (f"NC{spatial}", f"OI{spatial}", f"NC{spatial}"))
     res_t = jnp.result_type(x)
     x, w = amp_cast("conv2d", x, w)
-    acc = jnp.float32 if jnp.result_type(x) in (jnp.bfloat16,
-                                                jnp.float16) else None
+    # no explicit preferred_element_type under AMP: the conv transpose
+    # rule would convolve the fp32 cotangent against bf16 operands
+    # (mixed-dtype error); the MXU accumulates bf16 convs in fp32
+    # natively, so low-precision inputs lose nothing
     out = lax.conv_general_dilated(
         x, w, window_strides=strides, padding=pad_cfg,
         rhs_dilation=dilations, dimension_numbers=dn,
-        feature_group_count=groups, preferred_element_type=acc or res_t)
+        feature_group_count=groups)
     ctx.set_output("Output", out.astype(res_t))
 
 
@@ -91,8 +93,7 @@ def _conv_transpose_nd(ctx, nd):
     out = lax.conv_general_dilated(
         x, w_t, window_strides=[1] * nd, padding=pad_cfg,
         lhs_dilation=strides, rhs_dilation=dilations,
-        dimension_numbers=dn, feature_group_count=groups,
-        preferred_element_type=res_t)
+        dimension_numbers=dn, feature_group_count=groups)
     ctx.set_output("Output", out.astype(res_t))
 
 

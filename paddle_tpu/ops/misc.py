@@ -1,0 +1,1523 @@
+"""Long-tail operator coverage (reference single-file ops at
+operators/ root + fused/ compositions): affine_grid, grid_sampler's op
+form, conv_shift, cvm, center_loss, fsp, spectral_norm, unpool,
+max_pool3d_with_index, modified_huber_loss, teacher_student_sigmoid
+_loss, pad_constant_like, sign, fill, lod_reset, row_conv, lstmp,
+similarity_focus, tree_conv, deformable_conv(+psroi), the fusion_*
+family (compositions — XLA re-fuses them anyway; registered for program
+compatibility), save/load ops, py_func, chunk_eval, and parity aliases
+(sync_batch_norm, conditional_block_infer, lookup_sparse_table,
+feed/fetch, get_places, rnn_memory_helper).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import OPS, ExecContext, register_op, \
+    register_no_grad_op
+
+
+# ---------------------------------------------------------------------------
+# simple math / shape ops
+# ---------------------------------------------------------------------------
+
+@register_op("sign")
+def sign(ctx):
+    ctx.set_output("Out", jnp.sign(ctx.input("X")))
+
+
+@register_no_grad_op("fill")
+def fill(ctx):
+    shape = [int(s) for s in ctx.attr("shape")]
+    value = ctx.attr("value", [])
+    dtype = ctx.attr("dtype", 5)
+    from ..core.types import dtype_to_np
+    arr = jnp.asarray(np.asarray(value, dtype_to_np(dtype))
+                      .reshape(shape))
+    ctx.set_output("Out", arr)
+
+
+@register_no_grad_op("fill_zeros_like2")
+def fill_zeros_like2(ctx):
+    x = ctx.input("X")
+    from ..core.types import dtype_to_np
+    dt = ctx.attr("dtype", None)
+    dtype = dtype_to_np(dt) if dt is not None else x.dtype
+    ctx.set_output("Out", jnp.zeros(x.shape, dtype))
+
+
+@register_op("pad_constant_like", no_grad_slots=("X",))
+def pad_constant_like(ctx):
+    """Pad Y up to X's shape with pad_value (reference
+    pad_constant_like_op.cc): grad flows to Y only."""
+    x, y = ctx.input("X"), ctx.input("Y")
+    pad_value = ctx.attr("pad_value", 0.0)
+    pads = [(0, int(xs - ys)) for xs, ys in zip(x.shape, y.shape)]
+    ctx.set_output("Out", jnp.pad(y, pads, constant_values=pad_value))
+
+
+@register_no_grad_op("lod_reset")
+def lod_reset(ctx):
+    """Replace X's LoD with Y's (or target_lod attr) — host metadata
+    only (reference lod_reset_op.cc)."""
+    x = ctx.input("X")
+    ctx.set_output("Out", x)
+    if ctx.has_input("Y"):
+        ylod = ctx.get_lod("Y")
+        if ylod:
+            ctx.set_lod("Out", ylod)
+        else:
+            y = ctx.input("Y")
+            if not isinstance(y, jax.core.Tracer):
+                offs = [int(v) for v in np.asarray(y).reshape(-1)]
+                ctx.set_lod("Out", [offs])
+    else:
+        tl = [int(v) for v in ctx.attr("target_lod", [])]
+        if tl:
+            ctx.set_lod("Out", [tl])
+
+
+@register_op("conv_shift")
+def conv_shift(ctx):
+    """Circular correlation (reference conv_shift_op.cc, NTM shift):
+    Out[i] = sum_{j=-(N-1)/2}^{(N-1)/2} X[(i+j) mod M] * Y[j+(N-1)/2]."""
+    x, y = ctx.input("X"), ctx.input("Y")     # [B, M], [B, N]
+    M, N = x.shape[1], y.shape[1]
+    half = (N - 1) // 2
+    idx = (jnp.arange(M)[:, None] +
+           jnp.arange(-half, N - half)[None, :]) % M   # [M, N]
+    ctx.set_output("Out", jnp.einsum("bmn,bn->bm", x[:, idx], y))
+
+
+@register_op("cvm", no_grad_slots=("CVM",))
+def cvm(ctx):
+    """Click-value model feature adjust (reference cvm_op.cc): first two
+    columns are (show, click); use_cvm=True log-transforms them,
+    False drops them."""
+    x = ctx.input("X")
+    use_cvm = ctx.attr("use_cvm", True)
+    if use_cvm:
+        head = jnp.log(jnp.maximum(x[:, :2], 0.0) + 1.0)
+        out = jnp.concatenate([head, x[:, 2:]], axis=1)
+    else:
+        out = x[:, 2:]
+    ctx.set_output("Y", out)
+
+
+@register_op("fsp", no_grad_slots=())
+def fsp(ctx):
+    """FSP matrix for distillation (reference fsp_op.cc):
+    Out[n, i, j] = sum_hw X[n,i,h,w] * Y[n,j,h,w] / (H*W)."""
+    x, y = ctx.input("X"), ctx.input("Y")
+    h, w = x.shape[2], x.shape[3]
+    ctx.set_output("Out",
+                   jnp.einsum("nihw,njhw->nij", x, y) / (h * w))
+
+
+@register_op("modified_huber_loss", no_grad_slots=("Y",),
+             intermediate_outputs=("IntermediateVal",))
+def modified_huber_loss(ctx):
+    """Reference modified_huber_loss_op.cc: y in {0,1} -> {-1,1};
+    L = max(0, 1 - yf)^2 if yf >= -1 else -4 yf."""
+    x = ctx.input("X")
+    y = ctx.input("Y").astype(x.dtype) * 2.0 - 1.0
+    prod = x * y
+    loss = jnp.where(prod >= -1.0,
+                     jnp.square(jnp.maximum(0.0, 1.0 - prod)),
+                     -4.0 * prod)
+    ctx.set_output("IntermediateVal", prod)
+    ctx.set_output("Out", loss)
+
+
+@register_op("teacher_student_sigmoid_loss", no_grad_slots=("Label",))
+def teacher_student_sigmoid_loss(ctx):
+    """Reference teacher_student_sigmoid_loss_op.cc: CTR click BCE plus
+    teacher-score BCE, with the combined label encoding
+    {-2: z=0 no teacher, -1: z=1 no teacher, [0,1): z=0 + z',
+    [1,2): z=1 + z'}."""
+    x = ctx.input("X").reshape(-1)
+    label = ctx.input("Label").astype(x.dtype).reshape(-1)
+
+    def bce(logit, t):
+        return jnp.maximum(logit, 0) - logit * t + \
+            jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+    z = jnp.where(label < 0, label + 2.0,             # -2 -> 0, -1 -> 1
+                  jnp.where(label < 1.0, 0.0, 1.0))
+    has_teacher = label >= 0
+    zprime = jnp.where(label < 1.0, label, label - 1.0)
+    loss = bce(x, z) + jnp.where(has_teacher, bce(x, zprime), 0.0)
+    ctx.set_output("Y", loss.reshape(-1, 1))
+
+
+@register_op("center_loss",
+             no_grad_slots=("Label", "Centers", "CenterUpdateRate"),
+             stateful_outputs=("CentersOut",),
+             intermediate_outputs=("SampleCenterDiff",))
+def center_loss(ctx):
+    """Reference center_loss_op.cc: L = |x - c_y|^2 / 2; centers move
+    toward their class mean at rate alpha when need_update."""
+    x = ctx.input("X")                        # [N, D]
+    label = ctx.input("Label").reshape(-1).astype(jnp.int32)
+    centers = ctx.input("Centers")            # [C, D]
+    alpha = ctx.input("CenterUpdateRate").reshape(())
+    need_update = ctx.attr("need_update", True)
+    diff = x - centers[label]
+    ctx.set_output("SampleCenterDiff", diff)
+    ctx.set_output("Loss",
+                   0.5 * jnp.sum(jnp.square(diff), axis=1,
+                                 keepdims=True))
+    if need_update:
+        # reference: c_j -= alpha * sum_{y_i=j}(c_j - x_i) / (1 + count_j)
+        C = centers.shape[0]
+        cnt = jnp.zeros((C,), x.dtype).at[label].add(1.0)
+        delta = jnp.zeros_like(centers).at[label].add(-diff)
+        centers_new = centers - alpha * delta / (1.0 + cnt)[:, None]
+        ctx.set_output("CentersOut", centers_new)
+    else:
+        ctx.set_output("CentersOut", centers)
+
+
+@register_op("spectral_norm", no_grad_slots=("U", "V"))
+def spectral_norm(ctx):
+    """Reference spectral_norm_op.cc: power-iteration estimate of the
+    largest singular value; Out = Weight / sigma."""
+    w = ctx.input("Weight")
+    u = ctx.input("U").reshape(-1)
+    v = ctx.input("V").reshape(-1)
+    dim = ctx.attr("dim", 0)
+    power_iters = ctx.attr("power_iters", 1)
+    eps = ctx.attr("eps", 1e-12)
+    perm = (dim,) + tuple(i for i in range(w.ndim) if i != dim)
+    wm = jnp.transpose(w, perm).reshape(w.shape[dim], -1)
+
+    def _l2(a):
+        return a / (jnp.linalg.norm(a) + eps)
+
+    for _ in range(max(power_iters, 0)):
+        v = _l2(wm.T @ u)
+        u = _l2(wm @ v)
+    u_s, v_s = lax.stop_gradient(u), lax.stop_gradient(v)
+    sigma = u_s @ wm @ v_s
+    ctx.set_output("Out", w / sigma)
+
+
+@register_op("similarity_focus", no_grad_slots=())
+def similarity_focus(ctx):
+    """Reference similarity_focus_op.h: for each selected channel,
+    greedily pick per-(row,col)-unique maxima of the [A, B] slice and
+    light up those rows+columns across ALL channels."""
+    x = ctx.input("X")                        # [N, C, A, B]
+    axis = ctx.attr("axis", 1)
+    indexes = [int(i) for i in ctx.attr("indexes")]
+    if axis != 1:
+        raise NotImplementedError("similarity_focus: axis=1 only "
+                                  "(the reference's primary mode)")
+    N, C, A, B = x.shape
+    K = min(A, B)
+
+    def per_slice(sl):                        # [A, B] -> row/col masks
+        def body(_, st):
+            rows, cols = st
+            m = (~rows[:, None]) & (~cols[None, :])
+            flat = jnp.where(m, sl, -jnp.inf).reshape(-1)
+            k = jnp.argmax(flat)
+            return rows.at[k // B].set(True), cols.at[k % B].set(True)
+
+        rows0 = jnp.zeros((A,), bool)
+        cols0 = jnp.zeros((B,), bool)
+        rows, cols = lax.fori_loop(0, K, body, (rows0, cols0))
+        return rows[:, None] | cols[None, :]
+
+    def per_image(xi):
+        mask = jnp.zeros((A, B), bool)
+        for i in indexes:
+            mask = mask | per_slice(xi[i])
+        return jnp.broadcast_to(mask[None], (C, A, B))
+
+    out = jax.vmap(per_image)(x).astype(x.dtype)
+    ctx.set_output("Out", out)
+
+
+@register_op("row_conv")
+def row_conv(ctx):
+    """Lookahead row convolution over sequences (reference
+    row_conv_op.cc): out[t] = sum_{j=0}^{k-1} w[j] * x[t+j], zero past
+    the sequence end. LoD input [T, D] or batched [B, T, D]."""
+    x = ctx.input("X")
+    w = ctx.input("Filter")                   # [k, D]
+    k = w.shape[0]
+    lod = ctx.get_lod("X")
+    if x.ndim == 3:                           # batched dense form
+        pads = ((0, 0), (0, k - 1), (0, 0))
+        xp = jnp.pad(x, pads)
+        out = sum(xp[:, j:j + x.shape[1]] * w[j] for j in range(k))
+        ctx.set_output("Out", out)
+        return
+    segs = []
+    offs = lod[0] if lod else [0, x.shape[0]]
+    for s, e in zip(offs[:-1], offs[1:]):
+        seg = x[s:e]
+        xp = jnp.pad(seg, ((0, k - 1), (0, 0)))
+        segs.append(sum(xp[j:j + seg.shape[0]] * w[j]
+                        for j in range(k)))
+    out = jnp.concatenate(segs, axis=0) if len(segs) > 1 else segs[0]
+    ctx.set_output("Out", out)
+    if lod:
+        ctx.set_lod("Out", lod)
+
+
+@register_op("unpool", no_grad_slots=("Indices",))
+def unpool(ctx):
+    """Max-unpool 2D by indices (reference unpool_op.cc)."""
+    x = ctx.input("X")                        # [N, C, H, W]
+    idx = ctx.input("Indices").astype(jnp.int32)
+    ksize = ctx.attr("ksize")
+    strides = ctx.attr("strides", [1, 1])
+    paddings = ctx.attr("paddings", [0, 0])
+    N, C, H, W = x.shape
+    out_h = (H - 1) * strides[0] - 2 * paddings[0] + ksize[0]
+    out_w = (W - 1) * strides[1] - 2 * paddings[1] + ksize[1]
+
+    def per_map(xm, im):                      # [H, W] each
+        flat = jnp.zeros((out_h * out_w,), x.dtype)
+        return flat.at[im.reshape(-1)].add(xm.reshape(-1)) \
+            .reshape(out_h, out_w)
+
+    out = jax.vmap(jax.vmap(per_map))(x, idx)
+    ctx.set_output("Out", out)
+
+
+@register_op("max_pool3d_with_index",
+             intermediate_outputs=("Mask",))
+def max_pool3d_with_index(ctx):
+    """Reference pool_with_index_op.cc (3D): max pool + argmax mask."""
+    x = ctx.input("X")                        # [N, C, D, H, W]
+    ks = ctx.attr("ksize")
+    st = ctx.attr("strides", [1, 1, 1])
+    pd = ctx.attr("paddings", [0, 0, 0])
+    if ctx.attr("global_pooling", False):
+        ks = list(x.shape[2:])
+        pd = [0, 0, 0]
+    neg = jnp.finfo(x.dtype).min
+    xp = jnp.pad(x, ((0, 0), (0, 0)) + tuple(
+        (p, p) for p in pd), constant_values=neg)
+    # linear index map of the padded volume back to unpadded coords
+    D, H, W = x.shape[2:]
+    Dp, Hp, Wp = xp.shape[2:]
+    lin = (jnp.arange(Dp)[:, None, None] - pd[0]) * (H * W) + \
+          (jnp.arange(Hp)[None, :, None] - pd[1]) * W + \
+          (jnp.arange(Wp)[None, None, :] - pd[2])
+
+    od = (Dp - ks[0]) // st[0] + 1
+    oh = (Hp - ks[1]) // st[1] + 1
+    ow = (Wp - ks[2]) // st[2] + 1
+
+    def pool_one(xm):                         # [Dp, Hp, Wp]
+        def win(i, j, k):
+            sl = lax.dynamic_slice(
+                xm, (i * st[0], j * st[1], k * st[2]), tuple(ks))
+            ln = lax.dynamic_slice(
+                lin, (i * st[0], j * st[1], k * st[2]), tuple(ks))
+            a = jnp.argmax(sl.reshape(-1))
+            return sl.reshape(-1)[a], ln.reshape(-1)[a]
+
+        ii, jj, kk = jnp.meshgrid(jnp.arange(od), jnp.arange(oh),
+                                  jnp.arange(ow), indexing="ij")
+        v, m = jax.vmap(win)(ii.reshape(-1), jj.reshape(-1),
+                             kk.reshape(-1))
+        return v.reshape(od, oh, ow), m.reshape(od, oh, ow)
+
+    v, m = jax.vmap(jax.vmap(pool_one))(xp)
+    ctx.set_output("Out", v)
+    ctx.set_output("Mask", m.astype(jnp.int32))
+
+
+@register_no_grad_op("get_places")
+def get_places(ctx):
+    """Device-count probe (reference get_places_op.cc); the engine has
+    no PLACE_LIST var type — emits the count."""
+    ctx.set_output("Out", jnp.asarray(len(jax.devices()), jnp.int32))
+
+
+@register_no_grad_op("rnn_memory_helper")
+def rnn_memory_helper(ctx):
+    ctx.set_output("Out", ctx.input("X"))
+
+
+@register_no_grad_op("tensor_array_to_tensor")
+def tensor_array_to_tensor(ctx):
+    """Stack/concat a TensorArray (reference
+    tensor_array_to_tensor_op.cc)."""
+    arr = ctx.env[ctx.op.input("X")[0]]
+    axis = ctx.attr("axis", 0)
+    use_stack = ctx.attr("use_stack", False)
+    vals = list(arr)
+    out = jnp.stack(vals, axis) if use_stack else \
+        jnp.concatenate(vals, axis)
+    ctx.set_output("Out", out)
+    ctx.set_output("OutIndex", jnp.asarray(
+        [v.shape[axis] for v in vals], jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# spatial samplers
+# ---------------------------------------------------------------------------
+
+@register_no_grad_op("affine_grid")
+def affine_grid(ctx):
+    """theta [N, 2, 3] -> flow-field grid [N, H, W, 2] in [-1, 1]
+    coords (reference affine_grid_op.cc)."""
+    theta = ctx.input("Theta")
+    if ctx.has_input("OutputShape"):
+        shape_in = ctx.input("OutputShape")
+        if isinstance(shape_in, jax.core.Tracer):
+            raise NotImplementedError(
+                "affine_grid with tensor OutputShape runs eagerly")
+        n, c, h, w = [int(v) for v in np.asarray(shape_in)]
+    else:
+        n, c, h, w = [int(v) for v in ctx.attr("output_shape")]
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    xg, yg = jnp.meshgrid(xs, ys)             # [H, W]
+    ones = jnp.ones_like(xg)
+    base = jnp.stack([xg, yg, ones], axis=-1)  # [H, W, 3]
+    grid = jnp.einsum("hwk,njk->nhwj", base, theta)
+    ctx.set_output("Output", grid.astype(theta.dtype))
+
+
+@register_op("grid_sampler")
+def grid_sampler(ctx):
+    """Bilinear sampling by normalized grid (reference
+    grid_sampler_op.cc): grid [N, H, W, 2] in [-1, 1] (x, y)."""
+    x = ctx.input("X")                        # [N, C, Hi, Wi]
+    grid = ctx.input("Grid")
+    Hi, Wi = x.shape[2], x.shape[3]
+    gx = (grid[..., 0] + 1.0) / 2.0 * (Wi - 1)
+    gy = (grid[..., 1] + 1.0) / 2.0 * (Hi - 1)
+
+    def per_image(feat, yy, xx):
+        y0 = jnp.floor(yy); x0 = jnp.floor(xx)
+        wy = yy - y0; wx = xx - x0
+
+        def tap(yi, xi):
+            inb = (yi >= 0) & (yi < Hi) & (xi >= 0) & (xi < Wi)
+            yc = jnp.clip(yi, 0, Hi - 1).astype(jnp.int32)
+            xc = jnp.clip(xi, 0, Wi - 1).astype(jnp.int32)
+            return feat[:, yc, xc] * inb.astype(feat.dtype)
+
+        return (tap(y0, x0) * (1 - wy) * (1 - wx) +
+                tap(y0, x0 + 1) * (1 - wy) * wx +
+                tap(y0 + 1, x0) * wy * (1 - wx) +
+                tap(y0 + 1, x0 + 1) * wy * wx)
+
+    ctx.set_output("Output", jax.vmap(per_image)(x, gy, gx))
+
+
+@register_op("deformable_conv", no_grad_slots=("Mask",))
+def deformable_conv(ctx):
+    """Deformable conv v2 (reference deformable_conv_op.cc): per output
+    position and kernel tap, sample input at (base + learned offset),
+    scale by modulation mask, then contract with the filter."""
+    x = ctx.input("Input")                    # [N, Cin, H, W]
+    offset = ctx.input("Offset")              # [N, 2*dg*kh*kw, Ho, Wo]
+    mask = ctx.input("Mask")                  # [N, dg*kh*kw, Ho, Wo]
+    w = ctx.input("Filter")                   # [Cout, Cin/g, kh, kw]
+    strides = ctx.attr("strides", [1, 1])
+    paddings = ctx.attr("paddings", [0, 0])
+    dilations = ctx.attr("dilations", [1, 1])
+    groups = ctx.attr("groups", 1) or 1
+    dg = ctx.attr("deformable_groups", 1) or 1
+    N, Cin, H, W = x.shape
+    Cout, _, kh, kw = w.shape
+    Ho = (H + 2 * paddings[0] - (dilations[0] * (kh - 1) + 1)) \
+        // strides[0] + 1
+    Wo = (W + 2 * paddings[1] - (dilations[1] * (kw - 1) + 1)) \
+        // strides[1] + 1
+
+    base_y = (jnp.arange(Ho) * strides[0] - paddings[0])[:, None, None] \
+        + (jnp.arange(kh) * dilations[0])[None, :, None]   # [Ho,kh,1]
+    base_x = (jnp.arange(Wo) * strides[1] - paddings[1])[:, None, None] \
+        + (jnp.arange(kw) * dilations[1])[None, :, None]   # [Wo,kw,1]
+
+    def per_image(xi, off, mk):
+        off = off.reshape(dg, kh, kw, 2, Ho, Wo)
+        mk = mk.reshape(dg, kh, kw, Ho, Wo)
+        cpg = Cin // dg                        # channels per deform group
+
+        def per_dg(xg, og, mg):
+            # sample coords y = base + offset_y, [kh, kw, Ho, Wo]
+            by = (jnp.arange(Ho) * strides[0] - paddings[0])[None, None,
+                                                            :, None]
+            bx = (jnp.arange(Wo) * strides[1] - paddings[1])[None, None,
+                                                            None, :]
+            ky = (jnp.arange(kh) * dilations[0])[:, None, None, None]
+            kx = (jnp.arange(kw) * dilations[1])[None, :, None, None]
+            ys = by + ky + og[:, :, 0]         # [kh, kw, Ho, Wo]
+            xs_ = bx + kx + og[:, :, 1]
+
+            y0 = jnp.floor(ys); x0 = jnp.floor(xs_)
+            wy = ys - y0; wx = xs_ - x0
+
+            def tap(yi, xi):
+                inb = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+                yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+                xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+                return xg[:, yc, xc] * inb.astype(xg.dtype)
+
+            val = (tap(y0, x0) * (1 - wy) * (1 - wx) +
+                   tap(y0, x0 + 1) * (1 - wy) * wx +
+                   tap(y0 + 1, x0) * wy * (1 - wx) +
+                   tap(y0 + 1, x0 + 1) * wy * wx)
+            return val * mg[None]              # [cpg, kh, kw, Ho, Wo]
+
+        cols = jnp.concatenate(
+            [per_dg(xi[g * cpg:(g + 1) * cpg], off[g], mk[g])
+             for g in range(dg)], axis=0)      # [Cin, kh, kw, Ho, Wo]
+        # grouped contraction with the filter
+        cpgrp = Cin // groups
+        outs = []
+        for g in range(groups):
+            c = cols[g * cpgrp:(g + 1) * cpgrp]
+            f = w[g * (Cout // groups):(g + 1) * (Cout // groups),
+                  :cpgrp]
+            outs.append(jnp.einsum("cklhw,ockl->ohw", c, f))
+        return jnp.concatenate(outs, axis=0)
+
+    ctx.set_output("Output", jax.vmap(per_image)(x, offset, mask))
+
+
+@register_op("deformable_psroi_pooling", no_grad_slots=("ROIs",))
+def deformable_psroi_pooling(ctx):
+    """Deformable position-sensitive ROI pooling (reference
+    deformable_psroi_pooling_op.cc): psroi bins shifted by learned
+    per-part offsets."""
+    from .detection import _roi_batch_ids, _bilinear_sample
+    x = ctx.input("Input")
+    rois = ctx.input("ROIs")
+    trans = ctx.input("Trans")                # [R, 2, ph, pw] offsets
+    no_trans = ctx.attr("no_trans", False)
+    spatial_scale = ctx.attr("spatial_scale", 1.0)
+    out_dim = ctx.attr("output_dim")
+    group_h, group_w = (ctx.attr("group_size", [1, 1]) + [1, 1])[:2]
+    ph = ctx.attr("pooled_height", 1)
+    pw = ctx.attr("pooled_width", 1)
+    part_h, part_w = (ctx.attr("part_size", [ph, pw]) + [ph, pw])[:2]
+    sample_per_part = ctx.attr("sample_per_part", 1)
+    trans_std = ctx.attr("trans_std", 0.1)
+    R = rois.shape[0]
+    ids = _roi_batch_ids(ctx, "ROIs", R, x.shape[0])
+
+    def one_roi(roi, tr, bid):
+        x1 = jnp.round(roi[0]) * spatial_scale - 0.5
+        y1 = jnp.round(roi[1]) * spatial_scale - 0.5
+        x2 = (jnp.round(roi[2]) + 1.0) * spatial_scale - 0.5
+        y2 = (jnp.round(roi[3]) + 1.0) * spatial_scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w, bin_h = rw / pw, rh / ph
+        sub_w = bin_w / sample_per_part
+        sub_h = bin_h / sample_per_part
+        feat = x[bid].reshape(out_dim, group_h * group_w,
+                              x.shape[2], x.shape[3])
+        pi = jnp.arange(ph)[:, None]
+        pj = jnp.arange(pw)[None, :]
+        if no_trans:
+            dy = jnp.zeros((ph, pw))
+            dx = jnp.zeros((ph, pw))
+        else:
+            ti = (pi * part_h // ph).astype(jnp.int32)
+            tj = (pj * part_w // pw).astype(jnp.int32)
+            dy = tr[1][ti, tj] * trans_std * rh
+            dx = tr[0][ti, tj] * trans_std * rw
+        gi = jnp.clip(pi * group_h // ph, 0, group_h - 1)
+        gj = jnp.clip(pj * group_w // pw, 0, group_w - 1)
+        gidx = (gi * group_w + gj)             # [ph, pw]
+        acc = jnp.zeros((out_dim, ph, pw), x.dtype)
+        for si in range(sample_per_part):
+            for sj in range(sample_per_part):
+                yy = y1 + pi * bin_h + (si + 0.5) * sub_h + dy
+                xx = x1 + pj * bin_w + (sj + 0.5) * sub_w + dx
+                sampled = _bilinear_sample(
+                    feat.reshape(-1, x.shape[2], x.shape[3]), yy, xx)
+                sampled = sampled.reshape(out_dim, group_h * group_w,
+                                          ph, pw)
+                acc = acc + jnp.take_along_axis(
+                    sampled, gidx[None, None], axis=1)[:, 0]
+        return acc / (sample_per_part * sample_per_part)
+
+    tr_in = trans if trans is not None else \
+        jnp.zeros((R, 2, part_h, part_w), x.dtype)
+    out = jax.vmap(one_roi)(rois, tr_in, ids)
+    ctx.set_output("Output", out)
+    ctx.set_output("TopCount", jnp.ones(out.shape, x.dtype))
+
+
+@register_op("tree_conv", no_grad_slots=("EdgeSet",))
+def tree_conv(ctx):
+    """Tree-based convolution (reference tree_conv_op.cc, TBCNN):
+    for each node, combine its patch (node + children) through three
+    weight matrices mixed by top/left/right coefficients."""
+    nodes = ctx.input("NodesVector")          # [N, n, F]
+    edges = ctx.input("EdgeSet")              # [N, E, 2] (parent, child)
+    filt = ctx.input("Filter")                # [F, 3, out, num_filters]
+    max_depth = ctx.attr("max_depth", 2)
+    N, n, F = nodes.shape
+    if isinstance(edges, jax.core.Tracer):
+        raise NotImplementedError(
+            "tree_conv builds value-dependent adjacency; runs eagerly")
+    edges_np = np.asarray(edges)
+
+    outs = []
+    for b in range(N):
+        children = {}
+        for p, c in edges_np[b]:
+            p, c = int(p), int(c)
+            if p == 0 and c == 0:
+                continue
+            children.setdefault(p, []).append(c)
+        rows = []
+        for node in range(n):
+            ch = children.get(node, [])
+            patch = [(node, 1.0, 0.5, 0.5)]    # (idx, top, left, right)
+            k = len(ch)
+            for i, c in enumerate(ch):
+                r = i / (k - 1) if k > 1 else 0.5
+                patch.append((c, 0.0, 1.0 - r, r))
+            acc = 0.0
+            for idx, t, l, r in patch:
+                vec = nodes[b, idx]            # [F]
+                wmix = t * filt[:, 0] + l * filt[:, 1] + r * filt[:, 2]
+                acc = acc + jnp.einsum("f,fok->ok", vec, wmix)
+            rows.append(jnp.tanh(acc))
+        outs.append(jnp.stack(rows))
+    ctx.set_output("Out", jnp.stack(outs))
+
+
+# ---------------------------------------------------------------------------
+# fused compositions (reference operators/fused/ — XLA re-fuses these;
+# registered so reference programs execute unchanged)
+# ---------------------------------------------------------------------------
+
+@register_op("fused_elemwise_activation")
+def fused_elemwise_activation(ctx):
+    x, y = ctx.input("X"), ctx.input("Y")
+    functors = [f.strip() for f in ctx.attr("functor_list")]
+    axis = ctx.attr("axis", -1)
+    val = {"X": x, "Y": y}
+
+    def apply(name, a, b=None):
+        table = {
+            "elementwise_add": lambda: a + b,
+            "elementwise_sub": lambda: a - b,
+            "elementwise_mul": lambda: a * b,
+            "relu": lambda: jnp.maximum(a, 0),
+            "scale": lambda: a * ctx.attr("scale", 1.0),
+            "tanh": lambda: jnp.tanh(a),
+            "sigmoid": lambda: jax.nn.sigmoid(a),
+        }
+        return table[name]()
+
+    # reference composes f1(f2(x, y)) or f1(x, f2(y)) by functor kinds;
+    # the common registrations are binary-then-unary
+    f1, f2 = functors[0], functors[1]
+    if f2.startswith("elementwise"):
+        inter = apply(f2, x, y)
+        out = apply(f1, inter)
+    else:
+        inter = apply(f2, y)
+        out = apply(f1, x, inter)
+    ctx.set_output("Out", out)
+    ctx.set_output("IntermediateOut", inter)
+
+
+@register_op("fused_embedding_seq_pool", no_grad_slots=("Ids",))
+def fused_embedding_seq_pool(ctx):
+    """lookup_table + sequence sum-pool in one op (reference
+    fused_embedding_seq_pool_op.cc)."""
+    w = ctx.input("W")
+    ids = ctx.input("Ids")
+    lod = ctx.get_lod("Ids")
+    emb = w[ids.reshape(-1).astype(jnp.int32)]
+    offs = lod[0] if lod else [0, emb.shape[0]]
+    rows = []
+    for s, e in zip(offs[:-1], offs[1:]):
+        rows.append(jnp.sum(emb[s:e], axis=0))
+    ctx.set_output("Out", jnp.stack(rows))
+
+
+@register_op("fusion_squared_mat_sub")
+def fusion_squared_mat_sub(ctx):
+    """(X@Y)^2 - (X^2)@(Y^2), scaled (reference
+    fusion_squared_mat_sub_op.cc — the FM interaction term)."""
+    x, y = ctx.input("X"), ctx.input("Y")
+    scalar = ctx.attr("scalar", 1.0)
+    xy = x @ y
+    ctx.set_output("SquaredXY", jnp.square(xy))
+    ctx.set_output("SquaredX", jnp.square(x))
+    ctx.set_output("SquaredY", jnp.square(y))
+    ctx.set_output("Out",
+                   scalar * (jnp.square(xy) -
+                             jnp.square(x) @ jnp.square(y)))
+
+
+@register_op("fusion_transpose_flatten_concat")
+def fusion_transpose_flatten_concat(ctx):
+    xs = ctx.inputs("X")
+    trans_axis = [int(a) for a in ctx.attr("trans_axis")]
+    flatten_axis = ctx.attr("flatten_axis", 1)
+    concat_axis = ctx.attr("concat_axis", 1)
+    outs = []
+    for x in xs:
+        t = jnp.transpose(x, trans_axis)
+        lead = int(np.prod(t.shape[:flatten_axis]))
+        outs.append(t.reshape(lead, -1))
+    ctx.set_output("Out", jnp.concatenate(outs, axis=concat_axis))
+
+
+@register_op("fusion_repeated_fc_relu")
+def fusion_repeated_fc_relu(ctx):
+    x = ctx.input("X")
+    ws = ctx.inputs("W")
+    bs = ctx.inputs("Bias")
+    h = x
+    for w, b in zip(ws, bs):
+        h = jnp.maximum(h @ w + b.reshape(1, -1), 0.0)
+    ctx.set_output("Out", h)
+
+
+@register_op("fusion_seqpool_concat")
+def fusion_seqpool_concat(ctx):
+    xs = ctx.inputs("X")
+    pooltype = ctx.attr("pooltype", "SUM").upper()
+    names = ctx.op.input("X")
+    outs = []
+    for x, nm in zip(xs, names):
+        lod = ctx.lod_env.get(nm, [])
+        offs = lod[0] if lod else [0, x.shape[0]]
+        rows = []
+        for s, e in zip(offs[:-1], offs[1:]):
+            seg = x[s:e]
+            if pooltype == "SUM":
+                rows.append(jnp.sum(seg, 0))
+            elif pooltype == "AVERAGE":
+                rows.append(jnp.mean(seg, 0))
+            else:
+                rows.append(jnp.max(seg, 0))
+        outs.append(jnp.stack(rows))
+    ctx.set_output("Out", jnp.concatenate(outs, axis=1))
+
+
+@register_op("fusion_seqpool_cvm_concat")
+def fusion_seqpool_cvm_concat(ctx):
+    xs = ctx.inputs("X")
+    use_cvm = ctx.attr("use_cvm", True)
+    names = ctx.op.input("X")
+    outs = []
+    for x, nm in zip(xs, names):
+        lod = ctx.lod_env.get(nm, [])
+        offs = lod[0] if lod else [0, x.shape[0]]
+        rows = [jnp.sum(x[s:e], 0)
+                for s, e in zip(offs[:-1], offs[1:])]
+        pooled = jnp.stack(rows)
+        if use_cvm:
+            head = jnp.log(jnp.maximum(pooled[:, :2], 0.0) + 1.0)
+            pooled = jnp.concatenate([head, pooled[:, 2:]], axis=1)
+        else:
+            pooled = pooled[:, 2:]
+        outs.append(pooled)
+    ctx.set_output("Out", jnp.concatenate(outs, axis=1))
+
+
+# ---------------------------------------------------------------------------
+# recurrent variants
+# ---------------------------------------------------------------------------
+
+def _last_level_lod(lod, n_rows):
+    if lod:
+        return np.asarray(lod[-1], np.int64)
+    return np.asarray([0, n_rows], np.int64)
+
+
+@register_op("lstmp", no_grad_slots=("C0",))
+def lstmp(ctx):
+    """LSTM with recurrent projection (reference lstmp_op.cc):
+    r_t = proj_act(W_rh h_t); the projection feeds the recurrence."""
+    x = ctx.input("Input")            # [T, 4D] x-projections
+    w = ctx.input("Weight")           # [P, 4D] (recurrent on projection)
+    w_proj = ctx.input("ProjWeight")  # [D, P]
+    bias = ctx.input("Bias")
+    h0, c0 = ctx.input("H0"), ctx.input("C0")
+    off = _last_level_lod(ctx.get_lod("Input"), x.shape[0])
+    D = w_proj.shape[0]
+    P = w_proj.shape[1]
+    use_peep = bool(ctx.attr("use_peepholes", True))
+    act_g = {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+             "relu": lambda a: jnp.maximum(a, 0),
+             "identity": lambda a: a}
+    g = act_g[ctx.attr("gate_activation", "sigmoid")]
+    c_act = act_g[ctx.attr("cell_activation", "tanh")]
+    n_act = act_g[ctx.attr("candidate_activation", "tanh")]
+    p_act = act_g[ctx.attr("proj_activation", "tanh")]
+
+    b = bias.reshape(-1) if bias is not None else \
+        jnp.zeros((4 * D,), x.dtype)
+    gate_b = b[:4 * D]
+    w_ic = b[4 * D:5 * D] if use_peep and b.shape[0] >= 7 * D else None
+    w_fc = b[5 * D:6 * D] if use_peep and b.shape[0] >= 7 * D else None
+    w_oc = b[6 * D:7 * D] if use_peep and b.shape[0] >= 7 * D else None
+
+    is_reverse = bool(ctx.attr("is_reverse", False))
+    segs_h, segs_c = [], []
+    for bi, (s, e) in enumerate(zip(off[:-1], off[1:])):
+        seq = x[s:e]
+        if is_reverse:
+            seq = jnp.flip(seq, axis=0)
+        r = h0[bi] if h0 is not None else jnp.zeros((P,), x.dtype)
+        c = c0[bi] if c0 is not None else jnp.zeros((D,), x.dtype)
+
+        def step(carry, xt):
+            r_prev, c_prev = carry
+            gates = xt + r_prev @ w + gate_b
+            g_in, g_i, g_f, g_o = (gates[0:D], gates[D:2 * D],
+                                   gates[2 * D:3 * D],
+                                   gates[3 * D:4 * D])
+            if w_ic is not None:
+                g_i = g_i + w_ic * c_prev
+                g_f = g_f + w_fc * c_prev
+            i, f = g(g_i), g(g_f)
+            c_new = n_act(g_in) * i + c_prev * f
+            if w_oc is not None:
+                g_o = g_o + w_oc * c_new
+            h = c_act(c_new) * g(g_o)
+            r_new = p_act(h @ w_proj)
+            return (r_new, c_new), (r_new, c_new)
+
+        _, (rs, cs) = lax.scan(step, (r, c), seq)
+        if is_reverse:
+            rs = jnp.flip(rs, axis=0)
+            cs = jnp.flip(cs, axis=0)
+        segs_h.append(rs)
+        segs_c.append(cs)
+    lod = ctx.get_lod("Input")
+    ctx.set_output("Projection", jnp.concatenate(segs_h, axis=0))
+    ctx.set_output("Cell", jnp.concatenate(segs_c, axis=0))
+    if lod:
+        ctx.set_lod("Projection", lod)
+        ctx.set_lod("Cell", lod)
+
+
+@register_op("attention_lstm", no_grad_slots=("C0",))
+def attention_lstm(ctx):
+    """Fused attention LSTM (reference fused/attention_lstm_op.cc): at
+    every step, score each element of the sequence from [x, h_prev],
+    softmax over the sequence, and feed the attention-pooled x into an
+    LSTM whose gates come from [x_pooled, h_prev] @ LSTMWeight."""
+    x = ctx.input("X")                 # LoD [T, M]
+    c0 = ctx.input("C0")
+    h0 = ctx.input("H0")
+    att_w = ctx.input("AttentionWeight")       # [M+D, 1]
+    att_b = ctx.input("AttentionBias")
+    att_scalar = ctx.input("AttentionScalar")
+    att_scalar_b = ctx.input("AttentionScalarBias")
+    lstm_w = ctx.input("LSTMWeight")           # [M+D, 4D]
+    lstm_b = ctx.input("LSTMBias")             # [1, 4D]
+    off = _last_level_lod(ctx.get_lod("X"), x.shape[0])
+    D = lstm_w.shape[1] // 4
+    M = x.shape[1]
+
+    segs_h, segs_c = [], []
+    for bi, (s, e) in enumerate(zip(off[:-1], off[1:])):
+        seq = x[s:e]                   # [T, M]
+        T = seq.shape[0]
+        h = h0[bi] if h0 is not None else jnp.zeros((D,), x.dtype)
+        c = c0[bi] if c0 is not None else jnp.zeros((D,), x.dtype)
+
+        def step(carry, _):
+            h_prev, c_prev = carry
+            expand = jnp.concatenate(
+                [seq, jnp.broadcast_to(h_prev[None], (T, D))], axis=1)
+            score = expand @ att_w     # [T, 1]
+            if att_b is not None:
+                score = score + att_b.reshape(-1)
+            if att_scalar is not None:
+                score = score * att_scalar.reshape(())
+            if att_scalar_b is not None:
+                score = score + att_scalar_b.reshape(())
+            alpha = jax.nn.softmax(score.reshape(-1))
+            pooled = alpha @ seq       # [M]
+            gates = jnp.concatenate([pooled, h_prev]) @ lstm_w + \
+                lstm_b.reshape(-1)
+            g_in, g_i, g_f, g_o = (gates[0:D], gates[D:2 * D],
+                                   gates[2 * D:3 * D],
+                                   gates[3 * D:4 * D])
+            i = jax.nn.sigmoid(g_i)
+            f = jax.nn.sigmoid(g_f)
+            c_new = jnp.tanh(g_in) * i + c_prev * f
+            h_new = jnp.tanh(c_new) * jax.nn.sigmoid(g_o)
+            return (h_new, c_new), (h_new, c_new)
+
+        _, (hs, cs) = lax.scan(step, (h, c), None, length=T)
+        segs_h.append(hs)
+        segs_c.append(cs)
+    ctx.set_output("Hidden", jnp.concatenate(segs_h, axis=0))
+    ctx.set_output("Cell", jnp.concatenate(segs_c, axis=0))
+    lod = ctx.get_lod("X")
+    if lod:
+        ctx.set_lod("Hidden", lod)
+        ctx.set_lod("Cell", lod)
+
+
+def _run_sub_op(op_type, inputs, outputs, attrs, ctx):
+    """Execute a registered op's lowering against ctx.env names."""
+    from ..framework import Operator
+    view_inputs = {k: [v] if isinstance(v, str) else list(v)
+                   for k, v in inputs.items()}
+    view_outputs = {k: [v] if isinstance(v, str) else list(v)
+                    for k, v in outputs.items()}
+
+    class _View:
+        type = op_type
+
+        def input(self, s):
+            return view_inputs.get(s, [])
+
+        def output(self, s):
+            return view_outputs.get(s, [])
+
+        def input_slots(self):
+            return list(view_inputs)
+
+        def output_slots(self):
+            return list(view_outputs)
+
+        def attr(self, n, d=None):
+            return attrs.get(n, d)
+
+        def has_attr(self, n):
+            return n in attrs
+
+        def _all_attrs(self):
+            return dict(attrs)
+
+        _attrs = attrs
+
+    OPS.get(op_type).lowering(
+        ExecContext(_View(), ctx.env, ctx.rng_ctx, ctx.block_runner,
+                    ctx.lod_env))
+
+
+@register_op("fusion_lstm", no_grad_slots=("C0",))
+def fusion_lstm(ctx):
+    """fc (x @ WeightX + bias) + lstm in one op (reference
+    fused/fusion_lstm_op.cc)."""
+    x = ctx.input("X")
+    wx = ctx.input("WeightX")
+    wh = ctx.input("WeightH")
+    bias = ctx.input("Bias")
+    D = wh.shape[0]
+    gate_b = bias.reshape(-1)[:4 * D] if bias is not None else 0.0
+    xx = x @ wx + gate_b
+    nm = ctx.op.output("Hidden")[0] + "@xx"
+    ctx.env[nm] = xx
+    if ctx.get_lod("X"):
+        ctx.lod_env[nm] = ctx.get_lod("X")
+    inputs = {"Input": nm, "Weight": ctx.op.input("WeightH")[0]}
+    bias_rest = None
+    if bias is not None and bias.reshape(-1).shape[0] > 4 * D:
+        # peephole part stays; gate bias already folded into xx
+        bn = nm + "@b"
+        ctx.env[bn] = jnp.concatenate(
+            [jnp.zeros((4 * D,), x.dtype),
+             bias.reshape(-1)[4 * D:]]).reshape(1, -1)
+        inputs["Bias"] = bn
+    if ctx.op.input("H0"):
+        inputs["H0"] = ctx.op.input("H0")[0]
+    if ctx.op.input("C0"):
+        inputs["C0"] = ctx.op.input("C0")[0]
+    _run_sub_op("lstm", inputs,
+                {"Hidden": ctx.op.output("Hidden")[0],
+                 "Cell": ctx.op.output("Cell")[0]},
+                {"use_peepholes": ctx.attr("use_peepholes", False),
+                 "is_reverse": ctx.attr("is_reverse", False),
+                 "gate_activation": ctx.attr("gate_activation",
+                                             "sigmoid"),
+                 "cell_activation": ctx.attr("cell_activation", "tanh"),
+                 "candidate_activation": ctx.attr(
+                     "candidate_activation", "tanh")}, ctx)
+
+
+@register_op("fusion_gru", no_grad_slots=("H0",))
+def fusion_gru(ctx):
+    """fc + gru (reference fused/fusion_gru_op.cc)."""
+    x = ctx.input("X")
+    wx = ctx.input("WeightX")
+    bias = ctx.input("Bias")
+    D = ctx.input("WeightH").shape[0]
+    xx = x @ wx + (bias.reshape(-1) if bias is not None else 0.0)
+    nm = ctx.op.output("Hidden")[0] + "@xx"
+    ctx.env[nm] = xx
+    if ctx.get_lod("X"):
+        ctx.lod_env[nm] = ctx.get_lod("X")
+    inputs = {"Input": nm, "Weight": ctx.op.input("WeightH")[0]}
+    if ctx.op.input("H0"):
+        inputs["H0"] = ctx.op.input("H0")[0]
+    _run_sub_op("gru", inputs,
+                {"Hidden": ctx.op.output("Hidden")[0]},
+                {"is_reverse": ctx.attr("is_reverse", False),
+                 "gate_activation": ctx.attr("gate_activation",
+                                             "sigmoid"),
+                 "activation": ctx.attr("activation", "tanh")}, ctx)
+
+
+@register_op("fused_embedding_fc_lstm", no_grad_slots=("Ids", "C0"))
+def fused_embedding_fc_lstm(ctx):
+    """embedding lookup + fc + lstm (reference
+    fused/fused_embedding_fc_lstm_op.cc)."""
+    ids = ctx.input("Ids")
+    emb = ctx.input("Embeddings")     # [V, 4D] pre-multiplied table
+    xx = emb[ids.reshape(-1).astype(jnp.int32)]
+    bias = ctx.input("Bias")
+    if bias is not None:
+        D4 = ctx.input("WeightH").shape[1]
+        xx = xx + bias.reshape(-1)[:D4]
+    nm = ctx.op.output("Hidden")[0] + "@xx"
+    ctx.env[nm] = xx
+    if ctx.get_lod("Ids"):
+        ctx.lod_env[nm] = ctx.get_lod("Ids")
+    inputs = {"Input": nm, "Weight": ctx.op.input("WeightH")[0]}
+    if ctx.op.input("H0"):
+        inputs["H0"] = ctx.op.input("H0")[0]
+    if ctx.op.input("C0"):
+        inputs["C0"] = ctx.op.input("C0")[0]
+    _run_sub_op("lstm", inputs,
+                {"Hidden": ctx.op.output("Hidden")[0],
+                 "Cell": ctx.op.output("Cell")[0]},
+                {"use_peepholes": ctx.attr("use_peepholes", False),
+                 "is_reverse": ctx.attr("is_reverse", False)}, ctx)
+
+
+@register_op("fusion_seqconv_eltadd_relu")
+def fusion_seqconv_eltadd_relu(ctx):
+    """sequence_conv + bias + relu (reference
+    fused/fusion_seqconv_eltadd_relu_op.cc)."""
+    x = ctx.input("X")
+    w = ctx.input("Filter")            # [ctx_len*D, out]
+    b = ctx.input("Bias")
+    ctx_len = ctx.attr("contextLength")
+    ctx_start = ctx.attr("contextStart", -(ctx_len - 1) // 2
+                         if ctx_len else 0)
+    off = _last_level_lod(ctx.get_lod("X"), x.shape[0])
+    D = x.shape[1]
+    segs = []
+    for s, e in zip(off[:-1], off[1:]):
+        seq = x[s:e]
+        T = seq.shape[0]
+        cols = []
+        for j in range(ctx_len):
+            shift = ctx_start + j
+            idx = np.arange(T) + shift
+            valid = (idx >= 0) & (idx < T)
+            take = jnp.asarray(np.clip(idx, 0, T - 1))
+            cols.append(seq[take] *
+                        jnp.asarray(valid, x.dtype)[:, None])
+        col = jnp.concatenate(cols, axis=1)    # [T, ctx_len*D]
+        segs.append(col)
+    col = jnp.concatenate(segs, axis=0)
+    out = jnp.maximum(col @ w + b.reshape(-1), 0.0)
+    ctx.set_output("Out", out)
+    if ctx.get_lod("X"):
+        ctx.set_lod("Out", ctx.get_lod("X"))
+
+
+@register_op("fusion_seqexpand_concat_fc")
+def fusion_seqexpand_concat_fc(ctx):
+    """sequence_expand (ref per-seq vectors) + concat + fc + act
+    (reference fused/fusion_seqexpand_concat_fc_op.cc): first input is
+    the LoD sequence, the rest are per-sequence rows expanded to it."""
+    xs = ctx.inputs("X")
+    names = ctx.op.input("X")
+    w = ctx.input("FCWeight")
+    b = ctx.input("FCBias")
+    act = ctx.attr("fc_activation", "identity")
+    base = xs[0]
+    lod = ctx.lod_env.get(names[0], [])
+    off = _last_level_lod(lod, base.shape[0])
+    lens = np.diff(off)
+    parts = [base]
+    for extra in xs[1:]:
+        rep = jnp.repeat(extra, jnp.asarray(lens), axis=0,
+                         total_repeat_length=int(off[-1]))
+        parts.append(rep)
+    cat = jnp.concatenate(parts, axis=1)
+    out = cat @ w
+    if b is not None:
+        out = out + b.reshape(-1)
+    out = {"identity": lambda a: a, "relu": lambda a: jnp.maximum(a, 0),
+           "tanh": jnp.tanh,
+           "sigmoid": jax.nn.sigmoid}[act](out)
+    ctx.set_output("Out", out)
+    if lod:
+        ctx.set_lod("Out", lod)
+
+
+# ---------------------------------------------------------------------------
+# eager side-effect ops + metrics
+# ---------------------------------------------------------------------------
+
+@register_no_grad_op("py_func")
+def py_func(ctx):
+    """Run a registered python callable (reference py_func_op.cc).
+    Eager-only: python side effects cannot live inside XLA."""
+    from ..layers.control_flow import py_func_registry
+    xs = ctx.inputs("X")
+    if any(isinstance(v, jax.core.Tracer) for v in xs):
+        raise NotImplementedError(
+            "py_func executes arbitrary python; it runs eagerly only")
+    fid = ctx.attr("forward_callable_id")
+    fn = py_func_registry[fid]
+    outs = fn(*[np.asarray(v) for v in xs])
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    for n, v in zip(ctx.op.output("Out"), outs):
+        ctx.env[n] = jnp.asarray(np.asarray(v))
+
+
+@register_no_grad_op("save")
+def save_op(ctx):
+    """Serialize one variable to file_path (reference save_op.cc).
+    Eager-only side effect."""
+    x = ctx.input("X")
+    if isinstance(x, jax.core.Tracer):
+        raise NotImplementedError("save writes the filesystem; eager "
+                                  "only")
+    from ..io import _serialize_tensor
+    path = ctx.attr("file_path")
+    import os as _os
+    _os.makedirs(_os.path.dirname(path) or ".", exist_ok=True)
+    buf = []
+    _serialize_tensor(buf, ctx.op.input("X")[0], np.asarray(x))
+    with open(path, "wb") as f:
+        for chunk in buf:
+            f.write(chunk)
+
+
+@register_no_grad_op("load")
+def load_op(ctx):
+    from ..io import _deserialize_tensors
+    path = ctx.attr("file_path")
+    with open(path, "rb") as f:
+        data = f.read()
+    for name, (arr, lod) in _deserialize_tensors(data).items():
+        ctx.env[ctx.op.output("Out")[0]] = jnp.asarray(arr)
+        if lod:
+            ctx.set_lod("Out", lod)
+        break
+
+
+@register_no_grad_op("save_combine")
+def save_combine(ctx):
+    xs = ctx.inputs("X")
+    if any(isinstance(v, jax.core.Tracer) for v in xs):
+        raise NotImplementedError("save_combine is eager-only")
+    from ..io import _serialize_tensor
+    path = ctx.attr("file_path")
+    import os as _os
+    _os.makedirs(_os.path.dirname(path) or ".", exist_ok=True)
+    buf = []
+    for n, v in zip(ctx.op.input("X"), xs):
+        _serialize_tensor(buf, n, np.asarray(v))
+    with open(path, "wb") as f:
+        for chunk in buf:
+            f.write(chunk)
+
+
+@register_no_grad_op("load_combine")
+def load_combine(ctx):
+    from ..io import _deserialize_tensors
+    path = ctx.attr("file_path")
+    with open(path, "rb") as f:
+        tensors = _deserialize_tensors(f.read())
+    for n in ctx.op.output("Out"):
+        arr, lod = tensors[n]
+        ctx.env[n] = jnp.asarray(arr)
+
+
+@register_no_grad_op("chunk_eval")
+def chunk_eval(ctx):
+    """Chunk F1 for sequence labeling (reference chunk_eval_op.cc):
+    IOB/IOE/IOBES/plain decoding, eager (variable chunk counts)."""
+    inf = ctx.input("Inference")
+    lab = ctx.input("Label")
+    if isinstance(inf, jax.core.Tracer) or \
+            isinstance(lab, jax.core.Tracer):
+        raise NotImplementedError("chunk_eval counts variable-size "
+                                  "chunk sets; eager only")
+    num_chunk_types = ctx.attr("num_chunk_types")
+    scheme = ctx.attr("chunk_scheme", "IOB")
+    excluded = set(ctx.attr("excluded_chunk_types", []) or [])
+    lod = ctx.get_lod("Inference") or ctx.get_lod("Label")
+    off = _last_level_lod(lod, np.asarray(inf).shape[0])
+
+    tag_map = {"IOB": 2, "IOE": 2, "IOBES": 4, "plain": 1}
+    n_tags = tag_map[scheme]
+
+    def chunks(seq):
+        """Decode (type, start, end) chunks from tag ids."""
+        out = []
+        start = None
+        cur_type = None
+        for i, t in enumerate(seq):
+            t = int(t)
+            if t == num_chunk_types * n_tags:   # outside tag
+                if start is not None:
+                    out.append((cur_type, start, i))
+                    start = None
+                continue
+            ctype, tag = t // n_tags, t % n_tags
+            if scheme == "plain":
+                begin = True
+            elif scheme == "IOB":
+                begin = tag == 0
+            elif scheme == "IOE":
+                begin = start is None or ctype != cur_type
+            else:  # IOBES: B=0 I=1 E=2 S=3
+                begin = tag in (0, 3)
+            if begin or ctype != cur_type:
+                if start is not None:
+                    out.append((cur_type, start, i))
+                start, cur_type = i, ctype
+            if scheme == "IOE" and tag == 0:    # E ends chunk
+                out.append((cur_type, start, i + 1))
+                start = None
+            if scheme == "IOBES" and tag in (2, 3):
+                out.append((cur_type, start, i + 1))
+                start = None
+        if start is not None:
+            out.append((cur_type, start, len(seq)))
+        return {c for c in out if c[0] not in excluded}
+
+    inf_np = np.asarray(inf).reshape(-1)
+    lab_np = np.asarray(lab).reshape(-1)
+    n_inf = n_lab = n_correct = 0
+    for s, e in zip(off[:-1], off[1:]):
+        ci = chunks(inf_np[s:e])
+        cl = chunks(lab_np[s:e])
+        n_inf += len(ci)
+        n_lab += len(cl)
+        n_correct += len(ci & cl)
+    p = n_correct / n_inf if n_inf else 0.0
+    r = n_correct / n_lab if n_lab else 0.0
+    f1 = 2 * p * r / (p + r) if p + r else 0.0
+    ctx.set_output("Precision", jnp.asarray(p, jnp.float32))
+    ctx.set_output("Recall", jnp.asarray(r, jnp.float32))
+    ctx.set_output("F1-Score", jnp.asarray(f1, jnp.float32))
+    ctx.set_output("NumInferChunks", jnp.asarray(n_inf, jnp.int32))
+    ctx.set_output("NumLabelChunks", jnp.asarray(n_lab, jnp.int32))
+    ctx.set_output("NumCorrectChunks",
+                   jnp.asarray(n_correct, jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# parity aliases + trivial forms
+# ---------------------------------------------------------------------------
+
+@register_op("fc")
+def fc_op(ctx):
+    """The C++ fc op form (reference operators/fc_op.cc): mul + bias."""
+    x = ctx.input("Input")
+    w = ctx.input("W")
+    b = ctx.input("Bias")
+    in_num_col_dims = ctx.attr("in_num_col_dims", 1)
+    lead = int(np.prod(x.shape[:in_num_col_dims]))
+    out = x.reshape(lead, -1) @ w
+    if b is not None:
+        out = out + b.reshape(-1)
+    ctx.set_output("Out",
+                   out.reshape(x.shape[:in_num_col_dims] +
+                               (w.shape[1],)))
+
+
+@register_no_grad_op("feed")
+def feed_op(ctx):
+    """Engine seeds feeds directly; registered for program parity."""
+    ctx.set_output("Out", ctx.input("X"))
+
+
+@register_no_grad_op("fetch")
+def fetch_op(ctx):
+    ctx.set_output("Out", ctx.input("X"))
+
+
+@register_op("conv2d_fusion")
+def conv2d_fusion(ctx):
+    """conv + bias + (residual add) + activation (reference
+    fused/conv2d_fusion_op.cc)."""
+    from .conv import _conv_nd
+    _conv_nd(ctx, 2)
+    out = ctx.env[ctx.op.output("Output")[0]]
+    b = ctx.input("Bias")
+    if b is not None:
+        out = out + b.reshape(1, -1, 1, 1)
+    r = ctx.input("ResidualData")
+    if r is not None:
+        out = out + r
+    act = ctx.attr("activation", "relu")
+    out = {"relu": lambda a: jnp.maximum(a, 0), "identity": lambda a: a,
+           "sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh}[act](out)
+    ctx.set_output("Output", out)
+
+
+def _register_aliases():
+    for new, old in [("sync_batch_norm", "batch_norm"),
+                     ("conditional_block_infer", "conditional_block"),
+                     ("lookup_sparse_table", "lookup_table")]:
+        if not OPS.has(new):
+            info = OPS.get(old)
+
+            def make(inner):
+                def lowering(ctx):
+                    return inner(ctx)
+                return lowering
+            from ..core.registry import OpInfo
+            OPS.insert(OpInfo(new, make(info.lowering),
+                              no_grad_slots=info.no_grad_slots,
+                              intermediate_outputs=(
+                                  info.intermediate_outputs),
+                              stateful_outputs=info.stateful_outputs))
+            gname = new + "_grad"
+            if not OPS.has(gname) and OPS.has(old + "_grad"):
+                ginfo = OPS.get(old + "_grad")
+                OPS.insert(OpInfo(gname, ginfo.lowering,
+                                  is_grad_op=True))
+
+
+_register_aliases()
+
+
+@register_no_grad_op("coalesce_tensor")
+def coalesce_tensor(ctx):
+    """Fuse tensors into one contiguous buffer (reference
+    coalesce_tensor_op.cc). XLA owns real buffer placement; this
+    provides the semantic contract: FusedOutput = flat concat, Output_i
+    alias the inputs."""
+    xs = ctx.inputs("Input")
+    flat = jnp.concatenate([v.reshape(-1) for v in xs])
+    ctx.set_output("FusedOutput", flat)
+    for n, v in zip(ctx.op.output("Output"), xs):
+        ctx.env[n] = v
+
+
+@register_no_grad_op("split_selected_rows")
+def split_selected_rows(ctx):
+    """Split SelectedRows by height sections (reference
+    split_selected_rows_op.cc)."""
+    from ..core.selected_rows import SelectedRows, is_selected_rows
+    x = ctx.input("X")
+    sections = [int(s) for s in ctx.attr("height_sections")]
+    outs = ctx.op.output("Out")
+    if not is_selected_rows(x):
+        # dense fallback: split rows by sections
+        start = 0
+        for n, sec in zip(outs, sections):
+            ctx.env[n] = x[start:start + sec]
+            start += sec
+        return
+    bounds = np.cumsum([0] + sections)
+    for i, n in enumerate(outs):
+        lo, hi = int(bounds[i]), int(bounds[i + 1])
+        m = (x.rows >= lo) & (x.rows < hi)
+        idx = jnp.where(m, x.rows - lo, 0)
+        ctx.env[n] = SelectedRows(
+            jnp.where(m, x.rows - lo, -1), x.values * m[:, None],
+            sections[i])
+
+
+@register_no_grad_op("quantize")
+def quantize_int8(ctx):
+    """int8 quantize (reference mkldnn quantize_op.cc): out = round(
+    x * Scale) stored as int8."""
+    x = ctx.input("Input")
+    scale = ctx.attr("Scale", 1.0)
+    ctx.set_output("Output", jnp.clip(
+        jnp.round(x * scale), -128, 127).astype(jnp.int8))
+
+
+@register_no_grad_op("dequantize")
+def dequantize_int8(ctx):
+    x = ctx.input("Input")
+    scale = ctx.attr("Scale", 1.0)
+    ctx.set_output("Output", x.astype(jnp.float32) / scale)
+
+
+@register_no_grad_op("requantize")
+def requantize_int8(ctx):
+    x = ctx.input("Input")
+    si = ctx.attr("Scale_in", 1.0)
+    so = ctx.attr("Scale_out", 1.0)
+    ctx.set_output("Output", jnp.clip(
+        jnp.round(x.astype(jnp.float32) / si * so),
+        -128, 127).astype(jnp.int8))
+
+
+@register_no_grad_op("unique")
+def unique(ctx):
+    """Reference unique_op.cc: eager (value-dependent output size)."""
+    x = ctx.input("X")
+    if isinstance(x, jax.core.Tracer):
+        raise NotImplementedError(
+            "unique has value-dependent output shape; eager only "
+            "(the reference registers it CPU-side)")
+    arr = np.asarray(x).reshape(-1)
+    uniq, inv = np.unique(arr, return_inverse=True)
+    ctx.set_output("Out", jnp.asarray(uniq))
+    ctx.set_output("Index", jnp.asarray(inv.astype(np.int32)))
+
+
+@register_no_grad_op("unique_with_counts")
+def unique_with_counts(ctx):
+    x = ctx.input("X")
+    if isinstance(x, jax.core.Tracer):
+        raise NotImplementedError(
+            "unique_with_counts is value-dependent; eager only")
+    arr = np.asarray(x).reshape(-1)
+    uniq, inv, cnt = np.unique(arr, return_inverse=True,
+                               return_counts=True)
+    ctx.set_output("Out", jnp.asarray(uniq))
+    ctx.set_output("Index", jnp.asarray(inv.astype(np.int32)))
+    ctx.set_output("Count", jnp.asarray(cnt.astype(np.int32)))
+
+
+@register_op("dense_lstm", no_grad_slots=("InitH", "InitC"))
+def dense_lstm(ctx):
+    """Batched dense multi-layer (bi)LSTM (the reference's cudnn_lstm
+    contract, cudnn_lstm_op.cc): Input [B, T, D], flat weight W packed
+    [Wx, Wh, bx, bh] per layer/direction."""
+    x = ctx.input("Input")
+    h0 = ctx.input("InitH")          # [L*dirs, B, H]
+    c0 = ctx.input("InitC")
+    w = ctx.input("W")
+    H = ctx.attr("hidden_size")
+    L = ctx.attr("num_layers", 1)
+    bidi = ctx.attr("is_bidirec", False)
+    dirs = 2 if bidi else 1
+    B, T, D = x.shape
+
+    pos = [0]
+
+    def take(n):
+        v = lax.dynamic_slice(w, (pos[0],), (n,))
+        pos[0] += n
+        return v
+
+    def lstm_dir(seq, wx, wh, b, h_init, c_init, reverse):
+        if reverse:
+            seq = jnp.flip(seq, axis=1)
+        xs = jnp.swapaxes(seq, 0, 1)          # [T, B, Din]
+
+        def step(carry, xt):
+            h_prev, c_prev = carry
+            g = xt @ wx + h_prev @ wh + b
+            i, f, o, cand = jnp.split(g, 4, axis=-1)
+            c = jax.nn.sigmoid(f) * c_prev + \
+                jax.nn.sigmoid(i) * jnp.tanh(cand)
+            h = jax.nn.sigmoid(o) * jnp.tanh(c)
+            return (h, c), h
+
+        (h_last, c_last), hs = lax.scan(step, (h_init, c_init), xs)
+        hs = jnp.swapaxes(hs, 0, 1)
+        if reverse:
+            hs = jnp.flip(hs, axis=1)
+        return hs, h_last, c_last
+
+    dropout_prob = ctx.attr("dropout_prob", 0.0)
+    is_test = ctx.attr("is_test", False)
+    out = x
+    last_h, last_c = [], []
+    for layer in range(L):
+        if layer > 0 and dropout_prob > 0.0 and not is_test:
+            keep = 1.0 - dropout_prob
+            m = jax.random.bernoulli(
+                jax.random.fold_in(ctx.rng(), layer), keep, out.shape)
+            out = jnp.where(m, out / keep, 0.0)
+        din = out.shape[-1]
+        dir_outs = []
+        for d in range(dirs):
+            wx = take(din * 4 * H).reshape(din, 4 * H)
+            wh = take(H * 4 * H).reshape(H, 4 * H)
+            bx = take(4 * H)
+            bh = take(4 * H)
+            idx = layer * dirs + d
+            hi = h0[idx] if h0 is not None else jnp.zeros((B, H),
+                                                         x.dtype)
+            ci = c0[idx] if c0 is not None else jnp.zeros((B, H),
+                                                         x.dtype)
+            hs, hl, cl = lstm_dir(out, wx, wh, bx + bh, hi, ci,
+                                  reverse=(d == 1))
+            dir_outs.append(hs)
+            last_h.append(hl)
+            last_c.append(cl)
+        out = jnp.concatenate(dir_outs, axis=-1) if dirs > 1 \
+            else dir_outs[0]
+    ctx.set_output("Out", out)
+    ctx.set_output("LastH", jnp.stack(last_h))
+    ctx.set_output("LastC", jnp.stack(last_c))
+
+
+def _register_cudnn_lstm_alias():
+    """cudnn_lstm shares dense_lstm's lowering — the dense [B, T, D]
+    batched contract of the reference's cudnn_lstm_op.cc (registered
+    here, after dense_lstm's definition)."""
+    from ..core.registry import OpInfo
+    if not OPS.has("cudnn_lstm"):
+        info = OPS.get("dense_lstm")
+        OPS.insert(OpInfo("cudnn_lstm", info.lowering,
+                          no_grad_slots=info.no_grad_slots))
+        if OPS.has("dense_lstm_grad"):
+            g = OPS.get("dense_lstm_grad")
+            OPS.insert(OpInfo("cudnn_lstm_grad", g.lowering,
+                              is_grad_op=True))
+
+
+_register_cudnn_lstm_alias()
+
+
+@register_op("py_func_grad", no_grad_slots=())
+def py_func_grad(ctx):
+    """Custom python gradient (reference py_func_op.cc backward path):
+    calls the registered backward callable with (inputs, outputs,
+    output grads) minus the skip list; eager only."""
+    from ..layers.control_flow import py_func_registry
+    bid = ctx.op.attr("backward_callable_id", -1)
+    if bid < 0:
+        for n in ctx.op.output_slots():
+            for nm in ctx.op.output(n):
+                if nm:
+                    src = ctx.env.get(ctx.op.input("X")[0])
+                    ctx.env[nm] = jnp.zeros_like(src)
+        return
+    fn = py_func_registry[bid]
+    skip = set(ctx.op.attr("skip_vars_in_backward_input", []) or [])
+    args = []
+    for slot in ("X", "Out"):
+        for nm in ctx.op.input(slot):
+            if nm in skip:
+                continue
+            v = ctx.env.get(nm)
+            if isinstance(v, jax.core.Tracer):
+                raise NotImplementedError("py_func backward is eager "
+                                          "only")
+            args.append(np.asarray(v))
+    for nm in ctx.op.input("Out@GRAD"):
+        v = ctx.env.get(nm)
+        args.append(np.asarray(v))
+    grads = fn(*args)
+    if not isinstance(grads, (list, tuple)):
+        grads = [grads]
+    for nm, g in zip(ctx.op.output("X@GRAD"), grads):
+        if nm:
+            ctx.env[nm] = jnp.asarray(np.asarray(g))

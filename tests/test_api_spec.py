@@ -1,0 +1,32 @@
+"""API surface lock (reference tools/print_signatures.py + API.spec +
+diff_api.py in CI): the committed manifest must match the live argspecs
+so the parity surface cannot regress silently."""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_api_spec_matches():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "print_signatures.py")],
+        capture_output=True, text=True, check=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    live = out.stdout.strip().splitlines()
+    with open(os.path.join(REPO, "API.spec")) as f:
+        committed = f.read().strip().splitlines()
+    live_set, committed_set = set(live), set(committed)
+    removed = committed_set - live_set
+    added = live_set - committed_set
+    msg = []
+    if removed:
+        msg.append("REMOVED/CHANGED from API surface:\n  " +
+                   "\n  ".join(sorted(removed)[:20]))
+    if added:
+        msg.append("ADDED (regenerate API.spec with "
+                   "`python tools/print_signatures.py > API.spec`):"
+                   "\n  " + "\n  ".join(sorted(added)[:20]))
+    assert not msg, "\n".join(msg)
+    assert "IMPORT ERROR" not in out.stdout

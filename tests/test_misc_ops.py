@@ -1,0 +1,422 @@
+"""Long-tail op coverage vs numpy goldens (reference single-file ops +
+fused/ compositions; python tests test_conv_shift_op.py,
+test_modified_huber_loss_op.py, test_spectral_norm_op.py,
+test_chunk_eval_op.py ...)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.scope import Scope, create_lod_tensor
+
+
+def _run_op(op_type, inputs, outputs, attrs, feeds, fetch,
+            lod_feeds=None, extra_vars=()):
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        b = main.global_block()
+        for n, arr in feeds.items():
+            b.create_var(name=n, shape=list(np.asarray(arr).shape),
+                         dtype=str(np.asarray(arr).dtype))
+        for n, shape, dtype in extra_vars:
+            b.create_var(name=n, shape=shape, dtype=dtype)
+        b.append_op(type=op_type, inputs=inputs, outputs=outputs,
+                    attrs=attrs or {}, infer_shape=False)
+    feed = dict(feeds)
+    if lod_feeds:
+        for n, lod in lod_feeds.items():
+            feed[n] = create_lod_tensor(feeds[n], lod)
+    with fluid.scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+def test_sign():
+    x = np.array([[-2.0, 0.0, 3.0]], np.float32)
+    out, = _run_op("sign", {"X": ["x"]}, {"Out": ["o"]}, {},
+                   {"x": x}, ["o"],
+                   extra_vars=[("o", [1, 3], "float32")])
+    np.testing.assert_allclose(np.asarray(out), [[-1, 0, 1]])
+
+
+def test_conv_shift_golden():
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 6).astype(np.float32)
+    y = rng.rand(2, 3).astype(np.float32)
+    out, = _run_op("conv_shift", {"X": ["x"], "Y": ["y"]},
+                   {"Out": ["o"]}, {}, {"x": x, "y": y}, ["o"],
+                   extra_vars=[("o", [2, 6], "float32")])
+    M, N = 6, 3
+    ref = np.zeros((2, M), np.float32)
+    for b in range(2):
+        for i in range(M):
+            for j in range(-(N - 1) // 2, (N - 1) // 2 + 1):
+                ref[b, i] += x[b, (i + j) % M] * y[b, j + (N - 1) // 2]
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5)
+
+
+def test_cvm_modes():
+    x = np.array([[2.0, 1.0, 5.0, 6.0]], np.float32)
+    out, = _run_op("cvm", {"X": ["x"]}, {"Y": ["o"]},
+                   {"use_cvm": True}, {"x": x}, ["o"],
+                   extra_vars=[("o", [1, 4], "float32")])
+    np.testing.assert_allclose(
+        np.asarray(out)[0, :2], np.log(np.array([3.0, 2.0])),
+        rtol=1e-6)
+    out, = _run_op("cvm", {"X": ["x"]}, {"Y": ["o"]},
+                   {"use_cvm": False}, {"x": x}, ["o"],
+                   extra_vars=[("o", [1, 2], "float32")])
+    np.testing.assert_allclose(np.asarray(out), [[5.0, 6.0]])
+
+
+def test_modified_huber_loss_golden():
+    x = np.array([[2.0], [0.5], [-3.0]], np.float32)
+    y = np.array([[1], [0], [1]], np.float32)
+    out, = _run_op("modified_huber_loss", {"X": ["x"], "Y": ["y"]},
+                   {"Out": ["o"], "IntermediateVal": ["iv"]}, {},
+                   {"x": x, "y": y}, ["o"],
+                   extra_vars=[("o", [3, 1], "float32"),
+                               ("iv", [3, 1], "float32")])
+    # yf: 2*1=2 -> 0; 0.5*-1=-0.5 -> (1.5)^2; -3*1=-3 -> 12
+    np.testing.assert_allclose(np.asarray(out).ravel(),
+                               [0.0, 2.25, 12.0], rtol=1e-5)
+
+
+def test_fsp_golden():
+    rng = np.random.RandomState(1)
+    x = rng.rand(2, 3, 4, 5).astype(np.float32)
+    y = rng.rand(2, 2, 4, 5).astype(np.float32)
+    out, = _run_op("fsp", {"X": ["x"], "Y": ["y"]}, {"Out": ["o"]},
+                   {}, {"x": x, "y": y}, ["o"],
+                   extra_vars=[("o", [2, 3, 2], "float32")])
+    ref = np.einsum("nihw,njhw->nij", x, y) / 20.0
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5)
+
+
+def test_spectral_norm_normalizes():
+    rng = np.random.RandomState(2)
+    w = rng.randn(4, 6).astype(np.float32)
+    u = rng.randn(4).astype(np.float32)
+    v = rng.randn(6).astype(np.float32)
+    out, = _run_op("spectral_norm",
+                   {"Weight": ["w"], "U": ["u"], "V": ["v"]},
+                   {"Out": ["o"]}, {"power_iters": 20},
+                   {"w": w, "u": u, "v": v}, ["o"],
+                   extra_vars=[("o", [4, 6], "float32")])
+    sigma = np.linalg.svd(w, compute_uv=False)[0]
+    np.testing.assert_allclose(np.asarray(out), w / sigma, atol=1e-4)
+
+
+def test_pad_constant_like():
+    x = np.zeros((3, 4), np.float32)
+    y = np.ones((2, 3), np.float32)
+    out, = _run_op("pad_constant_like", {"X": ["x"], "Y": ["y"]},
+                   {"Out": ["o"]}, {"pad_value": 7.0},
+                   {"x": x, "y": y}, ["o"],
+                   extra_vars=[("o", [3, 4], "float32")])
+    o = np.asarray(out)
+    assert o.shape == (3, 4)
+    np.testing.assert_allclose(o[:2, :3], 1.0)
+    np.testing.assert_allclose(o[2, :], 7.0)
+
+
+def test_affine_grid_grid_sampler_identity():
+    """Identity theta -> grid_sampler reproduces the input."""
+    rng = np.random.RandomState(3)
+    x = rng.rand(1, 2, 5, 7).astype(np.float32)
+    theta = np.tile(np.array([[[1, 0, 0], [0, 1, 0]]], np.float32),
+                    (1, 1, 1))
+    grid, = _run_op("affine_grid", {"Theta": ["t"]},
+                    {"Output": ["g"]},
+                    {"output_shape": [1, 2, 5, 7]},
+                    {"t": theta}, ["g"],
+                    extra_vars=[("g", [1, 5, 7, 2], "float32")])
+    out, = _run_op("grid_sampler", {"X": ["x"], "Grid": ["g"]},
+                   {"Output": ["o"]}, {},
+                   {"x": x, "g": np.asarray(grid)}, ["o"],
+                   extra_vars=[("o", [1, 2, 5, 7], "float32")])
+    np.testing.assert_allclose(np.asarray(out), x, atol=1e-5)
+
+
+def test_unpool_roundtrip():
+    x = np.array([[[[5.0, 7.0], [13.0, 15.0]]]], np.float32)
+    idx = np.array([[[[5, 7], [13, 15]]]], np.int32)
+    out, = _run_op("unpool", {"X": ["x"], "Indices": ["i"]},
+                   {"Out": ["o"]},
+                   {"ksize": [2, 2], "strides": [2, 2],
+                    "paddings": [0, 0]},
+                   {"x": x, "i": idx}, ["o"],
+                   extra_vars=[("o", [1, 1, 4, 4], "float32")])
+    o = np.asarray(out)[0, 0]
+    assert o[1, 1] == 5.0 and o[1, 3] == 7.0
+    assert o[3, 1] == 13.0 and o[3, 3] == 15.0
+    assert o.sum() == 40.0
+
+
+def test_max_pool3d_with_index():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 2, 2)
+    out, mask = _run_op(
+        "max_pool3d_with_index", {"X": ["x"]},
+        {"Out": ["o"], "Mask": ["m"]},
+        {"ksize": [2, 2, 2], "strides": [2, 2, 2],
+         "paddings": [0, 0, 0]},
+        {"x": x}, ["o", "m"],
+        extra_vars=[("o", [1, 1, 2, 1, 1], "float32"),
+                    ("m", [1, 1, 2, 1, 1], "int32")])
+    np.testing.assert_allclose(np.asarray(out).ravel(), [7.0, 15.0])
+    np.testing.assert_array_equal(np.asarray(mask).ravel(), [7, 15])
+
+
+def test_center_loss_updates_centers():
+    x = np.array([[1.0, 1.0], [3.0, 3.0]], np.float32)
+    label = np.array([[0], [0]], np.int32)
+    centers = np.zeros((3, 2), np.float32)
+    rate = np.array([0.5], np.float32)
+    loss, c_out = _run_op(
+        "center_loss",
+        {"X": ["x"], "Label": ["l"], "Centers": ["c"],
+         "CenterUpdateRate": ["r"]},
+        {"Loss": ["loss"], "CentersOut": ["c"],
+         "SampleCenterDiff": ["d"]},
+        {"need_update": True},
+        {"x": x, "l": label, "c": centers, "r": rate},
+        ["loss", "c"],
+        extra_vars=[("loss", [2, 1], "float32"),
+                    ("d", [2, 2], "float32")])
+    np.testing.assert_allclose(np.asarray(loss).ravel(), [1.0, 9.0])
+    # center 0 moves toward mean of its samples: delta = -(sum diff)
+    # update = -0.5 * (-(1+3)) / (1+2) per dim = +2/3
+    np.testing.assert_allclose(np.asarray(c_out)[0],
+                               [2.0 / 3, 2.0 / 3], rtol=1e-5)
+
+
+def test_row_conv_lookahead():
+    x = np.arange(8, dtype=np.float32).reshape(4, 2)
+    w = np.array([[1.0, 1.0], [0.5, 0.5]], np.float32)
+    out, = _run_op("row_conv", {"X": ["x"], "Filter": ["w"]},
+                   {"Out": ["o"]}, {},
+                   {"x": x, "w": w}, ["o"],
+                   lod_feeds={"x": [[4]]},
+                   extra_vars=[("o", [4, 2], "float32")])
+    o = np.asarray(out.array if hasattr(out, "array") else out)
+    ref = x.copy()
+    ref[:3] += 0.5 * x[1:]
+    np.testing.assert_allclose(o, ref, rtol=1e-5)
+
+
+def test_fusion_squared_mat_sub():
+    rng = np.random.RandomState(4)
+    x = rng.rand(3, 4).astype(np.float32)
+    y = rng.rand(4, 5).astype(np.float32)
+    out, = _run_op(
+        "fusion_squared_mat_sub", {"X": ["x"], "Y": ["y"]},
+        {"Out": ["o"], "SquaredXY": ["sxy"], "SquaredX": ["sx"],
+         "SquaredY": ["sy"]},
+        {"scalar": 0.5}, {"x": x, "y": y}, ["o"],
+        extra_vars=[("o", [3, 5], "float32"),
+                    ("sxy", [3, 5], "float32"),
+                    ("sx", [3, 4], "float32"),
+                    ("sy", [4, 5], "float32")])
+    ref = 0.5 * ((x @ y) ** 2 - (x ** 2) @ (y ** 2))
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_lstmp_shapes_and_projection():
+    rng = np.random.RandomState(5)
+    T, D, P = 5, 4, 3
+    x = rng.randn(T, 4 * D).astype(np.float32)
+    w = rng.randn(P, 4 * D).astype(np.float32) * 0.1
+    wp = rng.randn(D, P).astype(np.float32) * 0.1
+    proj, cell = _run_op(
+        "lstmp",
+        {"Input": ["x"], "Weight": ["w"], "ProjWeight": ["wp"]},
+        {"Projection": ["p"], "Cell": ["c"]},
+        {"use_peepholes": False},
+        {"x": x, "w": w, "wp": wp}, ["p", "c"],
+        lod_feeds={"x": [[T]]},
+        extra_vars=[("p", [T, P], "float32"),
+                    ("c", [T, D], "float32")])
+    p = np.asarray(proj.array if hasattr(proj, "array") else proj)
+    c = np.asarray(cell.array if hasattr(cell, "array") else cell)
+    assert p.shape == (T, P) and c.shape == (T, D)
+    assert np.abs(p).max() <= 1.0  # tanh projection
+
+
+def test_chunk_eval_iob():
+    # 2 chunk types, IOB: tags B0=0 I0=1 B1=2 I1=3 O=4
+    label = np.array([[0], [1], [4], [2], [3]], np.int64)
+    inf = np.array([[0], [1], [4], [2], [4]], np.int64)
+    outs = _run_op(
+        "chunk_eval", {"Inference": ["i"], "Label": ["l"]},
+        {"Precision": ["p"], "Recall": ["r"], "F1-Score": ["f"],
+         "NumInferChunks": ["ni"], "NumLabelChunks": ["nl"],
+         "NumCorrectChunks": ["nc"]},
+        {"num_chunk_types": 2, "chunk_scheme": "IOB"},
+        {"i": inf, "l": label}, ["p", "r", "f", "nc"],
+        lod_feeds={"i": [[5]], "l": [[5]]},
+        extra_vars=[("p", [1], "float32"), ("r", [1], "float32"),
+                    ("f", [1], "float32"), ("ni", [1], "int64"),
+                    ("nl", [1], "int64"), ("nc", [1], "int64")])
+    p, r, f, nc = [float(np.asarray(o)) for o in outs]
+    # label chunks: {(0,[0,2)), (1,[3,5))}; inferred: {(0,[0,2)),
+    # (1,[3,4))} -> correct = 1
+    assert nc == 1
+    np.testing.assert_allclose(p, 0.5)
+    np.testing.assert_allclose(r, 0.5)
+
+
+def test_fc_op_form():
+    rng = np.random.RandomState(6)
+    x = rng.rand(3, 4).astype(np.float32)
+    w = rng.rand(4, 5).astype(np.float32)
+    b = rng.rand(5).astype(np.float32)
+    out, = _run_op("fc", {"Input": ["x"], "W": ["w"], "Bias": ["b"]},
+                   {"Out": ["o"]}, {},
+                   {"x": x, "w": w, "b": b}, ["o"],
+                   extra_vars=[("o", [3, 5], "float32")])
+    np.testing.assert_allclose(np.asarray(out), x @ w + b, rtol=1e-5)
+
+
+def test_deformable_conv_zero_offset_matches_conv():
+    """With zero offsets and unit mask, deformable conv == plain conv."""
+    rng = np.random.RandomState(7)
+    x = rng.rand(1, 2, 6, 6).astype(np.float32)
+    w = rng.rand(3, 2, 3, 3).astype(np.float32)
+    offset = np.zeros((1, 2 * 9, 4, 4), np.float32)
+    mask = np.ones((1, 9, 4, 4), np.float32)
+    out, = _run_op(
+        "deformable_conv",
+        {"Input": ["x"], "Offset": ["of"], "Mask": ["m"],
+         "Filter": ["w"]},
+        {"Output": ["o"]},
+        {"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1],
+         "groups": 1, "deformable_groups": 1},
+        {"x": x, "of": offset, "m": mask, "w": w}, ["o"],
+        extra_vars=[("o", [1, 3, 4, 4], "float32")])
+    ref = np.zeros((1, 3, 4, 4), np.float32)
+    for co in range(3):
+        for i in range(4):
+            for j in range(4):
+                ref[0, co, i, j] = np.sum(
+                    x[0, :, i:i + 3, j:j + 3] * w[co])
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_py_func_layer():
+    def double(a):
+        return a * 2
+
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [3], dtype="float32")
+        out = main.global_block().create_var(
+            name="pyout", shape=[-1, 3], dtype="float32")
+        layers.py_func(double, x, out)
+    with fluid.scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        r = exe.run(main, feed={"x": np.ones((2, 3), np.float32)},
+                    fetch_list=["pyout"])
+    np.testing.assert_allclose(np.asarray(r[0]), 2.0)
+
+
+def test_lstmp_is_reverse_differs_and_flips():
+    rng = np.random.RandomState(8)
+    T, D, P = 4, 3, 2
+    x = rng.randn(T, 4 * D).astype(np.float32)
+    w = rng.randn(P, 4 * D).astype(np.float32) * 0.1
+    wp = rng.randn(D, P).astype(np.float32) * 0.1
+
+    def run(rev, xin):
+        out, = _run_op(
+            "lstmp",
+            {"Input": ["x"], "Weight": ["w"], "ProjWeight": ["wp"]},
+            {"Projection": ["p"], "Cell": ["c"]},
+            {"use_peepholes": False, "is_reverse": rev},
+            {"x": xin, "w": w, "wp": wp}, ["p"],
+            lod_feeds={"x": [[T]]},
+            extra_vars=[("p", [T, P], "float32"),
+                        ("c", [T, D], "float32")])
+        return np.asarray(out.array if hasattr(out, "array") else out)
+
+    fwd = run(False, x)
+    rev = run(True, x)
+    assert not np.allclose(fwd, rev)
+    # reverse of reversed input = forward result flipped
+    rev2 = run(True, x[::-1].copy())
+    np.testing.assert_allclose(rev2, fwd[::-1], rtol=1e-5, atol=1e-6)
+
+
+def test_cudnn_lstm_matches_dense_lstm():
+    rng = np.random.RandomState(9)
+    B, T, D, H = 2, 3, 4, 5
+    x = rng.randn(B, T, D).astype(np.float32)
+    wsize = (D + H) * H * 4 + H * 8
+    w = (rng.randn(wsize) * 0.1).astype(np.float32)
+    h0 = np.zeros((1, B, H), np.float32)
+    c0 = np.zeros((1, B, H), np.float32)
+    outs = {}
+    for op_type in ("dense_lstm", "cudnn_lstm"):
+        out, = _run_op(
+            op_type,
+            {"Input": ["x"], "InitH": ["h"], "InitC": ["c"],
+             "W": ["w"]},
+            {"Out": ["o"], "LastH": ["lh"], "LastC": ["lc"]},
+            {"hidden_size": H, "num_layers": 1, "is_bidirec": False},
+            {"x": x, "h": h0, "c": c0, "w": w}, ["o"],
+            extra_vars=[("o", [B, T, H], "float32"),
+                        ("lh", [1, B, H], "float32"),
+                        ("lc", [1, B, H], "float32")])
+        outs[op_type] = np.asarray(out)
+    np.testing.assert_allclose(outs["cudnn_lstm"], outs["dense_lstm"])
+    assert np.abs(outs["dense_lstm"]).max() > 0
+
+
+def test_conv2d_fusion_applies_bias_and_act():
+    rng = np.random.RandomState(10)
+    x = rng.randn(1, 2, 4, 4).astype(np.float32)
+    w = rng.randn(3, 2, 1, 1).astype(np.float32)
+    b = np.array([10.0, -100.0, 0.5], np.float32)
+    out, = _run_op(
+        "conv2d_fusion",
+        {"Input": ["x"], "Filter": ["w"], "Bias": ["b"]},
+        {"Output": ["o"]},
+        {"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1],
+         "groups": 1, "activation": "relu"},
+        {"x": x, "w": w, "b": b}, ["o"],
+        extra_vars=[("o", [1, 3, 4, 4], "float32")])
+    o = np.asarray(out)
+    ref = np.einsum("nchw,oc->nohw", x, w[:, :, 0, 0])
+    ref = np.maximum(ref + b.reshape(1, 3, 1, 1), 0.0)
+    np.testing.assert_allclose(o, ref, rtol=1e-4, atol=1e-5)
+    assert (o[:, 1] == 0).all()   # bias -100 + relu zeroes channel 1
+
+
+def test_py_func_backward():
+    def fwd(a):
+        return a * 3
+
+    def bwd(a, out, dout):
+        return dout * 3  # d(3a)/da
+
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [3], dtype="float32")
+        x.stop_gradient = False
+        out = main.global_block().create_var(
+            name="pf_out", shape=[-1, 3], dtype="float32")
+        layers.py_func(fwd, x, out, backward_func=bwd)
+        loss = layers.reduce_sum(out)
+        grads = fluid.gradients(loss, x)
+    with fluid.scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        g, = exe.run(main, feed={"x": np.ones((2, 3), np.float32)},
+                     fetch_list=[grads[0].name])
+    np.testing.assert_allclose(np.asarray(g), 3.0)

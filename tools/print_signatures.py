@@ -1,0 +1,92 @@
+"""Generate the public-API argspec manifest (reference
+tools/print_signatures.py -> API.spec, diffed in CI by diff_api.py).
+
+Each line: ``<qualified name> (argspec)`` for every public callable of
+the stable surface. Classes list their __init__ argspec. Run:
+
+    python tools/print_signatures.py > API.spec
+
+CI (tests/test_api_spec.py) regenerates and diffs, so the parity
+surface cannot regress silently.
+"""
+from __future__ import annotations
+
+import inspect
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+MODULES = [
+    "paddle_tpu",
+    "paddle_tpu.layers",
+    "paddle_tpu.layers.nn",
+    "paddle_tpu.layers.tensor",
+    "paddle_tpu.layers.control_flow",
+    "paddle_tpu.layers.detection",
+    "paddle_tpu.layers.sequence",
+    "paddle_tpu.layers.loss",
+    "paddle_tpu.layers.metric_op",
+    "paddle_tpu.layers.learning_rate_scheduler",
+    "paddle_tpu.layers.collective",
+    "paddle_tpu.layers.io",
+    "paddle_tpu.optimizer",
+    "paddle_tpu.initializer",
+    "paddle_tpu.regularizer",
+    "paddle_tpu.clip",
+    "paddle_tpu.io",
+    "paddle_tpu.metrics",
+    "paddle_tpu.profiler",
+    "paddle_tpu.reader",
+    "paddle_tpu.backward",
+    "paddle_tpu.dygraph",
+    "paddle_tpu.dygraph.nn",
+    "paddle_tpu.transpiler",
+    "paddle_tpu.inference",
+    "paddle_tpu.contrib.mixed_precision",
+    "paddle_tpu.contrib.slim.quantization",
+    "paddle_tpu.incubate.fleet.base.role_maker",
+    "paddle_tpu.incubate.fleet.collective",
+]
+
+
+def _spec_of(obj):
+    try:
+        if inspect.isclass(obj):
+            sig = inspect.signature(obj.__init__)
+        else:
+            sig = inspect.signature(obj)
+        return str(sig)
+    except (ValueError, TypeError):
+        return "(<uninspectable>)"
+
+
+def collect():
+    import importlib
+    lines = []
+    for mod_name in MODULES:
+        try:
+            mod = importlib.import_module(mod_name)
+        except ImportError as e:
+            lines.append(f"{mod_name} <IMPORT ERROR: {e}>")
+            continue
+        public = getattr(mod, "__all__", None)
+        if public is None:
+            public = [n for n in dir(mod) if not n.startswith("_")]
+        for name in sorted(public):
+            obj = getattr(mod, name, None)
+            if obj is None:
+                continue
+            if inspect.ismodule(obj):
+                continue
+            if not callable(obj):
+                continue
+            lines.append(f"{mod_name}.{name} {_spec_of(obj)}")
+    return lines
+
+
+if __name__ == "__main__":
+    for line in collect():
+        print(line)
