@@ -1,0 +1,76 @@
+"""Op version registry + serialized-program compat checks (reference
+framework/op_version_registry (1.6+) and framework/version.{h,cc} —
+the SURVEY inventory's "Version / compat" row).
+
+Each op type has a registered version (default 1) bumped when its
+attr/semantic contract changes. `stamp_program` embeds the map into the
+serialized proto (a reserved op carrying the versions); `check_program`
+verifies on load that every op's recorded version is <= the runtime's —
+a newer-than-runtime op fails loudly instead of silently misreading
+attrs.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+__all__ = ["register_op_version", "get_op_version", "stamp_program",
+           "check_program", "OpVersionError"]
+
+_VERSIONS: Dict[str, int] = {}
+VERSION_OP = "@OP_VERSIONS@"     # reserved carrier op type
+
+
+class OpVersionError(RuntimeError):
+    pass
+
+
+def register_op_version(op_type: str, version: int):
+    """Bump when an op's attr/semantic contract changes."""
+    _VERSIONS[op_type] = int(version)
+
+
+def get_op_version(op_type: str) -> int:
+    return _VERSIONS.get(op_type, 1)
+
+
+def stamp_program(proto):
+    """Record per-op versions into the serialized ProgramDesc (attrs of
+    a metadata op appended to block 0; stripped on load)."""
+    used = set()
+    for blk in proto.blocks:
+        for op in blk.ops:
+            used.add(op.type)
+    used.discard(VERSION_OP)
+    if not proto.blocks:
+        return proto
+    op = proto.blocks[0].ops.add()
+    op.type = VERSION_OP
+    for t in sorted(used):
+        a = op.attrs.add()
+        a.name = t
+        a.type = 1  # AT_LONG
+        a.i = get_op_version(t)
+    return proto
+
+
+def check_program(proto, strip: bool = True):
+    """Raise OpVersionError if the program needs newer op semantics
+    than this runtime provides; optionally strip the carrier op."""
+    for blk in proto.blocks:
+        keep = []
+        for op in blk.ops:
+            if op.type != VERSION_OP:
+                keep.append(op)
+                continue
+            for a in op.attrs:
+                runtime_v = get_op_version(a.name)
+                if a.i > runtime_v:
+                    raise OpVersionError(
+                        f"program was saved with op {a.name!r} "
+                        f"version {a.i}, but this runtime implements "
+                        f"version {runtime_v} — upgrade the framework "
+                        f"or re-export the model")
+        if strip and len(keep) != len(blk.ops):
+            del blk.ops[:]
+            blk.ops.extend(keep)
+    return proto

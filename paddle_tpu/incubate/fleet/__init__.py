@@ -2,3 +2,4 @@
 incubate/fleet/base/fleet_base.py + incubate/fleet/collective/)."""
 from . import base  # noqa: F401
 from . import collective  # noqa: F401
+from . import parameter_server  # noqa: F401

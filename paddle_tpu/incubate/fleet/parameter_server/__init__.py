@@ -1,0 +1,129 @@
+"""Parameter-server fleet API (reference incubate/fleet/
+parameter_server/distribute_transpiler/__init__.py + pslib/).
+
+North-star design ("pserver-to-collective transpile",
+transpiler/distribute_transpiler.py): the pserver-era API surface is
+preserved — init(role), distributed_optimizer(opt, config).minimize,
+init_server/run_server/init_worker/stop_worker — but pserver programs
+never run an RPC loop on TPU. minimize() runs DistributeTranspiler
+(which folds the parameter exchange into XLA collectives over the
+mesh), so:
+
+* TRAINER processes execute the transpiled trainer program under SPMD;
+* the SERVER role is a no-op (`run_server` logs and returns instead of
+  blocking on gRPC — there is nothing left to serve);
+* sparse tables ride the SelectedRows + sharded-embedding path.
+"""
+from __future__ import annotations
+
+import logging
+
+from .... import framework
+from ....transpiler import (DistributeTranspiler,
+                            DistributeTranspilerConfig)
+from ..base.fleet_base import DistributedOptimizer, Fleet, Mode
+
+__all__ = ["fleet", "TranspilerOptimizer", "ParameterServerFleet",
+           "DistributeTranspilerConfig"]
+
+_log = logging.getLogger(__name__)
+
+
+class ParameterServerFleet(Fleet):
+    """Reference DistributedTranspiler fleet
+    (parameter_server/distribute_transpiler/__init__.py:37)."""
+
+    def __init__(self):
+        super().__init__(Mode.TRANSPILER)
+        self._transpiler = None
+        self.main_program = None
+        self.startup_program = None
+        self._origin_program = None
+
+    def init_worker(self):
+        # collective bootstrap replaces the pserver wait-loop; reuse
+        # the collective fleet's jax.distributed path when multi-host
+        from ..collective import fleet as collective_fleet
+        collective_fleet._role_maker = self._role_maker
+        collective_fleet.init_worker()
+
+    def init_server(self, model_dir=None):
+        if model_dir:
+            from .... import io
+            from ....executor import Executor
+            from ....core.place import CPUPlace
+            io.load_persistables(Executor(CPUPlace()), model_dir,
+                                 self.main_program or
+                                 framework.default_main_program())
+
+    def run_server(self):
+        # the transpile folded every optimizer block into the trainer
+        # program's collective step; a pserver process has no RPC loop
+        # to serve (reference ListenAndServOp event loop is subsumed)
+        _log.info("parameter_server fleet: pserver role is transpiled "
+                  "to collectives on TPU; run_server is a no-op")
+
+    def stop_worker(self):
+        pass
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        self._optimizer = TranspilerOptimizer(optimizer, strategy)
+        return self._optimizer
+
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None,
+                             export_for_deployment=True):
+        from .... import io
+        io.save_inference_model(dirname, feeded_var_names, target_vars,
+                                executor,
+                                main_program or self.main_program)
+
+    def save_persistables(self, executor, dirname, main_program=None):
+        from .... import io
+        io.save_persistables(executor, dirname,
+                             main_program or self.main_program)
+
+
+fleet = ParameterServerFleet()
+
+
+class TranspilerOptimizer(DistributedOptimizer):
+    """minimize() = inner minimize + DistributeTranspiler over the
+    fleet's role (reference TranspilerOptimizer,
+    parameter_server/distribute_transpiler/__init__.py:147)."""
+
+    def __init__(self, optimizer, strategy=None):
+        if strategy is not None and not isinstance(
+                strategy, DistributeTranspilerConfig):
+            raise TypeError(
+                "strategy must be a DistributeTranspilerConfig")
+        super().__init__(optimizer, strategy or
+                         DistributeTranspilerConfig())
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return self._optimizer.backward(loss, startup_program,
+                                        parameter_list, no_grad_set,
+                                        callbacks)
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        optimize_ops, params_grads = self._optimizer.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+        fleet._origin_program = loss.block.program
+        t = DistributeTranspiler(config=self._strategy)
+        t.transpile(
+            trainer_id=fleet.worker_index(),
+            pservers=fleet.server_endpoints(to_string=True),
+            trainers=fleet.worker_num(),
+            program=loss.block.program,
+            startup_program=startup_program or
+            framework.default_startup_program())
+        fleet._transpiler = t
+        fleet.main_program = t.get_trainer_program()
+        fleet.startup_program = startup_program or \
+            framework.default_startup_program()
+        return optimize_ops, params_grads

@@ -134,7 +134,14 @@ def load_vars(executor, dirname, main_program=None, vars=None,
         for v in vars:
             path = os.path.join(dirname, v.name)
             if not os.path.exists(path):
-                continue
+                # a missing file for a wanted var is a broken
+                # checkpoint — fail loudly like the reference load_op
+                # (load_op.cc PADDLE_ENFORCE on fin), never resume
+                # silently from a partial state
+                raise FileNotFoundError(
+                    f"checkpoint {dirname!r} has no file for "
+                    f"variable {v.name!r} — partial/corrupt "
+                    f"checkpoint")
             with open(path, "rb") as f:
                 tensors = _deserialize_tensors(f)
             for name, (arr, lod) in tensors.items():
@@ -191,12 +198,14 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     os.makedirs(dirname, exist_ok=True)
     model_path = os.path.join(dirname, model_filename or "__model__")
     meta = {"feed": list(feeded_var_names), "fetch": fetch_names}
+    from .core.op_version import stamp_program
+    proto = stamp_program(pruned.to_proto())
     with open(model_path, "wb") as f:
         f.write(struct.pack("<I", 1))  # format version
         meta_b = pickle.dumps(meta)
         f.write(struct.pack("<I", len(meta_b)))
         f.write(meta_b)
-        f.write(pruned.serialize_to_string())
+        f.write(proto.SerializeToString())
     if not program_only:
         save_persistables(executor, dirname, pruned,
                           filename=params_filename)
@@ -210,7 +219,12 @@ def load_inference_model(dirname, executor, model_filename=None,
         (_ver,) = struct.unpack("<I", f.read(4))
         (meta_len,) = struct.unpack("<I", f.read(4))
         meta = pickle.loads(f.read(meta_len))
-        program = Program.parse_from_string(f.read())
+        from .proto import framework_pb2 as _fpb
+        from .core.op_version import check_program
+        proto = _fpb.ProgramDesc()
+        proto.ParseFromString(f.read())
+        check_program(proto)   # op-version compat gate (version.h)
+        program = Program.from_proto(proto)
     load_persistables(executor, dirname, program,
                       filename=params_filename)
     fetch_vars = [program.global_block().var(n) for n in meta["fetch"]]

@@ -1423,11 +1423,23 @@ def unpool2d(input, indices, ksize, strides=None, paddings=None):
 
 def adaptive_pool3d(input, pool_size, pool_type="max",
                     require_index=False, name=None):
-    """Reference nn.py adaptive_pool3d: output bins of adaptive size."""
+    """Reference nn.py adaptive_pool3d: output bins of adaptive size;
+    require_index=True returns (out, argmax-mask) via
+    max_pool3d_with_index(adaptive=True)."""
     helper = LayerHelper("adaptive_pool3d", name=name)
-    out = helper.create_variable_for_type_inference(input.dtype)
     ps = pool_size if isinstance(pool_size, (list, tuple)) \
         else [pool_size] * 3
+    if require_index:
+        if pool_type != "max":
+            raise ValueError("require_index needs pool_type='max'")
+        out = helper.create_variable_for_type_inference(input.dtype)
+        mask = helper.create_variable_for_type_inference("int32")
+        helper.append_op(
+            "max_pool3d_with_index", inputs={"X": input},
+            outputs={"Out": out, "Mask": mask},
+            attrs={"ksize": list(ps), "adaptive": True})
+        return out, mask
+    out = helper.create_variable_for_type_inference(input.dtype)
     helper.append_op(
         "pool3d", inputs={"X": input}, outputs={"Out": out},
         attrs={"pooling_type": pool_type, "ksize": list(ps),
@@ -1568,11 +1580,12 @@ def image_resize_short(input, out_short_len, resample="BILINEAR"):
 
 
 def lod_append(x, level):
-    """Reference nn.py lod_append: append a finer lod level."""
+    """Reference nn.py lod_append: APPEND a finer lod level under the
+    existing levels (lod_reset with append_lod=True keeps x.lod)."""
     helper = LayerHelper("lod_append")
     out = helper.create_variable_for_type_inference(x.dtype)
     inputs = {"X": x}
-    attrs = {}
+    attrs = {"append_lod": True}
     from .. import framework as _fw
     if isinstance(level, _fw.Variable):
         inputs["Y"] = level

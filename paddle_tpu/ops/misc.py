@@ -63,22 +63,24 @@ def pad_constant_like(ctx):
 @register_no_grad_op("lod_reset")
 def lod_reset(ctx):
     """Replace X's LoD with Y's (or target_lod attr) — host metadata
-    only (reference lod_reset_op.cc)."""
+    only (reference lod_reset_op.cc). append_lod=True keeps X's
+    existing levels and appends the new one (lod_append)."""
     x = ctx.input("X")
     ctx.set_output("Out", x)
+    prefix = ctx.get_lod("X") if ctx.attr("append_lod", False) else []
     if ctx.has_input("Y"):
         ylod = ctx.get_lod("Y")
         if ylod:
-            ctx.set_lod("Out", ylod)
+            ctx.set_lod("Out", list(prefix) + list(ylod))
         else:
             y = ctx.input("Y")
             if not isinstance(y, jax.core.Tracer):
                 offs = [int(v) for v in np.asarray(y).reshape(-1)]
-                ctx.set_lod("Out", [offs])
+                ctx.set_lod("Out", list(prefix) + [offs])
     else:
         tl = [int(v) for v in ctx.attr("target_lod", [])]
         if tl:
-            ctx.set_lod("Out", [tl])
+            ctx.set_lod("Out", list(prefix) + [tl])
 
 
 @register_op("conv_shift")
@@ -204,6 +206,12 @@ def spectral_norm(ctx):
     u_s, v_s = lax.stop_gradient(u), lax.stop_gradient(v)
     sigma = u_s @ wm @ v_s
     ctx.set_output("Out", w / sigma)
+    # the reference kernel mutates U/V in place so power iteration
+    # converges ACROSS steps; functionally: write the advanced vectors
+    # back to the persistable input vars (engine persists written names)
+    u_name, v_name = ctx.op.input("U")[0], ctx.op.input("V")[0]
+    ctx.env[u_name] = u_s.reshape(ctx.input("U").shape)
+    ctx.env[v_name] = v_s.reshape(ctx.input("V").shape)
 
 
 @register_op("similarity_focus", no_grad_slots=())
@@ -295,7 +303,9 @@ def unpool(ctx):
 @register_op("max_pool3d_with_index",
              intermediate_outputs=("Mask",))
 def max_pool3d_with_index(ctx):
-    """Reference pool_with_index_op.cc (3D): max pool + argmax mask."""
+    """Reference pool_with_index_op.cc (3D): max pool + argmax mask.
+    adaptive=True treats ksize as the output bins (adaptive_pool3d
+    with require_index)."""
     x = ctx.input("X")                        # [N, C, D, H, W]
     ks = ctx.attr("ksize")
     st = ctx.attr("strides", [1, 1, 1])
@@ -303,6 +313,9 @@ def max_pool3d_with_index(ctx):
     if ctx.attr("global_pooling", False):
         ks = list(x.shape[2:])
         pd = [0, 0, 0]
+    if ctx.attr("adaptive", False):
+        _adaptive_max_pool3d_with_index(ctx, x, ks)
+        return
     neg = jnp.finfo(x.dtype).min
     xp = jnp.pad(x, ((0, 0), (0, 0)) + tuple(
         (p, p) for p in pd), constant_values=neg)
@@ -368,7 +381,7 @@ def tensor_array_to_tensor(ctx):
 # spatial samplers
 # ---------------------------------------------------------------------------
 
-@register_no_grad_op("affine_grid")
+@register_op("affine_grid", no_grad_slots=("OutputShape",))
 def affine_grid(ctx):
     """theta [N, 2, 3] -> flow-field grid [N, H, W, 2] in [-1, 1]
     coords (reference affine_grid_op.cc)."""
@@ -1145,6 +1158,8 @@ def load_combine(ctx):
     for n in ctx.op.output("Out"):
         arr, lod = tensors[n]
         ctx.env[n] = jnp.asarray(arr)
+        if lod:
+            ctx.lod_env[n] = [list(lv) for lv in lod]
 
 
 @register_no_grad_op("chunk_eval")
@@ -1191,7 +1206,7 @@ def chunk_eval(ctx):
                 if start is not None:
                     out.append((cur_type, start, i))
                 start, cur_type = i, ctype
-            if scheme == "IOE" and tag == 0:    # E ends chunk
+            if scheme == "IOE" and tag == 1:    # E (=1) ends chunk
                 out.append((cur_type, start, i + 1))
                 start = None
             if scheme == "IOBES" and tag in (2, 3):
@@ -1494,11 +1509,12 @@ def py_func_grad(ctx):
     from ..layers.control_flow import py_func_registry
     bid = ctx.op.attr("backward_callable_id", -1)
     if bid < 0:
-        for n in ctx.op.output_slots():
-            for nm in ctx.op.output(n):
-                if nm:
-                    src = ctx.env.get(ctx.op.input("X")[0])
-                    ctx.env[nm] = jnp.zeros_like(src)
+        # no backward_func: gradient stops here — zero-fill each input
+        # grad with ITS OWN input's shape
+        for in_name, g_name in zip(ctx.op.input("X"),
+                                   ctx.op.output("X@GRAD")):
+            if g_name:
+                ctx.env[g_name] = jnp.zeros_like(ctx.env[in_name])
         return
     fn = py_func_registry[bid]
     skip = set(ctx.op.attr("skip_vars_in_backward_input", []) or [])
@@ -1521,3 +1537,42 @@ def py_func_grad(ctx):
     for nm, g in zip(ctx.op.output("X@GRAD"), grads):
         if nm:
             ctx.env[nm] = jnp.asarray(np.asarray(g))
+
+
+def _adaptive_max_pool3d_with_index(ctx, x, bins):
+    """Adaptive bins: bin i of dim size S covers
+    [floor(i*S/n), ceil((i+1)*S/n)) (reference AdaptiveStartIndex/
+    AdaptiveEndIndex in pooling.h)."""
+    N, C, D, H, W = x.shape
+    od, oh, ow = [int(b) for b in bins]
+
+    def sel(n_bins, size):
+        i = np.arange(n_bins)
+        starts = (i * size) // n_bins
+        ends = -((-(i + 1) * size) // n_bins)   # ceil div
+        idx = np.arange(size)
+        return (idx[None, :] >= starts[:, None]) & \
+               (idx[None, :] < ends[:, None])    # [bins, size]
+
+    sd = jnp.asarray(sel(od, D))
+    sh = jnp.asarray(sel(oh, H))
+    sw = jnp.asarray(sel(ow, W))
+    lin = (jnp.arange(D)[:, None, None] * (H * W) +
+           jnp.arange(H)[None, :, None] * W +
+           jnp.arange(W)[None, None, :])
+    m = (sd[:, None, None, :, None, None] &
+         sh[None, :, None, None, :, None] &
+         sw[None, None, :, None, None, :])      # [od,oh,ow,D,H,W]
+    neg = jnp.finfo(x.dtype).min
+
+    def one_map(xm):                            # [D, H, W]
+        vals = jnp.where(m, xm[None, None, None], neg)
+        flat = vals.reshape(od, oh, ow, -1)
+        a = jnp.argmax(flat, axis=-1)
+        v = jnp.take_along_axis(flat, a[..., None], axis=-1)[..., 0]
+        idx = lin.reshape(-1)[a]
+        return v, idx
+
+    v, idx = jax.vmap(jax.vmap(one_map))(x)
+    ctx.set_output("Out", v)
+    ctx.set_output("Mask", idx.astype(jnp.int32))

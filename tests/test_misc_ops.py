@@ -420,3 +420,127 @@ def test_py_func_backward():
         g, = exe.run(main, feed={"x": np.ones((2, 3), np.float32)},
                      fetch_list=[grads[0].name])
     np.testing.assert_allclose(np.asarray(g), 3.0)
+
+
+def test_affine_grid_is_differentiable():
+    """STN path: grads must flow through affine_grid to theta."""
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        t = layers.data("t", [2, 3], dtype="float32")
+        t.stop_gradient = False
+        grid = layers.affine_grid(t, out_shape=[1, 1, 3, 3])
+        loss = layers.reduce_sum(grid)
+        grads = fluid.gradients(loss, t)
+    assert grads and grads[0] is not None
+    theta = np.array([[[1, 0, 0], [0, 1, 0]]], np.float32)
+    with fluid.scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        g, = exe.run(main, feed={"t": theta},
+                     fetch_list=[grads[0].name])
+    assert np.abs(np.asarray(g)).sum() > 0
+
+
+def test_py_func_no_backward_zero_grads_per_input_shape():
+    def fwd(a, b):
+        return a  # shape follows first input
+
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = layers.data("a", [3], dtype="float32")
+        b = layers.data("b", [5], dtype="float32")
+        a.stop_gradient = False
+        b.stop_gradient = False
+        h = layers.fc(b, 5)   # downstream of b so b's grad is demanded
+        out = main.global_block().create_var(
+            name="pf2_out", shape=[-1, 3], dtype="float32")
+        layers.py_func(fwd, [a, h], out)
+        loss = layers.elementwise_add(layers.reduce_sum(out),
+                                      layers.reduce_sum(h))
+        grads = fluid.gradients(loss, b)
+    with fluid.scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        g, = exe.run(main, feed={"a": np.ones((2, 3), np.float32),
+                                 "b": np.ones((2, 5), np.float32)},
+                     fetch_list=[grads[0].name])
+    assert np.asarray(g).shape == (2, 5)
+
+
+def test_chunk_eval_ioe_scheme():
+    # IOE, 1 chunk type: I=0, E=1, O=2. [I, I, E] = ONE chunk [0,3)
+    seq = np.array([[0], [0], [1]], np.int64)
+    outs = _run_op(
+        "chunk_eval", {"Inference": ["i"], "Label": ["l"]},
+        {"Precision": ["p"], "Recall": ["r"], "F1-Score": ["f"],
+         "NumInferChunks": ["ni"], "NumLabelChunks": ["nl"],
+         "NumCorrectChunks": ["nc"]},
+        {"num_chunk_types": 1, "chunk_scheme": "IOE"},
+        {"i": seq, "l": seq}, ["ni", "nc"],
+        lod_feeds={"i": [[3]], "l": [[3]]},
+        extra_vars=[("p", [1], "float32"), ("r", [1], "float32"),
+                    ("f", [1], "float32"), ("ni", [1], "int32"),
+                    ("nl", [1], "int32"), ("nc", [1], "int32")])
+    ni, nc = [int(np.asarray(o)) for o in outs]
+    assert ni == 1 and nc == 1
+
+
+def test_spectral_norm_power_iteration_converges_across_steps():
+    """U/V persist: repeated steps with power_iters=1 must approach the
+    true sigma (the reference mutates U/V in place)."""
+    rng = np.random.RandomState(11)
+    w = rng.randn(6, 8).astype(np.float32)
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        wv = layers.data("w", [6, 8], dtype="float32",
+                         append_batch_size=False)
+        out = layers.spectral_norm(wv, dim=0, power_iters=1)
+    sc = Scope()
+    with fluid.scope_guard(sc):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for _ in range(30):   # 30 steps x 1 power iter each
+            o, = exe.run(main, feed={"w": w}, fetch_list=[out.name])
+    sigma = np.linalg.svd(w, compute_uv=False)[0]
+    np.testing.assert_allclose(np.asarray(o), w / sigma, atol=1e-3)
+
+
+def test_adaptive_pool3d_require_index():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 2, 2)
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = layers.data("x", [1, 4, 2, 2], dtype="float32")
+        out, mask = layers.adaptive_pool3d(xv, [2, 1, 1],
+                                           require_index=True)
+    with fluid.scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        o, m = exe.run(main, feed={"x": x},
+                       fetch_list=[out.name, mask.name])
+    np.testing.assert_allclose(np.asarray(o).ravel(), [7.0, 15.0])
+    np.testing.assert_array_equal(np.asarray(m).ravel(), [7, 15])
+
+
+def test_lod_append_keeps_existing_levels():
+    x = np.arange(8, dtype=np.float32).reshape(4, 2)
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = layers.data("x", [2], dtype="float32", lod_level=1)
+        out = layers.lod_append(xv, [0, 1, 2, 3, 4])
+    with fluid.scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        r = exe.run(main,
+                    feed={"x": create_lod_tensor(x, [[2, 2]])},
+                    fetch_list=[out.name])
+    t = r[0]
+    assert hasattr(t, "lod")
+    lod = t.lod()
+    assert len(lod) == 2           # existing level + appended level
+    assert lod[0] == [0, 2, 4]
+    assert lod[1] == [0, 1, 2, 3, 4]

@@ -1,0 +1,136 @@
+"""slim pruning / distillation / NAS (reference contrib/slim/prune,
+distillation/distiller.py, nas/sa_controller.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.contrib.slim.prune import (
+    MagnitudePruner, StructuredPruner, apply_prune_masks)
+from paddle_tpu.contrib.slim.distillation import (
+    merge, l2_loss, soft_label_loss, fsp_loss)
+from paddle_tpu.contrib.slim.nas import SAController
+from paddle_tpu.core.scope import Scope
+
+
+def _blobs(n, seed):
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 4, size=(n, 1))
+    centers = np.array([[2, 2], [-2, 2], [2, -2], [-2, -2]], np.float32)
+    x = centers[y[:, 0]] + rng.normal(0, 0.5, (n, 2))
+    return x.astype(np.float32), y.astype(np.int64)
+
+
+def _classifier(width=32, prefix=""):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [2], dtype="float32")
+        y = layers.data("y", [1], dtype="int64")
+        h = layers.fc(x, width, act="relu",
+                      param_attr=fluid.ParamAttr(name=prefix + "w0"))
+        logits = layers.fc(h, 4,
+                           param_attr=fluid.ParamAttr(name=prefix + "w1"))
+        sm = layers.softmax(logits)
+        loss = layers.mean(layers.cross_entropy(sm, y))
+        acc = layers.accuracy(sm, y)
+    return main, startup, loss, acc, logits, h
+
+
+def test_prune_finetune_keeps_accuracy_and_sparsity():
+    fluid.framework.unique_name.reset()
+    main, startup, loss, acc, _, _ = _classifier()
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.AdamOptimizer(0.02).minimize(loss)
+    xs, ys = _blobs(256, 0)
+    sc = Scope()
+    with fluid.scope_guard(sc):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for _ in range(40):
+            exe.run(main, feed={"x": xs, "y": ys},
+                    fetch_list=[loss.name])
+        base = float(np.asarray(exe.run(
+            main, feed={"x": xs, "y": ys}, fetch_list=[acc.name])[0]))
+        assert base > 0.9
+
+        pruner = MagnitudePruner(scope=sc)
+        masks = pruner.prune(main, ["w0"], [0.5])
+        w = np.asarray(sc.find_var("w0").get_value())
+        assert (w == 0).mean() >= 0.45
+        for _ in range(30):   # fine-tune with mask re-application
+            exe.run(main, feed={"x": xs, "y": ys},
+                    fetch_list=[loss.name])
+            apply_prune_masks(sc, masks)
+        w2 = np.asarray(sc.find_var("w0").get_value())
+        assert (w2 == 0).mean() >= 0.45   # stayed pruned
+        tuned = float(np.asarray(exe.run(
+            main, feed={"x": xs, "y": ys}, fetch_list=[acc.name])[0]))
+        assert tuned > 0.9
+
+
+def test_structured_pruner_removes_columns():
+    fluid.framework.unique_name.reset()
+    main, startup, loss, acc, _, _ = _classifier(width=16)
+    sc = Scope()
+    with fluid.scope_guard(sc):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        StructuredPruner(scope=sc).prune(main, ["w0"], [0.25])
+        w = np.asarray(sc.find_var("w0").get_value())   # [2, 16]
+        zero_cols = (w == 0).all(axis=0).sum()
+        assert zero_cols == 4   # 25% of 16 columns zeroed whole
+
+
+def test_distillation_merge_and_losses():
+    fluid.framework.unique_name.reset()
+    # teacher: train to high accuracy
+    t_main, t_startup, t_loss, t_acc, t_logits, t_h = _classifier(
+        width=64, prefix="t_")
+    t_infer = t_main.clone(for_test=True)   # before minimize: no opt ops
+    with fluid.program_guard(t_main, t_startup):
+        fluid.optimizer.AdamOptimizer(0.02).minimize(t_loss)
+    xs, ys = _blobs(256, 1)
+    sc = Scope()
+    with fluid.scope_guard(sc):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(t_startup)
+        for _ in range(60):
+            exe.run(t_main, feed={"x": xs, "y": ys},
+                    fetch_list=[t_loss.name])
+
+        # student
+        fluid.framework.unique_name.reset()
+        s_main, s_startup, s_loss, s_acc, s_logits, s_h = _classifier(
+            width=8, prefix="s_")
+        merged = merge(t_infer, s_main, {"x": "x", "y": "y"}, scope=sc)
+        dl = soft_label_loss("teacher_" + t_logits.name, s_logits.name,
+                             merged)
+        l2 = l2_loss("teacher_" + t_logits.name, s_logits.name, merged)
+        with fluid.program_guard(merged, s_startup):
+            total = fluid.layers.elementwise_add(
+                fluid.layers.elementwise_add(s_loss, dl), l2)
+            fluid.optimizer.AdamOptimizer(0.02).minimize(total)
+        exe.run(s_startup)
+        losses = [float(np.asarray(exe.run(
+            merged, feed={"x": xs, "y": ys},
+            fetch_list=[total.name])[0])) for _ in range(60)]
+        assert losses[-1] < losses[0]
+        s_accv = float(np.asarray(exe.run(
+            merged, feed={"x": xs, "y": ys},
+            fetch_list=[s_acc.name])[0]))
+        assert s_accv > 0.85
+        # teacher weights were NOT trained by the student optimizer
+        tw_names = [p.name for p in t_infer.all_parameters()]
+        assert all(n.startswith("teacher_") is False for n in tw_names)
+
+
+def test_sa_controller_minimizes_toy_objective():
+    # reward = -(sum(tokens) - 10)^2: optimum = token sum 10
+    ctrl = SAController(range_table=[8] * 4, max_iter_number=400,
+                        seed=3)
+
+    def reward(tokens):
+        return -float((sum(tokens) - 10) ** 2)
+
+    best, r = ctrl.search(reward, init_tokens=[0, 0, 0, 0])
+    assert sum(best) == 10 and r == 0.0

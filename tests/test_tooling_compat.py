@@ -1,0 +1,151 @@
+"""parameter_server fleet (transpile-to-collective), timeline tool,
+op-version compat gate, eager-fallback warning (reference
+parameter_server fleet, tools/timeline.py, framework/version.h,
+executor fallback)."""
+import json
+import os
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.scope import Scope
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------- parameter_server fleet
+
+def test_parameter_server_fleet_trains():
+    from paddle_tpu.incubate.fleet.base import role_maker
+    from paddle_tpu.incubate.fleet.parameter_server import fleet
+    os.environ["PADDLE_TRAINER_ID"] = "0"
+    os.environ["PADDLE_TRAINERS_NUM"] = "1"
+    os.environ["PADDLE_PSERVERS_IP_PORT_LIST"] = "127.0.0.1:36001"
+    os.environ["TRAINING_ROLE"] = "TRAINER"
+    try:
+        fluid.framework.unique_name.reset()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", [4], dtype="float32")
+            y = layers.data("y", [1], dtype="float32")
+            pred = layers.fc(x, 1)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+        fleet.init(role_maker.PaddleCloudRoleMaker(is_collective=False))
+        opt = fluid.optimizer.SGDOptimizer(learning_rate=0.1)
+        opt = fleet.distributed_optimizer(opt)
+        with fluid.program_guard(main, startup):
+            opt.minimize(loss)
+        fleet.run_server()      # must be a no-op, not a blocking loop
+        rng = np.random.RandomState(0)
+        xs = rng.rand(16, 4).astype(np.float32)
+        ys = xs.sum(1, keepdims=True).astype(np.float32)
+        scope = Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(fleet.startup_program)
+            losses = [float(np.asarray(exe.run(
+                fleet.main_program, feed={"x": xs, "y": ys},
+                fetch_list=[loss.name])[0])) for _ in range(10)]
+        assert losses[-1] < losses[0] * 0.5
+    finally:
+        for k in ("PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM",
+                  "PADDLE_PSERVERS_IP_PORT_LIST", "TRAINING_ROLE"):
+            os.environ.pop(k, None)
+
+
+# --------------------------------------------------------- timeline tool
+
+def test_timeline_merges_profiles(tmp_path):
+    p0 = tmp_path / "t0.chrome_trace.json"
+    p1 = tmp_path / "t1.chrome_trace.json"
+    for p, nm in [(p0, "fwd"), (p1, "bwd")]:
+        p.write_text(json.dumps({"traceEvents": [
+            {"name": nm, "ph": "X", "ts": 0, "dur": 5, "pid": 99,
+             "tid": 1}]}))
+    out = tmp_path / "timeline.json"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "timeline.py"),
+         "--profile_path", f"trainer0={p0},trainer1={p1}",
+         "--timeline_path", str(out)],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    trace = json.loads(out.read_text())
+    evs = trace["traceEvents"]
+    pids = {e["pid"] for e in evs if e.get("ph") == "X"}
+    assert pids == {0, 1}       # one lane per profile
+    names = {e["args"]["name"] for e in evs if e.get("ph") == "M"}
+    assert names == {"trainer0", "trainer1"}
+
+
+def test_profiler_emits_chrome_trace(tmp_path):
+    path = str(tmp_path / "prof")
+    fluid.profiler.reset_profiler()
+    fluid.profiler.start_profiler(state="CPU")
+    with fluid.profiler.RecordEvent("demo_scope"):
+        np.dot(np.ones((8, 8)), np.ones((8, 8)))
+    fluid.profiler.stop_profiler(profile_path=path)
+    trace = json.load(open(path + ".chrome_trace.json"))
+    assert any(e.get("name") == "demo_scope"
+               for e in trace["traceEvents"])
+
+
+# ------------------------------------------------------ op-version gate
+
+def test_op_version_compat_gate(tmp_path):
+    from paddle_tpu.core import op_version
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        pred = layers.fc(x, 2)
+    d = str(tmp_path / "m")
+    with fluid.scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ["x"], [pred], exe,
+                                      main_program=main)
+        # same-version load is clean
+        prog, feeds, fetches = fluid.io.load_inference_model(d, exe)
+        assert feeds == ["x"]
+        assert not any(op.type == op_version.VERSION_OP
+                       for op in prog.global_block().ops)
+
+        # saved-with-newer-op-version must fail loudly on load
+        op_version.register_op_version("mul", 99)
+        try:
+            d2 = str(tmp_path / "m2")
+            fluid.io.save_inference_model(d2, ["x"], [pred], exe,
+                                          main_program=main)
+        finally:
+            op_version.register_op_version("mul", 1)
+        with pytest.raises(op_version.OpVersionError):
+            fluid.io.load_inference_model(d2, exe)
+
+
+# ------------------------------------------- eager fallback is announced
+
+def test_eager_fallback_warns():
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [1], dtype="int64", lod_level=1)
+        erased = layers.sequence_erase(x, [0])
+    from paddle_tpu.core.scope import create_lod_tensor
+    ids = np.array([[0], [1], [2], [0]], np.int64)
+    with fluid.scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            out = exe.run(main,
+                          feed={"x": create_lod_tensor(ids, [[4]])},
+                          fetch_list=[erased.name])
+        assert any("EAGER interpreter" in str(x.message) for x in w)
+    arr = np.asarray(out[0].array if hasattr(out[0], "array")
+                     else out[0])
+    np.testing.assert_array_equal(arr.ravel(), [1, 2])

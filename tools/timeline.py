@@ -1,0 +1,68 @@
+"""Merge profiler outputs into one chrome://tracing timeline (reference
+tools/timeline.py: converts profiler protos from multiple trainers into
+a single trace with one pid lane per profile).
+
+Usage (same CLI contract as the reference):
+
+    python tools/timeline.py \
+        --profile_path "trainer0=/tmp/p0.chrome_trace.json,\
+trainer1=/tmp/p1.chrome_trace.json" \
+        --timeline_path /tmp/timeline.json
+
+Each input is a `<name>=<path>` pair where path is the
+`*.chrome_trace.json` written by `fluid.profiler.stop_profiler`; events
+from each profile are remapped onto their own pid and labeled with a
+process_name metadata record so chrome://tracing shows one lane per
+trainer.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def merge(profile_paths):
+    """profile_paths: list of (name, path). Returns chrome-trace dict."""
+    events = []
+    for pid, (name, path) in enumerate(profile_paths):
+        with open(path) as f:
+            data = json.load(f)
+        events.append({
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": name}})
+        for ev in data.get("traceEvents", []):
+            ev = dict(ev)
+            ev["pid"] = pid
+            events.append(ev)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def _parse_profile_arg(arg):
+    out = []
+    for item in arg.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        if "=" in item:
+            name, path = item.split("=", 1)
+        else:
+            name, path = f"profile{len(out)}", item
+        out.append((name, path))
+    return out
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--profile_path", required=True,
+                   help="comma-separated name=path chrome_trace inputs")
+    p.add_argument("--timeline_path", default="/tmp/timeline.json")
+    args = p.parse_args()
+    trace = merge(_parse_profile_arg(args.profile_path))
+    with open(args.timeline_path, "w") as f:
+        json.dump(trace, f)
+    print(f"wrote {args.timeline_path} "
+          f"({len(trace['traceEvents'])} events)")
+
+
+if __name__ == "__main__":
+    main()
