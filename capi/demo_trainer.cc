@@ -1,0 +1,72 @@
+/* Native trainer + inference demo (reference paddle/fluid/train/demo/
+ * demo_trainer.cc and inference/api/demo_ci): loads serialized
+ * ProgramDescs exported by save_demo_programs.py, trains fit-a-line
+ * from C++, then serves the saved inference model through the C API.
+ *
+ * Build + run:  make -C capi demo && ./capi/demo_trainer <work_dir>
+ * (save_demo_programs.py must have exported programs into work_dir.)
+ */
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+#include <vector>
+
+#include "paddle_tpu_c.h"
+
+int main(int argc, char** argv) {
+  const std::string dir = argc > 1 ? argv[1] : "/tmp/ptpu_capi_demo";
+  const std::string repo = argc > 2 ? argv[2] : ".";
+  if (ptpu_init(repo.c_str()) != 0) {
+    std::fprintf(stderr, "init failed: %s\n", ptpu_last_error());
+    return 1;
+  }
+
+  /* ---- train: y ~= sum(x) / 2, 13-dim fit-a-line ---- */
+  const long batch = 32, x_dim = 13;
+  std::vector<float> x(batch * x_dim), y(batch);
+  unsigned seed = 7;
+  for (long i = 0; i < batch; ++i) {
+    float s = 0.f;
+    for (long j = 0; j < x_dim; ++j) {
+      seed = seed * 1664525u + 1013904223u;
+      float v = (seed >> 8) / float(1 << 24);
+      x[i * x_dim + j] = v;
+      s += v;
+    }
+    y[i] = s / 2.0f;
+  }
+  float loss = -1.f;
+  if (ptpu_train_run((dir + "/main.pb").c_str(),
+                     (dir + "/startup.pb").c_str(), "demo_loss",
+                     "demo_x", "demo_y", x.data(), y.data(), batch,
+                     x_dim, 50, &loss) != 0) {
+    std::fprintf(stderr, "train failed: %s\n", ptpu_last_error());
+    return 1;
+  }
+  std::printf("train final loss: %f\n", loss);
+  if (!(loss < 0.5f)) {
+    std::fprintf(stderr, "loss did not converge\n");
+    return 1;
+  }
+
+  /* ---- inference through the predictor C API ---- */
+  int h = ptpu_predictor_create((dir + "/model").c_str(),
+                                /*use_accelerator=*/0);
+  if (h < 0) {
+    std::fprintf(stderr, "predictor failed: %s\n", ptpu_last_error());
+    return 1;
+  }
+  long shape[2] = {batch, x_dim};
+  std::vector<float> out(batch);
+  size_t out_len = 0;
+  if (ptpu_predictor_run(h, "demo_x", x.data(), shape, 2, out.data(),
+                         out.size(), &out_len) != 0) {
+    std::fprintf(stderr, "run failed: %s\n", ptpu_last_error());
+    return 1;
+  }
+  std::printf("inference ok: %zu outputs, out[0]=%f (target %f)\n",
+              out_len, out[0], y[0]);
+  ptpu_predictor_destroy(h);
+  std::printf("CAPI DEMO OK\n");
+  return 0;
+}

@@ -1,0 +1,233 @@
+/* Implementation of the paddle_tpu C API via CPython embedding.
+ * See paddle_tpu_c.h for the design rationale. */
+#include "paddle_tpu_c.h"
+
+#include <Python.h>
+
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+std::string g_last_error;
+std::mutex g_mu;
+std::map<int, PyObject*> g_predictors;
+int g_next_handle = 0;
+
+void set_error_from_python() {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    g_last_error = s ? PyUnicode_AsUTF8(s) : "unknown python error";
+    Py_XDECREF(s);
+  } else {
+    g_last_error = "unknown error";
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+PyObject* np_array_1d(const float* data, size_t n) {
+  /* build a python list then np.asarray(list, float32).reshape(shape)
+   * — avoids depending on the numpy C API headers */
+  PyObject* lst = PyList_New((Py_ssize_t)n);
+  for (size_t i = 0; i < n; ++i) {
+    PyList_SET_ITEM(lst, (Py_ssize_t)i, PyFloat_FromDouble(data[i]));
+  }
+  return lst;
+}
+
+}  // namespace
+
+extern "C" {
+
+int ptpu_init(const char* repo_root) {
+  if (Py_IsInitialized()) return 0;
+  Py_Initialize();
+  if (repo_root != nullptr) {
+    std::string code = "import sys; sys.path.insert(0, '";
+    code += repo_root;
+    code += "')";
+    if (PyRun_SimpleString(code.c_str()) != 0) {
+      g_last_error = "failed to set sys.path";
+      return -1;
+    }
+  }
+  if (PyRun_SimpleString("import paddle_tpu") != 0) {
+    g_last_error = "failed to import paddle_tpu";
+    return -1;
+  }
+  return 0;
+}
+
+void ptpu_finalize(void) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  for (auto& kv : g_predictors) Py_XDECREF(kv.second);
+  g_predictors.clear();
+  /* leave the interpreter up: JAX runtimes do not survive
+   * re-initialization; process exit cleans up */
+}
+
+int ptpu_predictor_create(const char* model_dir, int use_accelerator) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.inference");
+  if (!mod) { set_error_from_python(); return -1; }
+  PyObject* cfg_cls = PyObject_GetAttrString(mod, "AnalysisConfig");
+  PyObject* cfg = PyObject_CallFunction(cfg_cls, "s", model_dir);
+  Py_XDECREF(cfg_cls);
+  if (!cfg) { set_error_from_python(); Py_DECREF(mod); return -1; }
+  if (!use_accelerator) {
+    PyObject* r = PyObject_CallMethod(cfg, "disable_gpu", nullptr);
+    Py_XDECREF(r);
+  }
+  PyObject* create = PyObject_GetAttrString(mod,
+                                            "create_paddle_predictor");
+  PyObject* pred = PyObject_CallFunctionObjArgs(create, cfg, nullptr);
+  Py_XDECREF(create);
+  Py_DECREF(cfg);
+  Py_DECREF(mod);
+  if (!pred) { set_error_from_python(); return -1; }
+  int h = g_next_handle++;
+  g_predictors[h] = pred;
+  return h;
+}
+
+int ptpu_predictor_run(int handle, const char* input_name,
+                       const float* data, const long* shape, int ndim,
+                       float* out, size_t out_capacity,
+                       size_t* out_len) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_predictors.find(handle);
+  if (it == g_predictors.end()) {
+    g_last_error = "bad predictor handle";
+    return -1;
+  }
+  size_t n = 1;
+  for (int i = 0; i < ndim; ++i) n *= (size_t)shape[i];
+
+  PyObject* np = PyImport_ImportModule("numpy");
+  PyObject* lst = np_array_1d(data, n);
+  PyObject* arr = PyObject_CallMethod(np, "asarray", "Os", lst,
+                                      "float32");
+  Py_DECREF(lst);
+  PyObject* shp = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(shp, i, PyLong_FromLong(shape[i]));
+  PyObject* reshaped = PyObject_CallMethod(arr, "reshape", "O", shp);
+  Py_DECREF(arr);
+  Py_DECREF(shp);
+  if (!reshaped) { set_error_from_python(); Py_DECREF(np); return -1; }
+
+  /* zero-copy contract: get_input_tensor / copy_from_cpu / run */
+  PyObject* pred = it->second;
+  PyObject* itsr = PyObject_CallMethod(pred, "get_input_tensor", "s",
+                                       input_name);
+  if (!itsr) { set_error_from_python(); return -1; }
+  PyObject* r1 = PyObject_CallMethod(itsr, "copy_from_cpu", "O",
+                                     reshaped);
+  Py_XDECREF(r1);
+  Py_DECREF(reshaped);
+  Py_DECREF(itsr);
+  Py_DECREF(np);
+  PyObject* r2 = PyObject_CallMethod(pred, "zero_copy_run", nullptr);
+  if (!r2) { set_error_from_python(); return -1; }
+  Py_DECREF(r2);
+  PyObject* names = PyObject_CallMethod(pred, "get_output_names",
+                                        nullptr);
+  if (!names || PyList_Size(names) == 0) {
+    set_error_from_python();
+    Py_XDECREF(names);
+    return -1;
+  }
+  PyObject* name0 = PyList_GetItem(names, 0);
+  PyObject* otsr = PyObject_CallMethod(pred, "get_output_tensor", "O",
+                                       name0);
+  Py_DECREF(names);
+  if (!otsr) { set_error_from_python(); return -1; }
+  PyObject* out_arr = PyObject_CallMethod(otsr, "copy_to_cpu",
+                                          nullptr);
+  Py_DECREF(otsr);
+  if (!out_arr) { set_error_from_python(); return -1; }
+  PyObject* flat = PyObject_CallMethod(out_arr, "reshape", "i", -1);
+  Py_DECREF(out_arr);
+  if (!flat) { set_error_from_python(); return -1; }
+  PyObject* out_list = PyObject_CallMethod(flat, "tolist", nullptr);
+  Py_DECREF(flat);
+  if (!out_list) { set_error_from_python(); return -1; }
+  size_t m = (size_t)PyList_Size(out_list);
+  *out_len = m;
+  for (size_t i = 0; i < m && i < out_capacity; ++i) {
+    out[i] = (float)PyFloat_AsDouble(PyList_GetItem(out_list, i));
+  }
+  Py_DECREF(out_list);
+  return 0;
+}
+
+void ptpu_predictor_destroy(int handle) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto it = g_predictors.find(handle);
+  if (it != g_predictors.end()) {
+    Py_XDECREF(it->second);
+    g_predictors.erase(it);
+  }
+}
+
+int ptpu_train_run(const char* main_program_path,
+                   const char* startup_program_path,
+                   const char* loss_name, const char* x_name,
+                   const char* y_name, const float* x,
+                   const float* y, long batch, long x_dim, int steps,
+                   float* final_loss) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  /* Drive Executor through a small helper defined in __main__ so the
+   * buffer marshalling stays in one PyRun call (train/demo parity:
+   * the reference demo also fixes the fit-a-line topology). */
+  PyObject* main_mod = PyImport_AddModule("__main__");
+  PyObject* g = PyModule_GetDict(main_mod);
+
+  PyObject* xl = np_array_1d(x, (size_t)(batch * x_dim));
+  PyObject* yl = np_array_1d(y, (size_t)batch);
+  PyDict_SetItemString(g, "_ptpu_x", xl);
+  PyDict_SetItemString(g, "_ptpu_y", yl);
+  Py_DECREF(xl);
+  Py_DECREF(yl);
+  char code[4096];
+  std::snprintf(code, sizeof(code),
+      "import numpy as _np\n"
+      "import paddle_tpu as _fluid\n"
+      "_main = _fluid.Program.parse_from_string("
+      "open(r'%s','rb').read())\n"
+      "_startup = _fluid.Program.parse_from_string("
+      "open(r'%s','rb').read())\n"
+      "_scope = _fluid.Scope()\n"
+      "with _fluid.scope_guard(_scope):\n"
+      "    _exe = _fluid.Executor(_fluid.CPUPlace())\n"
+      "    _exe.run(_startup)\n"
+      "    _xa = _np.asarray(_ptpu_x, _np.float32)"
+      ".reshape(%ld, %ld)\n"
+      "    _ya = _np.asarray(_ptpu_y, _np.float32).reshape(%ld, 1)\n"
+      "    for _ in range(%d):\n"
+      "        _out = _exe.run(_main, feed={'%s': _xa, '%s': _ya},"
+      " fetch_list=['%s'])\n"
+      "    _ptpu_loss = float(_np.asarray(_out[0]))\n",
+      main_program_path, startup_program_path, batch, x_dim, batch,
+      steps, x_name, y_name, loss_name);
+  if (PyRun_SimpleString(code) != 0) {
+    g_last_error = "training script failed (see stderr)";
+    return -1;
+  }
+  PyObject* loss = PyDict_GetItemString(g, "_ptpu_loss");
+  if (!loss) { g_last_error = "loss not produced"; return -1; }
+  *final_loss = (float)PyFloat_AsDouble(loss);
+  return 0;
+}
+
+const char* ptpu_last_error(void) { return g_last_error.c_str(); }
+
+}  /* extern "C" */

@@ -1,0 +1,54 @@
+/* C API for paddle_tpu inference + training (reference
+ * paddle/fluid/inference/api/paddle_api.h C/C++ surface and
+ * paddle/fluid/train/demo's trainer entry).
+ *
+ * Design: the orchestration layer of this framework is Python (XLA
+ * executes the compute), so the native entry point embeds CPython —
+ * the inverse of the reference, whose Python embeds a C++ core. The
+ * contract is the same: load a serialized ProgramDesc/model dir from
+ * native code, push float32 buffers in, get float32 buffers out.
+ */
+#ifndef PADDLE_TPU_C_H
+#define PADDLE_TPU_C_H
+
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Start/stop the embedded runtime. repo_root = directory containing
+ * the paddle_tpu package; pass NULL to rely on PYTHONPATH. */
+int ptpu_init(const char* repo_root);
+void ptpu_finalize(void);
+
+/* ---- inference (AnalysisPredictor) ---- */
+/* Returns a predictor handle >= 0, or -1 on error. */
+int ptpu_predictor_create(const char* model_dir, int use_accelerator);
+/* Run with a single float32 input tensor; writes up to out_capacity
+ * floats of output 0 and stores its element count in *out_len.
+ * Returns 0 on success. */
+int ptpu_predictor_run(int handle, const char* input_name,
+                       const float* data, const long* shape, int ndim,
+                       float* out, size_t out_capacity,
+                       size_t* out_len);
+void ptpu_predictor_destroy(int handle);
+
+/* ---- training (train/demo parity) ----
+ * Load serialized main/startup ProgramDesc files (Program.
+ * serialize_to_string bytes on disk), run `steps` iterations feeding
+ * x[batch, x_dim] / y[batch, 1] float32 buffers, return final loss. */
+int ptpu_train_run(const char* main_program_path,
+                   const char* startup_program_path,
+                   const char* loss_name, const char* x_name,
+                   const char* y_name, const float* x,
+                   const float* y, long batch, long x_dim, int steps,
+                   float* final_loss);
+
+/* Last error message (empty string if none). */
+const char* ptpu_last_error(void);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* PADDLE_TPU_C_H */

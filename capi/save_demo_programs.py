@@ -1,0 +1,50 @@
+"""Export the fit-a-line train/startup ProgramDescs + inference model
+for the native demo (reference train/demo/README.md's save_model step)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import numpy as np  # noqa: E402
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu import layers  # noqa: E402
+
+
+def main(out_dir):
+    os.makedirs(out_dir, exist_ok=True)
+    fluid.framework.unique_name.reset()
+    main_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup):
+        x = layers.data("demo_x", [13], dtype="float32")
+        y = layers.data("demo_y", [1], dtype="float32")
+        pred = layers.fc(x, 1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        blk = main_p.global_block()
+        blk.create_var(name="demo_loss", shape=[], dtype="float32")
+        blk.append_op("assign", inputs={"X": [loss.name]},
+                      outputs={"Out": ["demo_loss"]})
+        fluid.optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    with open(os.path.join(out_dir, "main.pb"), "wb") as f:
+        f.write(main_p.serialize_to_string())
+    with open(os.path.join(out_dir, "startup.pb"), "wb") as f:
+        f.write(startup.serialize_to_string())
+
+    # train briefly in-python only to export a usable inference model
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    xs = rng.rand(64, 13).astype(np.float32)
+    ys = xs.sum(1, keepdims=True).astype(np.float32) / 2
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for _ in range(50):
+            exe.run(main_p, feed={"demo_x": xs, "demo_y": ys},
+                    fetch_list=[loss.name])
+        fluid.io.save_inference_model(
+            os.path.join(out_dir, "model"), ["demo_x"], [pred], exe,
+            main_program=main_p)
+    print("exported to", out_dir)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "/tmp/ptpu_capi_demo")

@@ -4,3 +4,4 @@ Parity: reference python/paddle/fluid/contrib/ (SURVEY §2.6 row contrib).
 """
 from . import mixed_precision  # noqa: F401
 from . import slim  # noqa: F401
+from . import utils  # noqa: F401

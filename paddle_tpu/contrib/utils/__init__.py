@@ -1,0 +1,4 @@
+"""contrib.utils (reference python/paddle/fluid/contrib/utils/):
+HDFSClient shell wrapper + local-fs helpers."""
+from .hdfs_utils import HDFSClient, LocalFS, multi_download, \
+    multi_upload  # noqa: F401

@@ -1,0 +1,195 @@
+"""Filesystem shell wrappers (reference contrib/utils/hdfs_utils.py:35
+HDFSClient + the C++ framework/io/fs.{h,cc} / shell.{h,cc} pair that
+backs Dataset file lists).
+
+HDFSClient shells out to `hadoop fs` exactly like the reference (with
+retries); LocalFS provides the same method surface over the local
+filesystem so Dataset/file-list code is storage-agnostic — the TPU
+image has no HDFS, so LocalFS is the default and HDFSClient raises a
+clear error when the hadoop binary is absent rather than at first use.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["HDFSClient", "LocalFS", "multi_download", "multi_upload"]
+
+
+class LocalFS:
+    """Local filesystem with the HDFSClient method surface (reference
+    framework/io/fs.cc localfs_* functions)."""
+
+    def ls(self, path) -> List[str]:
+        return sorted(os.path.join(path, n) for n in os.listdir(path))
+
+    def lsr(self, path, only_file=True) -> List[str]:
+        out = []
+        for root, dirs, files in os.walk(path):
+            for f in files:
+                out.append(os.path.join(root, f))
+            if not only_file:
+                for d in dirs:
+                    out.append(os.path.join(root, d))
+        return sorted(out)
+
+    def is_exist(self, path) -> bool:
+        return os.path.exists(path)
+
+    def is_dir(self, path) -> bool:
+        return os.path.isdir(path)
+
+    def makedirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def rename(self, src, dst, overwrite=False):
+        if overwrite and os.path.exists(dst):
+            self.delete(dst)
+        os.replace(src, dst)
+
+    def upload(self, dst, src, overwrite=False, retry_times=5):
+        if overwrite and os.path.exists(dst):
+            self.delete(dst)
+        shutil.copy(src, dst)
+
+    def download(self, src, local_path, overwrite=False, unzip=False):
+        if overwrite and os.path.exists(local_path):
+            self.delete(local_path)
+        shutil.copy(src, local_path)
+
+
+class HDFSClient:
+    """`hadoop fs` shell wrapper (reference hdfs_utils.py:35-435):
+    every call runs `hadoop --config <configs> fs <cmd>` with
+    retry_times retries."""
+
+    def __init__(self, hadoop_home: str, configs: Dict[str, str]):
+        self.pre_commands = []
+        hadoop_bin = os.path.join(hadoop_home, "bin", "hadoop")
+        if not os.path.exists(hadoop_bin):
+            raise RuntimeError(
+                f"hadoop binary not found at {hadoop_bin}; this image "
+                f"has no HDFS — use LocalFS for local file lists")
+        self.pre_commands.append(hadoop_bin)
+        self.pre_commands.append("fs")
+        # -D config flags ride on every command (hadoop fs -Dk=v <cmd>)
+        for k, v in (configs or {}).items():
+            self.pre_commands.append(f"-D{k}={v}")
+
+    def _run(self, commands: List[str], retry_times: int = 5):
+        cmd = list(self.pre_commands) + commands
+        for attempt in range(max(int(retry_times), 1)):
+            ret = subprocess.run(cmd, capture_output=True, text=True)
+            if ret.returncode == 0:
+                return True, ret.stdout
+            time.sleep(min(2 ** attempt, 16))
+        return False, ret.stderr
+
+    def is_exist(self, hdfs_path) -> bool:
+        ok, _ = self._run(["-test", "-e", hdfs_path], retry_times=1)
+        return ok
+
+    def is_dir(self, hdfs_path) -> bool:
+        ok, _ = self._run(["-test", "-d", hdfs_path], retry_times=1)
+        return ok
+
+    def ls(self, hdfs_path) -> List[str]:
+        ok, out = self._run(["-ls", hdfs_path])
+        if not ok:
+            return []
+        files = []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) >= 8:
+                files.append(parts[-1])
+        return sorted(files)
+
+    def lsr(self, hdfs_path, only_file=True, sort=True) -> List[str]:
+        ok, out = self._run(["-lsr", hdfs_path])
+        if not ok:
+            return []
+        files = []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) >= 8:
+                if only_file and parts[0].startswith("d"):
+                    continue
+                files.append(parts[-1])
+        return sorted(files) if sort else files
+
+    def makedirs(self, hdfs_path):
+        ok, err = self._run(["-mkdir", "-p", hdfs_path])
+        if not ok:
+            raise RuntimeError(f"hdfs mkdir failed: {err}")
+
+    def delete(self, hdfs_path):
+        self._run(["-rm", "-r", "-skipTrash", hdfs_path])
+
+    def rename(self, src, dst, overwrite=False):
+        if overwrite:
+            self.delete(dst)
+        ok, err = self._run(["-mv", src, dst])
+        if not ok:
+            raise RuntimeError(f"hdfs mv failed: {err}")
+
+    def upload(self, hdfs_path, local_path, overwrite=False,
+               retry_times=5):
+        if overwrite:
+            self.delete(hdfs_path)
+        ok, err = self._run(["-put", local_path, hdfs_path],
+                            retry_times)
+        if not ok:
+            raise RuntimeError(f"hdfs put failed: {err}")
+
+    def download(self, hdfs_path, local_path, overwrite=False,
+                 unzip=False):
+        if overwrite and os.path.exists(local_path):
+            if os.path.isdir(local_path):
+                shutil.rmtree(local_path, ignore_errors=True)
+            else:
+                os.remove(local_path)
+        ok, err = self._run(["-get", hdfs_path, local_path])
+        if not ok:
+            raise RuntimeError(f"hdfs get failed: {err}")
+
+
+def multi_download(client, hdfs_path, local_path, trainer_id,
+                   trainers, multi_processes=5):
+    """Download this trainer's shard of the file list with a worker
+    pool (reference hdfs_utils.py:437 __subprocess_download)."""
+    from multiprocessing.pool import ThreadPool
+    files = client.lsr(hdfs_path)
+    my_files = files[trainer_id::trainers]
+    os.makedirs(local_path, exist_ok=True)
+
+    def _one(f):
+        dst = os.path.join(local_path, os.path.basename(f))
+        client.download(f, dst)
+        return dst
+
+    with ThreadPool(max(int(multi_processes), 1)) as pool:
+        return pool.map(_one, my_files)
+
+
+def multi_upload(client, hdfs_path, local_path, multi_processes=5,
+                 overwrite=False):
+    from multiprocessing.pool import ThreadPool
+    lfs = LocalFS()
+    files = lfs.lsr(local_path)
+    client.makedirs(hdfs_path)
+
+    def _one(f):
+        client.upload(os.path.join(hdfs_path, os.path.basename(f)), f,
+                      overwrite=overwrite)
+
+    with ThreadPool(max(int(multi_processes), 1)) as pool:
+        pool.map(_one, files)

@@ -41,15 +41,18 @@ class Place:
 
 @functools.lru_cache(maxsize=None)
 def _devices_for(platforms):
+    # LOCAL devices: a Place is a per-process device handle (like the
+    # reference's CUDAPlace(dev_id) per trainer process); under
+    # jax.distributed another process's device is not addressable
     for p in platforms:
         try:
-            devs = jax.devices(p)
+            devs = jax.local_devices(backend=p)
         except RuntimeError:
             devs = []
         if devs:
             return tuple(devs)
-    # final fallback: whatever the default backend exposes
-    return tuple(jax.devices())
+    # final fallback: whatever the default backend exposes locally
+    return tuple(jax.local_devices())
 
 
 class CPUPlace(Place):

@@ -85,14 +85,55 @@ class DataParallel(Layer):
         return loss * (1.0 / self._strategy.nranks)
 
     def apply_collective_grads(self):
+        """Eager cross-process gradient allreduce (reference
+        apply_collective_grads -> c_allreduce_sum over coalesced grads,
+        dygraph/parallel.py:202-245). Each process contributes its
+        local grad as one slice of a ["dp"]-stacked global array; a
+        jitted sum over that axis is the XLA allreduce. With
+        scale_loss's 1/nranks this reproduces the reference's
+        scale-then-sum contract exactly."""
         if self._strategy.nranks < 2:
             return
-        # multi-process eager allreduce arrives with the multi-host comm
-        # milestone (parallel/); single-process multi-chip dygraph uses
-        # the graph-mode CompiledProgram path instead.
+        if jax.process_count() < 2:
+            # single process: the whole batch is local, grads complete
+            return
+        stacked, nproc, _sum0 = self._allreduce_ctx()
         for p in self._layers.parameters():
-            if p.grad is not None:
-                pass
+            ivar = getattr(p, "_ivar", p)
+            if getattr(ivar, "grad", None) is None:
+                continue
+            local = np.asarray(ivar.grad)
+            garr = jax.make_array_from_process_local_data(
+                stacked, local[None], (nproc,) + local.shape)
+            # pull the replicated result back to a process-local array
+            # so subsequent eager ops don't mix global/local devices
+            import jax.numpy as jnp
+            ivar.grad = jnp.asarray(np.asarray(_sum0(garr)))
+
+    def _allreduce_ctx(self):
+        """Cached (sharding, nproc, jitted sum): built once so the jit
+        cache holds per grad shape instead of retracing every step.
+        The allreduce mesh uses ONE device per process — the stacked
+        axis has process_count slices regardless of how many local
+        chips each process owns."""
+        if getattr(self, "_ar_ctx", None) is None:
+            import jax.numpy as jnp
+            from jax.sharding import Mesh, NamedSharding, \
+                PartitionSpec as P
+            nproc = jax.process_count()
+            devs = [jax.local_devices(process_index=i)[0]
+                    for i in range(nproc)]
+            mesh = Mesh(np.array(devs), ("dp",))
+            repl = NamedSharding(mesh, P())
+            stacked = NamedSharding(mesh, P("dp"))
+
+            @jax.jit
+            def _sum0(a):
+                return jax.lax.with_sharding_constraint(
+                    jnp.sum(a, axis=0), repl)
+
+            self._ar_ctx = (stacked, nproc, _sum0)
+        return self._ar_ctx
 
     def parameters(self, include_sublayers=True):
         return self._layers.parameters(include_sublayers)

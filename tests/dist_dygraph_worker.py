@@ -1,0 +1,83 @@
+"""Dygraph DataParallel worker for the 2-process cluster test
+(reference test_dist_base.py TestParallelDyGraphRunnerBase.run_trainer:
+scale_loss -> backward -> apply_collective_grads -> minimize)."""
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("XLA_FLAGS", None)
+
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu import dygraph  # noqa: E402
+
+
+class Net(dygraph.Layer):
+    def __init__(self):
+        super().__init__("net")
+        self.fc1 = dygraph.nn.FC("fc1", 16)
+        self.fc2 = dygraph.nn.FC("fc2", 1)
+
+    def forward(self, x):
+        return self.fc2(fluid.layers.tanh(self.fc1(x)))
+
+
+def main():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    nranks = int(os.environ["PADDLE_TRAINERS_NUM"])
+    eps = os.environ["PADDLE_TRAINER_ENDPOINTS"].split(",")
+    jax.distributed.initialize(coordinator_address=eps[0],
+                               num_processes=nranks, process_id=rank)
+    with dygraph.guard():
+        net = Net()
+        strategy = dygraph.parallel.prepare_context()
+        dp = dygraph.parallel.DataParallel(net, strategy)
+        opt = fluid.optimizer.SGDOptimizer(learning_rate=0.1)
+        # identical init on every rank: overwrite params deterministically
+        first = True
+        losses = []
+        for step in range(5):
+            rng = np.random.RandomState(500 + step)
+            gx = rng.rand(8, 4).astype(np.float32)
+            gy = gx.sum(1, keepdims=True).astype(np.float32) / 2
+            per = 8 // nranks
+            sl = slice(rank * per, (rank + 1) * per)
+            x = dygraph.to_variable(gx[sl])
+            y = dygraph.to_variable(gy[sl])
+            pred = dp(x)
+            if first:
+                first = False
+                wrng = np.random.RandomState(7)
+                for p in net.parameters():
+                    ivar = getattr(p, "_ivar", p)
+                    shape = np.asarray(ivar.value).shape
+                    ivar.set_value(
+                        (wrng.rand(*shape) * 0.2).astype(np.float32))
+                pred = dp(x)   # recompute with the shared init
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            loss = dp.scale_loss(loss)
+            loss.backward()
+            dp.apply_collective_grads()
+            opt.minimize(loss)
+            net.clear_gradients()
+            # undo scale_loss: this is the RANK-LOCAL mean loss
+            # (ranks see different shards, so values differ)
+            losses.append(float(np.asarray(loss.numpy())) * nranks)
+        w = np.asarray(getattr(net.parameters()[0], "_ivar",
+                               net.parameters()[0]).value)
+    print("DYLOSSES " + json.dumps(losses), flush=True)
+    print("DYWSUM " + json.dumps(float(w.sum())), flush=True)
+
+
+if __name__ == "__main__":
+    main()

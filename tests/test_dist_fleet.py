@@ -96,3 +96,72 @@ def test_two_process_fleet_matches_single_process():
     ref = _single_process_reference()
     np.testing.assert_allclose(per_rank[0], ref, rtol=1e-4, atol=1e-5)
     assert per_rank[0][-1] < per_rank[0][0]
+
+
+def test_two_process_dygraph_data_parallel():
+    """Dygraph DataParallel eager allreduce across 2 processes: both
+    ranks converge to IDENTICAL params matching the single-process
+    full-batch run (reference test_parallel_dygraph_* pattern)."""
+    worker = os.path.join(os.path.dirname(WORKER),
+                          "dist_dygraph_worker.py")
+    nranks = 2
+    eps = ",".join(f"127.0.0.1:{_free_port()}" for _ in range(nranks))
+    procs = []
+    for rank in range(nranks):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.update({"PADDLE_TRAINER_ID": str(rank),
+                    "PADDLE_TRAINERS_NUM": str(nranks),
+                    "PADDLE_TRAINER_ENDPOINTS": eps,
+                    "JAX_PLATFORMS": "cpu"})
+        procs.append(subprocess.Popen(
+            [sys.executable, worker], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+        outs.append(out)
+    wsums = [json.loads([ln for ln in o.splitlines()
+                         if ln.startswith("DYWSUM ")][0][7:])
+             for o in outs]
+    assert abs(wsums[0] - wsums[1]) < 1e-6   # ranks stayed in sync
+
+    # single-process full-batch reference with the same forced init
+    from paddle_tpu import dygraph
+    import paddle_tpu as fluid2
+    sys.path.insert(0, os.path.dirname(WORKER))
+    from dist_dygraph_worker import Net
+    with dygraph.guard():
+        net = Net()
+        opt = fluid2.optimizer.SGDOptimizer(learning_rate=0.1)
+        first = True
+        for step in range(5):
+            rng = np.random.RandomState(500 + step)
+            gx = rng.rand(8, 4).astype(np.float32)
+            gy = gx.sum(1, keepdims=True).astype(np.float32) / 2
+            x = dygraph.to_variable(gx)
+            y = dygraph.to_variable(gy)
+            pred = net(x)
+            if first:
+                first = False
+                wrng = np.random.RandomState(7)
+                for p in net.parameters():
+                    ivar = getattr(p, "_ivar", p)
+                    shape = np.asarray(ivar.value).shape
+                    ivar.set_value(
+                        (wrng.rand(*shape) * 0.2).astype(np.float32))
+                pred = net(x)
+            loss = fluid2.layers.mean(
+                fluid2.layers.square_error_cost(pred, y))
+            loss.backward()
+            opt.minimize(loss)
+            net.clear_gradients()
+        ref_w = np.asarray(getattr(net.parameters()[0], "_ivar",
+                                   net.parameters()[0]).value)
+    np.testing.assert_allclose(wsums[0], float(ref_w.sum()), rtol=1e-5)
