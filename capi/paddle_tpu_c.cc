@@ -18,6 +18,17 @@ std::mutex g_mu;
 std::map<int, PyObject*> g_predictors;
 int g_next_handle = 0;
 
+/* RAII GIL acquisition: after ptpu_init releases the GIL (so OTHER
+ * threads can enter), every entry point must take it back. */
+class GilGuard {
+ public:
+  GilGuard() : state_(PyGILState_Ensure()) {}
+  ~GilGuard() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
 void set_error_from_python() {
   PyObject *type, *value, *tb;
   PyErr_Fetch(&type, &value, &tb);
@@ -50,24 +61,29 @@ extern "C" {
 int ptpu_init(const char* repo_root) {
   if (Py_IsInitialized()) return 0;
   Py_Initialize();
+  int rc = 0;
   if (repo_root != nullptr) {
     std::string code = "import sys; sys.path.insert(0, '";
     code += repo_root;
     code += "')";
     if (PyRun_SimpleString(code.c_str()) != 0) {
       g_last_error = "failed to set sys.path";
-      return -1;
+      rc = -1;
     }
   }
-  if (PyRun_SimpleString("import paddle_tpu") != 0) {
+  if (rc == 0 && PyRun_SimpleString("import paddle_tpu") != 0) {
     g_last_error = "failed to import paddle_tpu";
-    return -1;
+    rc = -1;
   }
-  return 0;
+  /* release the GIL so ANY thread (including this one, via GilGuard)
+   * can enter the API afterwards */
+  PyEval_SaveThread();
+  return rc;
 }
 
 void ptpu_finalize(void) {
   std::lock_guard<std::mutex> lk(g_mu);
+  GilGuard gil;
   for (auto& kv : g_predictors) Py_XDECREF(kv.second);
   g_predictors.clear();
   /* leave the interpreter up: JAX runtimes do not survive
@@ -76,6 +92,7 @@ void ptpu_finalize(void) {
 
 int ptpu_predictor_create(const char* model_dir, int use_accelerator) {
   std::lock_guard<std::mutex> lk(g_mu);
+  GilGuard gil;
   PyObject* mod = PyImport_ImportModule("paddle_tpu.inference");
   if (!mod) { set_error_from_python(); return -1; }
   PyObject* cfg_cls = PyObject_GetAttrString(mod, "AnalysisConfig");
@@ -103,6 +120,7 @@ int ptpu_predictor_run(int handle, const char* input_name,
                        float* out, size_t out_capacity,
                        size_t* out_len) {
   std::lock_guard<std::mutex> lk(g_mu);
+  GilGuard gil;
   auto it = g_predictors.find(handle);
   if (it == g_predictors.end()) {
     g_last_error = "bad predictor handle";
@@ -171,6 +189,7 @@ int ptpu_predictor_run(int handle, const char* input_name,
 
 void ptpu_predictor_destroy(int handle) {
   std::lock_guard<std::mutex> lk(g_mu);
+  GilGuard gil;
   auto it = g_predictors.find(handle);
   if (it != g_predictors.end()) {
     Py_XDECREF(it->second);
@@ -185,6 +204,7 @@ int ptpu_train_run(const char* main_program_path,
                    const float* y, long batch, long x_dim, int steps,
                    float* final_loss) {
   std::lock_guard<std::mutex> lk(g_mu);
+  GilGuard gil;
   /* Drive Executor through a small helper defined in __main__ so the
    * buffer marshalling stays in one PyRun call (train/demo parity:
    * the reference demo also fixes the fit-a-line topology). */

@@ -50,6 +50,14 @@ from .transpiler import (  # noqa: F401
 )
 from . import incubate  # noqa: F401
 from . import inference  # noqa: F401
+from . import nets  # noqa: F401
+from . import average  # noqa: F401
+from . import evaluator  # noqa: F401
+from . import lod_tensor  # noqa: F401
+from .lod_tensor import create_random_int_lodtensor  # noqa: F401
+from . import net_drawer  # noqa: F401
+from . import install_check  # noqa: F401
+from . import dygraph_grad_clip  # noqa: F401
 from .core.flags import get_flags, set_flags  # noqa: F401
 from .core.enforce import EnforceNotMet, enforce  # noqa: F401
 

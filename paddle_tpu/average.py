@@ -1,0 +1,39 @@
+"""WeightedAverage (reference fluid/average.py:30): host-side running
+weighted mean used by training loops to smooth fetched metrics."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["WeightedAverage"]
+
+
+def _is_number_or_matrix(var):
+    return isinstance(var, (int, float, np.ndarray)) or \
+        np.isscalar(var)
+
+
+class WeightedAverage:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.numerator = None
+        self.denominator = None
+
+    def add(self, value, weight):
+        if not _is_number_or_matrix(value):
+            raise ValueError("value must be a number or ndarray")
+        if not _is_number_or_matrix(weight):
+            raise ValueError("weight must be a number")
+        v = np.mean(value)
+        if self.numerator is None:
+            self.numerator = v * weight
+            self.denominator = weight
+        else:
+            self.numerator += v * weight
+            self.denominator += weight
+
+    def eval(self):
+        if self.numerator is None or self.denominator == 0:
+            raise ValueError("eval() before add()")
+        return self.numerator / self.denominator

@@ -57,12 +57,18 @@ class LocalFS:
         os.replace(src, dst)
 
     def upload(self, dst, src, overwrite=False, retry_times=5):
-        if overwrite and os.path.exists(dst):
+        if os.path.exists(dst):
+            if not overwrite:
+                raise FileExistsError(
+                    f"{dst} exists and overwrite=False")
             self.delete(dst)
         shutil.copy(src, dst)
 
     def download(self, src, local_path, overwrite=False, unzip=False):
-        if overwrite and os.path.exists(local_path):
+        if os.path.exists(local_path):
+            if not overwrite:
+                raise FileExistsError(
+                    f"{local_path} exists and overwrite=False")
             self.delete(local_path)
         shutil.copy(src, local_path)
 
@@ -87,11 +93,13 @@ class HDFSClient:
 
     def _run(self, commands: List[str], retry_times: int = 5):
         cmd = list(self.pre_commands) + commands
-        for attempt in range(max(int(retry_times), 1)):
+        n = max(int(retry_times), 1)
+        for attempt in range(n):
             ret = subprocess.run(cmd, capture_output=True, text=True)
             if ret.returncode == 0:
                 return True, ret.stdout
-            time.sleep(min(2 ** attempt, 16))
+            if attempt + 1 < n:       # no pointless sleep after the last try
+                time.sleep(min(2 ** attempt, 16))
         return False, ret.stderr
 
     def is_exist(self, hdfs_path) -> bool:
@@ -172,8 +180,13 @@ def multi_download(client, hdfs_path, local_path, trainer_id,
     os.makedirs(local_path, exist_ok=True)
 
     def _one(f):
-        dst = os.path.join(local_path, os.path.basename(f))
-        client.download(f, dst)
+        # keep the remote directory structure: equal basenames in
+        # different subdirs (part-00000 everywhere) must not collide
+        rel = os.path.relpath(f, hdfs_path) if f.startswith(
+            str(hdfs_path)) else os.path.basename(f)
+        dst = os.path.join(local_path, rel)
+        os.makedirs(os.path.dirname(dst) or local_path, exist_ok=True)
+        client.download(f, dst, overwrite=True)
         return dst
 
     with ThreadPool(max(int(multi_processes), 1)) as pool:
@@ -188,7 +201,8 @@ def multi_upload(client, hdfs_path, local_path, multi_processes=5,
     client.makedirs(hdfs_path)
 
     def _one(f):
-        client.upload(os.path.join(hdfs_path, os.path.basename(f)), f,
+        rel = os.path.relpath(f, local_path)
+        client.upload(os.path.join(hdfs_path, rel), f,
                       overwrite=overwrite)
 
     with ThreadPool(max(int(multi_processes), 1)) as pool:

@@ -373,13 +373,24 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
 
     # --- phase 1: abstract trace to discover updated persistables ---------
     params_sig = {}
+    opaque_state = False
     for n in avail:
         val = scope.find_var(n).get_value()
         arr = val.array if isinstance(val, LoDTensor) else val
-        params_sig[n] = jax.ShapeDtypeStruct(jnp.shape(arr),
-                                             jnp.result_type(arr))
+        try:
+            params_sig[n] = jax.ShapeDtypeStruct(jnp.shape(arr),
+                                                 jnp.result_type(arr))
+        except (TypeError, ValueError):
+            # host-state object persistable (e.g. the DetectionMAP
+            # evaluator's accumulation state): not jittable by
+            # definition — run the whole block eagerly
+            opaque_state = True
+            break
     key_sig = jax.ShapeDtypeStruct((2,), jnp.uint32)
     try:
+        if opaque_state:
+            raise NotImplementedError(
+                f"persistable {n!r} holds a host-side state object")
         jax.eval_shape(step, params_sig, feed_sig, key_sig)
     except NotImplementedError as reason:
         # Block contains value-dependent-shape ops (sequence_erase,

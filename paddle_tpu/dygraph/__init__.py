@@ -6,3 +6,9 @@ from . import nn  # noqa: F401
 from .nn import *  # noqa: F401,F403
 from .checkpoint import save_persistables, load_persistables  # noqa: F401
 from .parallel import DataParallel, prepare_context, Env  # noqa: F401
+
+from . import learning_rate_scheduler  # noqa: F401
+from .learning_rate_scheduler import (  # noqa: F401
+    NoamDecay, PiecewiseDecay, NaturalExpDecay,
+    ExponentialDecay, InverseTimeDecay, PolynomialDecay,
+    CosineDecay)

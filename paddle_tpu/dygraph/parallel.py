@@ -95,8 +95,13 @@ class DataParallel(Layer):
         if self._strategy.nranks < 2:
             return
         if jax.process_count() < 2:
-            # single process: the whole batch is local, grads complete
-            return
+            raise RuntimeError(
+                f"DataParallel configured with nranks="
+                f"{self._strategy.nranks} but jax.process_count()=1 — "
+                f"jax.distributed was never initialized (call "
+                f"fleet.init_worker / jax.distributed.initialize "
+                f"before training); refusing to train on 1/nranks-"
+                f"scaled gradients")
         stacked, nproc, _sum0 = self._allreduce_ctx()
         for p in self._layers.parameters():
             ivar = getattr(p, "_ivar", p)

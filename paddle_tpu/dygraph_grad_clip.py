@@ -1,0 +1,66 @@
+"""Dygraph gradient clipping (reference fluid/dygraph_grad_clip.py:
+GradClipByValue, GradClipByNorm, GradClipByGlobalNorm) — applied to
+(param, grad) lists before optimizer.minimize in eager mode."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+__all__ = ["GradClipByValue", "GradClipByNorm", "GradClipByGlobalNorm"]
+
+
+def _grad_of(p):
+    return getattr(getattr(p, "_ivar", p), "grad", None)
+
+
+def _set_grad(p, g):
+    getattr(p, "_ivar", p).grad = g
+
+
+class GradClipBase:
+    def __call__(self, params):
+        """Clip every parameter's .grad in place; returns params."""
+        self._apply([p for p in params if _grad_of(p) is not None])
+        return params
+
+
+class GradClipByValue(GradClipBase):
+    def __init__(self, min_value, max_value=None):
+        if max_value is None:
+            max_value = abs(min_value)
+            min_value = -max_value
+        self.min_value = float(min_value)
+        self.max_value = float(max_value)
+
+    def _apply(self, params):
+        for p in params:
+            g = _grad_of(p)
+            _set_grad(p, jnp.clip(g, self.min_value, self.max_value))
+
+
+class GradClipByNorm(GradClipBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _apply(self, params):
+        for p in params:
+            g = _grad_of(p)
+            norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+            scale = jnp.minimum(self.clip_norm /
+                                jnp.maximum(norm, 1e-12), 1.0)
+            _set_grad(p, g * scale)
+
+
+class GradClipByGlobalNorm(GradClipBase):
+    def __init__(self, max_global_norm):
+        self.max_global_norm = float(max_global_norm)
+
+    def _apply(self, params):
+        grads = [_grad_of(p) for p in params]
+        global_sq = sum(jnp.sum(jnp.square(g)) for g in grads)
+        global_norm = jnp.sqrt(global_sq)
+        scale = jnp.minimum(self.max_global_norm /
+                            jnp.maximum(global_norm, 1e-12), 1.0)
+        for p, g in zip(params, grads):
+            _set_grad(p, g * scale)

@@ -1,0 +1,41 @@
+"""Installation sanity check (reference fluid/install_check.py:
+run_check builds a tiny linear model, trains one step on the available
+device(s), and prints success)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["run_check"]
+
+
+def run_check():
+    """Train a 2-layer net for a few steps; raises on any failure."""
+    from . import (CPUPlace, Executor, Program, Scope, layers,
+                   optimizer, program_guard, scope_guard)
+    from . import framework
+
+    framework.unique_name.reset()
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = layers.data("install_check_x", [4], dtype="float32")
+        y = layers.data("install_check_y", [1], dtype="float32")
+        pred = layers.fc(layers.fc(x, 8, act="relu"), 1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        optimizer.SGDOptimizer(learning_rate=0.1).minimize(loss)
+    rng = np.random.RandomState(0)
+    xs = rng.rand(8, 4).astype(np.float32)
+    ys = xs.sum(1, keepdims=True).astype(np.float32)
+    with scope_guard(Scope()):
+        exe = Executor()
+        exe.run(startup)
+        losses = [float(np.asarray(exe.run(
+            main, feed={"install_check_x": xs, "install_check_y": ys},
+            fetch_list=[loss.name])[0])) for _ in range(5)]
+    assert losses[-1] < losses[0], losses
+    print("Your paddle_tpu works well on this machine!")
+    import jax
+    print(f"devices: {jax.devices()}")
+
+
+if __name__ == "__main__":
+    run_check()

@@ -23,3 +23,5 @@ from .learning_rate_scheduler import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .sequence import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
+
+from . import distributions  # noqa: F401

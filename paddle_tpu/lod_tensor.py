@@ -1,0 +1,19 @@
+"""LoDTensor helper module (reference fluid/lod_tensor.py:
+create_lod_tensor :22, create_random_int_lodtensor :75) — thin wrappers
+over core.scope's LoDTensor with recursive-sequence-length inputs."""
+from __future__ import annotations
+
+import numpy as np
+
+from .core.scope import LoDTensor, create_lod_tensor  # noqa: F401
+
+__all__ = ["create_lod_tensor", "create_random_int_lodtensor"]
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place,
+                                low, high):
+    assert isinstance(base_shape, list), "base_shape should be a list"
+    # rows = total elements of the finest (innermost) lod level
+    overall = [sum(recursive_seq_lens[-1])] + list(base_shape)
+    data = np.random.randint(low, high + 1, overall).astype("int64")
+    return create_lod_tensor(data, recursive_seq_lens, place)

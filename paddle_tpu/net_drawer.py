@@ -1,0 +1,18 @@
+"""Program visualization (reference fluid/net_drawer.py + debugger.py
+draw_block_graphviz): renders a Program/Block as graphviz .dot via
+utils/graphviz.py."""
+from __future__ import annotations
+
+from .utils.graphviz import draw_program, program_to_dot  # noqa: F401
+
+__all__ = ["draw_program", "program_to_dot", "draw_block_graphviz"]
+
+
+def draw_block_graphviz(block, path="program.dot", highlights=None):
+    """Reference debugger.draw_block_graphviz: render ONE block,
+    highlighting the named vars."""
+    dot = program_to_dot(block.program, blocks=[block.idx],
+                         highlights=highlights)
+    with open(path, "w") as f:
+        f.write(dot)
+    return path

@@ -1,0 +1,110 @@
+"""Composite network builders (reference python/paddle/fluid/nets.py:
+simple_img_conv_pool :28, img_conv_group :136, sequence_conv_pool :249,
+glu :307, scaled_dot_product_attention :345) — composed from the same
+layer primitives the reference composes."""
+from __future__ import annotations
+
+from . import layers
+
+__all__ = ["simple_img_conv_pool", "img_conv_group",
+           "sequence_conv_pool", "glu", "scaled_dot_product_attention"]
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                         pool_stride, pool_padding=0, pool_type="max",
+                         global_pooling=False, conv_stride=1,
+                         conv_padding=0, conv_dilation=1,
+                         conv_groups=1, param_attr=None,
+                         bias_attr=None, act=None, use_cudnn=True):
+    conv_out = layers.conv2d(
+        input=input, num_filters=num_filters, filter_size=filter_size,
+        stride=conv_stride, padding=conv_padding,
+        dilation=conv_dilation, groups=conv_groups,
+        param_attr=param_attr, bias_attr=bias_attr, act=act)
+    return layers.pool2d(
+        input=conv_out, pool_size=pool_size, pool_type=pool_type,
+        pool_stride=pool_stride, pool_padding=pool_padding,
+        global_pooling=global_pooling)
+
+
+def img_conv_group(input, conv_num_filter, pool_size,
+                   conv_padding=1, conv_filter_size=3, conv_act=None,
+                   param_attr=None, conv_with_batchnorm=False,
+                   conv_batchnorm_drop_rate=0.0, pool_stride=1,
+                   pool_type="max", use_cudnn=True):
+    """VGG-style conv block: N convs (+BN +dropout) then one pool."""
+    tmp = input
+    assert isinstance(conv_num_filter, (list, tuple))
+
+    def _expand(v):
+        return v if isinstance(v, (list, tuple)) else \
+            [v] * len(conv_num_filter)
+
+    paddings = _expand(conv_padding)
+    fsizes = _expand(conv_filter_size)
+    with_bn = _expand(conv_with_batchnorm)
+    drops = _expand(conv_batchnorm_drop_rate)
+    pattrs = param_attr if isinstance(param_attr, (list, tuple)) \
+        else [param_attr] * len(conv_num_filter)
+
+    for i in range(len(conv_num_filter)):
+        local_act = conv_act if not with_bn[i] else None
+        tmp = layers.conv2d(
+            input=tmp, num_filters=conv_num_filter[i],
+            filter_size=fsizes[i], padding=paddings[i],
+            param_attr=pattrs[i], act=local_act)
+        if with_bn[i]:
+            tmp = layers.batch_norm(input=tmp, act=conv_act)
+            if abs(drops[i]) > 1e-5:
+                tmp = layers.dropout(x=tmp, dropout_prob=drops[i])
+    return layers.pool2d(input=tmp, pool_size=pool_size,
+                         pool_type=pool_type, pool_stride=pool_stride)
+
+
+def sequence_conv_pool(input, num_filters, filter_size, param_attr=None,
+                       act="sigmoid", pool_type="max", bias_attr=None):
+    conv_out = layers.sequence_conv(
+        input=input, num_filters=num_filters, filter_size=filter_size,
+        param_attr=param_attr, bias_attr=bias_attr, act=act)
+    return layers.sequence_pool(input=conv_out, pool_type=pool_type)
+
+
+def glu(input, dim=-1):
+    """Gated linear unit: split + sigmoid gate (reference nets.py:307)."""
+    a, b = layers.split(input, num_or_sections=2, dim=dim)
+    return layers.elementwise_mul(x=a, y=layers.sigmoid(b))
+
+
+def scaled_dot_product_attention(queries, keys, values, num_heads=1,
+                                 dropout_rate=0.0):
+    """Multi-head scaled dot-product attention over dense [B, T, D]
+    tensors (reference nets.py:345)."""
+    if num_heads < 1:
+        raise ValueError("num_heads must be >= 1")
+    d_key = queries.shape[-1] // num_heads
+
+    def _split_heads(x):
+        if num_heads == 1:
+            return x
+        b, t = 0, x.shape[1]
+        hidden = x.shape[2]
+        reshaped = layers.reshape(
+            x, shape=[0, x.shape[1], num_heads, hidden // num_heads])
+        return layers.transpose(reshaped, perm=[0, 2, 1, 3])
+
+    def _combine_heads(x):
+        if num_heads == 1:
+            return x
+        t = layers.transpose(x, perm=[0, 2, 1, 3])
+        return layers.reshape(
+            t, shape=[0, t.shape[1],
+                      int(t.shape[2]) * int(t.shape[3])])
+
+    q, k, v = (_split_heads(x) for x in (queries, keys, values))
+    scaled_q = layers.scale(q, scale=d_key ** -0.5)
+    product = layers.matmul(scaled_q, k, transpose_y=True)
+    weights = layers.softmax(product)
+    if dropout_rate:
+        weights = layers.dropout(weights, dropout_prob=dropout_rate)
+    ctx = layers.matmul(weights, v)
+    return _combine_heads(ctx)

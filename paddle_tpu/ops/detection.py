@@ -1537,8 +1537,21 @@ def detection_map(ctx):
     true_pos = {c: [] for c in range(class_num)}
     false_pos = {c: [] for c in range(class_num)}
     has_state = ctx.input("HasState")
-    if has_state is not None and int(np.asarray(has_state).ravel()[0]):
-        pc = np.asarray(ctx.input("PosCount")).ravel()
+    state_in = ctx.input("PosCount")
+    if isinstance(state_in, DetectionMAPState):
+        # evaluator accumulation path: host state object carried in a
+        # persistable var (eager-only op, so arbitrary host values are
+        # legal scope contents — same mechanism as SelectedRows)
+        if not state_in.empty:
+            pos_count = {c: int(v) for c, v in
+                         state_in.pos_count.items()}
+            true_pos = {c: [list(r) for r in v]
+                        for c, v in state_in.true_pos.items()}
+            false_pos = {c: [list(r) for r in v]
+                         for c, v in state_in.false_pos.items()}
+    elif has_state is not None and \
+            int(np.asarray(has_state).ravel()[0]):
+        pc = np.asarray(state_in).ravel()
         for c in range(min(class_num, pc.shape[0])):
             pos_count[c] = int(pc[c])
         tp_in = np.asarray(ctx.input("TruePos")).reshape(-1, 2)
@@ -1627,10 +1640,32 @@ def detection_map(ctx):
         tp_lod.append(len(tp_rows))
         fp_rows += false_pos.get(c, [])
         fp_lod.append(len(fp_rows))
-    ctx.set_output("AccumPosCount", jnp.asarray(pc_rows))
+    if isinstance(state_in, DetectionMAPState):
+        new_state = DetectionMAPState()
+        new_state.pos_count = dict(pos_count)
+        new_state.true_pos = {c: [list(r) for r in v]
+                              for c, v in true_pos.items()}
+        new_state.false_pos = {c: [list(r) for r in v]
+                               for c, v in false_pos.items()}
+        new_state.empty = False
+        ctx.set_output("AccumPosCount", new_state)
+    else:
+        ctx.set_output("AccumPosCount", jnp.asarray(pc_rows))
     ctx.set_output("AccumTruePos", jnp.asarray(
         np.array(tp_rows, np.float32).reshape(-1, 2)))
     ctx.set_output("AccumFalsePos", jnp.asarray(
         np.array(fp_rows, np.float32).reshape(-1, 2)))
     ctx.set_lod("AccumTruePos", [tp_lod])
     ctx.set_lod("AccumFalsePos", [fp_lod])
+
+
+class DetectionMAPState:
+    """Host-side accumulation state for the DetectionMAP evaluator
+    (per-class pos counts + scored tp/fp lists). Lives in a persistable
+    scope var; the eager detection_map op consumes and re-emits it."""
+
+    def __init__(self):
+        self.pos_count = {}
+        self.true_pos = {}
+        self.false_pos = {}
+        self.empty = True

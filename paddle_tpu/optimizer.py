@@ -78,6 +78,23 @@ class Optimizer:
     # ---- learning rate ----------------------------------------------------
     def _create_global_learning_rate(self):
         if in_dygraph_mode():
+            from .dygraph.learning_rate_scheduler import \
+                LearningRateDecay
+            if isinstance(self._learning_rate, LearningRateDecay):
+                # scheduler object: step it and refresh the lr var on
+                # every minimize (reference dygraph optimizer calls
+                # self._learning_rate() per step)
+                import jax.numpy as jnp
+                lr_now = float(self._learning_rate())
+                holder = self._learning_rate_map.get("dygraph")
+                if holder is None:
+                    from .dygraph.tracer import VarBase
+                    holder = VarBase(jnp.asarray([lr_now], jnp.float32),
+                                     stop_gradient=True)
+                    self._learning_rate_map["dygraph"] = holder
+                else:
+                    holder.set_value(jnp.asarray([lr_now], jnp.float32))
+                return
             if "dygraph" not in self._learning_rate_map:
                 if isinstance(self._learning_rate, Variable):
                     self._learning_rate_map["dygraph"] = \

@@ -1,5 +1,8 @@
 """Data pipeline (reference python/paddle/fluid/reader.py + data_feeder.py
 + paddle.batch + framework/data_set)."""
-from .decorators import DataFeeder, batch, PyReader  # noqa: F401
+from .decorators import (  # noqa: F401
+    DataFeeder, batch, PyReader, cache, map_readers, shuffle,
+    chain, compose, buffered, firstn, xmap_readers,
+    multiprocess_reader)
 from . import decorators  # noqa: F401
 from . import dataset  # noqa: F401

@@ -19,7 +19,10 @@ from ..core.scope import LoDTensor
 from ..core.types import dtype_to_np
 from ..framework import Variable
 
-__all__ = ["DataFeeder", "batch", "PyReader"]
+__all__ = ["DataFeeder", "batch", "PyReader", "cache",
+           "map_readers", "shuffle", "chain", "compose",
+           "buffered", "firstn", "xmap_readers",
+           "multiprocess_reader"]
 
 
 def batch(reader, batch_size, drop_last=False):
@@ -137,3 +140,220 @@ class PyReader:
             if item is stop:
                 break
             yield item
+
+
+# ---------------------------------------------------------------------------
+# paddle.reader decorator surface (reference python/paddle/reader/
+# decorator.py: cache :36, map_readers :60, shuffle :82, chain :117,
+# compose :149, buffered :196, firstn :239, xmap_readers :267,
+# multiprocess_reader :360)
+# ---------------------------------------------------------------------------
+
+def cache(reader):
+    """Cache the full pass in memory; subsequent passes replay it."""
+    all_data = tuple(reader())
+
+    def cached_reader():
+        yield from all_data
+
+    return cached_reader
+
+
+def map_readers(func, *readers):
+    def reader():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    import random as _random
+
+    def shuffled_reader():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return shuffled_reader
+
+
+def chain(*readers):
+    def chained_reader():
+        for r in readers:
+            yield from r()
+
+    return chained_reader
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into combined samples: (a, (b, c)) -> (a, b, c)."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def _flatten(item):
+        if isinstance(item, tuple):
+            out = []
+            for x in item:
+                out.extend(_flatten(x))
+            return tuple(out)
+        return (item,)
+
+    def composed_reader():
+        iters = [r() for r in readers]
+        while True:
+            items = []
+            done = 0
+            for it in iters:
+                try:
+                    items.append(next(it))
+                except StopIteration:
+                    done += 1
+                    items.append(None)
+            if done:
+                if check_alignment and 0 < done < len(iters):
+                    raise RuntimeError(
+                        "compose: readers have uneven lengths")
+                return
+            yield sum((_flatten(i) for i in items), ())
+
+    return composed_reader
+
+
+def buffered(reader, size):
+    """Prefetch up to `size` samples on a worker thread."""
+    import queue as _queue
+    import threading as _threading
+
+    end = object()
+
+    def buffered_reader():
+        q = _queue.Queue(maxsize=size)
+
+        def _fill():
+            try:
+                for item in reader():
+                    q.put(item)
+            finally:
+                q.put(end)
+
+        t = _threading.Thread(target=_fill, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is end:
+                return
+            yield item
+
+    return buffered_reader
+
+
+def firstn(reader, n):
+    def firstn_reader():
+        for i, item in enumerate(reader()):
+            if i >= n:
+                return
+            yield item
+
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel sample mapping over a thread pool (reference uses
+    threads too)."""
+    import queue as _queue
+    import threading as _threading
+
+    end = object()
+
+    def xreader():
+        in_q = _queue.Queue(buffer_size)
+        out_q = _queue.Queue(buffer_size)
+
+        def _feed():
+            try:
+                for i, sample in enumerate(reader()):
+                    in_q.put((i, sample))
+            finally:
+                # sentinels ALWAYS flow, even when reader() raises —
+                # otherwise workers and the consumer hang forever
+                for _ in range(process_num):
+                    in_q.put(end)
+
+        def _work():
+            try:
+                while True:
+                    item = in_q.get()
+                    if item is end:
+                        return
+                    i, sample = item
+                    out_q.put((i, mapper(sample)))
+            finally:
+                out_q.put(end)
+
+        _threading.Thread(target=_feed, daemon=True).start()
+        workers = [_threading.Thread(target=_work, daemon=True)
+                   for _ in range(process_num)]
+        for w in workers:
+            w.start()
+        finished = 0
+        pending = {}
+        next_idx = 0
+        while finished < process_num:
+            item = out_q.get()
+            if item is end:
+                finished += 1
+                continue
+            i, mapped = item
+            if not order:
+                yield mapped
+            else:
+                pending[i] = mapped
+                while next_idx in pending:
+                    yield pending.pop(next_idx)
+                    next_idx += 1
+        if order:
+            while next_idx in pending:
+                yield pending.pop(next_idx)
+                next_idx += 1
+
+    return xreader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Interleave readers on worker threads (the reference forks
+    processes; thread workers keep the same contract without fork-unsafe
+    interaction with the PJRT runtime)."""
+    import queue as _queue
+    import threading as _threading
+
+    end = object()
+
+    def mreader():
+        q = _queue.Queue(queue_size)
+
+        def _work(r):
+            try:
+                for item in r():
+                    q.put(item)
+            finally:
+                q.put(end)
+
+        for r in readers:
+            _threading.Thread(target=_work, args=(r,),
+                              daemon=True).start()
+        finished = 0
+        while finished < len(readers):
+            item = q.get()
+            if item is end:
+                finished += 1
+                continue
+            yield item
+
+    return mreader

@@ -16,9 +16,15 @@ def _esc(s: str) -> str:
     return s.replace('"', r'\"')
 
 
-def program_to_dot(program, name: str = "program") -> str:
+def program_to_dot(program, name: str = "program", blocks=None,
+                   highlights=None) -> str:
+    """Render the program (or just `blocks`, a list of block indices)
+    as graphviz; `highlights` names vars drawn filled red."""
+    hi = set(highlights or ())
     lines = [f'digraph "{_esc(name)}" {{', "  rankdir=TB;"]
-    for block in program.blocks:
+    selected = [b for b in program.blocks
+                if blocks is None or b.idx in blocks]
+    for block in selected:
         bi = block.idx
         lines.append(f"  subgraph cluster_block_{bi} {{")
         lines.append(f'    label="block {bi}";')
@@ -29,8 +35,12 @@ def program_to_dot(program, name: str = "program") -> str:
             if n not in var_nodes:
                 var_nodes.add(n)
                 v = block._find_var_recursive(n)
-                style = ' style=filled fillcolor=lightgrey' \
-                    if v is not None and v.persistable else ""
+                if n in hi:
+                    style = ' style=filled fillcolor=lightcoral'
+                elif v is not None and v.persistable:
+                    style = ' style=filled fillcolor=lightgrey'
+                else:
+                    style = ""
                 lines.append(f'    "{nid}" [label="{_esc(n)}" '
                              f'shape=ellipse{style}];')
             return nid

