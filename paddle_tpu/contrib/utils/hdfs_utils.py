@@ -200,8 +200,14 @@ def multi_upload(client, hdfs_path, local_path, multi_processes=5,
     files = lfs.lsr(local_path)
     client.makedirs(hdfs_path)
 
+    made = set()
+
     def _one(f):
         rel = os.path.relpath(f, local_path)
+        parent = os.path.dirname(os.path.join(hdfs_path, rel))
+        if parent and parent not in made:
+            client.makedirs(parent)
+            made.add(parent)
         client.upload(os.path.join(hdfs_path, rel), f,
                       overwrite=overwrite)
 

@@ -3,9 +3,11 @@
 Parity: reference python/paddle/fluid/dygraph/parallel.py (Env :30,
 DataParallel :84: scale_loss + apply_collective_grads ->
 c_allreduce_sum, NCCL bootstrap in imperative/nccl_context.cc). TPU-native:
-gradients are all-reduced with jax.lax.psum-equivalent pmean over the local
-device mesh; on a single chip this is the identity, keeping the API
-contract (scale_loss/apply_collective_grads) intact.
+gradients are all-reduced as a jitted cross-process sum over a
+one-device-per-process mesh. nranks == 1 keeps scale_loss/
+apply_collective_grads as identities; nranks > 1 REQUIRES
+jax.distributed to be initialized — apply_collective_grads raises
+rather than training silently on 1/nranks-scaled gradients.
 """
 from __future__ import annotations
 

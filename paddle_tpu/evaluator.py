@@ -186,9 +186,8 @@ class DetectionMAP(Evaluator):
                                  shape=[1])
         self._state_var = state
         self.states.append(state)
-        from .framework import unique_name
         accum_map = block.create_var(
-            name=unique_name.generate("map_eval_accum"),
+            name=_un.generate("map_eval_accum"),
             dtype="float32", shape=[1])
         tp = block.create_var(name=_un.generate("map_eval_tp"),
                               dtype="float32", shape=[-1, 2])

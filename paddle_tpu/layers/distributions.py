@@ -123,6 +123,11 @@ class Categorical(Distribution):
         return _nn.softmax(self.logits)
 
     def sample(self, shape=None, seed=0):
+        if shape:
+            raise NotImplementedError(
+                "Categorical.sample draws one id per logits row "
+                "(sampling_id); arbitrary sample shapes are not "
+                "supported")
         return _nn.sampling_id(self._probs(), seed=seed)
 
     def entropy(self):

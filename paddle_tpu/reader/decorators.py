@@ -233,6 +233,10 @@ def buffered(reader, size):
 
     end = object()
 
+    class _Err:
+        def __init__(self, exc):
+            self.exc = exc
+
     def buffered_reader():
         q = _queue.Queue(maxsize=size)
 
@@ -240,13 +244,16 @@ def buffered(reader, size):
             try:
                 for item in reader():
                     q.put(item)
-            finally:
                 q.put(end)
+            except BaseException as e:   # propagate, never truncate
+                q.put(_Err(e))
 
         t = _threading.Thread(target=_fill, daemon=True)
         t.start()
         while True:
             item = q.get()
+            if isinstance(item, _Err):
+                raise item.exc
             if item is end:
                 return
             yield item
@@ -262,6 +269,14 @@ def firstn(reader, n):
             yield item
 
     return firstn_reader
+
+
+class _XErr:
+    """Worker exception carrier: re-raised in the consumer so failures
+    propagate instead of truncating the stream."""
+
+    def __init__(self, exc):
+        self.exc = exc
 
 
 def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
@@ -280,6 +295,8 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
             try:
                 for i, sample in enumerate(reader()):
                     in_q.put((i, sample))
+            except BaseException as e:
+                out_q.put(_XErr(e))
             finally:
                 # sentinels ALWAYS flow, even when reader() raises —
                 # otherwise workers and the consumer hang forever
@@ -294,6 +311,8 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                         return
                     i, sample = item
                     out_q.put((i, mapper(sample)))
+            except BaseException as e:
+                out_q.put(_XErr(e))
             finally:
                 out_q.put(end)
 
@@ -307,6 +326,8 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
         next_idx = 0
         while finished < process_num:
             item = out_q.get()
+            if isinstance(item, _XErr):
+                raise item.exc
             if item is end:
                 finished += 1
                 continue
@@ -342,6 +363,8 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
             try:
                 for item in r():
                     q.put(item)
+            except BaseException as e:
+                q.put(_XErr(e))
             finally:
                 q.put(end)
 
@@ -351,6 +374,8 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
         finished = 0
         while finished < len(readers):
             item = q.get()
+            if isinstance(item, _XErr):
+                raise item.exc
             if item is end:
                 finished += 1
                 continue

@@ -348,3 +348,26 @@ def test_reader_decorators():
     assert ordered == [0, 2, 4, 6, 8]
     assert sorted(R.multiprocess_reader([r1, r2])()) == sorted(
         list(range(5)) + list(range(10, 15)))
+
+
+def test_reader_worker_exceptions_propagate():
+    from paddle_tpu import reader as R
+
+    def bad():
+        yield 1
+        raise IOError("disk gone")
+
+    with pytest.raises(IOError):
+        list(R.buffered(bad, 2)())
+    with pytest.raises(IOError):
+        list(R.multiprocess_reader([bad])())
+    with pytest.raises(IOError):
+        list(R.xmap_readers(lambda x: x, bad, 2, 2)())
+
+    def ok():
+        yield from range(4)
+
+    with pytest.raises(ValueError):
+        list(R.xmap_readers(
+            lambda x: (_ for _ in ()).throw(ValueError("bad map"))
+            if x == 2 else x, ok, 2, 2)())
