@@ -51,6 +51,7 @@ from .transpiler import (  # noqa: F401
 from . import incubate  # noqa: F401
 from . import inference  # noqa: F401
 from . import nets  # noqa: F401
+from . import dataset  # noqa: F401
 from . import average  # noqa: F401
 from . import evaluator  # noqa: F401
 from . import lod_tensor  # noqa: F401

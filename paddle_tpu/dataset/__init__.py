@@ -1,0 +1,25 @@
+"""paddle.dataset-compatible canned datasets (reference
+python/paddle/dataset/: mnist, uci_housing, cifar, imdb, conll05,
+movielens, wmt14, wmt16, sentiment, flowers).
+
+This environment has no network egress, so the download-and-cache
+readers are replaced by DETERMINISTIC SYNTHETIC generators with the
+same reader API, sample shapes, dtypes, and vocabulary sizes — book
+scripts written against paddle.dataset run unmodified and converge on
+the synthetic tasks (each dataset hides a learnable mapping, not pure
+noise). Swap in real data by pointing the same reader names at your
+own files.
+"""
+from . import mnist  # noqa: F401
+from . import uci_housing  # noqa: F401
+from . import cifar  # noqa: F401
+from . import imdb  # noqa: F401
+from . import conll05  # noqa: F401
+from . import movielens  # noqa: F401
+from . import wmt14  # noqa: F401
+from . import wmt16  # noqa: F401
+from . import sentiment  # noqa: F401
+from . import flowers  # noqa: F401
+
+__all__ = ["mnist", "uci_housing", "cifar", "imdb", "conll05",
+           "movielens", "wmt14", "wmt16", "sentiment", "flowers"]

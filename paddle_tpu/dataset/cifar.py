@@ -1,0 +1,32 @@
+"""CIFAR-shaped synthetic images (reference paddle/dataset/cifar.py:
+3072 float32 + label; 10 or 100 classes)."""
+from ._synth import classify_features, make_reader, rng_for
+
+TRAIN_N, TEST_N = 4096, 1024
+
+
+def _build(name, split, classes, n):
+    rng = rng_for(name, split)
+    xs, ys = classify_features(rng, n, 3 * 32 * 32, classes)
+    xs = (xs / max(abs(xs.min()), xs.max())).astype("float32")
+
+    def sample(i):
+        return xs[i].reshape(3072), int(ys[i])
+
+    return make_reader(sample, n)
+
+
+def train10():
+    return _build("cifar10", "train", 10, TRAIN_N)
+
+
+def test10():
+    return _build("cifar10", "test", 10, TEST_N)
+
+
+def train100():
+    return _build("cifar100", "train", 100, TRAIN_N)
+
+
+def test100():
+    return _build("cifar100", "test", 100, TEST_N)

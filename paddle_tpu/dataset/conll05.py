@@ -1,0 +1,43 @@
+"""CoNLL-05-shaped synthetic SRL (reference paddle/dataset/conll05.py:
+8 feature sequences + BIO label sequence; get_dict/get_embedding)."""
+import numpy as np
+
+from ._synth import make_reader, rng_for
+
+WORD_DICT_LEN = 44068
+LABEL_DICT_LEN = 59
+PRED_DICT_LEN = 3162
+MARK_DICT_LEN = 2
+TEST_N = 512
+
+
+def get_dict():
+    word_dict = {f"w{i}": i for i in range(WORD_DICT_LEN)}
+    verb_dict = {f"v{i}": i for i in range(PRED_DICT_LEN)}
+    label_dict = {f"l{i}": i for i in range(LABEL_DICT_LEN)}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    rng = rng_for("conll05", "emb")
+    return rng.standard_normal((WORD_DICT_LEN, 32)).astype("float32")
+
+
+def test():
+    rng = rng_for("conll05", "test")
+
+    def sample(i):
+        length = int(rng.randint(5, 30))
+        word = rng.randint(0, WORD_DICT_LEN, length).astype(np.int64)
+        ctx = [rng.randint(0, WORD_DICT_LEN, length).astype(np.int64)
+               for _ in range(5)]
+        pred = np.full(length, rng.randint(0, PRED_DICT_LEN),
+                       np.int64)
+        mark = rng.randint(0, MARK_DICT_LEN, length).astype(np.int64)
+        label = ((word + pred) % LABEL_DICT_LEN).astype(np.int64)
+        return (word.tolist(), ctx[0].tolist(), ctx[1].tolist(),
+                ctx[2].tolist(), ctx[3].tolist(), ctx[4].tolist(),
+                pred.tolist(), mark.tolist(), label.tolist())
+
+    samples = [sample(i) for i in range(TEST_N)]
+    return make_reader(lambda i: samples[i], TEST_N)

@@ -1,0 +1,26 @@
+"""Flowers-102-shaped synthetic images (reference
+paddle/dataset/flowers.py: 3x224x224 float32 + label)."""
+from ._synth import classify_features, make_reader, rng_for
+
+TRAIN_N, TEST_N = 512, 128
+
+
+def _build(split, n):
+    rng = rng_for("flowers", split)
+    xs, ys = classify_features(rng, n, 3 * 32 * 32, 102)
+
+    def sample(i):
+        # tile the compact feature up to the 3x224x224 contract lazily
+        import numpy as np
+        img = np.resize(xs[i], (3, 224, 224)).astype("float32")
+        return img, int(ys[i])
+
+    return make_reader(sample, n)
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True):
+    return _build("train", TRAIN_N)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True):
+    return _build("test", TEST_N)

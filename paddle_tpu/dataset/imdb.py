@@ -1,0 +1,34 @@
+"""IMDB-shaped synthetic sentiment (reference paddle/dataset/imdb.py:
+word-id sequences + 0/1 polarity; word_dict())."""
+import numpy as np
+
+from ._synth import make_reader, rng_for
+
+VOCAB = 5147
+TRAIN_N, TEST_N = 2048, 512
+
+
+def word_dict():
+    return {f"w{i}".encode(): i for i in range(VOCAB)}
+
+
+def _build(split, n):
+    rng = rng_for("imdb", split)
+    # polarity hides in the id parity mix of each sequence
+    def sample(i):
+        length = int(rng.randint(8, 64))
+        label = int(rng.randint(0, 2))
+        base = rng.randint(0, VOCAB // 2, size=length)
+        ids = base * 2 + label
+        return ids.astype(np.int64).tolist(), label
+
+    samples = [sample(i) for i in range(n)]
+    return make_reader(lambda i: samples[i], n)
+
+
+def train(word_idx=None):
+    return _build("train", TRAIN_N)
+
+
+def test(word_idx=None):
+    return _build("test", TEST_N)

@@ -1,0 +1,25 @@
+"""MNIST-shaped synthetic digits (reference paddle/dataset/mnist.py:
+train :105, test :113 — samples are (784 float32 in [-1,1], int label
+0-9))."""
+from ._synth import classify_features, make_reader, rng_for
+
+TRAIN_N, TEST_N = 8192, 2048
+
+
+def _build(split, n):
+    rng = rng_for("mnist", split)
+    xs, ys = classify_features(rng, n, 784, 10)
+    xs = (xs / max(abs(xs.min()), xs.max())).astype("float32")
+
+    def sample(i):
+        return xs[i].reshape(784), int(ys[i])
+
+    return make_reader(sample, n)
+
+
+def train():
+    return _build("train", TRAIN_N)
+
+
+def test():
+    return _build("test", TEST_N)

@@ -1,0 +1,58 @@
+"""MovieLens-shaped synthetic ratings (reference
+paddle/dataset/movielens.py: user/movie features -> score)."""
+import numpy as np
+
+from ._synth import make_reader, rng_for
+
+USER_N, MOVIE_N = 944, 1683
+CATEGORIES = 18
+TITLE_VOCAB = 5175
+
+
+def max_user_id():
+    return USER_N - 1
+
+
+def max_movie_id():
+    return MOVIE_N - 1
+
+
+def max_job_id():
+    return 20
+
+
+def age_table():
+    return [1, 18, 25, 35, 45, 50, 56]
+
+
+def _build(split, n):
+    rng = rng_for("movielens", split)
+    u_emb = rng.standard_normal(USER_N)
+    m_emb = rng.standard_normal(MOVIE_N)
+
+    def sample(i):
+        uid = int(rng.randint(1, USER_N))
+        mid = int(rng.randint(1, MOVIE_N))
+        gender = int(rng.randint(0, 2))
+        age = int(rng.randint(0, 7))
+        job = int(rng.randint(0, 21))
+        cat = rng.randint(0, CATEGORIES,
+                          rng.randint(1, 4)).astype(np.int64)
+        title = rng.randint(0, TITLE_VOCAB,
+                            rng.randint(1, 6)).astype(np.int64)
+        score = float(np.clip(
+            3.0 + u_emb[uid] + m_emb[mid] +
+            0.2 * rng.standard_normal(), 1.0, 5.0))
+        return (uid, gender, age, job, mid, cat.tolist(),
+                title.tolist(), [score])
+
+    samples = [sample(i) for i in range(n)]
+    return make_reader(lambda i: samples[i], n)
+
+
+def train():
+    return _build("train", 4096)
+
+
+def test():
+    return _build("test", 1024)

@@ -1,0 +1,15 @@
+"""Movie-review-shaped synthetic sentiment (reference
+paddle/dataset/sentiment.py)."""
+from . import imdb as _imdb
+
+
+def get_word_dict():
+    return sorted(_imdb.word_dict().items(), key=lambda kv: kv[1])
+
+
+def train():
+    return _imdb._build("sentiment-train", 1024)
+
+
+def test():
+    return _imdb._build("sentiment-test", 256)

@@ -1,0 +1,30 @@
+"""UCI-housing-shaped synthetic regression (reference
+paddle/dataset/uci_housing.py: 13 features -> 1 target)."""
+import numpy as np
+
+from ._synth import make_reader, rng_for
+
+TRAIN_N, TEST_N = 404, 102
+feature_names = ["CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE",
+                 "DIS", "RAD", "TAX", "PTRATIO", "B", "LSTAT"]
+
+
+def _build(split, n):
+    rng = rng_for("uci_housing", split)
+    w = rng.standard_normal(13).astype(np.float32)
+    xs = rng.standard_normal((n, 13)).astype(np.float32)
+    ys = (xs @ w + 0.1 * rng.standard_normal(n) + 22.0).astype(
+        np.float32)
+
+    def sample(i):
+        return xs[i], ys[i:i + 1]
+
+    return make_reader(sample, n)
+
+
+def train():
+    return _build("train", TRAIN_N)
+
+
+def test():
+    return _build("test", TEST_N)

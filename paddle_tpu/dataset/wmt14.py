@@ -1,0 +1,30 @@
+"""WMT14-shaped synthetic translation pairs (reference
+paddle/dataset/wmt14.py: (src_ids, trg_ids, trg_next_ids))."""
+import numpy as np
+
+from ._synth import make_reader, rng_for
+
+
+def _build(split, dict_size, n):
+    rng = rng_for("wmt14", split)
+    start, end = 0, 1
+
+    def sample(i):
+        length = int(rng.randint(4, 20))
+        src = rng.randint(2, dict_size, length).astype(np.int64)
+        # learnable toy task: target = reversed source
+        trg_core = src[::-1] % dict_size
+        trg = np.concatenate([[start], trg_core])
+        trg_next = np.concatenate([trg_core, [end]])
+        return (src.tolist(), trg.tolist(), trg_next.tolist())
+
+    samples = [sample(i) for i in range(n)]
+    return make_reader(lambda i: samples[i], n)
+
+
+def train(dict_size):
+    return _build("train", dict_size, 4096)
+
+
+def test(dict_size):
+    return _build("test", dict_size, 512)

@@ -1,0 +1,19 @@
+"""WMT16-shaped synthetic translation (reference
+paddle/dataset/wmt16.py: same triple contract as wmt14 with
+configurable vocab)."""
+from . import wmt14 as _w
+
+
+def train(src_dict_size, trg_dict_size, src_lang="en"):
+    return _w._build("wmt16-train", min(src_dict_size, trg_dict_size),
+                     4096)
+
+
+def test(src_dict_size, trg_dict_size, src_lang="en"):
+    return _w._build("wmt16-test", min(src_dict_size, trg_dict_size),
+                     512)
+
+
+def get_dict(lang, dict_size, reverse=False):
+    d = {f"{lang}{i}": i for i in range(dict_size)}
+    return {v: k for k, v in d.items()} if reverse else d

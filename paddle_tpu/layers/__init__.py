@@ -23,5 +23,9 @@ from .learning_rate_scheduler import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .sequence import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
+from .detection import *  # noqa: F401,F403
+from .collective import *  # noqa: F401,F403
+from .distributions import (  # noqa: F401
+    Normal, Uniform, Categorical, MultivariateNormalDiag)
 
 from . import distributions  # noqa: F401

