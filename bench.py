@@ -30,7 +30,7 @@ import numpy as np
 
 V100_TOKENS_PER_SEC = 15000.0
 
-BATCH = 48
+BATCH = 96
 SRC_LEN = 128
 TRG_LEN = 128
 WARMUP = 3
