@@ -23,6 +23,7 @@ configs (MNIST LeNet, ResNet-50, Wide&Deep CTR, dygraph) to stderr.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -228,7 +229,29 @@ def bench_dygraph():
     return sps * B, sps, float(final)
 
 
+_CONFIGS = {
+    "mnist_lenet": ("bench_lenet", "images/sec"),
+    "resnet50": ("bench_resnet50", "images/sec"),
+    "wide_deep_ctr": ("bench_ctr", "examples/sec"),
+    "dygraph_convnet": ("bench_dygraph", "images/sec"),
+}
+
+
+def _run_one(name):
+    fn = globals()[_CONFIGS[name][0]]
+    rate, sps, traj = fn()
+    if isinstance(traj, tuple):
+        tr = "->".join(f"{v:.4f}" for v in traj)
+    else:
+        tr = f"{traj:.4f}"
+    print(f"# {name}: {rate:.0f} {_CONFIGS[name][1]} "
+          f"(steps/s={sps:.2f} loss {tr})", file=sys.stderr)
+
+
 def main():
+    if "--config" in sys.argv:
+        _run_one(sys.argv[sys.argv.index("--config") + 1])
+        return
     tokens_per_sec, sps, traj = bench_transformer()
     print(json.dumps({
         "metric": "transformer_base_train_tokens_per_sec",
@@ -240,22 +263,19 @@ def main():
           f"loss {traj[0]:.4f}->{traj[1]:.4f}->{traj[2]:.4f}",
           file=sys.stderr)
     if "--all" in sys.argv:
-        for name, fn, unit in [
-                ("mnist_lenet", bench_lenet, "images/sec"),
-                ("resnet50", bench_resnet50, "images/sec"),
-                ("wide_deep_ctr", bench_ctr, "examples/sec"),
-                ("dygraph_convnet", bench_dygraph, "images/sec")]:
-            try:
-                rate, sps, traj = fn()
-                if isinstance(traj, tuple):
-                    tr = "->".join(f"{v:.4f}" for v in traj)
-                else:
-                    tr = f"{traj:.4f}"
-                print(f"# {name}: {rate:.0f} {unit} "
-                      f"(steps/s={sps:.2f} loss {tr})",
-                      file=sys.stderr)
-            except Exception as e:  # report, keep headline intact
-                print(f"# {name}: FAILED {type(e).__name__}: {e}",
+        # each config in a FRESH process: a previous model's live scope
+        # keeps HBM occupied and can slow a later config >20x
+        import subprocess
+        for name in _CONFIGS:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--config", name],
+                capture_output=True, text=True)
+            for line in r.stderr.splitlines():
+                if line.startswith("#"):
+                    print(line, file=sys.stderr)
+            if r.returncode != 0:
+                print(f"# {name}: FAILED\n{r.stderr[-500:]}",
                       file=sys.stderr)
 
 
