@@ -229,28 +229,61 @@ def bench_dygraph():
     return sps * B, sps, float(final)
 
 
-_CONFIGS = {
-    "mnist_lenet": ("bench_lenet", "images/sec"),
-    "resnet50": ("bench_resnet50", "images/sec"),
-    "wide_deep_ctr": ("bench_ctr", "examples/sec"),
-    "dygraph_convnet": ("bench_dygraph", "images/sec"),
-}
+def _config_table():
+    return {
+        "mnist_lenet": (bench_lenet, "images/sec"),
+        "resnet50": (bench_resnet50, "images/sec"),
+        "wide_deep_ctr": (bench_ctr, "examples/sec"),
+        "dygraph_convnet": (bench_dygraph, "images/sec"),
+    }
 
 
 def _run_one(name):
-    fn = globals()[_CONFIGS[name][0]]
+    table = _config_table()
+    if name not in table:
+        raise SystemExit(f"unknown --config {name!r}; valid: "
+                         f"{sorted(table)}")
+    fn, unit = table[name]
     rate, sps, traj = fn()
     if isinstance(traj, tuple):
         tr = "->".join(f"{v:.4f}" for v in traj)
     else:
         tr = f"{traj:.4f}"
-    print(f"# {name}: {rate:.0f} {_CONFIGS[name][1]} "
+    print(f"# {name}: {rate:.0f} {unit} "
           f"(steps/s={sps:.2f} loss {tr})", file=sys.stderr)
 
 
 def main():
     if "--config" in sys.argv:
-        _run_one(sys.argv[sys.argv.index("--config") + 1])
+        idx = sys.argv.index("--config") + 1
+        if idx >= len(sys.argv):
+            raise SystemExit(
+                f"--config needs a name; valid: "
+                f"{sorted(_config_table())}")
+        _run_one(sys.argv[idx])
+        return
+    if "--all" in sys.argv:
+        # EVERY config (headline included) in a FRESH process: a
+        # previous model's live scope keeps HBM occupied and can slow
+        # a later config >20x
+        import subprocess
+        me = os.path.abspath(__file__)
+        r = subprocess.run([sys.executable, me],
+                           capture_output=True, text=True)
+        sys.stdout.write(r.stdout)          # the driver's JSON line
+        for line in r.stderr.splitlines():
+            if line.startswith("#"):
+                print(line, file=sys.stderr)
+        for name in _config_table():
+            r = subprocess.run([sys.executable, me, "--config", name],
+                               capture_output=True, text=True)
+            if r.returncode == 0:
+                for line in r.stderr.splitlines():
+                    if line.startswith("#"):
+                        print(line, file=sys.stderr)
+            else:
+                print(f"# {name}: FAILED\n{r.stderr[-500:]}",
+                      file=sys.stderr)
         return
     tokens_per_sec, sps, traj = bench_transformer()
     print(json.dumps({
@@ -262,21 +295,6 @@ def main():
     print(f"# transformer: steps/s={sps:.2f} "
           f"loss {traj[0]:.4f}->{traj[1]:.4f}->{traj[2]:.4f}",
           file=sys.stderr)
-    if "--all" in sys.argv:
-        # each config in a FRESH process: a previous model's live scope
-        # keeps HBM occupied and can slow a later config >20x
-        import subprocess
-        for name in _CONFIGS:
-            r = subprocess.run(
-                [sys.executable, os.path.abspath(__file__),
-                 "--config", name],
-                capture_output=True, text=True)
-            for line in r.stderr.splitlines():
-                if line.startswith("#"):
-                    print(line, file=sys.stderr)
-            if r.returncode != 0:
-                print(f"# {name}: FAILED\n{r.stderr[-500:]}",
-                      file=sys.stderr)
 
 
 if __name__ == "__main__":
