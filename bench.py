@@ -8,12 +8,34 @@ throughput ballpark (~15k tokens/sec, fairseq/tensor2tensor-era
 reports); vs_baseline = measured / 15000 (1.0 == V100 parity, 0.8 ==
 the north-star bar).
 
-Measurement discipline: steps are dispatched asynchronously (device
-arrays fetched, converted to host numpy only after the timing window
-closes) — the steady-state training-loop pattern. Forcing a host
-round-trip per step measures the network tunnel, not the chip: on this
-axon-tunneled setup it reads ~5-40k tokens/sec with huge variance,
-while the chip itself sustains ~70 steps/sec (see BASELINE.md).
+Measurement discipline (v3 — the auditable version, VERDICT r2 #1):
+
+  Through the axon tunnel `jax.Array.block_until_ready()` returns when
+  the dispatch stream drains, NOT when the device finishes computing
+  (measured: 10 chained 4096^3 bf16 matmuls "block" in 0.02 ms; at the
+  v5e's 197 TFLOP/s bf16 peak they need >= 7 ms of MXU time). The only
+  completion observable is a HOST FETCH of a result. Round-2's numbers
+  closed the timing window with block_until_ready and therefore timed
+  dispatch, not execution — they are retracted in BASELINE.md.
+
+  v3 closes every timing window with a host fetch of the final scalar
+  loss, and cancels the window-constant overhead (tunnel RTT + fetch)
+  by differencing two window sizes: steps/s = N / (T(2N) - T(N)).
+  Cross-checks emitted per config:
+    * analytical FLOPs/step from the compiled executable's XLA
+      cost_analysis() (Engine.compiled_stats),
+    * implied TFLOP/s = FLOPs/step * steps/s and implied MFU vs the
+      detected chip's dense bf16 peak — any value > 100% of peak is a
+      measurement bug by definition and is flagged loudly,
+    * a synchronous single-step latency (dispatch + fetch each step;
+      includes one tunnel RTT, so it upper-bounds true step time).
+  Validation of the methodology itself: a pure chained-matmul probe
+  measured this way sustains 169-196 TFLOP/s on this chip = 86-99% of
+  v5e bf16 peak — consistent, physical, and reproducible.
+
+Execution proof: donated params chain step N's input to step N-1's
+update, so the fixed-batch loss at steps {0, mid, last} being pairwise
+distinct proves every timed step really executed (no dedup/skip).
 
 Default prints ONE JSON line for the driver:
   {"metric", "value", "unit", "vs_baseline"}.
@@ -31,15 +53,42 @@ import numpy as np
 
 V100_TOKENS_PER_SEC = 15000.0
 
+# dense bf16/fp16 matmul peak TFLOP/s per chip, public spec sheets;
+# longest prefix wins ("TPU v5" must not shadow "TPU v5 lite")
+PEAK_TFLOPS = {
+    "TPU v6 lite": 918.0,
+    "TPU v6e": 918.0,
+    "TPU v5 lite": 197.0,
+    "TPU v5e": 197.0,
+    "TPU v5p": 459.0,
+    "TPU v5": 459.0,
+    "TPU v4": 275.0,
+    "TPU v3": 123.0,
+    "TPU v2": 46.0,
+}
+
 BATCH = 96
 SRC_LEN = 128
 TRG_LEN = 128
 WARMUP = 3
-ITERS = 100
+ITERS = 30
+
+
+def _device_peak():
+    import jax
+    kind = getattr(jax.devices()[0], "device_kind", "")
+    for k in sorted(PEAK_TFLOPS, key=len, reverse=True):
+        if kind.startswith(k):
+            return kind, PEAK_TFLOPS[k]
+    return kind, None
 
 
 def _loop(eng, prog, scope, batch, fetch, iters, warmup=WARMUP):
-    """Async-dispatch timing loop; returns (steps/sec, last_loss)."""
+    """Fetch-fenced, overhead-cancelling timing loop.
+
+    Returns (steps/sec, (l0, lm, ln), sync_ms). See module docstring
+    for why the fence must be a host fetch and not block_until_ready.
+    """
     import jax
 
     def _arr(o):
@@ -49,27 +98,68 @@ def _loop(eng, prog, scope, batch, fetch, iters, warmup=WARMUP):
     # link (a real input pipeline overlaps transfers; the axon tunnel
     # would otherwise dominate large-image configs)
     batch = {k: jax.device_put(v) for k, v in batch.items()}
-    jax.block_until_ready(list(batch.values()))
     for _ in range(warmup):
         out = eng.run(prog, scope, None, batch, fetch,
                       return_numpy=False)
-    jax.block_until_ready(_arr(out[0]))
-    t0 = time.perf_counter()
-    losses = []
-    for _ in range(iters):
-        out = eng.run(prog, scope, None, batch, fetch,
-                      return_numpy=False)
-        losses.append(_arr(out[0]))
-    jax.block_until_ready(losses[-1])
-    dt = time.perf_counter() - t0
-    # execution proof: every timed step must have produced a distinct
-    # optimizer state -> the fixed-batch loss strictly changes step to
-    # step (catches any would-be skipped/deduped dispatch)
-    l0 = float(np.asarray(losses[0]))
-    lm = float(np.asarray(losses[iters // 2]))
-    ln = float(np.asarray(losses[-1]))
-    assert l0 != lm != ln, (l0, lm, ln)
-    return iters / dt, (l0, lm, ln)
+    np.asarray(_arr(out[0]))  # completion fence
+
+    def window(n):
+        t0 = time.perf_counter()
+        ls = [eng.run(prog, scope, None, batch, fetch,
+                      return_numpy=False)[0] for _ in range(n)]
+        float(np.asarray(_arr(ls[-1])))  # fence: fetch, not block
+        return time.perf_counter() - t0, ls
+
+    t1, la = window(iters)
+    t2, lb = window(2 * iters)
+    if t2 - t1 > 0.02 * t2:
+        sps = iters / (t2 - t1)
+    else:
+        # tunnel variance swallowed the difference; fall back to the
+        # conservative upper-bound-inclusive estimate (overhead counted)
+        sps = 3 * iters / (t1 + t2)
+    losses = la + lb
+    l0 = float(np.asarray(_arr(losses[0])))
+    lm = float(np.asarray(_arr(losses[len(losses) // 2])))
+    ln = float(np.asarray(_arr(losses[-1])))
+    # execution proof (see module docstring); all three finite (NaNs are
+    # pairwise-"distinct" in a set) and pairwise distinct
+    assert all(np.isfinite(v) for v in (l0, lm, ln)), (l0, lm, ln)
+    assert len({l0, lm, ln}) == 3, (l0, lm, ln)
+    # synchronous single-step latency: includes one tunnel RTT per step,
+    # upper-bounds the true device step time
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        o = eng.run(prog, scope, None, batch, fetch, return_numpy=False)
+        float(np.asarray(_arr(o[0])))
+        ts.append(time.perf_counter() - t0)
+    sync_ms = sorted(ts)[len(ts) // 2] * 1e3
+    return sps, (l0, lm, ln), sync_ms
+
+
+def _mfu_lines(name, sps, sync_ms, stats):
+    """MFU/roofline accounting lines for stderr (VERDICT r2 #1)."""
+    kind, peak = _device_peak()
+    lines = []
+    if stats and stats.get("flops"):
+        fl = stats["flops"]
+        tfs = fl * sps / 1e12
+        line = (f"# {name}: roofline: {fl/1e12:.3f} TFLOPs/step x "
+                f"{sps:.2f} steps/s = {tfs:.1f} TFLOP/s")
+        if peak:
+            mfu = tfs / peak
+            line += f" -> MFU {mfu*100:.1f}% of {kind} peak {peak:.0f}"
+            if mfu > 1.0:
+                line += (" *** IMPOSSIBLE (>100% of peak): measurement"
+                         " bug, do not trust this row ***")
+        lines.append(line)
+    if sync_ms:
+        lines.append(
+            f"# {name}: sync 1-step latency {sync_ms:.1f} ms "
+            f"(incl. tunnel RTT; device-only bound "
+            f"{1e3/sps:.1f} ms/step)")
+    return lines
 
 
 def bench_transformer():
@@ -97,9 +187,10 @@ def bench_transformer():
         eng = Engine()
         batch = models.transformer.make_batch(cfg, BATCH, SRC_LEN,
                                               TRG_LEN)
-        sps, traj = _loop(eng, main_prog, scope, batch, [cost.name],
-                          ITERS)
-    return sps * BATCH * TRG_LEN, sps, traj
+        sps, traj, sync_ms = _loop(eng, main_prog, scope, batch,
+                                   [cost.name], ITERS)
+        stats = eng.compiled_stats(main_prog, scope, batch, [cost.name])
+    return sps * BATCH * TRG_LEN, sps, traj, sync_ms, stats
 
 
 def bench_lenet():
@@ -122,9 +213,10 @@ def bench_lenet():
         exe = fluid.Executor()
         exe.run(startup)
         eng = Engine()
-        sps, traj = _loop(eng, main_prog, scope, batch, [cost.name],
-                          60)
-    return sps * B, sps, traj
+        sps, traj, sync_ms = _loop(eng, main_prog, scope, batch,
+                                   [cost.name], 40)
+        stats = eng.compiled_stats(main_prog, scope, batch, [cost.name])
+    return sps * B, sps, traj, sync_ms, stats
 
 
 def bench_resnet50():
@@ -149,9 +241,10 @@ def bench_resnet50():
         exe = fluid.Executor()
         exe.run(startup)
         eng = Engine()
-        sps, traj = _loop(eng, main_prog, scope, batch, [cost.name],
-                          30)
-    return sps * B, sps, traj
+        sps, traj, sync_ms = _loop(eng, main_prog, scope, batch,
+                                   [cost.name], 20)
+        stats = eng.compiled_stats(main_prog, scope, batch, [cost.name])
+    return sps * B, sps, traj, sync_ms, stats
 
 
 def bench_ctr():
@@ -178,13 +271,13 @@ def bench_ctr():
         exe = fluid.Executor()
         exe.run(startup)
         eng = Engine()
-        sps, traj = _loop(eng, main_prog, scope, batch, [cost.name],
-                          40)
-    return sps * B, sps, traj
+        sps, traj, sync_ms = _loop(eng, main_prog, scope, batch,
+                                   [cost.name], 30)
+        stats = eng.compiled_stats(main_prog, scope, batch, [cost.name])
+    return sps * B, sps, traj, sync_ms, stats
 
 
 def bench_dygraph():
-    import jax
     import paddle_tpu as fluid
     from paddle_tpu import dygraph
 
@@ -223,10 +316,10 @@ def bench_dygraph():
             opt.minimize(loss)
             net.clear_gradients()
             losses.append(loss)
-        final = np.asarray(losses[-1].numpy())
+        final = np.asarray(losses[-1].numpy())  # fetch = fence
         dt = time.perf_counter() - t0
     sps = n_timed / dt
-    return sps * B, sps, float(final)
+    return sps * B, sps, float(final), None, None
 
 
 def _config_table():
@@ -244,13 +337,15 @@ def _run_one(name):
         raise SystemExit(f"unknown --config {name!r}; valid: "
                          f"{sorted(table)}")
     fn, unit = table[name]
-    rate, sps, traj = fn()
+    rate, sps, traj, sync_ms, stats = fn()
     if isinstance(traj, tuple):
         tr = "->".join(f"{v:.4f}" for v in traj)
     else:
         tr = f"{traj:.4f}"
     print(f"# {name}: {rate:.0f} {unit} "
           f"(steps/s={sps:.2f} loss {tr})", file=sys.stderr)
+    for line in _mfu_lines(name, sps, sync_ms, stats):
+        print(line, file=sys.stderr)
 
 
 def main():
@@ -270,10 +365,15 @@ def main():
         me = os.path.abspath(__file__)
         r = subprocess.run([sys.executable, me],
                            capture_output=True, text=True)
-        sys.stdout.write(r.stdout)          # the driver's JSON line
-        for line in r.stderr.splitlines():
-            if line.startswith("#"):
-                print(line, file=sys.stderr)
+        headline_ok = r.returncode == 0
+        if headline_ok:
+            sys.stdout.write(r.stdout)      # the driver's JSON line
+            for line in r.stderr.splitlines():
+                if line.startswith("#"):
+                    print(line, file=sys.stderr)
+        else:
+            print(f"# headline transformer: FAILED\n{r.stderr[-500:]}",
+                  file=sys.stderr)
         for name in _config_table():
             r = subprocess.run([sys.executable, me, "--config", name],
                                capture_output=True, text=True)
@@ -284,8 +384,12 @@ def main():
             else:
                 print(f"# {name}: FAILED\n{r.stderr[-500:]}",
                       file=sys.stderr)
+        # still measure the isolated configs, but surface the headline
+        # failure in the exit code
+        if not headline_ok:
+            sys.exit(1)
         return
-    tokens_per_sec, sps, traj = bench_transformer()
+    tokens_per_sec, sps, traj, sync_ms, stats = bench_transformer()
     print(json.dumps({
         "metric": "transformer_base_train_tokens_per_sec",
         "value": round(tokens_per_sec, 1),
@@ -295,6 +399,8 @@ def main():
     print(f"# transformer: steps/s={sps:.2f} "
           f"loss {traj[0]:.4f}->{traj[1]:.4f}->{traj[2]:.4f}",
           file=sys.stderr)
+    for line in _mfu_lines("transformer", sps, sync_ms, stats):
+        print(line, file=sys.stderr)
 
 
 if __name__ == "__main__":

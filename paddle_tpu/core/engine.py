@@ -535,6 +535,64 @@ class Engine:
                     repl, np.asarray(a), tuple(np.asarray(a).shape))
                 for n, a in params.items()}
 
+    @staticmethod
+    def _cache_key(program, block_idx, feed_sig_key, fetch_names):
+        return (program.fingerprint, block_idx, feed_sig_key,
+                tuple(fetch_names), bool(FLAGS.check_nan_inf),
+                int(getattr(program, "_gradient_accumulation_steps", 1)
+                    or 1))
+
+    def compiled_stats(self, program, scope: Scope, feed, fetch_names,
+                       block_idx: int = 0) -> Optional[Dict[str, float]]:
+        """XLA analytical cost of the already-compiled step: flops,
+        bytes accessed, and temp (scratch) memory per step. Returns None
+        on the eager-interpreter fallback (nothing is compiled there).
+        This powers bench.py's MFU/roofline accounting — the TPU-native
+        analog of the reference's per-op benchmark bookkeeping
+        (/root/reference/paddle/fluid/operators/benchmark/op_tester.cc).
+        """
+        arrays, _, feed_sig_key = self._normalize_feed(feed, None)
+        key = self._cache_key(program, block_idx, feed_sig_key,
+                              fetch_names)
+        traced = self._cache.get(key)
+        if traced is None:
+            if self._cache:
+                raise ValueError(
+                    "compiled_stats: no compiled step for this "
+                    "(program, feed, fetch) signature — pass the same "
+                    "feed/fetch that run() used")
+            return None
+        if not hasattr(traced.fn, "lower"):
+            return None  # eager-interpreter fallback: nothing compiled
+        cached = getattr(traced, "_stats_cache", None)
+        if cached is not None:
+            return cached
+
+        def _sig(n):
+            a = _scope_array(scope, n)
+            return jax.ShapeDtypeStruct(jnp.shape(a), jnp.result_type(a))
+
+        donated = {n: _sig(n) for n in traced.donated_names}
+        const = {n: _sig(n) for n in traced.const_names}
+        feeds = {n: jax.ShapeDtypeStruct(a.shape, a.dtype)
+                 for n, a in arrays.items()}
+        key_sig = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        compiled = traced.fn.lower(donated, const, feeds,
+                                   key_sig).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        out = {"flops": float(ca.get("flops", 0.0)),
+               "bytes_accessed": float(ca.get("bytes accessed", 0.0))}
+        try:
+            ma = compiled.memory_analysis()
+            out["temp_bytes"] = float(ma.temp_size_in_bytes)
+            out["argument_bytes"] = float(ma.argument_size_in_bytes)
+        except Exception:
+            pass
+        traced._stats_cache = out
+        return out
+
     def run(self, program, scope: Scope, place, feed, fetch_names,
             block_idx: int = 0,
             return_numpy: bool = True) -> List[Any]:
@@ -551,10 +609,8 @@ class Engine:
                 (n, tuple(arrays[n].shape), str(arrays[n].dtype),
                  tuple(map(tuple, lods.get(n, []))))
                 for n in sorted(arrays))
-        key = (program.fingerprint, block_idx, feed_sig_key,
-               tuple(fetch_names), bool(FLAGS.check_nan_inf),
-               int(getattr(program, "_gradient_accumulation_steps", 1)
-                   or 1))
+        key = self._cache_key(program, block_idx, feed_sig_key,
+                              fetch_names)
         traced = self._cache.get(key)
         if traced is None:
             feed_sig = {n: jax.ShapeDtypeStruct(a.shape, a.dtype)
