@@ -472,7 +472,8 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
 class Engine:
     """Compile cache + step dispatch for one (program, scope) pair."""
 
-    def __init__(self, mesh=None, data_axis: str = "dp", strategy=None):
+    def __init__(self, mesh=None, data_axis: str = "dp", strategy=None,
+                 replicated_feeds=()):
         if strategy is not None and mesh is None:
             mesh = strategy.mesh
             data_axis = strategy.data_axis
@@ -480,6 +481,10 @@ class Engine:
         self._cache: Dict[Any, TracedStep] = {}
         self.mesh = mesh
         self.data_axis = data_axis
+        # feed names that are identical on every process under multihost
+        # SPMD (shared tables, per-step constants) — globalized by
+        # replication instead of batch-dim concatenation
+        self.replicated_feeds = set(replicated_feeds)
 
     @staticmethod
     def _normalize_feed(feed: Optional[Dict[str, Any]], place):
@@ -513,20 +518,34 @@ class Engine:
         """Multi-host SPMD (reference multi-trainer NCCL mode): each
         process feeds its LOCAL batch shard; assemble global arrays
         over the cross-process mesh so the one jitted step runs SPMD
-        with XLA collectives over the wire. Replicated inputs (params)
-        are globalized from identical per-process copies."""
+        with XLA collectives over the wire. Feeds named in
+        `replicated_feeds` (and scalars) are identical across processes
+        and globalized by replication, not batch concatenation."""
         from jax.sharding import NamedSharding, PartitionSpec as P
-        nproc = jax.process_count()
         batch = NamedSharding(self.mesh, P(self.data_axis))
+        repl = NamedSharding(self.mesh, P())
         out = {}
         for n, a in arrays.items():
-            if a.ndim >= 1:
-                gshape = (a.shape[0] * nproc,) + tuple(a.shape[1:])
+            if a.ndim >= 1 and n not in self.replicated_feeds:
                 out[n] = jax.make_array_from_process_local_data(
-                    batch, np.asarray(a), gshape)
+                    batch, np.asarray(a), self._global_shape(n, a))
             else:
-                out[n] = a
+                out[n] = jax.make_array_from_process_local_data(
+                    repl, np.asarray(a), tuple(a.shape))
         return out
+
+    def _global_shape(self, name, a):
+        if a.ndim >= 1 and name not in self.replicated_feeds:
+            return ((a.shape[0] * jax.process_count(),)
+                    + tuple(a.shape[1:]))
+        return tuple(a.shape)
+
+    def _global_sig_key(self, arrays, lods):
+        return tuple(
+            (n, self._global_shape(n, arrays[n]),
+             str(arrays[n].dtype),
+             tuple(map(tuple, lods.get(n, []))))
+            for n in sorted(arrays))
 
     def _globalize_replicated(self, params):
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -551,7 +570,9 @@ class Engine:
         analog of the reference's per-op benchmark bookkeeping
         (/root/reference/paddle/fluid/operators/benchmark/op_tester.cc).
         """
-        arrays, _, feed_sig_key = self._normalize_feed(feed, None)
+        arrays, lods, feed_sig_key = self._normalize_feed(feed, None)
+        if self._is_multihost():
+            feed_sig_key = self._global_sig_key(arrays, lods)
         key = self._cache_key(program, block_idx, feed_sig_key,
                               fetch_names)
         traced = self._cache.get(key)
@@ -574,7 +595,10 @@ class Engine:
 
         donated = {n: _sig(n) for n in traced.donated_names}
         const = {n: _sig(n) for n in traced.const_names}
-        feeds = {n: jax.ShapeDtypeStruct(a.shape, a.dtype)
+        multihost = self._is_multihost()
+        feeds = {n: jax.ShapeDtypeStruct(
+                     self._global_shape(n, a) if multihost else a.shape,
+                     a.dtype)
                  for n, a in arrays.items()}
         key_sig = jax.ShapeDtypeStruct((2,), jnp.uint32)
         compiled = traced.fn.lower(donated, const, feeds,
@@ -604,11 +628,8 @@ class Engine:
                 raise NotImplementedError(
                     "multihost SPMD cannot assemble LoD (ragged) feeds "
                     "across processes; pad to dense first")
+            feed_sig_key = self._global_sig_key(arrays, lods)
             arrays = self._globalize(arrays)
-            feed_sig_key = tuple(
-                (n, tuple(arrays[n].shape), str(arrays[n].dtype),
-                 tuple(map(tuple, lods.get(n, []))))
-                for n in sorted(arrays))
         key = self._cache_key(program, block_idx, feed_sig_key,
                               fetch_names)
         traced = self._cache.get(key)

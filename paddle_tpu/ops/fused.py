@@ -37,3 +37,81 @@ def fused_attention(ctx):
         # CPU / odd-shape fallback: composed formulation (same math)
         out = _attn_reference(q, k, v, bias, scale)
     ctx.set_output("Out", out.astype(res_t))
+
+
+@register_op("conv2d_inception_fusion")
+def conv2d_inception_fusion(ctx):
+    """GoogleNet inception block as one op: 4 conv branches + concat.
+
+    Parity: reference fused/fusion_conv_inception_op.{cc,cu} (cuDNN
+    conv+bias+activation chain). Dataflow reverse-engineered from the CUDA
+    kernel (fusion_conv_inception_op.cu:192-249):
+
+      t0 = act(conv1x1(pool3x3_s1_p1(x), F0) + B0)            # oc0 ch
+      c1 = act(conv1x1(x, F1) + B1)                           # oc1 + 2*ic2
+      c2 = act(conv3x3_p1_groups2(c1[:, oc1:], F2) + B2)      # oc2 + ic3
+      c3 = act(conv1x1(c2[:, oc2:], F3) + B3)                 # oc3 ch
+      out = concat([t0, c1[:, :oc1], c2[:, :oc2], c3], channel)
+
+    with oc1 = F1.oc - 2*F2.ic and oc2 = F2.oc - F3.ic (the reference's
+    channel bookkeeping, fusion_conv_inception_op.cc:43-49). TPU-native
+    design: expressed as jnp/lax compositions in one traced block — XLA
+    fuses bias+activation into the convs, so no hand-scheduled
+    cudnnConvolutionBiasActivationForward equivalent is needed; the grad
+    comes from the mechanical vjp (the reference registers only a CUDA
+    forward).
+    """
+    from jax import lax
+
+    x = ctx.input("Input")
+    filters = ctx.inputs("Filter")
+    biases = ctx.inputs("Bias")
+    pool_type = ctx.attr("pooling_type", "max")
+    exclusive = ctx.attr("exclusive", True)
+    act_name = ctx.attr("activation", "relu")
+
+    acts = {
+        "identity": lambda v: v,
+        "relu": jax.nn.relu,
+        "relu6": lambda v: jnp.clip(v, 0.0, 6.0),
+        "sigmoid": jax.nn.sigmoid,
+        "tanh": jnp.tanh,
+    }
+    act = acts[act_name]
+    res_t = jnp.result_type(x)
+
+    def cba(inp, w, b, groups=1, pad=0):
+        dn = lax.conv_dimension_numbers(inp.shape, w.shape,
+                                        ("NCHW", "OIHW", "NCHW"))
+        inp, w = amp_cast("conv2d", inp, w)
+        y = lax.conv_general_dilated(
+            inp, w, window_strides=(1, 1), padding=[(pad, pad)] * 2,
+            dimension_numbers=dn, feature_group_count=groups)
+        return act(y + b.reshape(1, -1, 1, 1).astype(y.dtype))
+
+    # branch 0: 3x3 stride-1 pad-1 pool then 1x1 conv
+    if pool_type == "max":
+        pooled = lax.reduce_window(
+            x, -jnp.inf, lax.max, (1, 1, 3, 3), (1, 1, 1, 1),
+            [(0, 0), (0, 0), (1, 1), (1, 1)])
+    else:
+        s = lax.reduce_window(
+            x, 0.0, lax.add, (1, 1, 3, 3), (1, 1, 1, 1),
+            [(0, 0), (0, 0), (1, 1), (1, 1)])
+        if exclusive:
+            cnt = lax.reduce_window(
+                jnp.ones_like(x), 0.0, lax.add, (1, 1, 3, 3), (1, 1, 1, 1),
+                [(0, 0), (0, 0), (1, 1), (1, 1)])
+        else:
+            cnt = 9.0
+        pooled = s / cnt
+    ic2 = filters[2].shape[1]          # per-group in-channels of the 3x3
+    ic3 = filters[3].shape[1]
+    oc1 = filters[1].shape[0] - 2 * ic2
+    oc2 = filters[2].shape[0] - ic3
+    t0 = cba(pooled, filters[0], biases[0])
+    c1 = cba(x, filters[1], biases[1])
+    c2 = cba(c1[:, oc1:], filters[2], biases[2], groups=2, pad=1)
+    c3 = cba(c2[:, oc2:], filters[3], biases[3])
+    out = jnp.concatenate([t0, c1[:, :oc1], c2[:, :oc2], c3], axis=1)
+    ctx.set_output("Output", out.astype(res_t))

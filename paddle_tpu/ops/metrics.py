@@ -124,3 +124,45 @@ def precision_recall(ctx):
     ctx.set_output("BatchMetrics", _metrics(batch_states))
     ctx.set_output("AccumMetrics", _metrics(acc_states))
     ctx.set_output("AccumStatesInfo", acc_states)
+
+
+@register_no_grad_op("positive_negative_pair")
+def positive_negative_pair(ctx):
+    """Ranking pair statistics grouped by query id.
+
+    Parity: reference positive_negative_pair_op.{cc,h} — for every pair of
+    rows with the same QueryID and differing labels, a pair is positive when
+    (score_i - score_j)*(label_i - label_j) > 0, else negative; equal scores
+    additionally count as neutral (the reference adds ties to BOTH neutral
+    and negative). TPU-native design: instead of the reference's host-side
+    hash-map of per-query lists with an O(n^2) inner loop, one masked [N, N]
+    pair matrix evaluates every pair at once on device (N is a minibatch, so
+    the matrix is small; the mask encodes query grouping).
+    """
+    score = ctx.input("Score")
+    label = ctx.input("Label").reshape(-1).astype(jnp.float32)
+    query = ctx.input("QueryID").reshape(-1)
+    weight = ctx.input("Weight") if ctx.has_input("Weight") else None
+    column = int(ctx.attr("column", 0))
+    if column < 0:
+        column += score.shape[1]
+    s = score[:, column].astype(jnp.float32)
+    n = s.shape[0]
+    w = (weight.reshape(-1).astype(jnp.float32) if weight is not None
+         else jnp.ones((n,), jnp.float32))
+    pair_mask = (jnp.triu(jnp.ones((n, n), bool), 1)
+                 & (query[:, None] == query[None, :])
+                 & (label[:, None] != label[None, :]))
+    pw = jnp.where(pair_mask, (w[:, None] + w[None, :]) * 0.5, 0.0)
+    ds = s[:, None] - s[None, :]
+    dl = label[:, None] - label[None, :]
+    pos = jnp.sum(pw * (ds * dl > 0))
+    neg = jnp.sum(pw * (ds * dl <= 0))
+    neu = jnp.sum(pw * (ds == 0))
+    if ctx.has_input("AccumulatePositivePair"):
+        pos = pos + ctx.input("AccumulatePositivePair").reshape(())
+        neg = neg + ctx.input("AccumulateNegativePair").reshape(())
+        neu = neu + ctx.input("AccumulateNeutralPair").reshape(())
+    ctx.set_output("PositivePair", pos.reshape(1))
+    ctx.set_output("NegativePair", neg.reshape(1))
+    ctx.set_output("NeutralPair", neu.reshape(1))
