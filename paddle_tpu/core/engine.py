@@ -65,9 +65,15 @@ class TracedStep:
         self.updated_names = updated_names
         self.fetch_lods = fetch_lods  # name -> lod (host metadata)
         self.uses_rng = uses_rng
-        # (op_type, var_name) per all-finite flag when check_nan_inf was
-        # on at trace time
-        self.nan_check_labels = nan_check_labels
+        # live reference to the trace's (op_type, var_name) label box, one
+        # entry per all-finite flag when check_nan_inf is on. A reference,
+        # not a snapshot: on the eager-interpreter path the box is only
+        # filled while a step runs, after TracedStep construction
+        self._nan_labels_box = nan_check_labels
+
+    @property
+    def nan_check_labels(self):
+        return tuple(self._nan_labels_box)
 
 
 def _collect_persistable_inputs(program, block, scope: Scope):
@@ -414,7 +420,7 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
 
         return TracedStep(eager_fn, [], avail, sorted(feed_sig),
                           list(fetch_names), [], fetch_lod_box, True,
-                          nan_check_labels=tuple(nan_labels_box))
+                          nan_check_labels=nan_labels_box)
     updated_names = list(updated_box)
     donated = [n for n in avail if n in updated_names]
     const = [n for n in avail if n not in updated_names]
@@ -466,7 +472,7 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
     return TracedStep(fn, donated, const, sorted(feed_sig),
                       list(fetch_names), updated_names,
                       fetch_lod_box, uses_rng_box[0],
-                      nan_check_labels=tuple(nan_labels_box))
+                      nan_check_labels=nan_labels_box)
 
 
 class Engine:

@@ -259,7 +259,12 @@ class AnalysisPredictor:
                 and not lods:
             try:
                 fn, donated, const = self._load_aot(aot_path)
-            except Exception:
+            except Exception as exc:
+                import warnings
+                warnings.warn(
+                    f"ignoring AOT artifact {aot_path!r} "
+                    f"({type(exc).__name__}: {exc}); re-tracing",
+                    stacklevel=2)
                 fn = None       # corrupt/stale AOT: fall back to trace
         if fn is None:
             traced = trace_step(self._program, 0, feed_sig, lods,
@@ -307,21 +312,24 @@ class AnalysisPredictor:
             os.makedirs(self._aot_dir, exist_ok=True)
             with open(path, "wb") as f:
                 f.write(exp.serialize())
+            # JSON, not pickle: the sidecar rides along with model dirs
+            # from arbitrary sources, and unpickling untrusted bytes
+            # executes code
             meta = {"donated": list(donated), "const": list(const)}
-            import pickle
-            with open(path + ".meta", "wb") as f:
-                pickle.dump(meta, f)
+            import json
+            with open(path + ".meta", "w") as f:
+                json.dump(meta, f)
         except Exception:
             # AOT is an optimization; never fail inference over it
             pass
 
     def _load_aot(self, path):
         from jax import export as jax_export
-        import pickle
+        import json
         with open(path, "rb") as f:
             exp = jax_export.deserialize(f.read())
-        with open(path + ".meta", "rb") as f:
-            meta = pickle.load(f)
+        with open(path + ".meta") as f:
+            meta = json.load(f)
 
         def fn(donated, const, feeds, key):
             return exp.call(donated, const, feeds, key)

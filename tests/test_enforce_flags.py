@@ -75,6 +75,41 @@ def test_check_nan_inf_names_offending_op():
         fluid.set_flags({"FLAGS_check_nan_inf": False})
 
 
+def test_check_nan_inf_fires_on_eager_fallback_path():
+    """A value-dependent op (edit_distance) demotes the program to the
+    eager interpreter; the NaN sweep must still fire there (ADVICE r2:
+    the label box is only filled while an eager step runs)."""
+    from paddle_tpu.core.scope import create_lod_tensor
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        b = main.global_block()
+        b.create_var(name="hyp", shape=[4, 1], dtype="int64")
+        b.create_var(name="ref", shape=[4, 1], dtype="int64")
+        b.create_var(name="dist", shape=[2, 1], dtype="float32")
+        b.create_var(name="seqn", shape=[1], dtype="int64")
+        b.append_op(type="edit_distance",
+                    inputs={"Hyps": ["hyp"], "Refs": ["ref"]},
+                    outputs={"Out": ["dist"], "SequenceNum": ["seqn"]},
+                    attrs={}, infer_shape=False)
+        x = layers.data("x", [3], dtype="float32")
+        y = layers.log(x)          # log of negative input -> NaN
+        z = layers.mean(y)
+    ids = np.array([[1], [2], [3], [4]], np.int64)
+    feed = {"hyp": create_lod_tensor(ids, [[0, 2, 4]]),
+            "ref": create_lod_tensor(ids, [[0, 2, 4]]),
+            "x": -np.ones((2, 3), np.float32)}
+    fluid.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # eager-fallback warning
+            with pytest.raises(fluid.EnforceNotMet) as ei:
+                _run(main, startup, feed, [z.name])
+        assert "log" in str(ei.value)
+    finally:
+        fluid.set_flags({"FLAGS_check_nan_inf": False})
+
+
 # ------------------------------------------------------------------ flags
 
 def test_flags_get_set_roundtrip():
