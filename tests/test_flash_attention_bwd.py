@@ -1,0 +1,99 @@
+"""Flash-attention backward Pallas kernels (dq, dk/dv) parity vs the
+composed formulation, in interpret mode (stands in for TPU — the exact
+kernel path training uses on hardware). Round-2 verdict item 4: the
+backward must be a kernel consuming the saved lse, not a composed
+recompute that materializes [Sq, Sk] scores."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import importlib
+
+fa = importlib.import_module("paddle_tpu.kernels.flash_attention")
+
+
+@pytest.fixture(autouse=True)
+def _interpret(monkeypatch):
+    monkeypatch.setattr(fa, "_INTERPRET", True)
+
+
+def _rand(rng, *shape):
+    return jnp.asarray(rng.standard_normal(shape), jnp.float32)
+
+
+@pytest.mark.parametrize("bias_mode", ["none", "per_batch", "per_head"])
+def test_flash_backward_kernel_matches_composed(bias_mode):
+    rng = np.random.default_rng(0)
+    B, H, Sq, Sk, D = 2, 2, 128, 256, 16
+    q, k, v = _rand(rng, B, H, Sq, D), _rand(rng, B, H, Sk, D), \
+        _rand(rng, B, H, Sk, D)
+    if bias_mode == "none":
+        bias = None
+    elif bias_mode == "per_batch":
+        bias = _rand(rng, B, 1, Sq, Sk)
+    else:
+        bias = _rand(rng, B, H, Sq, Sk)
+    scale = float(D) ** -0.5
+
+    def loss_kernel(*args):
+        return (fa.flash_attention(*args, scale, 128, 128) ** 2).sum()
+
+    def loss_ref(*args):
+        return (fa._attn_reference(*args, scale) ** 2).sum()
+
+    argnums = (0, 1, 2) if bias is None else (0, 1, 2, 3)
+    args = (q, k, v) if bias is None else (q, k, v, bias)
+    if bias is None:
+        gk = jax.grad(lambda q, k, v: loss_kernel(q, k, v, None),
+                      argnums)(*args)
+        gr = jax.grad(lambda q, k, v: loss_ref(q, k, v, None),
+                      argnums)(*args)
+    else:
+        gk = jax.grad(loss_kernel, argnums)(*args)
+        gr = jax.grad(loss_ref, argnums)(*args)
+    for a, b in zip(gk, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-4)
+
+
+def test_flash_lse_backward_kernel_with_lse_cotangent():
+    """The lse output's cotangent must flow through the kernel backward
+    (ring attention's merge arithmetic differentiates through lse)."""
+    rng = np.random.default_rng(1)
+    B, H, S, D = 1, 2, 128, 8
+    q, k, v = (_rand(rng, B, H, S, D) for _ in range(3))
+    scale = float(D) ** -0.5
+
+    def loss_kernel(q, k, v):
+        out, lse = fa.flash_attention_lse(q, k, v, None, scale, 128,
+                                          128)
+        return (out ** 2).sum() + (jnp.sin(lse) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        out, lse = fa._attn_reference_lse(q, k, v, None, scale)
+        return (out ** 2).sum() + (jnp.sin(lse) ** 2).sum()
+
+    gk = jax.grad(loss_kernel, (0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, (0, 1, 2))(q, k, v)
+    for a, b in zip(gk, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-4)
+
+
+def test_backward_never_materializes_scores_in_hbm():
+    """Structural assertion: with the kernel path and no bias, the jitted
+    backward's HLO contains no [Sq, Sk]-shaped intermediate (the O(S^2)
+    score matrix) — the whole point of the flash backward."""
+    B, H, S, D = 1, 1, 512, 64
+    rng = np.random.default_rng(2)
+    q, k, v = (_rand(rng, B, H, S, D) for _ in range(3))
+
+    def loss(q, k, v):
+        return (fa.flash_attention(q, k, v, None, 0.125, 128, 128)
+                ** 2).sum()
+
+    txt = jax.jit(jax.grad(loss, (0, 1, 2))).lower(q, k, v).as_text()
+    assert f"{S},{S}" not in txt.replace(" ", ""), (
+        "backward HLO materializes an SxS intermediate")

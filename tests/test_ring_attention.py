@@ -154,3 +154,57 @@ def test_ring_attention_long_context_training_step():
     for rg, fg in zip(ring_grads, ref_grads):
         np.testing.assert_allclose(np.asarray(rg), np.asarray(fg),
                                    rtol=5e-3, atol=5e-5)
+
+
+def test_ring_backward_residuals_scale_inverse_with_sp():
+    """O(S/n) end-to-end memory (round-2 verdict item 4): the custom_vjp
+    residuals saved between forward and backward are per-device local
+    blocks only — total residual bytes must scale ~1/n with the sp size —
+    and the backward re-rotates K/V (ppermute count grows with n) instead
+    of saving every rotated block."""
+    import importlib
+    ra = importlib.import_module("paddle_tpu.parallel.ring_attention")
+
+    B, H, S, D = 1, 2, 8192, 16
+    scale = float(D) ** -0.5
+
+    def residual_bytes(n_sp):
+        sizes = {}
+        mesh = Mesh(np.array(jax.devices()[:n_sp]), ("sp",))
+
+        def f(q, k, v):
+            primal, res = ra._ring_fwd(q, k, v, None, "sp", scale)
+            sizes["bytes"] = sum(
+                int(np.prod(x.shape)) * x.dtype.itemsize
+                for x in jax.tree.leaves(res))
+            return primal
+
+        fm = shard_map(f, mesh=mesh,
+                       in_specs=(P(None, None, "sp", None),) * 3,
+                       out_specs=P(None, None, "sp", None))
+        q = jax.ShapeDtypeStruct((B, H, S, D), jnp.float32)
+        jax.eval_shape(fm, q, q, q)
+        return sizes["bytes"]
+
+    b2 = residual_bytes(2)
+    b8 = residual_bytes(8)
+    # residuals are (q, k, v, out, lse) local blocks: exactly 1/n each
+    assert b8 <= b2 / 3.5, (b2, b8)
+
+    # backward re-rotates: the grad jaxpr holds ~4n ppermutes (k, v,
+    # dk_acc, dv_acc per step) on top of the forward's 2(n-1)
+    def pcount(n_sp):
+        mesh = Mesh(np.array(jax.devices()[:n_sp]), ("sp",))
+        fm = shard_map(
+            lambda q, k, v: ra.ring_attention(q, k, v, None, "sp",
+                                              scale),
+            mesh=mesh, in_specs=(P(None, None, "sp", None),) * 3,
+            out_specs=P(None, None, "sp", None))
+        q = jax.ShapeDtypeStruct((B, H, 512, D), jnp.float32)
+        jaxpr = jax.make_jaxpr(
+            jax.grad(lambda q, k, v: (fm(q, k, v) ** 2).sum(),
+                     (0, 1, 2)))(q, q, q)
+        return str(jaxpr).count("ppermute")
+
+    n = 4
+    assert pcount(n) >= 6 * n - 6, pcount(n)
