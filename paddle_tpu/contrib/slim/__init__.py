@@ -2,6 +2,7 @@
 python/paddle/fluid/contrib/slim/) — quantization-aware training first;
 the reference's pruning/distillation/NAS live here too as they land."""
 from . import quantization  # noqa: F401
+from . import core  # noqa: F401
 from . import prune  # noqa: F401
 from . import distillation  # noqa: F401
 from . import nas  # noqa: F401

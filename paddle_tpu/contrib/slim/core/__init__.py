@@ -1,0 +1,17 @@
+"""slim.core: the compression pipeline (Compressor / Strategy / Context
+/ ConfigFactory).
+
+Parity: reference contrib/slim/core/{compressor.py,strategy.py,
+config.py} — a config-driven epoch loop that composes quantization,
+pruning, distillation and NAS strategies over one training run, with
+checkpoint/restore of the compression state. TPU-native notes: the
+"graph" a strategy rewrites is a Program (the engine compiles whole
+blocks to XLA; there is no IrGraph layer to wrap), and eval runs
+through the same compiled-executor path as training.
+"""
+from .strategy import Strategy
+from .compressor import Compressor, Context
+from .config import ConfigFactory, load_config
+
+__all__ = ["Strategy", "Compressor", "Context", "ConfigFactory",
+           "load_config"]

@@ -118,3 +118,11 @@ def fsp_loss(teacher_var1_name, teacher_var2_name, student_var1_name,
         tf.stop_gradient = True
         sf = L.fsp_matrix(s1, s2)
         return L.reduce_mean(L.square(L.elementwise_sub(sf, tf)))
+
+
+from .strategies import (  # noqa: E402,F401
+    DistillationStrategy, L2Distiller, SoftLabelDistiller,
+    FSPDistiller)
+
+__all__ += ["DistillationStrategy", "L2Distiller", "SoftLabelDistiller",
+            "FSPDistiller"]

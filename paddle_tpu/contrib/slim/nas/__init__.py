@@ -28,6 +28,13 @@ class SearchSpaceBase:
     def create_net(self, tokens):
         raise NotImplementedError
 
+    def eval_tokens(self, tokens, context) -> float:
+        """Score one candidate (LightNASStrategy calls this): build
+        the net, short-train/eval it, return the reward. Override for
+        anything beyond the default create_net()-returns-reward
+        contract."""
+        return float(self.create_net(tokens))
+
 
 class SAController:
     """Simulated annealing over token lists (reference
@@ -106,3 +113,9 @@ class SAController:
             tokens = self.next_tokens()
             self.update(tokens, eval_fn(tokens))
         return self.best_tokens, self.max_reward
+
+
+from .strategies import (  # noqa: E402,F401
+    ControllerServer, SearchAgent, LightNASStrategy)
+
+__all__ += ["ControllerServer", "SearchAgent", "LightNASStrategy"]

@@ -1,0 +1,167 @@
+"""NAS controller server / search agent / LightNASStrategy.
+
+Parity: reference contrib/slim/nas/{controller_server.py,
+search_agent.py,light_nas_strategy.py}: a TCP server wraps the
+SAController so multiple distributed search agents (one per trial
+worker) can request `next_tokens` and report `key\\ttokens\\treward`
+lines; LightNASStrategy drives the search from the compression loop —
+each epoch asks for tokens, builds the candidate net via the user's
+SearchSpace, short-trains/evaluates it, and reports the reward.
+"""
+from __future__ import annotations
+
+import socket
+from threading import Thread
+
+from ..core.strategy import Strategy
+
+__all__ = ["ControllerServer", "SearchAgent", "LightNASStrategy"]
+
+
+class ControllerServer:
+    """TCP wrapper over a controller (reference controller_server.py).
+
+    Protocol (newline-terminated ASCII):
+      "next_tokens"            -> "t0,t1,..."
+      "<key>\\t<tokens>\\t<reward>" -> "ok" (controller.update called)
+    """
+
+    def __init__(self, controller=None, address=("127.0.0.1", 0),
+                 max_client_num=100, search_steps=None, key="nas"):
+        self._controller = controller
+        self._address = address
+        self._max_client_num = max_client_num
+        self._search_steps = search_steps
+        self._closed = False
+        self._key = key
+        self._ip, self._port = address
+
+    def start(self):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(self._address)
+        self._sock.listen(self._max_client_num)
+        self._ip, self._port = self._sock.getsockname()[:2]
+        self._thread = Thread(target=self.run, daemon=True)
+        self._thread.start()
+        return self._thread
+
+    def close(self):
+        self._closed = True
+        try:  # unblock accept()
+            socket.create_connection((self._ip, self._port),
+                                     timeout=1).close()
+        except OSError:
+            pass
+        self._thread.join(timeout=5)
+        self._sock.close()
+
+    def ip(self):
+        return self._ip
+
+    def port(self):
+        return self._port
+
+    def run(self):
+        while not self._closed and (
+                self._search_steps is None
+                or self._controller._iter < self._search_steps):
+            try:
+                conn, addr = self._sock.accept()
+            except OSError:
+                break
+            with conn:
+                message = conn.recv(4096).decode().strip("\n")
+                if self._closed:
+                    break
+                if message == "next_tokens":
+                    tokens = self._controller.next_tokens()
+                    conn.send(",".join(map(str, tokens)).encode())
+                else:
+                    parts = message.split("\t")
+                    if len(parts) < 3 or parts[0] != self._key:
+                        continue  # noise
+                    tokens = [int(t) for t in parts[1].split(",")]
+                    self._controller.update(tokens, float(parts[2]))
+                    conn.send(b"ok")
+
+
+class SearchAgent:
+    """Client side (reference search_agent.py)."""
+
+    def __init__(self, server_ip=None, server_port=None, key="nas"):
+        self.server_ip = server_ip
+        self.server_port = server_port
+        self._key = key
+
+    def _send(self, message):
+        with socket.create_connection(
+                (self.server_ip, self.server_port), timeout=30) as s:
+            s.send(message.encode())
+            return s.recv(4096).decode()
+
+    def next_tokens(self):
+        return [int(t) for t in self._send("next_tokens").split(",")]
+
+    def update(self, tokens, reward):
+        tokens = ",".join(map(str, tokens))
+        return self._send(f"{self._key}\t{tokens}\t{reward}")
+
+
+class LightNASStrategy(Strategy):
+    """Architecture search inside the compression loop (reference
+    light_nas_strategy.py): per epoch in [start, end): fetch tokens,
+    build the candidate via context's search space, score it with
+    `retrain_epoch` quick training + eval, report the reward."""
+
+    def __init__(self, controller=None, end_epoch=10, target_flops=None,
+                 retrain_epoch=0, metric_name="acc", server_ip=None,
+                 server_port=0, is_server=True, search_steps=None,
+                 key="light-nas"):
+        super().__init__(0, end_epoch)
+        self._controller = controller
+        self.target_flops = target_flops
+        self.retrain_epoch = retrain_epoch
+        self.metric_name = metric_name
+        self._is_server = is_server
+        self._server_ip = server_ip or "127.0.0.1"
+        self._server_port = server_port
+        self._search_steps = search_steps
+        self._key = key
+        self._server = None
+        self._agent = None
+
+    def on_compression_begin(self, context):
+        space = context.get("search_space")
+        assert space is not None, (
+            "LightNASStrategy needs context.put('search_space', <your "
+            "SearchSpaceBase impl>) before run()")
+        self._space = space
+        if self._is_server:
+            from . import SAController
+            ctrl = self._controller or SAController(
+                range_table=space.range_table())
+            self._server = ControllerServer(
+                controller=ctrl,
+                address=(self._server_ip, self._server_port),
+                search_steps=self._search_steps, key=self._key)
+            self._server.start()
+            self._server_port = self._server.port()
+        self._agent = SearchAgent(self._server_ip, self._server_port,
+                                  key=self._key)
+
+    def on_epoch_begin(self, context):
+        if not (self.start_epoch <= context.epoch_id < self.end_epoch):
+            return
+        tokens = self._agent.next_tokens()
+        reward = self._space.eval_tokens(tokens, context)
+        self._agent.update(tokens, reward)
+        context.put("nas_last", (tokens, reward))
+
+    def on_compression_end(self, context):
+        if self._server is not None:
+            context.put("nas_best_tokens",
+                        self._server._controller.best_tokens)
+            context.put("nas_best_reward",
+                        self._server._controller.max_reward)
+            self._server.close()

@@ -87,3 +87,11 @@ def apply_prune_masks(scope, masks: Dict[str, np.ndarray]):
     for name, mask in masks.items():
         w = _scope_arr(scope, name)
         scope.var(name).set_value(w * np.broadcast_to(mask, w.shape))
+
+
+from .strategies import (  # noqa: E402,F401
+    PruneStrategy, UniformPruneStrategy, SensitivePruneStrategy,
+    AutoPruneStrategy)
+
+__all__ += ["PruneStrategy", "UniformPruneStrategy",
+            "SensitivePruneStrategy", "AutoPruneStrategy"]

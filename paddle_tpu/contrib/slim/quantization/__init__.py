@@ -1,3 +1,4 @@
 from .quantization_pass import (  # noqa: F401
     QuantizationTransformPass, QuantizationFreezePass, ConvertToInt8Pass,
 )
+from .strategies import QuantizationStrategy  # noqa: F401

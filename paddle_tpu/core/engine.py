@@ -164,8 +164,14 @@ def run_block_ops(block, env, rng_ctx, lod_env, block_runner, ops=None):
             info = OPS.get(op.type)
             ctx = ExecContext(op, env, rng_ctx, block_runner, lod_env)
             info.lowering(ctx)
-        except (EnforceNotMet, NotImplementedError):
-            # already carries op context / handled by the eager fallback
+        except NotImplementedError as exc:
+            # handled by the island partitioner; overwrite so the
+            # OUTERMOST frame's index wins (a dynamic op inside a
+            # control-flow sub-block demotes the whole control-flow op)
+            exc._island_op_index = i
+            raise
+        except EnforceNotMet:
+            # already carries op context
             raise
         except Exception as exc:  # re-raise with op/var context (enforce.h)
             raise wrap_op_error(exc, op, env, i) from exc
@@ -399,26 +405,59 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
                 f"persistable {n!r} holds a host-side state object")
         jax.eval_shape(step, params_sig, feed_sig, key_sig)
     except NotImplementedError as reason:
-        # Block contains value-dependent-shape ops (sequence_erase,
-        # edit_distance, ...): fall back to the eager interpreter path —
-        # the TPU-native analog of the reference's per-op CPU executor
-        # for ops XLA cannot express with static shapes (SURVEY §7
-        # "interpreter as fallback"). This path re-traces EVERY step at
-        # Python speed; warn once per program so slow training is never
-        # a mystery (VERDICT r1 weak #6).
-        import warnings as _warnings
-        _warnings.warn(
-            f"program falls back to the EAGER interpreter (no XLA "
-            f"step compilation): {reason}. Expect per-step Python "
-            f"overhead; isolate the value-dependent op if this block "
-            f"is a hot loop.", stacklevel=2)
+        # Block contains value-dependent-shape ops (edit_distance,
+        # sequence_erase, save, ...) or host-state persistables: compile
+        # maximal static segments as XLA islands and interpret only the
+        # dynamic ops on host — the TPU-native analog of the reference's
+        # per-op CPU dispatch (operator.cc:884-940). With gradient
+        # accumulation the step re-slices feeds inside one trace, which
+        # the island partitioner cannot split; that combination keeps
+        # the whole-program eager interpreter.
+        if accum_k > 1:
+            import warnings as _warnings
+            _warnings.warn(
+                f"program falls back to the EAGER interpreter (no XLA "
+                f"step compilation): {reason}; gradient accumulation "
+                f"prevents island partitioning. Expect per-step Python "
+                f"overhead.", stacklevel=2)
 
-        def eager_fn(donated_params, const_params, feeds, key):
+            def eager_fn(donated_params, const_params, feeds, key):
+                params = dict(const_params)
+                params.update(donated_params)
+                return step(params, feeds, key)
+
+            return TracedStep(eager_fn, [], avail, sorted(feed_sig),
+                              list(fetch_names), [], fetch_lod_box,
+                              True, nan_check_labels=nan_labels_box)
+
+        from .islands import IslandRunner
+        opaque_names = set()
+        if opaque_state:
+            for pn in avail:
+                val = scope.find_var(pn).get_value()
+                arr = val.array if isinstance(val, LoDTensor) else val
+                try:
+                    jax.ShapeDtypeStruct(jnp.shape(arr),
+                                         jnp.result_type(arr))
+                except (TypeError, ValueError):
+                    opaque_names.add(pn)
+        first_idx = getattr(reason, "_island_op_index", None)
+        runner = IslandRunner(
+            program, block, fetch_names, persistable_all, feed_lods,
+            amp_cfg, check_nan, nan_labels_box, fetch_lod_box,
+            first_dynamic_idx=first_idx)
+        for idx, op in enumerate(runner.ops):
+            if opaque_names and (
+                    opaque_names & set(runner._op_reads(op)) or
+                    opaque_names & set(runner._op_writes(op))):
+                runner.dynamic_idx.add(idx)
+
+        def islands_fn(donated_params, const_params, feeds, key):
             params = dict(const_params)
             params.update(donated_params)
-            return step(params, feeds, key)
+            return runner.step(params, feeds, key)
 
-        return TracedStep(eager_fn, [], avail, sorted(feed_sig),
+        return TracedStep(islands_fn, [], avail, sorted(feed_sig),
                           list(fetch_names), [], fetch_lod_box, True,
                           nan_check_labels=nan_labels_box)
     updated_names = list(updated_box)

@@ -378,4 +378,5 @@ def _make_generic_grad_lowering(fwd_type: str):
                     ctx.env[n] = v
 
     grad_lowering.__name__ = f"{fwd_type}_grad_lowering"
+    grad_lowering._generic_vjp_of = fwd_type
     return grad_lowering

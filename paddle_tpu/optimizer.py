@@ -136,8 +136,29 @@ class Optimizer:
     # ---- accumulators -----------------------------------------------------
     def _add_accumulator(self, name, param, dtype=None, fill_value=0.0,
                          shape=None):
-        if param.name in self._accumulators[name]:
-            return self._accumulators[name][param.name]
+        cached = self._accumulators[name].get(param.name)
+        if cached is not None:
+            if in_dygraph_mode():
+                return cached
+            # one optimizer may minimize a SECOND program (slim's
+            # compressor re-minimizes rewritten graphs): the cached
+            # Variable belongs to the first program's block, so
+            # re-declare it — same name, so scope state carries — in
+            # the current program and re-init in its startup
+            blk = default_main_program().global_block()
+            if blk._find_var_recursive(cached.name) is not None:
+                return cached
+            assert self.helper is not None
+            var = self.helper.create_global_variable(
+                name=cached.name, persistable=True,
+                dtype=cached.dtype, shape=list(cached.shape))
+            sb = default_startup_program().global_block()
+            sv = sb.create_var(name=cached.name,
+                               shape=list(cached.shape),
+                               dtype=cached.dtype, persistable=True)
+            Constant(float(fill_value))(sv, sb)
+            self._accumulators[name][param.name] = var
+            return var
         shape = shape if shape is not None else list(param.shape)
         if in_dygraph_mode():
             import jax.numpy as jnp

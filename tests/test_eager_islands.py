@@ -1,0 +1,155 @@
+"""Eager islands (round-2 verdict item 3): one value-dependent op must
+NOT demote the whole program to per-step Python interpretation — maximal
+static segments compile as XLA islands, only the dynamic op runs on
+host, the warning names only the island, and the islanded path beats
+per-op host dispatch by >=10x on a 100-op block."""
+import importlib
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.scope import Scope, create_lod_tensor
+
+isl = importlib.import_module("paddle_tpu.core.islands")
+
+
+def _build_program(n_fc=24):
+    """~100-op block: n_fc fc(+relu) stacks then an edit_distance."""
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [128], dtype="float32")
+        h = x
+        for _ in range(n_fc):
+            h = layers.fc(h, 128, act="relu")
+        out = layers.mean(h)
+        b = main.global_block()
+        for n, s, d in (("hyp", [4, 1], "int64"),
+                        ("ref", [4, 1], "int64"),
+                        ("dist", [2, 1], "float32"),
+                        ("seqn", [1], "int64")):
+            b.create_var(name=n, shape=s, dtype=d)
+        b.append_op(type="edit_distance",
+                    inputs={"Hyps": ["hyp"], "Refs": ["ref"]},
+                    outputs={"Out": ["dist"], "SequenceNum": ["seqn"]},
+                    attrs={}, infer_shape=False)
+        dm = layers.mean(layers.cast(b.var("dist"), "float32"))
+    return main, startup, out, dm
+
+
+def _feed():
+    ids = np.array([[1], [2], [3], [4]], np.int64)
+    return {"x": np.random.RandomState(0).rand(32, 128).astype(
+                np.float32),
+            "hyp": create_lod_tensor(ids, [[0, 2, 4]]),
+            "ref": create_lod_tensor(ids, [[0, 2, 4]])}
+
+
+def _run_steps(main, startup, fetches, n, warm=3):
+    feed = _feed()
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for _ in range(warm):
+            vals = exe.run(main, feed=feed, fetch_list=fetches)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            vals = exe.run(main, feed=feed, fetch_list=fetches)
+        dt = time.perf_counter() - t0
+    return dt / n, vals
+
+
+def test_islands_compile_static_segments_and_warn_names_island():
+    main, startup, out, dm = _build_program()
+    n_ops = len(main.global_block().ops)
+    assert n_ops >= 75
+    feed = _feed()
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            vals = exe.run(main, feed=feed,
+                           fetch_list=[out.name, dm.name])
+    island_warnings = [str(w.message) for w in rec
+                       if "HOST between compiled XLA islands"
+                       in str(w.message)]
+    assert len(island_warnings) == 1, island_warnings
+    assert "edit_distance" in island_warnings[0]
+    # no whole-program eager demotion
+    assert not any("EAGER interpreter" in str(w.message) for w in rec)
+    # numerically correct: edit_distance of identical hyp/ref is 0
+    assert float(np.asarray(vals[1])) == 0.0
+    assert np.isfinite(float(np.asarray(vals[0])))
+
+
+def test_islands_beat_per_op_dispatch_10x(monkeypatch):
+    # ~400-op static region: per-op dispatch cost scales with op count,
+    # the islanded path dispatches ONE cached executable regardless
+    main, startup, out, dm = _build_program(n_fc=100)
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        t_islands, v_islands = _run_steps(main, startup,
+                                          [out.name, dm.name], 15)
+
+    # the pre-islands behavior: every op interpreted on host per step
+    orig_init = isl.IslandRunner.__init__
+
+    def all_dynamic_init(self, *a, **k):
+        orig_init(self, *a, **k)
+        self.dynamic_idx = set(range(len(self.ops)))
+
+    monkeypatch.setattr(isl.IslandRunner, "__init__", all_dynamic_init)
+    main2, startup2, out2, dm2 = _build_program(n_fc=100)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        t_eager, v_eager = _run_steps(main2, startup2,
+                                      [out2.name, dm2.name], 3, warm=1)
+
+    np.testing.assert_allclose(np.asarray(v_islands[0]),
+                               np.asarray(v_eager[0]), rtol=1e-5)
+    speedup = t_eager / t_islands
+    assert speedup >= 10, (
+        f"islands {t_islands * 1e3:.1f} ms/step vs per-op dispatch "
+        f"{t_eager * 1e3:.1f} ms/step — only {speedup:.1f}x")
+
+
+def test_islands_partition_converges_and_caches():
+    """After the first step, later steps run from segment caches: no new
+    jit entries, stable dynamic set."""
+    main, startup, out, dm = _build_program(n_fc=4)
+    feed = _feed()
+    scope = Scope()
+    runners = []
+    orig_init = isl.IslandRunner.__init__
+
+    def spy_init(self, *a, **k):
+        orig_init(self, *a, **k)
+        runners.append(self)
+
+    isl.IslandRunner.__init__ = spy_init
+    try:
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                for _ in range(4):
+                    exe.run(main, feed=feed,
+                            fetch_list=[out.name, dm.name])
+    finally:
+        isl.IslandRunner.__init__ = orig_init
+    assert len(runners) == 1
+    r = runners[0]
+    ed_idx = [i for i, op in enumerate(r.ops)
+              if op.type == "edit_distance"]
+    assert set(r.dynamic_idx) == set(ed_idx)
+    for seg in r._segments.values():
+        assert len(seg.cache) == 1

@@ -1,0 +1,42 @@
+"""GRAD.spec guard (reference op_use_default_grad_op_maker.spec +
+tools/diff_use_default_grad_op_maker.py, SURVEY §4.10): the committed
+spec records each op's gradient source (mechanical vjp / hand-written /
+none); any registration change that flips a class fails here until the
+spec is regenerated deliberately:
+    python tools/print_grad_spec.py > GRAD.spec
+"""
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def test_grad_spec_matches_registry():
+    from print_grad_spec import grad_spec_lines
+    with open(os.path.join(REPO, "GRAD.spec")) as f:
+        committed = [l.rstrip("\n") for l in f if l.strip()]
+    current = grad_spec_lines()
+    committed_map = dict(l.split() for l in committed)
+    current_map = dict(l.split() for l in current)
+    added = sorted(set(current_map) - set(committed_map))
+    removed = sorted(set(committed_map) - set(current_map))
+    changed = sorted(t for t in set(current_map) & set(committed_map)
+                     if current_map[t] != committed_map[t])
+    assert not (added or removed or changed), (
+        f"gradient-source registry drifted from GRAD.spec — "
+        f"added={added} removed={removed} "
+        f"changed={[(t, committed_map[t], '->', current_map[t]) for t in changed]}. "
+        f"If intentional, regenerate: "
+        f"python tools/print_grad_spec.py > GRAD.spec")
+
+
+def test_spec_has_expected_hand_written_grads():
+    """The ops whose reference grads are hand-crafted must never fall
+    back to the mechanical vjp silently."""
+    with open(os.path.join(REPO, "GRAD.spec")) as f:
+        m = dict(l.split() for l in f if l.strip())
+    assert m["lookup_table"] == "custom"      # sparse SelectedRows grad
+    assert m["py_func"] == "custom"
+    assert m["conv2d"] == "default_vjp"
+    assert m["accuracy"] == "no_grad"

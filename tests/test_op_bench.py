@@ -1,0 +1,29 @@
+"""Per-op microbench harness (reference operators/benchmark/
+op_tester.cc): any registered op times standalone and reports
+steps/s + implied TFLOP/s."""
+import json
+
+from paddle_tpu.tools.op_bench import bench_op, main
+
+
+def test_bench_softmax_by_shape():
+    rec = bench_op("softmax", shape=[8, 16, 32], iters=3, warmup=1)
+    assert rec["op"] == "softmax"
+    assert rec["steps_per_sec"] > 0
+    assert rec["flops_per_step"] > 0
+    assert "implied_tflops" in rec
+
+
+def test_bench_matmul_explicit_inputs():
+    rec = bench_op("matmul", inputs={"X": [64, 64], "Y": [64, 64]},
+                   iters=3, warmup=1)
+    # 2*M*N*K = 524288 analytical flops
+    assert rec["flops_per_step"] >= 2 * 64 * 64 * 64
+    assert rec["steps_per_sec"] > 0
+
+
+def test_cli_prints_json(capsys):
+    main(["--op", "relu", "--shape", "16,16", "--iters", "2"])
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert rec["op"] == "relu"
+    assert rec["steps_per_sec"] > 0

@@ -1,0 +1,207 @@
+"""slim SUBSYSTEM end-to-end (round-2 verdict item 5): a config-file
+driven Compressor run composing distillation + pruning + QAT trains a
+small MNIST classifier through the strategy schedule; plus sensitivity
+pruning and the NAS controller-server/search-agent loop."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.scope import Scope
+from paddle_tpu.contrib.slim.core import Compressor
+from paddle_tpu.contrib.slim.prune import (StructuredPruner,
+                                           SensitivePruneStrategy)
+from paddle_tpu.contrib.slim.nas import (ControllerServer, SearchAgent,
+                                         SAController)
+
+
+_PROTOS = np.random.RandomState(42).normal(0, 1, (10, 64)).astype(
+    np.float32)
+
+
+def _mnist_data(n, seed=0):
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 10, size=(n, 1))
+    x = _PROTOS[y[:, 0]] + rng.normal(0, 0.35, (n, 64))
+    return x.astype(np.float32), y.astype(np.int64)
+
+
+def _classifier(width, prefix=""):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("img", [64], dtype="float32")
+        y = layers.data("label", [1], dtype="int64")
+        h = layers.fc(x, width, act="relu",
+                      param_attr=fluid.ParamAttr(name=prefix + "w0"))
+        logits = layers.fc(h, 10,
+                           param_attr=fluid.ParamAttr(
+                               name=prefix + "w1"))
+        sm = layers.softmax(logits)
+        loss = layers.mean(layers.cross_entropy(sm, y))
+        acc = layers.accuracy(sm, y)
+    return main, startup, loss, acc, logits
+
+
+def _reader(xs, ys, bs=64):
+    def r():
+        for i in range(0, len(xs), bs):
+            yield {"img": xs[i:i + bs], "label": ys[i:i + bs]}
+    return r
+
+
+CONFIG = """
+version: 1.0
+pruners:
+    pruner_1:
+        class: 'StructuredPruner'
+strategies:
+    distill_strategy:
+        class: 'DistillationStrategy'
+        distillers: ['soft_distiller']
+        start_epoch: 0
+        end_epoch: 2
+    prune_strategy:
+        class: 'UniformPruneStrategy'
+        pruner: 'pruner_1'
+        start_epoch: 2
+        target_ratio: 0.25
+        pruned_params: 'w0'
+        metric_name: 'acc'
+    quant_strategy:
+        class: 'QuantizationStrategy'
+        start_epoch: 3
+        weight_bits: 8
+        activation_bits: 8
+        int8_model_save_path: '{int8_dir}'
+distillers:
+    soft_distiller:
+        class: 'SoftLabelDistiller'
+        teacher_feature_map: '{teacher_logits}'
+        student_feature_map: '{student_logits}'
+        distillation_loss_weight: 1.0
+compressor:
+    epoch: 4
+    checkpoint_path: '{ckpt_dir}'
+    strategies:
+        - distill_strategy
+        - prune_strategy
+        - quant_strategy
+"""
+
+
+def test_config_driven_compress_pipeline(tmp_path):
+    xs, ys = _mnist_data(512, 0)
+    exs, eys = _mnist_data(256, 1)
+
+    # --- teacher: larger net trained normally -------------------------
+    fluid.framework.unique_name.reset()
+    scope = Scope()
+    t_main, t_start, t_loss, t_acc, t_logits = _classifier(
+        64, prefix="t_")
+    t_opt_prog = t_main.clone()
+    with fluid.program_guard(t_opt_prog, t_start):
+        loss_var = t_opt_prog.global_block().var(t_loss.name)
+        fluid.optimizer.AdamOptimizer(0.05).minimize(loss_var)
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(t_start)
+        for _ in range(30):
+            exe.run(t_opt_prog, feed={"img": xs, "label": ys},
+                    fetch_list=[t_loss.name])
+
+    # --- student forward graph ----------------------------------------
+    s_main, s_start, s_loss, s_acc, s_logits = _classifier(24)
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(s_start)
+
+    cfg = CONFIG.format(teacher_logits=t_logits.name,
+                        student_logits=s_logits.name,
+                        int8_dir=str(tmp_path / "int8"),
+                        ckpt_dir=str(tmp_path / "ckpt"))
+    cfg_path = tmp_path / "compress.yaml"
+    cfg_path.write_text(cfg)
+
+    comp = Compressor(
+        fluid.CPUPlace(), scope, s_main,
+        train_reader=_reader(xs, ys),
+        train_feed_list=["img", "label"],
+        train_fetch_list=[s_loss.name, s_acc.name],
+        eval_program=s_main.clone(for_test=True),
+        eval_reader=_reader(exs, eys, bs=256),
+        eval_feed_list=["img", "label"],
+        eval_fetch_list=[s_acc.name],
+        teacher_programs=[t_main.clone(for_test=True)],
+        train_optimizer=fluid.optimizer.AdamOptimizer(0.03),
+        distiller_optimizer=fluid.optimizer.AdamOptimizer(0.03),
+        log_period=1000)
+    comp.config(str(cfg_path))
+    assert comp.epoch == 4
+    assert len(comp.strategies) == 3
+    ctx = comp.run()
+
+    # distill+prune+quant composed: the student must still classify
+    accs = ctx.eval_results[s_acc.name]
+    assert accs[-1] > 0.7, accs
+    # pruning really pruned (w0 columns zeroed) and survived fine-tune
+    w0 = np.asarray(scope.find_var("w0").get_value())
+    col_zero = (np.abs(w0).sum(0) == 0).mean()
+    assert 0.2 <= col_zero <= 0.3, col_zero
+    # QAT rewrote the eval graph
+    q_ops = [op.type for op in ctx.eval_graph[0].global_block().ops]
+    assert any(t.startswith("fake_quantize") or
+               t.startswith("fake_") for t in q_ops), q_ops
+    # int8 export happened
+    assert (tmp_path / "int8").exists()
+    # compression checkpoint exists (resume state)
+    assert (tmp_path / "ckpt" / "compress.state").exists()
+
+
+def test_sensitivity_pruning_orders_ratios(tmp_path):
+    xs, ys = _mnist_data(256, 2)
+    fluid.framework.unique_name.reset()
+    scope = Scope()
+    main, startup, loss, acc, _ = _classifier(32)
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+    comp = Compressor(
+        fluid.CPUPlace(), scope, main,
+        train_reader=_reader(xs, ys),
+        train_feed_list=["img", "label"],
+        train_fetch_list=[loss.name, acc.name],
+        eval_program=main.clone(for_test=True),
+        eval_reader=_reader(xs, ys, bs=256),
+        eval_feed_list=["img", "label"],
+        eval_fetch_list=[acc.name],
+        train_optimizer=fluid.optimizer.AdamOptimizer(0.03),
+        epoch=2, log_period=1000)
+    strat = SensitivePruneStrategy(
+        pruner=StructuredPruner(scope=scope), start_epoch=1,
+        target_ratio=0.2, metric_name=acc.name,
+        pruned_params="w[01]", delta_rate=0.3)
+    comp.strategies = [strat]
+    comp.run()
+    assert set(strat.sensitivities) == {"w0", "w1"}
+    for losses in strat.sensitivities.values():
+        assert all(np.isfinite(v) for v in losses.values())
+    assert strat.pruned_list == ["w0", "w1"]
+
+
+def test_nas_controller_server_agent_roundtrip():
+    ctrl = SAController(range_table=[8, 8, 8], max_iter_number=50,
+                        seed=3)
+    server = ControllerServer(controller=ctrl, key="k")
+    server.start()
+    try:
+        agent = SearchAgent("127.0.0.1", server.port(), key="k")
+        # reward peaks at tokens == [6, 6, 6]
+        for _ in range(40):
+            tokens = agent.next_tokens()
+            reward = -sum((t - 6) ** 2 for t in tokens)
+            agent.update(tokens, reward)
+        assert ctrl.max_reward > -12, (ctrl.best_tokens,
+                                       ctrl.max_reward)
+    finally:
+        server.close()

@@ -145,7 +145,10 @@ def test_eager_fallback_warns():
             out = exe.run(main,
                           feed={"x": create_lod_tensor(ids, [[4]])},
                           fetch_list=[erased.name])
-        assert any("EAGER interpreter" in str(x.message) for x in w)
+        # since the island partitioner landed, a value-dependent op
+        # demotes only ITSELF to host dispatch, with a warning naming it
+        assert any("HOST between compiled XLA islands" in str(x.message)
+                   and "sequence_erase" in str(x.message) for x in w)
     arr = np.asarray(out[0].array if hasattr(out[0], "array")
                      else out[0])
     np.testing.assert_array_equal(arr.ravel(), [1, 2])

@@ -1,0 +1,46 @@
+"""Emit the gradient-source spec of every registered op.
+
+Parity: reference `op_use_default_grad_op_maker.spec` +
+tools/diff_use_default_grad_op_maker.py (SURVEY §4.10) — a committed
+record of which ops use the MECHANICAL default gradient versus a
+hand-written one, diffed in CI so nobody accidentally ships a default
+grad for an op whose reference gradient is hand-crafted (or silently
+drops a hand-written grad back to the default).
+
+Classes:
+  default_vjp — `<op>_grad` is the mechanical jax.vjp of the lowering
+  custom      — `<op>_grad` has a hand-written lowering
+  no_grad     — op registers no gradient (metrics, readers, ...)
+
+Usage: python tools/print_grad_spec.py > GRAD.spec
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def grad_spec_lines():
+    from paddle_tpu.core.registry import OPS
+    import paddle_tpu.ops  # noqa: F401 — trigger registrations
+    import paddle_tpu.parallel.pipeline  # noqa: F401
+
+    lines = []
+    for t in OPS.types():
+        info = OPS.get(t)
+        if info.is_grad_op or t.endswith("_grad"):
+            continue
+        gt = t + "_grad"
+        if not OPS.has(gt):
+            cls = "no_grad"
+        else:
+            glow = OPS.get(gt).lowering
+            fwd = getattr(glow, "_generic_vjp_of", None)
+            cls = "default_vjp" if fwd == t else "custom"
+        lines.append(f"{t} {cls}")
+    return lines
+
+
+if __name__ == "__main__":
+    print("\n".join(grad_spec_lines()))
