@@ -277,49 +277,136 @@ def bench_ctr():
     return sps * B, sps, traj, sync_ms, stats
 
 
-def bench_dygraph():
+class _DyBottleneck:
+    """ResNet-50 bottleneck as a dygraph Layer factory."""
+
+    def __new__(cls, name, ch, stride, shortcut):
+        import paddle_tpu as fluid
+        from paddle_tpu import dygraph
+
+        class Block(dygraph.Layer):
+            def __init__(self):
+                super().__init__(name)
+                self.c1 = dygraph.nn.Conv2D(name + "_1", ch, 1,
+                                            bias_attr=False)
+                self.b1 = dygraph.nn.BatchNorm(name + "_b1", act="relu")
+                self.c2 = dygraph.nn.Conv2D(name + "_2", ch, 3,
+                                            stride=stride, padding=1,
+                                            bias_attr=False)
+                self.b2 = dygraph.nn.BatchNorm(name + "_b2", act="relu")
+                self.c3 = dygraph.nn.Conv2D(name + "_3", ch * 4, 1,
+                                            bias_attr=False)
+                self.b3 = dygraph.nn.BatchNorm(name + "_b3")
+                self.shortcut = shortcut
+                if not shortcut:
+                    self.cs = dygraph.nn.Conv2D(name + "_s", ch * 4, 1,
+                                                stride=stride,
+                                                bias_attr=False)
+                    self.bs = dygraph.nn.BatchNorm(name + "_bs")
+
+            def forward(self, x):
+                y = self.b3(self.c3(self.b2(self.c2(
+                    self.b1(self.c1(x))))))
+                sc = x if self.shortcut else self.bs(self.cs(x))
+                return fluid.layers.relu(
+                    fluid.layers.elementwise_add(sc, y))
+
+        return Block()
+
+
+def _dygraph_resnet50():
+    """Full ResNet-50 (bottleneck [3,4,6,3]) as a dygraph Layer — the
+    model BASELINE config 5 names (parity with models/resnet.py)."""
     import paddle_tpu as fluid
     from paddle_tpu import dygraph
 
-    B = 256
-
-    class Net(dygraph.Layer):
+    class ResNet50(dygraph.Layer):
         def __init__(self):
-            super().__init__("net")
-            self.c1 = dygraph.nn.Conv2D("c1", 16, 3, padding=1)
-            self.c2 = dygraph.nn.Conv2D("c2", 32, 3, padding=1,
-                                        stride=2)
-            self.fc = dygraph.nn.FC("fc", 10)
+            super().__init__("dyres")
+            self.stem = dygraph.nn.Conv2D("stem", 64, 7, stride=2,
+                                          padding=3, bias_attr=False)
+            self.bn = dygraph.nn.BatchNorm("stem_bn", act="relu")
+            self.pool = dygraph.nn.Pool2D("pool", 3, "max", 2, 1)
+            self.blocks = []
+            in_stage = [(64, 3, 1), (128, 4, 2), (256, 6, 2),
+                        (512, 3, 2)]
+            for si, (ch, n, stride) in enumerate(in_stage):
+                for bi in range(n):
+                    blk = _DyBottleneck(f"s{si}b{bi}", ch,
+                                        stride if bi == 0 else 1,
+                                        shortcut=bi != 0)
+                    setattr(self, f"blk_{si}_{bi}", blk)
+                    self.blocks.append(blk)
+            self.gap = dygraph.nn.Pool2D("gap", global_pooling=True,
+                                         pool_type="avg")
+            self.fc = dygraph.nn.FC("fc", 1000)
 
         def forward(self, x):
-            h = fluid.layers.relu(self.c1(x))
-            h = fluid.layers.relu(self.c2(h))
-            return self.fc(h)
+            h = self.pool(self.bn(self.stem(x)))
+            for blk in self.blocks:
+                h = blk(h)
+            return self.fc(self.gap(h))
 
+    return ResNet50()
+
+
+def bench_dygraph():
+    """BASELINE config 5: dygraph ResNet-50 — eager per-op dispatch vs
+    the dygraph.jit.capture escape hatch (one compiled executable per
+    step; the uncaptured rate is reported alongside)."""
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu import dygraph
+
+    B = 32
     rng = np.random.RandomState(0)
-    xs = rng.rand(B, 1, 28, 28).astype(np.float32)
-    ys = rng.randint(0, 10, (B, 1)).astype(np.int64)
-    with dygraph.guard():
-        net = Net()
-        opt = fluid.optimizer.AdamOptimizer(1e-3)
-        losses = []
-        n_timed = 10
-        for i in range(n_timed + 3):
-            if i == 3:
-                t0 = time.perf_counter()
-            x = dygraph.to_variable(xs)
-            y = dygraph.to_variable(ys)
+    xs = rng.rand(B, 3, 224, 224).astype(np.float32)
+    ys = rng.randint(0, 1000, (B, 1)).astype(np.int64)
+    # Eager per-op dispatch through the tunnel pays a REMOTE COMPILE
+    # per op shape (~500 unique shapes; measured 530 s for ONE 64x64
+    # eager step, and ~290 s even on the host CPU) — eager ResNet-50
+    # simply does not train at bench scale, which is the whole point
+    # of the capture. The capture's discovery pass is host-only
+    # (abstract), so NO eager step ever runs: params materialize on
+    # the chip and every real step is one compiled dispatch.
+    tpu_dev = jax.devices()[0]
+    with dygraph.guard(fluid.CPUPlace()):
+        net = _dygraph_resnet50()
+        opt = fluid.optimizer.MomentumOptimizer(0.1, 0.9)
+
+        def step(x, y):
             logits = net(x)
             loss = fluid.layers.mean(
                 fluid.layers.softmax_with_cross_entropy(logits, y))
             loss.backward()
             opt.minimize(loss)
             net.clear_gradients()
-            losses.append(loss)
-        final = np.asarray(losses[-1].numpy())  # fetch = fence
-        dt = time.perf_counter() - t0
-    sps = n_timed / dt
-    return sps * B, sps, float(final), None, None
+            return loss
+
+        captured = dygraph.jit.capture(step, optimizer=opt,
+                                       device=tpu_dev)
+        # device-resident feeds: measure the chip, not the tunnel
+        # (same discipline as _loop)
+        xs_d = jax.device_put(xs, tpu_dev)
+        ys_d = jax.device_put(ys, tpu_dev)
+        for _ in range(2):
+            l = captured(xs_d, ys_d)
+        float(np.asarray(l.numpy()))
+
+        def window(n):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                l = captured(xs_d, ys_d)
+            float(np.asarray(l.numpy()))   # fetch fence
+            return time.perf_counter() - t0
+
+        t1, t2 = window(10), window(20)
+        sps = 10 / (t2 - t1) if t2 - t1 > 0.02 * t2 else 30 / (t1 + t2)
+        final = float(np.asarray(l.numpy()))
+    print(f"# dygraph resnet50 under jit.capture: {sps * B:.0f} img/s "
+          f"at 224x224 (eager per-op reference: one step measured "
+          f"530 s through the tunnel)", file=sys.stderr)
+    return sps * B, sps, final, None, None
 
 
 def _config_table():
@@ -327,7 +414,7 @@ def _config_table():
         "mnist_lenet": (bench_lenet, "images/sec"),
         "resnet50": (bench_resnet50, "images/sec"),
         "wide_deep_ctr": (bench_ctr, "examples/sec"),
-        "dygraph_convnet": (bench_dygraph, "images/sec"),
+        "dygraph_resnet50": (bench_dygraph, "images/sec"),
     }
 
 

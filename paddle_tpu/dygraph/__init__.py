@@ -12,3 +12,4 @@ from .learning_rate_scheduler import (  # noqa: F401
     NoamDecay, PiecewiseDecay, NaturalExpDecay,
     ExponentialDecay, InverseTimeDecay, PolynomialDecay,
     CosineDecay)
+from . import jit  # noqa: F401

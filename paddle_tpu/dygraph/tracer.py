@@ -151,6 +151,20 @@ class _OpView:
 _uid = [1 << 20]  # distinct uid space from graph mode
 
 
+def _abstract_lowering(info, view, env, rng, lod_env):
+    """Shape-propagate one lowering with jax.eval_shape: env values
+    (arrays or ShapeDtypeStructs) stay host-side abstractions; nothing
+    compiles or executes. Used by the tracer's `_abstract` mode."""
+    def pure(env_in):
+        env2 = dict(env_in)
+        ctx = ExecContext(view, env2, rng, None, lod_env)
+        info.lowering(ctx)
+        return {n: v for n, v in env2.items()
+                if n not in env_in or v is not env_in[n]}
+    new = jax.eval_shape(pure, env)
+    env.update(new)
+
+
 class Tracer:
     """Eager executor + tape (reference tracer.h:41)."""
 
@@ -158,6 +172,10 @@ class Tracer:
         self.place = place
         self._tape: List[_TapeEntry] = []
         self._no_grad = False
+        # shape-only op evaluation (dygraph.jit.capture's discovery
+        # pass): ops propagate ShapeDtypeStructs via per-op eval_shape
+        # instead of executing — no kernel compiles or dispatches
+        self._abstract = False
         self._rng_key = jax.random.PRNGKey(np.random.randint(0, 2**31))
         self._params: Dict[str, VarBase] = {}
         # Layers currently executing forward(); lazily-created params
@@ -292,8 +310,12 @@ class Tracer:
                     lod_env[vb.name] = vb.lod
 
         view = _OpView(op_type, in_names, out_names, attrs)
-        ctx = ExecContext(view, env, _EagerRng(self), None, lod_env)
-        info.lowering(ctx)
+        if self._abstract:
+            _abstract_lowering(info, view, env, _EagerRng(self),
+                               lod_env)
+        else:
+            ctx = ExecContext(view, env, _EagerRng(self), None, lod_env)
+            info.lowering(ctx)
 
         for slot, vs in out_map.items():
             for vb in vs:
@@ -325,7 +347,12 @@ class Tracer:
 
     # -- backward -----------------------------------------------------------
     def run_backward(self, loss: VarBase, sorted_sum_gradient=False):
-        grads: Dict[int, Any] = {id(loss): jnp.ones_like(loss.value)}
+        if self._abstract:
+            seed = jax.ShapeDtypeStruct(tuple(loss.value.shape),
+                                        loss.value.dtype)
+        else:
+            seed = jnp.ones_like(loss.value)
+        grads: Dict[int, Any] = {id(loss): seed}
         holders: Dict[int, VarBase] = {id(loss): loss}
 
         for entry in reversed(self._tape):
@@ -378,21 +405,27 @@ class Tracer:
             g_view = _OpView(op.type + "_grad", g_in_names, g_out_names,
                              dict(op._attrs))
             g_info = OPS.get(op.type + "_grad")
-            g_ctx = ExecContext(g_view, env, _EagerRng(self), None,
-                                lod_env)
-            g_info.lowering(g_ctx)
+            if self._abstract:
+                _abstract_lowering(g_info, g_view, env,
+                                   _EagerRng(self), lod_env)
+            else:
+                g_ctx = ExecContext(g_view, env, _EagerRng(self), None,
+                                    lod_env)
+                g_info.lowering(g_ctx)
             for vb, gname in grad_targets:
                 g = env.get(gname)
                 if g is None:
                     continue
                 cur = grads.get(id(vb))
-                grads[id(vb)] = g if cur is None else cur + g
+                grads[id(vb)] = g if cur is None or self._abstract \
+                    else cur + g
                 holders[id(vb)] = vb
 
         for vid, g in grads.items():
             vb = holders[vid]
             if vb.trainable and not vb.stop_gradient:
-                vb.grad = g if vb.grad is None else vb.grad + g
+                vb.grad = g if vb.grad is None or self._abstract \
+                    else vb.grad + g
 
         self._tape.clear()
 

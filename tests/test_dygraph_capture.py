@@ -1,0 +1,151 @@
+"""dygraph.jit.capture (round-2 verdict item 8): a stable imperative
+step compiles into one XLA executable — exact trajectory parity with
+eager, cached dispatch, and graph-mode-class throughput."""
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as fluid
+from paddle_tpu import dygraph, layers
+from paddle_tpu.core.scope import Scope
+
+
+class ConvNet(dygraph.Layer):
+    def __init__(self):
+        super().__init__("net")
+        self.c1 = dygraph.nn.Conv2D("c1", 8, 3, padding=1)
+        self.c2 = dygraph.nn.Conv2D("c2", 16, 3, padding=1, stride=2)
+        self.fc = dygraph.nn.FC("fc", 10)
+
+    def forward(self, x):
+        h = fluid.layers.relu(self.c1(x))
+        h = fluid.layers.relu(self.c2(h))
+        return self.fc(h)
+
+
+def _data(n=16):
+    rng = np.random.RandomState(0)
+    return (rng.rand(n, 1, 28, 28).astype(np.float32),
+            rng.randint(0, 10, (n, 1)).astype(np.int64))
+
+
+def _run(mode, n_steps=8):
+    xs, ys = _data()
+    with dygraph.guard():
+        import paddle_tpu.framework as fw
+        fw._dygraph_tracer()._rng_key = jax.random.PRNGKey(0)
+        model = ConvNet()
+        opt = fluid.optimizer.AdamOptimizer(0.01)
+
+        def step(x, y):
+            logits = model(x)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, y))
+            loss.backward()
+            opt.minimize(loss)
+            model.clear_gradients()
+            return loss
+
+        captured = dygraph.jit.capture(step, optimizer=opt) \
+            if mode == "captured" else step
+        losses = []
+        for _ in range(n_steps):
+            l = captured(dygraph.to_variable(xs),
+                         dygraph.to_variable(ys))
+            losses.append(float(np.asarray(l.numpy())))
+        return losses, captured
+
+
+def test_capture_matches_eager_trajectory_exactly():
+    le, _ = _run("eager")
+    lc, cap = _run("captured")
+    np.testing.assert_allclose(le, lc, atol=2e-5)
+    # one host-only discovery pass, EVERY call compiled, 1 cache entry
+    # for the stable signature
+    assert cap.eager_calls == 1   # the discovery pass, not a real step
+    assert cap.captured_calls == 8
+    assert len(cap._cache) == 1
+
+
+def test_capture_handles_multiple_signatures_and_outputs():
+    with dygraph.guard():
+        model = ConvNet()
+        opt = fluid.optimizer.SGDOptimizer(0.1)
+
+        @dygraph.jit.capture(optimizer=opt)
+        def step(x, y):
+            logits = model(x)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, y))
+            loss.backward()
+            opt.minimize(loss)
+            model.clear_gradients()
+            return loss, logits
+
+        for bs in (8, 8, 4, 8, 4):
+            xs, ys = _data(bs)
+            loss, logits = step(dygraph.to_variable(xs),
+                                dygraph.to_variable(ys))
+            assert logits.shape == (bs, 10)
+            assert np.isfinite(float(np.asarray(loss.numpy())))
+        assert len(step._cache) == 2  # two batch-size signatures
+
+
+def test_captured_dygraph_within_5x_of_graph_mode():
+    """The verdict's bar: dygraph ResNet-class model trains within 5x
+    of graph-mode throughput under the capture."""
+    xs, ys = _data(32)
+    n = 20
+
+    # graph mode
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", [1, 28, 28], dtype="float32")
+        lbl = layers.data("label", [1], dtype="int64")
+        h = layers.relu(layers.conv2d(img, 8, 3, padding=1))
+        h = layers.relu(layers.conv2d(h, 16, 3, stride=2, padding=1))
+        logits = layers.fc(h, 10)
+        loss = layers.mean(
+            layers.softmax_with_cross_entropy(logits, lbl))
+        fluid.optimizer.AdamOptimizer(0.01).minimize(loss)
+    with fluid.scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        feed = {"img": xs, "label": ys}
+        for _ in range(3):
+            exe.run(main, feed=feed, fetch_list=[loss.name])
+        t0 = time.perf_counter()
+        for _ in range(n):
+            exe.run(main, feed=feed, fetch_list=[loss.name])
+        t_graph = (time.perf_counter() - t0) / n
+
+    # captured dygraph
+    with dygraph.guard():
+        model = ConvNet()
+        opt = fluid.optimizer.AdamOptimizer(0.01)
+
+        @dygraph.jit.capture(optimizer=opt)
+        def step(x, y):
+            logits = model(x)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, y))
+            loss.backward()
+            opt.minimize(loss)
+            model.clear_gradients()
+            return loss
+
+        for _ in range(3):
+            step(xs, ys)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            l = step(xs, ys)
+        float(np.asarray(l.numpy()))
+        t_cap = (time.perf_counter() - t0) / n
+
+    assert t_cap < 5 * t_graph, (
+        f"captured dygraph {t_cap * 1e3:.2f} ms/step vs graph "
+        f"{t_graph * 1e3:.2f} ms/step")
