@@ -193,6 +193,60 @@ def _append_nan_checks(checks, op, env):
                 checks.append((op.type, n, jnp.all(jnp.isfinite(v))))
 
 
+def _slice_lod(lod, s0, s1):
+    """Slice sequences [s0, s1) out of a (possibly multi-level) LoD.
+    Returns (rebased_lod, row0, row1) where rows index the tensor's
+    leading dim (offsets partition the next level's entries, the last
+    level partitions rows — reference lod_tensor.h:58 semantics)."""
+    out = []
+    lo, hi = s0, s1
+    for level in lod:
+        seg = [int(x) for x in level[lo:hi + 1]]
+        base = seg[0]
+        out.append([x - base for x in seg])
+        lo, hi = seg[0], seg[-1]
+    return out, lo, hi
+
+
+def _lod_accum_slices(feed_sig, feed_lods, accum_k):
+    """Per-micro-batch feed slicing plan for ragged feeds: each entry
+    maps feed name -> (row0, row1, sliced_lod or None)."""
+    seq_counts = {n: len(lod[0]) - 1 for n, lod in feed_lods.items()
+                  if lod}
+    counts = set(seq_counts.values())
+    if len(counts) != 1:
+        raise EnforceNotMet(
+            f"gradient accumulation over ragged feeds requires every "
+            f"LoD feed to hold the same number of sequences; got "
+            f"{seq_counts}")
+    (n_seq,) = counts
+    if n_seq % accum_k != 0:
+        raise EnforceNotMet(
+            f"{n_seq} sequences are not divisible by "
+            f"gradient_accumulation_steps={accum_k}")
+    per = n_seq // accum_k
+    for n, sig in feed_sig.items():
+        if n not in feed_lods and (not sig.shape or
+                                   sig.shape[0] != n_seq):
+            raise EnforceNotMet(
+                f"dense feed {n!r} (shape {tuple(sig.shape)}) must "
+                f"have one row per sequence ({n_seq}) to combine with "
+                f"ragged feeds under gradient accumulation")
+    plans = []
+    for i in range(accum_k):
+        s0, s1 = i * per, (i + 1) * per
+        plan = {}
+        for n in feed_sig:
+            lod = feed_lods.get(n)
+            if lod:
+                sliced, r0, r1 = _slice_lod(lod, s0, s1)
+                plan[n] = (r0, r1, sliced)
+            else:
+                plan[n] = (s0, s1, None)
+        plans.append(plan)
+    return plans
+
+
 def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
                feed_lods: Dict[str, list], fetch_names: Sequence[str],
                scope: Scope, mesh=None, data_axis: str = "dp",
@@ -246,11 +300,15 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
     amp_cfg = getattr(program, "_amp", None)
     accum_k = int(getattr(program, "_gradient_accumulation_steps", 1)
                   or 1)
+    accum_slices = None
     if accum_k > 1 and feed_lods:
-        raise NotImplementedError(
-            "gradient accumulation slices feeds on the batch dim and "
-            "cannot split LoD (ragged) feeds")
-    if accum_k > 1:
+        # Ragged feeds split on SEQUENCE boundaries: LoD offsets are
+        # host metadata, static per trace, so each micro-batch slice is
+        # a static row range with rebased offsets (lifts the r2
+        # restriction; reference ir/multi_batch_merge_pass.cc has no
+        # LoD restriction either).
+        accum_slices = _lod_accum_slices(feed_sig, feed_lods, accum_k)
+    elif accum_k > 1:
         batch_dims = {n: (s.shape[0] if s.shape else None)
                       for n, s in feed_sig.items()}
         sizes = set(batch_dims.values())
@@ -300,9 +358,17 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
         for i in range(accum_k):
             env = _TrackingDict()
             env.update(params)
-            for n, arr in feeds.items():
-                sz = arr.shape[0] // accum_k  # validated above
-                env[n] = arr[i * sz:(i + 1) * sz]
+            lod_env_i = {}
+            if accum_slices is not None:
+                for n, arr in feeds.items():
+                    r0, r1, sliced = accum_slices[i][n]
+                    env[n] = arr[r0:r1]
+                    if sliced:
+                        lod_env_i[n] = [list(l) for l in sliced]
+            else:
+                for n, arr in feeds.items():
+                    sz = arr.shape[0] // accum_k  # validated above
+                    env[n] = arr[i * sz:(i + 1) * sz]
             rng_ctx = _Rng(jax.random.fold_in(key, i))
 
             def block_runner(idx, sub_env=None):
@@ -311,7 +377,6 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
                               rng_ctx, lod_env_i, block_runner)
                 return sub_env if sub_env is not None else env
 
-            lod_env_i = {}
             run_block_ops(block, env, rng_ctx, lod_env_i, block_runner,
                           ops=compute_ops)
             for n in grad_names:

@@ -158,3 +158,145 @@ class LocalSGD(Collective):
                 "assign", inputs={"X": [p.name]},
                 outputs={"Out": [snap]}, infer_shape=False)
         self.main_program._bump_version()
+
+
+class StaleSyncSGD(LocalSGD):
+    """Half-async pserver behavioral equivalent (round-2 verdict item
+    6): reference DistributeTranspiler sync_mode=False lets trainers
+    push grads / pull params WITHOUT barriers, so each trainer trains
+    on parameters up to ~k steps stale before the server state reaches
+    it. The SPMD analog: trainers run `avg_period` purely-LOCAL
+    optimizer steps between parameter-averaging rounds — in between,
+    every trainer's params drift exactly as stale pserver reads would,
+    and the periodic average is the "server state catches up" event
+    (this is LocalSGD with period k; period 1 degenerates to sync).
+
+    The gating counter advances identically on every rank, so the
+    collective schedule stays SPMD-uniform: the allreduce executes
+    every step (on a zero-masked delta during local steps — trading a
+    little ICI bandwidth for a single compiled program with no
+    data-dependent control flow).
+    """
+
+    COUNTER = "@LOCAL_STEP@"
+
+    def __init__(self, nrings=1, avg_period=4):
+        super().__init__(nrings)
+        self.avg_period = int(avg_period)
+
+    def _transpile_startup_program(self):
+        super()._transpile_startup_program()
+        block = self.startup_program.global_block()
+        main_block = self.main_program.global_block()
+        for b in (block, main_block):
+            b.create_var(name=self.COUNTER, shape=[1],
+                         dtype="float32", persistable=True)
+        block.append_op("fill_constant", inputs={},
+                        outputs={"Out": [self.COUNTER]},
+                        attrs={"shape": [1], "dtype": 5,
+                               "value": 0.0}, infer_shape=False)
+
+    def _transpile_main_program(self):
+        block = self.main_program.global_block()
+        k = float(self.avg_period)
+        cnt = self.COUNTER
+        block.append_op("increment", inputs={"X": [cnt]},
+                        outputs={"Out": [cnt]},
+                        attrs={"step": 1.0}, infer_shape=False)
+        kvar = block.create_var(name="@AVG_K@", shape=[1],
+                                dtype="float32")
+        block.append_op("fill_constant", inputs={},
+                        outputs={"Out": [kvar.name]},
+                        attrs={"shape": [1], "dtype": 5, "value": k},
+                        infer_shape=False)
+        mod = block.create_var(name="@STEP_MOD@", shape=[1],
+                               dtype="float32")
+        block.append_op("elementwise_mod",
+                        inputs={"X": [cnt], "Y": [kvar.name]},
+                        outputs={"Out": [mod.name]}, infer_shape=False)
+        zero = block.create_var(name="@AVG_ZERO@", shape=[1],
+                                dtype="float32")
+        block.append_op("fill_constant", inputs={},
+                        outputs={"Out": [zero.name]},
+                        attrs={"shape": [1], "dtype": 5, "value": 0.0},
+                        infer_shape=False)
+        is_avg = block.create_var(name="@IS_AVG@", shape=[1],
+                                  dtype="bool")
+        block.append_op("equal",
+                        inputs={"X": [mod.name], "Y": [zero.name]},
+                        outputs={"Out": [is_avg.name]},
+                        infer_shape=False)
+        gate = block.create_var(name="@AVG_GATE@", shape=[1],
+                                dtype="float32")
+        block.append_op("cast", inputs={"X": [is_avg.name]},
+                        outputs={"Out": [gate.name]},
+                        attrs={"in_dtype": 0, "out_dtype": 5},
+                        infer_shape=False)
+
+        for p in self.main_program.all_parameters():
+            snap = p.name + self.SNAPSHOT_SUFFIX
+            delta = block.create_var(
+                name=p.name + "@DELTA", shape=p.shape, dtype=p.dtype)
+            block.append_op(
+                "elementwise_sub", inputs={"X": [snap], "Y": [p.name]},
+                outputs={"Out": [delta.name]}, infer_shape=False)
+            # zero-mask the delta on local (non-averaging) steps so the
+            # uniform allreduce is a no-op between sync rounds
+            block.append_op(
+                "elementwise_mul",
+                inputs={"X": [delta.name], "Y": [gate.name]},
+                outputs={"Out": [delta.name]}, attrs={"axis": -1},
+                infer_shape=False)
+            block.append_op(
+                "c_allreduce_sum", inputs={"X": [delta.name]},
+                outputs={"Out": [delta.name]},
+                attrs={"ring_id": 0, "scale": 1.0 / self.nranks},
+                infer_shape=False)
+            # on avg steps: param <- snap - avg_delta; else keep local
+            synced = block.create_var(
+                name=p.name + "@SYNCED", shape=p.shape, dtype=p.dtype)
+            block.append_op(
+                "elementwise_sub",
+                inputs={"X": [snap], "Y": [delta.name]},
+                outputs={"Out": [synced.name]}, infer_shape=False)
+            inv = block.create_var(name=p.name + "@INVG", shape=[1],
+                                   dtype="float32")
+            block.append_op(
+                "scale", inputs={"X": [gate.name]},
+                outputs={"Out": [inv.name]},
+                attrs={"scale": -1.0, "bias": 1.0}, infer_shape=False)
+            keep = block.create_var(
+                name=p.name + "@KEEP", shape=p.shape, dtype=p.dtype)
+            block.append_op(
+                "elementwise_mul",
+                inputs={"X": [p.name], "Y": [inv.name]},
+                outputs={"Out": [keep.name]}, attrs={"axis": -1},
+                infer_shape=False)
+            gated = block.create_var(
+                name=p.name + "@GATED", shape=p.shape, dtype=p.dtype)
+            block.append_op(
+                "elementwise_mul",
+                inputs={"X": [synced.name], "Y": [gate.name]},
+                outputs={"Out": [gated.name]}, attrs={"axis": -1},
+                infer_shape=False)
+            block.append_op(
+                "elementwise_add",
+                inputs={"X": [gated.name], "Y": [keep.name]},
+                outputs={"Out": [p.name]}, attrs={"axis": -1},
+                infer_shape=False)
+            # the snapshot refreshes ONLY at sync rounds — it anchors
+            # the cumulative local drift the next average consumes
+            skeep = block.create_var(
+                name=p.name + "@SNAPKEEP", shape=p.shape,
+                dtype=p.dtype)
+            block.append_op(
+                "elementwise_mul",
+                inputs={"X": [snap], "Y": [inv.name]},
+                outputs={"Out": [skeep.name]}, attrs={"axis": -1},
+                infer_shape=False)
+            block.append_op(
+                "elementwise_add",
+                inputs={"X": [gated.name], "Y": [skeep.name]},
+                outputs={"Out": [snap]}, attrs={"axis": -1},
+                infer_shape=False)
+        self.main_program._bump_version()

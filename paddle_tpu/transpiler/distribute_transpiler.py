@@ -45,6 +45,9 @@ class DistributeTranspilerConfig:
     # TPU build extras
     collective_mode = "grad_allreduce"  # or "local_sgd"
     nrings = 1
+    # half-async staleness bound: local steps between averaging rounds
+    # when transpile(..., sync_mode=False)
+    stale_steps = 4
 
 
 class DistributeTranspiler:
@@ -86,8 +89,17 @@ class DistributeTranspiler:
                 stacklevel=2)
 
         mode = self.config.collective_mode
-        cls = LocalSGD if mode == "local_sgd" else GradAllReduce
-        t = cls(nrings=self.config.nrings)
+        if not sync_mode:
+            # half-async pserver (reference distribute_transpiler.py:375
+            # sync_mode=False): trainers see up-to-k-steps-stale params;
+            # behavioral equivalent = k local steps between averaging
+            # rounds (StaleSyncSGD docstring has the mapping)
+            from .collective import StaleSyncSGD
+            t = StaleSyncSGD(nrings=self.config.nrings,
+                             avg_period=self.config.stale_steps)
+        else:
+            cls = LocalSGD if mode == "local_sgd" else GradAllReduce
+            t = cls(nrings=self.config.nrings)
         ep = trainer_eps[trainer_id] if trainer_id < len(trainer_eps) \
             else current_endpoint
         t.transpile(startup_program=startup_program,
