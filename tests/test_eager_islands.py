@@ -93,9 +93,9 @@ def test_islands_compile_static_segments_and_warn_names_island():
 
 
 def test_islands_beat_per_op_dispatch_10x(monkeypatch):
-    # ~800-op static region: per-op dispatch cost scales with op count,
+    # ~1600-op static region: per-op dispatch cost scales with op count,
     # the islanded path dispatches ONE cached executable regardless
-    main, startup, out, dm = _build_program(n_fc=200)
+    main, startup, out, dm = _build_program(n_fc=400)
 
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
@@ -110,7 +110,7 @@ def test_islands_beat_per_op_dispatch_10x(monkeypatch):
         self.dynamic_idx = set(range(len(self.ops)))
 
     monkeypatch.setattr(isl.IslandRunner, "__init__", all_dynamic_init)
-    main2, startup2, out2, dm2 = _build_program(n_fc=200)
+    main2, startup2, out2, dm2 = _build_program(n_fc=400)
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
         t_eager, v_eager = _run_steps(main2, startup2,
