@@ -1,0 +1,44 @@
+#!/usr/bin/env python
+"""Diff two API spec files; exit non-zero on ANY surface change.
+
+Parity: reference tools/diff_api.py (CI gate over API.spec). Usage:
+
+    python tools/print_signatures.py > /tmp/API.now
+    python tools/diff_api.py API.spec /tmp/API.now
+
+Also works for GRAD.spec (tools/print_grad_spec.py). The same check
+runs in-suite (tests/test_api_spec.py, tests/test_grad_spec.py); this
+CLI is the standalone CI form.
+"""
+import difflib
+import sys
+
+
+def main(argv):
+    if len(argv) != 3:
+        print(__doc__)
+        return 2
+    with open(argv[1]) as f:
+        origin = f.read().splitlines()
+    with open(argv[2]) as f:
+        new = f.read().splitlines()
+
+    error = False
+    print("API Difference is: ")
+    for each_diff in difflib.Differ().compare(origin, new):
+        if each_diff[0] in ("-", "?", "+"):
+            error = True
+        if each_diff[0] != " ":
+            print(each_diff)
+    if error:
+        print("\nThe public surface changed. If intentional, "
+              "regenerate the committed spec:\n"
+              "  python tools/print_signatures.py > API.spec\n"
+              "  python tools/print_grad_spec.py  > GRAD.spec")
+        return 1
+    print("(no difference)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
