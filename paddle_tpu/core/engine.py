@@ -164,10 +164,14 @@ def run_block_ops(block, env, rng_ctx, lod_env, block_runner, ops=None):
             info = OPS.get(op.type)
             ctx = ExecContext(op, env, rng_ctx, block_runner, lod_env)
             info.lowering(ctx)
-        except NotImplementedError as exc:
+        except (NotImplementedError, jax.errors.JAXTypeError) as exc:
             # handled by the island partitioner; overwrite so the
             # OUTERMOST frame's index wins (a dynamic op inside a
-            # control-flow sub-block demotes the whole control-flow op)
+            # control-flow sub-block demotes the whole control-flow op).
+            # JAXTypeError covers lowerings that CONCRETIZE tracer
+            # values (np.asarray on data-dependent results, e.g. the
+            # `where` index op) — same host-op treatment as an explicit
+            # NotImplementedError
             exc._island_op_index = i
             raise
         except EnforceNotMet:
@@ -469,7 +473,7 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
             raise NotImplementedError(
                 f"persistable {n!r} holds a host-side state object")
         jax.eval_shape(step, params_sig, feed_sig, key_sig)
-    except NotImplementedError as reason:
+    except (NotImplementedError, jax.errors.JAXTypeError) as reason:
         # Block contains value-dependent-shape ops (edit_distance,
         # sequence_erase, save, ...) or host-state persistables: compile
         # maximal static segments as XLA islands and interpret only the

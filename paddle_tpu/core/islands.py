@@ -193,7 +193,8 @@ class IslandRunner:
             jf = jax.jit(f)
             try:
                 outs, flags = jf(ins, key)
-            except NotImplementedError as exc:
+            except (NotImplementedError,
+                    jax.errors.JAXTypeError) as exc:
                 off = getattr(exc, "_island_op_index", None)
                 if off is None:
                     raise

@@ -157,3 +157,38 @@ def test_islands_partition_converges_and_caches():
     assert set(r.dynamic_idx) == set(ed_idx)
     for seg in r._segments.values():
         assert len(seg.cache) == 1
+
+
+def test_concretizing_op_becomes_island():
+    """Ops whose lowerings concretize tracer values (the data-dependent
+    `where` index op uses np.nonzero) must become host islands instead
+    of crashing the trace with TracerArrayConversionError."""
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [6], dtype="float32")
+        h = layers.fc(x, 6, act="relu")
+        s = layers.reduce_sum(h)
+        b = main.global_block()
+        b.create_var(name="cond", shape=[4], dtype="bool")
+        b.create_var(name="idx", shape=[-1, 1], dtype="int64")
+        b.append_op(type="where", inputs={"Condition": ["cond"]},
+                    outputs={"Out": ["idx"]}, attrs={},
+                    infer_shape=False)
+    feed = {"x": np.random.RandomState(0).rand(4, 6).astype(np.float32),
+            "cond": np.array([True, False, True, True])}
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            for _ in range(2):
+                sv, idx = exe.run(main, feed=feed,
+                                  fetch_list=[s.name, "idx"])
+    msgs = [str(w.message) for w in rec
+            if "HOST between compiled XLA islands" in str(w.message)]
+    assert len(msgs) == 1 and "'where'" in msgs[0], msgs
+    np.testing.assert_array_equal(
+        np.asarray(idx).ravel(), [0, 2, 3])
+    assert np.isfinite(float(np.asarray(sv)))
