@@ -654,6 +654,42 @@ class Engine:
                     + tuple(a.shape[1:]))
         return tuple(a.shape)
 
+    @staticmethod
+    def _verify_uniform_lods(lods):
+        """Every process must hold identical feed offsets: allgather a
+        cheap fingerprint and compare (a mismatch would otherwise
+        desynchronize program caches and hang the cluster)."""
+        import hashlib
+        from jax.experimental import multihost_utils
+        blob = repr(sorted((n, tuple(map(tuple, l)))
+                           for n, l in lods.items())).encode()
+        h = np.frombuffer(hashlib.sha256(blob).digest()[:8],
+                          np.uint64).astype(np.float64)
+        gathered = np.asarray(
+            multihost_utils.process_allgather(h))
+        if not (gathered == gathered[0]).all():
+            raise EnforceNotMet(
+                "multihost ragged feeds require every process to feed "
+                "the SAME LoD signature (use length bucketing); "
+                "fingerprints differ across processes")
+
+    @staticmethod
+    def _replicate_lod(lod):
+        """Global offsets of nproc same-signature ragged shards
+        concatenated on the row dim: each level is the per-process
+        offsets repeated with a cumulative shift (the next level's
+        entry count per process)."""
+        nproc = jax.process_count()
+        out = []
+        for level in lod:
+            level = [int(x) for x in level]
+            span = level[-1]
+            g = [0]
+            for p in range(nproc):
+                g.extend(x + p * span for x in level[1:])
+            out.append(g)
+        return out
+
     def _global_sig_key(self, arrays, lods):
         return tuple(
             (n, self._global_shape(n, arrays[n]),
@@ -739,9 +775,15 @@ class Engine:
         multihost = self._is_multihost()
         if multihost:
             if lods:
-                raise NotImplementedError(
-                    "multihost SPMD cannot assemble LoD (ragged) feeds "
-                    "across processes; pad to dense first")
+                # Ragged feeds are supported when every process's batch
+                # has the SAME LoD signature (what length-bucketing
+                # produces): the single global program then sees the
+                # k-fold replicated offsets, and row blocks concatenate
+                # uniformly. Divergent per-process lods would need
+                # per-process programs — SPMD cannot express that.
+                self._verify_uniform_lods(lods)
+                lods = {n: self._replicate_lod(lod)
+                        for n, lod in lods.items()}
             feed_sig_key = self._global_sig_key(arrays, lods)
             arrays = self._globalize(arrays)
         key = self._cache_key(program, block_idx, feed_sig_key,
