@@ -49,24 +49,6 @@ def _feed():
             "ref": create_lod_tensor(ids, [[2, 2]])}
 
 
-def _run_steps(main, startup, fetches, n, warm=3, repeats=3):
-    """min-of-repeats per-step time (robust to machine load)."""
-    feed = _feed()
-    scope = Scope()
-    best = float("inf")
-    with fluid.scope_guard(scope):
-        exe = fluid.Executor(fluid.CPUPlace())
-        exe.run(startup)
-        for _ in range(warm):
-            vals = exe.run(main, feed=feed, fetch_list=fetches)
-        for _ in range(repeats):
-            t0 = time.perf_counter()
-            for _ in range(n):
-                vals = exe.run(main, feed=feed, fetch_list=fetches)
-            best = min(best, (time.perf_counter() - t0) / n)
-    return best, vals
-
-
 def test_islands_compile_static_segments_and_warn_names_island():
     main, startup, out, dm = _build_program()
     n_ops = len(main.global_block().ops)
