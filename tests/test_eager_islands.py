@@ -207,3 +207,55 @@ def test_concretizing_op_becomes_island():
     np.testing.assert_array_equal(
         np.asarray(idx).ravel(), [0, 2, 3])
     assert np.isfinite(float(np.asarray(sv)))
+
+
+def test_dynamic_op_inside_control_flow_demotes_whole_op():
+    """A dynamic op nested in a control-flow sub-block demotes the
+    WHOLE control-flow op to a host island (the outermost op index
+    wins), and the host execution runs the sub-block eagerly."""
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [8], dtype="float32")
+        h = layers.fc(x, 8, act="relu")
+        hm = layers.mean(h)
+        b = main.global_block()
+        for n, s, d in (("hyp", [4, 1], "int64"),
+                        ("ref", [4, 1], "int64"),
+                        ("dist", [2, 1], "float32"),
+                        ("seqn", [1], "int64"),
+                        ("flag", [1], "bool")):
+            b.create_var(name=n, shape=s, dtype=d)
+        sub = main._create_block()
+        sub.append_op(type="edit_distance",
+                      inputs={"Hyps": ["hyp"], "Refs": ["ref"]},
+                      outputs={"Out": ["dist"], "SequenceNum": ["seqn"]},
+                      attrs={}, infer_shape=False)
+        main._rollback()
+        b.append_op(type="conditional_block",
+                    inputs={"Cond": ["flag"]},
+                    outputs={}, attrs={"sub_block": sub},
+                    infer_shape=False)
+        after = layers.mean(layers.scale(h, scale=2.0))
+    ids = np.array([[1], [2], [3], [4]], np.int64)
+    feed = {"x": np.random.RandomState(0).rand(4, 8).astype(np.float32),
+            "hyp": create_lod_tensor(ids, [[2, 2]]),
+            "ref": create_lod_tensor(ids, [[2, 2]]),
+            "flag": np.array([True])}
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            vals = exe.run(main, feed=feed,
+                           fetch_list=[hm.name, "dist", after.name])
+    msgs = [str(w.message) for w in rec
+            if "HOST between compiled XLA islands" in str(w.message)]
+    assert len(msgs) == 1 and "conditional_block" in msgs[0], msgs
+    # sub-block really executed on host (cond True)
+    np.testing.assert_allclose(np.asarray(vals[1]), np.zeros((2, 1)))
+    # compiled segments on either side produced consistent values
+    np.testing.assert_allclose(float(np.asarray(vals[2])),
+                               2 * float(np.asarray(vals[0])),
+                               rtol=1e-6)

@@ -205,3 +205,43 @@ def test_nas_controller_server_agent_roundtrip():
                                        ctrl.max_reward)
     finally:
         server.close()
+
+
+def test_light_nas_strategy_through_compressor():
+    """LightNASStrategy drives the SA search from the compression loop:
+    per epoch it asks the controller server for tokens, scores the
+    candidate via the search space, and reports the reward; best
+    tokens land in the context blackboard."""
+    from paddle_tpu.contrib.slim.nas import (LightNASStrategy,
+                                             SearchSpaceBase)
+
+    class ToySpace(SearchSpaceBase):
+        """Reward peaks at tokens == [5, 5]."""
+
+        def range_table(self):
+            return [8, 8]
+
+        def init_tokens(self):
+            return [0, 0]
+
+        def eval_tokens(self, tokens, context):
+            return -sum((t - 5) ** 2 for t in tokens)
+
+    fluid.framework.unique_name.reset()
+    scope = Scope()
+    main, startup, loss, acc, _ = _classifier(8)
+    with fluid.scope_guard(scope):
+        fluid.Executor(fluid.CPUPlace()).run(startup)
+    comp = Compressor(
+        fluid.CPUPlace(), scope, main,
+        train_feed_list=["img", "label"],
+        train_fetch_list=[loss.name, acc.name],
+        epoch=25, log_period=1000)
+    strat = LightNASStrategy(end_epoch=25, search_steps=200)
+    comp.strategies = [strat]
+    comp.context.put("search_space", ToySpace())
+    ctx = comp.run()
+    best = ctx.get("nas_best_tokens")
+    assert best is not None
+    assert ctx.get("nas_best_reward") > -20, (
+        best, ctx.get("nas_best_reward"))
