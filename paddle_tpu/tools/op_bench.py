@@ -67,7 +67,7 @@ def _rand(shape, dtype, rng):
 
 
 _IN_CANDIDATES = (("X",), ("Input",), ("X", "Y"))
-_OUT_CANDIDATES = ("Out", "Output")
+_OUT_CANDIDATES = ("Out", "Output", "Y", "Loss")
 
 
 def bench_op(op_type, inputs=None, shape=None, attrs=None,
@@ -158,6 +158,9 @@ def bench_op(op_type, inputs=None, shape=None, attrs=None,
                                    ["bench_out"])
 
     flops = float(stats["flops"]) if stats else 0.0
+    if flops < 0:
+        # XLA reports unknown costs (e.g. Pallas custom calls) as -1/-2
+        flops = 0.0
     tflops = flops * sps / 1e12
     kind = getattr(jax.devices()[0], "device_kind", "cpu")
     sys.path.insert(0, ".")
