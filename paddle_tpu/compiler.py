@@ -189,11 +189,11 @@ class CompiledProgram:
                     or 1) if self._exec_strategy is not None else 1
         if not self._is_data_parallel:
             feed = executor._canonical_feed(feed, self._program)
-            for _ in range(iters):
-                out = executor._engine.run(
-                    self._program, scope, executor.place, feed, fetch_names,
-                    return_numpy=return_numpy)
-            return out
+            # K iterations compile into ONE lax.scan executable on the
+            # jit path (host-looped on the eager/islands fallbacks)
+            return executor._engine.run(
+                self._program, scope, executor.place, feed, fetch_names,
+                return_numpy=return_numpy, iterations=iters)
         if self._dp_engine is None:
             places = self._places
             if places is None and executor.place is not None:

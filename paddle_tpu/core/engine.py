@@ -251,10 +251,34 @@ def _lod_accum_slices(feed_sig, feed_lods, accum_k):
     return plans
 
 
+def _loop_fallback(fn, iterations):
+    """num_iteration_per_run on the eager/islands paths: host loop with
+    state chained through the updated-persistables dict."""
+    if iterations <= 1:
+        return fn
+
+    def looped(donated_params, const_params, feeds, key):
+        donated = dict(donated_params)
+        const = dict(const_params)
+        merged_upd = {}
+        for i in range(iterations):
+            f, upd, nf = fn(donated, const, feeds,
+                            jax.random.fold_in(key, i))
+            merged_upd.update(upd)
+            for n, v in upd.items():
+                if n in donated:
+                    donated[n] = v
+                elif n in const:
+                    const[n] = v
+        return f, merged_upd, nf
+
+    return looped
+
+
 def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
                feed_lods: Dict[str, list], fetch_names: Sequence[str],
                scope: Scope, mesh=None, data_axis: str = "dp",
-               strategy=None) -> TracedStep:
+               strategy=None, iterations: int = 1) -> TracedStep:
     """Build + jit the step function for one (program, feed-sig) pair.
 
     With `mesh`, the step is compiled SPMD: feeds sharded on their batch
@@ -495,7 +519,8 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
                 params.update(donated_params)
                 return step(params, feeds, key)
 
-            return TracedStep(eager_fn, [], avail, sorted(feed_sig),
+            return TracedStep(_loop_fallback(eager_fn, iterations),
+                              [], avail, sorted(feed_sig),
                               list(fetch_names), [], fetch_lod_box,
                               True, nan_check_labels=nan_labels_box)
 
@@ -526,7 +551,8 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
             params.update(donated_params)
             return runner.step(params, feeds, key)
 
-        return TracedStep(islands_fn, [], avail, sorted(feed_sig),
+        return TracedStep(_loop_fallback(islands_fn, iterations),
+                          [], avail, sorted(feed_sig),
                           list(fetch_names), [], fetch_lod_box, True,
                           nan_check_labels=nan_labels_box)
     updated_names = list(updated_box)
@@ -534,10 +560,40 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
     const = [n for n in avail if n not in updated_names]
 
     # --- phase 2: jit with donation of updated persistables ---------------
-    def step2(donated_params, const_params, feeds, key):
+    def step1(donated_params, const_params, feeds, key):
         params = dict(const_params)
         params.update(donated_params)
         return step(params, feeds, key)
+
+    if iterations > 1:
+        # ExecutionStrategy.num_iteration_per_run, TPU-native: K chained
+        # steps compile into ONE executable (lax.scan over the donated
+        # state), amortizing the per-dispatch host/tunnel cost — the
+        # reference's knob exists for exactly this amortization in its
+        # threaded executor. Fetches come from the LAST iteration.
+        donated_set = set(donated)
+
+        def step2(donated_params, const_params, feeds, key):
+            def body(carry, i):
+                f, upd, nf = step1(carry, const_params, feeds,
+                                   jax.random.fold_in(key, i))
+                carry2 = {n: upd.get(n, carry[n]) for n in carry}
+                extra = {n: v for n, v in upd.items()
+                         if n not in donated_set}
+                return carry2, (f, extra, nf)
+
+            carry, (fs, extras, nfs) = jax.lax.scan(
+                body, dict(donated_params),
+                jnp.arange(iterations))
+            fetches = tuple(jax.tree_util.tree_map(lambda x: x[-1], f)
+                            for f in fs)
+            upd_out = {n: carry[n] for n in updated_names
+                       if n in carry}
+            upd_out.update({n: v[-1] for n, v in extras.items()})
+            nan_flags = jax.tree_util.tree_map(lambda x: x[-1], nfs)
+            return fetches, upd_out, nan_flags
+    else:
+        step2 = step1
 
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -705,14 +761,16 @@ class Engine:
                 for n, a in params.items()}
 
     @staticmethod
-    def _cache_key(program, block_idx, feed_sig_key, fetch_names):
+    def _cache_key(program, block_idx, feed_sig_key, fetch_names,
+                   iterations=1):
         return (program.fingerprint, block_idx, feed_sig_key,
                 tuple(fetch_names), bool(FLAGS.check_nan_inf),
                 int(getattr(program, "_gradient_accumulation_steps", 1)
-                    or 1))
+                    or 1), int(iterations))
 
     def compiled_stats(self, program, scope: Scope, feed, fetch_names,
-                       block_idx: int = 0) -> Optional[Dict[str, float]]:
+                       block_idx: int = 0,
+                       iterations: int = 1) -> Optional[Dict[str, float]]:
         """XLA analytical cost of the already-compiled step: flops,
         bytes accessed, and temp (scratch) memory per step. Returns None
         on the eager-interpreter fallback (nothing is compiled there).
@@ -724,7 +782,7 @@ class Engine:
         if self._is_multihost():
             feed_sig_key = self._global_sig_key(arrays, lods)
         key = self._cache_key(program, block_idx, feed_sig_key,
-                              fetch_names)
+                              fetch_names, iterations)
         traced = self._cache.get(key)
         if traced is None:
             if self._cache:
@@ -756,8 +814,12 @@ class Engine:
         ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0] if ca else {}
-        out = {"flops": float(ca.get("flops", 0.0)),
-               "bytes_accessed": float(ca.get("bytes accessed", 0.0))}
+        # normalize to PER-STEP costs when the executable scans K
+        # iterations (num_iteration_per_run)
+        k = max(int(iterations), 1)
+        out = {"flops": float(ca.get("flops", 0.0)) / k,
+               "bytes_accessed":
+                   float(ca.get("bytes accessed", 0.0)) / k}
         try:
             ma = compiled.memory_analysis()
             out["temp_bytes"] = float(ma.temp_size_in_bytes)
@@ -769,7 +831,8 @@ class Engine:
 
     def run(self, program, scope: Scope, place, feed, fetch_names,
             block_idx: int = 0,
-            return_numpy: bool = True) -> List[Any]:
+            return_numpy: bool = True,
+            iterations: int = 1) -> List[Any]:
         arrays, lods, feed_sig_key = self._normalize_feed(
             feed, None if self.mesh is not None else place)
         multihost = self._is_multihost()
@@ -786,8 +849,13 @@ class Engine:
                         for n, lod in lods.items()}
             feed_sig_key = self._global_sig_key(arrays, lods)
             arrays = self._globalize(arrays)
+        iterations = int(iterations or 1)
+        if iterations > 1 and lods:
+            raise NotImplementedError(
+                "num_iteration_per_run > 1 cannot scan over LoD "
+                "(ragged) feeds; pad to dense first")
         key = self._cache_key(program, block_idx, feed_sig_key,
-                              fetch_names)
+                              fetch_names, iterations)
         traced = self._cache.get(key)
         if traced is None:
             feed_sig = {n: jax.ShapeDtypeStruct(a.shape, a.dtype)
@@ -795,7 +863,8 @@ class Engine:
             traced = trace_step(program, block_idx, feed_sig, lods,
                                 fetch_names, scope, mesh=self.mesh,
                                 data_axis=self.data_axis,
-                                strategy=self.strategy)
+                                strategy=self.strategy,
+                                iterations=iterations)
             self._cache[key] = traced
 
         donated_params = {}

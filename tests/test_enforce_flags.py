@@ -239,3 +239,59 @@ def test_num_iteration_per_run_executes_k_steps():
         w_ref = np.array(scope2.find_var(
             main2.all_parameters()[0].name).get_value())
     np.testing.assert_allclose(w3, w_ref, rtol=2e-5, atol=2e-6)
+
+
+def test_num_iteration_per_run_on_island_fallback():
+    """iterations>1 on the islands/eager fallback path host-loops with
+    state chained (the jit path lax.scans instead)."""
+    from paddle_tpu.core.scope import create_lod_tensor
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        pred = layers.fc(x, 1, param_attr=fluid.ParamAttr(name="wit"))
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        b = main.global_block()
+        for n, s, d in (("hyp", [4, 1], "int64"),
+                        ("ref", [4, 1], "int64"),
+                        ("dist", [2, 1], "float32"),
+                        ("seqn", [1], "int64")):
+            b.create_var(name=n, shape=s, dtype=d)
+        b.append_op(type="edit_distance",
+                    inputs={"Hyps": ["hyp"], "Refs": ["ref"]},
+                    outputs={"Out": ["dist"], "SequenceNum": ["seqn"]},
+                    attrs={}, infer_shape=False)
+    es = fluid.ExecutionStrategy()
+    es.num_iteration_per_run = 3
+    cp = fluid.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name, exec_strategy=es)
+    ids = np.array([[1], [2], [3], [4]], np.int64)
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(8, 4).astype(np.float32),
+            "y": rng.rand(8, 1).astype(np.float32),
+            "hyp": create_lod_tensor(ids, [[2, 2]]),
+            "ref": create_lod_tensor(ids, [[2, 2]])}
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        w0 = np.array(scope.find_var("wit").get_value())
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            exe.run(cp, feed=feed, fetch_list=[loss.name])
+        w3 = np.array(scope.find_var("wit").get_value())
+
+    # manual 3 plain steps from identical init
+    scope2 = Scope()
+    with fluid.scope_guard(scope2):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        scope2.var("wit").set_value(w0)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for _ in range(3):
+                exe.run(main, feed=feed, fetch_list=[loss.name])
+        w_ref = np.array(scope2.find_var("wit").get_value())
+    np.testing.assert_allclose(w3, w_ref, rtol=1e-5, atol=1e-6)
