@@ -249,8 +249,8 @@ def bench_resnet50():
         exe.run(startup)
         eng = Engine()
         sps, traj, sync_ms = _loop(eng, main_prog, scope, batch,
-                                   [cost.name], 20)
-        stats = eng.compiled_stats(main_prog, scope, batch, [cost.name])
+                                   [cost.name], 20, iterations=4)
+        stats = eng.compiled_stats(main_prog, scope, batch, [cost.name], iterations=4)
     return sps * B, sps, traj, sync_ms, stats
 
 

@@ -814,12 +814,12 @@ class Engine:
         ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0] if ca else {}
-        # normalize to PER-STEP costs when the executable scans K
-        # iterations (num_iteration_per_run)
-        k = max(int(iterations), 1)
-        out = {"flops": float(ca.get("flops", 0.0)) / k,
+        # XLA cost_analysis counts a while/scan body ONCE (trip counts
+        # are not multiplied in), so a num_iteration_per_run executable
+        # already reports ~per-step costs — no normalization needed
+        out = {"flops": float(ca.get("flops", 0.0)),
                "bytes_accessed":
-                   float(ca.get("bytes accessed", 0.0)) / k}
+                   float(ca.get("bytes accessed", 0.0))}
         try:
             ma = compiled.memory_analysis()
             out["temp_bytes"] = float(ma.temp_size_in_bytes)
