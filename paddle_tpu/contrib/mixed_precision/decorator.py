@@ -41,7 +41,8 @@ class OptimizerWithMixedPrecision:
                  no_grad_set=None, callbacks=None):
         program = loss.block.program
         program._amp = {"dtype": self._dtype,
-                        "black_ops": frozenset(self._amp_lists.black_list)}
+                        "black_ops": frozenset(self._amp_lists.black_list),
+                        "white_ops": frozenset(self._amp_lists.white_list)}
         program._bump_version()
         scale = self._loss_scaling
         if scale != 1.0:

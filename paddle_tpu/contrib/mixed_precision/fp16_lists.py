@@ -1,25 +1,20 @@
 """Op lists controlling which ops compute in reduced precision.
 
 Parity: reference contrib/mixed_precision/fp16_lists.py (white/black/gray
-lists). On TPU only MXU ops benefit from reduced precision and XLA fuses
-the casts, so the white list is exactly the matmul/conv family; black_list
-entries are honored by skipping the amp cast for that op type.
+lists). The default policy lives in core/amp.py (WHITE/GRAY/BLACK/NORM
+sets) and is applied centrally at trace time by ExecContext; this module
+is the user-facing configuration surface — custom white/black entries are
+merged into the active policy via the decorator.
 """
 from __future__ import annotations
 
-white_list = {"conv2d", "matmul", "mul"}
+from ...core import amp as _amp
 
-black_list = {
-    "exp", "square", "log", "mean", "sum", "cos_sim",
-    "softmax", "softmax_with_cross_entropy", "sigmoid_cross_entropy_with_logits",
-    "cross_entropy", "cross_entropy2",
-}
+white_list = set(_amp.WHITE_OPS)
 
-gray_list = {
-    "elementwise_add", "elementwise_mul", "elementwise_sub", "relu",
-    "batch_norm", "layer_norm", "pool2d", "dropout", "concat", "reshape2",
-    "transpose2", "scale", "slice", "stack",
-}
+black_list = set(_amp.BLACK_OPS)
+
+gray_list = set(_amp.GRAY_OPS)
 
 
 class AutoMixedPrecisionLists:

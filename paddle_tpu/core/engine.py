@@ -360,7 +360,8 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
         if amp_cfg:
             from .amp import amp_guard
             with amp_guard(True, amp_cfg.get("dtype", jnp.bfloat16),
-                           amp_cfg.get("black_ops", ())):
+                           amp_cfg.get("black_ops", ()),
+                           amp_cfg.get("white_ops", ())):
                 run_block_ops(block, env, rng_ctx, lod_env,
                               block_runner)
         else:

@@ -133,7 +133,8 @@ class IslandRunner:
             from .amp import amp_guard
             return amp_guard(True,
                              self.amp_cfg.get("dtype", jnp.bfloat16),
-                             self.amp_cfg.get("black_ops", ()))
+                             self.amp_cfg.get("black_ops", ()),
+                             self.amp_cfg.get("white_ops", ()))
         import contextlib
         return contextlib.nullcontext()
 

@@ -29,6 +29,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import amp as _amp
+
 # Attr names used internally by the framework (filtered from user attrs).
 OP_UID_ATTR = "__op_uid__"
 FWD_TYPE_ATTR = "__fwd_type__"
@@ -136,9 +138,18 @@ def generic_grad_lowering(fwd_type: str):
 class ExecContext:
     """Per-op view during block tracing (reference ExecutionContext,
     operator.h:230). Values are JAX tracers/arrays; `env` maps var name to
-    value. Missing optional inputs return None."""
+    value. Missing optional inputs return None.
 
-    __slots__ = ("op", "env", "rng_ctx", "block_runner", "lod_env")
+    Under an active amp_guard, input()/inputs()/set_output() apply the
+    central mixed-precision policy (core/amp.py op_mode/cast_in/cast_out)
+    — white MXU ops read f32 operands as bf16 (so their result dtype,
+    derived from inputs, stays bf16), gray ops follow an already-reduced
+    input, black ops read reduced floats as f32. This is the trace-time
+    analog of the reference's cast-insertion pass
+    (contrib/mixed_precision/fp16_utils.py:103)."""
+
+    __slots__ = ("op", "env", "rng_ctx", "block_runner", "lod_env",
+                 "_amp_mode", "_amp_follow")
 
     def __init__(self, op, env, rng_ctx=None, block_runner=None,
                  lod_env=None):
@@ -150,6 +161,20 @@ class ExecContext:
         # per trace (part of the executor's compile-cache key), the
         # XLA-friendly encoding of ragged batches.
         self.lod_env = lod_env if lod_env is not None else {}
+        self._amp_mode = _amp.op_mode(op.type)
+        self._amp_follow = False
+        if self._amp_mode == "gray":
+            dt = _amp.amp_dtype()
+            slots = getattr(op, "input_slots", None)
+            for slot in (slots() if slots else ()):
+                for n in op.input(slot):
+                    v = env.get(n) if hasattr(env, "get") else None
+                    if v is not None and \
+                            getattr(v, "dtype", None) == dt:
+                        self._amp_follow = True
+                        break
+                if self._amp_follow:
+                    break
 
     # ---- inputs / outputs -------------------------------------------------
     def input_names(self, slot: str) -> List[str]:
@@ -173,16 +198,25 @@ class ExecContext:
             raise ValueError(
                 f"op {self.op.type} input slot {slot} is multi-arg; "
                 f"use inputs()")
-        return self.env[names[0]]
+        v = self.env[names[0]]
+        if self._amp_mode is not None:
+            v = _amp.cast_in(self._amp_mode, v, self._amp_follow)
+        return v
 
     def inputs(self, slot: str):
-        return [self.env[n] for n in self.op.input(slot)]
+        vals = [self.env[n] for n in self.op.input(slot)]
+        if self._amp_mode is not None:
+            vals = [_amp.cast_in(self._amp_mode, v, self._amp_follow)
+                    for v in vals]
+        return vals
 
     def set_output(self, slot: str, value):
         names = self.op.output(slot)
         if not names:
             return  # optional output not bound
         assert len(names) == 1, f"{self.op.type}.{slot} is multi-arg"
+        if self._amp_mode is not None:
+            value = _amp.cast_out(self._amp_mode, value)
         self.env[names[0]] = value
 
     def set_outputs(self, slot: str, values):
@@ -190,6 +224,8 @@ class ExecContext:
         assert len(names) == len(values), (
             f"{self.op.type}.{slot}: {len(names)} names vs "
             f"{len(values)} values")
+        if self._amp_mode is not None:
+            values = [_amp.cast_out(self._amp_mode, v) for v in values]
         for n, v in zip(names, values):
             self.env[n] = v
 
@@ -258,6 +294,12 @@ class _SlotView:
 
     def output(self, slot):
         return self._outputs.get(slot, [])
+
+    def input_slots(self):
+        return list(self._inputs)
+
+    def output_slots(self):
+        return list(self._outputs)
 
     def attr(self, name, default=None):
         return self._attrs.get(name, default)

@@ -5,6 +5,7 @@ from ..layer_helper import LayerHelper
 
 __all__ = [
     "cross_entropy", "softmax_with_cross_entropy",
+    "label_smoothed_softmax_xent",
     "sigmoid_cross_entropy_with_logits", "square_error_cost", "log_loss",
     "huber_loss", "kldiv_loss", "smooth_l1", "margin_rank_loss",
     "rank_loss", "hinge_loss", "bpr_loss", "mse_loss",
@@ -38,6 +39,21 @@ def softmax_with_cross_entropy(logits, label, soft_label=False,
                "axis": axis})
     if return_softmax:
         return loss, softmax
+    return loss
+
+
+def label_smoothed_softmax_xent(logits, label, epsilon=0.1):
+    """Fused equivalent of one_hot -> label_smooth ->
+    softmax_with_cross_entropy(soft_label=True) with a uniform prior —
+    same math, no [batch, ..., vocab] one-hot materialization (see
+    ops/nn.py label_smoothed_softmax_xent for the algebra)."""
+    helper = LayerHelper("label_smoothed_softmax_xent")
+    loss = helper.create_variable_for_type_inference(logits.dtype)
+    helper.append_op(
+        "label_smoothed_softmax_xent",
+        inputs={"Logits": logits, "Label": label},
+        outputs={"Loss": loss},
+        attrs={"epsilon": float(epsilon)})
     return loss
 
 

@@ -29,7 +29,7 @@ class TransformerConfig:
     def __init__(self, src_vocab_size=32000, trg_vocab_size=32000,
                  max_length=256, d_model=512, d_inner=2048, n_head=8,
                  n_layer=6, dropout=0.1, label_smooth_eps=0.1,
-                 dtype="float32", fuse_attention=False):
+                 dtype="float32", fuse_attention=False, fuse_loss=True):
         self.src_vocab_size = src_vocab_size
         self.trg_vocab_size = trg_vocab_size
         self.max_length = max_length
@@ -41,6 +41,10 @@ class TransformerConfig:
         self.label_smooth_eps = label_smooth_eps
         self.dtype = dtype
         self.fuse_attention = fuse_attention
+        # fused label-smoothed CE (no [B,S,vocab] one-hot
+        # materialization); fuse_loss=False keeps the reference's
+        # composed one_hot->label_smooth->soft-label-CE path
+        self.fuse_loss = fuse_loss
         assert d_model % n_head == 0
         self.d_head = d_model // n_head
 
@@ -211,7 +215,11 @@ def transformer_train(cfg: TransformerConfig, is_test=False):
     dec_out = decoder(trg_ids, trg_bias, enc_out, src_bias, cfg, is_test)
     logits = _project_logits(dec_out, cfg)
 
-    if cfg.label_smooth_eps:
+    if cfg.label_smooth_eps and cfg.fuse_loss:
+        cost = layers.label_smoothed_softmax_xent(
+            logits, lbl_ids, epsilon=cfg.label_smooth_eps)
+        cost = layers.squeeze(cost, axes=[-1])
+    elif cfg.label_smooth_eps:
         oh = layers.one_hot(lbl_ids, cfg.trg_vocab_size)
         soft = layers.label_smooth(oh, epsilon=cfg.label_smooth_eps)
         cost = layers.softmax_with_cross_entropy(

@@ -15,7 +15,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..core.registry import register_op, register_no_grad_op
+from ..core.registry import (register_op, register_no_grad_op,
+                             override_grad_lowering)
 
 
 @register_op("softmax")
@@ -78,6 +79,77 @@ def softmax_with_cross_entropy(ctx):
         loss = jnp.where(ids[..., None] != ignore_index, loss, 0.0)
     ctx.set_output("Softmax", jnp.exp(log_p))
     ctx.set_output("Loss", loss)
+
+
+@register_op("label_smoothed_softmax_xent", no_grad_slots=("Label",))
+def label_smoothed_softmax_xent(ctx):
+    """Fused label-smoothed softmax cross-entropy over hard labels.
+
+    Algebraically identical to the reference composition
+    one_hot -> label_smooth -> softmax_with_cross_entropy(soft_label=
+    True) (label_smooth_op.cc with uniform prior;
+    softmax_with_cross_entropy_op.cc) but never materializes the
+    [batch, seq, vocab] smoothed one-hot or an f32 softmax:
+
+      y_j = (1-eps)*[j==y] + eps/K
+      CE  = lse(l) - sum_j y_j l_j
+          = lse(l) - (1-eps)*l_y - eps*mean_j(l_j)
+
+    For a 32k vocab at B=96 S=128 the composed form costs ~6 GB of
+    HBM/step in f32 intermediates; this form reads the bf16 logits once
+    forward (both reductions fuse into one pass) and twice backward.
+    """
+    logits, label = ctx.input("Logits"), ctx.input("Label")
+    eps = ctx.attr("epsilon", 0.0)
+    lf = logits.astype(jnp.float32)
+    ids = label.astype(jnp.int32)
+    if ids.ndim == logits.ndim:
+        ids = ids.squeeze(-1)
+    # lse and mean are sibling reductions over the same convert — XLA
+    # fuses both into one pass reading the bf16 logits once; the gather
+    # reads from the ORIGINAL logits (one element per row) so no f32
+    # materialization of the [.., vocab] tensor ever happens
+    lse = jax.nn.logsumexp(lf, axis=-1)
+    l_y = jnp.take_along_axis(logits, ids[..., None],
+                              axis=-1).squeeze(-1).astype(jnp.float32)
+    loss = lse - (1.0 - eps) * l_y - eps * jnp.mean(lf, axis=-1)
+    ctx.set_output("Loss", loss[..., None])
+
+
+@override_grad_lowering("label_smoothed_softmax_xent")
+def label_smoothed_softmax_xent_grad(ctx):
+    """Hand-written grad: d l_j = dLoss * (p_j - eps/K - (1-eps)[j==y]).
+
+    The generic vjp would route the one-hot term through a vocab-sized
+    scatter that XLA cannot fuse; the iota-compare form below fuses into
+    a single elementwise pass over the logits, and the grad is emitted
+    in the logits' own (bf16 under AMP) dtype."""
+    logits = ctx.input("Logits")
+    label = ctx.input("Label")
+    eps = ctx.attr("epsilon", 0.0)
+    g_names = ctx.op.input("Loss@GRAD")
+    out_names = ctx.op.output("Logits@GRAD")
+    if not (out_names and out_names[0]):
+        return
+    dloss = ctx.env.get(g_names[0]) if g_names and g_names[0] else None
+    lf = logits.astype(jnp.float32)
+    ids = label.astype(jnp.int32)
+    if ids.ndim == logits.ndim:
+        ids = ids.squeeze(-1)
+    k = logits.shape[-1]
+    p = jax.nn.softmax(lf, axis=-1)
+    iota = lax.broadcasted_iota(jnp.int32, logits.shape,
+                                logits.ndim - 1)
+    target = eps / k + (1.0 - eps) * (iota == ids[..., None])
+    g = p - target
+    if dloss is not None:
+        d = dloss.astype(jnp.float32)
+        if d.ndim == logits.ndim and d.shape[-1] == 1:
+            pass
+        else:
+            d = d[..., None]
+        g = g * d
+    ctx.env[out_names[0]] = g.astype(jnp.result_type(logits))
 
 
 @register_op("sigmoid_cross_entropy_with_logits",
@@ -196,10 +268,19 @@ def dropout(ctx):
     prob = ctx.attr("dropout_prob", 0.5)
     is_test = ctx.attr("is_test", False)
     impl = ctx.attr("dropout_implementation", "downgrade_in_infer")
-    if is_test:
-        out = x if impl == "upscale_in_train" else x * (1.0 - prob)
+    # the u8 threshold below cannot represent "keep everything"
+    # (t=256) or "drop everything" (t=0 would still keep 1/256 after
+    # a naive clamp) — both edges get exact handling here
+    t = int(round((1.0 - prob) * 256.0))
+    if is_test or t >= 256:
+        out = x if (impl == "upscale_in_train" or not is_test) \
+            else x * (1.0 - prob)
         ctx.set_output("Out", out)
         ctx.set_output("Mask", jnp.ones_like(x, dtype=jnp.uint8))
+        return
+    if t <= 0:
+        ctx.set_output("Out", jnp.zeros_like(x))
+        ctx.set_output("Mask", jnp.zeros_like(x, dtype=jnp.uint8))
         return
     # XLA RngBitGenerator instead of jax.random.bernoulli: the threefry
     # op chain materializes several mask-sized intermediates per site —
@@ -207,16 +288,25 @@ def dropout(ctx):
     # traffic. One fused generator instruction + a compare keeps the
     # same determinism contract (state derived from the op's uid-keyed
     # rng, so the vjp recompute regenerates the identical mask).
+    #
+    # The generator's output is the op's dominant HBM cost (a custom
+    # call cannot fuse), so bits are drawn at ONE BYTE per element
+    # (XLA:TPU emits u8 natively — measured 25 MB instead of 100 MB for
+    # a [96,128,2048] site). The keep threshold snaps to 1/256
+    # granularity; the upscale divides by the EXACT realized keep
+    # probability t/256, so E[out] == x stays unbiased (the realized
+    # drop rate differs from `prob` by < 2^-8, vs the reference's
+    # f32-uniform compare, dropout_op.cu:40).
     key = ctx.rng()
     state = jax.lax.bitcast_convert_type(
         jnp.concatenate([key, key ^ jnp.uint32(0x9E3779B9)]),
         jnp.uint32).reshape(4)
-    _, bits = jax.lax.rng_bit_generator(
-        state, x.shape, dtype=jnp.uint32)
-    keep = bits < jnp.uint32(
-        min((1.0 - prob) * 4294967296.0, 4294967295.0))
+    _, bits = jax.lax.rng_bit_generator(state, x.shape,
+                                        dtype=jnp.uint8)
+    keep = bits < jnp.uint8(t)   # t in [1, 255] after the edge exits
+    q = t / 256.0
     if impl == "upscale_in_train":
-        out = jnp.where(keep, x / max(1.0 - prob, 1e-8), 0.0)
+        out = jnp.where(keep, x / q, 0.0)
     else:
         out = jnp.where(keep, x, 0.0)
     ctx.set_output("Out", out)
@@ -239,14 +329,21 @@ def batch_norm(ctx):
     ch_axis = 1 if layout == "NCHW" else x.ndim - 1
     red_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
 
+    # bf16/f16 activations: statistics and normalization in f32 (the
+    # casts fuse into the reductions — registers, not HBM), Y back in
+    # the input dtype so the activation stream stays 2-byte under AMP.
+    res_t = jnp.result_type(x)
+    reduced = res_t in (jnp.bfloat16, jnp.float16)
+    xf = x.astype(jnp.float32) if reduced else x
+
     if use_global:
         mean, var = mean_in, var_in
         saved_mean = jnp.zeros_like(mean_in)
         saved_var = jnp.zeros_like(var_in)
         mean_out, var_out = mean_in, var_in
     else:
-        mean = jnp.mean(x, axis=red_axes)
-        var = jnp.var(x, axis=red_axes)
+        mean = jnp.mean(xf, axis=red_axes)
+        var = jnp.var(xf, axis=red_axes)
         mean_out = mean_in * momentum + mean * (1 - momentum)
         var_out = var_in * momentum + var * (1 - momentum)
         saved_mean = mean
@@ -254,9 +351,9 @@ def batch_norm(ctx):
 
     shape = [1] * x.ndim
     shape[ch_axis] = x.shape[ch_axis]
-    xhat = (x - mean.reshape(shape)) * lax.rsqrt(var.reshape(shape) + eps)
+    xhat = (xf - mean.reshape(shape)) * lax.rsqrt(var.reshape(shape) + eps)
     y = xhat * scale.reshape(shape) + bias.reshape(shape)
-    ctx.set_output("Y", y)
+    ctx.set_output("Y", y.astype(res_t) if reduced else y)
     ctx.set_output("MeanOut", mean_out)
     ctx.set_output("VarianceOut", var_out)
     ctx.set_output("SavedMean", saved_mean)
@@ -270,16 +367,20 @@ def layer_norm(ctx):
     eps = ctx.attr("epsilon", 1e-5)
     begin = ctx.attr("begin_norm_axis", 1)
     axes = tuple(range(begin, x.ndim))
-    mean = jnp.mean(x, axis=axes, keepdims=True)
-    var = jnp.var(x, axis=axes, keepdims=True)
-    xhat = (x - mean) * lax.rsqrt(var + eps)
+    # f32 statistics with bf16 I/O under AMP (see batch_norm note)
+    res_t = jnp.result_type(x)
+    reduced = res_t in (jnp.bfloat16, jnp.float16)
+    xf = x.astype(jnp.float32) if reduced else x
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    xhat = (xf - mean) * lax.rsqrt(var + eps)
     y = xhat
     norm_shape = x.shape[begin:]
     if scale is not None:
         y = y * scale.reshape((1,) * begin + norm_shape)
     if bias is not None:
         y = y + bias.reshape((1,) * begin + norm_shape)
-    ctx.set_output("Y", y)
+    ctx.set_output("Y", y.astype(res_t) if reduced else y)
     ctx.set_output("Mean", mean.reshape(x.shape[:begin]))
     ctx.set_output("Variance", var.reshape(x.shape[:begin]))
 
@@ -291,7 +392,10 @@ def group_norm(ctx):
     eps = ctx.attr("epsilon", 1e-5)
     groups = ctx.attr("groups")
     n, c = x.shape[0], x.shape[1]
-    g = x.reshape(n, groups, c // groups, *x.shape[2:])
+    res_t = jnp.result_type(x)
+    reduced = res_t in (jnp.bfloat16, jnp.float16)
+    xf = x.astype(jnp.float32) if reduced else x
+    g = xf.reshape(n, groups, c // groups, *x.shape[2:])
     axes = tuple(range(2, g.ndim))
     mean = jnp.mean(g, axis=axes, keepdims=True)
     var = jnp.var(g, axis=axes, keepdims=True)
@@ -302,7 +406,7 @@ def group_norm(ctx):
         y = y * scale.reshape(shape)
     if bias is not None:
         y = y + bias.reshape(shape)
-    ctx.set_output("Y", y)
+    ctx.set_output("Y", y.astype(res_t) if reduced else y)
     ctx.set_output("Mean", mean.reshape(n, groups))
     ctx.set_output("Variance", var.reshape(n, groups))
 

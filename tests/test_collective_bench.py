@@ -1,0 +1,57 @@
+"""tools/collective_bench.py: the allreduce bus-bandwidth machinery
+(BASELINE.json metric 3). Runs the sweep on a small virtual mesh in a
+subprocess and checks the accounting (nccl-tests busbw formula)."""
+import json
+import os
+import subprocess
+import sys
+import unittest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "collective_bench.py")
+
+
+class TestCollectiveBench(unittest.TestCase):
+    def _run(self, *extra):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        r = subprocess.run(
+            [sys.executable, TOOL, "--cpu", "4", "--iters", "2",
+             "--sizes", "16384,262144", "--json", *extra],
+            capture_output=True, text=True, env=env, timeout=600)
+        self.assertEqual(r.returncode, 0, r.stderr[-2000:])
+        return [json.loads(l) for l in r.stdout.splitlines()
+                if l.startswith("{")]
+
+    def test_allreduce_sweep(self):
+        rows = self._run()
+        self.assertEqual(len(rows), 2)
+        for row in rows:
+            self.assertEqual(row["n_devices"], 4)
+            self.assertGreater(row["algbw_gbps"], 0)
+            # busbw = algbw * 2(n-1)/n for allreduce
+            self.assertAlmostEqual(
+                row["busbw_gbps"],
+                round(row["algbw_gbps"] * 2 * 3 / 4, 2), delta=0.02)
+        self.assertEqual(rows[0]["bytes"], 16384)
+
+    def test_reduce_scatter(self):
+        rows = self._run("--collective", "reduce_scatter")
+        for row in rows:
+            self.assertAlmostEqual(
+                row["busbw_gbps"],
+                round(row["algbw_gbps"] * 3 / 4, 2), delta=0.02)
+
+    def test_all_gather_total_bytes(self):
+        # S is the TOTAL gathered buffer (n * per-device shard): the
+        # --sizes value is the per-device shard, so bytes = 4x that
+        rows = self._run("--collective", "all_gather")
+        self.assertEqual(rows[0]["bytes"], 16384 * 4)
+        for row in rows:
+            self.assertAlmostEqual(
+                row["busbw_gbps"],
+                round(row["algbw_gbps"] * 3 / 4, 2), delta=0.02)
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -82,6 +82,54 @@ class TestSoftmaxWithCESoft(OpTest):
         self.check_output()
 
 
+class TestLabelSmoothedSoftmaxXent(OpTest):
+    """Fused label-smoothed CE == one_hot -> label_smooth -> soft CE."""
+
+    def setUp(self):
+        self.op_type = "label_smoothed_softmax_xent"
+        rng = np.random.default_rng(7)
+        eps = 0.1
+        k = 6
+        logits = rng.standard_normal((4, k)).astype(np.float32)
+        label = rng.integers(0, k, (4,)).astype(np.int64)
+        sm = _softmax(logits)
+        soft = (1 - eps) * np.eye(k)[label] + eps / k
+        loss = -(soft * np.log(sm)).sum(1, keepdims=True)
+        self.inputs = {"Logits": logits, "Label": label}
+        self.outputs = {"Loss": loss.astype(np.float32)}
+        self.attrs = {"epsilon": eps}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["logits"], "loss_out")
+
+
+class TestLabelSmoothedSoftmaxXent3D(OpTest):
+    """[B, S, K] logits with [B, S] int labels (the transformer shape)."""
+
+    def setUp(self):
+        self.op_type = "label_smoothed_softmax_xent"
+        rng = np.random.default_rng(8)
+        eps = 0.2
+        b, s, k = 2, 3, 5
+        logits = rng.standard_normal((b, s, k)).astype(np.float32)
+        label = rng.integers(0, k, (b, s)).astype(np.int64)
+        sm = _softmax(logits)
+        soft = (1 - eps) * np.eye(k)[label] + eps / k
+        loss = -(soft * np.log(sm)).sum(-1, keepdims=True)
+        self.inputs = {"Logits": logits, "Label": label}
+        self.outputs = {"Loss": loss.astype(np.float32)}
+        self.attrs = {"epsilon": eps}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["logits"], "loss_out")
+
+
 class TestSigmoidCE(OpTest):
     def setUp(self):
         self.op_type = "sigmoid_cross_entropy_with_logits"

@@ -1,0 +1,206 @@
+"""Collective (allreduce/allgather/reducescatter) bandwidth benchmark.
+
+The third BASELINE.json metric ("allreduce bus-bandwidth"). The
+reference ships distributed benchmark tooling but no kernel-level
+collective bench (/root/reference/tools/aws_benchmarking/README.md;
+its allreduce is NCCLAllReduce inside AllReduceOpHandle,
+/root/reference/paddle/fluid/framework/details/all_reduce_op_handle.cc:35).
+TPU-native equivalent: XLA collectives over the ICI mesh, timed with the
+same fetch-fenced two-window methodology as bench.py.
+
+Bandwidth accounting (nccl-tests formulas, which the reference's NCCL
+path would report identically):
+
+  algbw = S / t                      (S = per-device buffer bytes)
+  busbw = algbw * 2(n-1)/n           (all_reduce)
+          algbw * (n-1)/n            (all_gather / reduce_scatter)
+
+busbw is the hardware-link utilization number comparable across
+topologies; on a single device the collective is the identity and the
+sweep reports only dispatch floor (flagged in the output).
+
+Usage:
+  python tools/collective_bench.py [--collective all_reduce]
+      [--sizes 1048576,16777216] [--iters 20] [--json]
+
+Runs on whatever devices JAX sees: real multi-chip when available, or a
+virtual mesh for correctness/dry-runs:
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  JAX_PLATFORMS=cpu python tools/collective_bench.py
+(virtual-mesh numbers measure the emulation, not ICI — the tool prints
+the platform so the two are never confused).
+"""
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import sys
+import time
+
+import numpy as np
+
+DEFAULT_SIZES = [2 ** p for p in range(12, 28, 2)]  # 4 KB .. 128 MB
+CHAIN = 8  # collectives chained per executable (amortizes dispatch)
+
+
+def _build(collective, n_elems, mesh):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    shard_map = jax.shard_map
+
+    n_dev = mesh.shape["x"]
+    if collective == "all_reduce":
+        in_spec, out_spec = P(None), P(None)
+
+        def op(x):
+            return jax.lax.psum(x, "x") / n_dev
+    elif collective == "all_gather":
+        # gather then keep the local slice so the scan carry keeps its
+        # shape (the slice is device-local, no extra wire traffic)
+        in_spec, out_spec = P("x"), P("x")
+
+        def op(x):
+            return jax.lax.all_gather(x, "x", tiled=True)[:x.shape[0]]
+    elif collective == "reduce_scatter":
+        # scatter then tile back to the carry shape (device-local)
+        in_spec, out_spec = P(None), P(None)
+
+        def op(x):
+            return jnp.tile(
+                jax.lax.psum_scatter(x, "x", tiled=True) / n_dev,
+                n_dev)
+    elif collective == "ppermute":
+        n = mesh.shape["x"]
+        in_spec, out_spec = P(None), P(None)
+
+        def op(x):
+            return jax.lax.ppermute(
+                x, "x", [(i, (i + 1) % n) for i in range(n)])
+    else:
+        raise SystemExit(f"unknown collective {collective!r}")
+
+    @jax.jit
+    @functools.partial(shard_map, mesh=mesh, in_specs=(in_spec,),
+                       out_specs=out_spec, check_vma=False)
+    # check_vma=False: collectives flip values between replicated and
+    # device-varying types across scan iterations; the chain is a
+    # benchmark (not a semantics-bearing program), so the varying-axes
+    # type check is disabled rather than threading pvary through
+    def chained(x):
+        def body(c, _):
+            return op(c), ()
+        c, _ = jax.lax.scan(body, x, None, length=CHAIN)
+        return c
+
+    def make_input():
+        if collective in ("all_gather",):
+            # per-device shard of n_elems each -> global n*n_elems
+            glob = jnp.arange(n_elems * mesh.shape["x"],
+                              dtype=jnp.float32)
+        else:
+            glob = jnp.arange(n_elems, dtype=jnp.float32)
+        from jax.sharding import NamedSharding
+        return jax.device_put(glob, NamedSharding(mesh, in_spec))
+
+    return chained, make_input
+
+
+def _time_one(fn, x, iters):
+    """Fetch-fenced two-window timing (bench.py discipline): returns
+    seconds per chained-executable call."""
+    out = fn(x)
+    np.asarray(jax.tree_util.tree_leaves(out)[0])[..., :1]  # warm fence
+
+    def window(k):
+        t0 = time.perf_counter()
+        for _ in range(k):
+            out = fn(x)
+        float(np.asarray(out).ravel()[0])
+        return time.perf_counter() - t0
+
+    t1 = window(iters)
+    t2 = window(2 * iters)
+    if t2 - t1 > 0.02 * t2:
+        return (t2 - t1) / iters
+    return (t1 + t2) / (3 * iters)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--collective", default="all_reduce",
+                    choices=["all_reduce", "all_gather",
+                             "reduce_scatter", "ppermute"])
+    ap.add_argument("--sizes", default=None,
+                    help="comma-separated per-device buffer bytes")
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--json", action="store_true",
+                    help="one JSON line per size on stdout")
+    ap.add_argument("--cpu", type=int, default=0, metavar="N",
+                    help="force an N-device virtual CPU mesh (the "
+                         "container's sitecustomize overrides "
+                         "JAX_PLATFORMS, so the env var alone is not "
+                         "enough)")
+    args = ap.parse_args()
+
+    import os
+    if args.cpu:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                f"{args.cpu}").strip()
+    global jax
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    from jax.sharding import Mesh
+    devs = jax.devices()
+    n = len(devs)
+    mesh = Mesh(np.array(devs), ("x",))
+    platform = devs[0].platform
+    kind = getattr(devs[0], "device_kind", platform)
+    sizes = ([int(s) for s in args.sizes.split(",")] if args.sizes
+             else DEFAULT_SIZES)
+    print(f"# {args.collective} over {n}x {kind} ({platform}); "
+          f"chain={CHAIN} per dispatch"
+          + ("" if n > 1 else
+             "  *** single device: identity collective, numbers are "
+             "the dispatch floor, NOT bandwidth ***"),
+        file=sys.stderr)
+    print(f"# {'bytes/dev':>12} {'time/coll':>10} {'algbw GB/s':>10} "
+          f"{'busbw GB/s':>10}", file=sys.stderr)
+    scale = {"all_reduce": 2 * (n - 1) / n,
+             "all_gather": (n - 1) / n,
+             "reduce_scatter": (n - 1) / n,
+             "ppermute": 1.0}[args.collective]
+    results = []
+    for size in sizes:
+        n_elems = max(size // 4, n)
+        fn, make_input = _build(args.collective, n_elems, mesh)
+        x = make_input()
+        t = _time_one(fn, x, args.iters) / CHAIN
+        # nccl-tests S convention: the TOTAL logical buffer — for
+        # all_gather each device contributes an S/n shard and receives
+        # (n-1)/n * S over the links, so S = n * per-device shard
+        total = n_elems * 4 * (n if args.collective == "all_gather"
+                               else 1)
+        algbw = total / t / 1e9
+        busbw = algbw * scale
+        results.append({"collective": args.collective, "n_devices": n,
+                        "bytes": total, "seconds": t,
+                        "algbw_gbps": round(algbw, 3),
+                        "busbw_gbps": round(busbw, 3)})
+        print(f"# {total:>12} {t*1e6:>9.1f}us {algbw:>10.2f} "
+              f"{busbw:>10.2f}", file=sys.stderr)
+    if args.json:
+        for r in results:
+            print(json.dumps(r))
+    best = max(r["busbw_gbps"] for r in results)
+    print(f"# peak busbw: {best:.2f} GB/s", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
