@@ -13,9 +13,25 @@ custom-kernel slot the reference's Xbyak JIT tier fills on x86
 * backward: dedicated dq and dk/dv kernels that consume the saved
   (out, lse) residuals and recompute the probability tile
   p = exp(s - lse) per block — the [Sq, Sk] matrix again never hits HBM.
-  With an additive bias that needs a gradient, the dq kernel also emits
-  the ds tile (dbias IS ds summed over broadcast dims), which costs the
-  O(Sq*Sk) buffer the bias itself already occupies.
+  di = sum(dO*O) is recomputed per block from the out/do streams (VPU
+  work) instead of a lane-broadcast HBM tensor. With an additive bias
+  that needs a gradient, the dq kernel also emits the ds tile (dbias IS
+  ds summed over broadcast dims).
+
+Layouts — the same kernel bodies serve two HBM layouts:
+
+* "bhsd" — q/k/v [B, H, S, D] (the classic layout; ring attention uses
+  this along the sequence axis). Blocks are [block, D] tiles of the
+  [B*H, S, D] view; one head per grid step.
+* "bshd" — q/k/v [B, S, H, D], i.e. a free reshape of the [B, S, H*D]
+  projection output. This kills the head-split transposes entirely:
+  XLA cannot fuse layout changes into a custom call, so the bhsd path's
+  pre/post-kernel transposes materialize (~8 GB/step of HBM copies on
+  transformer-base at B=96). Mosaic requires lane blocks of 128 (or the
+  full minor dim), so with D < 128 the kernel PACKS hpb = 128 // D
+  heads into each 128-wide lane block of the [B, S, H*D] view and
+  slices per-head tiles in VMEM (static lane slices) — grid
+  (B, H/hpb, n_q, n_kv), an unrolled hpb-iteration loop per step.
 
 Grad identities (standard flash attention backward):
   di = sum(dO * O, -1);  p = exp(s - lse)
@@ -37,57 +53,330 @@ _NEG_INF = -1e30
 _INTERPRET = False
 
 
-def _fa_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
-               m_scr, l_scr, acc_scr, *, scale, n_kv):
-    kv_idx = pl.program_id(2)
+def _dims(q, layout):
+    if layout == "bshd":
+        B, S, H, D = q.shape
+        return B, H, S, D
+    B, H, S, D = q.shape
+    return B, H, S, D
+
+
+def _seq_len(x, layout):
+    return x.shape[1] if layout == "bshd" else x.shape[2]
+
+
+def _heads_per_block(H, D):
+    """bshd lane packing: how many heads share one lane block. Aims for
+    128 lanes (the Mosaic minimum for a strict lane block); interpret
+    mode and _kernel_ok tolerate smaller when H is small."""
+    hpb = max(1, 128 // D) if D < 128 else 1
+    hpb = min(hpb, H)
+    while H % hpb:
+        hpb -= 1
+    return hpb
+
+
+class _Plan:
+    """Geometry for one (layout, shape, block) configuration.
+
+    bhsd: grid (B*H, i, j);    rows [B*H, S, D];    hpb=1
+    bshd: grid (B, Hg, i, j);  rows [B, S, H*D];    hpb=128//D heads
+          per lane block (Hg = H // hpb)
+    `order` maps the q/k sequence grid axes for the active kernel
+    (dq-style grids put q before kv; dkv-style grids swap them)."""
+
+    def __init__(self, layout, B, H, Sq, Sk, D, bq, bk):
+        self.layout = layout
+        self.B, self.H, self.Sq, self.Sk, self.D = B, H, Sq, Sk, D
+        self.bq, self.bk = bq, bk
+        if layout == "bshd":
+            self.hpb = _heads_per_block(H, D)
+            self.Hg = H // self.hpb
+        else:
+            self.hpb = 1
+            self.Hg = None
+
+    def rows(self, x):
+        """HBM view handed to pallas_call."""
+        if self.layout == "bshd":
+            B, S = x.shape[0], x.shape[1]
+            return x.reshape(B, S, self.H * self.D)
+        B, H, S, D = x.shape
+        return x.reshape(B * H, S, D)
+
+    def grid(self, n_i, n_j):
+        if self.layout == "bshd":
+            return (self.B, self.Hg, n_i, n_j)
+        return (self.B * self.H, n_i, n_j)
+
+    def seq_axes(self, swap):
+        """(q_axis, k_axis) grid positions; swap=True for dkv grids."""
+        base = 2 if self.layout == "bshd" else 1
+        return (base + 1, base) if swap else (base, base + 1)
+
+    def row_spec(self, blk, width_per_head, which_axis):
+        """Spec for a q/k/v/out/do/lse tensor: [blk rows x
+        hpb*width_per_head lanes]. which_axis = grid position of the
+        sequence index."""
+        if self.layout == "bshd":
+            def index_map(*g):
+                return (g[0], g[which_axis], g[1])
+            return pl.BlockSpec(
+                (None, blk, self.hpb * width_per_head), index_map)
+
+        def index_map(*g):
+            return (g[0], g[which_axis], 0)
+        return pl.BlockSpec((None, blk, width_per_head), index_map)
+
+    def wide_shape(self, S):
+        """lse carrier: per-row f32 lane-broadcast to 128 per head."""
+        if self.layout == "bshd":
+            return (self.B, S, self.Hg * self.hpb * 128)
+        return (self.B * self.H, S, 128)
+
+    def wide_spec(self, blk, which_axis):
+        return self.row_spec(blk, 128, which_axis)
+
+    def bias_info(self, bias):
+        """Returns (reshaped_bias, spec_factory, per_head, per_q).
+        spec_factory(q_axis, k_axis) -> BlockSpec whose ref is
+        [hpb, bqs, bk] for packed per-head bias, else [bqs, bk]."""
+        B, H, Sq = self.B, self.H, self.Sq
+        bq, bk, hpb = self.bq, self.bk, self.hpb
+        per_head = bias.shape[1] != 1
+        per_q = bias.shape[2] != 1
+        bqs = bq if per_q else 1
+        if self.layout == "bshd":
+            if per_head:
+                br = bias.reshape(B, self.Hg, hpb,
+                                  Sq if per_q else 1, bias.shape[3])
+
+                def factory(q_axis, k_axis):
+                    def index_map(*g):
+                        return (g[0], g[1], 0,
+                                g[q_axis] if per_q else 0, g[k_axis])
+                    return pl.BlockSpec((None, None, hpb, bqs, bk),
+                                        index_map)
+            else:
+                br = bias.reshape(B, Sq if per_q else 1, bias.shape[3])
+
+                def factory(q_axis, k_axis):
+                    def index_map(*g):
+                        return (g[0], g[q_axis] if per_q else 0,
+                                g[k_axis])
+                    return pl.BlockSpec((None, bqs, bk), index_map)
+            return br, factory, per_head, per_q
+        br = bias.reshape((B * H if per_head else B,
+                           Sq if per_q else 1, bias.shape[3]))
+
+        def factory(q_axis, k_axis):
+            def index_map(*g):
+                return (g[0] if per_head else g[0] // H,
+                        g[q_axis] if per_q else 0, g[k_axis])
+            return pl.BlockSpec((None, bqs, bk), index_map)
+        return br, factory, per_head, per_q
+
+    def bias_tile(self, bias_ref, i):
+        """Per-local-head [bqs, bk] f32 tile from the bias ref."""
+        if bias_ref is None:
+            return None
+        if bias_ref.ndim == 3:          # packed per-head [hpb, bqs, bk]
+            return bias_ref[i].astype(jnp.float32)
+        return bias_ref[...].astype(jnp.float32)
+
+    def ds_shape(self):
+        if self.layout == "bshd":
+            return (self.B, self.Hg, self.hpb, self.Sq, self.Sk)
+        return (self.B * self.H, self.Sq, self.Sk)
+
+    def ds_spec(self, q_axis, k_axis):
+        if self.layout == "bshd":
+            def index_map(*g):
+                return (g[0], g[1], 0, g[q_axis], g[k_axis])
+            return pl.BlockSpec(
+                (None, None, self.hpb, self.bq, self.bk), index_map)
+
+        def index_map(*g):
+            return (g[0], g[q_axis], g[k_axis])
+        return pl.BlockSpec((None, self.bq, self.bk), index_map)
+
+    def ds_store(self, ds_ref, i, tile):
+        if self.layout == "bshd":
+            ds_ref[i] = tile
+        else:
+            ds_ref[...] = tile
+
+    def lanes(self, ref, i, width):
+        """Local head i's [rows, width] slice of a packed ref."""
+        if self.hpb == 1 and self.layout != "bshd":
+            return ref[...]
+        return ref[:, i * width:(i + 1) * width]
+
+    def store_lanes(self, ref, i, width, val):
+        if self.hpb == 1 and self.layout != "bshd":
+            ref[...] = val
+        else:
+            ref[:, i * width:(i + 1) * width] = val
+
+
+# ---------------------------------------------------------------------------
+# kernel bodies (shared by both layouts via the plan's lane slicing)
+# ---------------------------------------------------------------------------
+
+def _fa_kernel(plan, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
+               m_scr, l_scr, acc_scr, *, scale, n_kv, kv_axis):
+    kv_idx = pl.program_id(kv_axis)
+    D = plan.D
 
     @pl.when(kv_idx == 0)
     def _init():
-        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
-        l_scr[:] = jnp.zeros_like(l_scr)
-        acc_scr[:] = jnp.zeros_like(acc_scr)
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[0]                                   # [bq, D]
-    k = k_ref[0]                                   # [bk, D]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale  # [bq, bk]
-    if bias_ref is not None:
-        s = s + bias_ref[0].astype(jnp.float32)
+    for i in range(plan.hpb):
+        q = plan.lanes(q_ref, i, D)                # [bq, D]
+        k = plan.lanes(k_ref, i, D)                # [bk, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        bt = plan.bias_tile(bias_ref, i)
+        if bt is not None:
+            s = s + bt
 
-    m_prev = m_scr[:, :1]                          # [bq, 1]
-    l_prev = l_scr[:, :1]
-    m_curr = jnp.max(s, axis=-1, keepdims=True)
-    m_next = jnp.maximum(m_prev, m_curr)
-    corr = jnp.exp(m_prev - m_next)
-    p = jnp.exp(s - m_next)                        # [bq, bk]
-    l_next = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
-    acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
-        p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    m_scr[:] = jnp.broadcast_to(m_next, m_scr.shape)
-    l_scr[:] = jnp.broadcast_to(l_next, l_scr.shape)
+        m_prev = m_scr[i][:, :1]                   # [bq, 1]
+        l_prev = l_scr[i][:, :1]
+        m_curr = jnp.max(s, axis=-1, keepdims=True)
+        m_next = jnp.maximum(m_prev, m_curr)
+        corr = jnp.exp(m_prev - m_next)
+        p = jnp.exp(s - m_next)                    # [bq, bk]
+        l_next = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[i] = acc_scr[i] * corr + jax.lax.dot_general(
+            p.astype(v_ref.dtype), plan.lanes(v_ref, i, D),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[i] = jnp.broadcast_to(m_next, m_scr[i].shape)
+        l_scr[i] = jnp.broadcast_to(l_next, l_scr[i].shape)
 
     @pl.when(kv_idx == n_kv - 1)
     def _finish():
-        denom = jnp.maximum(l_scr[:, :1], 1e-30)
-        o_ref[0] = (acc_scr[:] / denom).astype(o_ref.dtype)
-        if lse_ref is not None:
-            lse_ref[0] = (m_scr[:] + jnp.log(
-                jnp.maximum(l_scr[:], 1e-30))).astype(lse_ref.dtype)
+        for i in range(plan.hpb):
+            denom = jnp.maximum(l_scr[i][:, :1], 1e-30)
+            plan.store_lanes(o_ref, i, D,
+                             (acc_scr[i] / denom).astype(o_ref.dtype))
+            if lse_ref is not None:
+                plan.store_lanes(
+                    lse_ref, i, 128,
+                    (m_scr[i] + jnp.log(jnp.maximum(
+                        l_scr[i], 1e-30))).astype(lse_ref.dtype))
 
+
+def _fa_bwd_dq_kernel(plan, q_ref, k_ref, v_ref, lse_ref, out_ref,
+                      do_ref, glse_ref, bias_ref, dq_ref, ds_ref,
+                      dq_scr, *, scale, n_kv, kv_axis):
+    kv_idx = pl.program_id(kv_axis)
+    D = plan.D
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    for i in range(plan.hpb):
+        q = plan.lanes(q_ref, i, D)                 # [bq, D]
+        k = plan.lanes(k_ref, i, D)                 # [bk, D]
+        v = plan.lanes(v_ref, i, D)
+        do = plan.lanes(do_ref, i, D).astype(jnp.float32)
+        lse = plan.lanes(lse_ref, i, 128)[:, :1]    # [bq, 1]
+        di = jnp.sum(plan.lanes(out_ref, i, D).astype(jnp.float32)
+                     * do, axis=-1, keepdims=True)
+        if glse_ref is not None:
+            di = di - plan.lanes(glse_ref, i, 128)[:, :1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        bt = plan.bias_tile(bias_ref, i)
+        if bt is not None:
+            s = s + bt
+        p = jnp.exp(s - lse)                        # [bq, bk]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - di)
+        if ds_ref is not None:
+            plan.ds_store(ds_ref, i, ds.astype(ds_ref.dtype))
+        dq_scr[i] += scale * jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kv_idx == n_kv - 1)
+    def _finish():
+        for i in range(plan.hpb):
+            plan.store_lanes(dq_ref, i, D,
+                             dq_scr[i].astype(dq_ref.dtype))
+
+
+def _fa_bwd_dkv_kernel(plan, q_ref, k_ref, v_ref, lse_ref, out_ref,
+                       do_ref, glse_ref, bias_ref, dk_ref, dv_ref,
+                       dk_scr, dv_scr, *, scale, n_q, q_axis):
+    q_idx = pl.program_id(q_axis)
+    D = plan.D
+
+    @pl.when(q_idx == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    for i in range(plan.hpb):
+        q = plan.lanes(q_ref, i, D)
+        k = plan.lanes(k_ref, i, D)
+        v = plan.lanes(v_ref, i, D)
+        do = plan.lanes(do_ref, i, D).astype(jnp.float32)
+        lse = plan.lanes(lse_ref, i, 128)[:, :1]
+        di = jnp.sum(plan.lanes(out_ref, i, D).astype(jnp.float32)
+                     * do, axis=-1, keepdims=True)
+        if glse_ref is not None:
+            di = di - plan.lanes(glse_ref, i, 128)[:, :1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        bt = plan.bias_tile(bias_ref, i)
+        if bt is not None:
+            s = s + bt
+        p = jnp.exp(s - lse)                        # [bq, bk]
+        dv_scr[i] += jax.lax.dot_general(
+            p.astype(do_ref.dtype), plan.lanes(do_ref, i, D),
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - di)
+        dk_scr[i] += scale * jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(q_idx == n_q - 1)
+    def _finish():
+        for i in range(plan.hpb):
+            plan.store_lanes(dk_ref, i, D,
+                             dk_scr[i].astype(dk_ref.dtype))
+            plan.store_lanes(dv_ref, i, D,
+                             dv_scr[i].astype(dv_ref.dtype))
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
 
 def _fa_forward(q, k, v, bias, scale, block_q, block_k,
-                return_lse=False):
-    B, H, Sq, D = q.shape
-    Sk = k.shape[2]
+                return_lse=False, layout="bhsd", raw_lse=False):
+    B, H, Sq, D = _dims(q, layout)
+    Sk = _seq_len(k, layout)
     bq = min(block_q, Sq)
     bk = min(block_k, Sk)
     assert Sq % bq == 0 and Sk % bk == 0, (Sq, Sk, bq, bk)
     n_kv = Sk // bk
-    qr = q.reshape(B * H, Sq, D)
-    kr = k.reshape(B * H, Sk, D)
-    vr = v.reshape(B * H, Sk, D)
+    plan = _Plan(layout, B, H, Sq, Sk, D, bq, bk)
     # under shard_map, outputs inherit the inputs' varying-mesh-axes
     # set (JAX >= 0.9 checks vma on pallas_call out_shapes)
     vma = getattr(jax.typeof(q), "vma", frozenset())
@@ -95,260 +384,188 @@ def _fa_forward(q, k, v, bias, scale, block_q, block_k,
     def _sds(shape, dtype):
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
 
+    grid = plan.grid(Sq // bq, n_kv)
+    qa, ka = plan.seq_axes(swap=False)
+    kv_axis = len(grid) - 1
+
     in_specs = [
-        pl.BlockSpec((1, bq, D), lambda bh, qi, ki: (bh, qi, 0)),
-        pl.BlockSpec((1, bk, D), lambda bh, qi, ki: (bh, ki, 0)),
-        pl.BlockSpec((1, bk, D), lambda bh, qi, ki: (bh, ki, 0)),
+        plan.row_spec(bq, D, qa),
+        plan.row_spec(bk, D, ka),
+        plan.row_spec(bk, D, ka),
     ]
-    args = [qr, kr, vr]
+    args = [plan.rows(q), plan.rows(k), plan.rows(v)]
     if bias is not None:
-        # bias [B, 1|H, 1|Sq, Sk]: head and query dims may broadcast
-        per_head = bias.shape[1] != 1
-        per_q = bias.shape[2] != 1
-        bqs = bq if per_q else 1
-        br = bias.reshape((B * H if per_head else B,
-                           Sq if per_q else 1, Sk))
-        if per_head:
-            def bias_map(bh, qi, ki):
-                return (bh, qi if per_q else 0, ki)
-        else:
-            def bias_map(bh, qi, ki):
-                return (bh // H, qi if per_q else 0, ki)
-        in_specs.append(pl.BlockSpec((1, bqs, bk), bias_map))
+        br, bfac, _, _ = plan.bias_info(bias)
+        in_specs.append(bfac(qa, ka))
         args.append(br)
         has_bias = True
     else:
         has_bias = False
 
+    out_rows = ((B, Sq, H * D) if layout == "bshd"
+                else (B * H, Sq, D))
+    out_specs = [plan.row_spec(bq, D, qa)]
+    out_shape = [_sds(out_rows, q.dtype)]
     if return_lse:
-        if has_bias:
-            def kern(q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref,
-                     m, l, a):
-                return _fa_kernel(q_ref, k_ref, v_ref, b_ref, o_ref,
-                                  lse_ref, m, l, a, scale=scale,
-                                  n_kv=n_kv)
-        else:
-            def kern(q_ref, k_ref, v_ref, o_ref, lse_ref, m, l, a):
-                return _fa_kernel(q_ref, k_ref, v_ref, None, o_ref,
-                                  lse_ref, m, l, a, scale=scale,
-                                  n_kv=n_kv)
-        out_specs = [
-            pl.BlockSpec((1, bq, D), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, bq, 128), lambda bh, qi, ki: (bh, qi, 0)),
-        ]
-        out_shape = [
-            _sds((B * H, Sq, D), q.dtype),
-            _sds((B * H, Sq, 128), jnp.float32),
-        ]
-    else:
-        if has_bias:
-            def kern(q_ref, k_ref, v_ref, b_ref, o_ref, m, l, a):
-                return _fa_kernel(q_ref, k_ref, v_ref, b_ref, o_ref,
-                                  None, m, l, a, scale=scale, n_kv=n_kv)
-        else:
-            def kern(q_ref, k_ref, v_ref, o_ref, m, l, a):
-                return _fa_kernel(q_ref, k_ref, v_ref, None, o_ref,
-                                  None, m, l, a, scale=scale, n_kv=n_kv)
-        out_specs = pl.BlockSpec((1, bq, D),
-                                 lambda bh, qi, ki: (bh, qi, 0))
-        out_shape = _sds((B * H, Sq, D), q.dtype)
+        out_specs.append(plan.wide_spec(bq, qa))
+        out_shape.append(_sds(plan.wide_shape(Sq), jnp.float32))
+
+    def kern(*refs):
+        i = 3
+        b_ref = refs[i] if has_bias else None
+        i += has_bias
+        o_ref = refs[i]
+        i += 1
+        lse_ref = refs[i] if return_lse else None
+        i += return_lse
+        m, l, a = refs[i:i + 3]
+        return _fa_kernel(plan, refs[0], refs[1], refs[2], b_ref,
+                          o_ref, lse_ref, m, l, a, scale=scale,
+                          n_kv=n_kv, kv_axis=kv_axis)
 
     res = pl.pallas_call(
         kern,
-        grid=(B * H, Sq // bq, n_kv),
+        grid=grid,
         in_specs=in_specs,
-        out_specs=out_specs,
-        out_shape=out_shape,
+        out_specs=out_specs if return_lse else out_specs[0],
+        out_shape=out_shape if return_lse else out_shape[0],
         scratch_shapes=[
-            pltpu.VMEM((bq, 128), jnp.float32),
-            pltpu.VMEM((bq, 128), jnp.float32),
-            pltpu.VMEM((bq, D), jnp.float32),
+            pltpu.VMEM((plan.hpb, bq, 128), jnp.float32),
+            pltpu.VMEM((plan.hpb, bq, 128), jnp.float32),
+            pltpu.VMEM((plan.hpb, bq, D), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+            dimension_semantics=("parallel",) * kv_axis
+            + ("arbitrary",)),
         interpret=_INTERPRET,
     )(*args)
+
+    def _out(o):
+        if layout == "bshd":
+            return o.reshape(B, Sq, H, D)
+        return o.reshape(B, H, Sq, D)
+
     if return_lse:
-        out, lse = res
-        return (out.reshape(B, H, Sq, D),
-                lse[:, :, 0].reshape(B, H, Sq))
-    return res.reshape(B, H, Sq, D)
+        out, lse_w = res
+        if raw_lse:
+            # wide carrier form, handed straight back to _fa_backward
+            # (skips a narrow->re-widen round trip)
+            return _out(out), lse_w
+        if layout == "bshd":
+            narrow = lse_w.reshape(B, Sq, H, 128)[..., 0]
+            return _out(out), jnp.moveaxis(narrow, 1, 2)   # [B,H,Sq]
+        return _out(out), lse_w[:, :, 0].reshape(B, H, Sq)
+    return _out(res)
 
 
-def _bias_blockinfo(bias, B, H, Sq, bq, bk):
-    """Shared bias reshaping/index logic for fwd and bwd kernels.
-    Returns (reshaped_bias, block_shape, index_map_factory) where the
-    factory takes (grid order) -> index_map over (bh, q_idx, kv_idx)."""
-    per_head = bias.shape[1] != 1
-    per_q = bias.shape[2] != 1
-    bqs = bq if per_q else 1
-    br = bias.reshape((B * H if per_head else B,
-                       Sq if per_q else 1, bias.shape[3]))
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
 
-    def make_map(order):
-        # order: tuple position of (bh, qi, ki) in the grid args
-        def index_map(*g):
-            bh, qi, ki = g[order[0]], g[order[1]], g[order[2]]
-            return (bh if per_head else bh // H,
-                    qi if per_q else 0, ki)
-        return index_map
-
-    return br, (1, bqs, bk), make_map, per_head, per_q
-
-
-def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, lse_ref, di_ref, do_ref,
-                      bias_ref, dq_ref, ds_ref, dq_scr, *, scale, n_kv):
-    kv_idx = pl.program_id(2)
-
-    @pl.when(kv_idx == 0)
-    def _init():
-        dq_scr[:] = jnp.zeros_like(dq_scr)
-
-    q = q_ref[0]                                    # [bq, D]
-    k = k_ref[0]                                    # [bk, D]
-    v = v_ref[0]
-    do = do_ref[0].astype(jnp.float32)              # [bq, D]
-    lse = lse_ref[0][:, :1]                         # [bq, 1]
-    di = di_ref[0][:, :1]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale
-    if bias_ref is not None:
-        s = s + bias_ref[0].astype(jnp.float32)
-    p = jnp.exp(s - lse)                            # [bq, bk]
-    dp = jax.lax.dot_general(
-        do, v, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    ds = p * (dp - di)
-    if ds_ref is not None:
-        ds_ref[0] = ds.astype(ds_ref.dtype)
-    dq_scr[:] += scale * jax.lax.dot_general(
-        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-
-    @pl.when(kv_idx == n_kv - 1)
-    def _finish():
-        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
-
-
-def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, lse_ref, di_ref, do_ref,
-                       bias_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
-                       scale, n_q):
-    q_idx = pl.program_id(2)
-
-    @pl.when(q_idx == 0)
-    def _init():
-        dk_scr[:] = jnp.zeros_like(dk_scr)
-        dv_scr[:] = jnp.zeros_like(dv_scr)
-
-    q = q_ref[0]
-    k = k_ref[0]
-    v = v_ref[0]
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, :1]
-    di = di_ref[0][:, :1]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale
-    if bias_ref is not None:
-        s = s + bias_ref[0].astype(jnp.float32)
-    p = jnp.exp(s - lse)                            # [bq, bk]
-    dv_scr[:] += jax.lax.dot_general(
-        p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    dp = jax.lax.dot_general(
-        do, v, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    ds = p * (dp - di)
-    dk_scr[:] += scale * jax.lax.dot_general(
-        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-
-    @pl.when(q_idx == n_q - 1)
-    def _finish():
-        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
-        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+def _widen(x_bhs, plan):
+    """Narrow [B,H,S] f32 -> the plan's wide lse carrier."""
+    B, H, Sq = plan.B, plan.H, plan.Sq
+    if plan.layout == "bshd":
+        x = jnp.moveaxis(x_bhs.reshape(B, H, Sq), 1, 2)   # [B,S,H]
+        return jnp.broadcast_to(
+            x[..., None], (B, Sq, H, 128)).reshape(
+                plan.wide_shape(Sq))
+    x = x_bhs.reshape(B * H, Sq)
+    return jnp.broadcast_to(x[..., None], (B * H, Sq, 128))
 
 
 def _fa_backward(q, k, v, bias, out, lse, g, scale, block_q, block_k,
-                 g_lse=None):
+                 g_lse=None, layout="bhsd", lse_wide=False):
     """Kernel-path backward: returns (dq, dk, dv, dbias?).
 
-    g_lse (per-row lse cotangent, [B,H,Sq]) folds into the di term:
-    ds = p*(dp - di + g_lse), so the kernels receive (di - g_lse)."""
-    B, H, Sq, D = q.shape
-    Sk = k.shape[2]
+    lse arrives either in its wide carrier form straight from the
+    forward kernel (lse_wide=True) or narrow [B,H,Sq]. g_lse (per-row
+    lse cotangent, [B,H,Sq]) folds into the di term inside the kernels:
+    ds = p*(dp - (di - g_lse))."""
+    B, H, Sq, D = _dims(q, layout)
+    Sk = _seq_len(k, layout)
     bq = min(block_q, Sq)
     bk = min(block_k, Sk)
     n_q = Sq // bq
     n_kv = Sk // bk
-    qr = q.reshape(B * H, Sq, D)
-    kr = k.reshape(B * H, Sk, D)
-    vr = v.reshape(B * H, Sk, D)
-    dor = g.reshape(B * H, Sq, D)
-    # per-row residuals lane-broadcast to the native 128-wide layout
-    lse_w = jnp.broadcast_to(
-        lse.reshape(B * H, Sq, 1).astype(jnp.float32),
-        (B * H, Sq, 128))
-    di = jnp.sum(out.astype(jnp.float32) * g.astype(jnp.float32),
-                 axis=-1)
+    plan = _Plan(layout, B, H, Sq, Sk, D, bq, bk)
+    qr, kr, vr = plan.rows(q), plan.rows(k), plan.rows(v)
+    dor, outr = plan.rows(g), plan.rows(out)
+    lse_w = lse if lse_wide else _widen(lse.astype(jnp.float32), plan)
+    glse_w = None
     if g_lse is not None:
-        di = di - g_lse.reshape(B, H, Sq).astype(jnp.float32)
-    di_w = jnp.broadcast_to(di.reshape(B * H, Sq, 1), (B * H, Sq, 128))
+        glse_w = _widen(g_lse.reshape(B, H, Sq).astype(jnp.float32),
+                        plan)
     vma = getattr(jax.typeof(q), "vma", frozenset())
 
     def _sds(shape, dtype):
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
 
+    def out_rows(S):
+        return ((B, S, H * D) if layout == "bshd" else (B * H, S, D))
+
+    def _unrows(o, S):
+        if layout == "bshd":
+            return o.reshape(B, S, H, D)
+        return o.reshape(B, H, S, D)
+
     want_dbias = bias is not None
-    if want_dbias:
-        br, bias_blk, bias_map_f, per_head, per_q = _bias_blockinfo(
-            bias, B, H, Sq, bq, bk)
+    has_glse = glse_w is not None
 
-    # ---- dq (+ds when dbias is needed): grid (BH, q, kv) -------------
+    # ---- dq (+ds when dbias is needed): reduction over kv ------------
+    grid = plan.grid(n_q, n_kv)
+    qa, ka = plan.seq_axes(swap=False)
+    kv_axis = len(grid) - 1
     in_specs = [
-        pl.BlockSpec((1, bq, D), lambda bh, qi, ki: (bh, qi, 0)),
-        pl.BlockSpec((1, bk, D), lambda bh, qi, ki: (bh, ki, 0)),
-        pl.BlockSpec((1, bk, D), lambda bh, qi, ki: (bh, ki, 0)),
-        pl.BlockSpec((1, bq, 128), lambda bh, qi, ki: (bh, qi, 0)),
-        pl.BlockSpec((1, bq, 128), lambda bh, qi, ki: (bh, qi, 0)),
-        pl.BlockSpec((1, bq, D), lambda bh, qi, ki: (bh, qi, 0)),
+        plan.row_spec(bq, D, qa),
+        plan.row_spec(bk, D, ka),
+        plan.row_spec(bk, D, ka),
+        plan.wide_spec(bq, qa),
+        plan.row_spec(bq, D, qa),
+        plan.row_spec(bq, D, qa),
     ]
-    args = [qr, kr, vr, lse_w, di_w, dor]
+    args = [qr, kr, vr, lse_w, outr, dor]
+    if has_glse:
+        in_specs.append(plan.wide_spec(bq, qa))
+        args.append(glse_w)
     if want_dbias:
-        in_specs.append(pl.BlockSpec(bias_blk, bias_map_f((0, 1, 2))))
+        br, bfac, per_head, per_q = plan.bias_info(bias)
+        in_specs.append(bfac(qa, ka))
         args.append(br)
-        out_specs = [
-            pl.BlockSpec((1, bq, D), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, bq, bk), lambda bh, qi, ki: (bh, qi, ki)),
-        ]
-        out_shape = [_sds((B * H, Sq, D), q.dtype),
-                     _sds((B * H, Sq, Sk), jnp.float32)]
-
-        def kern_dq(q_r, k_r, v_r, l_r, d_r, do_r, b_r, dq_r, ds_r,
-                    scr):
-            return _fa_bwd_dq_kernel(q_r, k_r, v_r, l_r, d_r, do_r,
-                                     b_r, dq_r, ds_r, scr,
-                                     scale=scale, n_kv=n_kv)
+        out_specs = [plan.row_spec(bq, D, qa),
+                     plan.ds_spec(qa, ka)]
+        out_shape = [_sds(out_rows(Sq), q.dtype),
+                     _sds(plan.ds_shape(), jnp.float32)]
     else:
-        out_specs = pl.BlockSpec((1, bq, D),
-                                 lambda bh, qi, ki: (bh, qi, 0))
-        out_shape = _sds((B * H, Sq, D), q.dtype)
+        out_specs = plan.row_spec(bq, D, qa)
+        out_shape = _sds(out_rows(Sq), q.dtype)
 
-        def kern_dq(q_r, k_r, v_r, l_r, d_r, do_r, dq_r, scr):
-            return _fa_bwd_dq_kernel(q_r, k_r, v_r, l_r, d_r, do_r,
-                                     None, dq_r, None, scr,
-                                     scale=scale, n_kv=n_kv)
+    def kern_dq(*refs):
+        i = 6
+        gl_r = refs[i] if has_glse else None
+        i += has_glse
+        b_r = refs[i] if want_dbias else None
+        i += want_dbias
+        dq_r = refs[i]
+        i += 1
+        ds_r = refs[i] if want_dbias else None
+        i += want_dbias
+        scr = refs[i]
+        return _fa_bwd_dq_kernel(plan, refs[0], refs[1], refs[2],
+                                 refs[3], refs[4], refs[5], gl_r, b_r,
+                                 dq_r, ds_r, scr, scale=scale,
+                                 n_kv=n_kv, kv_axis=kv_axis)
 
     res = pl.pallas_call(
         kern_dq,
-        grid=(B * H, n_q, n_kv),
+        grid=grid,
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
-        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((plan.hpb, bq, D), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+            dimension_semantics=("parallel",) * kv_axis
+            + ("arbitrary",)),
         interpret=_INTERPRET,
     )(*args)
     if want_dbias:
@@ -363,91 +580,140 @@ def _fa_backward(q, k, v, bias, out, lse, g, scale, block_q, block_k,
     else:
         dq = res
         dbias = None
-    dq = dq.reshape(B, H, Sq, D)
+    dq = _unrows(dq, Sq)
 
-    # ---- dk/dv: grid (BH, kv, q) -------------------------------------
+    # ---- dk/dv: reduction over q -------------------------------------
+    grid = plan.grid(n_kv, n_q)
+    qa, ka = plan.seq_axes(swap=True)
+    q_axis = len(grid) - 1
     in_specs = [
-        pl.BlockSpec((1, bq, D), lambda bh, ki, qi: (bh, qi, 0)),
-        pl.BlockSpec((1, bk, D), lambda bh, ki, qi: (bh, ki, 0)),
-        pl.BlockSpec((1, bk, D), lambda bh, ki, qi: (bh, ki, 0)),
-        pl.BlockSpec((1, bq, 128), lambda bh, ki, qi: (bh, qi, 0)),
-        pl.BlockSpec((1, bq, 128), lambda bh, ki, qi: (bh, qi, 0)),
-        pl.BlockSpec((1, bq, D), lambda bh, ki, qi: (bh, qi, 0)),
+        plan.row_spec(bq, D, qa),
+        plan.row_spec(bk, D, ka),
+        plan.row_spec(bk, D, ka),
+        plan.wide_spec(bq, qa),
+        plan.row_spec(bq, D, qa),
+        plan.row_spec(bq, D, qa),
     ]
-    args = [qr, kr, vr, lse_w, di_w, dor]
+    args = [qr, kr, vr, lse_w, outr, dor]
+    if has_glse:
+        in_specs.append(plan.wide_spec(bq, qa))
+        args.append(glse_w)
     if want_dbias:
-        in_specs.append(pl.BlockSpec(bias_blk, bias_map_f((0, 2, 1))))
+        br, bfac, _, _ = plan.bias_info(bias)
+        in_specs.append(bfac(qa, ka))
         args.append(br)
 
-        def kern_dkv(q_r, k_r, v_r, l_r, d_r, do_r, b_r, dk_r, dv_r,
-                     ks, vs):
-            return _fa_bwd_dkv_kernel(q_r, k_r, v_r, l_r, d_r, do_r,
-                                      b_r, dk_r, dv_r, ks, vs,
-                                      scale=scale, n_q=n_q)
-    else:
-        def kern_dkv(q_r, k_r, v_r, l_r, d_r, do_r, dk_r, dv_r, ks,
-                     vs):
-            return _fa_bwd_dkv_kernel(q_r, k_r, v_r, l_r, d_r, do_r,
-                                      None, dk_r, dv_r, ks, vs,
-                                      scale=scale, n_q=n_q)
+    def kern_dkv(*refs):
+        i = 6
+        gl_r = refs[i] if has_glse else None
+        i += has_glse
+        b_r = refs[i] if want_dbias else None
+        i += want_dbias
+        dk_r, dv_r, ks, vs = refs[i:i + 4]
+        return _fa_bwd_dkv_kernel(plan, refs[0], refs[1], refs[2],
+                                  refs[3], refs[4], refs[5], gl_r,
+                                  b_r, dk_r, dv_r, ks, vs,
+                                  scale=scale, n_q=n_q, q_axis=q_axis)
 
     dk, dv = pl.pallas_call(
         kern_dkv,
-        grid=(B * H, n_kv, n_q),
+        grid=grid,
         in_specs=in_specs,
-        out_specs=[
-            pl.BlockSpec((1, bk, D), lambda bh, ki, qi: (bh, ki, 0)),
-            pl.BlockSpec((1, bk, D), lambda bh, ki, qi: (bh, ki, 0)),
-        ],
-        out_shape=[_sds((B * H, Sk, D), k.dtype),
-                   _sds((B * H, Sk, D), v.dtype)],
-        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
-                        pltpu.VMEM((bk, D), jnp.float32)],
+        out_specs=[plan.row_spec(bk, D, ka),
+                   plan.row_spec(bk, D, ka)],
+        out_shape=[_sds(out_rows(Sk), k.dtype),
+                   _sds(out_rows(Sk), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((plan.hpb, bk, D), jnp.float32),
+                        pltpu.VMEM((plan.hpb, bk, D), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+            dimension_semantics=("parallel",) * q_axis
+            + ("arbitrary",)),
         interpret=_INTERPRET,
     )(*args)
-    return (dq, dk.reshape(B, H, Sk, D), dv.reshape(B, H, Sk, D),
-            dbias)
+    return dq, _unrows(dk, Sk), _unrows(dv, Sk), dbias
 
 
-def _kernel_ok(q, k, block_q, block_k):
-    Sq, Sk = q.shape[2], k.shape[2]
+def _kernel_ok(q, k, block_q, block_k, layout="bhsd"):
+    import os
+    if os.environ.get("PT_FORCE_COMPOSED"):   # A/B-measurement knob
+        return False
+    Sq, Sk = _seq_len(q, layout), _seq_len(k, layout)
+    D = q.shape[3]
+    if layout == "bshd":
+        H = q.shape[2]
+        hpb = _heads_per_block(H, D)
+        # real Mosaic requires strict 128-lane (or full-minor) blocks;
+        # the interpreter does not care, which lets CPU tests cover
+        # small shapes
+        if not _INTERPRET and (hpb * D) % 128 != 0:
+            return False
     return (Sq % min(block_q, Sq) == 0 and Sk % min(block_k, Sk) == 0
-            and q.shape[3] % 8 == 0
+            and D % 8 == 0
             and (_INTERPRET or jax.default_backend() != "cpu"))
 
 
-# Backward dispatch: the kernel backward's win is MEMORY (no [Sq, Sk]
-# score tensor in HBM); measured on the chip, XLA's fused composed
-# backward is the faster choice while the score tensor is small (at the
-# headline shape B=96 H=8 S=128 it is ~30% faster). Switch to the
-# kernel once the batched score matrix crosses ~1 GB in f32 — the
-# regime where the composed backward starts to thrash or OOM HBM.
-_KERNEL_BWD_MIN_SCORE_ELEMS = 2 ** 28
+# Kernel-vs-composed dispatch: the Pallas kernels' win is MEMORY (no
+# [Sq, Sk] score tensor in HBM — 0.27 GB vs 4.30 GB composed temp at
+# B=4 H=8 S=4096); while the batched score matrix is small, XLA's
+# fully-fused composed attention is FASTER on both passes. r4 A/B on
+# transformer-base (B=96 H=8 S=128, bf16 stream, bshd layout):
+# composed fwd+bwd 215.5k tokens/s / 57.0 ms step; kernel fwd +
+# composed bwd 190.1k / 64.8 ms; kernel fwd+bwd 158.0k / 77.8 ms —
+# the D=64 contractions underfill the MXU and every custom-call
+# boundary blocks XLA fusion. Above ~2^28 batched score elements
+# (~1 GB f32) the composed path thrashes/OOMs HBM and the kernels
+# take over; interpret mode always uses the kernels so CPU tests
+# cover them.
+_KERNEL_MIN_SCORE_ELEMS = 2 ** 28
+_KERNEL_BWD_MIN_SCORE_ELEMS = _KERNEL_MIN_SCORE_ELEMS  # back-compat
 
 
-def _use_kernel_bwd(q, k, block_q, block_k):
-    if not _kernel_ok(q, k, block_q, block_k):
+def _score_elems(q, k, layout):
+    B = q.shape[0]
+    H = q.shape[2] if layout == "bshd" else q.shape[1]
+    return B * H * _seq_len(q, layout) * _seq_len(k, layout)
+
+
+def use_kernel_path(q, k, block_q=128, block_k=128, layout="bhsd"):
+    """True when the fused-attention op should route through the Pallas
+    kernels rather than the composed einsum formulation."""
+    if not _kernel_ok(q, k, block_q, block_k, layout):
         return False
     if _INTERPRET:
         return True
-    B, H, Sq, _ = q.shape
-    return B * H * Sq * k.shape[2] >= _KERNEL_BWD_MIN_SCORE_ELEMS
+    return _score_elems(q, k, layout) >= _KERNEL_MIN_SCORE_ELEMS
 
 
-def _attn_reference(q, k, v, bias, scale):
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+def _use_kernel_bwd(q, k, block_q, block_k, layout="bhsd"):
+    return use_kernel_path(q, k, block_q, block_k, layout)
+
+
+def _attn_reference(q, k, v, bias, scale, layout="bhsd",
+                    dropout=None):
+    """Composed attention. dropout = (key, t) applies u8-threshold
+    attention-weights dropout with exact-realized-probability upscale
+    (same contract as the dropout op, ops/nn.py)."""
+    eq = "bqhd,bkhd->bhqk" if layout == "bshd" else "bhqd,bhkd->bhqk"
+    s = jnp.einsum(eq, q, k,
                    preferred_element_type=jnp.float32) * scale
     if bias is not None:
         s = s + bias.astype(jnp.float32)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    if dropout is not None:
+        key, t = dropout
+        state = jax.lax.bitcast_convert_type(
+            jnp.concatenate([key, key ^ jnp.uint32(0x9E3779B9)]),
+            jnp.uint32).reshape(4)
+        _, bits = jax.lax.rng_bit_generator(state, p.shape,
+                                            dtype=jnp.uint8)
+        p = jnp.where(bits < jnp.uint8(t), p / (t / 256.0), 0.0)
+    eo = "bhqk,bkhd->bqhd" if layout == "bshd" else "bhqk,bhkd->bhqd"
+    return jnp.einsum(eo, p, v)
 
 
 def _attn_reference_lse(q, k, v, bias, scale):
-    """Composed attention that also returns logsumexp over keys —
-    the CPU/odd-shape counterpart of the kernel's return_lse mode."""
+    """Composed attention ([B,H,S,D] only) that also returns logsumexp
+    over keys — the CPU/odd-shape counterpart of return_lse mode."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
     if bias is not None:
@@ -461,31 +727,49 @@ def _attn_reference_lse(q, k, v, bias, scale):
     return out, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
 def flash_attention(q, k, v, bias=None, scale=1.0, block_q=128,
-                    block_k=128):
-    """q [B,H,Sq,D], k/v [B,H,Sk,D], bias [B,1|H,Sq,Sk] additive."""
-    return _fa_forward(q, k, v, bias, scale, block_q, block_k)
+                    block_k=128, layout="bhsd"):
+    """q [B,H,Sq,D] (bhsd) or [B,Sq,H,D] (bshd); k/v likewise;
+    bias [B,1|H,Sq|1,Sk] additive in either layout."""
+    if _kernel_ok(q, k, block_q, block_k, layout):
+        return _fa_forward(q, k, v, bias, scale, block_q, block_k,
+                           layout=layout)
+    qb, kb, vb = q, k, v
+    if layout == "bshd":
+        qb, kb, vb = (jnp.moveaxis(x, 2, 1) for x in (q, k, v))
+    out = _attn_reference(qb, kb, vb, bias, scale)
+    return jnp.moveaxis(out, 1, 2) if layout == "bshd" else out
 
 
-def _fa_fwd(q, k, v, bias, scale, block_q, block_k):
-    if _kernel_ok(q, k, block_q, block_k):
+def _fa_fwd(q, k, v, bias, scale, block_q, block_k, layout):
+    if _kernel_ok(q, k, block_q, block_k, layout):
+        # lse residual stays in the kernel's wide carrier layout;
+        # _kernel_ok is static, so _fa_bwd re-derives the same branch
         out, lse = _fa_forward(q, k, v, bias, scale, block_q, block_k,
-                               return_lse=True)
+                               return_lse=True, layout=layout,
+                               raw_lse=True)
     else:
-        out, lse = _attn_reference_lse(q, k, v, bias, scale)
+        qb, kb, vb = q, k, v
+        if layout == "bshd":
+            qb, kb, vb = (jnp.moveaxis(x, 2, 1) for x in (q, k, v))
+        out, lse = _attn_reference_lse(qb, kb, vb, bias, scale)
+        if layout == "bshd":
+            out = jnp.moveaxis(out, 1, 2)
     return out, (q, k, v, bias, out, lse)
 
 
-def _fa_bwd(scale, block_q, block_k, res, g):
+def _fa_bwd(scale, block_q, block_k, layout, res, g):
     q, k, v, bias, out, lse = res
-    if _use_kernel_bwd(q, k, block_q, block_k):
-        dq, dk, dv, dbias = _fa_backward(q, k, v, bias, out, lse, g,
-                                         scale, block_q, block_k)
+    if _use_kernel_bwd(q, k, block_q, block_k, layout):
+        dq, dk, dv, dbias = _fa_backward(
+            q, k, v, bias, out, lse, g, scale, block_q, block_k,
+            layout=layout,
+            lse_wide=_kernel_ok(q, k, block_q, block_k, layout))
         return dq, dk, dv, dbias
 
     def f(q, k, v, bias):
-        return _attn_reference(q, k, v, bias, scale)
+        return _attn_reference(q, k, v, bias, scale, layout=layout)
     _, vjp = jax.vjp(f, q, k, v, bias)
     dq, dk, dv, dbias = vjp(g)
     return dq, dk, dv, None if bias is None else dbias
@@ -497,11 +781,7 @@ flash_attention.defvjp(_fa_fwd, _fa_bwd)
 def _lse_dispatch(q, k, v, bias, scale, block_q, block_k):
     """Kernel when the shapes tile onto the MXU (or interpret mode is
     forced for CPU tests), composed formulation otherwise."""
-    Sq, Sk = q.shape[2], k.shape[2]
-    use_kernel = (Sq % block_q == 0 and Sk % block_k == 0
-                  and q.shape[3] % 8 == 0
-                  and (_INTERPRET or jax.default_backend() != "cpu"))
-    if use_kernel:
+    if _kernel_ok(q, k, block_q, block_k):
         return _fa_forward(q, k, v, bias, scale, block_q, block_k,
                            return_lse=True)
     return _attn_reference_lse(q, k, v, bias, scale)
@@ -510,11 +790,11 @@ def _lse_dispatch(q, k, v, bias, scale, block_q, block_k):
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
 def flash_attention_lse(q, k, v, bias=None, scale=1.0, block_q=128,
                         block_k=128):
-    """Flash attention returning (out, lse) — the block primitive for
-    ring attention's online-softmax merge. Differentiable on every
-    backend: the backward recomputes through the composed lse-emitting
-    formulation (handles nonzero cotangents on BOTH outputs, since the
-    ring merge arithmetic uses lse downstream)."""
+    """Flash attention ([B,H,S,D]) returning (out, lse) — the block
+    primitive for ring attention's online-softmax merge. Differentiable
+    on every backend: the backward recomputes through the composed
+    lse-emitting formulation (handles nonzero cotangents on BOTH
+    outputs, since the ring merge arithmetic uses lse downstream)."""
     return _lse_dispatch(q, k, v, bias, scale, block_q, block_k)
 
 
@@ -528,8 +808,8 @@ def _fal_bwd(scale, block_q, block_k, res, g):
     g_out, g_lse = g
     if _use_kernel_bwd(q, k, block_q, block_k):
         # the lse cotangent folds into the per-row correction term:
-        # dlse/ds = p, so ds = p*(dp - di + g_lse) — pass (di - g_lse)
-        # where the kernel expects di
+        # dlse/ds = p, so ds = p*(dp - di + g_lse) — the kernels
+        # subtract the widened g_lse from di
         dq, dk, dv, dbias = _fa_backward(
             q, k, v, bias, out, lse, g_out, scale, block_q, block_k,
             g_lse=g_lse)

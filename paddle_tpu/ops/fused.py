@@ -14,12 +14,16 @@ from ..core.amp import amp_cast
 
 @register_op("fused_attention")
 def fused_attention(ctx):
-    """Q/K/V: [B, H, S, D]; optional BiasQK [B, 1|H, Sq, Sk] additive.
-    attrs: scale (default d^-0.5), block_q, block_k."""
+    """Q/K/V: [B, H, S, D] (layout "bhsd") or [B, S, H, D] ("bshd");
+    optional BiasQK [B, 1|H, Sq|1, Sk] additive.
+    attrs: scale (default d^-0.5), block_q, block_k, layout,
+    dropout_prob (attention-weights dropout; composed regime only —
+    the Pallas long-context kernels run dropout-free and warn)."""
     from ..kernels.flash_attention import flash_attention, \
-        _attn_reference
+        _attn_reference, use_kernel_path
     q, k, v = ctx.input("Q"), ctx.input("K"), ctx.input("V")
     bias = ctx.input("BiasQK") if ctx.has_input("BiasQK") else None
+    layout = ctx.attr("layout", "bhsd") or "bhsd"
     scale = ctx.attr("scale", None)
     if scale is None or scale <= 0:
         scale = float(q.shape[-1]) ** -0.5
@@ -27,15 +31,27 @@ def fused_attention(ctx):
     q, k, v = amp_cast("fused_attention", q, k, v)
     bq = int(ctx.attr("block_q", 128))
     bk = int(ctx.attr("block_k", 128))
-    Sq, Sk = q.shape[2], k.shape[2]
-    use_pallas = (jax.default_backend() != "cpu"
-                  and Sq % min(bq, Sq) == 0 and Sk % min(bk, Sk) == 0
-                  and q.shape[-1] % 8 == 0)
-    if use_pallas:
-        out = flash_attention(q, k, v, bias, scale, bq, bk)
+    p_drop = float(ctx.attr("dropout_prob", 0.0) or 0.0)
+    is_test = ctx.attr("is_test", False)
+    if use_kernel_path(q, k, bq, bk, layout):
+        # long-context regime: Pallas flash kernels, O(S) HBM
+        if p_drop and not is_test:
+            import warnings
+            warnings.warn(
+                "fused_attention: attention-weights dropout is not "
+                "applied on the long-context Pallas kernel path",
+                stacklevel=2)
+        out = flash_attention(q, k, v, bias, scale, bq, bk, layout)
     else:
-        # CPU / odd-shape fallback: composed formulation (same math)
-        out = _attn_reference(q, k, v, bias, scale)
+        # shape-bounded regime / CPU / odd shapes: XLA's fully-fused
+        # composed formulation is faster while [Sq,Sk] fits (see the
+        # measured dispatch table in kernels/flash_attention.py)
+        drop = None
+        if p_drop and not is_test:
+            t = max(1, min(int(round((1.0 - p_drop) * 256.0)), 255))
+            drop = (ctx.rng(), t)
+        out = _attn_reference(q, k, v, bias, scale, layout=layout,
+                              dropout=drop)
     ctx.set_output("Out", out.astype(res_t))
 
 

@@ -82,6 +82,47 @@ def test_flash_lse_backward_kernel_with_lse_cotangent():
                                    atol=2e-4, rtol=2e-4)
 
 
+@pytest.mark.parametrize("bias_mode", ["none", "per_batch"])
+def test_bshd_layout_matches_bhsd(bias_mode):
+    """The transpose-free [B,S,H,D] layout must produce identical
+    outputs and grads to the classic [B,H,S,D] path (same kernels,
+    different BlockSpec index maps)."""
+    rng = np.random.default_rng(3)
+    B, H, Sq, Sk, D = 2, 2, 128, 128, 16
+    q, k, v = _rand(rng, B, H, Sq, D), _rand(rng, B, H, Sk, D), \
+        _rand(rng, B, H, Sk, D)
+    bias = None if bias_mode == "none" else _rand(rng, B, 1, Sq, Sk)
+    scale = float(D) ** -0.5
+
+    def loss_bhsd(q, k, v, bias):
+        return (fa.flash_attention(q, k, v, bias, scale, 128, 128,
+                                   "bhsd") ** 2).sum()
+
+    def loss_bshd(q, k, v, bias):
+        out = fa.flash_attention(
+            jnp.moveaxis(q, 1, 2), jnp.moveaxis(k, 1, 2),
+            jnp.moveaxis(v, 1, 2), bias, scale, 128, 128, "bshd")
+        return (out ** 2).sum()
+
+    o1 = fa.flash_attention(q, k, v, bias, scale, 128, 128, "bhsd")
+    o2 = fa.flash_attention(
+        jnp.moveaxis(q, 1, 2), jnp.moveaxis(k, 1, 2),
+        jnp.moveaxis(v, 1, 2), bias, scale, 128, 128, "bshd")
+    np.testing.assert_allclose(np.asarray(o1),
+                               np.asarray(jnp.moveaxis(o2, 1, 2)),
+                               atol=1e-5, rtol=1e-5)
+    g1 = jax.grad(loss_bhsd, (0, 1, 2))(q, k, v, bias)
+    g2 = jax.grad(loss_bshd, (0, 1, 2))(q, k, v, bias)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-4)
+    if bias is not None:
+        gb1 = jax.grad(loss_bhsd, 3)(q, k, v, bias)
+        gb2 = jax.grad(loss_bshd, 3)(q, k, v, bias)
+        np.testing.assert_allclose(np.asarray(gb1), np.asarray(gb2),
+                                   atol=2e-4, rtol=2e-4)
+
+
 def test_backward_never_materializes_scores_in_hbm():
     """Structural assertion: with the kernel path and no bias, the jitted
     backward's HLO contains no [Sq, Sk]-shaped intermediate (the O(S^2)

@@ -35,7 +35,8 @@ def build_transformer(batch=96, s=128, vocab=32000):
     return main_prog, startup, batch_d, [cost.name]
 
 
-def build_resnet50(batch=64):
+def build_resnet50(batch=None):
+    batch = batch or int(os.environ.get("RN_BATCH", "128"))
     import numpy as np
     import paddle_tpu as fluid
     from paddle_tpu import models
