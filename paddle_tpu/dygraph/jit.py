@@ -49,10 +49,26 @@ __all__ = ["capture", "CapturedFunction"]
 
 class CapturedFunction:
     def __init__(self, fn, optimizer=None, extra_state=None,
-                 device=None):
+                 device=None, amp=False, amp_dtype="bfloat16",
+                 amp_lists=None):
         self.fn = fn
         self.optimizer = optimizer
         self.extra_state = dict(extra_state or {})
+        # mixed precision: the dygraph tracer dispatches through the
+        # same ExecContext as graph mode, so activating the central AMP
+        # policy (core/amp.py) around the traced step gives the
+        # identical bf16 activation stream + fp32 master params —
+        # forward, tape backward AND optimizer update are all inside
+        # the capture, so the whole step computes under one policy
+        self.amp = bool(amp)
+        self._amp_dtype = jnp.float16 \
+            if amp_dtype in ("float16", "fp16") else jnp.bfloat16
+        if amp_lists is None:
+            from ..contrib.mixed_precision.fp16_lists import \
+                AutoMixedPrecisionLists
+            amp_lists = AutoMixedPrecisionLists()
+        self._amp_black = frozenset(amp_lists.black_list)
+        self._amp_white = frozenset(amp_lists.white_list)
         # target device for the compiled step; lets the
         # state-materializing eager call run under a CPU-place guard
         # (per-op dispatch on a tunneled TPU pays a remote compile per
@@ -114,8 +130,10 @@ class CapturedFunction:
         tracer._tape = []
         tracer._abstract = True
         try:
-            self.fn(*[VarBase(jax.ShapeDtypeStruct(a.shape, a.dtype),
-                              stop_gradient=True) for a in arrs])
+            with self._amp_cm():
+                self.fn(*[VarBase(
+                    jax.ShapeDtypeStruct(a.shape, a.dtype),
+                    stop_gradient=True) for a in arrs])
         finally:
             tracer._abstract = False
             tracer.trace_op = orig_trace_op
@@ -127,6 +145,14 @@ class CapturedFunction:
             vb.grad = None
             if self.device is not None:
                 vb.value = jax.device_put(vb.value, self.device)
+
+    def _amp_cm(self):
+        if not self.amp:
+            import contextlib
+            return contextlib.nullcontext()
+        from ..core.amp import amp_guard
+        return amp_guard(True, self._amp_dtype, self._amp_black,
+                         self._amp_white)
 
     # ---- call ------------------------------------------------------------
     def __call__(self, *args):
@@ -154,8 +180,9 @@ class CapturedFunction:
                     for n in names:
                         self._state[n].value = state[n]
                     tracer._rng_key = key
-                    outs = self.fn(*[VarBase(a, stop_gradient=True)
-                                     for a in ins])
+                    with self._amp_cm():
+                        outs = self.fn(*[VarBase(a, stop_gradient=True)
+                                         for a in ins])
                     flat, treedef = jax.tree_util.tree_flatten(
                         outs, is_leaf=lambda x: isinstance(x, VarBase))
                     structure_box["treedef"] = treedef
@@ -185,14 +212,20 @@ class CapturedFunction:
                                             out_vbs)
 
 
-def capture(fn=None, optimizer=None, extra_state=None, device=None):
+def capture(fn=None, optimizer=None, extra_state=None, device=None,
+            amp=False, amp_dtype="bfloat16", amp_lists=None):
     """Decorator/factory: `capture(step_fn, optimizer=opt)` or
 
-        @dygraph.jit.capture(optimizer=opt)
+        @dygraph.jit.capture(optimizer=opt, amp=True)
         def step(x, y): ...
-    """
+
+    amp=True traces the step under the central mixed-precision policy
+    (bf16 activation stream, fp32 master params — same semantics as
+    contrib.mixed_precision.decorate on the graph path)."""
     if fn is None:
         def deco(f):
-            return CapturedFunction(f, optimizer, extra_state, device)
+            return CapturedFunction(f, optimizer, extra_state, device,
+                                    amp, amp_dtype, amp_lists)
         return deco
-    return CapturedFunction(fn, optimizer, extra_state, device)
+    return CapturedFunction(fn, optimizer, extra_state, device, amp,
+                            amp_dtype, amp_lists)

@@ -53,6 +53,20 @@ class LayerHelper:
         attr = ParamAttr._to_attr(attr)
         if attr is False:
             return None
+        # Under an active AMP trace (dygraph lazy creation), a param
+        # whose dtype follows a bf16 activation would be BORN bf16 and
+        # its optimizer state with it — parameters are master weights
+        # and stay f32; the white/gray policy casts them at use sites.
+        from .core.amp import amp_enabled
+        if amp_enabled():
+            import numpy as _np
+            from .core.types import dtype_to_np
+            try:
+                name = _np.dtype(dtype_to_np(dtype)).name
+            except (TypeError, ValueError, KeyError):
+                name = str(dtype)
+            if name in ("bfloat16", "float16"):
+                dtype = "float32"
         if not attr.name:
             attr.name = unique_name.generate(
                 f"{self.name}.b" if is_bias else f"{self.name}.w")

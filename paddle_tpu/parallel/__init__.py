@@ -12,4 +12,5 @@ from .strategy import (  # noqa: F401
     transformer_rules, transformer_feed_rules, ctr_rules,
 )
 from .pipeline import PipelineEngine  # noqa: F401
+from .mpmd_pipeline import MPMDPipelineEngine  # noqa: F401
 from .ring_attention import ring_attention  # noqa: F401

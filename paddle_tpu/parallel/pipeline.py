@@ -33,9 +33,12 @@ stage slice — and params, grads and moments stay sharded end to end.
 Shared (multi-stage) params and any non-conforming case fall back to
 replicated WITH A WARNING naming them (the memory win must never
 degrade silently). Stage activations must share one shape (uniform
-transformer-style stages); ResNet-style heterogeneous stages need the
-reference's MPMD section model, which SPMD shard_map cannot express —
-use dp/mp sharding for those.
+transformer-style stages); ResNet-style heterogeneous stages and
+tied (multi-stage) parameters are served by the MPMD engine in
+parallel/mpmd_pipeline.py (per-stage executables + host schedule —
+the reference's section/queue model), which has no uniformity
+requirement; this SPMD engine remains the fast path for uniform
+stages.
 """
 from __future__ import annotations
 

@@ -1,0 +1,155 @@
+"""MPMD pipeline (parallel/mpmd_pipeline.py): heterogeneous stages as
+per-stage executables — the reference's PipelineTrainer/SectionWorker
+model (pipeline_trainer.cc:35-48). VERDICT r3 #5: a ResNet-style
+conv->fc pipeline (stage shapes differ) must train and match
+single-device training; a parameter shared across stages (tied
+embedding) must get its gradient summed, not fall back to replication.
+"""
+import numpy as np
+import unittest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.scope import Scope
+from paddle_tpu.parallel.mpmd_pipeline import MPMDPipelineEngine
+
+
+def _build_conv_fc():
+    """Stage 0: conv+pool (NCHW image); stage 1: flatten+fc+loss.
+    Activation shapes differ per stage — inexpressible in the SPMD
+    GPipe engine."""
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", [1, 12, 12],
+                                dtype="float32")
+        lbl = fluid.layers.data("lbl", [1], dtype="int64")
+        c = fluid.layers.conv2d(
+            img, num_filters=4, filter_size=3, padding=1, act="relu",
+            param_attr=fluid.ParamAttr(name="c.w"),
+            bias_attr=fluid.ParamAttr(name="c.b"))
+        p = fluid.layers.pool2d(c, pool_size=2, pool_type="max",
+                                pool_stride=2)
+        cut = p
+        fc = fluid.layers.fc(
+            p, 10, param_attr=fluid.ParamAttr(name="f.w"),
+            bias_attr=fluid.ParamAttr(name="f.b"))
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(fc, lbl))
+    opt = fluid.optimizer.PipelineOptimizer(
+        fluid.optimizer.SGD(learning_rate=0.1),
+        cut_list=[cut], num_microbatches=4)
+    with fluid.program_guard(main, startup):
+        opt.minimize(loss, startup_program=startup)
+    return main, startup, loss, [cut.name], opt
+
+
+class TestMPMDPipeline(unittest.TestCase):
+    def test_conv_fc_matches_single_device(self):
+        rng = np.random.RandomState(0)
+        B = 8
+        img = rng.rand(B, 1, 12, 12).astype(np.float32)
+        lbl = rng.randint(0, 10, (B, 1)).astype(np.int64)
+
+        # ---- MPMD pipeline, 2 heterogeneous stages -------------------
+        main, startup, loss, cuts, popt = _build_conv_fc()
+        scope = Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            eng = MPMDPipelineEngine(
+                main, loss.name, cuts,
+                optimizer_program=popt.opt_program, num_microbatches=4)
+            losses = [eng.run(scope, {"img": img, "lbl": lbl})
+                      for _ in range(5)]
+            w_pipe = np.asarray(scope.find_var("f.w").get_value())
+        self.assertLess(losses[-1], losses[0])
+
+        # ---- single-device reference: same model, same big batch -----
+        main2, startup2, loss2, _, _ = _build_conv_fc()
+        fluid.framework.unique_name.reset()
+        m2, s2 = fluid.Program(), fluid.Program()
+        with fluid.program_guard(m2, s2):
+            img_v = fluid.layers.data("img", [1, 12, 12],
+                                      dtype="float32")
+            lbl_v = fluid.layers.data("lbl", [1], dtype="int64")
+            c = fluid.layers.conv2d(
+                img_v, num_filters=4, filter_size=3, padding=1,
+                act="relu", param_attr=fluid.ParamAttr(name="c.w"),
+                bias_attr=fluid.ParamAttr(name="c.b"))
+            p = fluid.layers.pool2d(c, pool_size=2, pool_type="max",
+                                    pool_stride=2)
+            fc = fluid.layers.fc(
+                p, 10, param_attr=fluid.ParamAttr(name="f.w"),
+                bias_attr=fluid.ParamAttr(name="f.b"))
+            l2 = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(fc, lbl_v))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(l2)
+        scope2 = Scope()
+        with fluid.scope_guard(scope2):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(s2)
+            ref_losses = []
+            for _ in range(5):
+                out, = exe.run(m2, feed={"img": img, "lbl": lbl},
+                               fetch_list=[l2.name])
+                ref_losses.append(float(out))
+            w_ref = np.asarray(scope2.find_var("f.w").get_value())
+
+        # microbatched grad mean == big-batch grad for mean losses,
+        # so the parameter trajectories must agree
+        np.testing.assert_allclose(w_pipe, w_ref, rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(losses, ref_losses, rtol=2e-3,
+                                   atol=2e-4)
+
+    def test_shared_param_grad_sums_across_stages(self):
+        """Tied weight used in stage 0 (embedding lookup) AND stage 1
+        (output projection via matmul) — the MPMD engine must sum both
+        stages' grads and apply ONE update."""
+        V, D = 12, 6
+        fluid.framework.unique_name.reset()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            ids = fluid.layers.data("ids", [4], dtype="int64")
+            lbl = fluid.layers.data("lbl2", [4], dtype="int64")
+            emb = fluid.layers.embedding(
+                ids, size=[V, D],
+                param_attr=fluid.ParamAttr(name="tied.w"))
+            h = fluid.layers.scale(emb, scale=1.0)
+            cut = h
+            # stage 1: project back onto the SAME table (weight tying)
+            w = main.global_block().var("tied.w")
+            logits = fluid.layers.matmul(h, w, transpose_y=True)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(
+                    logits, fluid.layers.unsqueeze(lbl, axes=[2])))
+        popt = fluid.optimizer.PipelineOptimizer(
+            fluid.optimizer.SGD(learning_rate=0.2),
+            cut_list=[cut], num_microbatches=2)
+        with fluid.program_guard(main, startup):
+            popt.minimize(loss, startup_program=startup)
+
+        rng = np.random.RandomState(1)
+        ids_np = rng.randint(0, V, (4, 4)).astype(np.int64)
+        lbl_np = rng.randint(0, V, (4, 4)).astype(np.int64)
+
+        scope = Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            eng = MPMDPipelineEngine(
+                main, loss.name, [cut.name],
+                optimizer_program=popt.opt_program, num_microbatches=2)
+            w0 = np.asarray(scope.find_var("tied.w").get_value()).copy()
+            l0 = eng.run(scope, {"ids": ids_np, "lbl2": lbl_np})
+            w1 = np.asarray(scope.find_var("tied.w").get_value())
+            # the tied param must appear in BOTH stages' param sets
+            self.assertIn("tied.w", eng._s_params[0])
+            self.assertIn("tied.w", eng._s_params[1])
+            self.assertGreater(np.abs(w1 - w0).max(), 0)
+            losses = [eng.run(scope, {"ids": ids_np, "lbl2": lbl_np})
+                      for _ in range(6)]
+        self.assertLess(losses[-1], l0)
+
+
+if __name__ == "__main__":
+    unittest.main()
