@@ -26,20 +26,26 @@ from .backward import append_backward, gradients  # noqa: F401
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
 from .executor import Executor, global_scope, scope_guard  # noqa: F401
 from .core.place import (  # noqa: F401
-    CPUPlace, TPUPlace, CUDAPlace, is_compiled_with_tpu, default_place,
+    CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace,
+    is_compiled_with_tpu, default_place,
+    cpu_places, cuda_places, tpu_places, cuda_pinned_places,
 )
 from .core.scope import (  # noqa: F401
     Scope, LoDTensor, create_lod_tensor,
 )
+from .core.scope import TensorArray as LoDTensorArray  # noqa: F401
 from .core import scope as core  # compatibility alias module-ish
 from .compiler import (  # noqa: F401
     CompiledProgram, BuildStrategy, ExecutionStrategy,
 )
+from .parallel_executor import ParallelExecutor  # noqa: F401
+from . import recordio_writer  # noqa: F401
+from . import unique_name  # noqa: F401
 from . import io  # noqa: F401
 from . import metrics  # noqa: F401
 from . import profiler  # noqa: F401
 from . import reader  # noqa: F401
-from .reader.decorators import DataFeeder  # noqa: F401
+from .reader.decorators import DataFeeder, DataFeedDesc  # noqa: F401
 from . import dygraph  # noqa: F401
 from . import parallel  # noqa: F401
 from . import contrib  # noqa: F401

@@ -175,6 +175,16 @@ class CompiledProgram:
         self._places = places
         return self
 
+    def with_inference_optimize(self, config):
+        """Reference CompiledProgram.with_inference_optimize: apply the
+        inference engine's config to this program. The whole-block XLA
+        engine already compiles the maximal fused executable, so the
+        analysis-pass side is subsumed; the AnalysisConfig is recorded
+        and honored by inference.AnalysisPredictor when this compiled
+        program is handed to it."""
+        self._inference_config = config
+        return self
+
     def _run(self, executor, feed, fetch_names, scope, return_numpy):
         from .parallel.data_parallel import DataParallelEngine
         if not getattr(self, "_strategies_validated", False):

@@ -5,3 +5,14 @@ Parity: reference python/paddle/fluid/contrib/ (SURVEY §2.6 row contrib).
 from . import mixed_precision  # noqa: F401
 from . import slim  # noqa: F401
 from . import utils  # noqa: F401
+from .utils.hdfs_utils import (  # noqa: F401
+    HDFSClient, multi_download, multi_upload)
+from .slim.core.compressor import Compressor  # noqa: F401
+from .slim.quantization import QuantizeTranspiler  # noqa: F401
+from .decoder import InitState, StateCell, TrainingDecoder  # noqa: F401
+from .extend import (  # noqa: F401
+    BasicGRUUnit, BasicLSTMUnit, basic_gru, basic_lstm,
+    memory_usage, op_freq_statistic,
+    extend_with_decoupled_weight_decay, fused_elemwise_activation,
+    distributed_batch_reader, convert_dist_to_sparse_program,
+    load_persistables_for_increment, load_persistables_for_inference)

@@ -1,0 +1,218 @@
+"""contrib seq2seq decoder API (reference
+fluid/contrib/decoder/beam_search_decoder.py: InitState, StateCell,
+TrainingDecoder — the pre-layers.beam_search decoder construction kit).
+
+TPU-native redesign: the reference builds these on StaticRNN blocks and
+per-step array ops; here the TrainingDecoder unrolls statically over the
+(padded, dense) time axis — the XLA-friendly form this framework uses
+everywhere LoD ragged input would appear — while keeping the reference's
+programming model intact: a StateCell holds named states, the user
+registers @state_updater, step inputs arrive via get_input, outputs
+collect per step. Inference-time beam search lives in
+layers.beam_search/beam_search_decode (ops/beam_search.py, tested
+against brute force in tests/test_beam_search.py); the contrib
+BeamSearchDecoder class itself is not carried — see
+docs/API_SPEC_ACCOUNTING.md.
+"""
+from __future__ import annotations
+
+from .. import layers
+
+__all__ = ["InitState", "StateCell", "TrainingDecoder"]
+
+
+class InitState:
+    """Initial state descriptor (reference decoder InitState: either a
+    concrete init Variable or a zero-filled boot shape)."""
+
+    def __init__(self, init=None, shape=None, value=0.0,
+                 init_boot=None, need_reorder=False, dtype="float32"):
+        if init is not None:
+            self._init = init
+        elif init_boot is not None:
+            self._init = layers.fill_constant_batch_size_like(
+                input=init_boot, shape=shape, dtype=dtype, value=value)
+        else:
+            raise ValueError(
+                "InitState needs `init` or `init_boot` to size the "
+                "batch dim")
+        self._need_reorder = need_reorder
+
+    @property
+    def value(self):
+        return self._init
+
+
+class StateCell:
+    """Named decoding states + a user-registered updater
+    (reference StateCell: states/inputs dicts, @state_updater
+    decorator, compute_state per step)."""
+
+    def __init__(self, inputs, states, out_state=None, name=None):
+        self._state_names = list(states)
+        self._init_states = dict(states)
+        self._cur_states = {}
+        self._input_names = list(inputs)
+        self._cur_inputs = dict(inputs)
+        self._out_state_name = out_state or (
+            self._state_names[0] if self._state_names else None)
+        self._updater = None
+        self._in_decoder = False
+
+    # -- registration -------------------------------------------------------
+    def state_updater(self, updater):
+        """Decorator registering the per-step transition function."""
+        self._updater = updater
+        return updater
+
+    # -- per-step accessors (valid inside compute_state / the decoder) --
+    def get_state(self, name):
+        if name in self._cur_states:
+            return self._cur_states[name]
+        init = self._init_states[name]
+        return init.value if isinstance(init, InitState) else init
+
+    def set_state(self, name, value):
+        self._cur_states[name] = value
+
+    def get_input(self, name):
+        v = self._cur_inputs.get(name)
+        if v is None:
+            raise KeyError(f"StateCell input {name!r} not set this step")
+        return v
+
+    def compute_state(self, inputs):
+        """Run the registered updater for one step with these inputs."""
+        if self._updater is None:
+            raise RuntimeError(
+                "StateCell has no updater; register one with "
+                "@state_cell.state_updater")
+        self._cur_inputs = dict(inputs)
+        self._updater(self)
+
+    def update_states(self):
+        """Commit the step's states (the unrolled form keeps them in
+        _cur_states; kept for reference API/flow parity)."""
+        return None
+
+    def out_state(self):
+        return self.get_state(self._out_state_name)
+
+
+class TrainingDecoder:
+    """Teacher-forced decoder loop (reference TrainingDecoder: a
+    StaticRNN-backed block; here a static unroll over the dense padded
+    time axis).
+
+    with decoder.block():
+        x_t = decoder.step_input(trg_embedding)   # [B, T, D] -> per-t
+        cell.compute_state({'x': x_t})
+        decoder.output(cell.out_state())
+        cell.update_states()
+    out = decoder()                               # [B, T, H]
+    """
+
+    BEFORE_DECODER = 0
+    IN_DECODER = 1
+    AFTER_DECODER = 2
+
+    def __init__(self, state_cell, name=None):
+        self._state_cell = state_cell
+        self._status = self.BEFORE_DECODER
+        self._block_fns = []
+        self._step_inputs = []
+        self._static_inputs = []
+        self._outputs_per_step = []
+        self._built = None
+
+    # -- block recording ----------------------------------------------------
+    def block(self):
+        """Context manager recording the per-step program. The body
+        runs once per time step during __call__ (static unroll)."""
+        import contextlib
+
+        decoder = self
+
+        @contextlib.contextmanager
+        def _ctx():
+            decoder._status = self.IN_DECODER
+            decoder._recording = []
+            try:
+                yield
+            finally:
+                decoder._status = self.AFTER_DECODER
+        # the body executes immediately inside the with-block for step
+        # 0; step_input/output record enough to replay steps 1..T-1
+        return _ctx()
+
+    def step_input(self, x):
+        """Mark x [B, T, ...] as a per-step input; returns the current
+        step's slice."""
+        if self._status != self.IN_DECODER:
+            raise RuntimeError("step_input only valid inside block()")
+        self._step_inputs.append(x)
+        self._cur_t = getattr(self, "_cur_t", 0)
+        return self._slice_t(x, 0)
+
+    def static_input(self, x):
+        """Mark x as shared by every step (e.g. encoder output)."""
+        self._static_inputs.append(x)
+        return x
+
+    def output(self, *outputs):
+        """Register per-step outputs. The unrolled replay re-runs only
+        the StateCell updater, so every output must BE a cell state
+        (register derived values with cell.set_state inside the
+        updater); anything else cannot be recomputed for steps > 0 and
+        is rejected here rather than silently dropped."""
+        cell = self._state_cell
+        self._output_state_names = []
+        for o in outputs:
+            matched = None
+            for name in cell._state_names + [
+                    n for n in cell._cur_states
+                    if n not in cell._state_names]:
+                try:
+                    if cell.get_state(name) is o:
+                        matched = name
+                        break
+                except KeyError:
+                    continue
+            if matched is None:
+                raise ValueError(
+                    "TrainingDecoder.output: each output must be a "
+                    "StateCell state (use cell.set_state('name', v) "
+                    "inside the updater for derived values) — the "
+                    "static unroll replays only the updater per step")
+            self._output_state_names.append(matched)
+        self._outputs_per_step = list(outputs)
+
+    @staticmethod
+    def _slice_t(x, t):
+        sliced = layers.slice(x, axes=[1], starts=[t], ends=[t + 1])
+        return layers.squeeze(sliced, axes=[1])
+
+    def __call__(self):
+        """Unroll: replay the updater over every time step, stacking
+        outputs on axis 1."""
+        if not self._step_inputs or not self._outputs_per_step:
+            raise RuntimeError(
+                "TrainingDecoder needs step_input() and output() "
+                "inside block()")
+        cell = self._state_cell
+        T = int(self._step_inputs[0].shape[1])
+        outs = [[layers.unsqueeze(o, axes=[1])
+                 for o in self._outputs_per_step]]
+        # step 0 ran while recording; replay steps 1..T-1, collecting
+        # the SAME registered states each step
+        for t in range(1, T):
+            inputs = {name: self._slice_t(x, t)
+                      for name, x in zip(cell._input_names,
+                                         self._step_inputs)}
+            cell.compute_state(inputs)
+            cell.update_states()
+            outs.append([layers.unsqueeze(cell.get_state(n), axes=[1])
+                         for n in self._output_state_names])
+        stacked = [layers.concat([o[i] for o in outs], axis=1)
+                   for i in range(len(outs[0]))]
+        return stacked[0] if len(stacked) == 1 else stacked

@@ -1,0 +1,297 @@
+"""contrib top-level helpers (reference fluid/contrib/__init__ surface:
+layers/rnn_impl.py BasicGRUUnit/BasicLSTMUnit/basic_gru/basic_lstm,
+memory_usage_calc.py, op_frequence.py, optimizer.py
+extend_with_decoupled_weight_decay, reader/distributed_reader.py,
+utils checkpoint converters)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import layers
+from ..dygraph.layers import Layer
+
+__all__ = [
+    "BasicGRUUnit", "BasicLSTMUnit", "basic_gru", "basic_lstm",
+    "memory_usage", "op_freq_statistic",
+    "extend_with_decoupled_weight_decay", "fused_elemwise_activation",
+    "distributed_batch_reader", "convert_dist_to_sparse_program",
+    "load_persistables_for_increment", "load_persistables_for_inference",
+]
+
+
+class _RecurrentUnit(Layer):
+    """Shared machinery: parameters are created ONCE (first forward,
+    when the input width is known) and reused by every later step —
+    the reference units create weights in __init__ for exactly this
+    reason (an unrolled RNN must tie weights across time steps)."""
+
+    def _weight(self, tag, shape):
+        cache = self.__dict__.setdefault("_tied", {})
+        key = f"w.{tag}"
+        if key not in cache:
+            from ..param_attr import ParamAttr
+            attr = ParamAttr(
+                name=f"{self.full_name()}.{tag}.w",
+                initializer=getattr(self._param_attr, "initializer",
+                                    None) if self._param_attr else None)
+            cache[key] = self.create_parameter(attr, shape, self._dtype)
+        return cache[key]
+
+    def _bias(self, tag, shape):
+        cache = self.__dict__.setdefault("_tied", {})
+        key = f"b.{tag}"
+        if key not in cache:
+            from ..param_attr import ParamAttr
+            from ..initializer import Constant
+            cache[key] = self.create_parameter(
+                ParamAttr(name=f"{self.full_name()}.{tag}.b",
+                          initializer=Constant(0.0)),
+                shape, self._dtype, is_bias=True)
+        return cache[key]
+
+    @staticmethod
+    def _linear(x, w, b):
+        out = layers.mul(x, w)
+        return layers.elementwise_add(out, b, axis=1) if b is not None \
+            else out
+
+
+class BasicGRUUnit(_RecurrentUnit):
+    """reference contrib/layers/rnn_impl.py BasicGRUUnit: one GRU step
+    as a Layer with step-shared gate/candidate weights."""
+
+    def __init__(self, name_scope=None, hidden_size=None,
+                 param_attr=None, bias_attr=None, gate_activation=None,
+                 activation=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._hidden_size = hidden_size
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+
+    def forward(self, input, pre_hidden):
+        H = self._hidden_size
+        D = int(input.shape[-1]) + H
+        concat = layers.concat([input, pre_hidden], axis=1)
+        gates = layers.sigmoid(self._linear(
+            concat, self._weight("gate", [D, 2 * H]),
+            self._bias("gate", [2 * H])))
+        u, r = layers.split(gates, num_or_sections=2, dim=1)
+        c_in = layers.concat(
+            [input, layers.elementwise_mul(r, pre_hidden)], axis=1)
+        c = layers.tanh(self._linear(
+            c_in, self._weight("cand", [D, H]), self._bias("cand",
+                                                           [H])))
+        one_minus_u = layers.scale(u, scale=-1.0, bias=1.0)
+        return layers.elementwise_add(
+            layers.elementwise_mul(u, pre_hidden),
+            layers.elementwise_mul(one_minus_u, c))
+
+
+class BasicLSTMUnit(_RecurrentUnit):
+    """reference contrib/layers/rnn_impl.py BasicLSTMUnit: one LSTM
+    step with step-shared weights; returns (hidden, cell)."""
+
+    def __init__(self, name_scope=None, hidden_size=None,
+                 param_attr=None, bias_attr=None, gate_activation=None,
+                 activation=None, forget_bias=1.0, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._hidden_size = hidden_size
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._forget_bias = float(forget_bias)
+
+    def forward(self, input, pre_hidden, pre_cell):
+        H = self._hidden_size
+        D = int(input.shape[-1]) + H
+        concat = layers.concat([input, pre_hidden], axis=1)
+        gates = self._linear(concat, self._weight("gates", [D, 4 * H]),
+                             self._bias("gates", [4 * H]))
+        i, j, f, o = layers.split(gates, num_or_sections=4, dim=1)
+        f = layers.scale(f, bias=self._forget_bias)
+        new_cell = layers.elementwise_add(
+            layers.elementwise_mul(pre_cell, layers.sigmoid(f)),
+            layers.elementwise_mul(layers.sigmoid(i),
+                                   layers.tanh(j)))
+        new_hidden = layers.elementwise_mul(
+            layers.tanh(new_cell), layers.sigmoid(o))
+        return new_hidden, new_cell
+
+
+def _rnn_over_steps(step_fn, input, init_states, hidden_size):
+    """Static unroll over the time dim (axis 1) for basic_gru/lstm."""
+    steps = input.shape[1]
+    states = init_states
+    outs = []
+    for t in range(steps):
+        x_t = layers.squeeze(
+            layers.slice(input, axes=[1], starts=[t], ends=[t + 1]),
+            axes=[1])
+        states = step_fn(x_t, states)
+        outs.append(layers.unsqueeze(
+            states[0] if isinstance(states, tuple) else states,
+            axes=[1]))
+    return layers.concat(outs, axis=1), states
+
+
+def basic_gru(input, init_hidden, hidden_size, num_layers=1,
+              sequence_length=None, dropout_prob=0.0,
+              bidirectional=False, batch_first=True, param_attr=None,
+              bias_attr=None, gate_activation=None, activation=None,
+              dtype="float32", name="basic_gru"):
+    """reference contrib basic_gru (single-direction static unroll;
+    returns (rnn_out [B,T,H], last_hidden [B,H]))."""
+    unit = BasicGRUUnit(name, hidden_size, param_attr, bias_attr,
+                        gate_activation, activation, dtype)
+    out, h = _rnn_over_steps(
+        lambda x, s: unit(x, s), input, init_hidden, hidden_size)
+    return out, h
+
+
+def basic_lstm(input, init_hidden, init_cell, hidden_size,
+               num_layers=1, sequence_length=None, dropout_prob=0.0,
+               bidirectional=False, batch_first=True, param_attr=None,
+               bias_attr=None, gate_activation=None, activation=None,
+               forget_bias=1.0, dtype="float32", name="basic_lstm"):
+    """reference contrib basic_lstm; returns (rnn_out, last_h, last_c)."""
+    unit = BasicLSTMUnit(name, hidden_size, param_attr, bias_attr,
+                         gate_activation, activation, forget_bias,
+                         dtype)
+    out, (h, c) = _rnn_over_steps(
+        lambda x, s: unit(x, s[0], s[1]), input,
+        (init_hidden, init_cell), hidden_size)
+    return out, h, c
+
+
+def memory_usage(program, batch_size):
+    """reference contrib/memory_usage_calc.py: rough lower/upper bound
+    of the program's activation+param memory in MB for one batch."""
+    dtype_bytes = {"float32": 4, "float64": 8, "float16": 2,
+                   "bfloat16": 2, "int64": 8, "int32": 4, "int8": 1,
+                   "bool": 1}
+    total = 0.0
+    for var in program.list_vars():
+        shape = list(getattr(var, "shape", []) or [])
+        if not shape:
+            continue
+        n = 1.0
+        for d in shape:
+            n *= batch_size if int(d) in (-1, 0) else int(d)
+        from ..core.types import dtype_to_np
+        try:
+            nb = np.dtype(dtype_to_np(var.dtype)).itemsize
+        except Exception:
+            nb = 4
+        total += n * nb
+    mb = total / (1 << 20)
+    return mb * 0.8, mb * 1.2, "MB"
+
+
+def op_freq_statistic(program):
+    """reference contrib/op_frequence.py: (uni_op_freq, adj_op_freq)
+    ordered dicts of op and adjacent-op-pair frequencies."""
+    from collections import OrderedDict
+    uni = {}
+    adj = {}
+    prev = None
+    for block in program.blocks:
+        for op in block.ops:
+            uni[op.type] = uni.get(op.type, 0) + 1
+            if prev is not None:
+                key = f"{prev}->{op.type}"
+                adj[key] = adj.get(key, 0) + 1
+            prev = op.type
+    uni_sorted = OrderedDict(sorted(uni.items(), key=lambda kv: -kv[1]))
+    adj_sorted = OrderedDict(sorted(adj.items(), key=lambda kv: -kv[1]))
+    return uni_sorted, adj_sorted
+
+
+def extend_with_decoupled_weight_decay(base_optimizer):
+    """reference contrib/optimizer.py DecoupledWeightDecay (AdamW):
+    the decay term must NOT pass through the base optimizer's moment
+    estimates — it is applied directly to the parameter after the
+    update: param <- param_updated - lr*coeff*param_pre_update."""
+    class DecoupledWeightDecay(base_optimizer):
+        def __init__(self, *args, weight_decay=0.0, **kwargs):
+            self._weight_decay = float(weight_decay)
+            super().__init__(*args, **kwargs)
+
+        def apply_gradients(self, params_grads):
+            if not self._weight_decay:
+                return super().apply_gradients(params_grads)
+            # snapshot the pre-update param values (reference scales
+            # params before the update and subtracts after)
+            snapshots = [(p, layers.scale(p, scale=1.0))
+                         for p, _ in params_grads]
+            ops = super().apply_gradients(params_grads)
+            try:
+                lr = float(self._learning_rate)
+            except (TypeError, ValueError):
+                lr = 1.0  # variable lr: coeff interpreted as lr*coeff
+            for p, snap in snapshots:
+                decayed = layers.elementwise_sub(
+                    p, layers.scale(snap,
+                                    scale=lr * self._weight_decay))
+                layers.assign(decayed, output=p)
+            return ops
+
+    DecoupledWeightDecay.__name__ = \
+        base_optimizer.__name__ + "WithDecoupledWeightDecay"
+    return DecoupledWeightDecay
+
+
+def fused_elemwise_activation(x, y, functor_list, axis=-1, scale=0.0,
+                              save_intermediate_out=True):
+    """reference contrib fused_elemwise_activation layer (the op is
+    registered in ops/misc.py; XLA fuses the composition anyway)."""
+    from ..layer_helper import LayerHelper
+    helper = LayerHelper("fused_elemwise_activation")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inter = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        "fused_elemwise_activation", inputs={"X": x, "Y": y},
+        outputs={"Out": out, "IntermediateOut": inter},
+        attrs={"functor_list": list(functor_list), "axis": axis,
+               "scale": scale,
+               "save_intermediate_out": save_intermediate_out})
+    return out
+
+
+def distributed_batch_reader(batch_reader):
+    """reference contrib/reader/distributed_reader.py: shard a batch
+    reader across trainers by round-robin (each trainer keeps every
+    trainer_num-th batch)."""
+    import os
+
+    def reader():
+        rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+        n = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+        for i, b in enumerate(batch_reader()):
+            if i % n == rank:
+                yield b
+    return reader
+
+
+def convert_dist_to_sparse_program(program):
+    """reference contrib/utils/lookup_table_utils.py: rewrite dense
+    lookup_table ops to is_sparse=True (SelectedRows grads)."""
+    for block in program.blocks:
+        for op in block.ops:
+            if op.type in ("lookup_table", "lookup_table_v2"):
+                op._attrs["is_sparse"] = True
+    program._bump_version()
+    return program
+
+
+def load_persistables_for_increment(dirname, executor, program,
+                                    lookup_table_var=None,
+                                    lookup_table_var_path=None):
+    """reference lookup_table_utils: load a checkpoint to continue
+    training (all persistables incl. optimizer state)."""
+    from .. import io as fluid_io
+    fluid_io.load_persistables(executor, dirname, main_program=program)
+
+
+def load_persistables_for_inference(dirname, executor, program,
+                                    lookup_table_var_name=None):
+    from .. import io as fluid_io
+    fluid_io.load_persistables(executor, dirname, main_program=program)

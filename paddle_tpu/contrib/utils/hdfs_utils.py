@@ -45,6 +45,10 @@ class LocalFS:
     def makedirs(self, path):
         os.makedirs(path, exist_ok=True)
 
+    def make_local_dirs(self, local_path):
+        """reference HDFSClient.make_local_dirs."""
+        os.makedirs(local_path, exist_ok=True)
+
     def delete(self, path):
         if os.path.isdir(path):
             shutil.rmtree(path, ignore_errors=True)
@@ -138,6 +142,11 @@ class HDFSClient:
         ok, err = self._run(["-mkdir", "-p", hdfs_path])
         if not ok:
             raise RuntimeError(f"hdfs mkdir failed: {err}")
+
+    def make_local_dirs(self, local_path):
+        """reference HDFSClient.make_local_dirs (local staging dir)."""
+        import os
+        os.makedirs(local_path, exist_ok=True)
 
     def delete(self, hdfs_path):
         self._run(["-rm", "-r", "-skipTrash", hdfs_path])

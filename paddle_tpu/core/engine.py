@@ -261,16 +261,25 @@ def _loop_fallback(fn, iterations):
         donated = dict(donated_params)
         const = dict(const_params)
         merged_upd = {}
+        nf_acc = None
         for i in range(iterations):
             f, upd, nf = fn(donated, const, feeds,
                             jax.random.fold_in(key, i))
+            # a transient NaN/Inf in ANY iteration must trip the check,
+            # not just the last one's flags
+            if nf_acc is None or (isinstance(nf_acc, tuple)
+                                  and not nf_acc):
+                nf_acc = nf
+            else:
+                nf_acc = jax.tree_util.tree_map(jnp.logical_and,
+                                                nf_acc, nf)
             merged_upd.update(upd)
             for n, v in upd.items():
                 if n in donated:
                     donated[n] = v
                 elif n in const:
                     const[n] = v
-        return f, merged_upd, nf
+        return f, merged_upd, nf_acc
 
     return looped
 
@@ -591,7 +600,10 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
             upd_out = {n: carry[n] for n in updated_names
                        if n in carry}
             upd_out.update({n: v[-1] for n, v in extras.items()})
-            nan_flags = jax.tree_util.tree_map(lambda x: x[-1], nfs)
+            # AND the all-finite flags over the scan axis: a transient
+            # NaN/Inf in iterations 0..K-2 must trip check_nan_inf too
+            nan_flags = jax.tree_util.tree_map(
+                lambda x: jnp.all(x, axis=0), nfs)
             return fetches, upd_out, nan_flags
     else:
         step2 = step1

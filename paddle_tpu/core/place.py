@@ -69,6 +69,41 @@ class TPUPlace(Place):
 CUDAPlace = TPUPlace
 
 
+class CUDAPinnedPlace(Place):
+    """Reference CUDAPinnedPlace (page-locked host staging memory).
+    TPU transfers stage through the PJRT runtime's own pinned buffers,
+    so this is host memory by another name — kept for API parity."""
+    _platforms = ("cpu",)
+
+
+def cpu_places(device_count=None):
+    """Reference fluid.cpu_places: CPU_NUM CPUPlaces."""
+    import os
+    n = device_count or int(os.environ.get("CPU_NUM", 1))
+    return [CPUPlace(i) for i in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """Reference fluid.cuda_places — here: one place per visible
+    accelerator chip."""
+    if device_ids is not None:
+        return [TPUPlace(int(i)) for i in device_ids]
+    try:
+        n = len(_devices_for(TPUPlace._platforms))
+    except RuntimeError:
+        n = 0
+    return [TPUPlace(i) for i in range(max(n, 1))]
+
+
+tpu_places = cuda_places
+
+
+def cuda_pinned_places(device_count=None):
+    import os
+    n = device_count or int(os.environ.get("CPU_NUM", 1))
+    return [CUDAPinnedPlace(i) for i in range(n)]
+
+
 def is_compiled_with_tpu() -> bool:
     try:
         return bool(_devices_for(TPUPlace._platforms)) and \

@@ -48,6 +48,24 @@ class LoDTensor:
         return [[b - a for a, b in zip(level[:-1], level[1:])]
                 for level in self._lod]
 
+    def has_valid_recursive_sequence_lengths(self):
+        """Reference LoDTensor::HasValidRecursiveSequenceLengths
+        (lod_tensor.cc CheckLoD): offsets ascending from 0; each
+        level's last offset partitions the next level (rows for the
+        last level)."""
+        rows = self.array.shape[0] if getattr(
+            self.array, "ndim", 0) else 0
+        expect = rows
+        for level in reversed(self._lod):
+            if not level or level[0] != 0:
+                return False
+            if any(b < a for a, b in zip(level[:-1], level[1:])):
+                return False
+            if level[-1] != expect:
+                return False
+            expect = len(level) - 1
+        return True
+
     def set_recursive_sequence_lengths(self, lengths):
         self._lod = []
         for level in lengths:
@@ -80,7 +98,11 @@ def create_lod_tensor(data, recursive_seq_lens, place=None):
 
 class TensorArray(list):
     """LoDTensorArray analog (lod_tensor_array.h)."""
-    pass
+
+    def append(self, tensor):
+        """list.append wrapped as a Python method so the API manifest
+        lists it (reference LoDTensorArray.append)."""
+        list.append(self, tensor)
 
 
 class LoDRankTable:

@@ -1,0 +1,6 @@
+"""paddle.distributed namespace: the process launcher CLI.
+
+Parity: reference python/paddle/distributed/launch.py (spawn one
+trainer process per device with the PADDLE_* env contract).
+"""
+from . import launch  # noqa: F401

@@ -13,3 +13,13 @@ from .learning_rate_scheduler import (  # noqa: F401
     ExponentialDecay, InverseTimeDecay, PolynomialDecay,
     CosineDecay)
 from . import jit  # noqa: F401
+
+
+class BackwardStrategy:
+    """Reference dygraph.BackwardStrategy (backward_strategy.cc):
+    sort_sum_gradient toggles deterministic gradient aggregation order.
+    The tape here always aggregates deterministically (python list
+    order), so the flag is accepted and recorded for API parity."""
+
+    def __init__(self):
+        self.sort_sum_gradient = False

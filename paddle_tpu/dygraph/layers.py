@@ -141,3 +141,29 @@ class Layer:
         return helper.create_parameter(
             attr, shape, dtype or self._dtype, is_bias,
             default_initializer)
+
+    def create_variable(self, name=None, persistable=None, dtype=None):
+        """Non-parameter state variable owned by this layer (reference
+        layers.py Layer.create_variable)."""
+        import numpy as np
+        from .tracer import VarBase
+        v = VarBase(np.zeros((1,), dtype or self._dtype),
+                    stop_gradient=True)
+        v.name = name or unique_name.generate(
+            self._full_name + ".var")
+        v.persistable = bool(persistable)
+        return v
+
+    def backward(self, *inputs):
+        """Reference Layer.backward hook — layers that implement a
+        custom backward override this; the tape calls it for PyLayer
+        subclasses. Default: autodiff handles everything."""
+        raise ValueError(
+            "Layer.backward is only meaningful on PyLayer-style "
+            "custom-gradient layers; built-in layers differentiate "
+            "through the tape automatically")
+
+    def load_dict(self, state_dict, include_sublayers=True):
+        """Alias of set_dict (reference API name)."""
+        return self.set_dict(state_dict,
+                             include_sublayers=include_sublayers)

@@ -22,6 +22,14 @@ class LearningRateDecay:
         self.step_num += self.step_size
         return float(lr)
 
+    def create_lr_var(self, lr):
+        """Reference LearningRateDecay.create_lr_var: wrap a python
+        scalar as a dygraph variable holding the current lr."""
+        import numpy as np
+        from .tracer import VarBase
+        return VarBase(np.asarray([float(lr)], np.float32),
+                       stop_gradient=True)
+
     def step(self):
         raise NotImplementedError
 

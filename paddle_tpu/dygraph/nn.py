@@ -16,7 +16,9 @@ from .layers import Layer
 
 __all__ = ["Conv2D", "Pool2D", "FC", "Linear", "BatchNorm", "Embedding",
            "LayerNorm", "GroupNorm", "PRelu", "Dropout",
-           "Conv2DTranspose", "BilinearTensorProduct"]
+           "Conv2DTranspose", "BilinearTensorProduct",
+           "Conv3D", "Conv3DTranspose", "GRUUnit", "NCE",
+           "SpectralNorm", "TreeConv"]
 
 
 class FC(Layer):
@@ -178,3 +180,92 @@ class BilinearTensorProduct(Layer):
 
     def forward(self, x, y):
         return L.bilinear_tensor_product(x, y, **self._kw)
+
+
+class Conv3D(Layer):
+    def __init__(self, name_scope=None, num_filters=None, filter_size=3,
+                 stride=1, padding=0, dilation=1, groups=None,
+                 param_attr=None, bias_attr=None, use_cudnn=True,
+                 act=None):
+        super().__init__(name_scope)
+        self._kw = dict(num_filters=num_filters,
+                        filter_size=filter_size, stride=stride,
+                        padding=padding, dilation=dilation,
+                        groups=groups, param_attr=param_attr,
+                        bias_attr=bias_attr, act=act)
+
+    def forward(self, input):
+        return L.conv3d(input, **self._kw)
+
+
+class Conv3DTranspose(Layer):
+    def __init__(self, name_scope=None, num_filters=None,
+                 output_size=None, filter_size=None, padding=0,
+                 stride=1, dilation=1, groups=None, param_attr=None,
+                 bias_attr=None, use_cudnn=True, act=None):
+        super().__init__(name_scope)
+        self._kw = dict(num_filters=num_filters,
+                        output_size=output_size,
+                        filter_size=filter_size, padding=padding,
+                        stride=stride, dilation=dilation,
+                        groups=groups, param_attr=param_attr,
+                        bias_attr=bias_attr, act=act)
+
+    def forward(self, input):
+        return L.conv3d_transpose(input, **self._kw)
+
+
+class GRUUnit(Layer):
+    def __init__(self, name_scope=None, size=None, param_attr=None,
+                 bias_attr=None, activation="tanh",
+                 gate_activation="sigmoid", origin_mode=False,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._kw = dict(size=size, param_attr=param_attr,
+                        bias_attr=bias_attr, activation=activation,
+                        gate_activation=gate_activation,
+                        origin_mode=origin_mode)
+
+    def forward(self, input, hidden):
+        return L.gru_unit(input, hidden, **self._kw)
+
+
+class NCE(Layer):
+    def __init__(self, name_scope=None, num_total_classes=None,
+                 sample_weight=None, param_attr=None, bias_attr=None,
+                 num_neg_samples=None, sampler="uniform",
+                 custom_dist=None, seed=0, is_sparse=False):
+        super().__init__(name_scope)
+        self._kw = dict(num_total_classes=num_total_classes,
+                        sample_weight=sample_weight,
+                        param_attr=param_attr, bias_attr=bias_attr,
+                        num_neg_samples=num_neg_samples,
+                        sampler=sampler, custom_dist=custom_dist,
+                        seed=seed, is_sparse=is_sparse)
+
+    def forward(self, input, label, sample_weight=None):
+        return L.nce(input, label, **self._kw)
+
+
+class SpectralNorm(Layer):
+    def __init__(self, name_scope=None, dim=0, power_iters=1,
+                 eps=1e-12, name=None):
+        super().__init__(name_scope)
+        self._kw = dict(dim=dim, power_iters=power_iters, eps=eps)
+
+    def forward(self, weight):
+        return L.spectral_norm(weight, **self._kw)
+
+
+class TreeConv(Layer):
+    def __init__(self, name_scope=None, output_size=None,
+                 num_filters=1, max_depth=8, act="tanh",
+                 param_attr=None, bias_attr=None, name=None):
+        super().__init__(name_scope)
+        self._kw = dict(output_size=output_size,
+                        num_filters=num_filters, max_depth=max_depth,
+                        act=act, param_attr=param_attr,
+                        bias_attr=bias_attr)
+
+    def forward(self, nodes_vector, edge_set):
+        return L.tree_conv(nodes_vector, edge_set, **self._kw)

@@ -182,6 +182,25 @@ class Tracer:
         # register on the innermost one (reference LayerObjectHelper).
         self._layer_stack: List[Any] = []
 
+    # -- reference Tracer API surface (tracer.h / imperative api) -----
+    def all_parameters(self):
+        return list(self._params.values())
+
+    def trace(self, op_type, inputs, outputs, attrs, place=None,
+              stop_gradient=False):
+        """Reference Tracer.trace: record + execute one op."""
+        return self.trace_op(op_type, inputs, outputs, attrs)
+
+    def trace_var(self, name, var):
+        self._params.setdefault(name, var)
+        return var
+
+    def train_mode(self):
+        self._no_grad = False
+
+    def eval_mode(self):
+        self._no_grad = True
+
     # -- construction helpers ----------------------------------------------
     def from_numpy(self, arr, name=None):
         dev = self.place.jax_device()

@@ -686,6 +686,10 @@ class Program:
     def serialize_to_string(self) -> bytes:
         return self.to_proto().SerializeToString()
 
+    def to_string(self, throw_on_error=True, with_details=False):
+        """Human-readable program text (reference Program.to_string)."""
+        return str(self.to_proto())
+
     @staticmethod
     def parse_from_string(s: bytes) -> "Program":
         p = fpb.ProgramDesc()

@@ -17,14 +17,24 @@ from . import framework
 
 __all__ = [
     "Constant", "Uniform", "Normal", "TruncatedNormal", "Xavier", "MSRA",
-    "NumpyArrayInitializer", "force_init_on_cpu",
+    "Bilinear", "NumpyArrayInitializer", "force_init_on_cpu",
+    "init_on_cpu",
     "ConstantInitializer", "UniformInitializer", "NormalInitializer",
     "TruncatedNormalInitializer", "XavierInitializer", "MSRAInitializer",
+    "BilinearInitializer",
 ]
 
 
 def force_init_on_cpu():
     return False
+
+
+def init_on_cpu():
+    """Reference initializer.init_on_cpu context: pin initializer ops
+    to CPU. Initializers here run once into the scope (host side
+    already), so this is a no-op context kept for API parity."""
+    import contextlib
+    return contextlib.nullcontext()
 
 
 class Initializer:

@@ -27,8 +27,11 @@ from .core.types import dtype_to_np
 __all__ = [
     "save_vars", "save_params", "save_persistables", "load_vars",
     "load_params", "load_persistables", "save_inference_model",
-    "load_inference_model", "get_program_parameter",
+    "load_inference_model", "get_program_parameter", "PyReader",
+    "DataFeeder", "batch",
 ]
+
+from .reader.decorators import PyReader, DataFeeder, batch  # noqa: E402,F401
 
 _MAGIC = b"PTCK"
 

@@ -932,10 +932,13 @@ def multiplex(inputs, index):
 
 
 def fused_attention(q, k, v, bias=None, scale=None, block_q=128,
-                    block_k=128, name=None):
+                    block_k=128, layout="bhsd", dropout_prob=0.0,
+                    is_test=False, name=None):
     """Fused multi-head attention via the Pallas flash kernel
-    (paddle_tpu/kernels/flash_attention.py). q/k/v: [B, H, S, D];
-    bias: [B, 1|H, Sq, Sk] additive mask or None."""
+    (paddle_tpu/kernels/flash_attention.py). q/k/v: [B, H, S, D]
+    (layout="bhsd") or [B, S, H, D] (layout="bshd" — the free-reshape
+    layout of a [B, S, H*D] projection, no head transposes);
+    bias: [B, 1|H, Sq|1, Sk] additive mask or None in either layout."""
     helper = LayerHelper("fused_attention", name=name)
     out = helper.create_variable_for_type_inference(q.dtype)
     inputs = {"Q": q, "K": k, "V": v}
@@ -945,7 +948,10 @@ def fused_attention(q, k, v, bias=None, scale=None, block_q=128,
                      outputs={"Out": out},
                      attrs={"scale": -1.0 if scale is None else
                             float(scale),
-                            "block_q": block_q, "block_k": block_k})
+                            "block_q": block_q, "block_k": block_k,
+                            "layout": layout,
+                            "dropout_prob": float(dropout_prob),
+                            "is_test": bool(is_test)})
     return out
 
 

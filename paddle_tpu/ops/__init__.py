@@ -26,3 +26,4 @@ from . import nlp  # noqa: F401
 from . import quantize  # noqa: F401
 from . import detection  # noqa: F401
 from . import misc  # noqa: F401
+from . import reader_ops  # noqa: F401

@@ -45,6 +45,39 @@ class Optimizer:
             defaultdict(dict)
         self.helper = None
 
+    def get_opti_var_name_list(self):
+        """Names of this optimizer's state variables (reference
+        optimizer.py Optimizer.get_opti_var_name_list — io.save/load
+        use it to persist moments alongside params)."""
+        names = []
+        for per_param in self._accumulators.values():
+            for v in per_param.values():
+                names.append(getattr(v, "name", None))
+        return [n for n in names if n]
+
+    def load(self, stat_dict):
+        """Restore optimizer state from a {name: ndarray} dict
+        (reference Optimizer.load, dygraph checkpointing)."""
+        import numpy as np
+        if in_dygraph_mode():
+            from .dygraph.tracer import VarBase
+            for per_param in self._accumulators.values():
+                for pname, v in list(per_param.items()):
+                    name = getattr(v, "name", None)
+                    if name in stat_dict:
+                        val = np.asarray(stat_dict[name])
+                        if isinstance(v, VarBase):
+                            v.value = val
+                        else:
+                            per_param[pname] = val
+            return
+        from .core.scope import global_scope
+        scope = global_scope()
+        for name in self.get_opti_var_name_list():
+            if name in stat_dict:
+                scope.var(name).set_value(
+                    np.asarray(stat_dict[name]))
+
     # ---- dygraph (eager) path --------------------------------------------
     # Reference parity: in dygraph mode optimizer ops run eagerly per
     # param (reference optimizer.py dispatches through the same
@@ -143,8 +176,13 @@ class Optimizer:
             # one optimizer may minimize a SECOND program (slim's
             # compressor re-minimizes rewritten graphs): the cached
             # Variable belongs to the first program's block, so
-            # re-declare it — same name, so scope state carries — in
-            # the current program and re-init in its startup
+            # re-declare it in the current program and append its
+            # Constant initializer to the NEW startup program. NOTE:
+            # running that startup RE-INITIALIZES the accumulator —
+            # moment state does not carry across re-minimize (the
+            # rewritten graph's params generally differ, so fresh
+            # moments are the sound default); skip running the new
+            # startup to keep existing scope state instead
             blk = default_main_program().global_block()
             if blk._find_var_recursive(cached.name) is not None:
                 return cached
@@ -613,6 +651,62 @@ class ModelAverage(Optimizer):
                    "min_average_window": self.min_average_window,
                    "max_average_window": self.max_average_window},
             infer_shape=False)
+
+    def _averaged(self, scope, param):
+        s1 = np.asarray(_scope_arr(scope,
+                                   self._get_accumulator("sum_1",
+                                                         param).name))
+        s2 = np.asarray(_scope_arr(scope,
+                                   self._get_accumulator("sum_2",
+                                                         param).name))
+        s3 = np.asarray(_scope_arr(scope,
+                                   self._get_accumulator("sum_3",
+                                                         param).name))
+        na = int(np.asarray(_scope_arr(
+            scope, self._get_accumulator("num_accumulates",
+                                         param).name)))
+        ona = int(np.asarray(_scope_arr(
+            scope, self._get_accumulator("old_num_accumulates",
+                                         param).name)))
+        total = max(na + ona, 1)
+        return (s1 + s2 + s3) / float(total)
+
+    def apply(self, executor, need_restore=True):
+        """Swap params for their window averages (reference
+        ModelAverage.apply — context manager form supported via
+        restore())."""
+        import contextlib
+        from .core.scope import global_scope
+        scope = global_scope()
+        self._backup = {}
+        for param, _ in self.params_grads:
+            cur = np.asarray(_scope_arr(scope, param.name))
+            self._backup[param.name] = cur
+            scope.var(param.name).set_value(
+                self._averaged(scope, param).astype(cur.dtype))
+
+        @contextlib.contextmanager
+        def _ctx():
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore(executor)
+        return _ctx()
+
+    def restore(self, executor):
+        """Restore the raw (non-averaged) params after apply()."""
+        from .core.scope import global_scope
+        scope = global_scope()
+        for name, val in getattr(self, "_backup", {}).items():
+            scope.var(name).set_value(val)
+        self._backup = {}
+
+
+def _scope_arr(scope, name):
+    v = scope.find_var(name).get_value()
+    from .core.scope import LoDTensor as _LT
+    return v.array if isinstance(v, _LT) else v
 
 
 class ExponentialMovingAverage:

@@ -1,0 +1,50 @@
+"""ParallelExecutor API surface.
+
+Parity: reference parallel_executor.py (ParallelExecutor: per-device
+graph clones + AllReduceOpHandle). TPU-native: delegates to
+CompiledProgram.with_data_parallel — ONE SPMD executable over the
+device mesh replaces the per-device clone machinery (see
+core/engine.py trace_step docstring) — wrapped in the reference's
+constructor/run() shape so ParallelExecutor call sites work unchanged.
+"""
+from __future__ import annotations
+
+from .compiler import BuildStrategy, CompiledProgram, ExecutionStrategy
+from .executor import Executor
+from .framework import default_main_program
+
+__all__ = ["ParallelExecutor"]
+
+
+class ParallelExecutor:
+    def __init__(self, use_cuda=None, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None,
+                 build_strategy=None, num_trainers=1, trainer_id=0,
+                 scope=None, use_tpu=None):
+        self._program = main_program or default_main_program()
+        self._compiled = CompiledProgram(
+            self._program, build_strategy).with_data_parallel(
+                loss_name=loss_name,
+                exec_strategy=exec_strategy or ExecutionStrategy(),
+                share_vars_from=getattr(share_vars_from, "_compiled",
+                                        share_vars_from))
+        self._exe = Executor()
+        self._scope = scope
+
+    def run(self, fetch_list, feed=None, feed_dict=None,
+            return_numpy=True):
+        feed = feed if feed is not None else feed_dict
+        from .executor import scope_guard
+        import contextlib
+        cm = scope_guard(self._scope) if self._scope is not None \
+            else contextlib.nullcontext()
+        with cm:
+            return self._exe.run(self._compiled, feed=feed,
+                                 fetch_list=list(fetch_list),
+                                 return_numpy=return_numpy)
+
+    def drop_local_exe_scopes(self):
+        """Reference: frees per-device local scopes between runs. The
+        SPMD engine holds no per-device scopes (one global scope, one
+        executable), so this is a documented no-op."""
+        return None

@@ -1,0 +1,9 @@
+"""paddle.reader.creator module surface (reference
+python/paddle/reader/creator.py): readers from data sources."""
+from .decorators import creator as _ns
+
+__all__ = ["np_array", "text_file", "recordio"]
+
+np_array = _ns.np_array
+text_file = _ns.text_file
+recordio = _ns.recordio

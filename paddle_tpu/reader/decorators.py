@@ -22,7 +22,8 @@ from ..framework import Variable
 __all__ = ["DataFeeder", "batch", "PyReader", "cache",
            "map_readers", "shuffle", "chain", "compose",
            "buffered", "firstn", "xmap_readers",
-           "multiprocess_reader"]
+           "multiprocess_reader", "Fake", "PipeReader", "creator",
+           "DataFeedDesc"]
 
 
 def batch(reader, batch_size, drop_last=False):
@@ -46,6 +47,38 @@ class DataFeeder:
                  program=None):
         self.feed_vars = list(feed_list)
         self.place = place
+
+    def decorate_reader(self, reader, multi_devices=False,
+                        num_places=None, drop_last=True):
+        """Reference DataFeeder.decorate_reader: wrap a sample-batch
+        reader into a feed-dict reader."""
+        def wrapped():
+            for samples in reader():
+                yield self.feed(samples)
+        return wrapped
+
+    def feed_parallel(self, iterable, num_places=None):
+        """Reference DataFeeder.feed_parallel: one feed dict per place.
+        Under the SPMD engine a single global feed dict is the native
+        form; per-place dicts are produced for API parity by splitting
+        the batch."""
+        feeds = self.feed(iterable)
+        n = num_places or 1
+        sizes = {name: np.asarray(arr).shape[0]
+                 for name, arr in feeds.items()}
+        if any(sz < n for sz in sizes.values()):
+            raise ValueError(
+                f"feed_parallel: batch sizes {sizes} are smaller than "
+                f"num_places={n}")
+        outs = []
+        for i in range(n):
+            d = {}
+            for name, arr in feeds.items():
+                # np.array_split semantics: remainder rows spread over
+                # the first places — every sample is fed exactly once
+                d[name] = np.array_split(np.asarray(arr), n)[i]
+            outs.append(d)
+        return outs
 
     def feed(self, iterable) -> Dict[str, object]:
         samples = list(iterable)
@@ -137,6 +170,13 @@ class PyReader:
                     yield {v.name: a for v, a in
                            zip(self.feed_list, arrays)}
         self._gen = _batch_gen
+
+    def decorate_sample_generator(self, sample_generator, batch_size,
+                                  drop_last=True, places=None):
+        """reference PyReader.decorate_sample_generator: single-sample
+        generator + batch size."""
+        self.decorate_sample_list_generator(
+            batch(sample_generator, batch_size, drop_last), places)
 
     decorate_paddle_reader = decorate_sample_list_generator
 
@@ -409,3 +449,143 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
             yield item
 
     return mreader
+
+
+class Fake:
+    """reference paddle.reader.Fake: replays the first batch of a
+    reader forever (pipeline debugging without IO)."""
+
+    def __init__(self):
+        self._cached = None
+
+    def __call__(self, reader, times):
+        def fake_reader():
+            if self._cached is None:
+                self._cached = list(reader())
+            for _ in range(times):
+                for item in self._cached:
+                    yield item
+        return fake_reader
+
+
+class PipeReader:
+    """reference paddle.reader.PipeReader: stream samples from a shell
+    command's stdout."""
+
+    def __init__(self, command, bufsize=8192, file_type="plain"):
+        import subprocess
+        self.command = command
+        self.process = subprocess.Popen(
+            command.split(" "), bufsize=bufsize,
+            stdout=subprocess.PIPE)
+        self.file_type = file_type
+
+    def get_line(self, cut_lines=True, line_break="\n"):
+        import zlib
+        decomp = zlib.decompressobj(32 + zlib.MAX_WBITS) \
+            if self.file_type == "gzip" else None
+        remained = ""
+        while True:
+            buff = self.process.stdout.read(8192)
+            if not buff:
+                break
+            if decomp is not None:
+                buff = decomp.decompress(buff)
+                if not buff:
+                    continue
+            buff = buff.decode()
+            if cut_lines:
+                lines = (remained + buff).split(line_break)
+                remained = lines.pop()
+                for line in lines:
+                    yield line
+            else:
+                yield buff
+        if decomp is not None:
+            tail = decomp.flush().decode()
+            if tail:
+                remained += tail
+        if remained:
+            yield remained
+
+
+class _CreatorNS:
+    """reference paddle.reader.creator: readers from data sources."""
+
+    @staticmethod
+    def np_array(x):
+        def reader():
+            for row in x:
+                yield row
+        return reader
+
+    @staticmethod
+    def text_file(path):
+        def reader():
+            with open(path) as f:
+                for line in f:
+                    yield line.rstrip("\n")
+        return reader
+
+    @staticmethod
+    def recordio(paths, buf_size=100):
+        """Read recordio file(s) written by recordio_writer (native
+        CRC-checked chunks)."""
+        from .native_feed import RecordIOReader
+
+        def reader():
+            ps = paths.split(",") if isinstance(paths, str) else paths
+            for p in ps:
+                r = RecordIOReader(p)
+                try:
+                    while True:
+                        sample = r.read_sample()
+                        if sample is None:
+                            break
+                        yield tuple(sample)
+                finally:
+                    r.close()
+        return reader
+
+
+creator = _CreatorNS()
+
+
+class DataFeedDesc:
+    """reference DataFeedDesc (data_feed.proto config wrapper): slot
+    schema for the native data feed."""
+
+    def __init__(self, proto_file):
+        self._batch_size = 1
+        self._slots = []
+        self._use_slots = []
+        self._dense = set()
+        if proto_file and __import__("os").path.exists(proto_file):
+            with open(proto_file) as f:
+                self._text = f.read()
+        else:
+            self._text = str(proto_file)
+        import re
+        for m in re.finditer(r'name:\s*"([^"]+)"', self._text):
+            self._slots.append(m.group(1))
+        m = re.search(r"batch_size:\s*(\d+)", self._text)
+        if m:
+            self._batch_size = int(m.group(1))
+
+    def set_batch_size(self, batch_size):
+        self._batch_size = int(batch_size)
+
+    def set_dense_slots(self, dense_slots_name):
+        self._dense.update(dense_slots_name)
+
+    def set_use_slots(self, use_slots_name):
+        self._use_slots = list(use_slots_name)
+
+    def desc(self):
+        lines = [f"batch_size: {self._batch_size}"]
+        for s in self._slots:
+            lines.append(
+                f'slot {{ name: "{s}" is_dense: '
+                f'{str(s in self._dense).lower()} is_used: '
+                f'{str(not self._use_slots or s in self._use_slots).lower()} }}')
+        return "\n".join(lines)

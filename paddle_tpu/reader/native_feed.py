@@ -103,6 +103,54 @@ class RecordIOWriter:
         self.close()
 
 
+class RecordIOReader:
+    """Scan one recordio shard sample by sample (inverse of
+    RecordIOWriter.write_sample; native scanner validates magic + CRC,
+    recordio.cc:93)."""
+
+    def __init__(self, path: str):
+        self._lib = get_lib()
+        self._h = self._lib.recordio_scanner_open(path.encode())
+        if not self._h:
+            raise IOError(f"recordio: cannot open {path}")
+
+    def read_sample(self):
+        ptr = ctypes.POINTER(ctypes.c_uint8)()
+        n = self._lib.recordio_next(self._h, ctypes.byref(ptr))
+        if n == -100:
+            return None
+        if n < 0:
+            raise IOError(f"recordio: corrupt record (code {n})")
+        payload = ctypes.string_at(ptr, n)   # copy out of the scanner
+        off = 0
+        count = np.frombuffer(payload, np.uint32, 1, off)[0]
+        off += 4
+        arrays = []
+        for _ in range(count):
+            code, ndim = np.frombuffer(payload, np.uint32, 2, off)
+            off += 8
+            dims = np.frombuffer(payload, np.uint64, int(ndim), off)
+            off += 8 * int(ndim)
+            dt = np.dtype(_DTYPES[int(code)])
+            size = int(np.prod(dims)) if len(dims) else 1
+            arr = np.frombuffer(payload, dt, size, off).reshape(
+                [int(d) for d in dims])
+            off += size * dt.itemsize
+            arrays.append(arr.copy())
+        return arrays
+
+    def close(self):
+        if self._h:
+            self._lib.recordio_scanner_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
 class NativeDataFeeder:
     """Threaded recordio -> batch queue (C++), iterated from Python.
 

@@ -175,8 +175,11 @@ def bench_op(op_type, inputs=None, shape=None, attrs=None,
         pass
     rec = {
         "op": op_type,
-        "inputs": {k: list(v) for k, v in
-                   (inputs or {s: shape for s in trials[0][0]}).items()},
+        # slot_shapes/out_name are the candidate layout that actually
+        # SUCCEEDED in the trial loop (an earlier candidate may have
+        # failed), so the record names what was really benchmarked
+        "inputs": {k: list(v) for k, v in slot_shapes.items()},
+        "out_slot": out_name,
         "dtype": dtype,
         "steps_per_sec": round(sps, 2),
         "flops_per_step": flops,

@@ -85,8 +85,23 @@ class DistributeTranspiler:
         if self.config.mode == "pserver":
             warnings.warn(
                 "pserver mode transpiles to the collective path on TPU "
-                "(pserver-to-collective); pserver programs become no-ops",
-                stacklevel=2)
+                "(pserver-to-collective); pserver programs become "
+                "no-ops. Semantic differences a migrating user must "
+                "know: (1) the OPTIMIZER runs on every trainer over "
+                "allreduced gradients, not on servers over gradient "
+                "shards — per-parameter optimizer state is replicated "
+                "on trainers instead of sharded across servers; "
+                "(2) there is no server-side table, so tables cannot "
+                "GROW at run time — sparse/embedding params need their "
+                "full [vocab, dim] shape declared up front (use the "
+                "vocab-sharded embedding path in parallel/strategy.py "
+                "for tables too big for one chip); (3) sync_mode=False "
+                "maps to bounded-staleness StaleSyncSGD (k local steps "
+                "between averaging rounds), not the unbounded-"
+                "staleness async communicator; (4) get_pserver_program"
+                "()/get_startup_program() return runnable no-op "
+                "programs so server launch scripts exit cleanly "
+                "instead of serving.", stacklevel=2)
 
         mode = self.config.collective_mode
         if not sync_mode:

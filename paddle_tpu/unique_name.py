@@ -1,0 +1,27 @@
+"""fluid.unique_name module surface (reference
+python/paddle/fluid/unique_name.py: generate/guard/switch). Delegates
+to the framework's namespace helper so there is exactly one generator
+state."""
+from __future__ import annotations
+
+from .framework import unique_name as _ns
+
+__all__ = ["generate", "guard", "switch"]
+
+
+def generate(key):
+    return _ns.generate(key)
+
+
+def guard(new_generator=None):
+    return _ns.guard(new_generator)
+
+
+def switch(new_generator=None):
+    """Swap the active generator (reference unique_name.switch);
+    returns the previous one. With no argument, resets to a fresh
+    namespace."""
+    from . import framework as fw
+    old = fw._name_gen
+    fw._name_gen = new_generator or fw._UniqueNameGenerator()
+    return old

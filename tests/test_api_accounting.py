@@ -1,0 +1,70 @@
+"""API.spec accounting stays honest (docs/API_SPEC_ACCOUNTING.md):
+every reference API name must be present in our API.spec or explicitly
+classified. Runs only where the reference tree exists (this container);
+elsewhere the parity gate is tests/test_api_spec.py."""
+import os
+import re
+import unittest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REF_SPEC = "/root/reference/paddle/fluid/API.spec"
+
+# classified intentional differences — keep in sync with
+# docs/API_SPEC_ACCOUNTING.md
+NOT_CARRIED = {
+    # superseded by layers.beam_search/beam_search_decode (tested)
+    "contrib.BeamSearchDecoder",
+    "contrib.BeamSearchDecoder.__init__",
+    "contrib.BeamSearchDecoder.block",
+    "contrib.BeamSearchDecoder.decode",
+    "contrib.BeamSearchDecoder.early_stop",
+    "contrib.BeamSearchDecoder.read_array",
+    "contrib.BeamSearchDecoder.update_array",
+    # extraction artifact in the reference generator's output
+    "dygraph.__impl__",
+}
+
+
+def _names(path):
+    out = set()
+    with open(path) as f:
+        for line in f:
+            m = re.match(r"([\w.]+)[ (]", line.strip())
+            if m:
+                out.add(m.group(1))
+    return out
+
+
+@unittest.skipUnless(os.path.exists(REF_SPEC),
+                     "reference tree not present")
+class TestApiAccounting(unittest.TestCase):
+    def test_every_reference_name_accounted(self):
+        refn = {n.replace("paddle.fluid.", "").replace("paddle.", "")
+                for n in _names(REF_SPEC)}
+        oursn = {n.replace("paddle_tpu.", "")
+                 for n in _names(os.path.join(REPO, "API.spec"))}
+        missing = refn - oursn
+        # constructor lines are cosmetic: we print the argspec on the
+        # class line itself — but ONLY when the class line exists
+        unexplained = sorted(
+            n for n in missing
+            if n not in NOT_CARRIED
+            and not (n.endswith(".__init__")
+                     and n[: -len(".__init__")] in oursn))
+        self.assertFalse(
+            unexplained,
+            "reference API names neither implemented nor classified in "
+            f"docs/API_SPEC_ACCOUNTING.md: {unexplained[:30]}")
+
+    def test_not_carried_entries_are_really_absent(self):
+        oursn = {n.replace("paddle_tpu.", "")
+                 for n in _names(os.path.join(REPO, "API.spec"))}
+        stale = sorted(n for n in NOT_CARRIED
+                       if n in oursn)
+        self.assertFalse(
+            stale, f"NOT_CARRIED entries now implemented — update the "
+            f"accounting: {stale}")
+
+
+if __name__ == "__main__":
+    unittest.main()

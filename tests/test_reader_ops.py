@@ -1,0 +1,106 @@
+"""`read` / `create_custom_reader` op-surface parity (VERDICT r3
+missing #4; reference reader/read_op.cc, create_custom_reader_op.cc).
+The reader variable is a host object, so programs containing these ops
+run on the engine's eager/islands path — asserted implicitly by the
+runs below succeeding with fresh batches per step."""
+import numpy as np
+import unittest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.scope import Scope
+from paddle_tpu.ops.reader_ops import BatchReader, CustomReader
+
+
+def _gen():
+    for i in range(4):
+        yield [np.full((2, 3), float(i), np.float32),
+               np.full((2, 1), float(10 + i), np.float32)]
+
+
+class TestReadOp(unittest.TestCase):
+    def test_read_feeds_program(self):
+        fluid.framework.unique_name.reset()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            block = main.global_block()
+            reader_var = block.create_var(name="my_reader",
+                                          persistable=True)
+            x = block.create_var(name="rx", dtype="float32",
+                                 shape=[2, 3])
+            y = block.create_var(name="ry", dtype="float32",
+                                 shape=[2, 1])
+            block.append_op("read", inputs={"Reader": reader_var},
+                            outputs={"Out": [x, y]},
+                            attrs={"infer_out": False})
+            s = fluid.layers.reduce_sum(x)
+            t = fluid.layers.reduce_sum(y)
+        scope = Scope()
+        with fluid.scope_guard(scope):
+            scope.var("my_reader").set_value(BatchReader(_gen))
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            sums = []
+            for _ in range(3):
+                a, b = exe.run(main, feed={},
+                               fetch_list=[s.name, t.name])
+                sums.append((float(np.asarray(a)),
+                             float(np.asarray(b))))
+        # successive runs pop successive batches
+        self.assertEqual(sums[0], (0.0, 20.0))
+        self.assertEqual(sums[1], (6.0, 22.0))
+        self.assertEqual(sums[2], (12.0, 24.0))
+
+    def test_custom_reader_applies_sub_block(self):
+        fluid.framework.unique_name.reset()
+        main = fluid.Program()
+        with fluid.program_guard(main):
+            sub = main._create_block()
+            src = sub.create_var(name="src0", dtype="float32",
+                                 shape=[2, 3])
+            dst = sub.create_var(name="dst0", dtype="float32",
+                                 shape=[2, 3])
+            sub.append_op("scale", inputs={"X": src},
+                          outputs={"Out": dst},
+                          attrs={"scale": 10.0, "bias": 1.0})
+        under = BatchReader(lambda: iter([[np.ones((2, 3),
+                                                   np.float32)]]))
+        custom = CustomReader(under, main, sub.idx, ["src0"], ["dst0"])
+        out, = custom.read_next()
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.full((2, 3), 11.0), rtol=1e-6)
+
+    def test_create_custom_reader_op(self):
+        fluid.framework.unique_name.reset()
+        main = fluid.Program()
+        with fluid.program_guard(main):
+            sub = main._create_block()
+            src = sub.create_var(name="s1", dtype="float32",
+                                 shape=[2, 2])
+            dst = sub.create_var(name="d1", dtype="float32",
+                                 shape=[2, 2])
+            sub.append_op("square", inputs={"X": src},
+                          outputs={"Out": dst})
+            block = main.global_block()
+            under_v = block.create_var(name="under_r",
+                                       persistable=True)
+            out_v = block.create_var(name="custom_r", persistable=True)
+            block.append_op(
+                "create_custom_reader",
+                inputs={"UnderlyingReader": under_v},
+                outputs={"Out": out_v},
+                attrs={"__program__": main, "sub_block": sub.idx,
+                       "source_var_names": ["s1"],
+                       "sink_var_names": ["d1"]})
+        from paddle_tpu.core.registry import OPS, ExecContext
+        env = {"under_r": BatchReader(
+            lambda: iter([[np.full((2, 2), 3.0, np.float32)]]))}
+        op = main.global_block().ops[-1]
+        OPS.get("create_custom_reader").lowering(
+            ExecContext(op, env))
+        out, = env["custom_r"].read_next()
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.full((2, 2), 9.0), rtol=1e-6)
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -170,7 +170,7 @@ def main():
              "  *** single device: identity collective, numbers are "
              "the dispatch floor, NOT bandwidth ***"),
         file=sys.stderr)
-    print(f"# {'bytes/dev':>12} {'time/coll':>10} {'algbw GB/s':>10} "
+    print(f"# {'bytes(S)':>12} {'time/coll':>10} {'algbw GB/s':>10} "
           f"{'busbw GB/s':>10}", file=sys.stderr)
     scale = {"all_reduce": 2 * (n - 1) / n,
              "all_gather": (n - 1) / n,

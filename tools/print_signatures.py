@@ -40,11 +40,18 @@ MODULES = [
     "paddle_tpu.metrics",
     "paddle_tpu.profiler",
     "paddle_tpu.reader",
+    "paddle_tpu.reader.creator",
     "paddle_tpu.backward",
     "paddle_tpu.dygraph",
     "paddle_tpu.dygraph.nn",
+    "paddle_tpu.dygraph_grad_clip",
+    "paddle_tpu.nets",
+    "paddle_tpu.unique_name",
     "paddle_tpu.transpiler",
+    "paddle_tpu.recordio_writer",
+    "paddle_tpu.install_check",
     "paddle_tpu.inference",
+    "paddle_tpu.contrib",
     "paddle_tpu.contrib.mixed_precision",
     "paddle_tpu.contrib.slim.quantization",
     "paddle_tpu.incubate.fleet.base.role_maker",
@@ -84,6 +91,25 @@ def collect():
             if not callable(obj):
                 continue
             lines.append(f"{mod_name}.{name} {_spec_of(obj)}")
+            if inspect.isclass(obj):
+                # reference API.spec enumerates public METHODS too,
+                # including inherited ones (paddle.fluid.dygraph.FC
+                # .parameters etc.) — list them so the surfaces diff
+                # 1:1
+                for mname in sorted(dir(obj)):
+                    if mname.startswith("_"):
+                        continue
+                    meth = getattr(obj, mname, None)
+                    if inspect.isclass(meth):
+                        # nested enum-style classes
+                        # (BuildStrategy.ReduceStrategy)
+                        lines.append(f"{mod_name}.{name}.{mname} "
+                                     f"{_spec_of(meth)}")
+                        continue
+                    if not inspect.isfunction(meth):
+                        continue
+                    lines.append(
+                        f"{mod_name}.{name}.{mname} {_spec_of(meth)}")
     return lines
 
 
