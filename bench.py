@@ -194,10 +194,22 @@ def bench_transformer(batch=BATCH, seq=None):
         exe.run(startup)
         eng = Engine()
         feed = models.transformer.make_batch(cfg, batch, s_src, s_trg)
+        K = int(os.environ.get("TF_ITERS", "1"))
         sps, traj, sync_ms = _loop(eng, main_prog, scope, feed,
-                                   [cost.name], ITERS)
-        stats = eng.compiled_stats(main_prog, scope, feed, [cost.name])
+                                   [cost.name], ITERS, iterations=K)
+        stats = eng.compiled_stats(main_prog, scope, feed,
+                                   [cost.name], iterations=K)
     return sps * batch * s_trg, sps, traj, sync_ms, stats
+
+
+def bench_transformer_longctx():
+    """Long-context regime (S=4096): crosses the 2^28 score-elements
+    threshold, so attention runs on the Pallas flash kernels (fwd +
+    dq/dkv backward) — the composed path's [B,H,S,S] tensors would need
+    ~4.3 GB temp HBM per layer pair (BASELINE long-context note)."""
+    return bench_transformer(
+        batch=int(os.environ.get("TF_BATCH", "4")),
+        seq=int(os.environ.get("TF_SEQ", "4096")))
 
 
 def bench_transformer_canonical():
@@ -283,7 +295,7 @@ def bench_ctr():
     from paddle_tpu.core.engine import Engine
     from paddle_tpu.core.scope import Scope
 
-    B = 4096
+    B = int(os.environ.get("CTR_BATCH", "4096"))
     num_slots, num_dense = 26, 13
     fluid.framework.unique_name.reset()
     main_prog, startup = fluid.Program(), fluid.Program()
@@ -443,6 +455,7 @@ def bench_dygraph():
 def _config_table():
     return {
         "transformer_s256": (bench_transformer_canonical, "tokens/sec"),
+        "transformer_s4096": (bench_transformer_longctx, "tokens/sec"),
         "mnist_lenet": (bench_lenet, "images/sec"),
         "resnet50": (bench_resnet50, "images/sec"),
         "wide_deep_ctr": (bench_ctr, "examples/sec"),

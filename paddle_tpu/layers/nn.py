@@ -931,8 +931,8 @@ def multiplex(inputs, index):
     return out
 
 
-def fused_attention(q, k, v, bias=None, scale=None, block_q=128,
-                    block_k=128, layout="bhsd", dropout_prob=0.0,
+def fused_attention(q, k, v, bias=None, scale=None, block_q=None,
+                    block_k=None, layout="bhsd", dropout_prob=0.0,
                     is_test=False, name=None):
     """Fused multi-head attention via the Pallas flash kernel
     (paddle_tpu/kernels/flash_attention.py). q/k/v: [B, H, S, D]
@@ -948,7 +948,8 @@ def fused_attention(q, k, v, bias=None, scale=None, block_q=128,
                      outputs={"Out": out},
                      attrs={"scale": -1.0 if scale is None else
                             float(scale),
-                            "block_q": block_q, "block_k": block_k,
+                            "block_q": int(block_q or 0),
+                            "block_k": int(block_k or 0),
                             "layout": layout,
                             "dropout_prob": float(dropout_prob),
                             "is_test": bool(is_test)})

@@ -138,3 +138,85 @@ def test_backward_never_materializes_scores_in_hbm():
     txt = jax.jit(jax.grad(loss, (0, 1, 2))).lower(q, k, v).as_text()
     assert f"{S},{S}" not in txt.replace(" ", ""), (
         "backward HLO materializes an SxS intermediate")
+
+
+def test_fused_attention_op_grad_without_bias_grad():
+    """The op's custom grad (ops/fused.py): with a mask bias whose
+    gradient is NOT demanded, dq/dk/dv must still include the bias in
+    the score recompute (kernel regime, want_dbias=False), matching the
+    composed reference; and demanding the bias grad must produce it."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core.engine import run_block_ops
+    from paddle_tpu.core.registry import _RngCtx
+
+    rng = np.random.default_rng(5)
+    B, H, S, D = 2, 2, 128, 16
+    qn = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
+    kn = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
+    vn = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
+    bn = jnp.asarray(rng.standard_normal((B, 1, S, S)), jnp.float32)
+
+    fluid.framework.unique_name.reset()
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        block = main.global_block()
+        mk = lambda n: block.create_var(name=n, dtype="float32",
+                                        stop_gradient=False)
+        q_v, k_v, v_v, b_v, o_v = (mk(n) for n in
+                                   ("fq", "fk", "fv", "fb", "fo"))
+        block.append_op(
+            "fused_attention",
+            inputs={"Q": q_v, "K": k_v, "V": v_v, "BiasQK": b_v},
+            outputs={"Out": o_v},
+            attrs={"scale": float(D) ** -0.5, "block_q": 128,
+                   "block_k": 128, "layout": "bhsd"})
+        fwd_op = block.ops[-1]
+        # grad op desc: dbias NOT bound
+        gop = block.append_op(
+            "fused_attention_grad",
+            inputs={"Q": q_v, "K": k_v, "V": v_v, "BiasQK": b_v,
+                    "Out": o_v,
+                    "Out@GRAD": block.create_var(name="fo@GRAD",
+                                                 dtype="float32")},
+            outputs={"Q@GRAD": mk("fq@GRAD"), "K@GRAD": mk("fk@GRAD"),
+                     "V@GRAD": mk("fv@GRAD")},
+            attrs=dict(fwd_op._all_attrs()))
+
+    go = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.float32)
+    env = {"fq": qn, "fk": kn, "fv": vn, "fb": bn, "fo@GRAD": go}
+    from paddle_tpu.core.registry import OPS, ExecContext
+    OPS.get("fused_attention").lowering(
+        ExecContext(fwd_op, env, _RngCtx(jax.random.PRNGKey(0))))
+    OPS.get("fused_attention_grad").lowering(
+        ExecContext(gop, env, _RngCtx(jax.random.PRNGKey(0))))
+
+    def ref(q, k, v, b):
+        return (fa._attn_reference(q, k, v, b, float(D) ** -0.5)
+                * go).sum()
+
+    gq, gk, gv, gb = jax.grad(ref, (0, 1, 2, 3))(qn, kn, vn, bn)
+    np.testing.assert_allclose(np.asarray(env["fq@GRAD"]),
+                               np.asarray(gq), atol=2e-4, rtol=2e-4)
+    np.testing.assert_allclose(np.asarray(env["fk@GRAD"]),
+                               np.asarray(gk), atol=2e-4, rtol=2e-4)
+    np.testing.assert_allclose(np.asarray(env["fv@GRAD"]),
+                               np.asarray(gv), atol=2e-4, rtol=2e-4)
+    assert "fb@GRAD" not in env  # dbias suppressed
+
+    # now DEMAND the bias grad through the same custom lowering
+    with fluid.program_guard(main, fluid.Program()):
+        block = main.global_block()
+        gop2 = block.append_op(
+            "fused_attention_grad",
+            inputs={"Q": q_v, "K": k_v, "V": v_v, "BiasQK": b_v,
+                    "Out": o_v,
+                    "Out@GRAD": block.var("fo@GRAD")},
+            outputs={"Q@GRAD": block.var("fq@GRAD"),
+                     "BiasQK@GRAD": block.create_var(
+                         name="fb@GRAD", dtype="float32",
+                         stop_gradient=False)},
+            attrs=dict(fwd_op._all_attrs()))
+    OPS.get("fused_attention_grad").lowering(
+        ExecContext(gop2, env, _RngCtx(jax.random.PRNGKey(0))))
+    np.testing.assert_allclose(np.asarray(env["fb@GRAD"]),
+                               np.asarray(gb), atol=2e-4, rtol=2e-4)
