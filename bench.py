@@ -507,8 +507,17 @@ def main():
             print(f"# headline transformer: FAILED\n{r.stderr[-500:]}",
                   file=sys.stderr)
         for name in _config_table():
-            r = subprocess.run([sys.executable, me, "--config", name],
-                               capture_output=True, text=True)
+            # one retry: the tunnel occasionally drops a long remote
+            # compile mid-body ("response body closed") — an infra
+            # flake, not a model failure
+            for attempt in (1, 2):
+                r = subprocess.run([sys.executable, me, "--config",
+                                    name],
+                                   capture_output=True, text=True)
+                if r.returncode == 0:
+                    break
+                print(f"# {name}: attempt {attempt} failed",
+                      file=sys.stderr)
             if r.returncode == 0:
                 for line in r.stderr.splitlines():
                     if line.startswith("#"):

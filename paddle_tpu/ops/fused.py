@@ -35,7 +35,11 @@ def _attn_args(ctx):
     scale = ctx.attr("scale", None)
     if scale is None or scale <= 0:
         scale = float(q.shape[-1]) ** -0.5
-    q, k, v = amp_cast("fused_attention", q, k, v)
+    # bias included: the FORWARD context white-casts every float input
+    # (ExecContext), but the grad op is policy-unlisted — casting here
+    # keeps the recomputed forward bit-identical (CSE) and the backward
+    # differentiating exactly the function the forward executed
+    q, k, v, bias = amp_cast("fused_attention", q, k, v, bias)
     # Block-size policy: user-set attrs win; otherwise scale with the
     # sequence — r4 A/B at B=4 H=8 S=4096 D=64: bq=512/bk=1024 runs
     # the forward kernel 2.3x faster than 128/128 (10.99 vs 25.07 ms;
@@ -79,9 +83,15 @@ def fused_attention(ctx):
                 "fused_attention: attention-weights dropout is not "
                 "applied on the long-context Pallas kernel path",
                 stacklevel=2)
-        out, _ = _fa_forward(q, k, v, bias, scale, bq, bk,
-                             return_lse=True, layout=layout,
-                             raw_lse=True)
+        if ctx.attr("is_test", False):
+            # inference: no grad op will consume lse — skip the
+            # un-DCE-able wide-lse output entirely
+            out = _fa_forward(q, k, v, bias, scale, bq, bk,
+                              layout=layout)
+        else:
+            out, _ = _fa_forward(q, k, v, bias, scale, bq, bk,
+                                 return_lse=True, layout=layout,
+                                 raw_lse=True)
     else:
         # shape-bounded regime / CPU / odd shapes: XLA's fully-fused
         # composed formulation is faster while [Sq,Sk] fits (see the

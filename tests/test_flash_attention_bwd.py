@@ -146,7 +146,6 @@ def test_fused_attention_op_grad_without_bias_grad():
     the score recompute (kernel regime, want_dbias=False), matching the
     composed reference; and demanding the bias grad must produce it."""
     import paddle_tpu as fluid
-    from paddle_tpu.core.engine import run_block_ops
     from paddle_tpu.core.registry import _RngCtx
 
     rng = np.random.default_rng(5)
