@@ -206,10 +206,12 @@ def op_freq_statistic(program):
 
 
 def extend_with_decoupled_weight_decay(base_optimizer):
-    """reference contrib/optimizer.py DecoupledWeightDecay (AdamW):
-    the decay term must NOT pass through the base optimizer's moment
-    estimates — it is applied directly to the parameter after the
-    update: param <- param_updated - lr*coeff*param_pre_update."""
+    """reference contrib/extend_optimizer/extend_optimizer_with_weight_decay.py
+    DecoupledWeightDecay: the decay term must NOT pass through the base
+    optimizer's moment estimates — it is applied directly to the
+    parameter after the update, with NO learning-rate factor
+    (extend_optimizer_with_weight_decay.py:107:
+    new_parameter = optimized_parameter - parameter * coeff)."""
     class DecoupledWeightDecay(base_optimizer):
         def __init__(self, *args, weight_decay=0.0, **kwargs):
             self._weight_decay = float(weight_decay)
@@ -223,14 +225,9 @@ def extend_with_decoupled_weight_decay(base_optimizer):
             snapshots = [(p, layers.scale(p, scale=1.0))
                          for p, _ in params_grads]
             ops = super().apply_gradients(params_grads)
-            try:
-                lr = float(self._learning_rate)
-            except (TypeError, ValueError):
-                lr = 1.0  # variable lr: coeff interpreted as lr*coeff
             for p, snap in snapshots:
                 decayed = layers.elementwise_sub(
-                    p, layers.scale(snap,
-                                    scale=lr * self._weight_decay))
+                    p, layers.scale(snap, scale=self._weight_decay))
                 layers.assign(decayed, output=p)
             return ops
 

@@ -53,6 +53,79 @@ _NEG_INF = -1e30
 _INTERPRET = False
 
 
+# ---------------------------------------------------------------------------
+# attention-weights dropout (reference dist_transformer.py:1043-1044 —
+# layers.dropout applied to the softmax WEIGHTS) inside the kernels
+# ---------------------------------------------------------------------------
+
+def _mix32(h):
+    """murmur3 finalizer on uint32 (works on jnp arrays in and out of
+    kernels)."""
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    return h ^ (h >> 16)
+
+
+def _hash_keep(s0, s1, bh, q_start, k_start, bq, bk, Sk, t):
+    """u8-threshold keep mask for one [bq, bk] score tile, as a pure
+    function of (seed, head, absolute row, absolute col) — block-
+    geometry-independent, so fwd and both bwd kernels regenerate
+    bit-identical masks, and it runs under the Pallas interpreter
+    (pltpu.prng_* has no interpreter lowering in this JAX). Compiled
+    kernels use the hardware PRNG instead (_tile_keep): the ~12
+    int-ops/element here would rival the block's MXU time."""
+    rows = (jax.lax.broadcasted_iota(jnp.uint32, (bq, bk), 0)
+            + q_start.astype(jnp.uint32))
+    cols = (jax.lax.broadcasted_iota(jnp.uint32, (bq, bk), 1)
+            + k_start.astype(jnp.uint32))
+    pos = rows * jnp.uint32(Sk) + cols
+    seed = (s0.astype(jnp.uint32)
+            ^ _mix32(s1.astype(jnp.uint32)
+                     ^ bh.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)))
+    return (_mix32(pos ^ seed) & jnp.uint32(255)) < jnp.uint32(t)
+
+
+def dropout_keep_mask(seed, B, H, Sq, Sk, t):
+    """[B, H, Sq, Sk] keep mask exactly as the INTERPRET-mode kernels
+    realize it (test/debug helper). seed: int32[2] (bitcast of the op's
+    uint32 PRNG key). Compiled kernels draw from the TPU hardware PRNG
+    instead; their masks share the seeding contract but not the bits."""
+    rows = jnp.arange(Sq, dtype=jnp.uint32)[:, None]
+    cols = jnp.arange(Sk, dtype=jnp.uint32)[None, :]
+    pos = rows * jnp.uint32(Sk) + cols
+    bh = jnp.arange(B * H, dtype=jnp.uint32).reshape(B, H, 1, 1)
+    sd = (seed[0].astype(jnp.uint32)
+          ^ _mix32(seed[1].astype(jnp.uint32)
+                   ^ bh * jnp.uint32(0x9E3779B1)))
+    return (_mix32(pos[None, None] ^ sd)
+            & jnp.uint32(255)) < jnp.uint32(t)
+
+
+def _tile_keep(plan, seed_ref, bh, q_idx, kv_idx, t):
+    """Keep mask for a local head's [bq, bk] tile at grid step
+    (q_idx, kv_idx). bh = the head's global batch*H+head id (computed
+    at kernel top — pl.program_id can't sit inside a pl.when body in
+    the interpreter). Seeded per (key, global head, q block, kv block)
+    — the same tuple in the forward and both backward kernels, so the
+    recomputed masks agree."""
+    bq, bk = plan.bq, plan.bk
+    if _INTERPRET:
+        return _hash_keep(seed_ref[0], seed_ref[1], bh,
+                          q_idx * bq, kv_idx * bk, bq, bk, plan.Sk, t)
+    # Mosaic's PRNG takes at most TWO seed words: fold the 5-tuple
+    # down with scalar mixes (once per block, scalar core)
+    a = _mix32(seed_ref[0].astype(jnp.uint32)
+               ^ bh.astype(jnp.uint32) * jnp.uint32(0x9E3779B1))
+    b = _mix32(seed_ref[1].astype(jnp.uint32)
+               ^ q_idx.astype(jnp.uint32) * jnp.uint32(0x85EBCA6B)
+               ^ kv_idx.astype(jnp.uint32) * jnp.uint32(0xC2B2AE35))
+    pltpu.prng_seed(a, b)
+    bits = pltpu.prng_random_bits((bq, bk))
+    return (bits & 255) < t
+
+
 def _dims(q, layout):
     if layout == "bshd":
         B, S, H, D = q.shape
@@ -114,18 +187,31 @@ class _Plan:
         base = 2 if self.layout == "bshd" else 1
         return (base + 1, base) if swap else (base, base + 1)
 
-    def row_spec(self, blk, width_per_head, which_axis):
+    def bh(self, i):
+        """Global batch*H + head index of local head i at this grid
+        step — the per-head dropout stream id (identical across the
+        fwd/dq/dkv grids)."""
+        if self.layout == "bshd":
+            return (pl.program_id(0) * self.H
+                    + pl.program_id(1) * self.hpb + i)
+        return pl.program_id(0)
+
+    def row_spec(self, blk, width_per_head, which_axis, idx=None):
         """Spec for a q/k/v/out/do/lse tensor: [blk rows x
         hpb*width_per_head lanes]. which_axis = grid position of the
-        sequence index."""
+        sequence index; idx (callable(g) -> index) overrides it — the
+        causal path clamps the masked-out tail of a sequential axis to
+        its last live block, so Mosaic sees a repeated block index and
+        elides the DMA for skipped steps."""
+        get = (lambda g: g[which_axis]) if idx is None else idx
         if self.layout == "bshd":
             def index_map(*g):
-                return (g[0], g[which_axis], g[1])
+                return (g[0], get(g), g[1])
             return pl.BlockSpec(
                 (None, blk, self.hpb * width_per_head), index_map)
 
         def index_map(*g):
-            return (g[0], g[which_axis], 0)
+            return (g[0], get(g), 0)
         return pl.BlockSpec((None, blk, width_per_head), index_map)
 
     def wide_shape(self, S):
@@ -134,13 +220,15 @@ class _Plan:
             return (self.B, S, self.Hg * self.hpb * 128)
         return (self.B * self.H, S, 128)
 
-    def wide_spec(self, blk, which_axis):
-        return self.row_spec(blk, 128, which_axis)
+    def wide_spec(self, blk, which_axis, idx=None):
+        return self.row_spec(blk, 128, which_axis, idx=idx)
 
     def bias_info(self, bias):
         """Returns (reshaped_bias, spec_factory, per_head, per_q).
-        spec_factory(q_axis, k_axis) -> BlockSpec whose ref is
-        [hpb, bqs, bk] for packed per-head bias, else [bqs, bk]."""
+        spec_factory(q_axis, k_axis, q_idx=, k_idx=) -> BlockSpec whose
+        ref is [hpb, bqs, bk] for packed per-head bias, else [bqs, bk];
+        the optional idx callables clamp a sequential axis (causal DMA
+        elision, see row_spec)."""
         B, H, Sq = self.B, self.H, self.Sq
         bq, bk, hpb = self.bq, self.bk, self.hpb
         per_head = bias.shape[1] != 1
@@ -151,28 +239,36 @@ class _Plan:
                 br = bias.reshape(B, self.Hg, hpb,
                                   Sq if per_q else 1, bias.shape[3])
 
-                def factory(q_axis, k_axis):
+                def factory(q_axis, k_axis, q_idx=None, k_idx=None):
+                    qg = (lambda g: g[q_axis]) if q_idx is None else q_idx
+                    kg = (lambda g: g[k_axis]) if k_idx is None else k_idx
+
                     def index_map(*g):
                         return (g[0], g[1], 0,
-                                g[q_axis] if per_q else 0, g[k_axis])
+                                qg(g) if per_q else 0, kg(g))
                     return pl.BlockSpec((None, None, hpb, bqs, bk),
                                         index_map)
             else:
                 br = bias.reshape(B, Sq if per_q else 1, bias.shape[3])
 
-                def factory(q_axis, k_axis):
+                def factory(q_axis, k_axis, q_idx=None, k_idx=None):
+                    qg = (lambda g: g[q_axis]) if q_idx is None else q_idx
+                    kg = (lambda g: g[k_axis]) if k_idx is None else k_idx
+
                     def index_map(*g):
-                        return (g[0], g[q_axis] if per_q else 0,
-                                g[k_axis])
+                        return (g[0], qg(g) if per_q else 0, kg(g))
                     return pl.BlockSpec((None, bqs, bk), index_map)
             return br, factory, per_head, per_q
         br = bias.reshape((B * H if per_head else B,
                            Sq if per_q else 1, bias.shape[3]))
 
-        def factory(q_axis, k_axis):
+        def factory(q_axis, k_axis, q_idx=None, k_idx=None):
+            qg = (lambda g: g[q_axis]) if q_idx is None else q_idx
+            kg = (lambda g: g[k_axis]) if k_idx is None else k_idx
+
             def index_map(*g):
                 return (g[0] if per_head else g[0] // H,
-                        g[q_axis] if per_q else 0, g[k_axis])
+                        qg(g) if per_q else 0, kg(g))
             return pl.BlockSpec((None, bqs, bk), index_map)
         return br, factory, per_head, per_q
 
@@ -223,10 +319,30 @@ class _Plan:
 # kernel bodies (shared by both layouts via the plan's lane slicing)
 # ---------------------------------------------------------------------------
 
-def _fa_kernel(plan, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
-               m_scr, l_scr, acc_scr, *, scale, n_kv, kv_axis):
+def _causal_mask(s, q_idx, kv_idx, bq, bk):
+    """Mask s to the causal triangle (absolute positions; fully-visible
+    blocks get an all-true compare, masked-out blocks never run)."""
+    rows = q_idx * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    cols = kv_idx * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(rows >= cols, s, _NEG_INF)
+
+
+def _causal_mask_dense(s):
+    """Whole-matrix sibling of _causal_mask for the composed paths
+    (s [..., Sq, Sk], absolute rows >= cols convention)."""
+    rows = jnp.arange(s.shape[-2])[:, None]
+    cols = jnp.arange(s.shape[-1])[None, :]
+    return jnp.where(rows >= cols, s, _NEG_INF)
+
+
+def _fa_kernel(plan, seed_ref, q_ref, k_ref, v_ref, bias_ref, o_ref,
+               lse_ref, m_scr, l_scr, acc_scr, *, scale, n_kv,
+               q_axis, kv_axis, causal, drop_t):
     kv_idx = pl.program_id(kv_axis)
-    D = plan.D
+    q_idx = pl.program_id(q_axis)
+    D, bq, bk = plan.D, plan.bq, plan.bk
+    bhs = [plan.bh(i) for i in range(plan.hpb)] \
+        if drop_t is not None else None
 
     @pl.when(kv_idx == 0)
     def _init():
@@ -234,29 +350,50 @@ def _fa_kernel(plan, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    for i in range(plan.hpb):
-        q = plan.lanes(q_ref, i, D)                # [bq, D]
-        k = plan.lanes(k_ref, i, D)                # [bk, D]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [bq, bk]
-        bt = plan.bias_tile(bias_ref, i)
-        if bt is not None:
-            s = s + bt
+    def _body():
+        for i in range(plan.hpb):
+            q = plan.lanes(q_ref, i, D)                # [bq, D]
+            k = plan.lanes(k_ref, i, D)                # [bk, D]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale  # [bq, bk]
+            bt = plan.bias_tile(bias_ref, i)
+            if bt is not None:
+                s = s + bt
+            if causal:
+                s = _causal_mask(s, q_idx, kv_idx, bq, bk)
 
-        m_prev = m_scr[i][:, :1]                   # [bq, 1]
-        l_prev = l_scr[i][:, :1]
-        m_curr = jnp.max(s, axis=-1, keepdims=True)
-        m_next = jnp.maximum(m_prev, m_curr)
-        corr = jnp.exp(m_prev - m_next)
-        p = jnp.exp(s - m_next)                    # [bq, bk]
-        l_next = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc_scr[i] = acc_scr[i] * corr + jax.lax.dot_general(
-            p.astype(v_ref.dtype), plan.lanes(v_ref, i, D),
-            (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_scr[i] = jnp.broadcast_to(m_next, m_scr[i].shape)
-        l_scr[i] = jnp.broadcast_to(l_next, l_scr[i].shape)
+            m_prev = m_scr[i][:, :1]                   # [bq, 1]
+            l_prev = l_scr[i][:, :1]
+            m_curr = jnp.max(s, axis=-1, keepdims=True)
+            m_next = jnp.maximum(m_prev, m_curr)
+            corr = jnp.exp(m_prev - m_next)
+            p = jnp.exp(s - m_next)                    # [bq, bk]
+            l_next = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+            # dropout hits the WEIGHTS (numerator) only: the softmax
+            # denominator l comes from the undropped p, matching
+            # dropout(softmax(s)) @ v semantics
+            p_v = p
+            if drop_t is not None:
+                keep = _tile_keep(plan, seed_ref, bhs[i], q_idx, kv_idx,
+                                  drop_t)
+                p_v = jnp.where(keep, p * (256.0 / drop_t), 0.0)
+            acc_scr[i] = acc_scr[i] * corr + jax.lax.dot_general(
+                p_v.astype(v_ref.dtype), plan.lanes(v_ref, i, D),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            m_scr[i] = jnp.broadcast_to(m_next, m_scr[i].shape)
+            l_scr[i] = jnp.broadcast_to(l_next, l_scr[i].shape)
+
+    if causal:
+        # skip fully-masked KV blocks (everything strictly above the
+        # block diagonal): no MXU work, and the clamped index maps
+        # already elided their DMA
+        @pl.when(q_idx * bq + bq > kv_idx * bk)
+        def _run():
+            _body()
+    else:
+        _body()
 
     @pl.when(kv_idx == n_kv - 1)
     def _finish():
@@ -271,42 +408,74 @@ def _fa_kernel(plan, q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
                         l_scr[i], 1e-30))).astype(lse_ref.dtype))
 
 
-def _fa_bwd_dq_kernel(plan, q_ref, k_ref, v_ref, lse_ref, out_ref,
-                      do_ref, glse_ref, bias_ref, dq_ref, ds_ref,
-                      dq_scr, *, scale, n_kv, kv_axis):
+def _fa_bwd_dq_kernel(plan, seed_ref, q_ref, k_ref, v_ref, lse_ref,
+                      out_ref, do_ref, glse_ref, bias_ref, dq_ref,
+                      ds_ref, dq_scr, *, scale, n_kv, q_axis, kv_axis,
+                      causal, drop_t):
     kv_idx = pl.program_id(kv_axis)
-    D = plan.D
+    q_idx = pl.program_id(q_axis)
+    D, bq, bk = plan.D, plan.bq, plan.bk
+    bhs = [plan.bh(i) for i in range(plan.hpb)] \
+        if drop_t is not None else None
 
     @pl.when(kv_idx == 0)
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    for i in range(plan.hpb):
-        q = plan.lanes(q_ref, i, D)                 # [bq, D]
-        k = plan.lanes(k_ref, i, D)                 # [bk, D]
-        v = plan.lanes(v_ref, i, D)
-        do = plan.lanes(do_ref, i, D).astype(jnp.float32)
-        lse = plan.lanes(lse_ref, i, 128)[:, :1]    # [bq, 1]
-        di = jnp.sum(plan.lanes(out_ref, i, D).astype(jnp.float32)
-                     * do, axis=-1, keepdims=True)
-        if glse_ref is not None:
-            di = di - plan.lanes(glse_ref, i, 128)[:, :1]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        bt = plan.bias_tile(bias_ref, i)
-        if bt is not None:
-            s = s + bt
-        p = jnp.exp(s - lse)                        # [bq, bk]
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        ds = p * (dp - di)
+    def _body():
+        for i in range(plan.hpb):
+            q = plan.lanes(q_ref, i, D)                 # [bq, D]
+            k = plan.lanes(k_ref, i, D)                 # [bk, D]
+            v = plan.lanes(v_ref, i, D)
+            do = plan.lanes(do_ref, i, D).astype(jnp.float32)
+            lse = plan.lanes(lse_ref, i, 128)[:, :1]    # [bq, 1]
+            di = jnp.sum(plan.lanes(out_ref, i, D).astype(jnp.float32)
+                         * do, axis=-1, keepdims=True)
+            if glse_ref is not None:
+                di = di - plan.lanes(glse_ref, i, 128)[:, :1]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            bt = plan.bias_tile(bias_ref, i)
+            if bt is not None:
+                s = s + bt
+            if causal:
+                s = _causal_mask(s, q_idx, kv_idx, bq, bk)
+            p = jnp.exp(s - lse)                        # [bq, bk]
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            if drop_t is not None:
+                # chain rule through p_drop = keep * p * 256/t:
+                # dp flows only through kept weights (di already equals
+                # sum(p_drop * dp) because out was computed with p_drop)
+                keep = _tile_keep(plan, seed_ref, bhs[i], q_idx, kv_idx,
+                                  drop_t)
+                dp = jnp.where(keep, dp * (256.0 / drop_t), 0.0)
+            ds = p * (dp - di)
+            if ds_ref is not None:
+                plan.ds_store(ds_ref, i, ds.astype(ds_ref.dtype))
+            dq_scr[i] += scale * jax.lax.dot_general(
+                ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+    if causal:
+        run = q_idx * bq + bq > kv_idx * bk
+
+        @pl.when(run)
+        def _run():
+            _body()
+
         if ds_ref is not None:
-            plan.ds_store(ds_ref, i, ds.astype(ds_ref.dtype))
-        dq_scr[i] += scale * jax.lax.dot_general(
-            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            # the ds OUTPUT block for a skipped step is never written
+            # by _body — zero it so dbias sums clean tiles
+            @pl.when(jnp.logical_not(run))
+            def _zero_ds():
+                for i in range(plan.hpb):
+                    plan.ds_store(ds_ref, i,
+                                  jnp.zeros((bq, bk), ds_ref.dtype))
+    else:
+        _body()
 
     @pl.when(kv_idx == n_kv - 1)
     def _finish():
@@ -315,45 +484,68 @@ def _fa_bwd_dq_kernel(plan, q_ref, k_ref, v_ref, lse_ref, out_ref,
                              dq_scr[i].astype(dq_ref.dtype))
 
 
-def _fa_bwd_dkv_kernel(plan, q_ref, k_ref, v_ref, lse_ref, out_ref,
-                       do_ref, glse_ref, bias_ref, dk_ref, dv_ref,
-                       dk_scr, dv_scr, *, scale, n_q, q_axis):
+def _fa_bwd_dkv_kernel(plan, seed_ref, q_ref, k_ref, v_ref, lse_ref,
+                       out_ref, do_ref, glse_ref, bias_ref, dk_ref,
+                       dv_ref, dk_scr, dv_scr, *, scale, n_q, q_axis,
+                       kv_axis, causal, drop_t):
     q_idx = pl.program_id(q_axis)
-    D = plan.D
+    kv_idx = pl.program_id(kv_axis)
+    D, bq, bk = plan.D, plan.bq, plan.bk
+    bhs = [plan.bh(i) for i in range(plan.hpb)] \
+        if drop_t is not None else None
 
     @pl.when(q_idx == 0)
     def _init():
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    for i in range(plan.hpb):
-        q = plan.lanes(q_ref, i, D)
-        k = plan.lanes(k_ref, i, D)
-        v = plan.lanes(v_ref, i, D)
-        do = plan.lanes(do_ref, i, D).astype(jnp.float32)
-        lse = plan.lanes(lse_ref, i, 128)[:, :1]
-        di = jnp.sum(plan.lanes(out_ref, i, D).astype(jnp.float32)
-                     * do, axis=-1, keepdims=True)
-        if glse_ref is not None:
-            di = di - plan.lanes(glse_ref, i, 128)[:, :1]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        bt = plan.bias_tile(bias_ref, i)
-        if bt is not None:
-            s = s + bt
-        p = jnp.exp(s - lse)                        # [bq, bk]
-        dv_scr[i] += jax.lax.dot_general(
-            p.astype(do_ref.dtype), plan.lanes(do_ref, i, D),
-            (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        ds = p * (dp - di)
-        dk_scr[i] += scale * jax.lax.dot_general(
-            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+    def _body():
+        for i in range(plan.hpb):
+            q = plan.lanes(q_ref, i, D)
+            k = plan.lanes(k_ref, i, D)
+            v = plan.lanes(v_ref, i, D)
+            do = plan.lanes(do_ref, i, D).astype(jnp.float32)
+            lse = plan.lanes(lse_ref, i, 128)[:, :1]
+            di = jnp.sum(plan.lanes(out_ref, i, D).astype(jnp.float32)
+                         * do, axis=-1, keepdims=True)
+            if glse_ref is not None:
+                di = di - plan.lanes(glse_ref, i, 128)[:, :1]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            bt = plan.bias_tile(bias_ref, i)
+            if bt is not None:
+                s = s + bt
+            if causal:
+                s = _causal_mask(s, q_idx, kv_idx, bq, bk)
+            p = jnp.exp(s - lse)                        # [bq, bk]
+            keep = None
+            if drop_t is not None:
+                keep = _tile_keep(plan, seed_ref, bhs[i], q_idx, kv_idx,
+                                  drop_t)
+            # dv consumes the DROPPED weights (out = p_drop @ v)
+            p_v = p if keep is None else \
+                jnp.where(keep, p * (256.0 / drop_t), 0.0)
+            dv_scr[i] += jax.lax.dot_general(
+                p_v.astype(do_ref.dtype), plan.lanes(do_ref, i, D),
+                (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            if keep is not None:
+                dp = jnp.where(keep, dp * (256.0 / drop_t), 0.0)
+            ds = p * (dp - di)
+            dk_scr[i] += scale * jax.lax.dot_general(
+                ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(q_idx * bq + bq > kv_idx * bk)
+        def _run():
+            _body()
+    else:
+        _body()
 
     @pl.when(q_idx == n_q - 1)
     def _finish():
@@ -368,8 +560,18 @@ def _fa_bwd_dkv_kernel(plan, q_ref, k_ref, v_ref, lse_ref, out_ref,
 # forward
 # ---------------------------------------------------------------------------
 
+def _seed_i32(dropout):
+    """(uint32 key, t) -> (int32[2] SMEM seed, static t)."""
+    if dropout is None:
+        return None, None
+    key, t = dropout
+    return jax.lax.bitcast_convert_type(key, jnp.int32).reshape(2), \
+        int(t)
+
+
 def _fa_forward(q, k, v, bias, scale, block_q, block_k,
-                return_lse=False, layout="bhsd", raw_lse=False):
+                return_lse=False, layout="bhsd", raw_lse=False,
+                causal=False, dropout=None):
     B, H, Sq, D = _dims(q, layout)
     Sk = _seq_len(k, layout)
     bq = min(block_q, Sq)
@@ -387,20 +589,33 @@ def _fa_forward(q, k, v, bias, scale, block_q, block_k,
     grid = plan.grid(Sq // bq, n_kv)
     qa, ka = plan.seq_axes(swap=False)
     kv_axis = len(grid) - 1
+    seed, drop_t = _seed_i32(dropout)
+    has_drop = seed is not None
+
+    k_idx = None
+    if causal:
+        # clamp the (sequential) kv axis to the diagonal block for
+        # masked-out steps: repeated block index -> Mosaic elides the
+        # k/v/bias DMA for the skipped upper triangle
+        def k_idx(g):
+            return jnp.minimum(g[ka], (g[qa] * bq + bq - 1) // bk)
 
     in_specs = [
         plan.row_spec(bq, D, qa),
-        plan.row_spec(bk, D, ka),
-        plan.row_spec(bk, D, ka),
+        plan.row_spec(bk, D, ka, idx=k_idx),
+        plan.row_spec(bk, D, ka, idx=k_idx),
     ]
     args = [plan.rows(q), plan.rows(k), plan.rows(v)]
     if bias is not None:
         br, bfac, _, _ = plan.bias_info(bias)
-        in_specs.append(bfac(qa, ka))
+        in_specs.append(bfac(qa, ka, k_idx=k_idx))
         args.append(br)
         has_bias = True
     else:
         has_bias = False
+    if has_drop:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(seed)
 
     out_rows = ((B, Sq, H * D) if layout == "bshd"
                 else (B * H, Sq, D))
@@ -414,14 +629,17 @@ def _fa_forward(q, k, v, bias, scale, block_q, block_k,
         i = 3
         b_ref = refs[i] if has_bias else None
         i += has_bias
+        seed_ref = refs[i] if has_drop else None
+        i += has_drop
         o_ref = refs[i]
         i += 1
         lse_ref = refs[i] if return_lse else None
         i += return_lse
         m, l, a = refs[i:i + 3]
-        return _fa_kernel(plan, refs[0], refs[1], refs[2], b_ref,
-                          o_ref, lse_ref, m, l, a, scale=scale,
-                          n_kv=n_kv, kv_axis=kv_axis)
+        return _fa_kernel(plan, seed_ref, refs[0], refs[1], refs[2],
+                          b_ref, o_ref, lse_ref, m, l, a, scale=scale,
+                          n_kv=n_kv, q_axis=qa, kv_axis=kv_axis,
+                          causal=causal, drop_t=drop_t)
 
     res = pl.pallas_call(
         kern,
@@ -476,7 +694,7 @@ def _widen(x_bhs, plan):
 
 def _fa_backward(q, k, v, bias, out, lse, g, scale, block_q, block_k,
                  g_lse=None, layout="bhsd", lse_wide=False,
-                 want_dbias=None):
+                 want_dbias=None, causal=False, dropout=None):
     """Kernel-path backward: returns (dq, dk, dv, dbias?).
 
     lse arrives either in its wide carrier form straight from the
@@ -520,15 +738,23 @@ def _fa_backward(q, k, v, bias, out, lse, g, scale, block_q, block_k,
     else:
         want_dbias = bool(want_dbias) and bias is not None
     has_glse = glse_w is not None
+    seed, drop_t = _seed_i32(dropout)
+    has_drop = seed is not None
 
     # ---- dq (+ds when dbias is needed): reduction over kv ------------
     grid = plan.grid(n_q, n_kv)
     qa, ka = plan.seq_axes(swap=False)
     kv_axis = len(grid) - 1
+
+    k_idx = None
+    if causal:
+        def k_idx(g):
+            return jnp.minimum(g[ka], (g[qa] * bq + bq - 1) // bk)
+
     in_specs = [
         plan.row_spec(bq, D, qa),
-        plan.row_spec(bk, D, ka),
-        plan.row_spec(bk, D, ka),
+        plan.row_spec(bk, D, ka, idx=k_idx),
+        plan.row_spec(bk, D, ka, idx=k_idx),
         plan.wide_spec(bq, qa),
         plan.row_spec(bq, D, qa),
         plan.row_spec(bq, D, qa),
@@ -542,8 +768,11 @@ def _fa_backward(q, k, v, bias, out, lse, g, scale, block_q, block_k,
         # bias always feeds the score recompute; ds is emitted ONLY
         # when a bias gradient is actually demanded
         br, bfac, per_head, per_q = plan.bias_info(bias)
-        in_specs.append(bfac(qa, ka))
+        in_specs.append(bfac(qa, ka, k_idx=k_idx))
         args.append(br)
+    if has_drop:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(seed)
     if want_dbias:
         out_specs = [plan.row_spec(bq, D, qa),
                      plan.ds_spec(qa, ka)]
@@ -559,15 +788,19 @@ def _fa_backward(q, k, v, bias, out, lse, g, scale, block_q, block_k,
         i += has_glse
         b_r = refs[i] if has_bias else None
         i += has_bias
+        seed_r = refs[i] if has_drop else None
+        i += has_drop
         dq_r = refs[i]
         i += 1
         ds_r = refs[i] if want_dbias else None
         i += want_dbias
         scr = refs[i]
-        return _fa_bwd_dq_kernel(plan, refs[0], refs[1], refs[2],
-                                 refs[3], refs[4], refs[5], gl_r, b_r,
-                                 dq_r, ds_r, scr, scale=scale,
-                                 n_kv=n_kv, kv_axis=kv_axis)
+        return _fa_bwd_dq_kernel(plan, seed_r, refs[0], refs[1],
+                                 refs[2], refs[3], refs[4], refs[5],
+                                 gl_r, b_r, dq_r, ds_r, scr,
+                                 scale=scale, n_kv=n_kv, q_axis=qa,
+                                 kv_axis=kv_axis, causal=causal,
+                                 drop_t=drop_t)
 
     res = pl.pallas_call(
         kern_dq,
@@ -599,22 +832,33 @@ def _fa_backward(q, k, v, bias, out, lse, g, scale, block_q, block_k,
     grid = plan.grid(n_kv, n_q)
     qa, ka = plan.seq_axes(swap=True)
     q_axis = len(grid) - 1
+
+    q_idx_f = None
+    if causal:
+        # the q stream's masked-out HEAD (q blocks strictly above the
+        # diagonal) clamps forward to the diagonal block
+        def q_idx_f(g):
+            return jnp.maximum(g[qa], (g[ka] * bk) // bq)
+
     in_specs = [
-        plan.row_spec(bq, D, qa),
+        plan.row_spec(bq, D, qa, idx=q_idx_f),
         plan.row_spec(bk, D, ka),
         plan.row_spec(bk, D, ka),
-        plan.wide_spec(bq, qa),
-        plan.row_spec(bq, D, qa),
-        plan.row_spec(bq, D, qa),
+        plan.wide_spec(bq, qa, idx=q_idx_f),
+        plan.row_spec(bq, D, qa, idx=q_idx_f),
+        plan.row_spec(bq, D, qa, idx=q_idx_f),
     ]
     args = [qr, kr, vr, lse_w, outr, dor]
     if has_glse:
-        in_specs.append(plan.wide_spec(bq, qa))
+        in_specs.append(plan.wide_spec(bq, qa, idx=q_idx_f))
         args.append(glse_w)
     if has_bias:
         br, bfac, _, _ = plan.bias_info(bias)
-        in_specs.append(bfac(qa, ka))
+        in_specs.append(bfac(qa, ka, q_idx=q_idx_f))
         args.append(br)
+    if has_drop:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(seed)
 
     def kern_dkv(*refs):
         i = 6
@@ -622,11 +866,15 @@ def _fa_backward(q, k, v, bias, out, lse, g, scale, block_q, block_k,
         i += has_glse
         b_r = refs[i] if has_bias else None
         i += has_bias
+        seed_r = refs[i] if has_drop else None
+        i += has_drop
         dk_r, dv_r, ks, vs = refs[i:i + 4]
-        return _fa_bwd_dkv_kernel(plan, refs[0], refs[1], refs[2],
-                                  refs[3], refs[4], refs[5], gl_r,
-                                  b_r, dk_r, dv_r, ks, vs,
-                                  scale=scale, n_q=n_q, q_axis=q_axis)
+        return _fa_bwd_dkv_kernel(plan, seed_r, refs[0], refs[1],
+                                  refs[2], refs[3], refs[4], refs[5],
+                                  gl_r, b_r, dk_r, dv_r, ks, vs,
+                                  scale=scale, n_q=n_q, q_axis=q_axis,
+                                  kv_axis=ka, causal=causal,
+                                  drop_t=drop_t)
 
     dk, dv = pl.pallas_call(
         kern_dkv,
@@ -702,15 +950,19 @@ def _use_kernel_bwd(q, k, block_q, block_k, layout="bhsd"):
 
 
 def _attn_reference(q, k, v, bias, scale, layout="bhsd",
-                    dropout=None):
+                    dropout=None, causal=False):
     """Composed attention. dropout = (key, t) applies u8-threshold
     attention-weights dropout with exact-realized-probability upscale
-    (same contract as the dropout op, ops/nn.py)."""
+    (same contract as the dropout op, ops/nn.py). causal masks to the
+    lower triangle in ABSOLUTE positions (rows >= cols), matching the
+    kernels' block mask."""
     eq = "bqhd,bkhd->bhqk" if layout == "bshd" else "bhqd,bhkd->bhqk"
     s = jnp.einsum(eq, q, k,
                    preferred_element_type=jnp.float32) * scale
     if bias is not None:
         s = s + bias.astype(jnp.float32)
+    if causal:
+        s = _causal_mask_dense(s)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     if dropout is not None:
         key, t = dropout
@@ -724,13 +976,15 @@ def _attn_reference(q, k, v, bias, scale, layout="bhsd",
     return jnp.einsum(eo, p, v)
 
 
-def _attn_reference_lse(q, k, v, bias, scale):
+def _attn_reference_lse(q, k, v, bias, scale, causal=False):
     """Composed attention ([B,H,S,D] only) that also returns logsumexp
     over keys — the CPU/odd-shape counterpart of return_lse mode."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
     if bias is not None:
         s = s + bias.astype(jnp.float32)
+    if causal:
+        s = _causal_mask_dense(s)
     m = jnp.max(s, axis=-1, keepdims=True)
     e = jnp.exp(s - m)
     l = jnp.sum(e, axis=-1, keepdims=True)
@@ -740,49 +994,52 @@ def _attn_reference_lse(q, k, v, bias, scale):
     return out, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
 def flash_attention(q, k, v, bias=None, scale=1.0, block_q=128,
-                    block_k=128, layout="bhsd"):
+                    block_k=128, layout="bhsd", causal=False):
     """q [B,H,Sq,D] (bhsd) or [B,Sq,H,D] (bshd); k/v likewise;
-    bias [B,1|H,Sq|1,Sk] additive in either layout."""
+    bias [B,1|H,Sq|1,Sk] additive in either layout; causal masks to
+    rows >= cols and SKIPS fully-masked KV blocks in the kernels."""
     if _kernel_ok(q, k, block_q, block_k, layout):
         return _fa_forward(q, k, v, bias, scale, block_q, block_k,
-                           layout=layout)
+                           layout=layout, causal=causal)
     qb, kb, vb = q, k, v
     if layout == "bshd":
         qb, kb, vb = (jnp.moveaxis(x, 2, 1) for x in (q, k, v))
-    out = _attn_reference(qb, kb, vb, bias, scale)
+    out = _attn_reference(qb, kb, vb, bias, scale, causal=causal)
     return jnp.moveaxis(out, 1, 2) if layout == "bshd" else out
 
 
-def _fa_fwd(q, k, v, bias, scale, block_q, block_k, layout):
+def _fa_fwd(q, k, v, bias, scale, block_q, block_k, layout, causal):
     if _kernel_ok(q, k, block_q, block_k, layout):
         # lse residual stays in the kernel's wide carrier layout;
         # _kernel_ok is static, so _fa_bwd re-derives the same branch
         out, lse = _fa_forward(q, k, v, bias, scale, block_q, block_k,
                                return_lse=True, layout=layout,
-                               raw_lse=True)
+                               raw_lse=True, causal=causal)
     else:
         qb, kb, vb = q, k, v
         if layout == "bshd":
             qb, kb, vb = (jnp.moveaxis(x, 2, 1) for x in (q, k, v))
-        out, lse = _attn_reference_lse(qb, kb, vb, bias, scale)
+        out, lse = _attn_reference_lse(qb, kb, vb, bias, scale,
+                                       causal=causal)
         if layout == "bshd":
             out = jnp.moveaxis(out, 1, 2)
     return out, (q, k, v, bias, out, lse)
 
 
-def _fa_bwd(scale, block_q, block_k, layout, res, g):
+def _fa_bwd(scale, block_q, block_k, layout, causal, res, g):
     q, k, v, bias, out, lse = res
     if _use_kernel_bwd(q, k, block_q, block_k, layout):
         dq, dk, dv, dbias = _fa_backward(
             q, k, v, bias, out, lse, g, scale, block_q, block_k,
-            layout=layout,
+            layout=layout, causal=causal,
             lse_wide=_kernel_ok(q, k, block_q, block_k, layout))
         return dq, dk, dv, dbias
 
     def f(q, k, v, bias):
-        return _attn_reference(q, k, v, bias, scale, layout=layout)
+        return _attn_reference(q, k, v, bias, scale, layout=layout,
+                               causal=causal)
     _, vjp = jax.vjp(f, q, k, v, bias)
     dq, dk, dv, dbias = vjp(g)
     return dq, dk, dv, None if bias is None else dbias

@@ -933,12 +933,15 @@ def multiplex(inputs, index):
 
 def fused_attention(q, k, v, bias=None, scale=None, block_q=None,
                     block_k=None, layout="bhsd", dropout_prob=0.0,
-                    is_test=False, name=None):
+                    is_test=False, causal=False, name=None):
     """Fused multi-head attention via the Pallas flash kernel
     (paddle_tpu/kernels/flash_attention.py). q/k/v: [B, H, S, D]
     (layout="bhsd") or [B, S, H, D] (layout="bshd" — the free-reshape
     layout of a [B, S, H*D] projection, no head transposes);
-    bias: [B, 1|H, Sq|1, Sk] additive mask or None in either layout."""
+    bias: [B, 1|H, Sq|1, Sk] additive mask or None in either layout.
+    causal=True masks rows >= cols IN the op (kernels skip fully-
+    masked KV blocks) — pass a padding-only bias alongside instead of
+    baking an O(S^2) causal bias feed."""
     helper = LayerHelper("fused_attention", name=name)
     out = helper.create_variable_for_type_inference(q.dtype)
     inputs = {"Q": q, "K": k, "V": v}
@@ -952,7 +955,8 @@ def fused_attention(q, k, v, bias=None, scale=None, block_q=None,
                             "block_k": int(block_k or 0),
                             "layout": layout,
                             "dropout_prob": float(dropout_prob),
-                            "is_test": bool(is_test)})
+                            "is_test": bool(is_test),
+                            "causal": bool(causal)})
     return out
 
 

@@ -53,12 +53,16 @@ def _attn_args(ctx):
     if bk <= 0:
         bk = _auto_block(Sk, 1024) if Sk >= 1024 else min(128, Sk)
     p_drop = float(ctx.attr("dropout_prob", 0.0) or 0.0)
+    causal = bool(ctx.attr("causal", False))
     drop = None
     if p_drop and not ctx.attr("is_test", False):
-        # u8 keep-threshold (same contract as the dropout op)
-        t = max(1, min(int(round((1.0 - p_drop) * 256.0)), 255))
-        drop = (ctx.rng(), t)
-    return q, k, v, bias, layout, scale, bq, bk, drop
+        # u8 keep-threshold, with BOTH edges handled exactly like the
+        # dropout op (ops/nn.py): t >= 256 keeps everything (no-op),
+        # t <= 0 drops everything (the lowerings emit zeros)
+        t = int(round((1.0 - p_drop) * 256.0))
+        if t < 256:
+            drop = (ctx.rng(), max(t, 0))
+    return q, k, v, bias, layout, scale, bq, bk, drop, causal
 
 
 @register_op("fused_attention")
@@ -66,38 +70,41 @@ def fused_attention(ctx):
     """Q/K/V: [B, H, S, D] (layout "bhsd") or [B, S, H, D] ("bshd");
     optional BiasQK [B, 1|H, Sq|1, Sk] additive.
     attrs: scale (default d^-0.5), block_q, block_k, layout,
-    dropout_prob (attention-weights dropout; composed regime only —
-    the Pallas long-context kernels run dropout-free and warn)."""
+    dropout_prob (attention-weights dropout, reference
+    dist_transformer.py:1043-1044 — applied in BOTH regimes; the Pallas
+    kernels regenerate the mask from the hardware PRNG per block),
+    causal (mask rows >= cols; the kernels SKIP fully-masked KV
+    blocks and elide their DMA)."""
     from ..kernels.flash_attention import (
         _fa_forward, _attn_reference, use_kernel_path)
     res_t = jnp.result_type(ctx.input("Q"))
-    q, k, v, bias, layout, scale, bq, bk, drop = _attn_args(ctx)
+    q, k, v, bias, layout, scale, bq, bk, drop, causal = \
+        _attn_args(ctx)
+    if drop is not None and drop[1] == 0:
+        # dropout_prob ~ 1.0: everything dropped
+        ctx.set_output("Out", jnp.zeros(q.shape, res_t))
+        return
     if use_kernel_path(q, k, bq, bk, layout):
         # long-context regime: Pallas flash kernels, O(S) HBM. The
         # forward requests (out, lse) even though only out is consumed:
         # the grad lowering issues the IDENTICAL call, so XLA CSE runs
         # the forward kernel once per step, not twice
-        if drop is not None:
-            import warnings
-            warnings.warn(
-                "fused_attention: attention-weights dropout is not "
-                "applied on the long-context Pallas kernel path",
-                stacklevel=2)
         if ctx.attr("is_test", False):
             # inference: no grad op will consume lse — skip the
             # un-DCE-able wide-lse output entirely
             out = _fa_forward(q, k, v, bias, scale, bq, bk,
-                              layout=layout)
+                              layout=layout, causal=causal)
         else:
             out, _ = _fa_forward(q, k, v, bias, scale, bq, bk,
                                  return_lse=True, layout=layout,
-                                 raw_lse=True)
+                                 raw_lse=True, causal=causal,
+                                 dropout=drop)
     else:
         # shape-bounded regime / CPU / odd shapes: XLA's fully-fused
         # composed formulation is faster while [Sq,Sk] fits (see the
         # measured dispatch table in kernels/flash_attention.py)
         out = _attn_reference(q, k, v, bias, scale, layout=layout,
-                              dropout=drop)
+                              dropout=drop, causal=causal)
     ctx.set_output("Out", out.astype(res_t))
 
 
@@ -115,7 +122,8 @@ def fused_attention_grad(ctx):
     from ..kernels.flash_attention import (
         _fa_forward, _fa_backward, _attn_reference, use_kernel_path)
     op = ctx.op
-    q, k, v, bias, layout, scale, bq, bk, drop = _attn_args(ctx)
+    q, k, v, bias, layout, scale, bq, bk, drop, causal = \
+        _attn_args(ctx)
 
     g_names = op.input("Out@GRAD")
     dout = ctx.env[g_names[0]]
@@ -124,19 +132,25 @@ def fused_attention_grad(ctx):
         names = op.output(slot + "@GRAD")
         return bool(names and names[0])
 
-    if use_kernel_path(q, k, bq, bk, layout):
+    if drop is not None and drop[1] == 0:
+        # forward emitted constant zeros: nothing flows back
+        dq, dk, dv = (jnp.zeros_like(x) for x in (q, k, v))
+        dbias = None if bias is None else jnp.zeros_like(bias)
+    elif use_kernel_path(q, k, bq, bk, layout):
         # identical call to the forward lowering's -> CSE-merged
         out, lse = _fa_forward(q, k, v, bias, scale, bq, bk,
                                return_lse=True, layout=layout,
-                               raw_lse=True)
+                               raw_lse=True, causal=causal,
+                               dropout=drop)
         dq, dk, dv, dbias = _fa_backward(
             q, k, v, bias, out, lse, dout.astype(q.dtype), scale, bq,
             bk, layout=layout, lse_wide=True,
-            want_dbias=_bound("BiasQK"))
+            want_dbias=_bound("BiasQK"), causal=causal, dropout=drop)
     else:
         def f(q, k, v, bias):
             return _attn_reference(q, k, v, bias, scale,
-                                   layout=layout, dropout=drop)
+                                   layout=layout, dropout=drop,
+                                   causal=causal)
 
         _, vjp = jax.vjp(f, q, k, v, bias)
         dq, dk, dv, dbias = vjp(dout.astype(q.dtype))

@@ -135,17 +135,22 @@ def transformer_rules(mp_axis="mp", sp_axis=None) -> ShardingRules:
     return r
 
 
-def transformer_feed_rules(data_axis="dp", sp_axis=None) -> ShardingRules:
+def transformer_feed_rules(data_axis="dp", sp_axis=None,
+                           fused=True) -> ShardingRules:
     """Feeds: batch over dp; optionally sequence over sp (context/sequence
     parallelism — activations sharded along seq, XLA gathers K/V for
-    attention)."""
+    attention). fused=True matches cfg.fuse_attention: the decoder bias
+    is then key-padding-only [B, 1, 1, Sk] (causal is the op attr) and
+    has no query dim to shard; fused=False keeps the [B, 1, Sq, Sk]
+    causal+padding bias sharded along its query dim."""
     sp = sp_axis
     if sp is None:
         return ShardingRules()
     return ShardingRules([
         (r"^(src_ids|trg_ids|lbl_ids|lbl_w)$", P(data_axis, sp)),
-        # biases: [B, 1, Sq, Sk] — shard query dim, keep key dim full
-        (r"^trg_bias$", P(data_axis, None, sp, None)),
+        (r"^trg_bias$",
+         P(data_axis, None, None, None) if fused
+         else P(data_axis, None, sp, None)),
         (r"^src_bias$", P(data_axis, None, None, None)),
     ])
 

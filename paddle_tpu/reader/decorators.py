@@ -452,8 +452,9 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
 
 
 class Fake:
-    """reference paddle.reader.Fake: replays the first batch of a
-    reader forever (pipeline debugging without IO)."""
+    """reference paddle.reader.Fake (decorator.py:531): caches the
+    FIRST item the wrapped reader yields and replays that one item
+    `times` times (speed testing without IO)."""
 
     def __init__(self):
         self._cached = None
@@ -461,10 +462,13 @@ class Fake:
     def __call__(self, reader, times):
         def fake_reader():
             if self._cached is None:
-                self._cached = list(reader())
+                for item in reader():   # not next(): PEP 479 — an
+                    self._cached = item  # empty reader must yield
+                    break                # nothing, not RuntimeError
+                else:
+                    return
             for _ in range(times):
-                for item in self._cached:
-                    yield item
+                yield self._cached
         return fake_reader
 
 

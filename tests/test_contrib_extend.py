@@ -156,11 +156,13 @@ class TestDecoupledWeightDecay(unittest.TestCase):
             e.run(m, feed={"x": np.ones((2, 4), "float32")},
                   fetch_list=[loss.name])
             w_after = np.asarray(scope.find_var("w0").get_value())
-        # decoupled: w_after = adam_update(w) - lr*coeff*w_before;
-        # adam's first step moves each weight by ~lr (bias-corrected
-        # sign step), so the decay term must appear on top of that
+        # decoupled (reference extend_optimizer_with_weight_decay.py:
+        # 107): w_after = adam_update(w) - coeff*w_before — NO lr
+        # factor on the decay term (ADVICE r4). adam's first step moves
+        # each weight by ~lr (bias-corrected sign step), so the decay
+        # term must appear on top of that
         adam_only = w_before - 0.1 * np.sign(np.ones_like(w_before))
-        expected = adam_only - 0.1 * 0.5 * w_before
+        expected = adam_only - 0.5 * w_before
         np.testing.assert_allclose(w_after, expected, rtol=2e-2,
                                    atol=2e-3)
 

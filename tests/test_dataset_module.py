@@ -111,3 +111,25 @@ def test_py_reader_propagates_generator_errors():
     import pytest as _pytest
     with _pytest.raises(IOError):
         list(r)
+
+
+def test_fake_reader_caches_first_item_only():
+    """reference paddle.reader.Fake (decorator.py:531): cache the FIRST
+    item and yield it `times` times — not the whole epoch (ADVICE r4)."""
+    calls = []
+
+    def base():
+        for i in range(10):
+            calls.append(i)
+            yield i
+
+    fake = preader.Fake()(base, 5)
+    assert list(fake()) == [0] * 5
+    assert calls == [0]            # wrapped reader read once, one item
+    assert list(fake()) == [0] * 5  # replays the cached item
+    assert calls == [0]
+
+
+def test_fake_reader_empty_source_yields_nothing():
+    fake = preader.Fake()(lambda: iter(()), 5)
+    assert list(fake()) == []

@@ -219,3 +219,239 @@ def test_fused_attention_op_grad_without_bias_grad():
         ExecContext(gop2, env, _RngCtx(jax.random.PRNGKey(0))))
     np.testing.assert_allclose(np.asarray(env["fb@GRAD"]),
                                np.asarray(gb), atol=2e-4, rtol=2e-4)
+
+# ---------------------------------------------------------------------------
+# round 5: causal block-skipping + in-kernel attention-weights dropout
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("layout", ["bhsd", "bshd"])
+@pytest.mark.parametrize("bias_mode", ["none", "padding"])
+def test_causal_kernel_matches_composed(layout, bias_mode):
+    """causal=True must equal the composed formulation with an explicit
+    lower-triangle mask — including fully-masked block skipping (S=384,
+    blocks=128 -> 3x3 blocks, 3 of them strictly above the diagonal)."""
+    rng = np.random.default_rng(7)
+    B, H, S, D = 2, 2, 384, 16
+    q, k, v = (_rand(rng, B, H, S, D) for _ in range(3))
+    # padding bias: key-padding-only [B, 1, 1, S] (the transformer's
+    # fused-path trg_bias shape)
+    bias = None
+    if bias_mode == "padding":
+        pad = np.zeros((B, 1, 1, S), np.float32)
+        pad[:, :, :, -32:] = -1e9
+        bias = jnp.asarray(pad)
+    scale = float(D) ** -0.5
+
+    def kern(q, k, v, bias):
+        if layout == "bshd":
+            out = fa.flash_attention(
+                jnp.moveaxis(q, 1, 2), jnp.moveaxis(k, 1, 2),
+                jnp.moveaxis(v, 1, 2), bias, scale, 128, 128,
+                "bshd", True)
+            return jnp.moveaxis(out, 1, 2)
+        return fa.flash_attention(q, k, v, bias, scale, 128, 128,
+                                  "bhsd", True)
+
+    def ref(q, k, v, bias):
+        return fa._attn_reference(q, k, v, bias, scale, causal=True)
+
+    np.testing.assert_allclose(np.asarray(kern(q, k, v, bias)),
+                               np.asarray(ref(q, k, v, bias)),
+                               atol=1e-5, rtol=1e-5)
+    gk = jax.grad(lambda *a: (kern(*a) ** 2).sum(), (0, 1, 2))(
+        q, k, v, bias)
+    gr = jax.grad(lambda *a: (ref(*a) ** 2).sum(), (0, 1, 2))(
+        q, k, v, bias)
+    for a, b in zip(gk, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-4)
+
+
+def test_causal_dbias_zero_store():
+    """want_dbias + causal: the ds output tiles of SKIPPED blocks must
+    be zeroed (never written by the main body), so dbias sums clean."""
+    rng = np.random.default_rng(8)
+    B, H, S, D = 1, 2, 384, 16
+    q, k, v = (_rand(rng, B, H, S, D) for _ in range(3))
+    bias = _rand(rng, B, 1, S, S) * 0.1
+    scale = float(D) ** -0.5
+    g = _rand(rng, B, H, S, D)
+
+    out, lse = fa._fa_forward(q, k, v, bias, scale, 128, 128,
+                              return_lse=True, raw_lse=True,
+                              causal=True)
+    dq, dk, dv, dbias = fa._fa_backward(
+        q, k, v, bias, out, lse, g, scale, 128, 128, lse_wide=True,
+        want_dbias=True, causal=True)
+
+    def ref(q, k, v, bias):
+        return (fa._attn_reference(q, k, v, bias, scale, causal=True)
+                * g).sum()
+
+    rq, rk, rv, rb = jax.grad(ref, (0, 1, 2, 3))(q, k, v, bias)
+    for a, b in ((dq, rq), (dk, rk), (dv, rv), (dbias, rb)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-4)
+
+
+@pytest.mark.parametrize("layout", ["bhsd", "bshd"])
+@pytest.mark.parametrize("causal", [False, True])
+def test_dropout_kernel_fwd_bwd_consistent(layout, causal):
+    """In-kernel attention-weights dropout: the kernel out/grads must
+    equal a composed formulation using the EXACT mask the interpret-
+    mode kernels realize (dropout_keep_mask reconstructs it), proving
+    the fwd and both bwd kernels regenerate identical bits and the
+    chain rule through p_drop = keep * p * 256/t is right."""
+    rng = np.random.default_rng(9)
+    B, H, S, D = 2, 2, 256, 16
+    qb, kb, vb = (_rand(rng, B, H, S, D) for _ in range(3))
+    scale = float(D) ** -0.5
+    key = jax.random.PRNGKey(42)
+    t = 205                      # keep ~80%
+    g = _rand(rng, B, H, S, D)
+    keep = fa.dropout_keep_mask(
+        jax.lax.bitcast_convert_type(key, jnp.int32).reshape(2),
+        B, H, S, S, t)
+    assert 0.72 < float(keep.mean()) < 0.88  # mask is sane
+
+    def to_layout(x):
+        return jnp.moveaxis(x, 1, 2) if layout == "bshd" else x
+
+    q, k, v = to_layout(qb), to_layout(kb), to_layout(vb)
+    out, lse = fa._fa_forward(q, k, v, None, scale, 128, 128,
+                              return_lse=True, raw_lse=True,
+                              layout=layout, causal=causal,
+                              dropout=(key, t))
+    dq, dk, dv, _ = fa._fa_backward(
+        q, k, v, None, out, lse, g if layout == "bhsd"
+        else jnp.moveaxis(g, 1, 2), scale, 128, 128, layout=layout,
+        lse_wide=True, causal=causal, dropout=(key, t))
+
+    def ref(q, k, v):
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = jnp.arange(S)[:, None]
+            cols = jnp.arange(S)[None, :]
+            s = jnp.where(rows >= cols, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        p = jnp.where(keep, p * (256.0 / t), 0.0)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+    out_b = out if layout == "bhsd" else jnp.moveaxis(out, 1, 2)
+    np.testing.assert_allclose(np.asarray(out_b),
+                               np.asarray(ref(qb, kb, vb)),
+                               atol=1e-4, rtol=1e-4)
+    rq, rk, rv = jax.grad(lambda *a: (ref(*a) * g).sum(),
+                          (0, 1, 2))(qb, kb, vb)
+    for a, b in ((dq, rq), (dk, rk), (dv, rv)):
+        ab = a if layout == "bhsd" else jnp.moveaxis(a, 1, 2)
+        np.testing.assert_allclose(np.asarray(ab), np.asarray(b),
+                                   atol=3e-4, rtol=3e-4)
+
+
+def test_fused_attention_op_dropout_edges():
+    """Op-level dropout edges (ADVICE r4): prob ~ 1.0 (t<=0) emits
+    zeros on BOTH paths; prob ~ 0 (t>=256) is an exact no-op."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core.registry import OPS, ExecContext, _RngCtx
+
+    rng = np.random.default_rng(11)
+    B, H, S, D = 1, 2, 128, 16
+    qn, kn, vn = (jnp.asarray(rng.standard_normal((B, H, S, D)),
+                              jnp.float32) for _ in range(3))
+
+    def run_op(prob):
+        fluid.framework.unique_name.reset()
+        main = fluid.Program()
+        with fluid.program_guard(main, fluid.Program()):
+            block = main.global_block()
+            mk = lambda n: block.create_var(name=n, dtype="float32",
+                                            stop_gradient=False)
+            q_v, k_v, v_v, o_v = (mk(n) for n in
+                                  ("dq", "dk", "dv", "do"))
+            block.append_op(
+                "fused_attention",
+                inputs={"Q": q_v, "K": k_v, "V": v_v},
+                outputs={"Out": o_v},
+                attrs={"scale": float(D) ** -0.5, "block_q": 128,
+                       "block_k": 128, "layout": "bhsd",
+                       "dropout_prob": float(prob), "seed": 7})
+            op = block.ops[-1]
+        env = {"dq": qn, "dk": kn, "dv": vn}
+        OPS.get("fused_attention").lowering(
+            ExecContext(op, env, _RngCtx(jax.random.PRNGKey(0))))
+        return env["do"]
+
+    out_hi = run_op(0.999)       # t = round(0.001*256) = 0 -> zeros
+    assert float(jnp.abs(out_hi).max()) == 0.0
+    out_lo = run_op(0.001)       # t = 256 -> exact no-op
+    out_none = run_op(0.0)
+    np.testing.assert_array_equal(np.asarray(out_lo),
+                                  np.asarray(out_none))
+
+
+@pytest.mark.skipif(jax.default_backend() == "cpu",
+                    reason="hardware-PRNG path needs a TPU")
+def test_hardware_dropout_mask_fwd_bwd_bit_identical(monkeypatch):
+    """TPU-only guard: the fwd, dq and dkv kernels must realize the
+    SAME hardware-PRNG mask (exact-extraction probe: q=k=0 makes p
+    uniform, one-hot v/do read the mask out elementwise). Run directly
+    on hardware; the CPU suite covers the interpret-mode hash path."""
+    monkeypatch.setattr(fa, "_INTERPRET", False)
+    B, H, S, D = 1, 4, 256, 64
+    bq = bk = 128
+    key = jax.random.PRNGKey(9)
+    t = 205
+    c = 256.0 / t
+    z = jnp.zeros((B, S, H, D), jnp.float32)
+
+    M_fwd = np.zeros((H, S, S))
+    for r in range(S // 64):
+        v = np.zeros((B, S, H, D), np.float32)
+        for j in range(64):
+            v[0, r * 64 + j, :, j] = 1.0
+        out, _ = fa._fa_forward(z, z, jnp.asarray(v), None, 1.0, bq,
+                                bk, return_lse=True, raw_lse=True,
+                                layout="bshd", dropout=(key, t))
+        o = np.asarray(out)[0]
+        M_fwd[:, :, r * 64:(r + 1) * 64] = np.moveaxis(o, 1, 0) * (S / c)
+    M_fwd = M_fwd > 0.5
+    assert 0.75 < M_fwd.mean() < 0.85
+
+    out, lse = fa._fa_forward(z, z, z, None, 1.0, bq, bk,
+                              return_lse=True, raw_lse=True,
+                              layout="bshd", dropout=(key, t))
+    M_dkv = np.zeros((H, S, S))
+    for r in range(S // 64):
+        do = np.zeros((B, S, H, D), np.float32)
+        for i in range(64):
+            do[0, r * 64 + i, :, i] = 1.0
+        _, _, dv, _ = fa._fa_backward(z, z, z, None, out, lse,
+                                      jnp.asarray(do), 1.0, bq, bk,
+                                      layout="bshd", lse_wide=True,
+                                      dropout=(key, t))
+        dvn = np.asarray(dv)[0]
+        M_dkv[:, r * 64:(r + 1) * 64, :] = \
+            np.transpose(dvn, (1, 2, 0)) * (S / c)
+    assert (M_fwd == (M_dkv > 0.5)).all()
+
+    rng = np.random.default_rng(0)
+    v = jnp.asarray(rng.standard_normal((B, S, H, D)) * 0.3 + 1.0,
+                    jnp.float32)
+    bias_h = jnp.zeros((B, H, S, S), jnp.float32)
+    out, lse = fa._fa_forward(z, z, v, bias_h, 1.0, bq, bk,
+                              return_lse=True, raw_lse=True,
+                              layout="bshd", dropout=(key, t))
+    ones = jnp.ones((B, S, H, D), jnp.float32)
+    _, _, _, dbias = fa._fa_backward(z, z, v, bias_h, out, lse, ones,
+                                     1.0, bq, bk, layout="bshd",
+                                     lse_wide=True, want_dbias=True,
+                                     dropout=(key, t))
+    ds = np.asarray(dbias)[0]
+    w = np.asarray(v.sum(-1))[0]
+    di = np.asarray(out.sum(-1))[0]
+    M_dq = np.zeros((H, S, S))
+    for h in range(H):
+        M_dq[h] = (S * ds[h] + di[:, h:h + 1]) / (c * w[:, h][None, :])
+    assert (M_fwd == (M_dq > 0.5)).all()
