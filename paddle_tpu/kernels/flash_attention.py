@@ -122,6 +122,15 @@ def _tile_keep(plan, seed_ref, bh, q_idx, kv_idx, t):
                ^ q_idx.astype(jnp.uint32) * jnp.uint32(0x85EBCA6B)
                ^ kv_idx.astype(jnp.uint32) * jnp.uint32(0xC2B2AE35))
     pltpu.prng_seed(a, b)
+    if bq % 4 == 0:
+        # the threshold only needs 8 bits: draw a QUARTER tile of u32s
+        # and bitcast to u8 (tpu.bitcast expands the sublane dim 4x) —
+        # the PRNG draw is the dominant dropout cost in the kernels.
+        # The target has no i8 vector compare; widen to i32 first
+        # (cheap relative to 3/4 of the draws saved).
+        bits = pltpu.bitcast(pltpu.prng_random_bits((bq // 4, bk)),
+                             jnp.uint8)
+        return bits.astype(jnp.int32) < t
     bits = pltpu.prng_random_bits((bq, bk))
     return (bits & 255) < t
 
@@ -565,6 +574,12 @@ def _seed_i32(dropout):
     if dropout is None:
         return None, None
     key, t = dropout
+    if int(t) <= 0:
+        # the kernels upscale by 256/t; the drop-everything edge must
+        # be handled by the CALLER emitting zeros (ops/fused.py does)
+        raise ValueError(
+            "flash kernels cannot realize t<=0 (drop everything); "
+            "emit zeros at the call site instead")
     return jax.lax.bitcast_convert_type(key, jnp.int32).reshape(2), \
         int(t)
 
