@@ -781,6 +781,58 @@ class Engine:
                 int(getattr(program, "_gradient_accumulation_steps", 1)
                     or 1), int(iterations))
 
+    def compiled_step(self, program, scope: Scope, feed, fetch_names,
+                      block_idx: int = 0, iterations: int = 1):
+        """The XLA-compiled executable of the already-run step (lowered
+        once and cached on the traced entry). Returns None on the
+        eager-interpreter fallback. The single source for everything
+        that inspects the compiled artifact — cost analysis
+        (compiled_stats), HLO text (tools/traffic_report.py,
+        tools/time_report.py)."""
+        compiled, _ = self._compiled_entry(program, scope, feed,
+                                           fetch_names, block_idx,
+                                           iterations)
+        return compiled
+
+    def _compiled_entry(self, program, scope, feed, fetch_names,
+                        block_idx=0, iterations=1):
+        """(compiled, traced) as ONE pair — no cross-call state."""
+        arrays, lods, feed_sig_key = self._normalize_feed(feed, None)
+        if self._is_multihost():
+            feed_sig_key = self._global_sig_key(arrays, lods)
+        key = self._cache_key(program, block_idx, feed_sig_key,
+                              fetch_names, iterations)
+        traced = self._cache.get(key)
+        if traced is None:
+            if self._cache:
+                raise ValueError(
+                    "compiled_step: no compiled step for this "
+                    "(program, feed, fetch) signature — pass the same "
+                    "feed/fetch that run() used")
+            return None, None
+        if not hasattr(traced.fn, "lower"):
+            # eager-interpreter fallback: nothing compiled
+            return None, None
+        compiled = getattr(traced, "_compiled_cache", None)
+        if compiled is None:
+            def _sig(n):
+                a = _scope_array(scope, n)
+                return jax.ShapeDtypeStruct(jnp.shape(a),
+                                            jnp.result_type(a))
+
+            donated = {n: _sig(n) for n in traced.donated_names}
+            const = {n: _sig(n) for n in traced.const_names}
+            multihost = self._is_multihost()
+            feeds = {n: jax.ShapeDtypeStruct(
+                         self._global_shape(n, a) if multihost
+                         else a.shape, a.dtype)
+                     for n, a in arrays.items()}
+            key_sig = jax.ShapeDtypeStruct((2,), jnp.uint32)
+            compiled = traced.fn.lower(donated, const, feeds,
+                                       key_sig).compile()
+            traced._compiled_cache = compiled
+        return compiled, traced
+
     def compiled_stats(self, program, scope: Scope, feed, fetch_names,
                        block_idx: int = 0,
                        iterations: int = 1) -> Optional[Dict[str, float]]:
@@ -791,39 +843,13 @@ class Engine:
         analog of the reference's per-op benchmark bookkeeping
         (/root/reference/paddle/fluid/operators/benchmark/op_tester.cc).
         """
-        arrays, lods, feed_sig_key = self._normalize_feed(feed, None)
-        if self._is_multihost():
-            feed_sig_key = self._global_sig_key(arrays, lods)
-        key = self._cache_key(program, block_idx, feed_sig_key,
-                              fetch_names, iterations)
-        traced = self._cache.get(key)
-        if traced is None:
-            if self._cache:
-                raise ValueError(
-                    "compiled_stats: no compiled step for this "
-                    "(program, feed, fetch) signature — pass the same "
-                    "feed/fetch that run() used")
+        compiled, traced = self._compiled_entry(
+            program, scope, feed, fetch_names, block_idx, iterations)
+        if compiled is None:
             return None
-        if not hasattr(traced.fn, "lower"):
-            return None  # eager-interpreter fallback: nothing compiled
         cached = getattr(traced, "_stats_cache", None)
         if cached is not None:
             return cached
-
-        def _sig(n):
-            a = _scope_array(scope, n)
-            return jax.ShapeDtypeStruct(jnp.shape(a), jnp.result_type(a))
-
-        donated = {n: _sig(n) for n in traced.donated_names}
-        const = {n: _sig(n) for n in traced.const_names}
-        multihost = self._is_multihost()
-        feeds = {n: jax.ShapeDtypeStruct(
-                     self._global_shape(n, a) if multihost else a.shape,
-                     a.dtype)
-                 for n, a in arrays.items()}
-        key_sig = jax.ShapeDtypeStruct((2,), jnp.uint32)
-        compiled = traced.fn.lower(donated, const, feeds,
-                                   key_sig).compile()
         ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0] if ca else {}

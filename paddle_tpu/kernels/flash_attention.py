@@ -953,9 +953,12 @@ def _score_elems(q, k, layout):
 def use_kernel_path(q, k, block_q=128, block_k=128, layout="bhsd"):
     """True when the fused-attention op should route through the Pallas
     kernels rather than the composed einsum formulation."""
+    import os
     if not _kernel_ok(q, k, block_q, block_k, layout):
         return False
     if _INTERPRET:
+        return True
+    if os.environ.get("PT_FORCE_KERNEL"):   # A/B-measurement knob
         return True
     return _score_elems(q, k, layout) >= _KERNEL_MIN_SCORE_ELEMS
 

@@ -55,7 +55,10 @@ class ShardingRules:
 
 
 def _legalize(spec: Optional[P], shape, mesh: Mesh) -> Optional[P]:
-    """Drop axis assignments that don't divide the dim / exceed rank."""
+    """Drop axis assignments that don't divide the dim / exceed rank —
+    or that name an axis this mesh doesn't define (a dp-only mesh must
+    accept the standard rule set that mentions mp/sp: those dims just
+    stay replicated)."""
     if spec is None:
         return None
     parts = list(spec)
@@ -67,6 +70,20 @@ def _legalize(spec: Optional[P], shape, mesh: Mesh) -> Optional[P]:
             out.append(None)
             continue
         axes = ax if isinstance(ax, tuple) else (ax,)
+        missing = [a for a in axes if a not in mesh.shape]
+        if missing:
+            # a KNOWN axis this mesh simply doesn't define (dp-only
+            # mesh with the standard mp/sp rule set) -> replicate;
+            # an unknown name is a rule typo -> loud error
+            bad = [a for a in missing
+                   if a not in ("dp", "mp", "sp", "pp", "ep")]
+            if bad:
+                raise ValueError(
+                    f"sharding rule names unknown mesh axis {bad}; "
+                    f"mesh has {sorted(mesh.shape)} and the known "
+                    "vocabulary is dp/mp/sp/pp/ep")
+            out.append(None)
+            continue
         n = int(np.prod([mesh.shape[a] for a in axes]))
         out.append(ax if dim % n == 0 else None)
     while out and out[-1] is None:

@@ -1,0 +1,62 @@
+"""Trace a BASELINE bench config's training step on the device and
+print its device-time-by-source table (paddle_tpu.tools.time_breakdown)
+— the TIME companion of tools/traffic_report.py's bytes table
+(VERDICT r4 #3).
+
+Usage: python tools/time_report.py [transformer|transformer_s4096|
+                                    resnet50] [--steps N]
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from traffic_report import build_transformer, build_resnet50  # noqa: E402
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "transformer"
+    steps = int(sys.argv[sys.argv.index("--steps") + 1]) \
+        if "--steps" in sys.argv else 3
+    import paddle_tpu as fluid
+    from paddle_tpu.core.engine import Engine
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.tools import time_breakdown
+
+    if which == "transformer":
+        prog, startup, batch, fetch = build_transformer()
+    elif which == "transformer_s4096":
+        prog, startup, batch, fetch = build_transformer(batch=4, s=4096)
+    else:
+        prog, startup, batch, fetch = build_resnet50()
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor()
+        exe.run(startup)
+        eng = Engine()
+
+        def run_step():
+            r = eng.run(prog, scope, None, batch, fetch,
+                        return_numpy=False)
+            # fence on a scalar so the traced window covers real device
+            # work, not queue depth
+            a = getattr(r[0], "array", r[0])
+            float(a.reshape(-1)[0])
+
+        trace = time_breakdown.trace_step(run_step, steps=steps)
+        compiled = eng.compiled_step(prog, scope, batch, fetch)
+        if compiled is None:
+            print("# nothing compiled (eager-interpreter "
+                  "fallback) — no report", file=sys.stderr)
+            return
+        hlo = compiled.as_text()
+        print(f"# trace: {trace}", file=sys.stderr)
+        time_breakdown.report(trace, hlo, steps, label=which)
+
+
+if __name__ == "__main__":
+    main()

@@ -72,23 +72,11 @@ def main():
         eng = Engine()
         eng.run(prog, scope, None, batch, fetch, return_numpy=False)
         stats = eng.compiled_stats(prog, scope, batch, fetch)
-        traced = next(iter(eng._cache.values()))
-        import jax
-
-        def _sig(a):
-            import jax.numpy as jnp
-            return jax.ShapeDtypeStruct(jnp.shape(a), a.dtype)
-
-        from paddle_tpu.core.engine import _scope_array
-        donated = {n: _sig(_scope_array(scope, n))
-                   for n in traced.donated_names}
-        const = {n: _sig(_scope_array(scope, n))
-                 for n in traced.const_names}
-        import jax.numpy as jnp
-        feeds = {n: _sig(jnp.asarray(v)) for n, v in batch.items()}
-        key_sig = jax.ShapeDtypeStruct((2,), jnp.uint32)
-        compiled = traced.fn.lower(donated, const, feeds,
-                                   key_sig).compile()
+        compiled = eng.compiled_step(prog, scope, batch, fetch)
+        if compiled is None:
+            print("# nothing compiled (eager-interpreter "
+                  "fallback) — no report", file=sys.stderr)
+            return
         hlo = compiled.as_text()
         if "--dump" in sys.argv:
             path = sys.argv[sys.argv.index("--dump") + 1]
