@@ -203,13 +203,23 @@ def bench_transformer(batch=BATCH, seq=None):
 
 
 def bench_transformer_longctx():
-    """Long-context regime (S=4096): crosses the 2^28 score-elements
-    threshold, so attention runs on the Pallas flash kernels (fwd +
-    dq/dkv backward) — the composed path's [B,H,S,S] tensors would need
+    """Long-context regime (S=4096): attention runs on the Pallas flash
+    kernels (fwd + dq/dkv backward, in-kernel dropout, causal decoder
+    block-skipping) — the composed path's [B,H,S,S] tensors would need
     ~4.3 GB temp HBM per layer pair (BASELINE long-context note)."""
     return bench_transformer(
         batch=int(os.environ.get("TF_BATCH", "4")),
         seq=int(os.environ.get("TF_SEQ", "4096")))
+
+
+def bench_transformer_s1024():
+    """Mid-range shape guarding the measured kernel/composed dispatch
+    crossover (VERDICT r4 #2): S=1024 sits just ABOVE the
+    sequence-keyed threshold (Sq*Sk >= 1024^2), where the kernels beat
+    composed ~2x (dispatch table in kernels/flash_attention.py)."""
+    return bench_transformer(
+        batch=int(os.environ.get("TF_BATCH", "8")),
+        seq=int(os.environ.get("TF_SEQ", "1024")))
 
 
 def bench_transformer_canonical():
@@ -241,21 +251,57 @@ def bench_lenet():
         exe = fluid.Executor()
         exe.run(startup)
         eng = Engine()
-        # VERDICT r3 #9: the sub-ms LeNet step is dominated by tunnel
-        # state, with a measured ~2.5x run-to-run spread — publish the
-        # MEDIAN of N window measurements with the spread, never a
-        # single draw
-        runs = []
-        for _ in range(5):
+        # r5 (VERDICT r4 #7): the sub-ms LeNet step is DISPATCH-bound —
+        # a trivial jit call costs 6-19 ms wall through the tunnel
+        # depending on the window (the launch floor, see the kernel
+        # roofline section in BASELINE.md), so even amortized over
+        # iterations=16 the floor is >=2/3 of the measured step and its
+        # drift IS the historical 2.5x spread. Policy: co-measure the
+        # floor each window, repeat until 3 CONSECUTIVE windows agree
+        # within 15%, publish that stable median + the all-window IQR +
+        # the floor correlation so the number describes the chip, not
+        # the tunnel's mood.
+        def _floor_probe(n=8):
+            import jax
+            import jax.numpy as jnp
+            x = jnp.ones((8, 128), jnp.float32)
+            f = jax.jit(lambda x: x * 2.0 + 1.0)
+            float(f(x)[0, 0])
+            t0 = time.time()
+            for _ in range(n):
+                r = f(x)
+            float(r[0, 0])
+            return (time.time() - t0) / n * 1e3
+
+        runs, floors = [], []
+        stable = None
+        for w in range(15):
+            floors.append(_floor_probe())
             sps_i, traj, sync_ms = _loop(eng, main_prog, scope, batch,
                                          [cost.name], 20,
                                          iterations=16)
             runs.append(sps_i)
-        runs.sort()
-        sps = runs[len(runs) // 2]
-        print(f"# mnist_lenet: median of {len(runs)} window runs; "
-              f"spread {runs[0] * B:.0f}..{runs[-1] * B:.0f} img/s",
-              file=sys.stderr)
+            if len(runs) >= 3:
+                last3 = runs[-3:]
+                if max(last3) / min(last3) <= 1.15:
+                    stable = sorted(last3)[1]
+                    break
+        srt = sorted(runs)
+        q1 = srt[len(srt) // 4]
+        q3 = srt[(3 * len(srt)) // 4]
+        sps = stable if stable is not None else srt[len(srt) // 2]
+        corr = float(np.corrcoef(
+            np.array(floors), 1.0 / np.array(runs))[0, 1]) \
+            if len(runs) >= 3 else float("nan")
+        print(f"# mnist_lenet: {'STABLE' if stable else 'UNSTABLE'} "
+              f"after {len(runs)} windows "
+              f"(policy: 3 consecutive within 15%); "
+              f"IQR {q1 * B:.0f}..{q3 * B:.0f} img/s; "
+              f"co-measured launch floor "
+              f"{min(floors):.1f}-{max(floors):.1f} ms "
+              f"(corr with step time {corr:.2f}, n={len(runs)} — "
+              f"noisy; the dispatch-bound diagnosis rests on sync "
+              f"latency vs device-only below)", file=sys.stderr)
         stats = eng.compiled_stats(main_prog, scope, batch, [cost.name], iterations=16)
     return sps * B, sps, traj, sync_ms, stats
 
@@ -455,6 +501,7 @@ def bench_dygraph():
 def _config_table():
     return {
         "transformer_s256": (bench_transformer_canonical, "tokens/sec"),
+        "transformer_s1024": (bench_transformer_s1024, "tokens/sec"),
         "transformer_s4096": (bench_transformer_longctx, "tokens/sec"),
         "mnist_lenet": (bench_lenet, "images/sec"),
         "resnet50": (bench_resnet50, "images/sec"),

@@ -9,7 +9,8 @@ from .utils.hdfs_utils import (  # noqa: F401
     HDFSClient, multi_download, multi_upload)
 from .slim.core.compressor import Compressor  # noqa: F401
 from .slim.quantization import QuantizeTranspiler  # noqa: F401
-from .decoder import InitState, StateCell, TrainingDecoder  # noqa: F401
+from .decoder import (InitState, StateCell, TrainingDecoder,  # noqa: F401
+                      BeamSearchDecoder)
 from .extend import (  # noqa: F401
     BasicGRUUnit, BasicLSTMUnit, basic_gru, basic_lstm,
     memory_usage, op_freq_statistic,

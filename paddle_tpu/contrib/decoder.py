@@ -2,23 +2,25 @@
 fluid/contrib/decoder/beam_search_decoder.py: InitState, StateCell,
 TrainingDecoder — the pre-layers.beam_search decoder construction kit).
 
-TPU-native redesign: the reference builds these on StaticRNN blocks and
-per-step array ops; here the TrainingDecoder unrolls statically over the
-(padded, dense) time axis — the XLA-friendly form this framework uses
-everywhere LoD ragged input would appear — while keeping the reference's
-programming model intact: a StateCell holds named states, the user
-registers @state_updater, step inputs arrive via get_input, outputs
-collect per step. Inference-time beam search lives in
-layers.beam_search/beam_search_decode (ops/beam_search.py, tested
-against brute force in tests/test_beam_search.py); the contrib
-BeamSearchDecoder class itself is not carried — see
-docs/API_SPEC_ACCOUNTING.md.
+TPU-native redesign: the reference builds these on StaticRNN/While
+blocks and per-step LoD-array ops; here the TrainingDecoder AND the
+BeamSearchDecoder unroll statically over the (padded, dense) time axis —
+the XLA-friendly form this framework uses everywhere LoD ragged input
+would appear — while keeping the reference's programming model intact:
+a StateCell holds named states, the user registers @state_updater, step
+inputs arrive via get_input, outputs collect per step. The
+BeamSearchDecoder's per-step selection rides the frozen-beam
+layers.beam_search / beam_search_decode ops (ops/beam_search.py), so
+`decoder.decode(); ids, scores = decoder()` compiles to ONE XLA
+executable instead of the reference's host-driven While loop
+(beam_search_decoder.py:523-789).
 """
 from __future__ import annotations
 
 from .. import layers
 
-__all__ = ["InitState", "StateCell", "TrainingDecoder"]
+__all__ = ["InitState", "StateCell", "TrainingDecoder",
+           "BeamSearchDecoder"]
 
 
 class InitState:
@@ -216,3 +218,185 @@ class TrainingDecoder:
         stacked = [layers.concat([o[i] for o in outs], axis=1)
                    for i in range(len(outs[0]))]
         return stacked[0] if len(stacked) == 1 else stacked
+
+
+class BeamSearchDecoder:
+    """Beam-search inference decoder over a StateCell (reference
+    contrib/decoder/beam_search_decoder.py:523 BeamSearchDecoder).
+
+    Reference flow per While step: read prev ids/scores arrays, embed,
+    sequence_expand states across beams, StateCell.compute_state,
+    fc+softmax scores, topk, accumulate log-probs, layers.beam_search,
+    early-stop on empty, write arrays. TPU-native form: a static unroll
+    to `max_len` with the SAME dataflow — beam expansion/reordering is
+    a `gather` by beam_search's parent pointers (the frozen-beam op
+    keeps every source at exactly beam_size rows, so shapes are
+    static), and "early stop" is subsumed by beam freezing: finished
+    beams re-emit (end_id, score) verbatim, so running the remaining
+    steps is a no-op on the result, not a semantic change. The arrays
+    the reference maintains become stacked step outputs backtracked by
+    beam_search_decode.
+
+    Because the step body executes once per unrolled step (not once
+    per While trace), every parameter inside it must have a FIXED name:
+    the decoder names its embedding/projection params
+    '<name>_emb.w_0' / '<name>_fc.{w,b}_0', and a custom
+    @state_updater must pass explicit param_attr names the same way
+    (true for TrainingDecoder too).
+
+    decoder = BeamSearchDecoder(cell, init_ids, init_scores,
+                                target_dict_dim=V, word_dim=E, ...)
+    decoder.decode()
+    translation_ids, translation_scores = decoder()   # [B*K, T], [B*K,1]
+    """
+
+    BEFORE_BEAM_SEARCH_DECODER = 0
+    IN_BEAM_SEARCH_DECODER = 1
+    AFTER_BEAM_SEARCH_DECODER = 2
+
+    def __init__(self, state_cell, init_ids, init_scores,
+                 target_dict_dim, word_dim, input_var_dict=None,
+                 topk_size=50, sparse_emb=True, max_len=100,
+                 beam_size=1, end_id=1, name=None):
+        self._state_cell = state_cell
+        self._init_ids = init_ids
+        self._init_scores = init_scores
+        self._target_dict_dim = int(target_dict_dim)
+        self._word_dim = int(word_dim)
+        self._input_var_dict = dict(input_var_dict or {})
+        self._topk_size = min(int(topk_size), int(target_dict_dim))
+        self._sparse_emb = bool(sparse_emb)
+        self._max_len = int(max_len)
+        self._beam_size = int(beam_size)
+        self._end_id = int(end_id)
+        self._name = name or "beam_search_decoder"
+        self._status = self.BEFORE_BEAM_SEARCH_DECODER
+        self._arrays = {}          # handle name -> current Variable
+        self._result = None
+        self._stopped = False
+
+    # -- reference API surface ------------------------------------------
+    def block(self):
+        """Marks the decode body (reference: the While block). In the
+        static-unroll design decode() drives the loop itself; block()
+        guards against double entry and keeps the reference's state
+        machine observable."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            if self._status != self.BEFORE_BEAM_SEARCH_DECODER:
+                raise ValueError("block() can only be invoked once.")
+            self._status = self.IN_BEAM_SEARCH_DECODER
+            try:
+                yield
+            finally:
+                self._status = self.AFTER_BEAM_SEARCH_DECODER
+        return _ctx()
+
+    def read_array(self, init, is_ids=False, is_scores=False):
+        """Current value of a step-carried variable (reference: an
+        array_read at the loop counter). Static form: the carried
+        python handle, seeded with `init`."""
+        if is_ids and is_scores:
+            raise ValueError(
+                "an array cannot be both the ids and the scores array")
+        key = init.name
+        if key not in self._arrays:
+            self._arrays[key] = init
+        return self._arrays[key]
+
+    def update_array(self, array_value, new_value):
+        """Write the next step's value of a carried variable."""
+        for key, cur in list(self._arrays.items()):
+            if cur is array_value:
+                self._arrays[key] = new_value
+                return
+        raise ValueError(
+            "update_array target was not produced by read_array")
+
+    def early_stop(self):
+        """Reference: force the While condition false. Static form:
+        a no-op by construction — finished beams are frozen by the
+        beam_search op, so extra steps cannot change the decode."""
+        self._stopped = True
+
+    # -- the default decode body ----------------------------------------
+    def decode(self):
+        """Build the beam decode (override for a custom body, as in the
+        reference)."""
+        from ..param_attr import ParamAttr
+        cell = self._state_cell
+        K, end_id = self._beam_size, self._end_id
+
+        with self.block():
+            prev_ids = self.read_array(self._init_ids, is_ids=True)
+            prev_scores = self.read_array(self._init_scores,
+                                          is_scores=True)
+            carried_inputs = {
+                n: self.read_array(v)
+                for n, v in self._input_var_dict.items()}
+            for n in carried_inputs:
+                if n not in cell._input_names:
+                    raise ValueError(
+                        f"Variable {n!r} not found in StateCell!")
+
+            ids_hist, score_hist, parent_hist = [], [], []
+            for step in range(self._max_len):
+                emb = layers.embedding(
+                    prev_ids,
+                    size=[self._target_dict_dim, self._word_dim],
+                    is_sparse=self._sparse_emb, dtype="float32",
+                    param_attr=ParamAttr(name=self._name + "_emb.w_0"))
+                feed = {}
+                for n, v in carried_inputs.items():
+                    feed[n] = v
+                for n in cell._input_names:
+                    if n not in feed:
+                        feed[n] = emb
+                cell.compute_state(inputs=feed)
+                current = cell.out_state()
+                probs = layers.fc(
+                    current, self._target_dict_dim, act="softmax",
+                    param_attr=ParamAttr(name=self._name + "_fc.w_0"),
+                    bias_attr=ParamAttr(name=self._name + "_fc.b_0"))
+                topk_scores, topk_idx = layers.topk(
+                    probs, k=self._topk_size)
+                accu = layers.elementwise_add(
+                    layers.log(topk_scores), prev_scores)
+                sel_ids, sel_scores, parent = layers.beam_search(
+                    prev_ids, prev_scores, topk_idx, accu, K,
+                    end_id=end_id, return_parent_idx=True)
+                # beam reorder/expansion: every state (and carried
+                # input) follows its parent row — the reference's
+                # sequence_expand + update_states
+                for sname in cell._state_names + [
+                        n for n in cell._cur_states
+                        if n not in cell._state_names]:
+                    cell.set_state(
+                        sname, layers.gather(cell.get_state(sname),
+                                             parent))
+                cell.update_states()
+                for n, v in carried_inputs.items():
+                    nv = layers.gather(v, parent)
+                    self.update_array(v, nv)
+                    carried_inputs[n] = nv
+                self.update_array(prev_ids, sel_ids)
+                self.update_array(prev_scores, sel_scores)
+                prev_ids, prev_scores = sel_ids, sel_scores
+                ids_hist.append(sel_ids)
+                score_hist.append(sel_scores)
+                parent_hist.append(parent)
+
+            ids_t = layers.stack(ids_hist, axis=0)
+            scores_t = layers.stack(score_hist, axis=0)
+            parents_t = layers.stack(parent_hist, axis=0)
+            self._result = layers.beam_search_decode(
+                ids_t, scores_t, parents_t, beam_size=K, end_id=end_id)
+
+    def __call__(self):
+        """(translation_ids [B*K, T], translation_scores [B*K, 1])."""
+        if self._result is None:
+            raise RuntimeError(
+                "call decode() before the decoder (reference contract)")
+        return self._result

@@ -82,6 +82,44 @@ class LayerHelper:
                 attr, shape, dtype, initializer, is_bias)
 
         shape = [int(d) for d in shape]
+        gb = self.main_program.global_block()
+        if attr.name in gb.vars:
+            # explicit-name reuse IS the weight-sharing contract
+            # (reference ParamAttr sharing, e.g. fc params inside an
+            # unrolled decoder step): return the existing parameter —
+            # re-creating would overwrite its shape with this call
+            # site's (possibly unknown) input shape and stack duplicate
+            # init ops in startup
+            from .framework import Parameter
+            existing = gb.vars[attr.name]
+            if not isinstance(existing, Parameter):
+                raise ValueError(
+                    f"ParamAttr name {attr.name!r} collides with a "
+                    f"non-parameter variable of the same name")
+            from .core.types import dtype_to_np
+
+            def _np_name(d):
+                import numpy as _np
+                try:
+                    return _np.dtype(dtype_to_np(d)).name
+                except (TypeError, ValueError, KeyError):
+                    return str(d)
+
+            if _np_name(existing.dtype) != _np_name(dtype):
+                raise ValueError(
+                    f"shared parameter {attr.name!r} dtype mismatch: "
+                    f"{existing.dtype} vs {dtype}")
+            if list(existing.shape) != list(shape):
+                # warn, don't raise: call sites downstream of
+                # unknown-static-shape ops (beam_search etc.) derive
+                # garbage expected shapes; the FIRST creation's shape
+                # is the real one
+                import warnings
+                warnings.warn(
+                    f"shared parameter {attr.name!r}: this call site "
+                    f"expected shape {list(shape)}, reusing existing "
+                    f"{list(existing.shape)}", stacklevel=3)
+            return existing
         param = self.main_program.global_block().create_parameter(
             name=attr.name, shape=shape, dtype=dtype,
             trainable=attr.trainable,

@@ -12,14 +12,6 @@ REF_SPEC = "/root/reference/paddle/fluid/API.spec"
 # classified intentional differences — keep in sync with
 # docs/API_SPEC_ACCOUNTING.md
 NOT_CARRIED = {
-    # superseded by layers.beam_search/beam_search_decode (tested)
-    "contrib.BeamSearchDecoder",
-    "contrib.BeamSearchDecoder.__init__",
-    "contrib.BeamSearchDecoder.block",
-    "contrib.BeamSearchDecoder.decode",
-    "contrib.BeamSearchDecoder.early_stop",
-    "contrib.BeamSearchDecoder.read_array",
-    "contrib.BeamSearchDecoder.update_array",
     # extraction artifact in the reference generator's output
     "dygraph.__impl__",
 }
