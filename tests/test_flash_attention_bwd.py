@@ -455,3 +455,26 @@ def test_hardware_dropout_mask_fwd_bwd_bit_identical(monkeypatch):
     for h in range(H):
         M_dq[h] = (S * ds[h] + di[:, h:h + 1]) / (c * w[:, h][None, :])
     assert (M_fwd == (M_dq > 0.5)).all()
+
+
+def test_dispatch_is_sequence_keyed(monkeypatch):
+    """The kernel/composed crossover rule (measured table beside
+    _KERNEL_MIN_SEQ_PRODUCT): sequence product decides, batch does
+    not."""
+    monkeypatch.setattr(fa, "_INTERPRET", False)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.delenv("PT_FORCE_KERNEL", raising=False)
+    monkeypatch.delenv("PT_FORCE_COMPOSED", raising=False)
+
+    def qk(B, S, H=8, D=64):
+        x = jax.ShapeDtypeStruct((B, S, H, D), jnp.bfloat16)
+        return x, x
+
+    # S=512 stays composed at ANY batch (even at the element count
+    # where S=1024 wins)
+    assert not fa.use_kernel_path(*qk(16, 512), 512, 512, "bshd")
+    assert not fa.use_kernel_path(*qk(32, 512), 512, 512, "bshd")
+    # S>=1024 takes the kernels even at small batch
+    assert fa.use_kernel_path(*qk(4, 1024), 512, 1024, "bshd")
+    assert fa.use_kernel_path(*qk(2, 2048), 512, 1024, "bshd")
+    assert fa.use_kernel_path(*qk(4, 4096), 512, 1024, "bshd")
