@@ -318,15 +318,19 @@ def bench_resnet50():
     from paddle_tpu.core.scope import Scope
 
     B = int(os.environ.get("RN_BATCH", "128"))
+    # RN_LAYOUT=NHWC: channels-last convs (measured A/B in BASELINE)
+    layout = os.environ.get("RN_LAYOUT", "NCHW")
     fluid.framework.unique_name.reset()
     main_prog, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_prog, startup):
-        cost, acc, feeds = models.resnet_train(depth=50)
+        cost, acc, feeds = models.resnet_train(depth=50, layout=layout)
         opt = fluid.optimizer.MomentumOptimizer(0.1, 0.9)
         opt = fluid.contrib.mixed_precision.decorate(opt)
         opt.minimize(cost)
     rng = np.random.RandomState(0)
-    batch = {"image": rng.rand(B, 3, 224, 224).astype(np.float32),
+    img_shape = (B, 224, 224, 3) if layout == "NHWC" else \
+        (B, 3, 224, 224)
+    batch = {"image": rng.rand(*img_shape).astype(np.float32),
              "label": rng.randint(0, 1000, (B, 1)).astype(np.int64)}
     scope = Scope()
     with fluid.scope_guard(scope):

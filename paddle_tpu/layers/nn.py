@@ -126,10 +126,14 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
 
 def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
            groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
-           act=None, name=None):
+           act=None, data_format="NCHW", name=None):
     helper = LayerHelper("conv2d", bias_attr=bias_attr, act=act, name=name)
     groups = groups or 1
-    num_channels = input.shape[1]
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(
+            f"data_format must be NCHW or NHWC, got {data_format!r}")
+    channel_last = data_format == "NHWC"
+    num_channels = input.shape[-1] if channel_last else input.shape[1]
     if isinstance(filter_size, int):
         filter_size = [filter_size, filter_size]
     filter_shape = [num_filters, num_channels // groups] + \
@@ -146,8 +150,11 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
         op_type, inputs={"Input": input, "Filter": w},
         outputs={"Output": out},
         attrs={"strides": _pair(stride), "paddings": _pair(padding),
-               "dilations": _pair(dilation), "groups": groups})
-    pre_act = helper.append_bias_op(out, dim_start=1, dim_end=2)
+               "dilations": _pair(dilation), "groups": groups,
+               "data_format": data_format})
+    pre_act = helper.append_bias_op(
+        out, dim_start=3 if channel_last else 1,
+        dim_end=None if channel_last else 2)
     return helper.append_activation(pre_act)
 
 
@@ -215,7 +222,8 @@ def _pair(v, n=2):
 
 def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
            pool_padding=0, global_pooling=False, use_cudnn=True,
-           ceil_mode=False, exclusive=True, name=None):
+           ceil_mode=False, exclusive=True, data_format="NCHW",
+           name=None):
     helper = LayerHelper("pool2d", name=name)
     out = helper.create_variable_for_type_inference(input.dtype)
     helper.append_op(
@@ -224,7 +232,7 @@ def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
                "strides": _pair(pool_stride),
                "paddings": _pair(pool_padding),
                "global_pooling": global_pooling, "ceil_mode": ceil_mode,
-               "exclusive": exclusive})
+               "exclusive": exclusive, "data_format": data_format})
     return out
 
 

@@ -31,13 +31,19 @@ def _conv_nd(ctx, nd, depthwise=False):
     paddings = _pair(ctx.attr("paddings", [0] * nd), nd)
     dilations = _pair(ctx.attr("dilations", [1] * nd), nd)
     groups = ctx.attr("groups", 1) or 1
+    # "NHWC"/"NDHWC" puts channels last (TPU-friendly at small channel
+    # counts — measured 1.5x on ResNet's early stages, BASELINE r5);
+    # the FILTER stays OI-major either way so both layouts share
+    # parameters
+    data_format = ctx.attr("data_format", None) or f"NC{'DHW'[-nd:]}"
+    channel_last = data_format.endswith("C")
     if depthwise:
-        groups = x.shape[1]
+        groups = x.shape[-1] if channel_last else x.shape[1]
     pad_cfg = [(p, p) for p in paddings]
     spatial = "".join("DHW"[-nd:])
+    io = f"N{spatial}C" if channel_last else f"NC{spatial}"
     dn = lax.conv_dimension_numbers(
-        x.shape, w.shape,
-        (f"NC{spatial}", f"OI{spatial}", f"NC{spatial}"))
+        x.shape, w.shape, (io, f"OI{spatial}", io))
     res_t = jnp.result_type(x)
     x, w = amp_cast("conv2d", x, w)
     # no explicit preferred_element_type under AMP: the conv transpose
@@ -122,14 +128,17 @@ def _pool_nd(ctx, nd):
     adaptive = ctx.attr("adaptive", False)
     exclusive = ctx.attr("exclusive", True)
     ceil_mode = ctx.attr("ceil_mode", False)
+    data_format = ctx.attr("data_format", None) or f"NC{'DHW'[-nd:]}"
+    channel_last = data_format.endswith("C")
+    sp0 = 1 if channel_last else 2      # first spatial axis
     if global_pool or (adaptive and all(k == 1 for k in ksize)):
-        axes = tuple(range(2, 2 + nd))
+        axes = tuple(range(sp0, sp0 + nd))
         red = jnp.max if ptype == "max" else jnp.mean
         ctx.set_output("Out", red(x, axis=axes, keepdims=True))
         return
     if adaptive:
         # adaptive pooling to output size ksize: split into even windows
-        axes = tuple(range(2, 2 + nd))
+        axes = tuple(range(sp0, sp0 + nd))
         out = x
         for ax, osize in zip(axes, ksize):
             isize = out.shape[ax]
@@ -142,18 +151,25 @@ def _pool_nd(ctx, nd):
         ctx.set_output("Out", out)
         return
 
-    window = (1, 1) + tuple(ksize)
-    strides_f = (1, 1) + tuple(strides)
-    pad_cfg = [(0, 0), (0, 0)] + [(p, p) for p in paddings]
+    if channel_last:
+        window = (1,) + tuple(ksize) + (1,)
+        strides_f = (1,) + tuple(strides) + (1,)
+        pad_cfg = [(0, 0)] + [(p, p) for p in paddings] + [(0, 0)]
+    else:
+        window = (1, 1) + tuple(ksize)
+        strides_f = (1, 1) + tuple(strides)
+        pad_cfg = [(0, 0), (0, 0)] + [(p, p) for p in paddings]
     if ceil_mode:
         # extend right/bottom padding so the last partial window counts
-        pad_cfg = [(0, 0), (0, 0)]
+        pad_cfg = ([(0, 0)] if channel_last else [(0, 0), (0, 0)])
         for i in range(nd):
-            isize = x.shape[2 + i]
+            isize = x.shape[sp0 + i]
             out_sz = -(-(isize + 2 * paddings[i] - ksize[i]) //
                        strides[i]) + 1
             need = (out_sz - 1) * strides[i] + ksize[i] - isize - paddings[i]
             pad_cfg.append((paddings[i], max(need, paddings[i])))
+        if channel_last:
+            pad_cfg.append((0, 0))
     if ptype == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
             jnp.iinfo(x.dtype).min

@@ -1,0 +1,59 @@
+"""NHWC ResNet (round-5 conv-layout lever): the NHWC graph must
+compute exactly what the NCHW graph computes from the SAME weights
+(filters are OIHW in both layouts, so one scope serves both), with the
+image feed transposed."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import models
+from paddle_tpu.core.scope import Scope
+
+
+def _build(layout):
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        cost, acc, feeds = models.resnet_train(
+            class_dim=10, depth=18, layout=layout,
+            image_shape=(16, 16, 3) if layout == "NHWC" else (3, 16, 16))
+    return main, startup, cost
+
+
+def test_nhwc_matches_nchw_from_shared_weights():
+    rng = np.random.default_rng(0)
+    m_c, s_c, cost_c = _build("NCHW")
+    m_h, s_h, cost_h = _build("NHWC")
+
+    img = rng.standard_normal((4, 3, 16, 16)).astype(np.float32)
+    lbl = rng.integers(0, 10, (4, 1)).astype(np.int64)
+
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(s_c)    # one init; same param names serve both graphs
+        lc = float(np.asarray(exe.run(
+            m_c, feed={"image": img, "label": lbl},
+            fetch_list=[cost_c])[0]))
+        lh = float(np.asarray(exe.run(
+            m_h, feed={"image": img.transpose(0, 2, 3, 1),
+                       "label": lbl},
+            fetch_list=[cost_h])[0]))
+    np.testing.assert_allclose(lc, lh, rtol=1e-5, atol=1e-6)
+
+
+def test_nhwc_trains():
+    m, s, cost = _build("NHWC")
+    rng = np.random.default_rng(1)
+    with fluid.program_guard(m, s):
+        fluid.optimizer.MomentumOptimizer(0.01, 0.9).minimize(cost)
+    scope = Scope()
+    feed = {"image": rng.standard_normal((4, 16, 16, 3)).astype(
+                np.float32),
+            "label": rng.integers(0, 10, (4, 1)).astype(np.int64)}
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(s)
+        losses = [float(np.asarray(exe.run(m, feed=feed,
+                                           fetch_list=[cost])[0]))
+                  for _ in range(5)]
+    assert losses[-1] < losses[0] and all(np.isfinite(losses))
