@@ -54,6 +54,7 @@ from .transpiler import (  # noqa: F401
     DistributeTranspiler, DistributeTranspilerConfig, HashName,
     RoundRobin, memory_optimize, release_memory,
 )
+from . import communicator  # noqa: F401
 from . import incubate  # noqa: F401
 from . import inference  # noqa: F401
 from . import nets  # noqa: F401

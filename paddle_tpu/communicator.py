@@ -1,0 +1,225 @@
+"""Communicator: trainer-side async send/recv threads for fully-async
+parameter-server training.
+
+Parity: reference python/paddle/fluid/communicator.py (the thin Python
+`Communicator(program)` start/stop/is_running wrapper) over
+operators/distributed/communicator.{h,cc}:
+
+* one bounded queue per gradient var (`send_varname_to_queue_`,
+  capacity FLAGS_communicator_send_queue_size, communicator.cc:84);
+* a send thread that pops up to FLAGS_communicator_max_merge_var_num
+  pending grads per var — waiting at most
+  FLAGS_communicator_send_wait_times empty polls — MERGES THEM BY SUM
+  (MergeVars, communicator.h:104-158) and pushes the merged grad to the
+  var's pserver (communicator.cc:110-150);
+* an independent recv thread that re-pulls every parameter once
+  FLAGS_communicator_min_send_grad_num_before_recv grads have been sent
+  since the last pull (communicator.cc:165-190), writing them into the
+  global scope — which this framework's engine re-reads every step, so
+  fresh params flow into the next compiled step without retracing;
+* FLAGS_communicator_fake_rpc skips the wire for perf debugging.
+
+Like the reference (communicator.py:47), construction sets
+`do_not_run=True` on the program's recv ops — the recv THREAD owns
+parameter refresh; the in-graph recv becomes a no-op.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .core.flags import FLAGS
+from .core.scope import global_scope
+from .distributed import async_ps
+from .framework import Program
+
+__all__ = ["Communicator"]
+
+_running_lock = threading.Lock()
+_running: Optional["Communicator"] = None
+
+
+def _merge_vals(vals):
+    """MergeVars (reference communicator.h:104-158): dense grads sum;
+    SelectedRows grads merge-add by row when
+    FLAGS_communicator_merge_sparse_grad, else concatenate."""
+    from .core.selected_rows import SelectedRows
+    if isinstance(vals[0], SelectedRows):
+        rows = np.concatenate([np.asarray(v.rows) for v in vals])
+        values = np.concatenate([np.asarray(v.values) for v in vals],
+                                axis=0)
+        if FLAGS.communicator_merge_sparse_grad:
+            uniq, inv = np.unique(rows, return_inverse=True)
+            merged = np.zeros((len(uniq),) + values.shape[1:],
+                              values.dtype)
+            np.add.at(merged, inv, values)
+            rows, values = uniq, merged
+        return ("selected_rows", rows, values, int(vals[0].height))
+    out = np.asarray(vals[0], np.float32).copy()
+    for v in vals[1:]:
+        out += np.asarray(v, np.float32)
+    return out
+
+
+class Communicator:
+    """Async distribute-training communicator; use inside the fleet API
+    after a fully-async DistributeTranspiler.transpile (reference
+    communicator.py docstring)."""
+
+    def __init__(self, program: Program, scope=None):
+        assert isinstance(program, Program)
+        self._scope = scope or global_scope()
+        self._send_ctx: Dict[str, dict] = {}
+        self._recv_ctx: Dict[str, str] = {}
+        self._trainer_id = 0
+        for op in program.global_block().ops:
+            if op.type == "send":
+                grad = op.input("X")[0]
+                self._send_ctx[grad] = {
+                    "endpoint": op.attr("endpoints", [""])[0],
+                    "param": op.attr("param_varname", ""),
+                }
+                self._trainer_id = int(op.attr("trainer_id", 0))
+            elif op.type == "recv":
+                # recv thread owns refresh (reference communicator.py:47)
+                op._attrs["do_not_run"] = True
+                for pname in op.output("Out"):
+                    self._recv_ctx[pname] = op.attr("endpoints", [""])[0]
+        self._queues: Dict[str, queue.Queue] = {}
+        self._grad_num = 0
+        self._grad_num_cv = threading.Condition()
+        self._running = False
+        self._send_thread = None
+        self._recv_thread = None
+
+    # -- registry (reference Communicator::GetInstance) --------------------
+    @staticmethod
+    def get_instance() -> Optional["Communicator"]:
+        return _running
+
+    def is_running(self) -> bool:
+        return self._running
+
+    # -- producer side (called by the islanded send op) --------------------
+    def send(self, grad_name: str, value) -> None:
+        q = self._queues.get(grad_name)
+        if q is None:
+            raise KeyError(
+                f"send({grad_name!r}): not a transpiled grad var; known: "
+                f"{sorted(self._queues)}")
+        q.put(value)  # blocks at send_queue_size (BlockingQueue::Push)
+
+    # -- threads -----------------------------------------------------------
+    def _send_loop(self):
+        pool = ThreadPoolExecutor(
+            max_workers=max(1, int(FLAGS.communicator_thread_pool_size)))
+        try:
+            while True:
+                futures = []
+                for name, q in self._queues.items():
+                    vals, waits = [], 0
+                    while len(vals) < int(
+                            FLAGS.communicator_max_merge_var_num):
+                        try:
+                            vals.append(q.get(timeout=0.005))
+                        except queue.Empty:
+                            waits += 1
+                            if waits >= int(
+                                    FLAGS.communicator_send_wait_times) \
+                                    or vals:
+                                break
+                    if not vals:
+                        continue
+                    merged = _merge_vals(vals)
+                    ctx = self._send_ctx[name]
+                    if not FLAGS.communicator_fake_rpc:
+                        futures.append(pool.submit(
+                            async_ps.push_grad, ctx["endpoint"], name,
+                            merged, self._trainer_id, len(vals)))
+                for f in futures:
+                    f.result()
+                    with self._grad_num_cv:
+                        self._grad_num += 1
+                        self._grad_num_cv.notify_all()
+                if not self._running and all(
+                        q.empty() for q in self._queues.values()):
+                    return
+        finally:
+            pool.shutdown(wait=True)
+
+    def _recv_all(self):
+        """RecvAll (reference communicator.cc:154-166): pull every
+        parameter from its shard and install it in the scope."""
+        by_ep: Dict[str, List[str]] = {}
+        for pname, ep in self._recv_ctx.items():
+            by_ep.setdefault(ep, []).append(pname)
+        for ep, names in by_ep.items():
+            if FLAGS.communicator_fake_rpc:
+                continue
+            fresh = async_ps.pull_params(ep, names)
+            for n, v in fresh.items():
+                self._scope.var(n).set_value(np.asarray(v))
+
+    def _recv_loop(self):
+        thresh = int(FLAGS.communicator_min_send_grad_num_before_recv)
+        while True:
+            with self._grad_num_cv:
+                self._grad_num_cv.wait_for(
+                    lambda: self._grad_num >= thresh or
+                    not self._running, timeout=0.2)
+                if self._grad_num >= thresh:
+                    self._grad_num = 0
+                elif not self._running:
+                    return
+                else:
+                    continue
+            try:
+                self._recv_all()
+            except OSError:
+                pass  # server transiently unreachable; retry next round
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        global _running
+        with _running_lock:
+            if _running is not None and _running is not self:
+                raise RuntimeError("another Communicator is running")
+            _running = self
+        cap = max(1, int(FLAGS.communicator_send_queue_size))
+        self._queues = {n: queue.Queue(maxsize=cap)
+                        for n in self._send_ctx}
+        self._running = True
+        self._send_thread = threading.Thread(
+            target=self._send_loop, daemon=True, name="comm-send")
+        self._send_thread.start()
+        if FLAGS.communicator_independent_recv_thread:
+            self._recv_thread = threading.Thread(
+                target=self._recv_loop, daemon=True, name="comm-recv")
+            self._recv_thread.start()
+
+    def stop(self):
+        """Flush pending grads, notify trainer completion (reference
+        SendComplete, executor.cc:95-103), and pull final params."""
+        global _running
+        if not self._running:
+            return
+        self._running = False
+        with self._grad_num_cv:
+            self._grad_num_cv.notify_all()
+        if self._send_thread is not None:
+            self._send_thread.join(timeout=60)
+        if self._recv_thread is not None:
+            self._recv_thread.join(timeout=60)
+        eps = ({c["endpoint"] for c in self._send_ctx.values()} |
+               set(self._recv_ctx.values()))
+        if not FLAGS.communicator_fake_rpc:
+            self._recv_all()
+            for ep in sorted(e for e in eps if e):
+                async_ps.send_complete(ep, self._trainer_id)
+        with _running_lock:
+            if _running is self:
+                _running = None

@@ -58,6 +58,29 @@ _define("benchmark", False, True,
 _define("paddle_num_threads", 2, True,
         "default reader worker threads for the native data feed")
 _define("seed", 0, True, "global default RNG seed when a Program sets none")
+# fully-async communicator knobs (reference communicator.cc:29-41)
+_define("communicator_independent_recv_thread", True, True,
+        "pull params on an independent thread (reference "
+        "communicator.cc:29); False pulls inline after each send round")
+_define("communicator_send_queue_size", 20, True,
+        "per-grad-var bounded queue capacity (communicator.cc:31)")
+_define("communicator_min_send_grad_num_before_recv", 20, True,
+        "grads sent since last pull before the recv thread refreshes "
+        "params (communicator.cc:33)")
+_define("communicator_thread_pool_size", 5, True,
+        "send/recv RPC worker threads (communicator.cc:35)")
+_define("communicator_send_wait_times", 5, True,
+        "empty-queue polls before a partial merge is sent "
+        "(communicator.cc:36)")
+_define("communicator_max_merge_var_num", 20, True,
+        "max queued grads merged (summed) into one push "
+        "(communicator.cc:39)")
+_define("communicator_fake_rpc", False, True,
+        "skip the wire; measure trainer-side overhead "
+        "(communicator.cc:41)")
+_define("communicator_merge_sparse_grad", True, True,
+        "merge-add SelectedRows grads by row before push; False "
+        "concatenates rows (communicator.cc:42)")
 
 # -- subsumed flags: accepted, validated, no effect under XLA/PJRT ----------
 for _name, _default, _help in [

@@ -1,0 +1,204 @@
+"""Fully-async parameter-server runtime: host-side TCP grad/param
+exchange.
+
+Parity: the reference's unbounded-staleness async pserver mode —
+`operators/distributed/communicator.h:160-192` (trainer-side send/recv
+threads batching grad pushes and param pulls over gRPC) and
+`operators/distributed_ops/listen_and_serv_op.cc` RunAsyncLoop (the
+server applies its optimize block per received gradient, with NO
+inter-trainer barriers).
+
+TPU-native stance: device compute stays whole-block XLA; the parameter
+exchange is HOST-side — exactly where the reference keeps it (its gRPC
+stack never touches the GPU either). Transport is length-prefixed
+pickled numpy over TCP on the DCN-equivalent host network; there is no
+gRPC dependency in this environment and the wire format is an internal
+detail of the framework (both ends are this module).
+
+This module is the shared transport + the server loop. The trainer-side
+policy threads (merge-by-sum queues, pull cadence) live in
+`paddle_tpu.communicator.Communicator`.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["AsyncParameterServer", "push_grad", "pull_param",
+           "pull_params", "send_complete", "wait_server"]
+
+_LEN = struct.Struct("<Q")
+
+
+def _send_msg(sock: socket.socket, obj) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_msg(sock: socket.socket):
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _parse_ep(endpoint: str):
+    host, port = endpoint.rsplit(":", 1)
+    return host or "127.0.0.1", int(port)
+
+
+def _rpc(endpoint: str, msg, timeout: float = 60.0):
+    host, port = _parse_ep(endpoint)
+    with socket.create_connection((host, port), timeout=timeout) as s:
+        _send_msg(s, msg)
+        return _recv_msg(s)
+
+
+def wait_server(endpoint: str, timeout: float = 60.0,
+                interval: float = 0.1) -> None:
+    """Block until the pserver at `endpoint` accepts connections
+    (reference trainer-side wait_port, distribute_transpiler.py
+    wait_port=True)."""
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            if _rpc(endpoint, {"t": "ping"}, timeout=5.0) == "pong":
+                return
+        except OSError:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"pserver {endpoint} not up after {timeout}s")
+            time.sleep(interval)
+
+
+def push_grad(endpoint: str, grad_name: str, value, trainer_id: int,
+              merged_n: int = 1) -> None:
+    """Push one (merged) gradient; the server applies its optimize
+    block before replying (reference grpc_client.h AsyncSendVar +
+    RunAsyncLoop's run-on-arrival)."""
+    rep = _rpc(endpoint, {"t": "push", "name": grad_name, "v": value,
+                          "trainer": int(trainer_id),
+                          "merged_n": int(merged_n)})
+    if rep != "ok":
+        raise RuntimeError(f"pserver {endpoint} push({grad_name}): {rep}")
+
+
+def pull_param(endpoint: str, param_name: str) -> np.ndarray:
+    rep = _rpc(endpoint, {"t": "pull", "name": param_name})
+    if isinstance(rep, dict) and rep.get("err"):
+        raise RuntimeError(
+            f"pserver {endpoint} pull({param_name}): {rep['err']}")
+    return rep
+
+
+def pull_params(endpoint: str, names: List[str]) -> Dict[str, np.ndarray]:
+    rep = _rpc(endpoint, {"t": "pull_all", "names": list(names)})
+    if isinstance(rep, dict) and rep.get("err"):
+        raise RuntimeError(f"pserver {endpoint} pull_all: {rep['err']}")
+    return rep
+
+
+def send_complete(endpoint: str, trainer_id: int) -> None:
+    """Trainer-exit notification (reference Executor::Close →
+    SendComplete, executor.cc:95-103): the server exits its loop once
+    every trainer has completed."""
+    _rpc(endpoint, {"t": "complete", "trainer": int(trainer_id)})
+
+
+class AsyncParameterServer:
+    """The RunAsyncLoop event loop (reference listen_and_serv_op.cc:
+    RunAsyncLoop): holds parameter (+ optimizer-state) values, applies
+    the gradient's optimize block immediately on every push — no
+    aggregation barrier, unbounded staleness — serves pulls, and exits
+    after `fanin` trainers send complete.
+
+    `apply_update(grad_name, value, merged_n)` owns the optimizer
+    semantics (the transpiled per-param sub-block); this class owns only
+    the loop. A single lock serializes updates against pulls — the
+    reference serializes per-var through its block queues the same way.
+    """
+
+    def __init__(self, endpoint: str, fanin: int,
+                 get_var: Callable[[str], np.ndarray],
+                 apply_update: Callable[[str, np.ndarray, int], None],
+                 known_params: List[str]):
+        self.endpoint = endpoint
+        self.fanin = int(fanin)
+        self._get_var = get_var
+        self._apply = apply_update
+        self._known = list(known_params)
+        self._lock = threading.Lock()
+        self._completed: set = set()
+        self._done = threading.Event()
+        self._push_count = 0
+        host, port = _parse_ep(endpoint)
+        self._srv = socket.create_server((host, port))
+        self._srv.settimeout(0.2)
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                msg = _recv_msg(conn)
+                t = msg.get("t")
+                if t == "ping":
+                    _send_msg(conn, "pong")
+                elif t == "push":
+                    with self._lock:
+                        self._apply(msg["name"], msg["v"],
+                                    msg.get("merged_n", 1))
+                        self._push_count += 1
+                    _send_msg(conn, "ok")
+                elif t == "pull":
+                    with self._lock:
+                        v = np.asarray(self._get_var(msg["name"]))
+                    _send_msg(conn, v)
+                elif t == "pull_all":
+                    names = msg.get("names") or self._known
+                    with self._lock:
+                        out = {n: np.asarray(self._get_var(n))
+                               for n in names}
+                    _send_msg(conn, out)
+                elif t == "complete":
+                    with self._lock:
+                        self._completed.add(msg["trainer"])
+                        done = len(self._completed) >= self.fanin
+                    _send_msg(conn, "ok")
+                    if done:
+                        self._done.set()
+                else:
+                    _send_msg(conn, {"err": f"unknown message {t!r}"})
+        except (ConnectionError, OSError):
+            pass
+        except Exception as exc:  # surface optimizer errors to the client
+            try:
+                _send_msg(conn, {"err": f"{type(exc).__name__}: {exc}"})
+            except OSError:
+                pass
+
+    def serve(self) -> int:
+        """Blocking loop; returns the number of pushes applied."""
+        try:
+            while not self._done.is_set():
+                try:
+                    conn, _ = self._srv.accept()
+                except socket.timeout:
+                    continue
+                threading.Thread(target=self._handle, args=(conn,),
+                                 daemon=True).start()
+        finally:
+            self._srv.close()
+        return self._push_count

@@ -40,7 +40,20 @@ class ParameterServerFleet(Fleet):
         self.startup_program = None
         self._origin_program = None
 
+    def _fully_async(self):
+        t = self._transpiler
+        return t is not None and \
+            getattr(t, "_fa_assignments", None) is not None
+
     def init_worker(self):
+        if self._fully_async():
+            # start the async communicator over the transpiled trainer
+            # program (reference fleet init_worker starts the C++
+            # Communicator in async mode)
+            from ....communicator import Communicator
+            self._communicator = Communicator(self.main_program)
+            self._communicator.start()
+            return
         # collective bootstrap replaces the pserver wait-loop; reuse
         # the collective fleet's jax.distributed path when multi-host
         from ..collective import fleet as collective_fleet
@@ -57,6 +70,19 @@ class ParameterServerFleet(Fleet):
                                  framework.default_main_program())
 
     def run_server(self):
+        if self._fully_async():
+            # the REAL event loop: run this endpoint's pserver startup
+            # + listen_and_serv programs (reference RunAsyncLoop);
+            # blocks until every trainer sends complete
+            from ....core.place import CPUPlace
+            from ....executor import Executor
+            eps = self._role_maker.get_pserver_endpoints()
+            ep = eps[self._role_maker.server_index()]
+            main, startup = self._transpiler.get_pserver_programs(ep)
+            exe = Executor(CPUPlace())
+            exe.run(startup)
+            exe.run(main)
+            return
         # the transpile folded every optimizer block into the trainer
         # program's collective step; a pserver process has no RPC loop
         # to serve (reference ListenAndServOp event loop is subsumed)
@@ -64,7 +90,10 @@ class ParameterServerFleet(Fleet):
                   "to collectives on TPU; run_server is a no-op")
 
     def stop_worker(self):
-        pass
+        comm = getattr(self, "_communicator", None)
+        if comm is not None:
+            comm.stop()
+            self._communicator = None
 
     def distributed_optimizer(self, optimizer, strategy=None):
         self._optimizer = TranspilerOptimizer(optimizer, strategy)
@@ -119,6 +148,7 @@ class TranspilerOptimizer(DistributedOptimizer):
             trainer_id=fleet.worker_index(),
             pservers=fleet.server_endpoints(to_string=True),
             trainers=fleet.worker_num(),
+            sync_mode=self._strategy.sync_mode,
             program=loss.block.program,
             startup_program=startup_program or
             framework.default_startup_program())

@@ -30,17 +30,125 @@ def _identity(ctx):
             ctx.env[n] = v
 
 
-for _t in ["send", "recv", "send_barrier", "fetch_barrier", "prefetch",
+for _t in ["send_barrier", "fetch_barrier", "prefetch",
            "checkpoint_notify", "ref_by_trainer_id"]:
     register_no_grad_op(_t)(_identity)
 
 
+@register_no_grad_op("send")
+def send(ctx):
+    """Send-op (reference distributed_ops/send_op.cc). Two behaviors,
+    matching the reference's: when an async Communicator is running the
+    grad is handed to its merge queue (send_op.cc routes through
+    Communicator::Send in async mode); otherwise the op is a
+    structure-preserving no-op (the collective transpile subsumed the
+    exchange). The communicator path must see CONCRETE host values, so
+    under tracing it raises NotImplementedError — the engine's island
+    partitioner then runs exactly this op on host between compiled XLA
+    islands (the TPU-native analog of the reference's per-op CPU
+    dispatch for this host-side op)."""
+    from ..communicator import Communicator
+    comm = Communicator.get_instance()
+    if comm is None:
+        return _identity(ctx)
+    xs = ctx.inputs("X")
+    if any(isinstance(v, jax.core.Tracer) for v in xs):
+        raise NotImplementedError(
+            "send pushes to the async communicator on host; runs as an "
+            "eager island")
+    for n, v in zip(ctx.op.input("X"), xs):
+        comm.send(n, v)
+    _identity(ctx)
+
+
+@register_no_grad_op("recv")
+def recv(ctx):
+    """Recv-op (reference distributed_ops/recv_op.cc). With an async
+    Communicator active, its recv THREAD owns parameter refresh and the
+    Communicator constructor sets do_not_run=True here (reference
+    communicator.py:47) — no-op. Without one, and with pserver
+    endpoints bound (the fully-async trainer STARTUP program does
+    this), the pull is synchronous: fetch the fresh value and bind the
+    output — the reference trainer's blocking param fetch."""
+    if ctx.attr("do_not_run", False):
+        return
+    eps = ctx.attr("endpoints", [])
+    if not eps or not eps[0]:
+        return _identity(ctx)
+    out_names = ctx.op.output("Out")
+    if any(isinstance(ctx.env.get(n), jax.core.Tracer)
+           for n in list(ctx.op.input("X")) + list(out_names)):
+        raise NotImplementedError("recv pulls on host; eager island")
+    from ..distributed import async_ps
+    if ctx.attr("wait_port", True):
+        async_ps.wait_server(eps[0])
+    fresh = async_ps.pull_params(eps[0], list(out_names))
+    for n in out_names:
+        ctx.env[n] = jnp.asarray(fresh[n])
+
+
 @register_no_grad_op("listen_and_serv")
 def listen_and_serv(ctx):
-    """Pserver event loop (reference listen_and_serv_op.cc:109 RunSyncLoop).
-    No pservers exist on TPU: exits immediately (the transpiler emits it
-    with attr noop=True for launcher compatibility)."""
-    return
+    """Pserver event loop (reference listen_and_serv_op.cc:330). With
+    attr noop=True (the pserver→collective transpile) it exits
+    immediately. With noop=False — the FULLY-ASYNC pserver transpile —
+    it is the real RunAsyncLoop (listen_and_serv_op.cc:RunAsyncLoop):
+    serve param pulls and, per received grad, run that grad's optimize
+    sub-block (attr grad_to_block_id, same contract as the reference
+    attr) against the served vars; exit after Fanin trainers complete.
+
+    The op's X inputs / Out outputs name every served var (params,
+    optimizer accumulators, LR) so the engine seeds them from the scope
+    and persists the final values back — optimizer state lives on the
+    server, sharded, exactly like the reference pserver."""
+    if ctx.attr("noop", True):
+        return
+    names = ctx.op.input("X")
+    vals = ctx.inputs("X")
+    if any(isinstance(v, jax.core.Tracer) for v in vals):
+        raise NotImplementedError(
+            "listen_and_serv is a host event loop; runs eagerly")
+    from ..core.selected_rows import SelectedRows
+    from ..distributed.async_ps import AsyncParameterServer
+
+    grad_to_block = {}
+    for entry in ctx.attr("grad_to_block_id", []):
+        g, bid = entry.rsplit(":", 1)
+        grad_to_block[g] = int(bid)
+    param_names = list(ctx.attr("param_names", []))
+
+    def get_var(name):
+        if name not in ctx.env:
+            raise KeyError(f"pserver does not serve var {name!r}")
+        return np.asarray(ctx.env[name])
+
+    def apply_update(grad_name, value, merged_n):
+        bid = grad_to_block.get(grad_name)
+        if bid is None:
+            raise KeyError(
+                f"no optimize block for grad {grad_name!r}; known: "
+                f"{sorted(grad_to_block)}")
+        if isinstance(value, tuple) and value and \
+                value[0] == "selected_rows":
+            _, rows, values, height = value
+            ctx.env[grad_name] = SelectedRows(
+                jnp.asarray(rows), jnp.asarray(values), height)
+        else:
+            ctx.env[grad_name] = jnp.asarray(value)
+        ctx.block_runner(bid)
+
+    srv = AsyncParameterServer(
+        endpoint=ctx.attr("endpoint", "127.0.0.1:6174"),
+        fanin=int(ctx.attr("Fanin", 1)),
+        get_var=get_var, apply_update=apply_update,
+        known_params=param_names)
+    pushes = srv.serve()
+    # re-bind outputs so the island runner records the served vars as
+    # written and persists them to the scope
+    for n, out in zip(names, ctx.op.output("Out")):
+        ctx.env[out] = ctx.env[n]
+    if ctx.has_output("PushCount"):
+        ctx.set_output("PushCount", jnp.asarray([pushes], jnp.int64))
 
 
 @register_no_grad_op("fake_init")

@@ -48,6 +48,13 @@ class DistributeTranspilerConfig:
     # half-async staleness bound: local steps between averaging rounds
     # when transpile(..., sync_mode=False)
     stale_steps = 4
+    # transpile(..., sync_mode=False) with fully_async=True selects the
+    # reference's UNBOUNDED-staleness async pserver mode
+    # (communicator.h:160-192): real pserver processes apply per-param
+    # optimize blocks on every grad arrival, trainers exchange through
+    # the async Communicator with no barriers. False (default) keeps
+    # the bounded-staleness StaleSyncSGD mapping.
+    fully_async = False
 
 
 class DistributeTranspiler:
@@ -82,7 +89,8 @@ class DistributeTranspiler:
         self.pserver_endpoints = pservers.split(",") if isinstance(
             pservers, str) else list(pservers)
 
-        if self.config.mode == "pserver":
+        if self.config.mode == "pserver" and not (
+                not sync_mode and self.config.fully_async):
             warnings.warn(
                 "pserver mode transpiles to the collective path on TPU "
                 "(pserver-to-collective); pserver programs become "
@@ -97,11 +105,21 @@ class DistributeTranspiler:
                 "vocab-sharded embedding path in parallel/strategy.py "
                 "for tables too big for one chip); (3) sync_mode=False "
                 "maps to bounded-staleness StaleSyncSGD (k local steps "
-                "between averaging rounds), not the unbounded-"
-                "staleness async communicator; (4) get_pserver_program"
+                "between averaging rounds) by default — set "
+                "config.fully_async=True for the reference's unbounded-"
+                "staleness async communicator mode with REAL pserver "
+                "processes; (4) get_pserver_program"
                 "()/get_startup_program() return runnable no-op "
                 "programs so server launch scripts exit cleanly "
                 "instead of serving.", stacklevel=2)
+
+        if not sync_mode and self.config.mode == "pserver" and \
+                self.config.fully_async:
+            self._transpile_fully_async(program, startup_program)
+            self._trainer_program = program
+            self._startup_program = startup_program
+            self._transpiled = True
+            return self
 
         mode = self.config.collective_mode
         if not sync_mode:
@@ -126,6 +144,145 @@ class DistributeTranspiler:
         self._transpiled = True
         return self
 
+    # ---- fully-async pserver mode (reference unbounded staleness) -------
+    def _transpile_fully_async(self, program, startup_program):
+        """Reference async pserver transpile (distribute_transpiler.py
+        :375 with sync_mode=False): move each parameter's update op(s)
+        to its pserver shard, replace them with barrier-free `send`
+        ops, and add `recv` ops for parameter refresh. Clip /
+        regularization (optimize-role ops WITHOUT a Param slot) stay on
+        the trainer — the sent var is the post-clip grad the update op
+        consumed, the reference's split point."""
+        block = program.global_block()
+        update_idx = []
+        for i, op in enumerate(block.ops):
+            if op.attr("op_role", "forward") != "optimize":
+                continue
+            if op.input("Param") and op.output("ParamOut"):
+                update_idx.append(i)
+        if not update_idx:
+            raise ValueError(
+                "fully-async transpile found no optimizer update ops; "
+                "call optimizer.minimize() before transpile()")
+        # scheduled LR would need per-arrival server-side decay blocks;
+        # honest contract: constant lr only (use StaleSyncSGD otherwise)
+        produced = {n for op in block.ops
+                    for slot in op.output_slots()
+                    for n in op.output(slot)}
+        assignments = []     # (endpoint, param, grad, op, served vars)
+        dispatcher_cls = self.config.split_method or HashName
+        dispatcher = dispatcher_cls(self.pserver_endpoints)
+        params = [block.ops[i].input("Param")[0] for i in update_idx]
+        eplist = dispatcher.dispatch(params)
+        for i, ep in zip(update_idx, eplist):
+            op = block.ops[i]
+            param = op.input("Param")[0]
+            grad = op.input("Grad")[0]
+            lr_in = op.input("LearningRate")
+            if lr_in and lr_in[0] in produced:
+                raise NotImplementedError(
+                    f"fully-async pserver mode supports constant "
+                    f"learning rates only ({lr_in[0]!r} is produced "
+                    f"in-program by a scheduler); use the bounded-"
+                    f"staleness StaleSyncSGD mapping "
+                    f"(fully_async=False) for scheduled LR")
+            served = set()
+            for slot in op.input_slots():
+                for n in op.input(slot):
+                    if n == grad:
+                        continue
+                    v = block._find_var_recursive(n)
+                    if v is not None and v.persistable:
+                        served.add(n)
+            served.add(param)
+            assignments.append((ep, param, grad, op, sorted(served)))
+
+        for i in reversed(update_idx):
+            block.remove_op(i)
+        for ep, param, grad, op, served in assignments:
+            block.append_op(
+                "send", inputs={"X": [grad]}, outputs={},
+                attrs={"endpoints": [ep], "param_varname": param,
+                       "trainer_id": self.trainer_id,
+                       "op_role": "optimize"}, infer_shape=False)
+            block.append_op(
+                "recv", inputs={}, outputs={"Out": [param]},
+                attrs={"endpoints": [ep], "do_not_run": False,
+                       "wait_port": False, "op_role": "optimize"},
+                infer_shape=False)
+        # trainer startup: pull the server's initial params so every
+        # trainer starts from the SAME point (the reference trainer
+        # recvs initial params instead of trusting local init)
+        sb = startup_program.global_block()
+        for ep, param, grad, op, served in assignments:
+            sb.append_op(
+                "recv", inputs={}, outputs={"Out": [param]},
+                attrs={"endpoints": [ep], "do_not_run": False,
+                       "wait_port": self.config.wait_port},
+                infer_shape=False)
+        self._fa_assignments = assignments
+        self._fa_startup = startup_program
+
+    def _fa_build_pserver_program(self, endpoint):
+        mine = [a for a in self._fa_assignments if a[0] == endpoint]
+        prog = framework.Program()
+        gb = prog.global_block()
+        served_all, grads, blk_ids, pnames = [], [], [], []
+        origin_block = self._origin_main.global_block()
+        for ep, param, grad, op, served in mine:
+            for n in list(served) + [grad]:
+                if gb.has_var(n):
+                    continue
+                v = origin_block._find_var_recursive(n)
+                gb.create_var(name=n, shape=list(v.shape),
+                              dtype=v.dtype,
+                              persistable=(n != grad))
+            sub = prog._create_block(parent_idx=0)
+            sub.append_op(op.type, inputs=dict(op._inputs),
+                          outputs=dict(op._outputs),
+                          attrs=dict(op._attrs), infer_shape=False)
+            prog._rollback()
+            served_all.extend(n for n in served if n not in served_all)
+            grads.append(grad)
+            blk_ids.append(sub.idx)
+            pnames.append(param)
+        gb.append_op(
+            "listen_and_serv", inputs={"X": served_all},
+            outputs={"Out": served_all},
+            attrs={"endpoint": endpoint, "Fanin": self.trainers,
+                   "noop": False, "distributed_mode": 1,
+                   "grad_to_block_id": [f"{g}:{b}" for g, b in
+                                        zip(grads, blk_ids)],
+                   "optimize_blocks": blk_ids,
+                   "param_names": pnames}, infer_shape=False)
+        return prog
+
+    def _fa_build_pserver_startup(self, endpoint):
+        """Init ops for this shard's served vars, cloned from the
+        trainer startup (the reference splits the startup program the
+        same way — each pserver initializes its own param blocks)."""
+        mine = [a for a in self._fa_assignments if a[0] == endpoint]
+        served = set()
+        for _, _, _, _, s in mine:
+            served.update(s)
+        prog = framework.Program()
+        gb = prog.global_block()
+        origin_block = self._origin_main.global_block()
+        for n in sorted(served):
+            v = origin_block._find_var_recursive(n)
+            gb.create_var(name=n, shape=list(v.shape), dtype=v.dtype,
+                          persistable=True)
+        for op in self._fa_startup.global_block().ops:
+            if op.type in ("recv", "send"):
+                continue
+            outs = [n for slot in op.output_slots()
+                    for n in op.output(slot)]
+            if outs and all(n in served for n in outs):
+                gb.append_op(op.type, inputs=dict(op._inputs),
+                             outputs=dict(op._outputs),
+                             attrs=dict(op._attrs), infer_shape=False)
+        return prog
+
     def get_trainer_program(self, wait_port=True):
         assert self._transpiled, "call transpile() first"
         return self._trainer_program
@@ -133,13 +290,21 @@ class DistributeTranspiler:
     def get_startup_program(self, endpoint=None, pserver_program=None,
                             startup_program=None):
         assert self._transpiled, "call transpile() first"
+        if endpoint is not None and getattr(self, "_fa_assignments",
+                                            None) is not None:
+            return self._fa_build_pserver_startup(endpoint)
         return self._startup_program
 
     def get_pserver_program(self, endpoint):
-        """North star: pservers are no-ops on TPU — return a minimal
-        program whose single listen_and_serv op exits immediately
-        (nranks collective training happens on the trainers)."""
+        """Fully-async mode: the REAL pserver program — a
+        listen_and_serv event loop over this shard's params with one
+        optimize sub-block per grad (runnable via Executor.run, like
+        the reference book tests' pserver processes). Otherwise (north
+        star pserver→collective): a minimal program whose single
+        listen_and_serv op exits immediately."""
         assert self._transpiled, "call transpile() first"
+        if getattr(self, "_fa_assignments", None) is not None:
+            return self._fa_build_pserver_program(endpoint)
         prog = framework.Program()
         block = prog.global_block()
         block.append_op("listen_and_serv", inputs={}, outputs={},
@@ -151,5 +316,8 @@ class DistributeTranspiler:
         return prog
 
     def get_pserver_programs(self, endpoint):
+        if getattr(self, "_fa_assignments", None) is not None:
+            return (self._fa_build_pserver_program(endpoint),
+                    self._fa_build_pserver_startup(endpoint))
         return self.get_pserver_program(endpoint), \
             framework.Program()

@@ -23,18 +23,23 @@ class PSDispatcher:
 
 
 class HashName(PSDispatcher):
-    """hash(var name) % #pservers (ps_dispatcher.py:56)."""
+    """hash(var name) % #pservers (ps_dispatcher.py:56). The reference's
+    Python-2 ``hash(str)`` was stable across processes; Python 3
+    randomizes it per process, which would send trainer pushes and
+    pserver assignments to DIFFERENT shards — so this build hashes with
+    crc32 (process-stable, same distribution role)."""
 
     def _hash_block(self, block_str, total):
-        return hash(block_str) % total
+        import zlib
+        return zlib.crc32(str(block_str).encode()) % total
 
     def dispatch(self, varlist):
         eplist = []
         for var in varlist:
-            server_id = self._hash_block(var.name(), len(self._eps)) \
-                if hasattr(var, "name") and callable(var.name) \
-                else hash(str(getattr(var, "name", var))) % len(self._eps)
-            eplist.append(self._eps[server_id])
+            name = var.name() if hasattr(var, "name") and \
+                callable(var.name) else str(getattr(var, "name", var))
+            eplist.append(self._eps[self._hash_block(
+                name, len(self._eps))])
         return eplist
 
 

@@ -1,0 +1,104 @@
+"""Worker for the FULLY-ASYNC pserver cluster test (1 pserver + 2
+trainers as subprocesses, reference test_dist_base.py:449-502 shape).
+
+Exercises the complete reference async stack: fleet parameter_server
+API -> DistributeTranspiler fully_async transpile (update ops moved to
+the pserver, barrier-free send/recv on the trainer) -> Communicator
+merge-queue send thread + param-pull recv thread -> real
+listen_and_serv event loop run through Executor on the server process,
+applying the SGD optimize sub-block per grad arrival with NO
+inter-trainer barriers (unbounded staleness,
+reference communicator.h:160-192 + listen_and_serv_op.cc RunAsyncLoop).
+
+Trainer prints per-step losses; the server prints its push count.
+"""
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("XLA_FLAGS", None)
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu import layers  # noqa: E402
+from paddle_tpu.core.flags import set_flags  # noqa: E402
+from paddle_tpu.incubate.fleet.base.role_maker import (  # noqa: E402
+    Role, UserDefinedRoleMaker)
+from paddle_tpu.incubate.fleet.parameter_server import (  # noqa: E402
+    DistributeTranspilerConfig, fleet)
+
+STEPS = 30
+
+
+def build():
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        pred = layers.fc(x, 1, param_attr=fluid.ParamAttr(name="w"),
+                         bias_attr=fluid.ParamAttr(name="b"))
+        loss = layers.mean(layers.square_error_cost(pred, y))
+    return main, startup, loss
+
+
+def main():
+    role_name = os.environ["ROLE"]
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    n_trainers = int(os.environ["PADDLE_TRAINERS_NUM"])
+    server_ep = os.environ["PADDLE_PSERVER_EP"]
+
+    role = UserDefinedRoleMaker(
+        current_id=rank,
+        role=Role.SERVER if role_name == "pserver" else Role.WORKER,
+        worker_num=n_trainers, server_endpoints=[server_ep])
+    fleet.init(role)
+
+    main_prog, startup, loss = build()
+    with fluid.program_guard(main_prog, startup):
+        opt = fluid.optimizer.SGDOptimizer(0.05)
+        cfg = DistributeTranspilerConfig()
+        cfg.sync_mode = False
+        cfg.fully_async = True
+        opt = fleet.distributed_optimizer(opt, cfg)
+        opt.minimize(loss)
+
+    if role_name == "pserver":
+        fleet.run_server()     # blocks until both trainers complete
+        print("SERVER_DONE", flush=True)
+        return
+
+    # trainer: pull merges eagerly (small cluster, tight test budget)
+    set_flags({"communicator_min_send_grad_num_before_recv": 2,
+               "communicator_max_merge_var_num": 4})
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fleet.startup_program or startup)  # init + recv initial w/b
+    fleet.init_worker()                        # starts the Communicator
+
+    rng = np.random.RandomState(11 + rank)     # different data per rank
+    w_true = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+    losses = []
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # island demotion warnings
+        for _ in range(STEPS):
+            bx = rng.rand(16, 4).astype(np.float32)
+            by = bx @ w_true + 0.25
+            out = exe.run(fleet.main_program,
+                          feed={"x": bx, "y": by},
+                          fetch_list=[loss.name])
+            losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+    fleet.stop_worker()  # flush + final param pull + SendComplete
+    wv = fluid.global_scope().find_var("w").get_value()
+    w = np.asarray(wv.array if hasattr(wv, "array") else wv)
+    print("LOSSES " + json.dumps(losses), flush=True)
+    print("W " + json.dumps(w.reshape(-1).tolist()), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,281 @@
+"""Fully-async pserver mode: transport, Communicator semantics,
+transpile structure, and the 2-trainer + 1-pserver subprocess cluster.
+
+Reference surface under test:
+- operators/distributed/communicator.{h,cc} (merge-by-sum queues, recv
+  cadence, flags) -> paddle_tpu/communicator.py
+- python/paddle/fluid/communicator.py (Communicator(program) wrapper,
+  do_not_run on recv ops)
+- distributed_ops/listen_and_serv_op.cc RunAsyncLoop -> the real
+  listen_and_serv lowering (ops/distributed_ops.py)
+- transpiler async pserver split (distribute_transpiler.py:375
+  sync_mode=False) -> DistributeTranspilerConfig.fully_async
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.communicator import Communicator
+from paddle_tpu.core.flags import get_flags, set_flags
+from paddle_tpu.distributed import async_ps
+from paddle_tpu.transpiler import DistributeTranspiler
+from paddle_tpu.transpiler.distribute_transpiler import (
+    DistributeTranspilerConfig)
+from paddle_tpu.transpiler.ps_dispatcher import HashName
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ---------------------------------------------------------------------------
+# transport + server loop
+# ---------------------------------------------------------------------------
+
+def test_async_ps_push_pull_complete():
+    ep = f"127.0.0.1:{_free_port()}"
+    state = {"w": np.zeros(3, np.float32)}
+    applied = []
+
+    def apply_update(name, value, merged_n):
+        applied.append((name, merged_n))
+        state["w"] -= 0.1 * np.asarray(value)
+
+    srv = async_ps.AsyncParameterServer(
+        ep, fanin=2, get_var=lambda n: state[n],
+        apply_update=apply_update, known_params=["w"])
+    th = threading.Thread(target=srv.serve, daemon=True)
+    th.start()
+    async_ps.wait_server(ep)
+
+    async_ps.push_grad(ep, "w@GRAD", np.ones(3, np.float32), 0)
+    assert np.allclose(async_ps.pull_param(ep, "w"), -0.1)
+    async_ps.push_grad(ep, "w@GRAD", np.ones(3, np.float32), 1,
+                       merged_n=3)
+    got = async_ps.pull_params(ep, ["w"])
+    assert np.allclose(got["w"], -0.2)
+    assert applied == [("w@GRAD", 1), ("w@GRAD", 3)]
+    async_ps.send_complete(ep, 0)
+    async_ps.send_complete(ep, 1)     # fanin reached -> loop exits
+    th.join(timeout=10)
+    assert not th.is_alive()
+
+
+def test_hashname_dispatch_is_process_stable():
+    # Python 3 randomizes hash(str) per process; the dispatcher must
+    # not (trainer and pserver processes agree on shard ownership)
+    eps = ["a:1", "b:2", "c:3"]
+    out = HashName(eps).dispatch(["w", "b", "emb", "fc_0.w_0"])
+    import zlib
+    want = [eps[zlib.crc32(n.encode()) % 3]
+            for n in ["w", "b", "emb", "fc_0.w_0"]]
+    assert out == want
+
+
+# ---------------------------------------------------------------------------
+# transpile structure (reference test_dist_transpiler.py style goldens)
+# ---------------------------------------------------------------------------
+
+def _build_and_transpile(n_trainers=2, ep=None):
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        pred = layers.fc(x, 1, param_attr=fluid.ParamAttr(name="w"),
+                         bias_attr=fluid.ParamAttr(name="b"))
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    cfg = DistributeTranspilerConfig()
+    cfg.sync_mode = False
+    cfg.fully_async = True
+    t = DistributeTranspiler(cfg)
+    t.transpile(0, program=main, pservers=ep or "127.0.0.1:6174",
+                trainers=n_trainers, sync_mode=False,
+                startup_program=startup)
+    return t, main, startup, loss
+
+
+def test_fully_async_transpile_structure():
+    t, main, startup, loss = _build_and_transpile()
+    types = [op.type for op in main.global_block().ops]
+    assert "sgd" not in types, "update ops must move to the pserver"
+    assert types.count("send") == 2 and types.count("recv") == 2
+    assert "send_barrier" not in types and "fetch_barrier" not in types
+
+    # trainer startup pulls initial params from the server
+    st_types = [op.type for op in startup.global_block().ops]
+    assert st_types.count("recv") == 2
+
+    ep = "127.0.0.1:6174"
+    ps = t.get_pserver_program(ep)
+    gb_types = [op.type for op in ps.global_block().ops]
+    assert gb_types == ["listen_and_serv"]
+    las = ps.global_block().ops[0]
+    assert las.attr("noop", True) is False
+    assert las.attr("Fanin") == 2
+    g2b = dict(e.rsplit(":", 1) for e in las.attr("grad_to_block_id"))
+    assert set(las.attr("param_names")) == {"w", "b"}
+    # each optimize sub-block holds exactly the sgd update op
+    for bid in g2b.values():
+        sub_ops = ps.block(int(bid)).ops
+        assert [o.type for o in sub_ops] == ["sgd"]
+
+    # pserver startup initializes the served vars (and only them)
+    pst = t.get_startup_program(endpoint=ep)
+    created = {n for op in pst.global_block().ops
+               for slot in op.output_slots() for n in op.output(slot)}
+    assert {"w", "b"}.issubset(created)
+    assert not any(o.type in ("recv", "send")
+                   for o in pst.global_block().ops)
+
+
+def test_fully_async_rejects_scheduled_lr():
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        pred = layers.fc(x, 1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        lr = layers.exponential_decay(0.1, 100, 0.9)
+        fluid.optimizer.SGDOptimizer(lr).minimize(loss)
+    cfg = DistributeTranspilerConfig()
+    cfg.sync_mode = False
+    cfg.fully_async = True
+    with pytest.raises(NotImplementedError, match="constant learning"):
+        DistributeTranspiler(cfg).transpile(
+            0, program=main, pservers="127.0.0.1:6174", trainers=2,
+            sync_mode=False, startup_program=startup)
+
+
+# ---------------------------------------------------------------------------
+# Communicator semantics against a counting server
+# ---------------------------------------------------------------------------
+
+def test_communicator_merges_by_sum_and_pulls():
+    ep = f"127.0.0.1:{_free_port()}"
+    t, main, startup, loss = _build_and_transpile(n_trainers=1, ep=ep)
+
+    state = {"w": np.zeros((4, 1), np.float32),
+             "b": np.zeros((1,), np.float32)}
+    pushes = []
+
+    def apply_update(name, value, merged_n):
+        pushes.append((name, merged_n))
+        pname = name.split("@")[0]
+        state[pname] -= np.asarray(value).reshape(state[pname].shape)
+
+    srv = async_ps.AsyncParameterServer(
+        ep, fanin=1, get_var=lambda n: state[n],
+        apply_update=apply_update, known_params=["w", "b"])
+    threading.Thread(target=srv.serve, daemon=True).start()
+    async_ps.wait_server(ep)
+
+    old = get_flags(["communicator_max_merge_var_num",
+                     "communicator_min_send_grad_num_before_recv"])
+    set_flags({"communicator_max_merge_var_num": 8,
+               "communicator_min_send_grad_num_before_recv": 1})
+    try:
+        scope = fluid.core.Scope()
+        with fluid.scope_guard(scope):
+            scope.var("w").set_value(np.zeros((4, 1), np.float32))
+            scope.var("b").set_value(np.zeros((1,), np.float32))
+            comm = Communicator(main, scope=scope)
+            # recv ops got do_not_run (reference communicator.py:47)
+            recv_ops = [op for op in main.global_block().ops
+                        if op.type == "recv"]
+            assert all(op.attr("do_not_run") for op in recv_ops)
+            comm.start()
+            assert comm.is_running()
+            grad_names = sorted(comm._send_ctx)
+            wg = [n for n in grad_names if n.startswith("w")][0]
+            bg = [n for n in grad_names if n.startswith("b")][0]
+            # enqueue 4 grads quickly: they merge by SUM into one+ push
+            for _ in range(4):
+                comm.send(wg, np.full((4, 1), 0.25, np.float32))
+                comm.send(bg, np.full((1,), 0.5, np.float32))
+            comm.stop()
+        # total applied effect == sum of all grads, regardless of how
+        # the merge batched them
+        assert np.allclose(state["w"], -1.0), state["w"]
+        assert np.allclose(state["b"], -2.0), state["b"]
+        merged_counts = [n for _, n in pushes]
+        assert sum(1 for c in merged_counts if c > 1) >= 1, \
+            f"expected at least one merged push, got {pushes}"
+        # final recv installed server params into the scope
+        got = np.asarray(scope.find_var("w").get_value().array
+                         if hasattr(scope.find_var("w").get_value(),
+                                    "array")
+                         else scope.find_var("w").get_value())
+        assert np.allclose(got, -1.0)
+    finally:
+        set_flags(old)
+
+
+# ---------------------------------------------------------------------------
+# full cluster: 1 pserver + 2 trainers (subprocess, CPU)
+# ---------------------------------------------------------------------------
+
+def test_fully_async_cluster_converges():
+    ep = f"127.0.0.1:{_free_port()}"
+    env_base = {**os.environ,
+                "JAX_PLATFORMS": "cpu",
+                "PADDLE_TRAINERS_NUM": "2",
+                "PADDLE_PSERVER_EP": ep}
+    env_base.pop("XLA_FLAGS", None)
+    worker = os.path.join(HERE, "dist_async_worker.py")
+
+    procs = [subprocess.Popen(
+        [sys.executable, worker],
+        env={**env_base, "ROLE": "pserver"},
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)]
+    time.sleep(0.5)
+    for rank in range(2):
+        procs.append(subprocess.Popen(
+            [sys.executable, worker],
+            env={**env_base, "ROLE": "trainer",
+                 "PADDLE_TRAINER_ID": str(rank)},
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, err = p.communicate()
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed rc={rc}\n{out}\n{err[-4000:]}"
+
+    assert "SERVER_DONE" in outs[0][1]
+    w_true = np.array([1.0, -2.0, 0.5, 3.0])
+    for rc, out, err in outs[1:]:
+        losses = json.loads(
+            [ln for ln in out.splitlines()
+             if ln.startswith("LOSSES")][0].split(" ", 1)[1])
+        w = np.array(json.loads(
+            [ln for ln in out.splitlines()
+             if ln.startswith("W ")][0].split(" ", 1)[1]))
+        first3 = np.mean(losses[:3])
+        last3 = np.mean(losses[-3:])
+        assert last3 < first3 * 0.5, \
+            f"async training did not converge: {losses}"
+        # both trainers' updates land on the shared server params;
+        # loose bound — unbounded staleness is not exact SGD
+        assert np.linalg.norm(w - w_true) < \
+            0.8 * np.linalg.norm(w_true), (w, w_true)
