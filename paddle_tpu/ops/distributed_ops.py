@@ -52,7 +52,9 @@ def send(ctx):
     if comm is None:
         return _identity(ctx)
     xs = ctx.inputs("X")
-    if any(isinstance(v, jax.core.Tracer) for v in xs):
+    if any(isinstance(l, jax.core.Tracer)
+           for l in jax.tree_util.tree_leaves(xs)):
+        # covers SelectedRows grads too (registered pytrees)
         raise NotImplementedError(
             "send pushes to the async communicator on host; runs as an "
             "eager island")

@@ -15,6 +15,7 @@ Trainer prints per-step losses; the server prints its push count.
 import json
 import os
 import sys
+import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.pop("XLA_FLAGS", None)
@@ -93,6 +94,9 @@ def main():
                           feed={"x": bx, "y": by},
                           fetch_list=[loss.name])
             losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+            # pace the loop: async staleness is unbounded, and a tight
+            # host loop can record every loss before a pull lands
+            time.sleep(0.05)
     fleet.stop_worker()  # flush + final param pull + SendComplete
     wv = fluid.global_scope().find_var("w").get_value()
     w = np.asarray(wv.array if hasattr(wv, "array") else wv)
