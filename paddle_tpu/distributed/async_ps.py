@@ -31,7 +31,8 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 __all__ = ["AsyncParameterServer", "push_grad", "pull_param",
-           "pull_params", "send_complete", "wait_server"]
+           "pull_params", "send_complete", "notify_checkpoint",
+           "wait_server"]
 
 _LEN = struct.Struct("<Q")
 
@@ -119,6 +120,17 @@ def send_complete(endpoint: str, trainer_id: int) -> None:
     _rpc(endpoint, {"t": "complete", "trainer": int(trainer_id)})
 
 
+def notify_checkpoint(endpoint: str, dirname: str) -> List[str]:
+    """Ask the pserver to snapshot its shard (reference
+    checkpoint_notify_op.cc → kRequestCheckpoint handler,
+    request_handler_impl.cc:218-227: the server runs its checkpoint
+    block over its own vars). Returns the saved var names."""
+    rep = _rpc(endpoint, {"t": "checkpoint", "dir": dirname})
+    if isinstance(rep, dict) and rep.get("err"):
+        raise RuntimeError(f"pserver {endpoint} checkpoint: {rep['err']}")
+    return rep
+
+
 class AsyncParameterServer:
     """The RunAsyncLoop event loop (reference listen_and_serv_op.cc:
     RunAsyncLoop): holds parameter (+ optimizer-state) values, applies
@@ -135,12 +147,16 @@ class AsyncParameterServer:
     def __init__(self, endpoint: str, fanin: int,
                  get_var: Callable[[str], np.ndarray],
                  apply_update: Callable[[str, np.ndarray, int], None],
-                 known_params: List[str]):
+                 known_params: List[str],
+                 checkpoint_vars: Optional[List[str]] = None):
         self.endpoint = endpoint
         self.fanin = int(fanin)
         self._get_var = get_var
         self._apply = apply_update
         self._known = list(known_params)
+        # shard snapshot covers optimizer state too (the reference
+        # pserver saves its whole shard, request_handler_impl.cc)
+        self._ckpt_vars = list(checkpoint_vars or known_params)
         self._lock = threading.Lock()
         self._completed: set = set()
         self._done = threading.Event()
@@ -172,6 +188,24 @@ class AsyncParameterServer:
                         out = {n: np.asarray(self._get_var(n))
                                for n in names}
                     _send_msg(conn, out)
+                elif t == "checkpoint":
+                    # snapshot this shard in the framework's own save
+                    # format (one file per var, io.load_vars-readable)
+                    import os
+                    d = msg["dir"]
+                    os.makedirs(d, exist_ok=True)
+                    from ..io import _serialize_tensor
+                    with self._lock:
+                        saved = []
+                        for n in self._ckpt_vars:
+                            buf: list = []
+                            _serialize_tensor(
+                                buf, n, np.asarray(self._get_var(n)))
+                            with open(os.path.join(d, n), "wb") as f:
+                                for chunk in buf:
+                                    f.write(chunk)
+                            saved.append(n)
+                    _send_msg(conn, saved)
                 elif t == "complete":
                     with self._lock:
                         self._completed.add(msg["trainer"])

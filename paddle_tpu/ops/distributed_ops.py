@@ -31,8 +31,32 @@ def _identity(ctx):
 
 
 for _t in ["send_barrier", "fetch_barrier", "prefetch",
-           "checkpoint_notify", "ref_by_trainer_id"]:
+           "ref_by_trainer_id"]:
     register_no_grad_op(_t)(_identity)
+
+
+@register_no_grad_op("checkpoint_notify")
+def checkpoint_notify(ctx):
+    """Tell each pserver to snapshot its shard under attr `dir`
+    (reference checkpoint_notify_op.cc:36-53: per-endpoint RPC, the
+    server saves its own vars). With no endpoints bound (the collective
+    transpile) it is a structure-preserving no-op; with endpoints it is
+    a host side effect — the op has no tensor operands to detect
+    tracing by, so it checks the global trace state and islands when a
+    trace is active."""
+    eps = [e for e in (ctx.attr("epmap", []) or
+                       ctx.attr("endpoints", [])) if e]
+    if not eps:
+        return _identity(ctx)
+    from jax._src.core import trace_state_clean
+    if not trace_state_clean():
+        raise NotImplementedError("checkpoint_notify RPCs on host")
+    import os as _os
+    from ..distributed import async_ps
+    d = ctx.attr("dir", "checkpoint")
+    for i, ep in enumerate(eps):
+        sub = _os.path.join(d, f"shard_{i}") if len(eps) > 1 else d
+        async_ps.notify_checkpoint(ep, sub)
 
 
 @register_no_grad_op("send")
@@ -143,7 +167,7 @@ def listen_and_serv(ctx):
         endpoint=ctx.attr("endpoint", "127.0.0.1:6174"),
         fanin=int(ctx.attr("Fanin", 1)),
         get_var=get_var, apply_update=apply_update,
-        known_params=param_names)
+        known_params=param_names, checkpoint_vars=list(names))
     pushes = srv.serve()
     # re-bind outputs so the island runner records the served vars as
     # written and persists them to the scope

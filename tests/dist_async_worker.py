@@ -33,7 +33,7 @@ from paddle_tpu.incubate.fleet.base.role_maker import (  # noqa: E402
 from paddle_tpu.incubate.fleet.parameter_server import (  # noqa: E402
     DistributeTranspilerConfig, fleet)
 
-STEPS = 30
+STEPS = 40
 
 
 def build():
@@ -62,7 +62,7 @@ def main():
 
     main_prog, startup, loss = build()
     with fluid.program_guard(main_prog, startup):
-        opt = fluid.optimizer.SGDOptimizer(0.05)
+        opt = fluid.optimizer.SGDOptimizer(0.01)
         cfg = DistributeTranspilerConfig()
         cfg.sync_mode = False
         cfg.fully_async = True
@@ -76,7 +76,7 @@ def main():
 
     # trainer: pull merges eagerly (small cluster, tight test budget)
     set_flags({"communicator_min_send_grad_num_before_recv": 2,
-               "communicator_max_merge_var_num": 4})
+               "communicator_max_merge_var_num": 2})
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(fleet.startup_program or startup)  # init + recv initial w/b
     fleet.init_worker()                        # starts the Communicator
