@@ -198,12 +198,9 @@ class AsyncParameterServer:
                     with self._lock:
                         saved = []
                         for n in self._ckpt_vars:
-                            buf: list = []
-                            _serialize_tensor(
-                                buf, n, np.asarray(self._get_var(n)))
                             with open(os.path.join(d, n), "wb") as f:
-                                for chunk in buf:
-                                    f.write(chunk)
+                                _serialize_tensor(
+                                    f, n, np.asarray(self._get_var(n)))
                             saved.append(n)
                     _send_msg(conn, saved)
                 elif t == "complete":

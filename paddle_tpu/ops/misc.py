@@ -1103,20 +1103,21 @@ def py_func(ctx):
 @register_no_grad_op("save")
 def save_op(ctx):
     """Serialize one variable to file_path (reference save_op.cc).
-    Eager-only side effect."""
+    Eager-only side effect; preserves LoD alongside the payload."""
     x = ctx.input("X")
     if isinstance(x, jax.core.Tracer):
         raise NotImplementedError("save writes the filesystem; eager "
                                   "only")
+    from ..core.scope import LoDTensor
     from ..io import _serialize_tensor
+    name = ctx.op.input("X")[0]
     path = ctx.attr("file_path")
     import os as _os
     _os.makedirs(_os.path.dirname(path) or ".", exist_ok=True)
-    buf = []
-    _serialize_tensor(buf, ctx.op.input("X")[0], np.asarray(x))
+    lod = ctx.lod_env.get(name)
+    val = LoDTensor(np.asarray(x), lod) if lod else np.asarray(x)
     with open(path, "wb") as f:
-        for chunk in buf:
-            f.write(chunk)
+        _serialize_tensor(f, name, val)
 
 
 @register_no_grad_op("load")
@@ -1124,8 +1125,8 @@ def load_op(ctx):
     from ..io import _deserialize_tensors
     path = ctx.attr("file_path")
     with open(path, "rb") as f:
-        data = f.read()
-    for name, (arr, lod) in _deserialize_tensors(data).items():
+        tensors = _deserialize_tensors(f)
+    for name, (arr, lod) in tensors.items():
         val = jnp.asarray(arr)
         if ctx.attr("load_as_fp16", False):
             val = val.astype(jnp.float16)
@@ -1140,16 +1141,16 @@ def save_combine(ctx):
     xs = ctx.inputs("X")
     if any(isinstance(v, jax.core.Tracer) for v in xs):
         raise NotImplementedError("save_combine is eager-only")
+    from ..core.scope import LoDTensor
     from ..io import _serialize_tensor
     path = ctx.attr("file_path")
     import os as _os
     _os.makedirs(_os.path.dirname(path) or ".", exist_ok=True)
-    buf = []
-    for n, v in zip(ctx.op.input("X"), xs):
-        _serialize_tensor(buf, n, np.asarray(v))
     with open(path, "wb") as f:
-        for chunk in buf:
-            f.write(chunk)
+        for n, v in zip(ctx.op.input("X"), xs):
+            lod = ctx.lod_env.get(n)
+            val = LoDTensor(np.asarray(v), lod) if lod else np.asarray(v)
+            _serialize_tensor(f, n, val)
 
 
 @register_no_grad_op("load_combine")
@@ -1157,7 +1158,7 @@ def load_combine(ctx):
     from ..io import _deserialize_tensors
     path = ctx.attr("file_path")
     with open(path, "rb") as f:
-        tensors = _deserialize_tensors(f.read())
+        tensors = _deserialize_tensors(f)
     for n in ctx.op.output("Out"):
         arr, lod = tensors[n]
         ctx.env[n] = jnp.asarray(arr)

@@ -414,7 +414,7 @@ def test_checkpoint_notify_saves_server_shard(tmp_path):
         p = os.path.join(ckpt_dir, name)
         assert os.path.exists(p), sorted(os.listdir(ckpt_dir))
         with open(p, "rb") as f:
-            got = _deserialize_tensors(f.read())
+            got = _deserialize_tensors(f)
         (arr, _lod), = got.values()
         sv = ps_scope.find_var(name).get_value()
         want = np.asarray(sv.array if hasattr(sv, "array") else sv)

@@ -544,3 +544,39 @@ def test_lod_append_keeps_existing_levels():
     assert len(lod) == 2           # existing level + appended level
     assert lod[0] == [0, 2, 4]
     assert lod[1] == [0, 1, 2, 3, 4]
+
+
+def test_standalone_save_load_ops_roundtrip(tmp_path):
+    """The raw save/load ops (reference save_op.cc/load_op.cc) used by
+    ad-hoc checkpoint programs — regression: the lowerings passed a
+    list/bytes where io's serializer wants a file object."""
+    import numpy as np
+    import paddle_tpu as fluid
+    path = str(tmp_path / "v.bin")
+    prog = fluid.Program()
+    b = prog.global_block()
+    b.create_var(name="v", shape=[3], dtype="float32",
+                 persistable=True)
+    b.append_op("save", inputs={"X": ["v"]}, outputs={},
+                attrs={"file_path": path}, infer_shape=False)
+    scope = fluid.core.Scope()
+    scope.var("v").set_value(np.arange(3, dtype=np.float32))
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with fluid.scope_guard(scope):
+            fluid.Executor(fluid.CPUPlace()).run(prog)
+
+        prog2 = fluid.Program()
+        b2 = prog2.global_block()
+        b2.create_var(name="w", shape=[3], dtype="float32",
+                      persistable=True)
+        b2.append_op("load", inputs={}, outputs={"Out": ["w"]},
+                     attrs={"file_path": path}, infer_shape=False)
+        scope2 = fluid.core.Scope()
+        scope2.var("w").set_value(np.zeros(3, np.float32))
+        with fluid.scope_guard(scope2):
+            fluid.Executor(fluid.CPUPlace()).run(prog2)
+    got = scope2.find_var("w").get_value()
+    got = np.asarray(got.array if hasattr(got, "array") else got)
+    assert np.allclose(got, [0.0, 1.0, 2.0])
