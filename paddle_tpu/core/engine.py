@@ -76,6 +76,27 @@ class TracedStep:
         return tuple(self._nan_labels_box)
 
 
+def _compiler_options():
+    """Backend compiler knobs for the compiled step, from
+    PT_COMPILER_OPTIONS="k=v,k=v" (e.g.
+    "xla_tpu_scoped_vmem_limit_kib=65536"). The reference exposed its
+    backend tuning the same way (conv_workspace_size_limit,
+    cudnn_exhaustive_search — gflags through the env); XLA_FLAGS cannot
+    carry TPU-only flags here because the CLIENT-side XLA parses them
+    and aborts on flags only the tunneled TPU compiler knows."""
+    import os
+    spec = os.environ.get("PT_COMPILER_OPTIONS", "").strip()
+    if not spec:
+        return None
+    opts = {}
+    for kv in spec.split(","):
+        if not kv.strip():
+            continue
+        k, _, v = kv.partition("=")
+        opts[k.strip()] = v.strip()
+    return opts or None
+
+
 def _collect_persistable_inputs(program, block, scope: Scope):
     """Names of persistable vars referenced by the block (params, opt state,
     LR, bn stats, ...) that must come from the scope."""
@@ -643,9 +664,11 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
                          repl)
         fn = jax.jit(step2, donate_argnums=(0,),
                      in_shardings=in_shardings,
-                     out_shardings=out_shardings)
+                     out_shardings=out_shardings,
+                     compiler_options=_compiler_options())
     else:
-        fn = jax.jit(step2, donate_argnums=(0,))
+        fn = jax.jit(step2, donate_argnums=(0,),
+                     compiler_options=_compiler_options())
     return TracedStep(fn, donated, const, sorted(feed_sig),
                       list(fetch_names), updated_names,
                       fetch_lod_box, uses_rng_box[0],

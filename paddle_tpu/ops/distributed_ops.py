@@ -143,6 +143,14 @@ def listen_and_serv(ctx):
         grad_to_block[g] = int(bid)
     param_names = list(ctx.attr("param_names", []))
 
+    # scheduled-LR chain: run ONCE at server start (reference
+    # RunAsyncLoop executes the non-grad-bound block 1 once,
+    # listen_and_serv_op.cc:258-264 — async training then holds the
+    # startup-time decayed LR)
+    lr_bid = int(ctx.attr("lr_decay_block_id", -1))
+    if lr_bid >= 0:
+        ctx.block_runner(lr_bid)
+
     def get_var(name):
         if name not in ctx.env:
             raise KeyError(f"pserver does not serve var {name!r}")

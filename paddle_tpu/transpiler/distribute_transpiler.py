@@ -164,35 +164,41 @@ class DistributeTranspiler:
             raise ValueError(
                 "fully-async transpile found no optimizer update ops; "
                 "call optimizer.minimize() before transpile()")
-        # scheduled LR would need per-arrival server-side decay blocks;
-        # honest contract: constant lr only (use StaleSyncSGD otherwise)
-        produced = {n for op in block.ops
-                    for slot in op.output_slots()
-                    for n in op.output(slot)}
+        producer = {}
+        for i, op in enumerate(block.ops):
+            for slot in op.output_slots():
+                for n in op.output(slot):
+                    producer.setdefault(n, i)
         assignments = []     # (endpoint, param, grad, op, served vars)
         dispatcher_cls = self.config.split_method or HashName
         dispatcher = dispatcher_cls(self.pserver_endpoints)
         params = [block.ops[i].input("Param")[0] for i in update_idx]
         eplist = dispatcher.dispatch(params)
+        lr_chain_idx: set = set()
+        lr_persist: set = set()
         for i, ep in zip(update_idx, eplist):
             op = block.ops[i]
             param = op.input("Param")[0]
             grad = op.input("Grad")[0]
             lr_in = op.input("LearningRate")
-            if lr_in and lr_in[0] in produced:
-                raise NotImplementedError(
-                    f"fully-async pserver mode supports constant "
-                    f"learning rates only ({lr_in[0]!r} is produced "
-                    f"in-program by a scheduler); use the bounded-"
-                    f"staleness StaleSyncSGD mapping "
-                    f"(fully_async=False) for scheduled LR")
+            if lr_in and lr_in[0] in producer:
+                # scheduled LR: collect the producing chain into the
+                # server-side lr block (reference lr_decay_block,
+                # distribute_transpiler.py:997; the async loop runs it
+                # ONCE at server start — listen_and_serv_op.cc:258-264
+                # executes the non-grad-bound block 1 once, so async
+                # training holds the startup-time decayed LR, exactly
+                # the reference semantics)
+                self._fa_collect_chain(block, lr_in[0], producer,
+                                       lr_chain_idx, lr_persist)
             served = set()
             for slot in op.input_slots():
                 for n in op.input(slot):
                     if n == grad:
                         continue
                     v = block._find_var_recursive(n)
-                    if v is not None and v.persistable:
+                    if v is not None and v.persistable and \
+                            n not in producer:
                         served.add(n)
             served.add(param)
             assignments.append((ep, param, grad, op, sorted(served)))
@@ -222,6 +228,43 @@ class DistributeTranspiler:
                 infer_shape=False)
         self._fa_assignments = assignments
         self._fa_startup = startup_program
+        self._fa_lr_ops = [block.ops[i] for i in sorted(lr_chain_idx)]
+        self._fa_lr_persist = sorted(lr_persist)
+
+    def _fa_collect_chain(self, block, var_name, producer, chain_idx,
+                          persist):
+        """Transitive producers of `var_name` within the main block
+        (the LR scheduler chain: step counter increment + decay math).
+        Leaf inputs must be persistable (startup-initialized) — a feed
+        in the chain cannot move to the server."""
+        stack = [var_name]
+        seen = set()
+        while stack:
+            n = stack.pop()
+            if n in seen:
+                continue
+            seen.add(n)
+            v = block._find_var_recursive(n)
+            if v is not None and v.persistable:
+                # startup-initialized state the server must hold (the
+                # step counter: produced in-program by its increment
+                # op AND initialized by startup)
+                persist.add(n)
+            i = producer.get(n)
+            if i is None:
+                if v is None or not v.persistable:
+                    raise NotImplementedError(
+                        f"fully-async: LR-scheduler input {n!r} is "
+                        f"neither produced in-program nor a "
+                        f"persistable var; cannot move the schedule "
+                        f"to the pserver")
+                continue
+            if i in chain_idx:
+                continue
+            chain_idx.add(i)
+            op = block.ops[i]
+            for slot in op.input_slots():
+                stack.extend(op.input(slot))
 
     def _fa_build_pserver_program(self, endpoint):
         mine = [a for a in self._fa_assignments if a[0] == endpoint]
@@ -229,14 +272,33 @@ class DistributeTranspiler:
         gb = prog.global_block()
         served_all, grads, blk_ids, pnames = [], [], [], []
         origin_block = self._origin_main.global_block()
+
+        def _declare(n, persistable):
+            if gb.has_var(n):
+                return
+            v = origin_block._find_var_recursive(n)
+            gb.create_var(name=n, shape=list(v.shape), dtype=v.dtype,
+                          persistable=persistable)
+
+        # lr block first (reference lr_decay_block is block 1; the
+        # async loop runs the non-grad-bound block once at start)
+        lr_bid = -1
+        if self._fa_lr_ops:
+            for n in self._fa_lr_persist:
+                _declare(n, True)
+                if n not in served_all:
+                    served_all.append(n)
+            lr_blk = prog._create_block(parent_idx=0)
+            for op in self._fa_lr_ops:
+                lr_blk.append_op(op.type, inputs=dict(op._inputs),
+                                 outputs=dict(op._outputs),
+                                 attrs=dict(op._attrs),
+                                 infer_shape=False)
+            prog._rollback()
+            lr_bid = lr_blk.idx
         for ep, param, grad, op, served in mine:
             for n in list(served) + [grad]:
-                if gb.has_var(n):
-                    continue
-                v = origin_block._find_var_recursive(n)
-                gb.create_var(name=n, shape=list(v.shape),
-                              dtype=v.dtype,
-                              persistable=(n != grad))
+                _declare(n, n != grad)
             sub = prog._create_block(parent_idx=0)
             sub.append_op(op.type, inputs=dict(op._inputs),
                           outputs=dict(op._outputs),
@@ -254,6 +316,7 @@ class DistributeTranspiler:
                    "grad_to_block_id": [f"{g}:{b}" for g, b in
                                         zip(grads, blk_ids)],
                    "optimize_blocks": blk_ids,
+                   "lr_decay_block_id": lr_bid,
                    "param_names": pnames}, infer_shape=False)
         return prog
 
@@ -262,7 +325,7 @@ class DistributeTranspiler:
         trainer startup (the reference splits the startup program the
         same way — each pserver initializes its own param blocks)."""
         mine = [a for a in self._fa_assignments if a[0] == endpoint]
-        served = set()
+        served = set(self._fa_lr_persist)
         for _, _, _, _, s in mine:
             served.update(s)
         prog = framework.Program()

@@ -143,23 +143,59 @@ def test_fully_async_transpile_structure():
                    for o in pst.global_block().ops)
 
 
-def test_fully_async_rejects_scheduled_lr():
+def test_fully_async_scheduled_lr_runs_on_server():
+    """Scheduled LR moves to the pserver's lr block, run ONCE at
+    server start (reference lr_decay_block + RunAsyncLoop's one-shot
+    execution of the non-grad-bound block 1,
+    listen_and_serv_op.cc:258-264)."""
+    ep = f"127.0.0.1:{_free_port()}"
     fluid.framework.unique_name.reset()
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         x = layers.data("x", [4], dtype="float32")
         y = layers.data("y", [1], dtype="float32")
-        pred = layers.fc(x, 1)
+        pred = layers.fc(x, 1, param_attr=fluid.ParamAttr(name="w"),
+                         bias_attr=False)
         loss = layers.mean(layers.square_error_cost(pred, y))
         lr = layers.exponential_decay(0.1, 100, 0.9)
         fluid.optimizer.SGDOptimizer(lr).minimize(loss)
     cfg = DistributeTranspilerConfig()
     cfg.sync_mode = False
     cfg.fully_async = True
-    with pytest.raises(NotImplementedError, match="constant learning"):
-        DistributeTranspiler(cfg).transpile(
-            0, program=main, pservers="127.0.0.1:6174", trainers=2,
-            sync_mode=False, startup_program=startup)
+    t = DistributeTranspiler(cfg)
+    t.transpile(0, program=main, pservers=ep, trainers=1,
+                sync_mode=False, startup_program=startup)
+
+    ps_main, ps_startup = t.get_pserver_programs(ep)
+    las = ps_main.global_block().ops[0]
+    lr_bid = las.attr("lr_decay_block_id")
+    assert lr_bid >= 0, "scheduled LR must get a server lr block"
+    lr_ops = [o.type for o in ps_main.block(lr_bid).ops]
+    assert "increment" in lr_ops or "scale" in lr_ops, lr_ops
+
+    ps_scope = fluid.core.Scope()
+
+    def serve():
+        import warnings
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with fluid.scope_guard(ps_scope):
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(ps_startup)
+                exe.run(ps_main)
+
+    th = threading.Thread(target=serve, daemon=True)
+    th.start()
+    async_ps.wait_server(ep)
+    w0 = np.asarray(async_ps.pull_param(ep, "w"))
+    async_ps.push_grad(ep, "w@GRAD", np.ones((4, 1), np.float32), 0)
+    w1 = np.asarray(async_ps.pull_param(ep, "w"))
+    async_ps.send_complete(ep, 0)
+    th.join(timeout=30)
+    # counter incremented once at server start -> step=1 ->
+    # lr = 0.1 * 0.9 ** (1/100)
+    want_lr = 0.1 * 0.9 ** (1.0 / 100.0)
+    assert np.allclose(w0 - w1, want_lr, rtol=1e-4), (w0 - w1, want_lr)
 
 
 # ---------------------------------------------------------------------------
