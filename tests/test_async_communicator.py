@@ -455,3 +455,91 @@ def test_checkpoint_notify_saves_server_shard(tmp_path):
         sv = ps_scope.find_var(name).get_value()
         want = np.asarray(sv.array if hasattr(sv, "array") else sv)
         assert np.allclose(arr, want)
+
+
+def test_fully_async_two_pserver_shards():
+    """Params split across TWO pservers by the (process-stable)
+    HashName dispatch; each server holds and updates only its shard
+    (reference multi-pserver slice_var_up/HashName assignment)."""
+    eps = [f"127.0.0.1:{_free_port()}", f"127.0.0.1:{_free_port()}"]
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        h = layers.fc(x, 8, param_attr=fluid.ParamAttr(name="w0"),
+                      bias_attr=fluid.ParamAttr(name="b0"))
+        pred = layers.fc(h, 1, param_attr=fluid.ParamAttr(name="w1"),
+                         bias_attr=fluid.ParamAttr(name="b1"))
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.SGDOptimizer(0.02).minimize(loss)
+    from paddle_tpu.transpiler.ps_dispatcher import RoundRobin
+    cfg = DistributeTranspilerConfig()
+    cfg.sync_mode = False
+    cfg.fully_async = True
+    cfg.split_method = RoundRobin   # deterministic 2-2 split
+    t = DistributeTranspiler(cfg)
+    t.transpile(0, program=main, pservers=",".join(eps), trainers=1,
+                sync_mode=False, startup_program=startup)
+
+    # the dispatch split the 4 params across both endpoints
+    by_ep = {}
+    for ep, param, grad, op, served in t._fa_assignments:
+        by_ep.setdefault(ep, []).append(param)
+    assert len(by_ep) == 2, by_ep
+
+    servers = []
+    for ep in eps:
+        ps_main, ps_startup = t.get_pserver_programs(ep)
+        # each shard program serves exactly its assigned params
+        las = ps_main.global_block().ops[-1]
+        assert set(las.attr("param_names")) == set(by_ep[ep])
+        ps_scope = fluid.core.Scope()
+
+        def serve(m=ps_main, st=ps_startup, sc=ps_scope):
+            # NB: pass the scope explicitly — scope_guard is a global
+            # stack, not safe across concurrent server threads
+            import warnings
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(st, scope=sc)
+                exe.run(m, scope=sc)
+
+        th = threading.Thread(target=serve, daemon=True)
+        th.start()
+        servers.append((th, ps_scope))
+    for ep in eps:
+        async_ps.wait_server(ep)
+
+    old = get_flags(["communicator_max_merge_var_num",
+                     "communicator_min_send_grad_num_before_recv"])
+    set_flags({"communicator_max_merge_var_num": 2,
+               "communicator_min_send_grad_num_before_recv": 1})
+    scope = fluid.core.Scope()
+    try:
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)    # pulls initial params from BOTH shards
+            comm = Communicator(main, scope=scope)
+            comm.start()
+            rng = np.random.RandomState(5)
+            bx = rng.rand(16, 4).astype(np.float32)
+            by = (bx.sum(1, keepdims=True) / 2).astype(np.float32)
+            import warnings
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                losses = []
+                for _ in range(20):
+                    out = exe.run(main, feed={"x": bx, "y": by},
+                                  fetch_list=[loss.name])
+                    losses.append(
+                        float(np.asarray(out[0]).reshape(-1)[0]))
+                    time.sleep(0.1)
+            comm.stop()
+    finally:
+        set_flags(old)
+    for th, _ in servers:
+        th.join(timeout=30)
+        assert not th.is_alive()
+    assert np.mean(losses[-3:]) < 0.6 * np.mean(losses[:3]), losses
