@@ -30,7 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .mesh import make_mesh
 
 __all__ = ["ShardingRules", "DistributedStrategy", "P",
-           "transformer_rules", "ctr_rules"]
+           "transformer_rules", "ctr_rules", "zero_optimizer_rules"]
 
 
 class ShardingRules:
@@ -178,3 +178,32 @@ def ctr_rules(mp_axis="mp") -> ShardingRules:
     return ShardingRules([
         (r"^(ctr_emb|ctr_wide|fm_emb|fm_first)\.w_0$", P(mp_axis, None)),
     ])
+
+
+def zero_optimizer_rules(dp_axis="dp",
+                         base: ShardingRules = None) -> ShardingRules:
+    """ZeRO-1: optimizer state sharded over the DATA axis. Matches the
+    accumulator names every optimizer in optimizer.py generates
+    (`{param}_{acc}_{n}`: moment/moment1/moment2/velocity/mean_square/
+    mean_grad/avg_squared_*/inf_norm/squared update state) and the AMP
+    master-weight copies, splitting dim 0 over `dp_axis`. XLA's SPMD
+    partitioner then computes each update on the shard that owns it and
+    gathers the replicated param — reduce-scatter + all-gather, the
+    ZeRO-1 communication pattern — while per-device optimizer-state
+    memory drops to 1/|dp|. Dims that don't divide (and [1]-shaped
+    beta-pow accumulators) legalize back to replicated, so the rules
+    are safe on any model. No reference counterpart (2019); this is
+    the TPU-idiomatic superset capability, like TP/SP.
+
+    Compose with a TP/EP rule set via `base`: accumulator rules win
+    first (state shards over dp even when its param shards over mp),
+    then the base rules apply to the params themselves."""
+    r = ShardingRules([
+        (r"_(moment|moment1|moment2|velocity|mean_square|mean_grad|"
+         r"avg_squared_grad|avg_squared_update|inf_norm|squared)_\d+$",
+         P(dp_axis)),
+        (r"\.master$", P(dp_axis)),
+    ])
+    if base is not None:
+        r._rules.extend(base._rules)
+    return r
