@@ -62,11 +62,24 @@ def _parse_ep(endpoint: str):
     return host or "127.0.0.1", int(port)
 
 
-def _rpc(endpoint: str, msg, timeout: float = 60.0):
+def _rpc(endpoint: str, msg, timeout: float = 60.0, retries: int = 3):
+    """One request/reply. Transient connection failures retry with
+    backoff (the reference gRPC client's deadline+retry,
+    grpc_client.h:176); semantics are at-least-once — a push whose
+    REPLY is lost may re-apply, same as the reference's async path."""
     host, port = _parse_ep(endpoint)
-    with socket.create_connection((host, port), timeout=timeout) as s:
-        _send_msg(s, msg)
-        return _recv_msg(s)
+    last = None
+    for attempt in range(max(1, retries)):
+        try:
+            with socket.create_connection((host, port),
+                                          timeout=timeout) as s:
+                _send_msg(s, msg)
+                return _recv_msg(s)
+        except OSError as exc:
+            last = exc
+            if attempt + 1 < retries:
+                time.sleep(0.3 * (attempt + 1))
+    raise last
 
 
 def wait_server(endpoint: str, timeout: float = 60.0,
@@ -118,6 +131,29 @@ def send_complete(endpoint: str, trainer_id: int) -> None:
     SendComplete, executor.cc:95-103): the server exits its loop once
     every trainer has completed."""
     _rpc(endpoint, {"t": "complete", "trainer": int(trainer_id)})
+
+
+def load_shard(dirname: str, names: List[str], scope) -> List[str]:
+    """Restore a pserver shard snapshot (written by the server's
+    checkpoint handler) into `scope`. Missing files fail LOUD — a
+    partial shard restore silently mixing fresh init with restored
+    state is the failure io.py's partial-checkpoint detection exists
+    to prevent."""
+    import os
+    from ..io import _deserialize_tensors
+    missing = [n for n in names
+               if not os.path.exists(os.path.join(dirname, n))]
+    if missing:
+        raise FileNotFoundError(
+            f"shard checkpoint {dirname!r} is missing vars {missing}; "
+            f"refusing a partial restore")
+    loaded = []
+    for n in names:
+        with open(os.path.join(dirname, n), "rb") as f:
+            (arr, _lod), = _deserialize_tensors(f).values()
+        scope.var(n).set_value(np.asarray(arr))
+        loaded.append(n)
+    return loaded
 
 
 def notify_checkpoint(endpoint: str, dirname: str) -> List[str]:

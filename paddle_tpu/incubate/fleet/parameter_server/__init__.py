@@ -61,6 +61,12 @@ class ParameterServerFleet(Fleet):
         collective_fleet.init_worker()
 
     def init_server(self, model_dir=None):
+        if self._fully_async():
+            # restart-from-snapshot: run_server restores the shard
+            # written by checkpoint_notify AFTER its startup program
+            # (reference pserver flow: startup then load)
+            self._fa_model_dir = model_dir
+            return
         if model_dir:
             from .... import io
             from ....executor import Executor
@@ -81,6 +87,15 @@ class ParameterServerFleet(Fleet):
             main, startup = self._transpiler.get_pserver_programs(ep)
             exe = Executor(CPUPlace())
             exe.run(startup)
+            model_dir = getattr(self, "_fa_model_dir", None)
+            if model_dir:
+                # preemption-resume: overwrite fresh init with the
+                # snapshotted shard (params + optimizer state)
+                from ....core.scope import global_scope
+                from ....distributed.async_ps import load_shard
+                las = main.global_block().ops[-1]
+                load_shard(model_dir, list(las.input("X")),
+                           global_scope())
             exe.run(main)
             return
         # the transpile folded every optimizer block into the trainer

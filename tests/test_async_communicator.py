@@ -543,3 +543,63 @@ def test_fully_async_two_pserver_shards():
         th.join(timeout=30)
         assert not th.is_alive()
     assert np.mean(losses[-3:]) < 0.6 * np.mean(losses[:3]), losses
+
+
+def test_pserver_restart_from_checkpoint():
+    """Preemption-resume for the async pserver: snapshot via
+    checkpoint_notify, kill the server, restart a fresh server from
+    the shard files (fleet.init_server(model_dir) path = startup then
+    load_shard), and verify state continuity — params AND optimizer
+    state survive (SURVEY §5: preemption-resume via checkpoint IS the
+    elastic story)."""
+    import tempfile
+    ckpt = tempfile.mkdtemp()
+    ep = f"127.0.0.1:{_free_port()}"
+    t, main, startup, loss = _build_and_transpile(n_trainers=1, ep=ep)
+    ps_main, ps_startup = t.get_pserver_programs(ep)
+
+    def serve(restore_dir=None):
+        import warnings
+        sc = fluid.core.Scope()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(ps_startup, scope=sc)
+            if restore_dir:
+                las = ps_main.global_block().ops[-1]
+                async_ps.load_shard(restore_dir,
+                                    list(las.input("X")), sc)
+            exe.run(ps_main, scope=sc)
+        return sc
+
+    # phase 1: train a bit, snapshot, server exits
+    th = threading.Thread(target=serve, daemon=True)
+    th.start()
+    async_ps.wait_server(ep)
+    async_ps.push_grad(ep, "w@GRAD", np.ones((4, 1), np.float32), 0)
+    async_ps.push_grad(ep, "b@GRAD", np.ones((1,), np.float32), 0)
+    w_snap = np.asarray(async_ps.pull_param(ep, "w"))
+    saved = async_ps.notify_checkpoint(ep, ckpt)
+    assert set(saved) >= {"w", "b"}
+    async_ps.send_complete(ep, 0)
+    th.join(timeout=30)
+    assert not th.is_alive(), "server did not exit (simulated preempt)"
+
+    # phase 2: fresh server restores the shard; state continues
+    th2 = threading.Thread(target=serve, kwargs={"restore_dir": ckpt},
+                           daemon=True)
+    th2.start()
+    async_ps.wait_server(ep)
+    w_restored = np.asarray(async_ps.pull_param(ep, "w"))
+    assert np.allclose(w_restored, w_snap), (w_restored, w_snap)
+    # and keeps training from there
+    async_ps.push_grad(ep, "w@GRAD", np.ones((4, 1), np.float32), 0)
+    w_next = np.asarray(async_ps.pull_param(ep, "w"))
+    assert np.allclose(w_snap - w_next, 0.1, rtol=1e-5)  # lr=0.1 sgd
+    async_ps.send_complete(ep, 0)
+    th2.join(timeout=30)
+
+    # partial restore fails LOUD
+    os.remove(os.path.join(ckpt, "b"))
+    with pytest.raises(FileNotFoundError, match="partial"):
+        async_ps.load_shard(ckpt, ["w", "b"], fluid.core.Scope())
