@@ -168,10 +168,78 @@ def _share_lod(op, env, lod_env):
                 lod_env[n] = lod_env[src]
 
 
+def _recompute_types():
+    """Op types to RECOMPUTE at the forward/backward boundary
+    (PT_RECOMPUTE="batch_norm,relu,elementwise_add"). The stash these
+    ops' outputs would otherwise carry fwd→bwd is re-derived behind an
+    optimization_barrier (so XLA cannot CSE it back into the original),
+    letting buffer assignment end the originals' lifetimes inside the
+    forward — the program-level analog of jax.checkpoint for a graph
+    whose backward is explicit grad ops. Trades one extra pass of
+    cheap compute for the carried bytes (the ResNet BN/relu/residual
+    chains are ~10.5 GB of a 54 GB step, BASELINE.md).
+
+    MEASURED (r5, BASELINE "remat attempt"): on ResNet-50 B=128 this
+    LOSES — 2,429 → 1,815 img/s (full list) / 1,932 (relu+residual
+    only). The barriers that keep XLA from CSE-ing the recompute away
+    also keep it from fusing the recomputed ops into their consumers,
+    so the pass materializes MORE buffers than the stash it frees. The
+    knob stays for experimentation; default off."""
+    import os
+    spec = os.environ.get("PT_RECOMPUTE", "").strip()
+    return frozenset(t for t in spec.split(",") if t) if spec else None
+
+
+def _recompute_stash(fwd_ops, bwd_ops, env, types, rng_ctx, lod_env,
+                     block_runner):
+    bwd_reads = set()
+    for op in bwd_ops:
+        for slot in op.input_slots():
+            bwd_reads.update(op.input(slot))
+    for op in fwd_ops:
+        if op.type not in types:
+            continue
+        outs = [n for slot in op.output_slots()
+                for n in op.output(slot)]
+        if not any(n in bwd_reads for n in outs):
+            continue
+        sub = dict(env)
+        for slot in op.input_slots():
+            for n in op.input(slot):
+                v = sub.get(n)
+                if v is not None and hasattr(v, "dtype"):
+                    sub[n] = jax.lax.optimization_barrier(v)
+        ctx = ExecContext(op, sub, rng_ctx, block_runner, lod_env)
+        OPS.get(op.type).lowering(ctx)
+        for n in outs:
+            # rebind ONLY bwd-consumed, non-persistable outputs; a
+            # persistable output (bn running stats) must not apply its
+            # update twice
+            var = op.block._find_var_recursive(n) \
+                if hasattr(op, "block") else None
+            if n in bwd_reads and n in sub and \
+                    (var is None or not var.persistable):
+                env[n] = sub[n]
+
+
 def run_block_ops(block, env, rng_ctx, lod_env, block_runner, ops=None):
     """Trace ops (default: all of the block) into the env (shared by
     executor + control flow sub-blocks)."""
+    recompute = _recompute_types()
+    recomputed = recompute is None
     for i, op in enumerate(block.ops if ops is None else ops):
+        if not recomputed and \
+                op.attr("op_role", "forward") == "backward":
+            recomputed = True
+            op_list = block.ops if ops is None else ops
+            try:
+                _recompute_stash(op_list[:i], op_list[i:], env,
+                                 recompute, rng_ctx, lod_env,
+                                 block_runner)
+            except Exception as exc:
+                import warnings
+                warnings.warn(f"PT_RECOMPUTE pass skipped: {exc}",
+                              stacklevel=2)
         if op.type in _ENGINE_OPS:
             # feed: value is pre-seeded into env; fetch: alias out name
             if op.type == "fetch":

@@ -295,3 +295,58 @@ def test_num_iteration_per_run_on_island_fallback():
                 exe.run(main, feed=feed, fetch_list=[loss.name])
         w_ref = np.array(scope2.find_var("wit").get_value())
     np.testing.assert_allclose(w3, w_ref, rtol=1e-5, atol=1e-6)
+
+
+def test_pt_recompute_trajectory_parity(monkeypatch):
+    """PT_RECOMPUTE re-derives the fwd stash behind optimization
+    barriers; without AMP the trajectory must be EXACT (the pass only
+    changes buffer lifetimes, not math). Measured perf story in
+    BASELINE.md ('remat attempt')."""
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    def run():
+        fluid.framework.unique_name.reset()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            img = layers.data("img", [3, 8, 8], dtype="float32")
+            lbl = layers.data("lbl", [1], dtype="int64")
+            c = layers.conv2d(img, 4, 3, padding=1, act=None)
+            b = layers.batch_norm(c, act="relu")
+            c2 = layers.conv2d(b, 4, 3, padding=1, act=None)
+            b2 = layers.batch_norm(c2)
+            s = layers.elementwise_add(b2, b, act="relu")
+            p = layers.pool2d(s, pool_type="avg", global_pooling=True)
+            fc = layers.fc(p, 10, act="softmax")
+            loss = layers.mean(layers.cross_entropy(fc, lbl))
+            fluid.optimizer.MomentumOptimizer(0.1, 0.9).minimize(loss)
+        scope = fluid.core.Scope()
+        rng = np.random.RandomState(0)
+        losses = []
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            for _ in range(3):
+                x = rng.rand(4, 3, 8, 8).astype(np.float32)
+                y = rng.randint(0, 10, (4, 1)).astype(np.int64)
+                out = exe.run(main, feed={"img": x, "lbl": y},
+                              fetch_list=[loss.name])
+                losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+        # BN running stats must update exactly once per step
+        stats = sorted(
+            n for n in scope.local_var_names() if "batch_norm" in n)
+        sums = {}
+        for n in stats:
+            v = scope.find_var(n).get_value()
+            arr = np.asarray(v.array if hasattr(v, "array") else v)
+            sums[n] = arr.astype(np.float64).sum()
+        return losses, sums
+
+    base_losses, base_sums = run()
+    monkeypatch.setenv("PT_RECOMPUTE", "batch_norm,relu,elementwise_add")
+    remat_losses, remat_sums = run()
+    np.testing.assert_allclose(base_losses, remat_losses, rtol=1e-6)
+    for n in base_sums:
+        np.testing.assert_allclose(base_sums[n], remat_sums[n],
+                                   rtol=1e-6, err_msg=n)
