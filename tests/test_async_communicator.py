@@ -605,3 +605,90 @@ def test_pserver_restart_from_checkpoint():
     os.remove(os.path.join(ckpt, "b"))
     with pytest.raises(FileNotFoundError, match="partial"):
         async_ps.load_shard(ckpt, ["w", "b"], fluid.core.Scope())
+
+
+def test_train_from_dataset_with_async_communicator(tmp_path):
+    """The reference's flagship async use-case end-to-end: a CTR-style
+    sparse model trained with Executor.train_from_dataset (the
+    DownpourWorker/DistMultiTrainer analog, trainer.h:81) while the
+    async Communicator pushes SelectedRows grads to a live pserver —
+    dataset pipeline, islands, merge queues, and the server's sparse
+    update composing in one flow."""
+    ep = f"127.0.0.1:{_free_port()}"
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = layers.data("slot0", [4], dtype="int64")
+        lbl = layers.data("click", [1], dtype="float32")
+        emb = layers.embedding(
+            ids, size=[40, 8], is_sparse=True,
+            param_attr=fluid.ParamAttr(name="ds_emb"))
+        pred = layers.reduce_sum(emb, dim=[1, 2], keep_dim=False)
+        loss = layers.mean(layers.square_error_cost(
+            layers.reshape(pred, [-1, 1]), lbl))
+        fluid.optimizer.SGDOptimizer(0.02).minimize(loss)
+    id_var = main.global_block().var("slot0")
+    lbl_var = main.global_block().var("click")
+
+    cfg = DistributeTranspilerConfig()
+    cfg.sync_mode = False
+    cfg.fully_async = True
+    t = DistributeTranspiler(cfg)
+    t.transpile(0, program=main, pservers=ep, trainers=1,
+                sync_mode=False, startup_program=startup)
+    ps_main, ps_startup = t.get_pserver_programs(ep)
+    ps_scope = fluid.core.Scope()
+
+    def serve():
+        import warnings
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(ps_startup, scope=ps_scope)
+            exe.run(ps_main, scope=ps_scope)
+
+    th = threading.Thread(target=serve, daemon=True)
+    th.start()
+    async_ps.wait_server(ep)
+
+    # MultiSlotDataFeed text file: "<len> ids... <len> label"
+    rng = np.random.RandomState(2)
+    fpath = tmp_path / "ctr.txt"
+    with open(fpath, "w") as f:
+        for _ in range(64):
+            ids_row = rng.randint(0, 40, 4)
+            f.write("4 " + " ".join(map(str, ids_row)) + " 1 1.0\n")
+
+    from paddle_tpu.reader.dataset import DatasetFactory
+    dataset = DatasetFactory().create_dataset("QueueDataset")
+    dataset.set_use_var([id_var, lbl_var])
+    dataset.set_batch_size(8)
+    dataset.set_filelist([str(fpath)])
+
+    old = get_flags(["communicator_min_send_grad_num_before_recv"])
+    set_flags({"communicator_min_send_grad_num_before_recv": 1})
+    scope = fluid.core.Scope()
+    try:
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            comm = Communicator(main, scope=scope)
+            comm.start()
+            s0 = float(np.asarray(
+                async_ps.pull_param(ep, "ds_emb")).sum())
+            import warnings
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                for _epoch in range(3):
+                    exe.train_from_dataset(program=main,
+                                           dataset=dataset)
+                    time.sleep(0.3)
+            comm.stop()
+        th.join(timeout=30)
+        # the server's table moved from init toward the target (every
+        # example wants its 4 rows to sum to 1 -> rows drift positive)
+        ev = ps_scope.find_var("ds_emb").get_value()
+        emb_final = np.asarray(ev.array if hasattr(ev, "array") else ev)
+        assert emb_final.sum() > s0 + 1.0, (emb_final.sum(), s0)
+    finally:
+        set_flags(old)
