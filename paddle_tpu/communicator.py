@@ -115,6 +115,19 @@ class Communicator:
 
     # -- threads -----------------------------------------------------------
     def _send_loop(self):
+        try:
+            self._send_loop_inner()
+        except Exception as exc:
+            # a dead send thread would silently stop all updates; fail
+            # LOUD and mark the communicator stopped so is_running()
+            # reflects reality (the reference's exception_holder role)
+            import logging
+            logging.getLogger(__name__).error(
+                "Communicator send thread died: %s — parameter "
+                "updates have STOPPED; check the pserver", exc)
+            self._running = False
+
+    def _send_loop_inner(self):
         pool = ThreadPoolExecutor(
             max_workers=max(1, int(FLAGS.communicator_thread_pool_size)))
         try:
