@@ -25,6 +25,7 @@ parameter refresh; the in-graph recv becomes a no-op.
 """
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 from concurrent.futures import ThreadPoolExecutor
@@ -38,6 +39,8 @@ from .distributed import async_ps
 from .framework import Program
 
 __all__ = ["Communicator"]
+
+_log = logging.getLogger(__name__)
 
 _running_lock = threading.Lock()
 _running: Optional["Communicator"] = None
@@ -90,6 +93,7 @@ class Communicator:
                 for pname in op.output("Out"):
                     self._recv_ctx[pname] = op.attr("endpoints", [""])[0]
         self._queues: Dict[str, queue.Queue] = {}
+        self._failed: Optional[BaseException] = None
         self._grad_num = 0
         self._grad_num_cv = threading.Condition()
         self._running = False
@@ -106,6 +110,10 @@ class Communicator:
 
     # -- producer side (called by the islanded send op) --------------------
     def send(self, grad_name: str, value) -> None:
+        if self._failed is not None:
+            raise RuntimeError(
+                "Communicator send thread died; parameter updates have "
+                "stopped") from self._failed
         q = self._queues.get(grad_name)
         if q is None:
             raise KeyError(
@@ -119,13 +127,13 @@ class Communicator:
             self._send_loop_inner()
         except Exception as exc:
             # a dead send thread would silently stop all updates; fail
-            # LOUD and mark the communicator stopped so is_running()
-            # reflects reality (the reference's exception_holder role)
-            import logging
-            logging.getLogger(__name__).error(
-                "Communicator send thread died: %s — parameter "
-                "updates have STOPPED; check the pserver", exc)
-            self._running = False
+            # LOUD at the producer instead (send() raises from now on —
+            # the reference's exception_holder role). stop() still runs
+            # so the global registry clears and completion is notified.
+            _log.exception(
+                "Communicator send thread died — parameter updates "
+                "have STOPPED; check the pserver")
+            self._failed = exc
 
     def _send_loop_inner(self):
         pool = ThreadPoolExecutor(
@@ -230,9 +238,16 @@ class Communicator:
         eps = ({c["endpoint"] for c in self._send_ctx.values()} |
                set(self._recv_ctx.values()))
         if not FLAGS.communicator_fake_rpc:
-            self._recv_all()
-            for ep in sorted(e for e in eps if e):
-                async_ps.send_complete(ep, self._trainer_id)
+            try:
+                if self._failed is None:
+                    self._recv_all()
+                for ep in sorted(e for e in eps if e):
+                    async_ps.send_complete(ep, self._trainer_id)
+            except OSError as exc:
+                # server already gone (it may be the reason the send
+                # thread died); the registry must still clear
+                _log.warning("Communicator.stop: completion notify "
+                             "failed: %s", exc)
         with _running_lock:
             if _running is self:
                 _running = None

@@ -692,3 +692,42 @@ def test_train_from_dataset_with_async_communicator(tmp_path):
         assert emb_final.sum() > s0 + 1.0, (emb_final.sum(), s0)
     finally:
         set_flags(old)
+
+
+def test_send_thread_death_fails_loud_and_stop_clears_registry():
+    """Code-review regression: a dead send thread must (a) make send()
+    raise instead of blocking forever on the full queue, and (b) leave
+    stop() able to clear the global registry so a new Communicator can
+    start in the same process."""
+    ep = f"127.0.0.1:{_free_port()}"
+    t, main, startup, loss = _build_and_transpile(n_trainers=1, ep=ep)
+    scope = fluid.core.Scope()
+    scope.var("w").set_value(np.zeros((4, 1), np.float32))
+    scope.var("b").set_value(np.zeros((1,), np.float32))
+    comm = Communicator(main, scope=scope)
+    comm.start()
+    grad = sorted(comm._send_ctx)[0]
+    # no server listening at ep -> push retries then raises -> thread
+    # records failure
+    comm.send(grad, np.ones((4, 1), np.float32))
+    deadline = time.monotonic() + 30
+    while comm._failed is None and time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert comm._failed is not None, "send thread should have died"
+    with pytest.raises(RuntimeError, match="send thread died"):
+        comm.send(grad, np.ones((4, 1), np.float32))
+    comm.stop()   # must not hang or raise; registry must clear
+    assert Communicator.get_instance() is None
+    # a fresh communicator can start now
+    comm2 = Communicator(main, scope=scope)
+    comm2.start()
+    comm2._failed = None
+    import paddle_tpu.communicator as cm
+    from paddle_tpu.core.flags import set_flags, get_flags
+    old = get_flags(["communicator_fake_rpc"])
+    set_flags({"communicator_fake_rpc": True})  # drain without a server
+    try:
+        comm2.stop()
+    finally:
+        set_flags(old)
+    assert Communicator.get_instance() is None
