@@ -110,16 +110,24 @@ class Communicator:
 
     # -- producer side (called by the islanded send op) --------------------
     def send(self, grad_name: str, value) -> None:
-        if self._failed is not None:
-            raise RuntimeError(
-                "Communicator send thread died; parameter updates have "
-                "stopped") from self._failed
         q = self._queues.get(grad_name)
         if q is None:
             raise KeyError(
                 f"send({grad_name!r}): not a transpiled grad var; known: "
                 f"{sorted(self._queues)}")
-        q.put(value)  # blocks at send_queue_size (BlockingQueue::Push)
+        # blocks at send_queue_size (BlockingQueue::Push) — but keeps
+        # re-checking for a dead send thread, which would never drain a
+        # full queue (the put must fail loud, not hang the trainer)
+        while True:
+            if self._failed is not None:
+                raise RuntimeError(
+                    "Communicator send thread died; parameter updates "
+                    "have stopped") from self._failed
+            try:
+                q.put(value, timeout=0.2)
+                return
+            except queue.Full:
+                continue
 
     # -- threads -----------------------------------------------------------
     def _send_loop(self):

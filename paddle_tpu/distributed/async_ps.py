@@ -133,6 +133,18 @@ def send_complete(endpoint: str, trainer_id: int) -> None:
     _rpc(endpoint, {"t": "complete", "trainer": int(trainer_id)})
 
 
+def resolve_shard_dir(model_dir: str, server_index: int,
+                      server_num: int) -> str:
+    """Mirror checkpoint_notify's layout (ops/distributed_ops.py): one
+    server snapshots into `model_dir` itself; multiple servers into
+    `model_dir/shard_{i}` keyed by their position in the endpoint
+    list."""
+    import os
+    if server_num > 1:
+        return os.path.join(model_dir, f"shard_{server_index}")
+    return model_dir
+
+
 def load_shard(dirname: str, names: List[str], scope) -> List[str]:
     """Restore a pserver shard snapshot (written by the server's
     checkpoint handler) into `scope`. Missing files fail LOUD — a

