@@ -722,7 +722,6 @@ def test_send_thread_death_fails_loud_and_stop_clears_registry():
     comm2 = Communicator(main, scope=scope)
     comm2.start()
     comm2._failed = None
-    import paddle_tpu.communicator as cm
     from paddle_tpu.core.flags import set_flags, get_flags
     old = get_flags(["communicator_fake_rpc"])
     set_flags({"communicator_fake_rpc": True})  # drain without a server
