@@ -30,7 +30,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .mesh import make_mesh
 
 __all__ = ["ShardingRules", "DistributedStrategy", "P",
-           "transformer_rules", "ctr_rules", "zero_optimizer_rules"]
+           "transformer_rules", "ctr_rules", "zero_optimizer_rules",
+           "fsdp_rules"]
 
 
 class ShardingRules:
@@ -177,6 +178,21 @@ def ctr_rules(mp_axis="mp") -> ShardingRules:
     sharded distributed lookup table, SURVEY §2.3 parameter prefetch)."""
     return ShardingRules([
         (r"^(ctr_emb|ctr_wide|fm_emb|fm_first)\.w_0$", P(mp_axis, None)),
+    ])
+
+
+def fsdp_rules(dp_axis="dp") -> ShardingRules:
+    """FSDP / ZeRO-3 via GSPMD: PARAMETERS shard dim 0 over the data
+    axis (XLA all-gathers each weight where its matmul needs it and
+    reduce-scatters the grad — the FSDP communication schedule,
+    scheduled by the compiler instead of hooks); optimizer accumulators
+    inherit their param's spec automatically (_ACC_RE), so the whole
+    (param + state) footprint drops to 1/|dp| per device. Dims that
+    don't divide legalize back to replicated. Like ZeRO-1/TP/SP this
+    has no reference counterpart (2019) — superset capability."""
+    return ShardingRules([
+        (r"\.(w|b)_\d+$", P(dp_axis)),
+        (r"\.master$", P(dp_axis)),
     ])
 
 
