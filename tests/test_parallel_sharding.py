@@ -102,18 +102,21 @@ def test_ep_embedding_sharded_ctr():
     np.testing.assert_allclose(single, sharded, rtol=2e-4, atol=2e-5)
 
 
-def _build_adam_mlp():
+def _build_adam_mlp(named_params=True):
+    # named_params=False keeps the default fc_0.w_0-style names the
+    # standard rule sets key on
     fluid.framework.unique_name.reset()
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         from paddle_tpu import layers
         x = layers.data("x", [16], dtype="float32")
         y = layers.data("y", [1], dtype="float32")
-        h = layers.fc(x, 32, act="relu",
-                      param_attr=fluid.ParamAttr(name="z_w0"),
-                      bias_attr=fluid.ParamAttr(name="z_b0"))
-        pred = layers.fc(h, 1, param_attr=fluid.ParamAttr(name="z_w1"),
-                         bias_attr=fluid.ParamAttr(name="z_b1"))
+        pa = (lambda n: fluid.ParamAttr(name=n)) if named_params \
+            else (lambda n: None)
+        h = layers.fc(x, 32, act="relu", param_attr=pa("z_w0"),
+                      bias_attr=pa("z_b0"))
+        pred = layers.fc(h, 1, param_attr=pa("z_w1"),
+                         bias_attr=pa("z_b1"))
         cost = layers.mean(layers.square_error_cost(pred, y))
         fluid.optimizer.AdamOptimizer(0.01).minimize(cost)
     return main, startup, cost
@@ -173,28 +176,12 @@ def test_zero1_composes_with_tp():
     np.testing.assert_allclose(single, sharded, rtol=2e-4, atol=2e-5)
 
 
-def _build_adam_mlp_autonames():
-    # default param names (fc_0.w_0 ...) — the naming convention the
-    # standard rule sets key on
-    fluid.framework.unique_name.reset()
-    main, startup = fluid.Program(), fluid.Program()
-    with fluid.program_guard(main, startup):
-        from paddle_tpu import layers
-        x = layers.data("x", [16], dtype="float32")
-        y = layers.data("y", [1], dtype="float32")
-        h = layers.fc(x, 32, act="relu")
-        pred = layers.fc(h, 1)
-        cost = layers.mean(layers.square_error_cost(pred, y))
-        fluid.optimizer.AdamOptimizer(0.01).minimize(cost)
-    return main, startup, cost
-
-
 def test_fsdp_param_sharding():
     """FSDP/ZeRO-3 rules: params AND their optimizer state live 1/|dp|
     per device; the trajectory matches the replicated run (XLA
     all-gathers weights / reduce-scatters grads under the hood)."""
     from paddle_tpu.parallel.strategy import fsdp_rules
-    main, startup, cost = _build_adam_mlp_autonames()
+    main, startup, cost = _build_adam_mlp(named_params=False)
     rng = np.random.default_rng(0)
     batches = [{"x": rng.normal(size=(8, 16)).astype(np.float32),
                 "y": rng.normal(size=(8, 1)).astype(np.float32)}
@@ -219,6 +206,7 @@ def test_fsdp_param_sharding():
         # ...and its Adam moment inherits the same sharding
         names = [n for n in scope.local_var_names()
                  if "moment1" in n and n.startswith("fc_0.w_0")]
+        assert names, sorted(scope.local_var_names())
         m = scope.find_var(names[0]).get_value()
         marr = m.array if hasattr(m, "array") else m
         assert tuple(marr.sharding.spec)[:1] == ("dp",), marr.sharding
