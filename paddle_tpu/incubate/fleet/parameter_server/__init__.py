@@ -4,15 +4,22 @@ parameter_server/distribute_transpiler/__init__.py + pslib/).
 North-star design ("pserver-to-collective transpile",
 transpiler/distribute_transpiler.py): the pserver-era API surface is
 preserved — init(role), distributed_optimizer(opt, config).minimize,
-init_server/run_server/init_worker/stop_worker — but pserver programs
-never run an RPC loop on TPU. minimize() runs DistributeTranspiler
-(which folds the parameter exchange into XLA collectives over the
-mesh), so:
+init_server/run_server/init_worker/stop_worker — and by default pserver
+programs never run an RPC loop on TPU: minimize() runs
+DistributeTranspiler (which folds the parameter exchange into XLA
+collectives over the mesh), so
 
 * TRAINER processes execute the transpiled trainer program under SPMD;
-* the SERVER role is a no-op (`run_server` logs and returns instead of
-  blocking on gRPC — there is nothing left to serve);
+* the SERVER role is a no-op (`run_server` logs and returns — there is
+  nothing left to serve);
 * sparse tables ride the SelectedRows + sharded-embedding path.
+
+EXCEPT in fully-async mode (strategy.fully_async=True,
+sync_mode=False): then `run_server` serves a REAL listen_and_serv
+event loop applying per-param optimize sub-blocks on every grad
+arrival, `init_worker` starts the async Communicator, `init_server
+(model_dir)` restores a checkpoint shard, and `stop_worker` flushes +
+notifies completion (reference communicator.h:160-192 semantics).
 """
 from __future__ import annotations
 

@@ -18,6 +18,11 @@ collectives over ICI/DCN). So:
   get_pserver_program() returns a minimal no-op listen program so
   existing launcher scripts that spawn pservers keep working (the
   pservers idle; trainers do collective training).
+* config.fully_async=True + sync_mode=False: the reference's
+  UNBOUNDED-staleness async pserver mode survives whole — update ops
+  (and any LR-scheduler chain) move to REAL pserver event loops served
+  through Executor.run, trainers exchange via the async Communicator
+  (docs/PARALLELISM.md "Fully-async parameter server").
 """
 from __future__ import annotations
 
