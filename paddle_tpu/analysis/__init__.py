@@ -1,0 +1,24 @@
+"""Static analysis over the Program IR (the Python analog of the
+reference's ``framework/ir`` + ``inference/analysis`` verification
+layer). See ``passes.py`` for the pass pipeline, ``validate.py`` for
+the flag-gated executor hook, and ``tools/lint_program.py`` for the
+CLI front-end.
+"""
+from .diagnostics import (Diagnostic, Severity, format_report, has_errors,
+                          max_severity, split_by_severity)
+from .def_use import DefUseGraph, Site, sub_block_indices
+from .passes import (AnalysisContext, COLLECTIVE_OP_TYPES, analysis_passes,
+                     analyze_program, analyze_shard_programs,
+                     check_collective_ordering, register_analysis_pass)
+from .validate import (clear_validation_cache, validate_cached,
+                       validate_program)
+
+__all__ = [
+    "Diagnostic", "Severity", "format_report", "has_errors",
+    "max_severity", "split_by_severity",
+    "DefUseGraph", "Site", "sub_block_indices",
+    "AnalysisContext", "COLLECTIVE_OP_TYPES", "analysis_passes",
+    "analyze_program", "analyze_shard_programs",
+    "check_collective_ordering", "register_analysis_pass",
+    "clear_validation_cache", "validate_cached", "validate_program",
+]

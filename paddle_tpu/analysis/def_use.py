@@ -1,0 +1,136 @@
+"""Def-use graph over the Program IR.
+
+Walks every block (including control-flow sub-blocks referenced through
+block attrs) and records, per variable name, the ordered def sites (op
+outputs) and use sites (op inputs), each keyed by (block_idx, op_idx,
+slot) plus the op's program-unique uid. This is the substrate the
+analysis passes share — the Python analog of the reference's
+``framework/ir`` Graph with its var->op edges (graph.h: Node in/out
+links), built once per analysis run instead of materializing IR nodes.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..core.registry import OP_UID_ATTR
+from ..framework import Block, Operator, Program, _BlockRef
+
+# ops the engine interprets itself; their holder-var slots ("feed"
+# minibatch / "fetch" list) are runtime plumbing, not dataflow
+ENGINE_OPS = frozenset({"feed", "fetch"})
+
+# op families whose sub-block bodies may execute repeatedly, so a read
+# inside the body can legally see a def from a *later* op of the same
+# body (loop-carried dependence)
+LOOP_OPS = frozenset({"while", "while_grad", "recurrent",
+                      "recurrent_grad", "dynamic_rnn"})
+
+
+class Site:
+    """One def or use of a variable name."""
+
+    __slots__ = ("block_idx", "op_idx", "slot", "op")
+
+    def __init__(self, block_idx: int, op_idx: int, slot: str,
+                 op: Operator):
+        self.block_idx = block_idx
+        self.op_idx = op_idx
+        self.slot = slot
+        self.op = op
+
+    @property
+    def op_type(self) -> str:
+        return self.op.type
+
+    @property
+    def op_uid(self):
+        return self.op.attr(OP_UID_ATTR, None)
+
+    def __repr__(self):
+        return (f"Site(b{self.block_idx}/op{self.op_idx} "
+                f"{self.op.type}.{self.slot})")
+
+
+def sub_block_indices(op: Operator) -> List[int]:
+    """Block indices referenced by this op's attrs (sub_block et al.),
+    handling live Block objects, deserialized _BlockRef, and raw ints
+    stored under *block* attr names."""
+    idxs = []
+    for name, val in op._all_attrs():
+        if isinstance(val, (Block, _BlockRef)):
+            idxs.append(int(val.idx))
+        elif isinstance(val, list) and val and \
+                all(isinstance(v, (Block, _BlockRef)) for v in val):
+            idxs.extend(int(v.idx) for v in val)
+        elif isinstance(val, int) and name.endswith("block_id") and \
+                val >= 0:
+            idxs.append(val)
+    return idxs
+
+
+class DefUseGraph:
+    """defs/uses per var name + sub-block ownership map."""
+
+    def __init__(self, program: Program):
+        self.program = program
+        self.defs: Dict[str, List[Site]] = {}
+        self.uses: Dict[str, List[Site]] = {}
+        # sub-block idx -> (owner_block_idx, owner_op_idx)
+        self.owner: Dict[int, Tuple[int, int]] = {}
+        self._build()
+
+    def _build(self):
+        for block in self.program.blocks:
+            for op_idx, op in enumerate(block.ops):
+                for sub in sub_block_indices(op):
+                    self.owner.setdefault(sub, (block.idx, op_idx))
+                if op.type in ENGINE_OPS:
+                    # feed defines its outputs, fetch uses its inputs;
+                    # the holder vars on the other side are plumbing
+                    if op.type == "feed":
+                        self._record(self.defs, block, op_idx, op,
+                                     op.output_slots(), op.output)
+                    else:
+                        self._record(self.uses, block, op_idx, op,
+                                     op.input_slots(), op.input)
+                    continue
+                self._record(self.uses, block, op_idx, op,
+                             op.input_slots(), op.input)
+                self._record(self.defs, block, op_idx, op,
+                             op.output_slots(), op.output)
+
+    def _record(self, table, block, op_idx, op, slots, getter):
+        for slot in slots:
+            for name in getter(slot):
+                if not name:   # "" = pruned grad output
+                    continue
+                table.setdefault(name, []).append(
+                    Site(block.idx, op_idx, slot, op))
+
+    # -- queries -----------------------------------------------------------
+    def defined_names(self):
+        return set(self.defs)
+
+    def used_names(self):
+        return set(self.uses)
+
+    def def_sites(self, name: str) -> List[Site]:
+        return self.defs.get(name, [])
+
+    def use_sites(self, name: str) -> List[Site]:
+        return self.uses.get(name, [])
+
+    def find_var(self, block_idx: int, name: str):
+        """Resolve `name` through the block's scope chain (None if the
+        program has no VarDesc for it anywhere on the chain)."""
+        return self.program.block(block_idx)._find_var_recursive(name)
+
+    def is_loop_body(self, block_idx: int) -> bool:
+        """True when the block is the body of a loop-family op (its ops
+        may see loop-carried defs)."""
+        ref = self.owner.get(block_idx)
+        if ref is None:
+            return False
+        owner_block, owner_op = ref
+        return self.program.block(owner_block).ops[owner_op].type \
+            in LOOP_OPS

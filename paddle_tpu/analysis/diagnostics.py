@@ -1,0 +1,104 @@
+"""Diagnostic model for the Program static analyzer.
+
+Parity: the reference's C++ analysis layer reports graph defects through
+``PADDLE_ENFORCE`` strings scattered across ``framework/ir`` passes and
+``inference/analysis``; this build gives them a first-class, structured
+shape — severity, originating pass, op type, variable names, and a
+(block, op) location — so the executor's flag-gated validator, the
+``tools/lint_program.py`` CLI, and tests all consume the same objects.
+"""
+from __future__ import annotations
+
+import enum
+from typing import List, Optional, Sequence, Tuple
+
+
+class Severity(enum.IntEnum):
+    """Ordered so max()/comparisons work: ERROR dominates."""
+
+    INFO = 0
+    WARNING = 1
+    ERROR = 2
+
+    def __str__(self):
+        return self.name
+
+
+class Diagnostic:
+    """One finding: what is wrong, where, and how bad.
+
+    ``block_idx``/``op_idx`` locate the offending op inside the Program
+    (op_idx is the position within its block's op list; -1 means the
+    finding is not tied to a single op, e.g. a missing fetch target).
+    """
+
+    __slots__ = ("severity", "pass_name", "message", "op_type",
+                 "var_names", "block_idx", "op_idx", "program_label")
+
+    def __init__(self, severity: Severity, pass_name: str, message: str,
+                 op_type: Optional[str] = None,
+                 var_names: Sequence[str] = (),
+                 block_idx: int = 0, op_idx: int = -1,
+                 program_label: str = ""):
+        self.severity = Severity(severity)
+        self.pass_name = pass_name
+        self.message = message
+        self.op_type = op_type
+        self.var_names = tuple(var_names)
+        self.block_idx = int(block_idx)
+        self.op_idx = int(op_idx)
+        # which program the finding belongs to when analyzing a set of
+        # shard programs ("shard 1"); empty for single-program analysis
+        self.program_label = program_label
+
+    @property
+    def is_error(self) -> bool:
+        return self.severity >= Severity.ERROR
+
+    def location(self) -> str:
+        where = f"block {self.block_idx}"
+        if self.op_idx >= 0:
+            where += f", op #{self.op_idx}"
+        if self.op_type:
+            where += f" '{self.op_type}'"
+        if self.program_label:
+            where = f"{self.program_label}: " + where
+        return where
+
+    def __str__(self):
+        parts = [f"[{self.severity}]", f"{self.pass_name}:", self.message,
+                 f"({self.location()}"]
+        if self.var_names:
+            parts[-1] += f"; vars: {', '.join(self.var_names)}"
+        parts[-1] += ")"
+        return " ".join(parts)
+
+    __repr__ = __str__
+
+
+def max_severity(diags: Sequence[Diagnostic]) -> Optional[Severity]:
+    return max((d.severity for d in diags), default=None)
+
+
+def has_errors(diags: Sequence[Diagnostic]) -> bool:
+    return any(d.is_error for d in diags)
+
+
+def split_by_severity(diags: Sequence[Diagnostic]) -> Tuple[
+        List[Diagnostic], List[Diagnostic], List[Diagnostic]]:
+    """(errors, warnings, infos) in stable order."""
+    errors = [d for d in diags if d.severity == Severity.ERROR]
+    warnings = [d for d in diags if d.severity == Severity.WARNING]
+    infos = [d for d in diags if d.severity == Severity.INFO]
+    return errors, warnings, infos
+
+
+def format_report(diags: Sequence[Diagnostic],
+                  header: str = "program analysis") -> str:
+    """Human-readable multi-line report (CLI + EnforceNotMet body)."""
+    errors, warnings, infos = split_by_severity(diags)
+    lines = [f"{header}: {len(errors)} error(s), {len(warnings)} "
+             f"warning(s), {len(infos)} info"]
+    for d in list(errors) + list(warnings) + list(infos):
+        lines.append("  " + str(d))
+    return "\n".join(lines)

@@ -1,0 +1,543 @@
+"""Verification passes over the Program IR.
+
+The reference validates graphs in C++ before execution — ``framework/ir``
+passes walk the Graph and PADDLE_ENFORCE structural invariants, and
+``inference/analysis`` re-checks fed/fetched reachability. This build
+compiles a Program straight to one XLA executable, so a malformed program
+otherwise surfaces as an opaque JAX tracer error (or a silent multi-host
+hang for collective divergence). These passes restore that verification
+layer at the Python level:
+
+* ``def-use``       — undefined or dangling (read-before-write) reads;
+* ``liveness``      — write-after-write shadowing and dead outputs;
+* ``shape-dtype``   — per-op shape/dtype inference (via jax.eval_shape of
+                      the registered lowering, the same single source of
+                      truth build-time inference uses) with mismatch
+                      diagnostics for the common op families
+                      (ops/basic.py, ops/matmul.py, ops/elementwise.py,
+                      ops/nn.py), plus unregistered-op detection;
+* ``fetch``         — every fetch target must be computable;
+* cross-program ``check_collective_ordering`` — compares the collective
+  op sequence across transpiled shard programs and flags deadlock-shaped
+  divergence (the reference relies on NCCL ring order being identical on
+  every rank; a shuffled shard hangs the ring).
+
+Passes register through ``register_analysis_pass`` and run via
+``analyze_program`` / ``analyze_shard_programs``.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, List, Optional, Sequence
+
+import jax
+
+from ..framework import Program, _DYN_SENTINEL
+from ..core.registry import OPS, ExecContext
+from ..core.types import convert_dtype, dtype_to_np, dtype_to_str
+from .def_use import DefUseGraph, ENGINE_OPS, sub_block_indices
+from .diagnostics import Diagnostic, Severity
+
+__all__ = ["register_analysis_pass", "analysis_passes", "analyze_program",
+           "analyze_shard_programs", "check_collective_ordering",
+           "AnalysisContext", "COLLECTIVE_OP_TYPES"]
+
+
+# ---------------------------------------------------------------------------
+# pass registry
+# ---------------------------------------------------------------------------
+
+_PASSES: Dict[str, Callable] = {}
+
+
+def register_analysis_pass(name: str):
+    """Register ``fn(ctx) -> List[Diagnostic]`` under `name` (the analog
+    of the reference's ``REGISTER_PASS`` macro, pass.h:195)."""
+    def deco(fn):
+        if name in _PASSES:
+            raise ValueError(f"analysis pass {name!r} registered twice")
+        _PASSES[name] = fn
+        fn.pass_name = name
+        return fn
+    return deco
+
+
+def analysis_passes() -> List[str]:
+    return list(_PASSES)
+
+
+class AnalysisContext:
+    """Shared state handed to every pass."""
+
+    def __init__(self, program: Program, feed_names=None, fetch_names=(),
+                 label: str = ""):
+        self.program = program
+        self.graph = DefUseGraph(program)
+        # None = feeds unknown (infer data-like vars); a set = strict
+        self.feed_names = None if feed_names is None else set(feed_names)
+        self.fetch_names = tuple(fetch_names)
+        self.label = label
+
+    def diag(self, severity, pass_name, message, op=None, block_idx=0,
+             op_idx=-1, var_names=()):
+        return Diagnostic(
+            severity, pass_name, message,
+            op_type=op.type if op is not None else None,
+            var_names=var_names, block_idx=block_idx, op_idx=op_idx,
+            program_label=self.label)
+
+
+def analyze_program(program: Program, feed_names=None, fetch_names=(),
+                    passes: Optional[Sequence[str]] = None,
+                    label: str = "") -> List[Diagnostic]:
+    """Run the registered single-program passes and return diagnostics.
+
+    ``feed_names=None`` means the caller does not know the feed set
+    (CLI over a serialized program): data-like vars (non-persistable,
+    stop_gradient, read before any write in the global block) are then
+    presumed to be feeds instead of dangling reads.
+    """
+    ctx = AnalysisContext(program, feed_names, fetch_names, label)
+    diags: List[Diagnostic] = []
+    for name in (passes if passes is not None else _PASSES):
+        try:
+            fn = _PASSES[name]
+        except KeyError:
+            raise ValueError(
+                f"unknown analysis pass {name!r}; registered: "
+                f"{analysis_passes()}") from None
+        diags.extend(fn(ctx))
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# def-use: undefined / dangling reads
+# ---------------------------------------------------------------------------
+
+def _is_presumed_feed(ctx: AnalysisContext, var, name: str) -> bool:
+    if ctx.feed_names is not None:
+        return name in ctx.feed_names
+    if var is None:
+        return False
+    # is_data does not survive a proto round-trip; stop_gradient does,
+    # and layers.data is the only builder that sets it on a
+    # non-persistable global-block var with no producer
+    return bool(getattr(var, "is_data", False)) or \
+        (var.stop_gradient and not var.persistable)
+
+
+@register_analysis_pass("def-use")
+def _check_def_use(ctx: AnalysisContext) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    g = ctx.graph
+    prog = ctx.program
+
+    def walk(block_idx: int, defined: set):
+        block = prog.block(block_idx)
+        if g.is_loop_body(block_idx):
+            # loop-carried defs: a body read may see a later body write
+            for op in block.ops:
+                for slot in op.output_slots():
+                    defined.update(n for n in op.output(slot) if n)
+        for op_idx, op in enumerate(block.ops):
+            if op.type == "feed":
+                for slot in op.output_slots():
+                    defined.update(n for n in op.output(slot) if n)
+                continue
+            for slot in op.input_slots():
+                for name in op.input(slot):
+                    if not name or name in defined:
+                        continue
+                    var = block._find_var_recursive(name)
+                    if var is not None and var.persistable:
+                        defined.add(name)
+                        continue
+                    if _is_presumed_feed(ctx, var, name):
+                        defined.add(name)
+                        continue
+                    if var is None and not g.def_sites(name):
+                        msg = (f"op reads {name!r} which is neither "
+                               f"defined by any op nor declared as a "
+                               f"variable")
+                    elif g.def_sites(name):
+                        msg = (f"dangling read: {name!r} is read before "
+                               f"any op writes it")
+                    else:
+                        msg = (f"dangling read: {name!r} is never "
+                               f"written (not persistable, not a feed)")
+                    diags.append(ctx.diag(
+                        Severity.ERROR, "def-use", msg, op=op,
+                        block_idx=block_idx, op_idx=op_idx,
+                        var_names=(name,)))
+                    defined.add(name)   # one diagnostic per name/site
+            for sub in sub_block_indices(op):
+                if 0 <= sub < prog.num_blocks and sub != block_idx:
+                    walk(sub, defined)
+            if op.type != "fetch":
+                for slot in op.output_slots():
+                    defined.update(n for n in op.output(slot) if n)
+
+    walk(0, set())
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# liveness: write-after-write + dead outputs
+# ---------------------------------------------------------------------------
+
+# structural / side-effectful ops whose outputs legitimately go unread
+_DEAD_OUTPUT_EXEMPT = frozenset({
+    "feed", "fetch", "send", "recv", "send_barrier", "fetch_barrier",
+    "listen_and_serv", "checkpoint_notify", "prefetch",
+    "c_gen_nccl_id", "c_comm_init", "gen_nccl_id",
+    "c_sync_calc_stream", "c_sync_comm_stream", "c_wait_comm",
+    "c_wait_compute", "while", "while_grad", "conditional_block",
+    "conditional_block_grad", "recurrent", "recurrent_grad",
+})
+# slot names that are markers, not data ("2"-suffixed reshape family)
+_MARKER_SLOTS = frozenset({"XShape"})
+
+
+def _exempt_slots(op_type: str) -> frozenset:
+    if not OPS.has(op_type):
+        return frozenset()
+    info = OPS.get(op_type)
+    return info.intermediate_outputs | info.stateful_outputs
+
+
+@register_analysis_pass("liveness")
+def _check_liveness(ctx: AnalysisContext) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    g = ctx.graph
+    fetched = set(ctx.fetch_names)
+
+    for name, dsites in g.defs.items():
+        usites = g.use_sites(name)
+        var = g.find_var(dsites[-1].block_idx, name)
+        persistable = var is not None and var.persistable
+
+        # -- write-after-write (same block, no intervening read) ----------
+        cross_block_uses = any(u.block_idx != dsites[0].block_idx
+                               for u in usites)
+        for a, b in zip(dsites, dsites[1:]):
+            if a.block_idx != b.block_idx or a.op_idx == b.op_idx:
+                continue
+            if g.is_loop_body(a.block_idx) or cross_block_uses:
+                continue   # loop-carried or sub-block reads: can't order
+            read_between = any(
+                u.block_idx == a.block_idx and
+                a.op_idx < u.op_idx <= b.op_idx for u in usites)
+            if read_between:
+                continue
+            diags.append(ctx.diag(
+                Severity.WARNING, "liveness",
+                f"write-after-write: {name!r} written by op "
+                f"#{a.op_idx} '{a.op_type}' is overwritten by op "
+                f"#{b.op_idx} '{b.op_type}' without being read",
+                op=b.op, block_idx=b.block_idx, op_idx=b.op_idx,
+                var_names=(name,)))
+
+        # -- dead output --------------------------------------------------
+        if usites or persistable or name in fetched:
+            continue
+        last = dsites[-1]
+        if last.op_type in _DEAD_OUTPUT_EXEMPT:
+            continue
+        if last.slot in _MARKER_SLOTS or \
+                last.slot in _exempt_slots(last.op_type):
+            continue
+        if ctx.feed_names is not None and name in ctx.feed_names:
+            continue
+        diags.append(ctx.diag(
+            Severity.WARNING, "liveness",
+            f"dead output: {name!r} (slot {last.slot}) is written but "
+            f"never read, fetched, or persisted",
+            op=last.op, block_idx=last.block_idx, op_idx=last.op_idx,
+            var_names=(name,)))
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# shape/dtype inference checking
+# ---------------------------------------------------------------------------
+
+# the op families the analyzer fully vouches for: abstract-eval failure
+# on one of these IS a program defect, not a host-only lowering
+_CHECKED_FAMILIES = frozenset({
+    "paddle_tpu.ops.basic", "paddle_tpu.ops.matmul",
+    "paddle_tpu.ops.elementwise", "paddle_tpu.ops.nn",
+})
+# host-side / data-dependent lowerings inside those modules that cannot
+# run under jax.eval_shape by design
+_ABSTRACT_EVAL_EXEMPT = frozenset({"range", "linspace", "where"})
+# binary families the reference requires dtype agreement for
+_SAME_DTYPE_BINARY = frozenset({
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "elementwise_pow", "elementwise_min",
+    "elementwise_max", "elementwise_mod", "elementwise_floordiv",
+    "matmul", "mul",
+})
+
+
+def _abstract_inputs(op, block):
+    """var name -> ShapeDtypeStruct for every input, or None when an
+    input var is unresolvable (the def-use pass owns that report)."""
+    env = {}
+    for slot in op.input_slots():
+        for name in op.input(slot):
+            if not name or name in env:
+                continue
+            v = block._find_var_recursive(name)
+            if v is None:
+                return None
+            shape = tuple(_DYN_SENTINEL if d == -1 else int(d)
+                          for d in v.shape)
+            env[name] = jax.ShapeDtypeStruct(shape, dtype_to_np(v.dtype))
+    return env
+
+
+def _from_sentinel(shape):
+    return tuple(-1 if (d >= _DYN_SENTINEL and d % _DYN_SENTINEL == 0)
+                 else int(d) for d in shape)
+
+
+def _shapes_compatible(declared, inferred) -> bool:
+    if len(declared) != len(inferred):
+        return False
+    return all(d == -1 or i == -1 or d == i
+               for d, i in zip(declared, inferred))
+
+
+@register_analysis_pass("shape-dtype")
+def _check_shape_dtype(ctx: AnalysisContext) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    for block in ctx.program.blocks:
+        for op_idx, op in enumerate(block.ops):
+            if op.type in ENGINE_OPS:
+                continue
+            if not OPS.has(op.type):
+                diags.append(ctx.diag(
+                    Severity.ERROR, "shape-dtype",
+                    f"op type {op.type!r} is not registered; the "
+                    f"engine cannot lower it", op=op,
+                    block_idx=block.idx, op_idx=op_idx))
+                continue
+            info = OPS.get(op.type)
+            if info.is_grad_op or op.type in _ABSTRACT_EVAL_EXEMPT:
+                continue
+            family = getattr(info.lowering, "__module__", "")
+            if family not in _CHECKED_FAMILIES:
+                continue
+            if op.type == "top_k" and op.input("K"):
+                continue   # K is a host scalar: data-dependent shape
+            diags.extend(_check_one_op(ctx, block, op_idx, op, info))
+    return diags
+
+
+def _check_one_op(ctx, block, op_idx, op, info) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+
+    # dtype agreement for the binary compute families (reference
+    # kernels dispatch on one dtype; silent promotion hides bugs)
+    if op.type in _SAME_DTYPE_BINARY:
+        xs, ys = op.input("X"), op.input("Y")
+        if xs and ys:
+            vx = block._find_var_recursive(xs[0])
+            vy = block._find_var_recursive(ys[0])
+            if vx is not None and vy is not None and \
+                    vx.dtype != vy.dtype:
+                diags.append(ctx.diag(
+                    Severity.ERROR, "shape-dtype",
+                    f"dtype mismatch between inputs: "
+                    f"{xs[0]!r} is {dtype_to_str(vx.dtype)} but "
+                    f"{ys[0]!r} is {dtype_to_str(vy.dtype)}",
+                    op=op, block_idx=block.idx, op_idx=op_idx,
+                    var_names=(xs[0], ys[0])))
+                return diags
+
+    env = _abstract_inputs(op, block)
+    if env is None:
+        return diags   # unresolvable input: def-use pass reports it
+    out_names = [n for slot in op.output_slots() for n in op.output(slot)
+                 if n]
+
+    def _run(abstract_env):
+        local = dict(abstract_env)
+        ectx = ExecContext(op, local, rng_ctx=None, block_runner=None)
+        info.lowering(ectx)
+        return [local.get(n) for n in out_names]
+
+    try:
+        outs = jax.eval_shape(_run, env)
+    except Exception as exc:
+        msg = str(exc).split("\n")[0][:200]
+        diags.append(ctx.diag(
+            Severity.ERROR, "shape-dtype",
+            f"shape/dtype inference failed: the lowering rejects the "
+            f"declared operand shapes/dtypes ({msg})",
+            op=op, block_idx=block.idx, op_idx=op_idx,
+            var_names=tuple(op.input_arg_names)))
+        return diags
+
+    for name, aval in zip(out_names, outs):
+        if aval is None:
+            continue
+        v = block._find_var_recursive(name)
+        if v is None or not v.shape:
+            continue   # undeclared shape: nothing to cross-check
+        inferred_shape = _from_sentinel(aval.shape)
+        declared = tuple(v.shape)
+        if not _shapes_compatible(declared, inferred_shape):
+            diags.append(ctx.diag(
+                Severity.ERROR, "shape-dtype",
+                f"shape mismatch: {name!r} is declared "
+                f"{list(declared)} but the op produces "
+                f"{list(inferred_shape)}",
+                op=op, block_idx=block.idx, op_idx=op_idx,
+                var_names=(name,)))
+        inferred_dtype = convert_dtype(aval.dtype)
+        if inferred_dtype != v.dtype:
+            diags.append(ctx.diag(
+                Severity.ERROR, "shape-dtype",
+                f"dtype mismatch: {name!r} is declared "
+                f"{dtype_to_str(v.dtype)} but the op produces "
+                f"{dtype_to_str(inferred_dtype)}",
+                op=op, block_idx=block.idx, op_idx=op_idx,
+                var_names=(name,)))
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# fetch reachability
+# ---------------------------------------------------------------------------
+
+@register_analysis_pass("fetch")
+def _check_fetch(ctx: AnalysisContext) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    g = ctx.graph
+    for name in ctx.fetch_names:
+        var = ctx.program.global_block()._find_var_recursive(name)
+        dsites = g.def_sites(name)
+        if var is None and not dsites:
+            diags.append(ctx.diag(
+                Severity.ERROR, "fetch",
+                f"fetch target {name!r} does not exist in the program",
+                var_names=(name,)))
+            continue
+        if dsites and all(d.block_idx != 0 for d in dsites) and \
+                var is None:
+            diags.append(ctx.diag(
+                Severity.ERROR, "fetch",
+                f"fetch target {name!r} is only written inside a "
+                f"sub-block and is not visible from the global block",
+                var_names=(name,)))
+            continue
+        if not dsites and var is not None and not var.persistable and \
+                not _is_presumed_feed(ctx, var, name):
+            diags.append(ctx.diag(
+                Severity.ERROR, "fetch",
+                f"fetch target {name!r} is never computed by any op",
+                var_names=(name,)))
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# cross-program collective ordering
+# ---------------------------------------------------------------------------
+
+# communication collectives whose issue ORDER must agree on every shard
+# (a divergent order deadlocks the ring, reference nccl semantics)
+COLLECTIVE_OP_TYPES = frozenset({
+    "c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
+    "c_allreduce_prod", "c_allgather", "c_reducescatter",
+    "c_broadcast", "allreduce", "broadcast",
+})
+
+
+def _collective_signature(program: Program):
+    """Ordered (block, op position, signature) of every collective. The
+    signature is (type, ring_id, root, reduce_type, operand names):
+    every rank must issue the same collective on the same tensors in
+    the same order — NCCL pairs calls purely by issue order, so a
+    reordered pair silently mixes tensors or hangs on a shape mismatch."""
+    seq = []
+    for block in program.blocks:
+        for op_idx, op in enumerate(block.ops):
+            if op.type not in COLLECTIVE_OP_TYPES:
+                continue
+            names = tuple(sorted(n for n in op.input_arg_names if n))
+            sig = (op.type, int(op.attr("ring_id", 0) or 0),
+                   int(op.attr("root", 0) or 0),
+                   int(op.attr("reduce_type", 0) or 0), names)
+            seq.append((block.idx, op_idx, sig))
+    return seq
+
+
+def check_collective_ordering(
+        programs: Sequence[Program],
+        labels: Optional[Sequence[str]] = None) -> List[Diagnostic]:
+    """Compare the collective sequence of each shard program against
+    shard 0; any divergence (different op, ring, root, or count) is an
+    ERROR — on hardware it hangs every rank, with no diagnostic."""
+    if len(programs) < 2:
+        return []
+    labels = list(labels) if labels is not None else [
+        f"shard {i}" for i in range(len(programs))]
+    ref_seq = _collective_signature(programs[0])
+    diags: List[Diagnostic] = []
+    for i, prog in enumerate(programs[1:], start=1):
+        seq = _collective_signature(prog)
+        for pos, ((rb, ro, rsig), (sb, so, ssig)) in enumerate(
+                zip(ref_seq, seq)):
+            if rsig == ssig:
+                continue
+            if rsig[:4] == ssig[:4]:
+                detail = (f"both issue {rsig[0]} on ring {rsig[1]} but "
+                          f"on different tensors ({list(rsig[4])} vs "
+                          f"{list(ssig[4])}) — reordered collectives "
+                          f"pair by issue order and silently mix or "
+                          f"hang")
+            else:
+                detail = (f"{labels[0]} issues {rsig[0]} (ring "
+                          f"{rsig[1]}) but {labels[i]} issues "
+                          f"{ssig[0]} (ring {ssig[1]}) — divergent "
+                          f"collective order deadlocks the ring")
+            diags.append(Diagnostic(
+                Severity.ERROR, "collective-order",
+                f"collective #{pos} diverges from {labels[0]}: " + detail,
+                op_type=ssig[0], block_idx=sb, op_idx=so,
+                program_label=labels[i]))
+            break
+        else:
+            if len(seq) != len(ref_seq):
+                longer = seq if len(seq) > len(ref_seq) else ref_seq
+                which = labels[i] if len(seq) > len(ref_seq) else \
+                    labels[0]
+                pos = min(len(seq), len(ref_seq))
+                bi, oi, sig = longer[pos]
+                diags.append(Diagnostic(
+                    Severity.ERROR, "collective-order",
+                    f"collective count mismatch: {labels[0]} issues "
+                    f"{len(ref_seq)} collectives but {labels[i]} "
+                    f"issues {len(seq)}; first unmatched is {sig[0]} "
+                    f"on {which} — the ring hangs waiting for the "
+                    f"missing rank",
+                    op_type=sig[0], block_idx=bi, op_idx=oi,
+                    program_label=labels[i]))
+    return diags
+
+
+def analyze_shard_programs(
+        programs: Sequence[Program],
+        feed_names=None, fetch_names=(),
+        labels: Optional[Sequence[str]] = None) -> List[Diagnostic]:
+    """Full fleet check: per-shard single-program passes plus the
+    cross-shard collective-ordering comparison."""
+    labels = list(labels) if labels is not None else [
+        f"shard {i}" for i in range(len(programs))]
+    diags: List[Diagnostic] = []
+    for prog, label in zip(programs, labels):
+        diags.extend(analyze_program(prog, feed_names=feed_names,
+                                     fetch_names=fetch_names,
+                                     label=label))
+    diags.extend(check_collective_ordering(programs, labels))
+    return diags

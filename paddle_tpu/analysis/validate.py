@@ -1,0 +1,63 @@
+"""Flag-gated program validation for the executor hot path.
+
+``validate_cached`` is what ``Executor.run`` / ``CompiledProgram._run``
+call when ``FLAGS_validate_program`` is on: it runs the full pass
+pipeline once per program fingerprint (uid, version) and raises
+``EnforceNotMet`` listing every error-severity diagnostic. The cache
+means a training loop re-running the same program pays the analysis
+cost exactly once, and an edited program (version bump) is re-checked.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from ..core.enforce import EnforceNotMet
+from .diagnostics import Diagnostic, format_report, has_errors
+from .passes import analyze_program
+
+__all__ = ["validate_program", "validate_cached", "clear_validation_cache"]
+
+
+def validate_program(program, feed_names=None, fetch_names=(),
+                     passes: Optional[Sequence[str]] = None,
+                     label: str = "") -> List[Diagnostic]:
+    """Analyze and raise ``EnforceNotMet`` if any ERROR finding exists.
+
+    Returns the full diagnostic list (warnings included) on success so
+    callers can surface non-fatal findings.
+    """
+    diags = analyze_program(program, feed_names=feed_names,
+                            fetch_names=fetch_names, passes=passes,
+                            label=label)
+    if has_errors(diags):
+        first_err = next(d for d in diags if d.is_error)
+        raise EnforceNotMet(
+            format_report([d for d in diags if d.is_error],
+                          header="program validation failed"),
+            op_type=first_err.op_type)
+    return diags
+
+
+# fingerprint -> frozenset of feed/fetch keys already validated clean
+_VALIDATED = {}
+_CACHE_LIMIT = 256
+
+
+def validate_cached(program, feed_names=None, fetch_names=()) -> None:
+    """``validate_program`` memoized on (program fingerprint, feed set,
+    fetch set). Failures are not cached: a raising program re-raises on
+    every run, matching the enforce semantics of the uncached path."""
+    key = (program.fingerprint,
+           None if feed_names is None else frozenset(feed_names),
+           tuple(fetch_names))
+    if key in _VALIDATED:
+        return
+    validate_program(program, feed_names=feed_names,
+                     fetch_names=fetch_names)
+    if len(_VALIDATED) >= _CACHE_LIMIT:
+        _VALIDATED.clear()
+    _VALIDATED[key] = True
+
+
+def clear_validation_cache() -> None:
+    _VALIDATED.clear()

@@ -45,6 +45,10 @@ _log = logging.getLogger(__name__)
 _running_lock = threading.Lock()
 _running: Optional["Communicator"] = None
 
+# consecutive failed pull rounds before the recv loop warns that the
+# trainer is running on stale parameters
+_RECV_WARN_AFTER = 3
+
 
 def _merge_vals(vals):
     """MergeVars (reference communicator.h:104-158): dense grads sum;
@@ -116,13 +120,19 @@ class Communicator:
                 f"send({grad_name!r}): not a transpiled grad var; known: "
                 f"{sorted(self._queues)}")
         # blocks at send_queue_size (BlockingQueue::Push) — but keeps
-        # re-checking for a dead send thread, which would never drain a
-        # full queue (the put must fail loud, not hang the trainer)
+        # re-checking for a dead or stopped send thread, which would
+        # never drain a full queue (the put must fail loud, not hang
+        # the trainer)
         while True:
             if self._failed is not None:
                 raise RuntimeError(
                     "Communicator send thread died; parameter updates "
                     "have stopped") from self._failed
+            if not self._running or self._send_thread is None or \
+                    not self._send_thread.is_alive():
+                raise RuntimeError(
+                    "Communicator is stopped; send() after stop() "
+                    "would never be drained")
             try:
                 q.put(value, timeout=0.2)
                 return
@@ -195,6 +205,7 @@ class Communicator:
 
     def _recv_loop(self):
         thresh = int(FLAGS.communicator_min_send_grad_num_before_recv)
+        consecutive_failures = 0
         while True:
             with self._grad_num_cv:
                 self._grad_num_cv.wait_for(
@@ -208,8 +219,20 @@ class Communicator:
                     continue
             try:
                 self._recv_all()
-            except OSError:
-                pass  # server transiently unreachable; retry next round
+                consecutive_failures = 0
+            except OSError as exc:
+                # transiently unreachable server: retry next round, but
+                # a persistent failure means the trainer keeps stepping
+                # on STALE parameters — that must be diagnosable
+                consecutive_failures += 1
+                if consecutive_failures == _RECV_WARN_AFTER or \
+                        consecutive_failures % (_RECV_WARN_AFTER * 10) \
+                        == 0:
+                    _log.warning(
+                        "Communicator recv failed %d consecutive pull "
+                        "round(s) (%s); training continues on stale "
+                        "parameters until the pserver is reachable",
+                        consecutive_failures, exc)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):

@@ -21,6 +21,7 @@ import numpy as np
 import jax
 
 from . import framework
+from .core.flags import FLAGS
 from .core.scope import LoDTensor
 
 __all__ = ["CompiledProgram", "BuildStrategy", "ExecutionStrategy"]
@@ -187,6 +188,17 @@ class CompiledProgram:
 
     def _run(self, executor, feed, fetch_names, scope, return_numpy):
         from .parallel.data_parallel import DataParallelEngine
+        if FLAGS.validate_program and isinstance(
+                self._program, framework.Program):
+            from .analysis import validate_cached
+            feed_keys = None
+            if isinstance(feed, dict):
+                feed_keys = list(feed)
+            elif isinstance(feed, (list, tuple)) and feed and \
+                    all(isinstance(f, dict) for f in feed):
+                feed_keys = sorted({k for f in feed for k in f})
+            validate_cached(self._program, feed_names=feed_keys,
+                            fetch_names=fetch_names)
         if not getattr(self, "_strategies_validated", False):
             _validate_strategies(self._build_strategy,
                                  self._exec_strategy, self._program)

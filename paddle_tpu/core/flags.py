@@ -58,6 +58,11 @@ _define("benchmark", False, True,
 _define("paddle_num_threads", 2, True,
         "default reader worker threads for the native data feed")
 _define("seed", 0, True, "global default RNG seed when a Program sets none")
+_define("validate_program", False, True,
+        "run the static analyzer (paddle_tpu/analysis) over each program "
+        "before execution and raise EnforceNotMet on error-severity "
+        "findings; cached per program fingerprint so steady-state "
+        "training pays the cost once")
 # fully-async communicator knobs (reference communicator.cc:29-41)
 _define("communicator_independent_recv_thread", True, True,
         "pull params on an independent thread (reference "

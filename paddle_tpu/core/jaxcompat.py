@@ -1,0 +1,59 @@
+"""Version shims for jax APIs that moved between releases.
+
+The codebase targets current jax (top-level ``jax.shard_map``,
+``lax.axis_size``, shard_map's ``check_vma``) but must also run on the
+0.4.x line this environment ships (``jax.experimental.shard_map``,
+``jax.core.axis_frame``, ``check_rep``). Import from here instead of
+guessing which spelling the installed jax has.
+"""
+from __future__ import annotations
+
+import inspect
+
+import jax
+from jax import lax
+
+try:                                    # jax >= 0.6 top-level export
+    from jax import shard_map as _shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_SM_PARAMS = frozenset(inspect.signature(_shard_map).parameters)
+
+__all__ = ["shard_map", "axis_size"]
+
+
+def shard_map(f=None, **kwargs):
+    """jax.shard_map accepting either spelling of the replication-check
+    kwarg (``check_vma`` on current jax, ``check_rep`` before 0.7)."""
+    if "check_vma" in kwargs and "check_vma" not in _SM_PARAMS:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    elif "check_rep" in kwargs and "check_rep" not in _SM_PARAMS:
+        kwargs["check_vma"] = kwargs.pop("check_rep")
+    if f is None:
+        return lambda fn: _shard_map(fn, **kwargs)
+    return _shard_map(f, **kwargs)
+
+
+def out_struct(shape, dtype, like=None):
+    """``jax.ShapeDtypeStruct`` carrying ``like``'s varying-mesh-axes
+    set when the installed jax tracks vma (>= 0.9, checked on
+    pallas_call out_shapes under shard_map); a plain struct on versions
+    without the concept."""
+    if like is not None and hasattr(jax, "typeof"):
+        vma = getattr(jax.typeof(like), "vma", frozenset())
+        if vma:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def axis_size(axis_name):
+    """Static size of a named mesh axis from inside shard_map/pmap
+    (``lax.axis_size`` on current jax; the axis frame before it
+    existed)."""
+    try:
+        return lax.axis_size(axis_name)
+    except AttributeError:
+        frame = jax.core.axis_frame(axis_name)
+        # 0.4.x returns the size itself; older frames carry .size
+        return frame.size if hasattr(frame, "size") else int(frame)

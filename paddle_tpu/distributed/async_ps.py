@@ -15,6 +15,18 @@ pickled numpy over TCP on the DCN-equivalent host network; there is no
 gRPC dependency in this environment and the wire format is an internal
 detail of the framework (both ends are this module).
 
+Trust boundary: like the reference's gRPC pserver transport, this wire
+has NO authentication or encryption — it is designed for a private
+training cluster network (trainers and pservers under one operator).
+Two mitigations bound the blast radius of a reachable port: endpoints
+with an empty host bind loopback by default (``_parse_ep``), and
+deserialization goes through a restricted Unpickler that only
+constructs numpy array/scalar/dtype machinery and builtin containers —
+an arbitrary ``__reduce__`` payload (the classic pickle-RCE vector) is
+rejected before any object is built. Do NOT expose these ports to an
+untrusted network; the allowlist stops code execution via pickle, not
+parameter tampering by a malicious peer.
+
 This module is the shared transport + the server loop. The trainer-side
 policy threads (merge-by-sum queues, pull cadence) live in
 `paddle_tpu.communicator.Communicator`.
@@ -36,6 +48,36 @@ __all__ = ["AsyncParameterServer", "push_grad", "pull_param",
 
 _LEN = struct.Struct("<Q")
 
+# every global a wire payload may construct: numpy array/scalar/dtype
+# reconstruction machinery (both the numpy 1.x "numpy.core" and 2.x
+# "numpy._core" spellings) plus builtin containers. Anything else —
+# os.system, subprocess, arbitrary __reduce__ — is rejected unbuilt.
+_SAFE_PICKLE_GLOBALS = {
+    "builtins": {"dict", "list", "tuple", "set", "frozenset", "str",
+                 "bytes", "bytearray", "int", "float", "bool",
+                 "complex", "slice", "range", "NoneType"},
+    "numpy": {"ndarray", "dtype"},
+    "numpy.core.multiarray": {"_reconstruct", "scalar"},
+    "numpy._core.multiarray": {"_reconstruct", "scalar"},
+    "numpy.core.numeric": {"_frombuffer"},
+    "numpy._core.numeric": {"_frombuffer"},
+}
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    def find_class(self, module, name):
+        if name in _SAFE_PICKLE_GLOBALS.get(module, ()):
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"refusing to unpickle {module}.{name}: not on the pserver "
+            f"wire allowlist (see the trust-boundary note in "
+            f"paddle_tpu/distributed/async_ps.py)")
+
+
+def _safe_loads(payload: bytes):
+    import io as _io
+    return _RestrictedUnpickler(_io.BytesIO(payload)).load()
+
 
 def _send_msg(sock: socket.socket, obj) -> None:
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
@@ -54,10 +96,11 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 def _recv_msg(sock: socket.socket):
     (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
-    return pickle.loads(_recv_exact(sock, n))
+    return _safe_loads(_recv_exact(sock, n))
 
 
 def _parse_ep(endpoint: str):
+    # empty host binds/connects loopback — never 0.0.0.0 by default
     host, port = endpoint.rsplit(":", 1)
     return host or "127.0.0.1", int(port)
 

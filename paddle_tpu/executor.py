@@ -14,6 +14,7 @@ import numpy as np
 
 from . import framework
 from .core.engine import Engine
+from .core.flags import FLAGS
 from .core.place import CPUPlace, TPUPlace, Place, default_place
 from .core.scope import LoDTensor, Scope, global_scope, scope_guard
 
@@ -57,6 +58,10 @@ class Executor:
             return program._run(self, feed, fetch_names, scope, return_numpy)
 
         feed = self._canonical_feed(feed, program)
+        if FLAGS.validate_program:
+            from .analysis import validate_cached
+            validate_cached(program, feed_names=list(feed),
+                            fetch_names=fetch_names)
         return self._engine.run(program, scope, self.place, feed,
                                 fetch_names, return_numpy=return_numpy)
 

@@ -44,8 +44,14 @@ import functools
 
 import jax
 import jax.numpy as jnp
+
+from ..core.jaxcompat import out_struct as _out_struct
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+# renamed across jax releases: TPUCompilerParams (0.4.x) -> CompilerParams
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or \
+    getattr(pltpu, "TPUCompilerParams")
 
 _NEG_INF = -1e30
 
@@ -596,10 +602,8 @@ def _fa_forward(q, k, v, bias, scale, block_q, block_k,
     plan = _Plan(layout, B, H, Sq, Sk, D, bq, bk)
     # under shard_map, outputs inherit the inputs' varying-mesh-axes
     # set (JAX >= 0.9 checks vma on pallas_call out_shapes)
-    vma = getattr(jax.typeof(q), "vma", frozenset())
-
     def _sds(shape, dtype):
-        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+        return _out_struct(shape, dtype, like=q)
 
     grid = plan.grid(Sq // bq, n_kv)
     qa, ka = plan.seq_axes(swap=False)
@@ -667,7 +671,7 @@ def _fa_forward(q, k, v, bias, scale, block_q, block_k,
             pltpu.VMEM((plan.hpb, bq, 128), jnp.float32),
             pltpu.VMEM((plan.hpb, bq, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel",) * kv_axis
             + ("arbitrary",)),
         interpret=_INTERPRET,
@@ -735,10 +739,8 @@ def _fa_backward(q, k, v, bias, out, lse, g, scale, block_q, block_k,
     if g_lse is not None:
         glse_w = _widen(g_lse.reshape(B, H, Sq).astype(jnp.float32),
                         plan)
-    vma = getattr(jax.typeof(q), "vma", frozenset())
-
     def _sds(shape, dtype):
-        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+        return _out_struct(shape, dtype, like=q)
 
     def out_rows(S):
         return ((B, S, H * D) if layout == "bshd" else (B * H, S, D))
@@ -824,7 +826,7 @@ def _fa_backward(q, k, v, bias, out, lse, g, scale, block_q, block_k,
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=[pltpu.VMEM((plan.hpb, bq, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel",) * kv_axis
             + ("arbitrary",)),
         interpret=_INTERPRET,
@@ -901,7 +903,7 @@ def _fa_backward(q, k, v, bias, out, lse, g, scale, block_q, block_k,
                    _sds(out_rows(Sk), v.dtype)],
         scratch_shapes=[pltpu.VMEM((plan.hpb, bk, D), jnp.float32),
                         pltpu.VMEM((plan.hpb, bk, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel",) * q_axis
             + ("arbitrary",)),
         interpret=_INTERPRET,

@@ -36,6 +36,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..core.jaxcompat import axis_size as _axis_size
+
 
 def _block_attn(q, k, v, bias, scale):
     from ..kernels.flash_attention import flash_attention_lse
@@ -77,7 +79,7 @@ def _block_bwd(q, k, v, bias, out, lse, di, g, scale):
 
 
 def _ring_forward(q, k, v, bias, axis_name, scale):
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     my = lax.axis_index(axis_name)
     s_local = k.shape[2]
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -122,7 +124,7 @@ def _ring_fwd(q, k, v, bias, axis_name, scale):
 
 def _ring_bwd(axis_name, scale, res, g):
     q, k, v, bias, out, lse = res
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     my = lax.axis_index(axis_name)
     s_local = k.shape[2]
     perm = [(i, (i + 1) % n) for i in range(n)]

@@ -1,0 +1,674 @@
+"""Static analyzer (paddle_tpu/analysis): per-pass positive/negative
+cases, the book-model sweep, the flag-gated executor validator, the
+lint_program CLI, and the ADVICE-round regression fixes that ride in
+the same PR (communicator liveness, recv-failure logging, the guarded
+private-jax import, and the restricted pserver unpickler).
+"""
+import logging
+import os
+import pickle
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.analysis import (Severity, analyze_program,
+                                 analyze_shard_programs,
+                                 check_collective_ordering,
+                                 clear_validation_cache, format_report,
+                                 has_errors, validate_program)
+from paddle_tpu.analysis.def_use import DefUseGraph
+from paddle_tpu.core.flags import set_flags
+from paddle_tpu.core.scope import Scope
+from paddle_tpu.core.types import convert_dtype
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(os.path.dirname(HERE), "tools"))
+
+import lint_program  # noqa: E402  (tools/lint_program.py)
+
+
+def _mlp_program():
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = layers.data("img", [784], dtype="float32")
+        label = layers.data("label", [1], dtype="int64")
+        h = layers.fc(img, 64, act="relu")
+        pred = layers.fc(h, 10, act="softmax")
+        loss = layers.mean(layers.cross_entropy(pred, label))
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    return main, startup, loss
+
+
+def _errors(diags):
+    return [d for d in diags if d.is_error]
+
+
+def _warnings(diags):
+    return [d for d in diags if d.severity == Severity.WARNING]
+
+
+# ---------------------------------------------------------------------------
+# def-use graph substrate
+# ---------------------------------------------------------------------------
+
+def test_def_use_graph_records_sites():
+    main, _, loss = _mlp_program()
+    g = DefUseGraph(main)
+    # every fc weight is read by a mul and written by its grad op
+    w_uses = g.use_sites("fc_0.w_0")
+    assert any(s.op_type == "mul" for s in w_uses)
+    assert any(s.op_type == "sgd" for s in w_uses)
+    assert any(s.op_type == "sgd" for s in g.def_sites("fc_0.w_0"))
+    assert loss.name in g.defined_names()
+    # sites carry exact (block, op) locations
+    s = g.def_sites(loss.name)[0]
+    assert main.block(s.block_idx).ops[s.op_idx] is s.op
+
+
+# ---------------------------------------------------------------------------
+# pass: def-use (dangling / undefined reads)
+# ---------------------------------------------------------------------------
+
+def test_clean_program_has_no_findings():
+    main, startup, loss = _mlp_program()
+    for prog, fetches in ((main, [loss.name]), (startup, [])):
+        diags = analyze_program(prog, feed_names=["img", "label"],
+                                fetch_names=fetches)
+        assert diags == [], format_report(diags)
+
+
+def test_undefined_read_is_error():
+    main, _, loss = _mlp_program()
+    for op in main.global_block().ops:
+        if op.type == "relu":
+            op._inputs["X"] = ["ghost"]
+            break
+    diags = analyze_program(main, feed_names=["img", "label"],
+                            fetch_names=[loss.name])
+    errs = _errors(diags)
+    assert len(errs) == 1
+    d = errs[0]
+    assert d.pass_name == "def-use" and d.op_type == "relu"
+    assert d.var_names == ("ghost",) and d.block_idx == 0
+    assert "ghost" in str(d)
+
+
+def test_read_before_write_is_dangling():
+    main, _, loss = _mlp_program()
+    blk = main.global_block()
+    # make the first op read a (non-persistable) var only defined later
+    first = next(op for op in blk.ops if op.type == "mul")
+    first._inputs["X"] = [loss.name]
+    diags = analyze_program(main, feed_names=["img", "label"],
+                            fetch_names=[loss.name])
+    assert any(d.pass_name == "def-use" and "before" in d.message
+               for d in _errors(diags))
+
+
+def test_strict_vs_lenient_feed_modes():
+    main, _, loss = _mlp_program()
+    # strict mode with an incomplete feed set: 'label' is read but
+    # neither fed nor written
+    diags = analyze_program(main, feed_names=["img"],
+                            fetch_names=[loss.name])
+    assert any("label" in d.var_names for d in _errors(diags))
+    # lenient mode (feeds unknown, e.g. a deserialized program): data
+    # vars are presumed fed
+    diags = analyze_program(main, feed_names=None,
+                            fetch_names=[loss.name])
+    assert _errors(diags) == []
+
+
+def test_lenient_mode_survives_proto_roundtrip():
+    # is_data does not survive serialization; the lenient heuristic
+    # must still treat deserialized feed vars as fed
+    main, _, loss = _mlp_program()
+    clone = fluid.Program.parse_from_string(main.serialize_to_string())
+    diags = analyze_program(clone, fetch_names=[loss.name])
+    assert _errors(diags) == [], format_report(diags)
+
+
+# ---------------------------------------------------------------------------
+# pass: liveness (write-after-write, dead outputs)
+# ---------------------------------------------------------------------------
+
+def test_dead_output_is_warning():
+    main, _, loss = _mlp_program()
+    with fluid.program_guard(main):
+        dead = layers.fc(main.global_block().vars["img"], 3)
+    diags = analyze_program(main, feed_names=["img", "label"],
+                            fetch_names=[loss.name])
+    assert _errors(diags) == []
+    warns = _warnings(diags)
+    assert any(d.pass_name == "liveness" and dead.name in d.var_names
+               for d in warns)
+
+
+def test_write_after_write_is_warning():
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        t1 = layers.scale(x, 2.0)
+        t2 = layers.scale(x, 3.0)
+        out = layers.scale(t2, 1.0)
+    blk = main.global_block()
+    # make the second scale clobber t1 (no read between the writes) and
+    # the third read the clobbered name
+    ops = [op for op in blk.ops if op.type == "scale"]
+    ops[1]._outputs["Out"] = [t1.name]
+    ops[2]._inputs["X"] = [t1.name]
+    diags = analyze_program(main, feed_names=["x"],
+                            fetch_names=[out.name])
+    assert any(d.pass_name == "liveness" and
+               "write-after-write" in d.message and
+               t1.name in d.var_names for d in diags)
+
+
+def test_inplace_optimizer_update_is_not_waw():
+    # sgd writes ParamOut = Param in place every program; the pass must
+    # not flag persistable in-place updates
+    main, _, loss = _mlp_program()
+    diags = analyze_program(main, feed_names=["img", "label"],
+                            fetch_names=[loss.name])
+    assert not any("write-after-write" in d.message for d in diags)
+
+
+# ---------------------------------------------------------------------------
+# pass: shape-dtype
+# ---------------------------------------------------------------------------
+
+def test_declared_dtype_mismatch_is_error():
+    main, _, loss = _mlp_program()
+    blk = main.global_block()
+    op = next(o for o in blk.ops if o.type == "elementwise_add")
+    out = op.output("Out")[0]
+    blk.vars[out].dtype = convert_dtype("int64")
+    diags = analyze_program(main, feed_names=["img", "label"],
+                            fetch_names=[loss.name])
+    errs = _errors(diags)
+    assert any(d.pass_name == "shape-dtype" and
+               "dtype mismatch" in d.message and out in d.var_names
+               for d in errs)
+    # the diagnostic is readable: severity, op type, var, location
+    d = next(x for x in errs if out in x.var_names)
+    s = str(d)
+    assert "ERROR" in s and d.op_type in s and out in s and "block" in s
+
+
+def test_declared_shape_mismatch_is_error():
+    main, _, loss = _mlp_program()
+    blk = main.global_block()
+    op = next(o for o in blk.ops if o.type == "mul")
+    out = op.output("Out")[0]
+    blk.vars[out].shape = (7, 7, 7)
+    diags = analyze_program(main, feed_names=["img", "label"],
+                            fetch_names=[loss.name])
+    assert any(d.pass_name == "shape-dtype" and
+               "shape mismatch" in d.message and out in d.var_names
+               for d in _errors(diags))
+
+
+def test_input_dtype_disagreement_is_error():
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = layers.data("a", [4], dtype="float32")
+        b = layers.data("b", [4], dtype="float32")
+        out = layers.elementwise_add(a, b)
+    main.global_block().vars["b"].dtype = convert_dtype("int64")
+    diags = analyze_program(main, feed_names=["a", "b"],
+                            fetch_names=[out.name])
+    assert any("dtype mismatch between inputs" in d.message
+               for d in _errors(diags))
+
+
+def test_unregistered_op_is_error():
+    main, _, loss = _mlp_program()
+    main.global_block().append_op(type="totally_bogus_op",
+                                  inputs={}, outputs={}, attrs={})
+    diags = analyze_program(main, feed_names=["img", "label"],
+                            fetch_names=[loss.name])
+    assert any(d.op_type == "totally_bogus_op" and
+               "not registered" in d.message for d in _errors(diags))
+
+
+def test_dynamic_batch_dim_is_wildcard():
+    # shape [-1, ...] declared dims must not be compared against the
+    # sentinel-materialized inferred dims
+    main, startup, loss = _mlp_program()
+    diags = analyze_program(main, feed_names=["img", "label"],
+                            fetch_names=[loss.name])
+    assert not any(d.pass_name == "shape-dtype" for d in diags)
+
+
+# ---------------------------------------------------------------------------
+# pass: fetch reachability
+# ---------------------------------------------------------------------------
+
+def test_missing_fetch_target_is_error():
+    main, _, _ = _mlp_program()
+    diags = analyze_program(main, feed_names=["img", "label"],
+                            fetch_names=["does_not_exist"])
+    errs = _errors(diags)
+    assert any(d.pass_name == "fetch" and
+               d.var_names == ("does_not_exist",) for d in errs)
+
+
+def test_never_computed_fetch_is_error():
+    main, _, _ = _mlp_program()
+    blk = main.global_block()
+    blk.create_var(name="orphan", shape=[4], dtype="float32")
+    diags = analyze_program(main, feed_names=["img", "label"],
+                            fetch_names=["orphan"])
+    assert any(d.pass_name == "fetch" and "never computed" in d.message
+               for d in _errors(diags))
+
+
+# ---------------------------------------------------------------------------
+# cross-program collective ordering
+# ---------------------------------------------------------------------------
+
+def _shard_programs(n=2):
+    return lint_program.transpile_shards("mlp", n)[0]
+
+
+def test_aligned_shards_are_clean():
+    shards = _shard_programs()
+    assert check_collective_ordering(shards) == []
+    diags = analyze_shard_programs(shards, feed_names=["img", "label"])
+    assert _errors(diags) == [], format_report(diags)
+
+
+def test_shuffled_collectives_are_error():
+    shards = _shard_programs()
+    blk = shards[1].global_block()
+    idxs = [i for i, op in enumerate(blk.ops)
+            if op.type.startswith("c_allreduce")]
+    assert len(idxs) >= 2
+    blk.ops[idxs[0]], blk.ops[idxs[1]] = \
+        blk.ops[idxs[1]], blk.ops[idxs[0]]
+    diags = check_collective_ordering(shards)
+    assert len(diags) == 1 and diags[0].is_error
+    assert diags[0].pass_name == "collective-order"
+    assert diags[0].program_label == "shard 1"
+
+
+def test_collective_count_mismatch_is_error():
+    shards = _shard_programs()
+    blk = shards[1].global_block()
+    # drop the LAST collective: the common prefix still matches, so the
+    # report is specifically about the count, not a reorder
+    i = max(i for i, op in enumerate(blk.ops)
+            if op.type.startswith("c_allreduce"))
+    del blk.ops[i]
+    diags = check_collective_ordering(shards)
+    assert any("count mismatch" in d.message for d in _errors(diags))
+
+
+def test_divergent_ring_id_is_error():
+    shards = _shard_programs()
+    blk = shards[1].global_block()
+    op = next(op for op in blk.ops
+              if op.type.startswith("c_allreduce"))
+    op._attrs["ring_id"] = 7
+    diags = check_collective_ordering(shards)
+    assert any("ring" in d.message for d in _errors(diags))
+
+
+# ---------------------------------------------------------------------------
+# book-model sweep: every standard net lints with zero errors
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("model", sorted(lint_program.MODELS))
+def test_book_models_lint_clean(model):
+    main, startup, feed_names, loss = lint_program.build_model(model)
+    for prog, fetches in ((main, [loss.name]), (startup, [])):
+        diags = analyze_program(prog, feed_names=feed_names,
+                                fetch_names=fetches)
+        assert not has_errors(diags), format_report(diags)
+
+
+# ---------------------------------------------------------------------------
+# flag-gated executor / compiler validation
+# ---------------------------------------------------------------------------
+
+def _fit_program():
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        y = layers.fc(x, 2)
+    return main, startup, y
+
+
+def test_executor_flag_gated_validation():
+    main, startup, y = _fit_program()
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        feed = {"x": np.ones((3, 4), np.float32)}
+        clear_validation_cache()
+        set_flags({"FLAGS_validate_program": True})
+        try:
+            out = exe.run(main, feed=feed, fetch_list=[y])
+            assert np.asarray(out[0]).shape == (3, 2)
+            # corrupt + version bump -> the cached validation re-runs
+            op = next(o for o in main.global_block().ops
+                      if o.type == "mul")
+            op._inputs["X"] = ["ghost"]
+            main._bump_version()
+            with pytest.raises(fluid.EnforceNotMet) as ei:
+                exe.run(main, feed=feed, fetch_list=[y])
+            assert "ghost" in str(ei.value)
+            assert "def-use" in str(ei.value)
+        finally:
+            set_flags({"FLAGS_validate_program": False})
+            clear_validation_cache()
+
+
+def test_validation_off_by_default_and_cached():
+    from paddle_tpu.core.flags import get_flags
+    assert get_flags("validate_program") == \
+        {"FLAGS_validate_program": False}
+    # validate_cached memoizes per fingerprint: second call does no work
+    from paddle_tpu.analysis import validate_cached
+    import paddle_tpu.analysis.validate as validate_mod
+    main, _, y = _fit_program()
+    clear_validation_cache()
+    validate_cached(main, feed_names=["x"], fetch_names=[y.name])
+    calls = []
+    orig = validate_mod.validate_program
+    validate_mod.validate_program = \
+        lambda *a, **k: calls.append(1) or orig(*a, **k)
+    try:
+        validate_cached(main, feed_names=["x"], fetch_names=[y.name])
+        assert calls == []
+        main._bump_version()
+        validate_cached(main, feed_names=["x"], fetch_names=[y.name])
+        assert calls == [1]
+    finally:
+        validate_mod.validate_program = orig
+        clear_validation_cache()
+
+
+def test_compiled_program_validation():
+    main, startup, y = _fit_program()
+    op = next(o for o in main.global_block().ops if o.type == "mul")
+    op._inputs["X"] = ["ghost"]
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        compiled = fluid.CompiledProgram(main)
+        clear_validation_cache()
+        set_flags({"FLAGS_validate_program": True})
+        try:
+            with pytest.raises(fluid.EnforceNotMet):
+                exe.run(compiled,
+                        feed={"x": np.ones((3, 4), np.float32)},
+                        fetch_list=[y])
+        finally:
+            set_flags({"FLAGS_validate_program": False})
+            clear_validation_cache()
+
+
+def test_validate_program_returns_warnings_on_success():
+    main, _, y = _fit_program()
+    with fluid.program_guard(main):
+        layers.fc(main.global_block().vars["x"], 3)   # dead output
+    diags = validate_program(main, feed_names=["x"],
+                             fetch_names=[y.name])
+    assert any(d.severity == Severity.WARNING for d in diags)
+
+
+# ---------------------------------------------------------------------------
+# lint_program CLI (in-process: subprocess startup costs a jax import)
+# ---------------------------------------------------------------------------
+
+def test_cli_clean_model_exits_zero(capsys):
+    assert lint_program.main(["--model", "mlp"]) == 0
+    out = capsys.readouterr().out
+    assert "0 error(s)" in out
+
+
+def test_cli_dangling_read_exits_nonzero(capsys):
+    rc = lint_program.main(["--model", "mlp", "--inject",
+                            "dangling_read"])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "[ERROR]" in out and "def-use" in out and "block 0" in out
+
+
+def test_cli_dtype_mismatch_exits_nonzero(capsys):
+    rc = lint_program.main(["--model", "fit_a_line", "--inject",
+                            "dtype_mismatch"])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "dtype mismatch" in out
+
+
+def test_cli_dead_output_warns(capsys):
+    assert lint_program.main(["--model", "mlp", "--inject",
+                              "dead_output"]) == 0
+    assert lint_program.main(["--model", "mlp", "--inject",
+                              "dead_output",
+                              "--warnings-as-errors"]) == 1
+    out = capsys.readouterr().out
+    assert "dead output" in out
+
+
+def test_cli_shuffled_collectives_exits_nonzero(capsys):
+    rc = lint_program.main(["--model", "mlp", "--shards", "2",
+                            "--inject", "shuffled_collectives"])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "collective" in out
+
+
+def test_cli_lints_serialized_model(tmp_path, capsys):
+    main, startup, y = _fit_program()
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        fluid.io.save_inference_model(str(tmp_path), ["x"], [y], exe,
+                                      main_program=main)
+    model = str(tmp_path / "__model__")
+    assert lint_program.main(["--program", model]) == 0
+    assert lint_program.main(["--program", model, "--fetch",
+                              "nonexistent"]) == 1
+    out = capsys.readouterr().out
+    assert "nonexistent" in out
+
+
+# ---------------------------------------------------------------------------
+# ADVICE regressions
+# ---------------------------------------------------------------------------
+
+def _comm_program(ep="127.0.0.1:6199"):
+    from paddle_tpu.transpiler import DistributeTranspiler
+    from paddle_tpu.transpiler.distribute_transpiler import (
+        DistributeTranspilerConfig)
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        pred = layers.fc(x, 1, param_attr=fluid.ParamAttr(name="w"),
+                         bias_attr=fluid.ParamAttr(name="b"))
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.SGDOptimizer(0.1).minimize(loss)
+    cfg = DistributeTranspilerConfig()
+    cfg.sync_mode = False
+    cfg.fully_async = True
+    t = DistributeTranspiler(cfg)
+    t.transpile(0, program=main, pservers=ep, trainers=1,
+                sync_mode=False, startup_program=startup)
+    return main
+
+
+def test_send_on_stopped_communicator_raises_not_hangs():
+    from paddle_tpu.communicator import Communicator
+    main = _comm_program()
+    scope = Scope()
+    scope.var("w").set_value(np.zeros((4, 1), np.float32))
+    comm = Communicator(main, scope=scope)
+    grad = sorted(comm._send_ctx)[0]
+    # never started: the retry loop must fail loud instead of spinning
+    # on a queue nobody drains
+    with pytest.raises((RuntimeError, KeyError)):
+        comm.send(grad, np.zeros((4, 1), np.float32))
+    # started with fake rpc, then stopped: send after stop raises
+    set_flags({"communicator_fake_rpc": True})
+    try:
+        comm.start()
+        comm.stop()
+        with pytest.raises(RuntimeError, match="stopped"):
+            comm.send(grad, np.zeros((4, 1), np.float32))
+    finally:
+        set_flags({"communicator_fake_rpc": False})
+
+
+def test_recv_loop_warns_after_consecutive_failures(caplog):
+    import paddle_tpu.communicator as comm_mod
+    from paddle_tpu.communicator import Communicator
+    main = _comm_program()
+    scope = Scope()
+    scope.var("w").set_value(np.zeros((4, 1), np.float32))
+    comm = Communicator(main, scope=scope)
+    comm._running = True
+    thresh = comm_mod._RECV_WARN_AFTER
+    fails = {"n": 0}
+
+    def broken_recv_all():
+        fails["n"] += 1
+        if fails["n"] >= thresh:
+            comm._running = False      # loop exits after this round
+        else:
+            comm._grad_num = 10 ** 6   # re-arm the next pull round
+        raise OSError("connection refused")
+
+    comm._recv_all = broken_recv_all
+    comm._grad_num = 10 ** 6
+    with caplog.at_level(logging.WARNING,
+                         logger="paddle_tpu.communicator"):
+        th = threading.Thread(target=comm._recv_loop, daemon=True)
+        th.start()
+        th.join(timeout=15)
+    assert not th.is_alive()
+    assert fails["n"] == thresh
+    assert any("stale" in r.getMessage() for r in caplog.records)
+
+
+def test_trace_state_clean_guarded():
+    import jax
+    from paddle_tpu.ops.distributed_ops import _trace_state_clean
+    assert _trace_state_clean() is True
+    seen = {}
+
+    def f(x):
+        seen["clean"] = _trace_state_clean()
+        return x * 2
+
+    jax.jit(f)(np.float32(1.0))
+    assert seen["clean"] is False
+
+
+def test_checkpoint_notify_no_endpoints_is_identity():
+    # the guard must not break the no-endpoint (collective) path
+    from paddle_tpu.core.registry import OPS
+    info = OPS.get("checkpoint_notify")
+    assert info is not None
+
+
+def test_restricted_unpickler_roundtrips_wire_payloads():
+    from paddle_tpu.distributed.async_ps import _safe_loads
+    payloads = [
+        np.ones((2, 3), np.float32),
+        {"t": "push", "name": "w@GRAD", "v": np.arange(4),
+         "trainer": 0, "merged_n": 2},
+        ("selected_rows", np.array([1, 2]),
+         np.ones((2, 3), np.float32), 7),
+        np.float32(1.5),
+        {"names": ["a", "b"]},
+        "pong",
+        None,
+    ]
+    for obj in payloads:
+        rt = _safe_loads(pickle.dumps(
+            obj, protocol=pickle.HIGHEST_PROTOCOL))
+        assert type(rt) is type(obj)
+    arr = _safe_loads(pickle.dumps(payloads[0]))
+    np.testing.assert_array_equal(arr, payloads[0])
+
+
+def test_restricted_unpickler_rejects_reduce_payloads():
+    from paddle_tpu.distributed.async_ps import _safe_loads
+
+    class Evil:
+        def __reduce__(self):
+            return (os.system, ("true",))
+
+    with pytest.raises(pickle.UnpicklingError, match="allowlist"):
+        _safe_loads(pickle.dumps(Evil()))
+
+    class EvilImport:
+        def __reduce__(self):
+            import subprocess
+            return (subprocess.check_output, (["true"],))
+
+    with pytest.raises(pickle.UnpicklingError):
+        _safe_loads(pickle.dumps(EvilImport()))
+
+
+def test_server_wire_rejects_malicious_pickle():
+    # end-to-end: a crafted frame on the socket must not execute; the
+    # server survives and keeps serving well-formed requests
+    import socket as socket_mod
+    import struct
+    from paddle_tpu.distributed import async_ps
+
+    with socket_mod.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    ep = f"127.0.0.1:{port}"
+    state = {"w": np.zeros(3, np.float32)}
+    srv = async_ps.AsyncParameterServer(
+        ep, fanin=1, get_var=lambda n: state[n],
+        apply_update=lambda *a: None, known_params=["w"])
+    th = threading.Thread(target=srv.serve, daemon=True)
+    th.start()
+    try:
+        async_ps.wait_server(ep)
+
+        class Evil:
+            def __reduce__(self):
+                return (os.system, ("true",))
+
+        payload = pickle.dumps(Evil())
+        with socket_mod.create_connection(("127.0.0.1", port),
+                                          timeout=5) as c:
+            c.sendall(struct.pack("<Q", len(payload)) + payload)
+            # server refuses the frame and drops the connection
+            # without executing anything
+            with pytest.raises(ConnectionError):
+                async_ps._recv_msg(c)
+        # still alive and serving
+        assert np.allclose(async_ps.pull_param(ep, "w"), 0.0)
+    finally:
+        async_ps.send_complete(ep, 0)
+        th.join(timeout=10)
+
+
+def test_parse_ep_defaults_to_loopback():
+    from paddle_tpu.distributed.async_ps import _parse_ep
+    assert _parse_ep(":6174") == ("127.0.0.1", 6174)
+    assert _parse_ep("10.0.0.5:6174") == ("10.0.0.5", 6174)

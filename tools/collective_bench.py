@@ -48,7 +48,19 @@ def _build(collective, n_elems, mesh):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
-    shard_map = jax.shard_map
+    try:
+        shard_map = jax.shard_map
+    except AttributeError:
+        from jax.experimental.shard_map import shard_map
+    import inspect
+    if "check_vma" not in inspect.signature(shard_map).parameters:
+        # older jax spells the kwarg check_rep
+        _inner = shard_map
+
+        def shard_map(f, **kw):
+            if "check_vma" in kw:
+                kw["check_rep"] = kw.pop("check_vma")
+            return _inner(f, **kw)
 
     n_dev = mesh.shape["x"]
     if collective == "all_reduce":

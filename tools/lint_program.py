@@ -1,0 +1,271 @@
+"""lint_program: run the static analyzer over a Program and report.
+
+The front-end of ``paddle_tpu/analysis`` (the Python analog of the
+reference's C++ ``framework/ir`` verification passes). Lints either a
+serialized ProgramDesc or a named book model built in-process, prints
+every diagnostic (severity, pass, op type, var names, block/op
+location), and exits non-zero when any error-severity finding exists —
+suitable for CI gating of exported models.
+
+Usage:
+  python tools/lint_program.py --model mlp
+  python tools/lint_program.py --model fit_a_line --inject dangling_read
+  python tools/lint_program.py --program /path/to/__model__ --fetch y
+  python tools/lint_program.py --model mlp --shards 2 \
+      --inject shuffled_collectives
+
+``--inject`` corrupts the program before linting (dev aid + the CLI's
+own test fixture): dangling_read, dtype_mismatch, dead_output,
+shuffled_collectives (needs --shards >= 2).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import paddle_tpu as fluid                              # noqa: E402
+from paddle_tpu import layers                           # noqa: E402
+from paddle_tpu.analysis import (analysis_passes, analyze_program,  # noqa: E402
+                                 analyze_shard_programs, format_report,
+                                 has_errors)
+
+EXIT_CLEAN = 0
+EXIT_ERRORS = 1
+EXIT_USAGE = 2
+
+
+# ---------------------------------------------------------------------------
+# named model builders (the book suite's standard nets)
+# ---------------------------------------------------------------------------
+
+def _build_mlp():
+    img = layers.data("img", [784], dtype="float32")
+    label = layers.data("label", [1], dtype="int64")
+    h = layers.fc(img, 64, act="relu")
+    h = layers.fc(h, 64, act="relu")
+    pred = layers.fc(h, 10, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, label))
+    return ["img", "label"], loss
+
+
+def _build_conv():
+    img = layers.data("img", [1, 28, 28], dtype="float32")
+    label = layers.data("label", [1], dtype="int64")
+    c1 = layers.conv2d(img, 8, 5, act="relu")
+    p1 = layers.pool2d(c1, 2, "max", 2)
+    c2 = layers.conv2d(p1, 16, 5, act="relu")
+    p2 = layers.pool2d(c2, 2, "max", 2)
+    pred = layers.fc(p2, 10, act="softmax")
+    loss = layers.mean(layers.cross_entropy(pred, label))
+    return ["img", "label"], loss
+
+
+def _build_fit_a_line():
+    x = layers.data("x", [13], dtype="float32")
+    y = layers.data("y", [1], dtype="float32")
+    pred = layers.fc(x, 1)
+    loss = layers.mean(layers.square_error_cost(pred, y))
+    return ["x", "y"], loss
+
+
+MODELS = {"mlp": _build_mlp, "conv": _build_conv,
+          "fit_a_line": _build_fit_a_line}
+
+
+def build_model(name: str, optimize: bool = True):
+    """(main, startup, feed_names, loss) for a named book model."""
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        feed_names, loss = MODELS[name]()
+        if optimize:
+            fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    return main, startup, feed_names, loss
+
+
+# ---------------------------------------------------------------------------
+# defect injection
+# ---------------------------------------------------------------------------
+
+def inject_defect(program, kind: str):
+    """Corrupt `program` in place; returns a short description."""
+    block = program.global_block()
+    if kind == "dangling_read":
+        for op in block.ops:
+            if op.input_slots():
+                slot = op.input_slots()[0]
+                op._inputs[slot] = ["__lint_ghost__"]
+                program._bump_version()
+                return (f"op '{op.type}' now reads undefined var "
+                        f"'__lint_ghost__'")
+        raise ValueError("no op with inputs to corrupt")
+    if kind == "dtype_mismatch":
+        from paddle_tpu.core.types import convert_dtype
+        for op in block.ops:
+            if op.type in ("elementwise_add", "mul", "matmul"):
+                out = op.output("Out")[0]
+                block.vars[out].dtype = convert_dtype("int64")
+                program._bump_version()
+                return (f"declared dtype of '{out}' flipped to int64 "
+                        f"under op '{op.type}'")
+        raise ValueError("no elementwise_add/mul/matmul op to corrupt")
+    if kind == "dead_output":
+        with fluid.program_guard(program):
+            feeds = [v for v in block.vars.values()
+                     if getattr(v, "is_data", False)]
+            src = feeds[0] if feeds else next(iter(block.vars.values()))
+            dead = layers.fc(src, 3)
+        return f"appended an fc whose output '{dead.name}' is never read"
+    if kind == "shuffled_collectives":
+        idxs = [i for i, op in enumerate(block.ops)
+                if op.type.startswith("c_allreduce")]
+        if len(idxs) < 2:
+            raise ValueError("fewer than 2 collectives; use --shards 2")
+        i, j = idxs[0], idxs[1]
+        block.ops[i], block.ops[j] = block.ops[j], block.ops[i]
+        program._bump_version()
+        return f"swapped collectives at op #{i} and op #{j}"
+    raise ValueError(f"unknown injection {kind!r}")
+
+
+def transpile_shards(model: str, n_shards: int):
+    """Build `model` once per rank and run the collective transpiler."""
+    from paddle_tpu.transpiler.collective import GradAllReduce
+    eps = [f"127.0.0.1:{6170 + i}" for i in range(n_shards)]
+    shards, feed_names, loss_name = [], None, None
+    for rank in range(n_shards):
+        main, startup, feed_names, loss = build_model(model)
+        GradAllReduce().transpile(
+            startup_program=startup, main_program=main, rank=rank,
+            endpoints=eps, current_endpoint=eps[rank], wait_port=False)
+        shards.append(main)
+        loss_name = loss.name
+    return shards, feed_names, loss_name
+
+
+def load_serialized_program(path: str):
+    """(Program, meta|None) from either an inference-model ``__model__``
+    container (version + feed/fetch meta + ProgramDesc, io.py) or raw
+    ProgramDesc bytes."""
+    import pickle
+    import struct
+    from paddle_tpu.core.op_version import check_program
+    from paddle_tpu.proto import framework_pb2 as fpb
+
+    def _parse(raw):
+        proto = fpb.ProgramDesc()
+        proto.ParseFromString(raw)
+        check_program(proto)   # version gate + strip @OP_VERSIONS@
+        return fluid.Program.from_proto(proto)
+
+    with open(path, "rb") as f:
+        blob = f.read()
+    try:
+        (ver,) = struct.unpack_from("<I", blob, 0)
+        (meta_len,) = struct.unpack_from("<I", blob, 4)
+        if ver == 1 and 8 + meta_len < len(blob):
+            meta = pickle.loads(blob[8:8 + meta_len])
+            if isinstance(meta, dict) and "feed" in meta:
+                return _parse(blob[8 + meta_len:]), meta
+    except Exception:
+        pass
+    return _parse(blob), None
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def _parser():
+    p = argparse.ArgumentParser(
+        prog="lint_program",
+        description="static analysis over a paddle_tpu Program")
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument("--model", choices=sorted(MODELS),
+                     help="build this book model in-process and lint it")
+    src.add_argument("--program", metavar="FILE",
+                     help="path to a serialized ProgramDesc (the "
+                          "__model__ file save_inference_model writes)")
+    p.add_argument("--fetch", nargs="*", default=None, metavar="NAME",
+                   help="fetch targets to check reachability for "
+                        "(default: the model's loss when --model)")
+    p.add_argument("--inject", choices=["dangling_read", "dtype_mismatch",
+                                        "dead_output",
+                                        "shuffled_collectives"],
+                   help="corrupt the program before linting")
+    p.add_argument("--shards", type=int, default=1,
+                   help="transpile the model into N data-parallel shard "
+                        "programs and also check collective ordering")
+    p.add_argument("--passes", nargs="*", default=None,
+                   metavar="PASS", help=f"subset of passes to run "
+                   f"(default all: {', '.join(analysis_passes())})")
+    p.add_argument("--warnings-as-errors", action="store_true",
+                   help="exit non-zero on warnings too")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ns = _parser().parse_args(argv)
+    if ns.program and ns.shards > 1:
+        print("lint_program: --shards requires --model", file=sys.stderr)
+        return EXIT_USAGE
+    if ns.inject == "shuffled_collectives" and ns.shards < 2:
+        print("lint_program: --inject shuffled_collectives requires "
+              "--shards >= 2", file=sys.stderr)
+        return EXIT_USAGE
+
+    feed_names = None
+    fetch_names = ns.fetch
+    if ns.program:
+        program, meta = load_serialized_program(ns.program)
+        if meta:
+            feed_names = meta.get("feed")
+            if fetch_names is None:
+                fetch_names = meta.get("fetch")
+        label = os.path.basename(ns.program)
+        programs = [program]
+    elif ns.shards > 1:
+        programs, feed_names, loss_name = transpile_shards(
+            ns.model, ns.shards)
+        label = ns.model
+        if fetch_names is None:
+            fetch_names = [loss_name]
+    else:
+        program, _, feed_names, loss = build_model(ns.model)
+        label = ns.model
+        programs = [program]
+        if fetch_names is None:
+            fetch_names = [loss.name]
+
+    if ns.inject:
+        # corrupt the last shard so cross-shard divergence is visible
+        desc = inject_defect(programs[-1], ns.inject)
+        print(f"injected: {desc}")
+
+    if len(programs) > 1:
+        diags = analyze_shard_programs(
+            programs, feed_names=feed_names,
+            fetch_names=fetch_names or ())
+    else:
+        diags = analyze_program(
+            programs[0], feed_names=feed_names,
+            fetch_names=fetch_names or (), passes=ns.passes,
+            label="")
+    print(format_report(diags, header=f"lint {label}"))
+    if has_errors(diags):
+        return EXIT_ERRORS
+    if ns.warnings_as_errors and diags:
+        return EXIT_ERRORS
+    return EXIT_CLEAN
+
+
+if __name__ == "__main__":
+    sys.exit(main())
