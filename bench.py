@@ -166,6 +166,13 @@ def _mfu_lines(name, sps, sync_ms, stats):
             f"# {name}: sync 1-step latency {sync_ms:.1f} ms "
             f"(incl. tunnel RTT; device-only bound "
             f"{1e3/sps:.1f} ms/step)")
+        try:
+            from tools.step_overhead_bench import overhead_report
+            line = overhead_report(name, sync_ms, sps, stats)
+            if line:
+                lines.append(line)
+        except Exception:
+            pass   # accounting line only; never fail the bench on it
     return lines
 
 

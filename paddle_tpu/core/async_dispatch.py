@@ -1,0 +1,141 @@
+"""Deferred fetch handles for the async step-dispatch pipeline.
+
+With ``FLAGS.async_dispatch`` on, ``Engine.run(..., return_numpy=False)``
+returns :class:`FetchHandle` objects instead of synced host copies. The
+payload stays a live ``jax.Array`` — JAX's async dispatch makes it a
+future — so the caller's next-step host work (feed conversion, reader
+next-batch, ``device_put``) overlaps the current step's device compute
+and D2H. The contract mirrors the reference's multi-stream executor
+semantics: errors that the synchronous path would raise inside ``run()``
+(``FLAGS_check_nan_inf`` trips, deferred XLA runtime errors) are
+re-raised at the MATERIALIZATION point — ``handle.numpy()``,
+``np.asarray(handle)``, or ``Executor.synchronize()`` — still carrying
+the original op context.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .enforce import EnforceNotMet
+
+__all__ = ["FetchHandle", "PendingStep"]
+
+
+class PendingStep:
+    """One dispatched-but-unchecked step: holds the device-resident
+    all-finite flags (check_nan_inf) until a materialization point.
+
+    ``check()`` is idempotent for success and sticky for failure: the
+    first call syncs the flags; a trip is cached and re-raised on every
+    later call, so each handle of a poisoned step fails the same way."""
+
+    __slots__ = ("_nan_flags", "_labels", "_fingerprint", "_done", "_exc")
+
+    def __init__(self, nan_flags, labels: Tuple[Tuple[str, str], ...],
+                 fingerprint):
+        self._nan_flags = nan_flags
+        self._labels = tuple(labels)
+        self._fingerprint = fingerprint
+        self._done = False
+        self._exc: Optional[BaseException] = None
+
+    def check(self):
+        if self._exc is not None:
+            raise self._exc
+        if self._done:
+            return
+        self._done = True
+        flags, self._nan_flags = self._nan_flags, None  # free the buffer
+        if not self._labels or flags is None or isinstance(flags, tuple):
+            return
+        try:
+            host = np.asarray(flags)
+        except EnforceNotMet:
+            raise
+        except Exception as exc:
+            self._exc = EnforceNotMet(
+                f"deferred XLA error from program {self._fingerprint} "
+                f"surfaced at materialization (FLAGS_async_dispatch): "
+                f"{exc}")
+            self._exc.__cause__ = exc
+            raise self._exc
+        if not host.all():
+            bad = int(np.argmin(host))
+            op_type, var = self._labels[bad]
+            self._exc = EnforceNotMet(
+                f"Operator {op_type!r} output {var!r} contains NaN or "
+                f"Inf (FLAGS_check_nan_inf, deferred by "
+                f"FLAGS_async_dispatch; reference operator.cc:953-983)",
+                op_type=op_type)
+            raise self._exc
+
+
+class FetchHandle:
+    """Non-blocking fetch result: a live ``jax.Array`` plus the step's
+    deferred-check record. Duck-types the LoDTensor surface the fetch
+    consumers already use (``.array``, ``.lod()``, ``np.asarray``)."""
+
+    __slots__ = ("_value", "_lod", "_rec", "_name", "_fingerprint")
+
+    def __init__(self, value, lod, rec: Optional[PendingStep], name,
+                 fingerprint):
+        self._value = value
+        self._lod = [list(level) for level in (lod or [])]
+        self._rec = rec
+        self._name = name
+        self._fingerprint = fingerprint
+
+    # -- live (non-materializing) surface ----------------------------------
+    @property
+    def array(self):
+        """The backing jax.Array — still a future until the step's
+        executable finishes; touching its VALUES is what synchronizes."""
+        return self._value
+
+    def lod(self):
+        return self._lod
+
+    def shape(self):
+        return tuple(getattr(self._value, "shape", ()))
+
+    def is_ready(self) -> bool:
+        """True once the device has produced the value (no blocking)."""
+        try:
+            return bool(self._value.is_ready())
+        except AttributeError:
+            return True
+
+    # -- materialization points -------------------------------------------
+    def numpy(self) -> np.ndarray:
+        """Sync: block for the value, surfacing any deferred step error
+        (NaN/Inf trip or XLA runtime failure) with its op context."""
+        if self._rec is not None:
+            self._rec.check()
+        try:
+            return np.asarray(self._value)
+        except EnforceNotMet:
+            raise
+        except Exception as exc:
+            err = EnforceNotMet(
+                f"deferred XLA error while materializing fetch "
+                f"{self._name!r} of program {self._fingerprint} "
+                f"(FLAGS_async_dispatch): {exc}")
+            err.__cause__ = exc
+            raise err
+
+    def block_until_ready(self) -> "FetchHandle":
+        self.numpy()
+        return self
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __repr__(self):
+        return (f"FetchHandle({self._name!r}, shape={self.shape()}, "
+                f"ready={self.is_ready()})")

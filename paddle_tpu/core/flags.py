@@ -55,6 +55,14 @@ _define("check_nan_inf", False, True,
 _define("benchmark", False, True,
         "block until device ready after every executor step and log step "
         "latency (reference FLAGS_benchmark per-op sync, operator.cc:949)")
+_define("async_dispatch", False, True,
+        "pipelined step dispatch: run(..., return_numpy=False) returns "
+        "fetch handles backed by live jax.Arrays instead of synced host "
+        "copies, and NaN/Inf checks (FLAGS_check_nan_inf) are deferred to "
+        "handle materialization / Executor.synchronize() so step N+1's "
+        "host work overlaps step N's device compute and D2H; ignored "
+        "while FLAGS_benchmark forces per-step sync (docs/ASYNC_DISPATCH"
+        ".md)")
 _define("paddle_num_threads", 2, True,
         "default reader worker threads for the native data feed")
 _define("seed", 0, True, "global default RNG seed when a Program sets none")

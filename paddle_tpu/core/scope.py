@@ -195,6 +195,14 @@ class Scope:
             s = s._parent
         return None
 
+    def var_refs(self, names):
+        """(name, Variable) pairs for `names`, creating as needed — the
+        engine's steady-state dispatch caches these references so the
+        per-step persistable read/writeback loop performs no name
+        lookups (values stay device-resident jax.Arrays end to end;
+        see docs/ASYNC_DISPATCH.md)."""
+        return [(n, self.var(n)) for n in names]
+
     def new_scope(self) -> "Scope":
         kid = Scope(self)
         self._kids.append(kid)

@@ -43,7 +43,16 @@ class Executor:
     def run(self, program=None, feed=None, fetch_list=None, feed_var_name="feed",
             fetch_var_name="fetch", scope=None, return_numpy=True,
             use_program_cache=True):
-        """Run a Program (or a CompiledProgram built from one)."""
+        """Run a Program (or a CompiledProgram built from one).
+
+        ``use_program_cache=False`` bypasses (and does not populate) the
+        engine's trace/fast-path caches: the step is re-traced and
+        re-compiled on every call — the reference's semantics for
+        programs whose desc mutates between runs without a version bump.
+        With ``FLAGS.async_dispatch`` on and ``return_numpy=False``,
+        fetches come back as live FetchHandles; call their ``.numpy()``
+        or :meth:`synchronize` to materialize (docs/ASYNC_DISPATCH.md).
+        """
         if self._closed:
             raise RuntimeError("Executor is closed")
         if program is None:
@@ -63,7 +72,15 @@ class Executor:
             validate_cached(program, feed_names=list(feed),
                             fetch_names=fetch_names)
         return self._engine.run(program, scope, self.place, feed,
-                                fetch_names, return_numpy=return_numpy)
+                                fetch_names, return_numpy=return_numpy,
+                                use_program_cache=use_program_cache)
+
+    def synchronize(self):
+        """Block until every step dispatched by this executor has
+        finished on device, draining all deferred FLAGS.async_dispatch
+        checks: NaN/Inf trips (FLAGS_check_nan_inf) and deferred XLA
+        errors are re-raised here with their original op context."""
+        self._engine.synchronize()
 
     def _canonical_feed(self, feed, program):
         if feed is None:
@@ -78,9 +95,24 @@ class Executor:
                 raise TypeError(
                     "list feed is only valid for CompiledProgram "
                     "with_data_parallel")
+        import jax
         out = {}
         for k, v in feed.items():
             if isinstance(v, LoDTensor):
+                out[k] = v
+            elif isinstance(v, jax.Array):
+                # already device-resident (e.g. from the
+                # DeviceFeedPrefetcher): np.asarray here would force a
+                # D2H sync on the dispatch hot path; dtype-matching
+                # arrays pass through untouched (compare against the
+                # CANONICALIZED dtype — x64-disabled jax stores int64
+                # feeds as int32, which must not astype every step)
+                var = program.global_block()._find_var_recursive(k)
+                if var is not None:
+                    want = jax.dtypes.canonicalize_dtype(
+                        framework.dtype_to_np(var.dtype))
+                    if v.dtype != want:
+                        v = v.astype(want)
                 out[k] = v
             else:
                 arr = np.asarray(v)

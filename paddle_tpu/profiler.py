@@ -19,11 +19,20 @@ from typing import Dict, List, Optional
 import jax
 
 __all__ = ["profiler", "start_profiler", "stop_profiler",
-           "reset_profiler", "RecordEvent", "cuda_profiler"]
+           "reset_profiler", "RecordEvent", "cuda_profiler",
+           "profiling_active"]
 
 _events: List[dict] = []
 _enabled = [False]
 _trace_dir = [None]
+
+
+def profiling_active() -> bool:
+    """Cheap guard for per-step instrumentation on the engine's dispatch
+    hot path: True while host events are collected or a device trace is
+    live. The async pipeline skips RecordEvent allocation entirely when
+    this is False, so steady-state dispatch pays one boolean check."""
+    return _enabled[0] or _trace_dir[0] is not None
 
 
 class RecordEvent:

@@ -4,6 +4,7 @@ from .decorators import (  # noqa: F401
     DataFeeder, batch, PyReader, cache, map_readers, shuffle,
     chain, compose, buffered, firstn, xmap_readers,
     multiprocess_reader, Fake, PipeReader, creator, DataFeedDesc)
+from .prefetcher import DeviceFeedPrefetcher  # noqa: F401
 from . import decorators  # noqa: F401
 from . import dataset  # noqa: F401
 from . import creator  # noqa: F401

@@ -1,0 +1,165 @@
+"""Per-step host-overhead micro-benchmark for the Engine hot loop.
+
+Reports how much of the synchronous 1-step wall time is HOST/dispatch
+overhead rather than device work: overhead = sync 1-step latency minus
+the device-pipeline bound (1 / pipelined steps-per-second, measured with
+bench.py's overhead-cancelling double-window method). This is the number
+the async dispatch pipeline (docs/ASYNC_DISPATCH.md) exists to shrink:
+a perfectly overlapped loop pays ~0 ms of it.
+
+CLI::
+
+    python tools/step_overhead_bench.py [--json] [--async-dispatch]
+        [--batch N] [--steps N] [--threshold-ms X]
+
+exits non-zero when measured host overhead exceeds ``--threshold-ms``
+(the CI regression gate). ``overhead_report()`` is imported by bench.py
+to emit the same accounting line alongside tokens/sec.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def overhead_report(name, sync_ms, sps, stats=None, counters=None):
+    """One '#'-prefixed accounting line: host overhead per step =
+    sync latency - pipelined bound. Returns None when inputs missing."""
+    if not sync_ms or not sps:
+        return None
+    bound_ms = 1e3 / sps
+    overhead = sync_ms - bound_ms
+    line = (f"# {name}: per-step host overhead {overhead:.1f} ms "
+            f"(sync {sync_ms:.1f} - pipelined bound {bound_ms:.1f})")
+    if counters:
+        line += (f"; steady-state counters: device_puts="
+                 f"{counters.get('device_puts', 0)} "
+                 f"sig_builds={counters.get('sig_builds', 0)} "
+                 f"traces={counters.get('traces', 0)}")
+    return line
+
+
+def _build_model(batch):
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.core.engine import Engine
+    from paddle_tpu.core.scope import Scope
+
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data(name="x", shape=[256], dtype="float32")
+        y = layers.data(name="y", shape=[1], dtype="int64")
+        h = layers.fc(x, size=512, act="relu")
+        h = layers.fc(h, size=512, act="relu")
+        pred = layers.fc(h, size=10, act="softmax")
+        loss = layers.mean(layers.cross_entropy(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        fluid.Executor().run(startup)
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.rand(batch, 256).astype(np.float32),
+            "y": rng.randint(0, 10, (batch, 1)).astype(np.int64)}
+    return Engine(), main, scope, feed, [loss.name]
+
+
+def measure_step_overhead(eng, prog, scope, batch, fetch_names,
+                          steps=30, warmup=5):
+    """(sync_ms, pipelined_ms, host_overhead_ms, counters-delta) for one
+    engine/program pair, fetch-fenced per bench.py's discipline (a host
+    fetch, not block_until_ready, is the only true completion
+    observable through the tunnel)."""
+    import jax
+
+    def _np(o):
+        return np.asarray(o.array if hasattr(o, "array") else o)
+
+    batch = {k: jax.device_put(np.asarray(v)) for k, v in batch.items()}
+    for _ in range(warmup):
+        out = eng.run(prog, scope, None, batch, fetch_names,
+                      return_numpy=False)
+    _np(out[0])
+    c0 = dict(eng.counters)
+
+    def window(n):
+        t0 = time.perf_counter()
+        last = None
+        for _ in range(n):
+            last = eng.run(prog, scope, None, batch, fetch_names,
+                           return_numpy=False)[0]
+        float(_np(last))   # fetch fence
+        return time.perf_counter() - t0
+
+    t1, t2 = window(steps), window(2 * steps)
+    sps = steps / (t2 - t1) if t2 - t1 > 0.02 * t2 \
+        else 3 * steps / (t1 + t2)
+    ts = []
+    for _ in range(7):
+        t0 = time.perf_counter()
+        float(_np(eng.run(prog, scope, None, batch, fetch_names,
+                          return_numpy=False)[0]))
+        ts.append(time.perf_counter() - t0)
+    sync_ms = sorted(ts)[len(ts) // 2] * 1e3
+    pipelined_ms = 1e3 / sps
+    counters = {k: eng.counters[k] - c0.get(k, 0)
+                for k in eng.counters}
+    return {"sync_ms": sync_ms,
+            "pipelined_ms": pipelined_ms,
+            "host_overhead_ms": sync_ms - pipelined_ms,
+            "steps_per_sec": sps,
+            "counters": counters}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--batch", type=int, default=256)
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--threshold-ms", type=float, default=None,
+                   help="exit 1 when host overhead/step exceeds this")
+    p.add_argument("--async-dispatch", action="store_true",
+                   help="measure with FLAGS_async_dispatch on")
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+
+    from paddle_tpu.core.flags import set_flags
+    if args.async_dispatch:
+        set_flags({"FLAGS_async_dispatch": True})
+
+    eng, prog, scope, feed, fetch = _build_model(args.batch)
+    import paddle_tpu as fluid
+    with fluid.scope_guard(scope):
+        r = measure_step_overhead(eng, prog, scope, feed, fetch,
+                                  steps=args.steps)
+    r["async_dispatch"] = bool(args.async_dispatch)
+    if args.json:
+        print(json.dumps(r))
+    else:
+        print(overhead_report("step_overhead_bench", r["sync_ms"],
+                              r["steps_per_sec"],
+                              counters=r["counters"]))
+    bad = []
+    if r["counters"].get("traces"):
+        bad.append(f"steady state re-traced "
+                   f"{r['counters']['traces']}x")
+    if args.threshold_ms is not None and \
+            r["host_overhead_ms"] > args.threshold_ms:
+        bad.append(f"host overhead {r['host_overhead_ms']:.1f} ms > "
+                   f"threshold {args.threshold_ms:.1f} ms")
+    if bad:
+        print("REGRESSION: " + "; ".join(bad), file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
