@@ -176,7 +176,44 @@ def _mfu_lines(name, sps, sync_ms, stats):
     return lines
 
 
-def bench_transformer(batch=BATCH, seq=None):
+def _bench_checkpoint(exe, scope, main_prog):
+    """Checkpoint round-trip timing (docs/CHECKPOINTING.md acceptance:
+    async ``save()`` must return in <10% of the synchronous
+    ``save_persistables`` wall time — the step loop pays only the
+    snapshot, not the D2H + serialization + fsync)."""
+    import shutil
+    import tempfile
+    import paddle_tpu as fluid
+    from paddle_tpu.checkpoint import CheckpointManager
+
+    root = tempfile.mkdtemp(prefix="ckpt_bench_")
+    try:
+        t0 = time.perf_counter()
+        fluid.io.save_persistables(exe, os.path.join(root, "legacy"),
+                                   main_prog)
+        sync_s = time.perf_counter() - t0
+        m = CheckpointManager(os.path.join(root, "async"))
+        t0 = time.perf_counter()
+        m.save(1, scope=scope, program=main_prog,
+               raise_on_missing=False)
+        ret_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        m.wait_all()
+        drain_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        m.restore(step=1, scope=scope, program=main_prog)
+        rest_s = time.perf_counter() - t0
+        m.close()
+        print(f"# checkpoint: sync save {sync_s*1e3:.0f} ms; async "
+              f"save() returned in {ret_s*1e3:.1f} ms "
+              f"({ret_s/sync_s*100:.1f}% of sync), background drain "
+              f"{drain_s*1e3:.0f} ms, restore {rest_s*1e3:.0f} ms",
+              file=sys.stderr)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def bench_transformer(batch=BATCH, seq=None, measure_ckpt=False):
     import paddle_tpu as fluid
     from paddle_tpu import models
     from paddle_tpu.core.engine import Engine
@@ -211,6 +248,8 @@ def bench_transformer(batch=BATCH, seq=None):
                                    [cost.name], ITERS, iterations=K)
         stats = eng.compiled_stats(main_prog, scope, feed,
                                    [cost.name], iterations=K)
+        if measure_ckpt:
+            _bench_checkpoint(exe, scope, main_prog)
     return sps * batch * s_trg, sps, traj, sync_ms, stats
 
 
@@ -593,7 +632,8 @@ def main():
         if not headline_ok:
             sys.exit(1)
         return
-    tokens_per_sec, sps, traj, sync_ms, stats = bench_transformer()
+    tokens_per_sec, sps, traj, sync_ms, stats = bench_transformer(
+        measure_ckpt=True)
     print(json.dumps({
         "metric": "transformer_base_train_tokens_per_sec",
         "value": round(tokens_per_sec, 1),

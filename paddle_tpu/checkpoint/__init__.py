@@ -1,0 +1,27 @@
+"""Async, sharded, crash-safe checkpointing (docs/CHECKPOINTING.md).
+
+The subsystem the step loop talks to is :class:`CheckpointManager`:
+``save(step)`` snapshots persistables as immutable device-side copies
+(near-zero pause) and hands them to a background writer; ``wait_all()``
+is the durability barrier; ``restore()`` verifies checksums and
+reshards onto the current device count. ``io.save_persistables`` /
+``load_persistables`` route through here under
+``FLAGS_async_checkpoint`` (the legacy one-file-per-var format stays
+readable either way).
+"""
+from .manager import CheckpointManager, SaveHandle  # noqa: F401
+from .manifest import (  # noqa: F401
+    CheckpointCorrupt, is_checkpoint_dir, list_steps, read_latest,
+    step_dir_name,
+)
+from .snapshot import (  # noqa: F401
+    Snapshot, SnapshotEntry, persistable_names, snapshot_scope,
+)
+from .writer import atomic_write  # noqa: F401
+
+__all__ = [
+    "CheckpointManager", "SaveHandle", "CheckpointCorrupt",
+    "Snapshot", "SnapshotEntry", "snapshot_scope", "persistable_names",
+    "is_checkpoint_dir", "list_steps", "read_latest", "step_dir_name",
+    "atomic_write",
+]

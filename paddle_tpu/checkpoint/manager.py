@@ -1,0 +1,331 @@
+"""CheckpointManager: async, sharded, crash-safe training-state saves.
+
+``save(step)`` snapshots the scope's persistables on the calling (step
+loop) thread — immutable device-side copies, near-zero pause — and
+queues the write; a single background thread performs D2H,
+serialization, the atomic commit (tmp dir -> fsync -> ``os.replace`` ->
+``LATEST``) and retention GC. ``wait_all()`` is the barrier, mirroring
+``Executor.synchronize()`` for async dispatch: after it returns every
+queued save is durable and any background failure has been re-raised.
+
+Multi-process contract (fleet/SPMD): every process constructs a manager
+with its ``process_index``/``process_count`` and calls ``save`` with the
+same step; each writes only its addressable shards (replica 0 of each
+index). Process 0 merges the per-process manifests and performs the
+commit once all shards are present. ``restore`` reads the merged
+manifest and assembles global tensors, so a checkpoint written by P
+processes restores on any device count.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import signal
+import threading
+import warnings
+from typing import Dict, List, Optional, Sequence
+
+from . import manifest as mf
+from . import writer as wr
+from .manifest import CheckpointCorrupt
+from .snapshot import Snapshot, persistable_names, snapshot_scope
+
+__all__ = ["CheckpointManager", "SaveHandle", "CheckpointCorrupt"]
+
+
+class SaveHandle:
+    """Future for one queued save. ``wait()`` blocks until the write is
+    durable (or failed) and re-raises the writer's exception."""
+
+    __slots__ = ("step", "_event", "_error", "committed_dir")
+
+    def __init__(self, step: int):
+        self.step = step
+        self._event = threading.Event()
+        self._error: Optional[BaseException] = None
+        self.committed_dir: Optional[str] = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    @property
+    def error(self) -> Optional[BaseException]:
+        return self._error
+
+    def wait(self, timeout: Optional[float] = None) -> "SaveHandle":
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"checkpoint save of step {self.step} still in flight "
+                f"after {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self
+
+    def _finish(self, error: Optional[BaseException],
+                committed_dir: Optional[str]) -> None:
+        self._error = error
+        self.committed_dir = committed_dir
+        self._event.set()
+
+
+class CheckpointManager:
+    def __init__(self, root: str, process_index: int = 0,
+                 process_count: int = 1, engine=None,
+                 keep_last_k: Optional[int] = None,
+                 keep_every_n: Optional[int] = None,
+                 commit_timeout: float = 300.0):
+        self.root = str(root)
+        self.process_index = int(process_index)
+        self.process_count = int(process_count)
+        self.engine = engine
+        self.keep_last_k = keep_last_k
+        self.keep_every_n = keep_every_n
+        self.commit_timeout = commit_timeout
+        self._queue: "queue.Queue" = queue.Queue()
+        self._worker: Optional[threading.Thread] = None
+        self._handles: List[SaveHandle] = []
+        self._lock = threading.Lock()
+        self._closed = False
+        self._last_save_spec = None   # (scope, program, vars) for SIGTERM
+        self._last_step: Optional[int] = None
+        self._prev_sigterm = None
+        self._preempt_step_fn = None
+
+    # -- save ---------------------------------------------------------------
+
+    def save(self, step: int, scope=None, program=None,
+             vars: Optional[Sequence[str]] = None,
+             snapshot: Optional[Snapshot] = None, sync: bool = False,
+             raise_on_missing: bool = True,
+             include_rng: bool = True) -> SaveHandle:
+        """Queue an async save of ``step``. The snapshot (immutable
+        refs + device-side copies) is taken HERE, on the caller's
+        thread, so later scope mutations / engine buffer donation cannot
+        corrupt it; everything slow (D2H, disk, fsync) happens on the
+        background writer. ``sync=True`` writes inline and returns a
+        completed handle."""
+        if self._closed:
+            raise RuntimeError("CheckpointManager is closed")
+        if snapshot is None:
+            if scope is None:
+                from ..core.scope import global_scope
+                scope = global_scope()
+            if vars is None:
+                if program is None:
+                    from ..framework import default_main_program
+                    program = default_main_program()
+                vars = persistable_names(program)
+            snapshot = snapshot_scope(scope, vars,
+                                      raise_on_missing=raise_on_missing,
+                                      include_rng=include_rng)
+        self._last_save_spec = (scope, program, vars)
+        self._last_step = int(step)
+        handle = SaveHandle(int(step))
+        with self._lock:
+            self._handles.append(handle)
+        self._count("ckpt_saves", 1)
+        self._count("ckpt_inflight", 1)
+        if sync:
+            self._execute(snapshot, handle)
+            if handle.error is not None:
+                raise handle.error
+            return handle
+        self._ensure_worker()
+        self._queue.put((snapshot, handle))
+        return handle
+
+    def _execute(self, snapshot: Snapshot, handle: SaveHandle) -> None:
+        committed = None
+        error: Optional[BaseException] = None
+        try:
+            tmp_dir = os.path.join(self.root,
+                                   mf.tmp_dir_name(handle.step))
+            os.makedirs(self.root, exist_ok=True)
+            wr.write_process_shard(tmp_dir, snapshot, handle.step,
+                                   self.process_index,
+                                   self.process_count)
+            if self.process_index == 0:
+                committed = wr.commit_step(
+                    self.root, handle.step, self.process_count,
+                    commit_timeout=self.commit_timeout)
+                wr.gc_steps(self.root, self.keep_last_k,
+                            self.keep_every_n)
+        except BaseException as exc:   # surfaced at wait_all()/wait()
+            error = exc
+        finally:
+            self._count("ckpt_inflight", -1)
+            handle._finish(error, committed)
+
+    def _worker_loop(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                self._queue.task_done()
+                return
+            snapshot, handle = item
+            try:
+                self._execute(snapshot, handle)
+            finally:
+                self._queue.task_done()
+
+    def _ensure_worker(self) -> None:
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(
+                target=self._worker_loop, daemon=True,
+                name="ckpt-writer")
+            self._worker.start()
+
+    def _count(self, key: str, delta: int) -> None:
+        if self.engine is not None:
+            counters = getattr(self.engine, "counters", None)
+            if counters is not None:
+                counters[key] = counters.get(key, 0) + delta
+
+    # -- barrier ------------------------------------------------------------
+
+    def wait_all(self, timeout: Optional[float] = None) -> None:
+        """Drain every in-flight save (the ``synchronize()`` analog of
+        docs/ASYNC_DISPATCH.md): after this returns, all queued
+        checkpoints are committed and durable; the first background
+        failure is re-raised here."""
+        with self._lock:
+            handles, self._handles = self._handles, []
+        first_error = None
+        for h in handles:
+            try:
+                h.wait(timeout)
+            except BaseException as exc:
+                if first_error is None:
+                    first_error = exc
+        if first_error is not None:
+            raise first_error
+
+    # reference-style alias (ISSUE: "final save + wait()")
+    wait = wait_all
+
+    def in_flight(self) -> int:
+        with self._lock:
+            return sum(1 for h in self._handles if not h.done())
+
+    # -- restore ------------------------------------------------------------
+
+    def latest_step(self) -> Optional[int]:
+        """The step restore would use: the LATEST pointer if its target
+        is a committed step, else the newest committed step on disk."""
+        latest = mf.read_latest(self.root)
+        complete = mf.list_steps(self.root, complete_only=True)
+        if latest is not None and latest in complete:
+            return latest
+        return complete[-1] if complete else None
+
+    def all_steps(self, complete_only: bool = True) -> List[int]:
+        return mf.list_steps(self.root, complete_only=complete_only)
+
+    def restore(self, step: Optional[int] = None, scope=None,
+                program=None, vars: Optional[Sequence[str]] = None,
+                place=None, verify: bool = True, strict: bool = True,
+                include_rng: bool = True) -> int:
+        """Load a committed checkpoint into ``scope``. ``step=None``
+        follows LATEST, falling back (with a warning) to the newest
+        complete step when the pointer is stale/dangling — the
+        crash-mid-save recovery path. Checksums are verified before any
+        value reaches the scope. Returns the restored step."""
+        if scope is None:
+            from ..core.scope import global_scope
+            scope = global_scope()
+        if step is None:
+            pointed = mf.read_latest(self.root)
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no complete checkpoint found under {self.root!r}")
+            if pointed is not None and pointed != step:
+                warnings.warn(
+                    f"checkpoint LATEST points at step {pointed} which "
+                    f"is not a complete checkpoint (crash mid-save?); "
+                    f"falling back to step {step}", stacklevel=2)
+        names = list(vars) if vars is not None else (
+            persistable_names(program) if program is not None else None)
+        from ..core.engine import RNG_STATE_VAR
+        if names is not None and include_rng:
+            man = wr._manifest_for_step(self.root, step)
+            if RNG_STATE_VAR in man["tensors"] and \
+                    RNG_STATE_VAR not in names:
+                names.append(RNG_STATE_VAR)
+        try:
+            tensors = wr.read_step(self.root, step, names=names,
+                                   verify=verify)
+        except CheckpointCorrupt:
+            if strict or names is None:
+                raise
+            tensors = wr.read_step(self.root, step, names=None,
+                                   verify=verify)
+            missing = [n for n in names if n not in tensors]
+            warnings.warn(
+                f"checkpoint step {step} is missing variables "
+                f"{missing}; restoring the {len(tensors)} present",
+                stacklevel=2)
+        from ..io import _restore
+        for name, (arr, lod) in tensors.items():
+            if not include_rng and name == RNG_STATE_VAR:
+                continue
+            _restore(scope, name, arr, lod, place)
+        return int(step)
+
+    # -- preemption ---------------------------------------------------------
+
+    def install_preemption_hook(self, step_fn=None) -> None:
+        """SIGTERM -> final synchronous save + ``wait()``. ``step_fn``
+        (if given) supplies the step number at preemption time;
+        otherwise the last ``save()``'s step + 1 is used. The previous
+        SIGTERM disposition is chained afterwards (a SIG_DFL previous
+        handler re-raises, terminating as the platform expects). Only
+        installable from the main thread (signal semantics)."""
+        self._preempt_step_fn = step_fn
+        self._prev_sigterm = signal.signal(signal.SIGTERM,
+                                           self._on_sigterm)
+
+    def uninstall_preemption_hook(self) -> None:
+        if self._prev_sigterm is not None:
+            signal.signal(signal.SIGTERM, self._prev_sigterm)
+            self._prev_sigterm = None
+
+    def _on_sigterm(self, signum, frame) -> None:
+        try:
+            step = (self._preempt_step_fn()
+                    if self._preempt_step_fn is not None
+                    else (self._last_step or 0) + 1)
+            spec = self._last_save_spec
+            if spec is not None:
+                scope, program, vars = spec
+                self.save(int(step), scope=scope, program=program,
+                          vars=vars, sync=True)
+            self.wait()
+        finally:
+            prev = self._prev_sigterm
+            if callable(prev):
+                prev(signum, frame)
+            elif prev == signal.SIG_DFL:
+                signal.signal(signum, signal.SIG_DFL)
+                os.kill(os.getpid(), signum)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def close(self) -> None:
+        """Drain in-flight saves and stop the writer thread."""
+        if self._closed:
+            return
+        try:
+            self.wait_all()
+        finally:
+            self._closed = True
+            self.uninstall_preemption_hook()
+            if self._worker is not None and self._worker.is_alive():
+                self._queue.put(None)
+                self._worker.join(timeout=30)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

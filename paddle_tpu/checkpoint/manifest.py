@@ -1,0 +1,193 @@
+"""Checkpoint manifest: the on-disk metadata contract.
+
+A checkpoint step directory holds one shard file per writing process
+plus JSON manifests describing every tensor: global shape/dtype/LoD,
+sharding layout (which index range of the global tensor each shard
+covers), and a CRC32 per shard payload so restore and
+``tools/ckpt_inspect.py`` can verify integrity without deserializing.
+Layout (docs/CHECKPOINTING.md):
+
+    root/
+      LATEST                      # text: name of the newest COMMITTED step dir
+      step_00000042/
+        manifest.json             # merged view (written by process 0 last
+                                  # before the directory is renamed in)
+        manifest_00000.json       # per-process manifests
+        shard_00000.bin           # per-process tensor payloads
+      step_00000043.tmp/          # in-flight save (never read by restore)
+
+Everything here is pure metadata handling — no jax, no device I/O — so
+``tools/ckpt_inspect.py`` can import it standalone.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Dict, List, Optional
+
+FORMAT_VERSION = 1
+LATEST_FILE = "LATEST"
+MERGED_MANIFEST = "manifest.json"
+_STEP_RE = re.compile(r"^step_(\d{8})$")
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint failed validation: checksum mismatch, missing shard
+    file, incomplete shard coverage, or unreadable manifest."""
+
+
+def step_dir_name(step: int) -> str:
+    return f"step_{int(step):08d}"
+
+
+def tmp_dir_name(step: int) -> str:
+    return step_dir_name(step) + ".tmp"
+
+
+def parse_step_dir(name: str) -> Optional[int]:
+    m = _STEP_RE.match(name)
+    return int(m.group(1)) if m else None
+
+
+def shard_file_name(process_index: int) -> str:
+    return f"shard_{int(process_index):05d}.bin"
+
+
+def process_manifest_name(process_index: int) -> str:
+    return f"manifest_{int(process_index):05d}.json"
+
+
+def build_manifest(step: int, process_index: Optional[int],
+                   process_count: int, tensors: Dict[str, dict]) -> dict:
+    return {
+        "format_version": FORMAT_VERSION,
+        "framework": "paddle_tpu",
+        "step": int(step),
+        "process_index": process_index,
+        "process_count": int(process_count),
+        "tensors": tensors,
+    }
+
+
+def tensor_entry(global_shape, dtype: str, lod, sharding: str,
+                 shards: List[dict]) -> dict:
+    return {
+        "global_shape": [int(d) for d in global_shape],
+        "dtype": str(dtype),
+        "lod": [[int(x) for x in level] for level in (lod or [])],
+        "sharding": sharding,
+        "shards": shards,
+    }
+
+
+def shard_entry(file: str, offset: int, nbytes: int, index,
+                crc32: int) -> dict:
+    return {
+        "file": file,
+        "offset": int(offset),
+        "nbytes": int(nbytes),
+        # [[start, stop], ...] over the global shape; [] for scalars
+        "index": [[int(a), int(b)] for a, b in index],
+        "crc32": int(crc32),
+    }
+
+
+def write_manifest(path: str, manifest: dict) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+        f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def read_manifest(path: str) -> dict:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            m = json.load(f)
+    except (OSError, ValueError) as exc:
+        raise CheckpointCorrupt(
+            f"unreadable checkpoint manifest {path!r}: {exc}") from exc
+    ver = m.get("format_version")
+    if ver != FORMAT_VERSION:
+        raise CheckpointCorrupt(
+            f"manifest {path!r} has format_version {ver!r}; this build "
+            f"reads version {FORMAT_VERSION}")
+    return m
+
+
+def merge_manifests(manifests: List[dict]) -> dict:
+    """Union of the per-process manifests of one step: shard lists of
+    the same tensor concatenate; global shape/dtype must agree."""
+    if not manifests:
+        raise ValueError("no manifests to merge")
+    step = manifests[0]["step"]
+    count = manifests[0]["process_count"]
+    tensors: Dict[str, dict] = {}
+    for m in manifests:
+        if m["step"] != step:
+            raise CheckpointCorrupt(
+                f"cannot merge manifests of different steps "
+                f"({m['step']} vs {step})")
+        for name, t in m["tensors"].items():
+            prev = tensors.get(name)
+            if prev is None:
+                tensors[name] = {k: (list(v) if isinstance(v, list)
+                                     else v) for k, v in t.items()}
+                tensors[name]["shards"] = list(t["shards"])
+                continue
+            if (prev["global_shape"] != t["global_shape"]
+                    or prev["dtype"] != t["dtype"]):
+                raise CheckpointCorrupt(
+                    f"tensor {name!r} disagrees across process "
+                    f"manifests: {prev['global_shape']}/{prev['dtype']} "
+                    f"vs {t['global_shape']}/{t['dtype']}")
+            prev["shards"].extend(t["shards"])
+            if t["sharding"] == "sharded":
+                prev["sharding"] = "sharded"
+    return build_manifest(step, None, count, tensors)
+
+
+# ---------------------------------------------------------------------------
+# directory-level queries
+# ---------------------------------------------------------------------------
+
+def list_steps(root: str, complete_only: bool = True) -> List[int]:
+    """Ascending committed step numbers under ``root``. A step is
+    complete when its directory exists (the commit rename happened) and,
+    with ``complete_only``, holds a merged manifest."""
+    if not os.path.isdir(root):
+        return []
+    steps = []
+    for name in os.listdir(root):
+        step = parse_step_dir(name)
+        if step is None:
+            continue
+        if complete_only and not os.path.exists(
+                os.path.join(root, name, MERGED_MANIFEST)):
+            continue
+        steps.append(step)
+    return sorted(steps)
+
+
+def read_latest(root: str) -> Optional[int]:
+    """Step number the LATEST pointer names, or None. Does not validate
+    the target — callers decide how to handle a dangling pointer."""
+    path = os.path.join(root, LATEST_FILE)
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            name = f.read().strip()
+    except OSError:
+        return None
+    return parse_step_dir(name)
+
+
+def is_checkpoint_dir(root: str) -> bool:
+    """True when ``root`` uses the checkpoint-subsystem layout (vs the
+    legacy one-file-per-var format): a LATEST pointer or any committed
+    step directory."""
+    if not os.path.isdir(root):
+        return False
+    if os.path.exists(os.path.join(root, LATEST_FILE)):
+        return True
+    return bool(list_steps(root, complete_only=False))

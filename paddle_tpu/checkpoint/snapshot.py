@@ -1,0 +1,210 @@
+"""Step-loop-side state capture for async checkpointing.
+
+``snapshot_scope`` runs on the training thread and must pause it as
+little as possible: for every persistable it records an IMMUTABLE
+reference — for ``jax.Array`` values a device-side copy made by a tiny
+jitted identity (enqueued asynchronously on the device stream, so the
+host returns immediately) — and hands the set to the background writer,
+which performs the D2H and serialization off the step loop.
+
+The device copy is not an optimization nicety but a correctness
+requirement: the engine dispatches steps with buffer donation
+(``donate_argnums``) of updated persistables, so the array the scope
+holds *now* is deleted the moment the next step runs. A snapshot that
+kept the raw reference would race the step loop and read a donated
+buffer; the copy gives the writer a buffer nothing else owns.
+"""
+from __future__ import annotations
+
+import warnings
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.scope import LoDTensor, Scope
+
+__all__ = ["Snapshot", "SnapshotEntry", "snapshot_scope",
+           "persistable_names"]
+
+# jitted device-side copy; without donation XLA may not alias the output
+# onto the input, so the result is a buffer the engine can never donate.
+# ONE call copies every captured array: jax.jit caches per input
+# signature, so a model with 100 distinct param shapes compiles one
+# executable per save signature instead of 100 (each a full remote
+# compile round-trip on TPU), and the whole snapshot is one dispatch.
+_device_copy = None
+
+
+def _copy_on_device(arrs: list) -> list:
+    global _device_copy
+    if _device_copy is None:
+        _device_copy = jax.jit(lambda xs: [jnp.copy(x) for x in xs])
+    if not arrs:
+        return []
+    return _device_copy(arrs)
+
+
+class SnapshotEntry:
+    """One tensor of a snapshot: global metadata plus the addressable
+    shards this process will write. ``shards`` is a list of
+    ``(index, data)`` where ``index`` is ``[[start, stop], ...]`` over
+    the global shape and ``data`` is an array-like (jax.Array copy or
+    host ndarray) still to be fetched by the writer."""
+
+    __slots__ = ("name", "global_shape", "dtype", "lod", "shards")
+
+    def __init__(self, name: str, global_shape, dtype, lod,
+                 shards: List[Tuple[list, object]]):
+        self.name = name
+        self.global_shape = tuple(int(d) for d in global_shape)
+        self.dtype = str(dtype)
+        self.lod = [list(map(int, level)) for level in (lod or [])]
+        self.shards = shards
+
+    @property
+    def sharded(self) -> bool:
+        if len(self.shards) != 1:
+            return True
+        index, _ = self.shards[0]
+        return any((b - a) != d
+                   for (a, b), d in zip(index, self.global_shape))
+
+    def __repr__(self):
+        return (f"SnapshotEntry({self.name!r}, {self.global_shape}, "
+                f"{self.dtype}, shards={len(self.shards)})")
+
+
+class Snapshot:
+    """An immutable capture of training state, safe to serialize from a
+    background thread while the step loop keeps running."""
+
+    __slots__ = ("entries",)
+
+    def __init__(self, entries: Sequence[SnapshotEntry]):
+        self.entries = list(entries)
+
+    def names(self):
+        return [e.name for e in self.entries]
+
+    def __len__(self):
+        return len(self.entries)
+
+
+def _normalize_index(index, shape) -> Optional[list]:
+    """jax shard index (tuple of slices) -> [[start, stop], ...];
+    None for non-unit strides (unsupported layouts are skipped)."""
+    out = []
+    for s, dim in zip(index, shape):
+        if s.step not in (None, 1):
+            return None
+        start = 0 if s.start is None else int(s.start)
+        stop = int(dim) if s.stop is None else int(s.stop)
+        out.append([start, stop])
+    return out
+
+
+def _full_index(shape) -> list:
+    return [[0, int(d)] for d in shape]
+
+
+def _jax_array_shards(arr) -> List[Tuple[list, object]]:
+    """Addressable shards this process is responsible for writing.
+    ``replica_id == 0`` picks exactly one owner per index globally, so
+    replicated tensors are written once across the fleet, and each
+    process of a sharded run writes only its own slices."""
+    shards = []
+    try:
+        addressable = arr.addressable_shards
+    except Exception:
+        addressable = None
+    if not addressable:
+        return [(_full_index(arr.shape), arr)]
+    for sh in addressable:
+        if sh.replica_id != 0:
+            continue
+        index = _normalize_index(sh.index, arr.shape)
+        if index is None:
+            # exotic layout; fall back to the full array (safe: a
+            # fully-addressable array can always be read whole)
+            return [(_full_index(arr.shape), arr)]
+        shards.append((index, sh.data))
+    if not shards:
+        # this process holds only replicas; nothing to write here
+        return []
+    return shards
+
+
+def persistable_names(program) -> List[str]:
+    """Names save_persistables would write for ``program`` (same
+    predicate as ``io._is_persistable``). Accepts a CompiledProgram —
+    the fleet hands its data-parallel wrapper straight through."""
+    from .. import io as _io
+    program = getattr(program, "_program", program)
+    return [v.name for v in program.list_vars() if _io._is_persistable(v)]
+
+
+def snapshot_scope(scope: Scope, names: Sequence[str],
+                   raise_on_missing: bool = True,
+                   include_rng: bool = True) -> Snapshot:
+    """Capture ``names`` from ``scope`` as a :class:`Snapshot`.
+
+    Near-zero pause: jax.Arrays are copied on-device (async enqueue);
+    host ndarrays are copied in host memory. Host-state objects that are
+    not array-like (e.g. evaluator accumulators) are skipped with a
+    warning — they cannot be checkpointed tensor-wise.
+    """
+    entries: List[SnapshotEntry] = []
+    skipped_host: List[str] = []
+    want = list(names)
+    if include_rng:
+        from ..core.engine import RNG_STATE_VAR
+        rng_var = scope.find_var(RNG_STATE_VAR)
+        if rng_var is not None and rng_var.is_initialized() and \
+                RNG_STATE_VAR not in want:
+            want.append(RNG_STATE_VAR)
+    live = scope.initialized_refs(want)
+    missing = sorted(set(want) - {n for n, _ in live})
+    device_items = []   # (name, lod, arr) awaiting the batched copy
+    for name, var in live:
+        value = var.get_value()
+        lod = value.lod() if isinstance(value, LoDTensor) else []
+        arr = value.array if isinstance(value, LoDTensor) else value
+        if isinstance(arr, jax.Array):
+            device_items.append((name, lod, arr))
+            continue
+        try:
+            host = np.array(arr, copy=True)
+        except Exception:
+            skipped_host.append(name)
+            continue
+        if host.dtype == object:
+            skipped_host.append(name)
+            continue
+        entries.append(SnapshotEntry(
+            name, host.shape, host.dtype.name, lod,
+            [(_full_index(host.shape), host)]))
+    copies = _copy_on_device([arr for _, _, arr in device_items])
+    for (name, lod, arr), copy in zip(device_items, copies):
+        shards = _jax_array_shards(copy)
+        if not shards:
+            continue  # a replica-only holder; the owner writes it
+        entries.append(SnapshotEntry(
+            name, arr.shape, np.dtype(arr.dtype).name, lod, shards))
+    if missing:
+        if raise_on_missing:
+            raise ValueError(
+                f"checkpoint snapshot: persistable variable(s) "
+                f"{sorted(missing)} are missing or uninitialized in the "
+                f"scope — a checkpoint must not silently omit "
+                f"parameters (pass raise_on_missing=False to skip)")
+        warnings.warn(
+            f"checkpoint snapshot skipped missing/uninitialized "
+            f"variables: {sorted(missing)}", stacklevel=2)
+    if skipped_host:
+        warnings.warn(
+            f"checkpoint snapshot skipped non-tensor host-state "
+            f"variables: {sorted(skipped_host)}", stacklevel=2)
+    return Snapshot(entries)

@@ -1,0 +1,357 @@
+"""Checkpoint serialization + the crash-safe commit protocol.
+
+Write side (runs on the background writer thread):
+
+1. shards serialize into ``step_N.tmp/shard_{p}.bin`` — per tensor
+   shard a small JSON-metadata chunk followed by the ``.npy`` payload,
+   CRC32'd; the file is fsync'd;
+2. the process manifest ``manifest_{p}.json`` is written and fsync'd;
+3. process 0 waits for every process manifest, merges them into
+   ``manifest.json`` (fsync), fsyncs the tmp directory, and atomically
+   commits with ``os.replace(step_N.tmp, step_N)``;
+4. only after the rename is durable (parent dir fsync) is the
+   ``LATEST`` pointer swapped — itself via tmp-file + ``os.replace``.
+
+A crash at ANY point leaves either (a) a stale ``.tmp`` directory that
+restore never reads, or (b) a fully-committed step that ``LATEST`` does
+not yet name — in which case restore follows the old pointer to the
+previous complete checkpoint. ``LATEST`` can never name a partial step.
+
+Read side: ``read_step`` verifies CRC32s against the manifest and
+assembles global tensors from (possibly resharded) index'd shards, so a
+checkpoint written by P processes restores on any device count.
+"""
+from __future__ import annotations
+
+import contextlib
+import io as _io
+import json
+import os
+import shutil
+import struct
+import time
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import manifest as mf
+from .manifest import CheckpointCorrupt
+from .snapshot import Snapshot
+
+_MAGIC = b"PTS1"
+_HEADER = struct.Struct("<II")  # meta_len, payload_len
+
+
+# ---------------------------------------------------------------------------
+# atomic file primitives (shared with io.save_vars / async_ps snapshots)
+# ---------------------------------------------------------------------------
+
+def fsync_dir(path: str) -> None:
+    """Make a directory entry (create/rename within it) durable."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # e.g. platforms without O_RDONLY dirs; best effort
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+@contextlib.contextmanager
+def atomic_write(path: str, mode: str = "wb"):
+    """Write-to-sibling-then-rename: the file at ``path`` is either the
+    complete new content or the previous content — never a truncated
+    intermediate. The tmp sibling lives in the same directory so the
+    ``os.replace`` is a same-filesystem atomic rename."""
+    tmp = path + ".tmp"
+    f = open(tmp, mode)
+    try:
+        yield f
+        f.flush()
+        os.fsync(f.fileno())
+        f.close()
+        os.replace(tmp, path)
+        fsync_dir(os.path.dirname(os.path.abspath(path)))
+    except BaseException:
+        with contextlib.suppress(Exception):
+            f.close()
+        with contextlib.suppress(OSError):
+            os.remove(tmp)
+        raise
+
+
+# ---------------------------------------------------------------------------
+# shard serialization
+# ---------------------------------------------------------------------------
+
+def _encode_payload(arr: np.ndarray) -> bytes:
+    buf = _io.BytesIO()
+    np.save(buf, arr, allow_pickle=False)
+    return buf.getvalue()
+
+
+def _decode_payload(payload: bytes, dtype: str) -> np.ndarray:
+    arr = np.load(_io.BytesIO(payload), allow_pickle=False)
+    if arr.dtype.name != dtype:
+        # exotic dtypes (bfloat16, float8_*) round-trip npy as raw void
+        # bytes; the manifest carries the logical dtype to view back
+        arr = arr.view(np.dtype(dtype))
+    return arr
+
+
+def write_process_shard(tmp_dir: str, snapshot: Snapshot, step: int,
+                        process_index: int, process_count: int) -> dict:
+    """Serialize this process's shards + manifest into ``tmp_dir``.
+    Returns the process manifest dict. The D2H happens here (np.asarray
+    on the snapshot's device copies) — on the writer thread, off the
+    step loop."""
+    os.makedirs(tmp_dir, exist_ok=True)
+    shard_name = mf.shard_file_name(process_index)
+    tensors: Dict[str, dict] = {}
+    with open(os.path.join(tmp_dir, shard_name), "wb") as f:
+        for entry in snapshot.entries:
+            shard_recs: List[dict] = []
+            for index, data in entry.shards:
+                host = np.asarray(data)
+                payload = _encode_payload(host)
+                crc = zlib.crc32(payload)
+                meta = json.dumps({
+                    "name": entry.name, "index": index,
+                    "dtype": entry.dtype, "lod": entry.lod,
+                }).encode("utf-8")
+                f.write(_MAGIC)
+                f.write(_HEADER.pack(len(meta), len(payload)))
+                f.write(meta)
+                offset = f.tell()
+                f.write(payload)
+                shard_recs.append(mf.shard_entry(
+                    shard_name, offset, len(payload), index, crc))
+            tensors[entry.name] = mf.tensor_entry(
+                entry.global_shape, entry.dtype, entry.lod,
+                "sharded" if entry.sharded else "replicated",
+                shard_recs)
+        f.flush()
+        os.fsync(f.fileno())
+    proc_manifest = mf.build_manifest(step, process_index,
+                                      process_count, tensors)
+    mf.write_manifest(
+        os.path.join(tmp_dir, mf.process_manifest_name(process_index)),
+        proc_manifest)
+    fsync_dir(tmp_dir)
+    return proc_manifest
+
+
+# ---------------------------------------------------------------------------
+# commit protocol
+# ---------------------------------------------------------------------------
+
+def _wait_for_process_manifests(tmp_dir: str, process_count: int,
+                                timeout: float) -> List[str]:
+    deadline = time.monotonic() + timeout
+    want = [os.path.join(tmp_dir, mf.process_manifest_name(p))
+            for p in range(process_count)]
+    while True:
+        present = [p for p in want if os.path.exists(p)]
+        if len(present) == len(want):
+            return want
+        if time.monotonic() >= deadline:
+            missing = [os.path.basename(p) for p in want
+                       if p not in present]
+            raise TimeoutError(
+                f"checkpoint commit timed out after {timeout:.0f}s "
+                f"waiting for process shards {missing} in {tmp_dir!r}")
+        time.sleep(0.05)
+
+
+def _write_latest(root: str, step: int) -> None:
+    """Swap the LATEST pointer — strictly the last act of a commit.
+    (Module-level so tests can monkeypatch it to simulate a crash
+    between the step rename and the pointer update.)"""
+    with atomic_write(os.path.join(root, mf.LATEST_FILE), "w") as f:
+        f.write(mf.step_dir_name(step) + "\n")
+
+
+def commit_step(root: str, step: int, process_count: int,
+                commit_timeout: float = 300.0,
+                update_latest: bool = True) -> str:
+    """Process-0 commit: merge manifests, rename tmp -> final, swap
+    LATEST. Returns the committed step directory path."""
+    tmp_dir = os.path.join(root, mf.tmp_dir_name(step))
+    final_dir = os.path.join(root, mf.step_dir_name(step))
+    if os.path.exists(final_dir):
+        raise FileExistsError(
+            f"checkpoint step {step} already committed at {final_dir!r}")
+    paths = _wait_for_process_manifests(tmp_dir, process_count,
+                                        commit_timeout)
+    merged = mf.merge_manifests([mf.read_manifest(p) for p in paths])
+    mf.write_manifest(os.path.join(tmp_dir, mf.MERGED_MANIFEST), merged)
+    fsync_dir(tmp_dir)
+    os.replace(tmp_dir, final_dir)
+    fsync_dir(root)
+    if update_latest:
+        _write_latest(root, step)
+    return final_dir
+
+
+def gc_steps(root: str, keep_last_k: Optional[int],
+             keep_every_n: Optional[int]) -> List[int]:
+    """Retention: delete committed steps that are neither in the newest
+    K nor multiples of N; the LATEST target is always kept. Stale
+    ``.tmp`` directories of steps older than the newest committed step
+    (crash leftovers) are swept too. Returns deleted step numbers."""
+    steps = mf.list_steps(root, complete_only=True)
+    if not steps:
+        return []
+    newest = steps[-1]
+    latest = mf.read_latest(root)
+    keep = set(steps[-keep_last_k:]) if keep_last_k else set()
+    if keep_last_k is None and keep_every_n is None:
+        return []
+    if keep_every_n:
+        keep.update(s for s in steps if s % keep_every_n == 0)
+    if latest is not None:
+        keep.add(latest)
+    keep.add(newest)
+    deleted = []
+    for s in steps:
+        if s not in keep:
+            shutil.rmtree(os.path.join(root, mf.step_dir_name(s)),
+                          ignore_errors=True)
+            deleted.append(s)
+    for name in os.listdir(root):
+        if name.endswith(".tmp"):
+            s = mf.parse_step_dir(name[:-4])
+            if s is not None and s < newest:
+                shutil.rmtree(os.path.join(root, name),
+                              ignore_errors=True)
+    return deleted
+
+
+# ---------------------------------------------------------------------------
+# read / verify side
+# ---------------------------------------------------------------------------
+
+def _manifest_for_step(root: str, step: int) -> dict:
+    step_dir = os.path.join(root, mf.step_dir_name(step))
+    merged = os.path.join(step_dir, mf.MERGED_MANIFEST)
+    if os.path.exists(merged):
+        return mf.read_manifest(merged)
+    # tolerate a pre-merge layout only if every process manifest exists
+    parts = sorted(n for n in os.listdir(step_dir)
+                   if n.startswith("manifest_") and n.endswith(".json"))
+    if not parts:
+        raise CheckpointCorrupt(
+            f"checkpoint step {step} at {step_dir!r} has no manifest")
+    manifests = [mf.read_manifest(os.path.join(step_dir, n))
+                 for n in parts]
+    if len(manifests) < manifests[0]["process_count"]:
+        raise CheckpointCorrupt(
+            f"checkpoint step {step} is incomplete: "
+            f"{len(manifests)}/{manifests[0]['process_count']} process "
+            f"manifests present")
+    return mf.merge_manifests(manifests)
+
+
+def _read_shard_payload(step_dir: str, shard: dict,
+                        verify: bool) -> bytes:
+    path = os.path.join(step_dir, shard["file"])
+    try:
+        with open(path, "rb") as f:
+            f.seek(shard["offset"])
+            payload = f.read(shard["nbytes"])
+    except OSError as exc:
+        raise CheckpointCorrupt(
+            f"checkpoint shard file {path!r} unreadable: {exc}") from exc
+    if len(payload) != shard["nbytes"]:
+        raise CheckpointCorrupt(
+            f"checkpoint shard file {path!r} truncated: wanted "
+            f"{shard['nbytes']} bytes at {shard['offset']}, got "
+            f"{len(payload)}")
+    if verify and zlib.crc32(payload) != shard["crc32"]:
+        raise CheckpointCorrupt(
+            f"checksum mismatch in {path!r} at offset "
+            f"{shard['offset']} (expected crc32 {shard['crc32']}) — "
+            f"refusing to restore corrupt data")
+    return payload
+
+
+def assemble_tensor(step_dir: str, name: str, entry: dict,
+                    verify: bool = True) -> np.ndarray:
+    """Global tensor from its shard set — reshards transparently onto
+    the reader (any device count): each shard lands at its recorded
+    index range."""
+    shape = tuple(entry["global_shape"])
+    dtype = entry["dtype"]
+    shards = entry["shards"]
+    if not shards:
+        raise CheckpointCorrupt(
+            f"tensor {name!r} has no shards in the manifest")
+    if len(shards) == 1 and all(
+            (b - a) == d
+            for (a, b), d in zip(shards[0]["index"], shape)):
+        payload = _read_shard_payload(step_dir, shards[0], verify)
+        arr = _decode_payload(payload, dtype)
+        if tuple(arr.shape) != shape:
+            raise CheckpointCorrupt(
+                f"tensor {name!r}: payload shape {tuple(arr.shape)} "
+                f"!= manifest shape {shape}")
+        return arr
+    out = np.empty(shape, dtype=np.dtype(dtype))
+    covered = 0
+    for shard in shards:
+        payload = _read_shard_payload(step_dir, shard, verify)
+        piece = _decode_payload(payload, dtype)
+        slices = tuple(slice(a, b) for a, b in shard["index"])
+        want = tuple(b - a for a, b in shard["index"])
+        if tuple(piece.shape) != want:
+            raise CheckpointCorrupt(
+                f"tensor {name!r}: shard shape {tuple(piece.shape)} "
+                f"!= index extent {want}")
+        out[slices] = piece
+        covered += int(np.prod(want)) if want else 1
+    total = int(np.prod(shape)) if shape else 1
+    if covered != total:
+        raise CheckpointCorrupt(
+            f"tensor {name!r}: shards cover {covered} of {total} "
+            f"elements — incomplete sharded checkpoint")
+    return out
+
+
+def read_step(root: str, step: int, names: Optional[List[str]] = None,
+              verify: bool = True) -> Dict[str, Tuple[np.ndarray, list]]:
+    """``{name: (global_array, lod)}`` for ``names`` (default: all
+    tensors in the manifest) of a committed step."""
+    man = _manifest_for_step(root, step)
+    step_dir = os.path.join(root, mf.step_dir_name(step))
+    tensors = man["tensors"]
+    wanted = list(tensors) if names is None else names
+    out = {}
+    for name in wanted:
+        entry = tensors.get(name)
+        if entry is None:
+            raise CheckpointCorrupt(
+                f"checkpoint step {step} has no tensor {name!r} — "
+                f"partial/incompatible checkpoint")
+        out[name] = (assemble_tensor(step_dir, name, entry, verify),
+                     entry.get("lod") or [])
+    return out
+
+
+def verify_step(root: str, step: int) -> List[str]:
+    """Recompute every shard CRC of a step; returns a list of problem
+    descriptions (empty = clean). Never raises on corruption — this is
+    the inspection path (tools/ckpt_inspect.py)."""
+    problems: List[str] = []
+    try:
+        man = _manifest_for_step(root, step)
+    except CheckpointCorrupt as exc:
+        return [str(exc)]
+    step_dir = os.path.join(root, mf.step_dir_name(step))
+    for name, entry in sorted(man["tensors"].items()):
+        try:
+            assemble_tensor(step_dir, name, entry, verify=True)
+        except CheckpointCorrupt as exc:
+            problems.append(f"{name}: {exc}")
+    return problems

@@ -812,9 +812,14 @@ class Engine:
         # dispatch instrumentation (asserted by tests/test_async_dispatch
         # .py: steady state must show zero new traces / sig builds /
         # device_puts)
+        # ckpt_saves / ckpt_inflight are maintained by CheckpointManager
+        # instances constructed with engine=<this engine>: inflight
+        # returns to 0 once every queued async save is durable
+        # (docs/CHECKPOINTING.md)
         self.counters: Dict[str, int] = {
             "runs": 0, "fast_path_hits": 0, "traces": 0,
-            "sig_builds": 0, "device_puts": 0}
+            "sig_builds": 0, "device_puts": 0,
+            "ckpt_saves": 0, "ckpt_inflight": 0}
         # feed names that are identical on every process under multihost
         # SPMD (shared tables, per-step constants) — globalized by
         # replication instead of batch-dim concatenation

@@ -63,6 +63,13 @@ _define("async_dispatch", False, True,
         "host work overlaps step N's device compute and D2H; ignored "
         "while FLAGS_benchmark forces per-step sync (docs/ASYNC_DISPATCH"
         ".md)")
+_define("async_checkpoint", False, True,
+        "route io.save_persistables/load_persistables (and the fleet "
+        "save paths) through the async sharded checkpoint subsystem "
+        "(paddle_tpu/checkpoint): snapshot on the step-loop thread, "
+        "background D2H + serialization, atomic commit with manifest + "
+        "checksums, LATEST pointer updated last "
+        "(docs/CHECKPOINTING.md)")
 _define("paddle_num_threads", 2, True,
         "default reader worker threads for the native data feed")
 _define("seed", 0, True, "global default RNG seed when a Program sets none")

@@ -203,6 +203,13 @@ class Scope:
         see docs/ASYNC_DISPATCH.md)."""
         return [(n, self.var(n)) for n in names]
 
+    def initialized_refs(self, names):
+        """`var_refs` filtered to initialized variables — the
+        checkpoint snapshot's read set (a missing/uninitialized
+        persistable is the caller's policy decision: warn or raise)."""
+        return [(n, v) for n, v in self.var_refs(names)
+                if v.is_initialized()]
+
     def new_scope(self) -> "Scope":
         kid = Scope(self)
         self._kids.append(kid)

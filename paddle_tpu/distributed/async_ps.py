@@ -286,10 +286,15 @@ class AsyncParameterServer:
                     d = msg["dir"]
                     os.makedirs(d, exist_ok=True)
                     from ..io import _serialize_tensor
+                    from ..checkpoint.writer import atomic_write
                     with self._lock:
                         saved = []
                         for n in self._ckpt_vars:
-                            with open(os.path.join(d, n), "wb") as f:
+                            # atomic per-var write: a server killed
+                            # mid-snapshot leaves the previous complete
+                            # file (or nothing), never a truncated one
+                            # load_shard would trust
+                            with atomic_write(os.path.join(d, n)) as f:
                                 _serialize_tensor(
                                     f, n, np.asarray(self._get_var(n)))
                             saved.append(n)

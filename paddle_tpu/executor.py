@@ -34,11 +34,31 @@ class Executor:
     def __init__(self, place: Optional[Place] = None):
         self.place = place if place is not None else default_place()
         self._engine = Engine()
+        self._ckpt_managers = {}
         self._closed = False
 
     def close(self):
         self._closed = True
+        managers, self._ckpt_managers = self._ckpt_managers, {}
+        for m in managers.values():
+            m.close()   # drain in-flight checkpoint saves
         self._engine = Engine()
+
+    def checkpoint_manager(self, dirname, **options):
+        """The async checkpoint subsystem bound to this executor: the
+        returned :class:`~paddle_tpu.checkpoint.CheckpointManager`
+        reports save-in-flight counts through this executor's
+        ``Engine.counters`` (``ckpt_saves`` / ``ckpt_inflight``) and is
+        drained by :meth:`close`. One manager per directory is cached —
+        repeated calls return the same instance
+        (docs/CHECKPOINTING.md)."""
+        m = self._ckpt_managers.get(dirname)
+        if m is None:
+            from .checkpoint import CheckpointManager
+            m = CheckpointManager(dirname, engine=self._engine,
+                                  **options)
+            self._ckpt_managers[dirname] = m
+        return m
 
     def run(self, program=None, feed=None, fetch_list=None, feed_var_name="feed",
             fetch_var_name="fetch", scope=None, return_numpy=True,

@@ -94,6 +94,57 @@ class Fleet(abc.ABC):
     def save_persistables(self, executor, dirname, main_program=None):
         ...
 
+    # -- fault-tolerant sharded checkpoints (paddle_tpu/checkpoint) --------
+    # Concrete on the base class: the protocol is identical for every
+    # fleet mode — each worker writes only its addressable shards
+    # (process_index=worker_index()) and worker 0 merges the manifests
+    # and commits. See docs/CHECKPOINTING.md.
+
+    def checkpoint_manager(self, dirname, executor=None, **options):
+        """A CheckpointManager wired to this fleet's topology."""
+        from ....checkpoint import CheckpointManager
+        engine = None
+        if executor is not None:
+            engine = getattr(executor, "_engine", None)
+        options.setdefault("process_index", self.worker_index())
+        options.setdefault("process_count", self.worker_num())
+        return CheckpointManager(dirname, engine=engine, **options)
+
+    def save_checkpoint(self, executor, dirname, step, main_program=None,
+                        scope=None, sync=True, **options):
+        """Write checkpoint ``step``: every worker calls this with the
+        same ``step``; worker 0 commits once all shards have landed.
+        ``sync=False`` returns a SaveHandle immediately (async save) —
+        the caller must keep the manager alive via ``handle.wait()``.
+        """
+        from ....core.scope import global_scope
+        manager = self.checkpoint_manager(dirname, executor=executor,
+                                          **options)
+        handle = manager.save(
+            step, scope=scope or global_scope(),
+            program=main_program or getattr(self, "main_program", None),
+            sync=sync)
+        if sync:
+            manager.close()
+        return handle
+
+    def load_checkpoint(self, executor, dirname, step=None,
+                        main_program=None, scope=None, **options):
+        """Restore the LATEST (or ``step``) checkpoint into the scope,
+        resharding onto this run's device topology. Returns the step
+        restored."""
+        from ....core.scope import global_scope
+        manager = self.checkpoint_manager(dirname, executor=executor,
+                                          **options)
+        try:
+            return manager.restore(
+                step=step, scope=scope or global_scope(),
+                program=main_program or getattr(self, "main_program",
+                                                None),
+                place=getattr(executor, "place", None))
+        finally:
+            manager.close()
+
 
 class DistributedOptimizer(abc.ABC):
     """Wrapper contract (fleet_base.py:224): same minimize() surface as a

@@ -90,9 +90,18 @@ class Collective(Fleet):
                                 self.main_program)
 
     def save_persistables(self, executor, dirname, main_program=None):
+        """A fleet save is a checkpoint: missing persistables are an
+        error (raise_on_missing=True), not a warning — a collective
+        worker whose scope lacks a parameter would write a checkpoint
+        other workers cannot restore. Under FLAGS_async_checkpoint the
+        write goes through the sharded subsystem; ``save_checkpoint``
+        (fleet_base) is the richer API with explicit steps/retention."""
         from .... import io
-        io.save_persistables(executor, dirname,
-                             main_program or self.main_program)
+        program = main_program or self._origin_program or \
+            self.main_program
+        program = getattr(program, "_program", program)
+        io.save_persistables(executor, dirname, program,
+                             raise_on_missing=True)
 
 
 fleet = Collective()

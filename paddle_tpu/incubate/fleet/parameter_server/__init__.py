@@ -135,9 +135,17 @@ class ParameterServerFleet(Fleet):
                                 main_program or self.main_program)
 
     def save_persistables(self, executor, dirname, main_program=None):
+        """Checkpoint caller: missing persistables abort the save
+        (raise_on_missing=True) instead of warning — the transpiled
+        trainer program's save must be complete to be restorable. The
+        ORIGIN program supplies the var list: the trainer program's
+        persistable set is the post-transpile one (split params etc.)
+        and would not match what init_server/load expects."""
         from .... import io
-        io.save_persistables(executor, dirname,
-                             main_program or self.main_program)
+        program = main_program or self._origin_program or \
+            self.main_program
+        io.save_persistables(executor, dirname, program,
+                             raise_on_missing=True)
 
 
 fleet = ParameterServerFleet()

@@ -12,15 +12,17 @@ through Program.parse_from_string.
 from __future__ import annotations
 
 import io as _io
+import json
 import os
-import pickle
 import struct
+import warnings
 from typing import List, Optional, Sequence
 
 import numpy as np
 
 from . import framework
 from .framework import Program, Variable, default_main_program
+from .core.flags import FLAGS
 from .core.scope import LoDTensor, Scope, global_scope
 from .core.types import dtype_to_np
 
@@ -51,7 +53,13 @@ def _serialize_tensor(buf, name: str, value) -> None:
     lod = value.lod() if isinstance(value, LoDTensor) else []
     payload = _io.BytesIO()
     np.save(payload, arr, allow_pickle=False)
-    meta = pickle.dumps({"name": name, "lod": lod})
+    # JSON metadata, not pickle: checkpoint files cross trust boundaries
+    # (shipped between machines, restored by pservers) and unpickling
+    # them would execute attacker-chosen reduce callables — the same
+    # hardening PR 1 applied to async_ps RPC payloads
+    meta = json.dumps({"name": name,
+                       "lod": [[int(x) for x in lvl]
+                               for lvl in lod]}).encode("utf-8")
     buf.write(_MAGIC)
     buf.write(struct.pack("<II", len(meta), payload.getbuffer().nbytes))
     buf.write(meta)
@@ -66,7 +74,14 @@ def _deserialize_tensors(buf):
             break
         assert head == _MAGIC, "corrupt checkpoint chunk"
         meta_len, data_len = struct.unpack("<II", buf.read(8))
-        meta = pickle.loads(buf.read(meta_len))
+        raw_meta = buf.read(meta_len)
+        try:
+            meta = json.loads(raw_meta.decode("utf-8"))
+        except (UnicodeDecodeError, ValueError):
+            raise ValueError(
+                "tensor file carries non-JSON (legacy pickle?) "
+                "metadata; refusing to unpickle untrusted checkpoint "
+                "data — re-save with this build") from None
         arr = np.load(_io.BytesIO(buf.read(data_len)),
                       allow_pickle=False)
         out[meta["name"]] = (arr, meta["lod"])
@@ -74,37 +89,70 @@ def _deserialize_tensors(buf):
 
 
 def save_vars(executor, dirname, main_program=None, vars=None,
-              predicate=None, filename=None):
+              predicate=None, filename=None, raise_on_missing=False):
     main_program = main_program or default_main_program()
     if vars is None:
         vars = [v for v in main_program.list_vars()
                 if predicate is None or predicate(v)]
     scope = global_scope()
+    present, skipped = [], []
+    for v in vars:
+        sv = scope.find_var(v.name)
+        if sv is None or not sv.is_initialized():
+            skipped.append(v.name)
+        else:
+            present.append((v, sv))
+    if skipped:
+        # checked BEFORE any file is written: a checkpoint caller
+        # (raise_on_missing=True) must not leave a half-saved dir
+        if raise_on_missing:
+            raise ValueError(
+                f"save_vars: variable(s) {sorted(skipped)} are missing "
+                f"or uninitialized in the scope — refusing to write a "
+                f"checkpoint that silently omits parameters")
+        warnings.warn(
+            f"save_vars skipped missing/uninitialized variables: "
+            f"{sorted(skipped)}", stacklevel=2)
+    from .checkpoint.writer import atomic_write
     os.makedirs(dirname, exist_ok=True)
     if filename is not None:
-        with open(os.path.join(dirname, filename), "wb") as f:
-            for v in vars:
-                sv = scope.find_var(v.name)
-                if sv is None or not sv.is_initialized():
-                    continue
+        # .tmp sibling + os.replace: a crash mid-save can truncate only
+        # the tmp file, never the file at the final path
+        with atomic_write(os.path.join(dirname, filename)) as f:
+            for v, sv in present:
                 _serialize_tensor(f, v.name, sv.get_value())
     else:
-        for v in vars:
-            sv = scope.find_var(v.name)
-            if sv is None or not sv.is_initialized():
-                continue
-            with open(os.path.join(dirname, v.name), "wb") as f:
+        for v, sv in present:
+            with atomic_write(os.path.join(dirname, v.name)) as f:
                 _serialize_tensor(f, v.name, sv.get_value())
 
 
-def save_params(executor, dirname, main_program=None, filename=None):
+def save_params(executor, dirname, main_program=None, filename=None,
+                raise_on_missing=False):
     return save_vars(executor, dirname, main_program,
-                     predicate=_is_parameter, filename=filename)
+                     predicate=_is_parameter, filename=filename,
+                     raise_on_missing=raise_on_missing)
 
 
-def save_persistables(executor, dirname, main_program=None, filename=None):
+def save_persistables(executor, dirname, main_program=None, filename=None,
+                      raise_on_missing=False):
+    """Durable training state. Under ``FLAGS_async_checkpoint`` this
+    routes through the sharded checkpoint subsystem
+    (paddle_tpu/checkpoint): atomic commit, manifest + checksums, one
+    step directory per call; ``load_persistables`` detects the layout,
+    so the two formats interoperate (docs/CHECKPOINTING.md)."""
+    if FLAGS.async_checkpoint and filename is None:
+        from .checkpoint import CheckpointManager
+        main_program = main_program or default_main_program()
+        with CheckpointManager(dirname) as m:
+            steps = m.all_steps()
+            m.save((steps[-1] + 1) if steps else 1,
+                   scope=global_scope(), program=main_program,
+                   sync=True, raise_on_missing=True)
+        return
     return save_vars(executor, dirname, main_program,
-                     predicate=_is_persistable, filename=filename)
+                     predicate=_is_persistable, filename=filename,
+                     raise_on_missing=raise_on_missing)
 
 
 def _restore(scope, name, arr, lod, place):
@@ -157,6 +205,19 @@ def load_params(executor, dirname, main_program=None, filename=None):
 
 
 def load_persistables(executor, dirname, main_program=None, filename=None):
+    """Restore training state. Detects the on-disk layout: a checkpoint
+    subsystem directory (LATEST pointer / step_* dirs) restores through
+    paddle_tpu/checkpoint — checksum-verified, resharded onto this
+    process — regardless of ``FLAGS_async_checkpoint``; anything else
+    takes the legacy one-file-per-var path."""
+    from .checkpoint import CheckpointManager, is_checkpoint_dir
+    if filename is None and is_checkpoint_dir(dirname):
+        main_program = main_program or default_main_program()
+        place = executor.place if executor is not None else None
+        with CheckpointManager(dirname) as m:
+            m.restore(scope=global_scope(), program=main_program,
+                      place=place)
+        return
     return load_vars(executor, dirname, main_program,
                      predicate=_is_persistable, filename=filename)
 
@@ -202,10 +263,11 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     model_path = os.path.join(dirname, model_filename or "__model__")
     meta = {"feed": list(feeded_var_names), "fetch": fetch_names}
     from .core.op_version import stamp_program
+    from .checkpoint.writer import atomic_write
     proto = stamp_program(pruned.to_proto())
-    with open(model_path, "wb") as f:
-        f.write(struct.pack("<I", 1))  # format version
-        meta_b = pickle.dumps(meta)
+    with atomic_write(model_path) as f:
+        f.write(struct.pack("<I", 2))  # format version (2 = JSON meta)
+        meta_b = json.dumps(meta).encode("utf-8")
         f.write(struct.pack("<I", len(meta_b)))
         f.write(meta_b)
         f.write(proto.SerializeToString())
@@ -221,7 +283,14 @@ def load_inference_model(dirname, executor, model_filename=None,
     with open(model_path, "rb") as f:
         (_ver,) = struct.unpack("<I", f.read(4))
         (meta_len,) = struct.unpack("<I", f.read(4))
-        meta = pickle.loads(f.read(meta_len))
+        raw_meta = f.read(meta_len)
+        try:
+            meta = json.loads(raw_meta.decode("utf-8"))
+        except (UnicodeDecodeError, ValueError):
+            raise ValueError(
+                f"inference model {model_path!r} carries non-JSON "
+                f"(legacy pickle?) metadata; refusing to unpickle — "
+                f"re-export with this build") from None
         from .proto import framework_pb2 as _fpb
         from .core.op_version import check_program
         proto = _fpb.ProgramDesc()

@@ -1,0 +1,397 @@
+"""The fault-tolerant async sharded checkpoint subsystem
+(paddle_tpu/checkpoint, docs/CHECKPOINTING.md):
+
+* async save/restore parity — the snapshot is isolated from the engine's
+  buffer donation, so training keeps mutating params while the writer
+  serializes the captured state;
+* save-in-flight visibility in Engine.counters via
+  Executor.checkpoint_manager;
+* checksum verification — a flipped byte is CheckpointCorrupt, never a
+  silently-wrong restore;
+* retention GC (keep-last-K + keep-every-N);
+* resharding — a checkpoint written sharded over 4 devices (and one
+  written by 2 "processes") restores single-process;
+* SIGTERM preemption hook — final sync save + previous handler chained;
+* FLAGS_async_checkpoint routing of io.save/load_persistables;
+* tools/ckpt_inspect.py exit codes (lint_program convention);
+* legacy save_vars hardening (skip-warning + raise_on_missing).
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.scope import Scope
+from paddle_tpu.checkpoint import (CheckpointCorrupt, CheckpointManager,
+                                   is_checkpoint_dir)
+from paddle_tpu.checkpoint.snapshot import Snapshot, SnapshotEntry
+from paddle_tpu.checkpoint import writer as ckpt_writer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _build():
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [6], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        h = layers.fc(x, 16, act="tanh",
+                      param_attr=fluid.ParamAttr(name="cw0"))
+        pred = layers.fc(h, 1, param_attr=fluid.ParamAttr(name="cw1"))
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.AdamOptimizer(learning_rate=0.05).minimize(loss)
+    return main, startup, loss
+
+
+def _batch(step):
+    rng = np.random.RandomState(7000 + step)
+    xs = rng.rand(8, 6).astype(np.float32)
+    return {"x": xs, "y": xs.sum(1, keepdims=True).astype(np.float32)}
+
+
+def _param(scope, name):
+    v = scope.find_var(name).get_value()
+    return np.asarray(v.array if hasattr(v, "array") else v)
+
+
+# ------------------------------------------------------ async save parity
+
+def test_async_save_isolated_from_training(tmp_path):
+    """save() captures step-k state; training continues (the engine
+    DONATES the captured buffers' originals on the very next step);
+    restore reproduces step-k values exactly."""
+    root = str(tmp_path / "ck")
+    main, startup, loss = _build()
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for i in range(3):
+            exe.run(main, feed=_batch(i), fetch_list=[loss.name])
+        at_save = {n: _param(scope, n).copy() for n in ("cw0", "cw1")}
+        m = exe.checkpoint_manager(root)
+        handle = m.save(3, scope=scope, program=main)
+        # keep training while the writer serializes — mutates (and
+        # donates) every param the snapshot captured
+        for i in range(3, 8):
+            exe.run(main, feed=_batch(i), fetch_list=[loss.name])
+        handle.wait(timeout=60)
+        m.wait_all()
+        assert not np.array_equal(_param(scope, "cw0"), at_save["cw0"])
+
+    main2, _, _ = _build()
+    scope2 = Scope()
+    with fluid.scope_guard(scope2):
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        m2 = exe2.checkpoint_manager(root)
+        assert m2.restore(scope=scope2, program=main2,
+                          place=exe2.place) == 3
+        exe2.close()
+    for n in ("cw0", "cw1"):
+        np.testing.assert_array_equal(_param(scope2, n), at_save[n])
+
+
+def test_engine_counters_track_saves(tmp_path):
+    main, startup, loss = _build()
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        exe.run(main, feed=_batch(0), fetch_list=[loss.name])
+        m = exe.checkpoint_manager(str(tmp_path / "ck"))
+        assert exe._engine.counters["ckpt_saves"] == 0
+        for s in (1, 2):
+            m.save(s, scope=scope, program=main)
+        m.wait_all()
+        assert exe._engine.counters["ckpt_saves"] == 2
+        assert exe._engine.counters["ckpt_inflight"] == 0
+        assert m.in_flight() == 0
+        # same dirname -> same cached manager; close() drains it
+        assert exe.checkpoint_manager(str(tmp_path / "ck")) is m
+        exe.close()
+        assert m._closed
+
+
+# ------------------------------------------------------------- checksums
+
+def _small_ckpt(root, step=1, extra=None):
+    scope = Scope()
+    scope.var("a").set_value(np.arange(12, dtype=np.float32)
+                             .reshape(3, 4))
+    scope.var("b").set_value(np.ones((5,), np.float32) * 7)
+    for name, val in (extra or {}).items():
+        scope.var(name).set_value(val)
+    names = ["a", "b"] + sorted(extra or {})
+    with CheckpointManager(root) as m:
+        m.save(step, scope=scope, vars=names, sync=True,
+               include_rng=False)
+    return scope
+
+
+def test_checksum_mismatch_rejected(tmp_path):
+    root = str(tmp_path / "ck")
+    _small_ckpt(root)
+    man = json.load(open(os.path.join(root, "step_00000001",
+                                      "manifest.json")))
+    shard = man["tensors"]["a"]["shards"][0]
+    path = os.path.join(root, "step_00000001", shard["file"])
+    with open(path, "r+b") as f:
+        f.seek(shard["offset"] + shard["nbytes"] - 1)
+        byte = f.read(1)
+        f.seek(shard["offset"] + shard["nbytes"] - 1)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    with CheckpointManager(root) as m:
+        with pytest.raises(CheckpointCorrupt, match="checksum"):
+            m.restore(step=1, scope=Scope(), vars=["a", "b"],
+                      include_rng=False)
+    problems = ckpt_writer.verify_step(root, 1)
+    assert len(problems) == 1 and "a:" in problems[0]
+    # verify=False restores without the integrity gate (explicit
+    # opt-out only)
+    sc = Scope()
+    with CheckpointManager(root) as m:
+        m.restore(step=1, scope=sc, vars=["b"], include_rng=False,
+                  verify=True)   # untouched tensor still verifies
+    np.testing.assert_array_equal(_param(sc, "b"),
+                                  np.ones((5,), np.float32) * 7)
+
+
+# ------------------------------------------------------------- retention
+
+def test_retention_keep_last_k_and_every_n(tmp_path):
+    root = str(tmp_path / "ck")
+    scope = Scope()
+    scope.var("w").set_value(np.zeros((4,), np.float32))
+    with CheckpointManager(root, keep_last_k=2, keep_every_n=4) as m:
+        for step in range(1, 9):
+            m.save(step, scope=scope, vars=["w"], sync=True,
+                   include_rng=False)
+        assert m.all_steps() == [4, 7, 8]
+        assert m.latest_step() == 8
+    # no retention knobs -> GC is a no-op
+    root2 = str(tmp_path / "ck2")
+    with CheckpointManager(root2) as m2:
+        for step in (1, 2, 3):
+            m2.save(step, scope=scope, vars=["w"], sync=True,
+                    include_rng=False)
+        assert m2.all_steps() == [1, 2, 3]
+
+
+# ------------------------------------------------------------ resharding
+
+def test_restore_resharded_from_4_devices(tmp_path):
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 devices (conftest forces 8 virtual)")
+    root = str(tmp_path / "ck")
+    mesh = Mesh(np.array(jax.devices()[:4]), ("dp",))
+    global_w = np.arange(64, dtype=np.float32).reshape(16, 4)
+    arr = jax.device_put(global_w,
+                         NamedSharding(mesh, PartitionSpec("dp", None)))
+    scope = Scope()
+    scope.var("w").set_value(arr)
+    scope.var("bias").set_value(
+        np.asarray(np.ones((3,), np.float16)))
+    with CheckpointManager(root) as m:
+        m.save(1, scope=scope, vars=["w", "bias"], sync=True,
+               include_rng=False)
+    man = json.load(open(os.path.join(root, "step_00000001",
+                                      "manifest.json")))
+    assert man["tensors"]["w"]["sharding"] == "sharded"
+    assert len(man["tensors"]["w"]["shards"]) == 4
+    # restore "on a different device count": plain single-process read
+    sc = Scope()
+    with CheckpointManager(root) as m2:
+        m2.restore(step=1, scope=sc, vars=["w", "bias"],
+                   include_rng=False)
+    np.testing.assert_array_equal(_param(sc, "w"), global_w)
+    assert _param(sc, "bias").dtype == np.float16
+
+
+def test_two_process_write_merges_and_restores(tmp_path):
+    """Two managers play a 2-process fleet: each writes only its half
+    of a row-sharded tensor; process 0 commits after process 1's shard
+    lands; a fresh single-process manager restores the global tensor."""
+    root = str(tmp_path / "ck")
+    full = np.arange(40, dtype=np.float32).reshape(8, 5)
+    halves = [
+        Snapshot([SnapshotEntry("w", (8, 5), "float32", [],
+                                [([[0, 4], [0, 5]], full[:4])])]),
+        Snapshot([SnapshotEntry("w", (8, 5), "float32", [],
+                                [([[4, 8], [0, 5]], full[4:])])]),
+    ]
+    m1 = CheckpointManager(root, process_index=1, process_count=2)
+    m1.save(1, snapshot=halves[1], sync=True)   # writes, doesn't commit
+    assert not os.path.exists(os.path.join(root, "step_00000001"))
+    m0 = CheckpointManager(root, process_index=0, process_count=2,
+                           commit_timeout=10)
+    m0.save(1, snapshot=halves[0], sync=True)   # merges + commits
+    m0.close(), m1.close()
+    sc = Scope()
+    with CheckpointManager(root) as m:
+        assert m.restore(scope=sc, vars=["w"], include_rng=False) == 1
+    np.testing.assert_array_equal(_param(sc, "w"), full)
+    man = json.load(open(os.path.join(root, "step_00000001",
+                                      "manifest.json")))
+    assert man["process_count"] == 2
+    assert len(man["tensors"]["w"]["shards"]) == 2
+
+
+# ------------------------------------------------------------ preemption
+
+def test_sigterm_hook_saves_then_chains(tmp_path):
+    root = str(tmp_path / "ck")
+    scope = Scope()
+    scope.var("w").set_value(np.full((4,), 3.0, np.float32))
+    seen = []
+    prev = signal.signal(signal.SIGTERM,
+                         lambda s, f: seen.append("prev"))
+    try:
+        m = CheckpointManager(root)
+        m.save(1, scope=scope, vars=["w"], sync=True,
+               include_rng=False)
+        m.install_preemption_hook()
+        scope.var("w").set_value(np.full((4,), 9.0, np.float32))
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert seen == ["prev"]          # previous disposition chained
+        assert m.all_steps() == [1, 2]   # final save at last step + 1
+        m.uninstall_preemption_hook()
+        m.close()
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+    sc = Scope()
+    with CheckpointManager(root) as m2:
+        assert m2.restore(scope=sc, vars=["w"],
+                          include_rng=False) == 2
+    np.testing.assert_array_equal(
+        _param(sc, "w"), np.full((4,), 9.0, np.float32))
+
+
+# ---------------------------------------------------------- flag routing
+
+def test_flag_routes_save_persistables_through_subsystem(tmp_path):
+    ckpt = str(tmp_path / "ck")
+    main, startup, loss = _build()
+    scope = Scope()
+    fluid.set_flags({"FLAGS_async_checkpoint": True})
+    try:
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            exe.run(main, feed=_batch(0), fetch_list=[loss.name])
+            fluid.io.save_persistables(exe, ckpt, main)
+            fluid.io.save_persistables(exe, ckpt, main)  # next step
+    finally:
+        fluid.set_flags({"FLAGS_async_checkpoint": False})
+    assert is_checkpoint_dir(ckpt)
+    assert os.path.exists(os.path.join(ckpt, "LATEST"))
+    with CheckpointManager(ckpt) as m:
+        assert m.all_steps() == [1, 2]
+    w = _param(scope, "cw1")
+    # load auto-detects the layout with the flag OFF
+    main2, _, _ = _build()
+    scope2 = Scope()
+    with fluid.scope_guard(scope2):
+        exe = fluid.Executor(fluid.CPUPlace())
+        fluid.io.load_persistables(exe, ckpt, main2)
+    np.testing.assert_array_equal(_param(scope2, "cw1"), w)
+
+
+# ------------------------------------------------------------------- CLI
+
+def test_ckpt_inspect_cli_exit_codes(tmp_path):
+    root = str(tmp_path / "ck")
+    _small_ckpt(root)
+    tool = os.path.join(REPO, "tools", "ckpt_inspect.py")
+
+    r = subprocess.run([sys.executable, tool, root, "--verify",
+                        "--tensors"], capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr + r.stdout
+    assert "LATEST" in r.stdout and "verified" in r.stdout
+
+    # corrupt one payload byte -> exit 1 naming the tensor
+    man = json.load(open(os.path.join(root, "step_00000001",
+                                      "manifest.json")))
+    shard = man["tensors"]["b"]["shards"][0]
+    path = os.path.join(root, "step_00000001", shard["file"])
+    with open(path, "r+b") as f:
+        f.seek(shard["offset"])
+        f.write(b"\xde\xad")
+    r = subprocess.run([sys.executable, tool, root, "--verify"],
+                       capture_output=True, text=True)
+    assert r.returncode == 1, r.stdout
+    assert "CORRUPT" in r.stdout and "b:" in r.stdout
+    # without --verify listing stays clean (CRCs not recomputed)
+    r = subprocess.run([sys.executable, tool, root],
+                       capture_output=True, text=True)
+    assert r.returncode == 0
+
+    # usage errors -> exit 2
+    r = subprocess.run([sys.executable, tool,
+                        str(tmp_path / "nope")],
+                       capture_output=True, text=True)
+    assert r.returncode == 2
+    r = subprocess.run([sys.executable, tool, str(tmp_path)],
+                       capture_output=True, text=True)
+    assert r.returncode == 2   # a dir, but not a checkpoint dir
+
+
+# -------------------------------------------------- legacy io hardening
+
+def test_save_vars_warns_listing_skipped(tmp_path):
+    main, startup, loss = _build()
+    # a persistable with no initializer and no produced value — the
+    # classic "declared but never written" hole save_vars must surface
+    main.global_block().create_var(name="ghost_state", shape=[1],
+                                   dtype="float32", persistable=True)
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        with pytest.warns(UserWarning, match="ghost_state"):
+            fluid.io.save_persistables(exe, str(tmp_path / "warn"),
+                                       main)
+        assert os.path.exists(str(tmp_path / "warn" / "cw1"))
+        assert not os.path.exists(str(tmp_path / "warn" /
+                                      "ghost_state"))
+        # checkpoint callers refuse to write a partial state
+        with pytest.raises(ValueError, match="refusing to write"):
+            fluid.io.save_persistables(exe, str(tmp_path / "strict"),
+                                       main, raise_on_missing=True)
+        assert not os.path.exists(str(tmp_path / "strict" / "cw1"))
+
+
+def test_legacy_tensor_files_are_json_not_pickle(tmp_path):
+    main, startup, loss = _build()
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        exe.run(main, feed=_batch(0), fetch_list=[loss.name])
+        fluid.io.save_persistables(exe, str(tmp_path / "leg"), main)
+    raw = open(str(tmp_path / "leg" / "cw1"), "rb").read()
+    meta_len = int.from_bytes(raw[4:8], "little")
+    meta = json.loads(raw[12:12 + meta_len])   # JSON, not pickle
+    assert meta["name"] == "cw1"
+    # a pickle-metadata file (pre-hardening format) is refused
+    import pickle
+    import struct as _struct
+    evil = pickle.dumps({"name": "cw1", "lod": []})
+    with open(str(tmp_path / "leg" / "cw1"), "wb") as f:
+        f.write(raw[:4])   # real magic, pickle metadata
+        f.write(_struct.pack("<II", len(evil), 0))
+        f.write(evil)
+    main2, _, _ = _build()
+    with fluid.scope_guard(Scope()):
+        exe = fluid.Executor(fluid.CPUPlace())
+        with pytest.raises(Exception, match="pickle|corrupt"):
+            fluid.io.load_persistables(exe, str(tmp_path / "leg"),
+                                       main2)

@@ -43,7 +43,19 @@ def _batch(step):
     return {"x": xs, "y": xs.sum(1, keepdims=True).astype(np.float32)}
 
 
-def test_preemption_resume_exact_trajectory(tmp_path):
+@pytest.fixture
+def async_ckpt_flag(request):
+    """Parametrize a test over the legacy and the async-subsystem
+    save paths; always restores the flag."""
+    fluid.set_flags({"FLAGS_async_checkpoint": request.param})
+    yield request.param
+    fluid.set_flags({"FLAGS_async_checkpoint": False})
+
+
+@pytest.mark.parametrize("async_ckpt_flag", [False, True],
+                         indirect=True,
+                         ids=["legacy", "async_subsystem"])
+def test_preemption_resume_exact_trajectory(tmp_path, async_ckpt_flag):
     ckpt = str(tmp_path / "ckpt")
 
     # uninterrupted run: 8 steps (snapshot the INIT first)
@@ -117,6 +129,68 @@ def test_resume_restores_optimizer_accumulators(tmp_path):
             if "moment" in n:
                 assert float(np.abs(np.asarray(
                     v.get_value())).max()) > 0
+
+
+def test_crash_between_shard_write_and_latest_falls_back(
+        tmp_path, monkeypatch):
+    """Atomicity of the async-subsystem commit: a crash after the shard
+    write but before the LATEST pointer swap must leave restore on the
+    previous complete checkpoint — never a partial one. Both crash
+    windows are injected: before the commit rename (stale .tmp) and
+    after it (committed step LATEST doesn't name)."""
+    from paddle_tpu.checkpoint import CheckpointManager
+    from paddle_tpu.checkpoint import writer as ckpt_writer
+
+    root = str(tmp_path / "ackpt")
+    main, startup, loss = _build()
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        exe.run(main, feed=_batch(0), fetch_list=[loss.name])
+        with CheckpointManager(root) as m:
+            m.save(1, scope=scope, program=main, sync=True)
+        w1 = np.asarray(scope.find_var("rw1").get_value()).copy()
+
+        # crash window A: process dies before the commit rename —
+        # only step_00000002.tmp exists
+        exe.run(main, feed=_batch(1), fetch_list=[loss.name])
+        m = CheckpointManager(root)
+        real_commit = ckpt_writer.commit_step
+        monkeypatch.setattr(
+            ckpt_writer, "commit_step",
+            lambda *a, **k: (_ for _ in ()).throw(
+                RuntimeError("injected crash before commit rename")))
+        with pytest.raises(RuntimeError, match="injected crash"):
+            m.save(2, scope=scope, program=main, sync=True)
+        monkeypatch.setattr(ckpt_writer, "commit_step", real_commit)
+        assert os.path.isdir(os.path.join(root, "step_00000002.tmp"))
+        assert not os.path.isdir(os.path.join(root, "step_00000002"))
+
+        # crash window B: rename happened, LATEST swap did not
+        monkeypatch.setattr(
+            ckpt_writer, "_write_latest",
+            lambda *a, **k: (_ for _ in ()).throw(
+                RuntimeError("injected crash before LATEST update")))
+        with pytest.raises(RuntimeError, match="injected crash"):
+            m2 = CheckpointManager(root)
+            m2.save(3, scope=scope, program=main, sync=True)
+        assert os.path.isdir(os.path.join(root, "step_00000003"))
+        with open(os.path.join(root, "LATEST")) as f:
+            assert f.read().strip() == "step_00000001"
+
+    # fresh-process restore follows LATEST -> the last checkpoint whose
+    # commit protocol COMPLETED, with the pre-crash parameter values
+    main2, _, _ = _build()
+    scope2 = Scope()
+    with fluid.scope_guard(scope2):
+        exe = fluid.Executor(fluid.CPUPlace())
+        with CheckpointManager(root) as m3:
+            restored = m3.restore(scope=scope2, program=main2,
+                                  place=exe.place)
+    assert restored == 1
+    np.testing.assert_array_equal(
+        np.asarray(scope2.find_var("rw1").get_value()), w1)
 
 
 def test_partial_checkpoint_fails_loudly(tmp_path):

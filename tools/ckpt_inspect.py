@@ -1,0 +1,161 @@
+"""ckpt_inspect: list and verify sharded checkpoints.
+
+The front-end of ``paddle_tpu/checkpoint``: lists every step under a
+checkpoint root (tensor count, payload bytes, writer process count,
+complete/in-flight status, which step LATEST names) and, with
+``--verify``, recomputes every shard CRC32 against the manifest —
+exiting non-zero on corruption, truncation, dangling LATEST, or
+incomplete shard coverage. Same exit-code convention as
+``tools/lint_program.py``, suitable for CI gating or a pre-restore
+sanity check on a copied/rsynced checkpoint directory.
+
+Usage:
+  python tools/ckpt_inspect.py /path/to/ckpt
+  python tools/ckpt_inspect.py /path/to/ckpt --step 42 --tensors
+  python tools/ckpt_inspect.py /path/to/ckpt --verify
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from paddle_tpu.checkpoint import manifest as mf       # noqa: E402
+from paddle_tpu.checkpoint import writer as wr         # noqa: E402
+
+EXIT_CLEAN = 0
+EXIT_CORRUPT = 1
+EXIT_USAGE = 2
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024.0
+    return f"{n}B"
+
+
+def _step_summary(root: str, step: int) -> dict:
+    step_dir = os.path.join(root, mf.step_dir_name(step))
+    try:
+        man = wr._manifest_for_step(root, step)
+    except mf.CheckpointCorrupt as exc:
+        return {"step": step, "error": str(exc)}
+    tensors = man["tensors"]
+    nbytes = sum(s["nbytes"] for t in tensors.values()
+                 for s in t["shards"])
+    return {
+        "step": step,
+        "dir": step_dir,
+        "tensors": len(tensors),
+        "shards": sum(len(t["shards"]) for t in tensors.values()),
+        "bytes": nbytes,
+        "processes": man["process_count"],
+        "sharded": sum(1 for t in tensors.values()
+                       if t["sharding"] == "sharded"),
+    }
+
+
+def _print_tensors(root: str, step: int) -> None:
+    man = wr._manifest_for_step(root, step)
+    width = max((len(n) for n in man["tensors"]), default=4)
+    for name, t in sorted(man["tensors"].items()):
+        nbytes = sum(s["nbytes"] for s in t["shards"])
+        print(f"    {name:<{width}}  {t['dtype']:<10} "
+              f"{str(t['global_shape']):<18} {t['sharding']:<10} "
+              f"shards={len(t['shards'])} {_fmt_bytes(nbytes)}")
+
+
+def inspect(root: str, step=None, verify=False,
+            show_tensors=False) -> int:
+    if not os.path.isdir(root):
+        print(f"error: {root!r} is not a directory", file=sys.stderr)
+        return EXIT_USAGE
+    all_steps = mf.list_steps(root, complete_only=False)
+    complete = set(mf.list_steps(root, complete_only=True))
+    latest = mf.read_latest(root)
+    in_flight = sorted(
+        mf.parse_step_dir(n[:-4])
+        for n in os.listdir(root)
+        if n.endswith(".tmp") and mf.parse_step_dir(n[:-4]) is not None)
+    if not all_steps and not in_flight:
+        print(f"{root}: not a checkpoint directory "
+              f"(no step_* dirs, no LATEST)", file=sys.stderr)
+        return EXIT_USAGE
+    wanted = [step] if step is not None else all_steps
+    problems: List[str] = []
+    print(f"checkpoint root: {root}")
+    for s in wanted:
+        if s not in all_steps:
+            print(f"error: no step {s} on disk (have {all_steps})",
+                  file=sys.stderr)
+            return EXIT_USAGE
+        mark = " <- LATEST" if s == latest else ""
+        if s not in complete:
+            print(f"  step {s}: INCOMPLETE (no merged manifest)"
+                  f"{mark}")
+            problems.append(f"step {s}: incomplete")
+            continue
+        info = _step_summary(root, s)
+        if "error" in info:
+            print(f"  step {s}: UNREADABLE — {info['error']}{mark}")
+            problems.append(f"step {s}: {info['error']}")
+            continue
+        print(f"  step {s}: {info['tensors']} tensors "
+              f"({info['sharded']} sharded) in {info['shards']} shards, "
+              f"{_fmt_bytes(info['bytes'])}, "
+              f"{info['processes']} writer process(es)"
+              f"{mark}")
+        if show_tensors:
+            _print_tensors(root, s)
+        if verify:
+            bad = wr.verify_step(root, s)
+            for b in bad:
+                print(f"    CORRUPT: {b}")
+            problems.extend(f"step {s}: {b}" for b in bad)
+            if not bad:
+                print(f"    verified: all shard checksums match")
+    for s in in_flight:
+        print(f"  step {s}: in-flight (.tmp — ignored by restore)")
+    if latest is not None and latest not in complete:
+        msg = (f"LATEST names step {latest} which is not a complete "
+               f"checkpoint (crash mid-save?); restore falls back to "
+               f"{max(complete) if complete else 'nothing'}")
+        print(f"  WARNING: {msg}")
+        problems.append(msg)
+    if problems:
+        print(f"\n{len(problems)} problem(s) found", file=sys.stderr)
+        return EXIT_CORRUPT
+    return EXIT_CLEAN
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ckpt_inspect",
+        description="list and verify paddle_tpu sharded checkpoints")
+    ap.add_argument("root", help="checkpoint root directory")
+    ap.add_argument("--step", type=int, default=None,
+                    help="inspect only this step")
+    ap.add_argument("--verify", action="store_true",
+                    help="recompute every shard CRC32 (exit 1 on "
+                         "mismatch)")
+    ap.add_argument("--tensors", action="store_true",
+                    help="list per-tensor shape/dtype/sharding")
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit:
+        return EXIT_USAGE
+    return inspect(args.root, step=args.step, verify=args.verify,
+                   show_tensors=args.tensors)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

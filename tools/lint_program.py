@@ -155,7 +155,7 @@ def load_serialized_program(path: str):
     """(Program, meta|None) from either an inference-model ``__model__``
     container (version + feed/fetch meta + ProgramDesc, io.py) or raw
     ProgramDesc bytes."""
-    import pickle
+    import json
     import struct
     from paddle_tpu.core.op_version import check_program
     from paddle_tpu.proto import framework_pb2 as fpb
@@ -171,10 +171,15 @@ def load_serialized_program(path: str):
     try:
         (ver,) = struct.unpack_from("<I", blob, 0)
         (meta_len,) = struct.unpack_from("<I", blob, 4)
-        if ver == 1 and 8 + meta_len < len(blob):
-            meta = pickle.loads(blob[8:8 + meta_len])
-            if isinstance(meta, dict) and "feed" in meta:
-                return _parse(blob[8 + meta_len:]), meta
+        if ver in (1, 2) and 8 + meta_len < len(blob):
+            meta = None
+            if ver == 2:
+                meta = json.loads(blob[8:8 + meta_len].decode("utf-8"))
+                if not (isinstance(meta, dict) and "feed" in meta):
+                    raise ValueError("not an inference-model container")
+            # ver 1 framed pickle metadata: skip it UNREAD — a lint tool
+            # must not unpickle an untrusted model file
+            return _parse(blob[8 + meta_len:]), meta
     except Exception:
         pass
     return _parse(blob), None
