@@ -449,7 +449,7 @@ def _check_fetch(ctx: AnalysisContext) -> List[Diagnostic]:
 COLLECTIVE_OP_TYPES = frozenset({
     "c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
     "c_allreduce_prod", "c_allgather", "c_reducescatter",
-    "c_broadcast", "allreduce", "broadcast",
+    "c_broadcast", "allreduce", "broadcast", "c_allreduce_fused",
 })
 
 
@@ -458,7 +458,15 @@ def _collective_signature(program: Program):
     signature is (type, ring_id, root, reduce_type, operand names):
     every rank must issue the same collective on the same tensors in
     the same order — NCCL pairs calls purely by issue order, so a
-    reordered pair silently mixes tensors or hangs on a shape mismatch."""
+    reordered pair silently mixes tensors or hangs on a shape mismatch.
+
+    A bucketed collective (c_allreduce_fused, comm_scheduler) carries a
+    whole bucket as operands: its name tuple is the bucket MEMBERSHIP
+    SET (sorted — member order inside one fused payload is a local
+    layout choice), so shards agreeing on membership but differing in
+    emission order inside the bucket do NOT false-positive, while a
+    grad assigned to different buckets on different shards (a real
+    payload-shape divergence that hangs the ring) is an error."""
     seq = []
     for block in program.blocks:
         for op_idx, op in enumerate(block.ops):
@@ -490,7 +498,16 @@ def check_collective_ordering(
                 zip(ref_seq, seq)):
             if rsig == ssig:
                 continue
-            if rsig[:4] == ssig[:4]:
+            if rsig[:4] == ssig[:4] and rsig[0] == "c_allreduce_fused":
+                ronly = sorted(set(rsig[4]) - set(ssig[4]))
+                sonly = sorted(set(ssig[4]) - set(rsig[4]))
+                detail = (f"bucket membership diverges: {labels[0]} "
+                          f"fuses {ronly or list(rsig[4])} where "
+                          f"{labels[i]} fuses {sonly or list(ssig[4])}"
+                          f" — mismatched bucket payloads have "
+                          f"different shapes and hang the fused "
+                          f"all-reduce")
+            elif rsig[:4] == ssig[:4]:
                 detail = (f"both issue {rsig[0]} on ring {rsig[1]} but "
                           f"on different tensors ({list(rsig[4])} vs "
                           f"{list(ssig[4])}) — reordered collectives "

@@ -222,9 +222,14 @@ def _recompute_stash(fwd_ops, bwd_ops, env, types, rng_ctx, lod_env,
                 env[n] = sub[n]
 
 
-def run_block_ops(block, env, rng_ctx, lod_env, block_runner, ops=None):
+def run_block_ops(block, env, rng_ctx, lod_env, block_runner, ops=None,
+                  comm_points=None):
     """Trace ops (default: all of the block) into the env (shared by
-    executor + control flow sub-blocks)."""
+    executor + control flow sub-blocks). `comm_points` maps op index ->
+    hook(env): the comm scheduler's fused-bucket collective points,
+    invoked right after the op that seals each bucket so the collective
+    interleaves with (and overlaps) the remaining backward
+    (parallel/comm_scheduler.py)."""
     recompute = _recompute_types()
     recomputed = recompute is None
     for i, op in enumerate(block.ops if ops is None else ops):
@@ -248,6 +253,10 @@ def run_block_ops(block, env, rng_ctx, lod_env, block_runner, ops=None):
                 env[dst] = env[src]
                 if src in lod_env and dst not in lod_env:
                     lod_env[dst] = lod_env[src]
+            if comm_points is not None:
+                hook = comm_points.get(i)
+                if hook is not None:
+                    hook(env)
             continue
         try:
             info = OPS.get(op.type)
@@ -272,6 +281,10 @@ def run_block_ops(block, env, rng_ctx, lod_env, block_runner, ops=None):
         checks = getattr(_nan_check_ctx, "items", None)
         if checks is not None:
             _append_nan_checks(checks, op, env)
+        if comm_points is not None:
+            hook = comm_points.get(i)
+            if hook is not None:
+                hook(env)
 
 
 def _append_nan_checks(checks, op, env):
@@ -448,6 +461,22 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
                 f"batch size {b} is not divisible by "
                 f"gradient_accumulation_steps={accum_k}")
 
+    # comm scheduler: fused-bucket collective points interleaved into
+    # the traced backward (parallel/comm_scheduler.py). Only built for
+    # multi-device meshes; programs with explicit collective ops manage
+    # their own comm and get static counter stats instead.
+    comm_sched = None
+    comm_stats = None
+    if mesh is not None and getattr(mesh, "size", 1) > 1:
+        from ..parallel.comm_scheduler import (
+            CommScheduler, static_collective_stats)
+        comm_sched = CommScheduler.for_program(
+            program, block_idx, mesh, data_axis, strategy)
+        comm_stats = comm_sched.stats if comm_sched is not None \
+            else static_collective_stats(program, block_idx)
+    comm_points = comm_sched.comm_points() \
+        if comm_sched is not None and accum_k == 1 else None
+
     def _run_whole(env, rng_ctx, lod_env):
         def block_runner(idx, sub_env=None):
             run_block_ops(program.block(idx),
@@ -461,9 +490,10 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
                            amp_cfg.get("black_ops", ()),
                            amp_cfg.get("white_ops", ())):
                 run_block_ops(block, env, rng_ctx, lod_env,
-                              block_runner)
+                              block_runner, comm_points=comm_points)
         else:
-            run_block_ops(block, env, rng_ctx, lod_env, block_runner)
+            run_block_ops(block, env, rng_ctx, lod_env, block_runner,
+                          comm_points=comm_points)
         return env
 
     def _run_accumulated(params, feeds, key):
@@ -524,6 +554,10 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
         for n, g in g_acc.items():
             env[n] = g.map_values(lambda v: (v * inv).astype(v.dtype)) \
                 if is_selected_rows(g) else g * inv
+        if comm_sched is not None:
+            # one fused collective point on the averaged grads (the
+            # per-op interleave cannot span the re-traced slices)
+            comm_sched.apply_all(env)
         rng_ctx = _Rng(key)
 
         def block_runner2(idx, sub_env=None):
@@ -704,11 +738,24 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
             if hasattr(mesh.shape, "get") else mesh.size
         batch = NamedSharding(mesh, P(data_axis))
 
+        shard_update = bool(FLAGS.sharded_weight_update)
+
         def param_sh(n):
+            shape = params_sig[n].shape if n in params_sig else ()
             if strategy is not None:
-                shape = params_sig[n].shape if n in params_sig else ()
                 spec = strategy.param_spec(n, shape)
                 if spec is not None:
+                    return NamedSharding(mesh, spec)
+            if shard_update:
+                # cross-replica sharded weight update (arXiv:
+                # 2004.13336): optimizer state shards dim 0 over dp,
+                # the partitioner computes each update on the shard
+                # that owns it (reduce-scatter + local update +
+                # all-gather)
+                from ..parallel.comm_scheduler import \
+                    sharded_update_spec
+                spec = sharded_update_spec(n, shape, mesh, data_axis)
+                if spec is not None and tuple(spec):
                     return NamedSharding(mesh, spec)
             return repl
 
@@ -737,10 +784,12 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
     else:
         fn = jax.jit(step2, donate_argnums=(0,),
                      compiler_options=_compiler_options())
-    return TracedStep(fn, donated, const, sorted(feed_sig),
-                      list(fetch_names), updated_names,
-                      fetch_lod_box, uses_rng_box[0],
-                      nan_check_labels=nan_labels_box)
+    ts = TracedStep(fn, donated, const, sorted(feed_sig),
+                    list(fetch_names), updated_names,
+                    fetch_lod_box, uses_rng_box[0],
+                    nan_check_labels=nan_labels_box)
+    ts.comm_stats = comm_stats
+    return ts
 
 
 def _on_device(arr, dev) -> bool:
@@ -816,10 +865,20 @@ class Engine:
         # instances constructed with engine=<this engine>: inflight
         # returns to 0 once every queued async save is durable
         # (docs/CHECKPOINTING.md)
+        # collective_* / grad_collectives_per_step / comm_overlap_frac
+        # are maintained from TracedStep.comm_stats (the comm
+        # scheduler's bucket plan or a transpiled block's static
+        # collective census): cumulative bytes/buckets/quantized plus
+        # two per-step gauges — fused gradient collectives issued per
+        # step and the fraction that can overlap remaining backward
+        # (docs/COLLECTIVES.md)
         self.counters: Dict[str, int] = {
             "runs": 0, "fast_path_hits": 0, "traces": 0,
             "sig_builds": 0, "device_puts": 0,
-            "ckpt_saves": 0, "ckpt_inflight": 0}
+            "ckpt_saves": 0, "ckpt_inflight": 0,
+            "collective_bytes": 0, "collective_buckets": 0,
+            "collective_quantized": 0, "grad_collectives_per_step": 0,
+            "comm_overlap_frac": 0.0}
         # feed names that are identical on every process under multihost
         # SPMD (shared tables, per-step constants) — globalized by
         # replication instead of batch-dim concatenation
@@ -941,7 +1000,10 @@ class Engine:
         return (program.fingerprint, block_idx, feed_sig_key,
                 tuple(fetch_names), bool(FLAGS.check_nan_inf),
                 int(getattr(program, "_gradient_accumulation_steps", 1)
-                    or 1), int(iterations))
+                    or 1), int(iterations),
+                float(FLAGS.allreduce_bucket_mb),
+                str(FLAGS.quantized_allreduce),
+                bool(FLAGS.sharded_weight_update))
 
     def compiled_step(self, program, scope: Scope, feed, fetch_names,
                       block_idx: int = 0, iterations: int = 1):
@@ -1034,7 +1096,10 @@ class Engine:
         return (program.fingerprint, block_idx, tuple(fetch_names),
                 int(iterations), bool(FLAGS.check_nan_inf),
                 int(getattr(program, "_gradient_accumulation_steps", 1)
-                    or 1))
+                    or 1),
+                float(FLAGS.allreduce_bucket_mb),
+                str(FLAGS.quantized_allreduce),
+                bool(FLAGS.sharded_weight_update))
 
     def _fast_feed_arrays(self, entry: _FastPathEntry, feed):
         """Feed dict -> device arrays through the cached signature: no
@@ -1204,6 +1269,14 @@ class Engine:
             fetches, updated, nan_flags = traced.fn(
                 donated_params, const_params, arrays, step_key)
         _set_rng_state(scope, next_state)
+        comm_stats = getattr(traced, "comm_stats", None)
+        if comm_stats:
+            c = self.counters
+            c["collective_bytes"] += comm_stats["bytes"]
+            c["collective_buckets"] += comm_stats["buckets"]
+            c["collective_quantized"] += comm_stats["quantized"]
+            c["grad_collectives_per_step"] = comm_stats["buckets"]
+            c["comm_overlap_frac"] = comm_stats["overlap_frac"]
         for n, v in updated.items():
             var = updated_vars.get(n) if updated_vars is not None \
                 else None

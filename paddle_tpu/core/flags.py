@@ -70,6 +70,30 @@ _define("async_checkpoint", False, True,
         "background D2H + serialization, atomic commit with manifest + "
         "checksums, LATEST pointer updated last "
         "(docs/CHECKPOINTING.md)")
+_define("allreduce_bucket_mb", 32.0, True,
+        "gradient-communication bucket size cap in MB for the comm "
+        "scheduler (paddle_tpu/parallel/comm_scheduler): param grads "
+        "are grouped into dtype-homogeneous buckets of at most this "
+        "many MB in reverse-backward (production) order and each "
+        "bucket is flattened into ONE fused all-reduce issued as soon "
+        "as its last grad is produced, overlapping collectives with "
+        "the remaining backward. <= 0 disables bucketing (one "
+        "collective per tensor, the pre-scheduler behavior); reference "
+        "FLAGS_fuse_parameter_memory_size analog (docs/COLLECTIVES.md)")
+_define("quantized_allreduce", "", True,
+        "quantize comm-scheduler bucket payloads on the wire: '' "
+        "(off, exact dtype), 'int8' (EQuARX-style scale-per-bucket "
+        "symmetric int8), or 'bf16' (cast). Small (<64KB) and "
+        "non-float buckets always fall back to the exact dtype. "
+        "Lossy — see docs/COLLECTIVES.md for tolerance accounting")
+_define("sharded_weight_update", False, True,
+        "shard the optimizer weight update across the data-parallel "
+        "axis (arXiv:2004.13336 / ZeRO-1): optimizer state shards "
+        "dim 0 over dp, XLA's partitioner turns grad all-reduce + "
+        "replicated update into reduce-scatter + 1/|dp| local update "
+        "+ all-gather of the updated params. Composes with an "
+        "explicit DistributedStrategy (strategy rules win first); "
+        "docs/COLLECTIVES.md")
 _define("paddle_num_threads", 2, True,
         "default reader worker threads for the native data feed")
 _define("seed", 0, True, "global default RNG seed when a Program sets none")

@@ -90,10 +90,19 @@ class DataParallel(Layer):
         """Eager cross-process gradient allreduce (reference
         apply_collective_grads -> c_allreduce_sum over coalesced grads,
         dygraph/parallel.py:202-245). Each process contributes its
-        local grad as one slice of a ["dp"]-stacked global array; a
+        local grads as slices of a ["dp"]-stacked global array; a
         jitted sum over that axis is the XLA allreduce. With
         scale_loss's 1/nranks this reproduces the reference's
-        scale-then-sum contract exactly."""
+        scale-then-sum contract exactly.
+
+        Grads are BUCKETED through the comm scheduler (parallel/
+        comm_scheduler.py, FLAGS_allreduce_bucket_mb): reverse
+        parameter order approximates backward production order, each
+        dtype-homogeneous size-capped bucket flattens into ONE fused
+        stacked sum — the reference's coalesce_tensor behavior — and
+        FLAGS_quantized_allreduce applies real pre-reduction payload
+        quantization inside the fused sum. bucket_mb <= 0 restores the
+        per-tensor path."""
         if self._strategy.nranks < 2:
             return
         if jax.process_count() < 2:
@@ -104,43 +113,87 @@ class DataParallel(Layer):
                 f"fleet.init_worker / jax.distributed.initialize "
                 f"before training); refusing to train on 1/nranks-"
                 f"scaled gradients")
-        stacked, nproc, _sum0 = self._allreduce_ctx()
-        for p in self._layers.parameters():
+        from ..parallel import comm_scheduler as _cs
+        import jax.numpy as jnp
+        stacked, nproc = self._allreduce_ctx()
+        ivars = []
+        for p in reversed(list(self._layers.parameters())):
             ivar = getattr(p, "_ivar", p)
-            if getattr(ivar, "grad", None) is None:
-                continue
-            local = np.asarray(ivar.grad)
+            if getattr(ivar, "grad", None) is not None:
+                ivars.append(ivar)
+        locals_ = [np.asarray(iv.grad) for iv in ivars]
+        bucket_bytes = _cs.bucket_bytes_from_flags()
+        if bucket_bytes <= 0:
+            # pre-scheduler behavior: one collective per tensor
+            fn = self._fused_fn("")
+            for iv, local in zip(ivars, locals_):
+                garr = jax.make_array_from_process_local_data(
+                    stacked, local.ravel()[None],
+                    (nproc, local.size))
+                out = np.asarray(fn(garr))
+                iv.grad = jnp.asarray(out.reshape(local.shape))
+            return
+        mode = _cs.quantize_mode_from_flags()
+        buckets = _cs.plan_named_buckets(
+            [(i, a.shape, a.dtype) for i, a in enumerate(locals_)],
+            bucket_bytes)
+        for b in buckets:
+            idxs = list(b.names)
+            parts = [locals_[i].ravel() for i in idxs]
+            flat = parts[0] if len(parts) == 1 else \
+                np.concatenate(parts)
+            use = mode if _cs.should_quantize(
+                flat.dtype, flat.nbytes, mode) else ""
             garr = jax.make_array_from_process_local_data(
-                stacked, local[None], (nproc,) + local.shape)
+                stacked, flat[None], (nproc, flat.size))
             # pull the replicated result back to a process-local array
             # so subsequent eager ops don't mix global/local devices
-            import jax.numpy as jnp
-            ivar.grad = jnp.asarray(np.asarray(_sum0(garr)))
+            out = np.asarray(self._fused_fn(use)(garr))
+            off = 0
+            for i in idxs:
+                k = locals_[i].size
+                ivars[i].grad = jnp.asarray(
+                    out[off:off + k].reshape(locals_[i].shape))
+                off += k
 
     def _allreduce_ctx(self):
-        """Cached (sharding, nproc, jitted sum): built once so the jit
-        cache holds per grad shape instead of retracing every step.
-        The allreduce mesh uses ONE device per process — the stacked
-        axis has process_count slices regardless of how many local
-        chips each process owns."""
+        """Cached (stacked sharding, nproc): built once. The allreduce
+        mesh uses ONE device per process — the stacked axis has
+        process_count slices regardless of how many local chips each
+        process owns."""
         if getattr(self, "_ar_ctx", None) is None:
-            import jax.numpy as jnp
             from jax.sharding import Mesh, NamedSharding, \
                 PartitionSpec as P
             nproc = jax.process_count()
             devs = [jax.local_devices(process_index=i)[0]
                     for i in range(nproc)]
             mesh = Mesh(np.array(devs), ("dp",))
-            repl = NamedSharding(mesh, P())
+            self._ar_repl = NamedSharding(mesh, P())
             stacked = NamedSharding(mesh, P("dp"))
+            self._ar_ctx = (stacked, nproc)
+        return self._ar_ctx
+
+    def _fused_fn(self, mode: str):
+        """Jitted fused bucket sum per quantize mode (jax.jit caches
+        per payload shape/dtype underneath): sum the (nranks, K) stack
+        over axis 0 — optionally quantizing the pre-reduction rows —
+        and replicate the result."""
+        fns = getattr(self, "_fused_fns", None)
+        if fns is None:
+            fns = self._fused_fns = {}
+        fn = fns.get(mode)
+        if fn is None:
+            self._allreduce_ctx()
+            repl = self._ar_repl
+            from ..parallel.comm_scheduler import fused_stacked_sum
 
             @jax.jit
-            def _sum0(a):
+            def fn(a):
                 return jax.lax.with_sharding_constraint(
-                    jnp.sum(a, axis=0), repl)
+                    fused_stacked_sum(a, mode), repl)
 
-            self._ar_ctx = (stacked, nproc, _sum0)
-        return self._ar_ctx
+            fns[mode] = fn
+        return fn
 
     def parameters(self, include_sublayers=True):
         return self._layers.parameters(include_sublayers)

@@ -56,6 +56,20 @@ def _ring_id_axis(ctx):
     return _axis()
 
 
+def _canonical(x):
+    """Canonicalize host operands before a collective: a numpy int64 /
+    float64 constant in the env (LoD metadata, host-computed tables)
+    reaches psum as-is and fails under x64-disabled JAX — jnp.asarray
+    applies the same dtype canonicalization feeds get (int64 -> int32),
+    so mixed int64/int32 operands reduce in one canonical dtype.
+    Tracers and jax.Arrays pass through unchanged (asarray is a no-op
+    on canonical-dtype values)."""
+    try:
+        return jnp.asarray(x)
+    except (TypeError, ValueError):
+        return x
+
+
 def _psum_prod(x, ax):
     """Product reduction via sign/abs decomposition (XLA has no
     product collective): magnitude = exp(psum(log|x|)) with zeros
@@ -101,7 +115,7 @@ def _c_allreduce(ctx, op):
         ctx.set_output("Out", out)
         return
     if ax:
-        out = op(x, ax)
+        out = op(_canonical(x), ax)
         if scale is not None:
             out = out * jnp.asarray(scale, out.dtype)
     else:
@@ -127,6 +141,7 @@ def allreduce(ctx):
     ax = _axis()
     red = int(ctx.attr("reduce_type", 0))  # 0 sum 1 prod 2 max 3 min
     if ax:
+        x = _canonical(x)
         if red == 0:
             x = lax.psum(x, ax)
         elif red == 1:
@@ -136,6 +151,55 @@ def allreduce(ctx):
         else:
             x = lax.pmin(x, ax)
     ctx.set_output("Out", x)
+
+
+@register_no_grad_op("c_allreduce_fused")
+def c_allreduce_fused(ctx):
+    """Bucketed gradient all-reduce (parallel/comm_scheduler.py): the
+    op carries a whole bucket's membership — inputs X = the member
+    grads, outputs Out = the same names — and reduces them as ONE
+    flattened payload. Under a per-device axis guard this is a real
+    fused collective (optionally quantized, EQuARX-style scale-per-
+    bucket with exact fallback for small/non-float payloads); in
+    global-view mode it is identity like every c_* op. SelectedRows
+    members fall back to the per-tensor sparse all-gather path and
+    dtype-mixed members (AMP) regroup by actual dtype."""
+    from ..core.selected_rows import SelectedRows, is_selected_rows
+    from ..parallel.comm_scheduler import (
+        fused_axis_psum, should_quantize)
+    names = list(ctx.op.input("X"))
+    ax = _ring_id_axis(ctx)
+    scale = ctx.attr("scale", None)
+    mode = str(ctx.attr("quantize", "") or "")
+    env = ctx.env
+    if not ax:
+        for n in names:
+            env[n] = env[n]  # identity; names alias in place
+        return
+    groups = {}
+    for n in names:
+        x = env[n]
+        if is_selected_rows(x):
+            rows = lax.all_gather(x.rows, ax, axis=0, tiled=True)
+            vals = lax.all_gather(x.values, ax, axis=0, tiled=True)
+            if scale is not None:
+                vals = (vals * scale).astype(vals.dtype)
+            env[n] = SelectedRows(rows, vals, x.height)
+            continue
+        x = _canonical(x)
+        groups.setdefault(jnp.result_type(x), []).append((n, x))
+    for dt, items in groups.items():
+        flats = [jnp.ravel(x) for _, x in items]
+        flat = flats[0] if len(flats) == 1 else jnp.concatenate(flats)
+        import numpy as _np
+        nbytes = flat.size * _np.dtype(dt).itemsize
+        use = mode if should_quantize(dt, nbytes, mode) else ""
+        red = fused_axis_psum(flat, ax, use, scale)
+        off = 0
+        for n, x in items:
+            k = int(_np.prod(x.shape)) if x.shape else 1
+            env[n] = red[off:off + k].reshape(x.shape)
+            off += k
 
 
 @register_no_grad_op("c_allgather")

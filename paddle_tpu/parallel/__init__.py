@@ -11,6 +11,9 @@ from .strategy import (  # noqa: F401
     DistributedStrategy, ShardingRules, P,
     transformer_rules, transformer_feed_rules, ctr_rules,
 )
+from .comm_scheduler import (  # noqa: F401
+    CommScheduler, GradBucket, plan_program_buckets,
+)
 from .pipeline import PipelineEngine  # noqa: F401
 from .mpmd_pipeline import MPMDPipelineEngine  # noqa: F401
 from .ring_attention import ring_attention  # noqa: F401

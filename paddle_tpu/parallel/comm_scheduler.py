@@ -1,0 +1,508 @@
+"""Collective communication scheduler: bucketed, backward-overlapped,
+optionally quantized gradient all-reduce with cross-replica sharded
+weight update.
+
+Every data-parallel path used to issue one collective per gradient
+tensor, serialized after the whole backward. This planner groups param
+grads into size-capped dtype-homogeneous buckets (FLAGS_allreduce_
+bucket_mb) in reverse-backward PRODUCTION order — the order autodiff
+emits them, last layer first — and fuses each bucket into a single
+flattened all-reduce issued as soon as the bucket's last gradient is
+produced, so communication overlaps the rest of the backward instead of
+trailing it (reference FLAGS_fuse_parameter_memory_size +
+fuse_all_reduce_op_pass; DDP gradient bucketing).
+
+Three consumers share the plan:
+
+* the ENGINE (core/engine.py trace_step): under global-view SPMD the
+  partitioner inserts the grad all-reduces implicitly, so the scheduler
+  interleaves per-bucket "collective points" into the traced step — the
+  bucket is flattened into one buffer and pinned replicated with
+  `with_sharding_constraint`, which makes XLA materialize ONE fused
+  cross-replica reduction per bucket at that program point (instead of
+  per-tensor reductions wherever lazy placement puts them);
+* the TRANSPILER (transpiler/collective.py GradAllReduce): emits one
+  `c_allreduce_fused` op per bucket (inputs = the member grads) whose
+  lowering (ops/collective.py) does flatten → optionally quantize →
+  psum → dequantize → unflatten under a per-device axis guard;
+* the DYGRAPH DP path (dygraph/parallel.py): buckets the eager
+  per-parameter grads into fused cross-process sums.
+
+Quantization (FLAGS_quantized_allreduce = "int8" | "bf16") is
+EQuARX-style (arXiv:2506.17615): one symmetric scale per bucket
+(max-abs / 127 for int8), with an exact-dtype fallback for small
+(< MIN_QUANT_BYTES) or non-float buckets. Honesty note: only the
+PER-DEVICE paths (fused-op lowering under `collective_axis_guard`, the
+dygraph stacked-sum) quantize the actual pre-reduction payloads; the
+global-view engine path cannot reach pre-reduction partial sums (the
+partitioner owns them), so there the flag applies the quantize→
+dequantize round-trip to the fused REDUCED value — same numerics class
+(one rounding of the bucket at bucket scale), not the same wire format.
+docs/COLLECTIVES.md spells out the difference.
+
+Sharded weight update (FLAGS_sharded_weight_update, arXiv:2004.13336):
+optimizer state shards dim 0 over the dp axis (zero_optimizer_rules,
+ZeRO-1), which makes the XLA partitioner lower grad-reduce + update +
+param-use into reduce-scatter + 1/|dp| local update + all-gather — the
+cross-replica sharded weight update — while reusing the existing
+ops/optimizer_ops lowerings unchanged.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.flags import FLAGS
+
+__all__ = [
+    "GradBucket", "CommScheduler", "plan_named_buckets",
+    "plan_program_buckets", "grad_production_order", "plan_stats",
+    "bucket_bytes_from_flags", "quantize_mode_from_flags",
+    "should_quantize", "emulate_quantized", "fused_axis_psum",
+    "fused_stacked_sum", "sharded_update_spec",
+    "static_collective_stats", "MIN_QUANT_BYTES",
+]
+
+GRAD_SUFFIX = "@GRAD"
+
+# buckets smaller than this keep the exact dtype on the wire even when
+# FLAGS_quantized_allreduce is on: tiny payloads are latency-bound (no
+# bandwidth to win) and biases/norm params are the quantization-
+# sensitive tail (EQuARX's small-tensor exemption)
+MIN_QUANT_BYTES = 64 * 1024
+
+
+def bucket_bytes_from_flags() -> int:
+    """FLAGS_allreduce_bucket_mb as a byte cap; <= 0 disables."""
+    try:
+        mb = float(FLAGS.allreduce_bucket_mb)
+    except (TypeError, ValueError):
+        return 0
+    return int(mb * 1024 * 1024) if mb > 0 else 0
+
+
+def quantize_mode_from_flags() -> str:
+    mode = str(FLAGS.quantized_allreduce or "").strip().lower()
+    if mode in ("", "0", "false", "off", "none"):
+        return ""
+    if mode not in ("int8", "bf16"):
+        raise ValueError(
+            f"FLAGS_quantized_allreduce={mode!r}; expected '', 'int8' "
+            f"or 'bf16'")
+    return mode
+
+
+class GradBucket:
+    """One fused-collective unit: an ordered run of same-dtype grads.
+
+    `names` keeps grad PRODUCTION order (reverse-backward);
+    `last_op_idx` is the block-op index whose completion makes the
+    bucket ready — the earliest point its fused collective can issue.
+    """
+
+    __slots__ = ("names", "shapes", "dtype", "bytes", "last_op_idx")
+
+    def __init__(self, names, shapes, dtype, nbytes, last_op_idx=-1):
+        self.names = tuple(names)
+        self.shapes = tuple(tuple(int(d) for d in s) for s in shapes)
+        self.dtype = np.dtype(dtype)
+        self.bytes = int(nbytes)
+        self.last_op_idx = int(last_op_idx)
+
+    @property
+    def size(self) -> int:
+        return sum(int(np.prod(s)) if s else 1 for s in self.shapes)
+
+    def key(self) -> Tuple:
+        """Deterministic identity used by cross-shard comparisons."""
+        return (self.names, self.shapes, str(self.dtype))
+
+    def __repr__(self):
+        return (f"GradBucket({len(self.names)} grads, "
+                f"{self.bytes} B, dtype={self.dtype}, "
+                f"last_op={self.last_op_idx})")
+
+
+def plan_named_buckets(items: Sequence[Tuple[Any, Sequence[int],
+                                             Any]],
+                       bucket_bytes: int,
+                       last_idx: Optional[Dict[Any, int]] = None
+                       ) -> List[GradBucket]:
+    """Greedy bucketing of ordered (name, shape, dtype) triples:
+    consecutive same-dtype entries pack into one bucket until the byte
+    cap; a dtype change or cap overflow seals the bucket. A single
+    tensor larger than the cap gets its own bucket (never split — the
+    fused collective is per-buffer). Deterministic: same items, same
+    plan, on every shard."""
+    if bucket_bytes <= 0:
+        bucket_bytes = 0
+    buckets: List[GradBucket] = []
+    cur: List[Tuple[Any, Tuple[int, ...]]] = []
+    cur_dtype = None
+    cur_bytes = 0
+
+    def seal():
+        nonlocal cur, cur_bytes
+        if cur:
+            lidx = -1
+            if last_idx:
+                lidx = max(last_idx.get(n, -1) for n, _ in cur)
+            buckets.append(GradBucket(
+                [n for n, _ in cur], [s for _, s in cur], cur_dtype,
+                cur_bytes, lidx))
+        cur, cur_bytes = [], 0
+
+    for name, shape, dtype in items:
+        dt = np.dtype(dtype)
+        shape = tuple(int(d) for d in shape)
+        nbytes = int(np.prod(shape)) * dt.itemsize if shape \
+            else dt.itemsize
+        if cur and (dt != cur_dtype or
+                    (bucket_bytes and
+                     cur_bytes + nbytes > bucket_bytes)):
+            seal()
+        if not cur:
+            cur_dtype = dt
+        cur.append((name, shape))
+        cur_bytes += nbytes
+        if bucket_bytes and cur_bytes >= bucket_bytes:
+            seal()
+    seal()
+    return buckets
+
+
+def grad_production_order(program, block_idx: int = 0,
+                          param_filter=None
+                          ) -> List[Tuple[str, int, Tuple[int, ...],
+                                          Any]]:
+    """(grad_name, producing_op_idx, shape, np_dtype) for every param
+    gradient the block produces, ordered by the LAST op that writes it
+    (reverse-backward order: autodiff emits last-layer grads first).
+    Shapes/dtypes come from the parameter (its grad matches); a grad
+    written multiple times (@RENAME@ accumulation) is keyed on its
+    final write — the earliest correct collective point."""
+    from ..core.types import dtype_to_np
+    block = program.block(block_idx)
+    params = {}
+    for p in program.all_parameters():
+        if param_filter is not None and not param_filter(p):
+            continue
+        params[p.name] = p
+    produced: Dict[str, int] = {}
+    for idx, op in enumerate(block.ops):
+        is_bwd = (op.attr("op_role", "forward") == "backward" or
+                  op.type.endswith("_grad"))
+        if not is_bwd:
+            continue
+        for slot in op.output_slots():
+            for name in op.output(slot):
+                if not name.endswith(GRAD_SUFFIX):
+                    continue
+                if name[:-len(GRAD_SUFFIX)] not in params:
+                    continue
+                produced[name] = idx  # last write wins
+    out = []
+    for name, idx in sorted(produced.items(), key=lambda kv: kv[1]):
+        p = params[name[:-len(GRAD_SUFFIX)]]
+        out.append((name, idx, tuple(p.shape), dtype_to_np(p.dtype)))
+    return out
+
+
+def plan_program_buckets(program, block_idx: int = 0,
+                         bucket_bytes: Optional[int] = None,
+                         param_filter=None) -> List[GradBucket]:
+    """Bucket plan for a static Program's param grads."""
+    if bucket_bytes is None:
+        bucket_bytes = bucket_bytes_from_flags()
+    order = grad_production_order(program, block_idx, param_filter)
+    items = [(n, shape, dt) for n, _, shape, dt in order]
+    last = {n: idx for n, idx, _, _ in order}
+    return plan_named_buckets(items, bucket_bytes, last)
+
+
+def plan_stats(buckets: Sequence[GradBucket],
+               last_backward_idx: int = -1,
+               quantize_mode: str = "") -> Dict[str, Any]:
+    """Counter payload for Engine.counters: total grad bytes, bucket
+    (= fused collective) count, quantized-bucket count, and the
+    fraction of buckets whose collective can overlap remaining
+    backward compute (their last grad lands strictly before the final
+    backward op)."""
+    n = len(buckets)
+    total = sum(b.bytes for b in buckets)
+    quant = sum(1 for b in buckets
+                if should_quantize(b.dtype, b.bytes, quantize_mode))
+    overlap = sum(1 for b in buckets
+                  if 0 <= b.last_op_idx < last_backward_idx)
+    return {"bytes": total, "buckets": n, "quantized": quant,
+            "overlap_frac": (overlap / n) if n else 0.0}
+
+
+# ---------------------------------------------------------------------------
+# payload math shared by every consumer
+# ---------------------------------------------------------------------------
+
+def should_quantize(dtype, nbytes: int, mode: str) -> bool:
+    if not mode:
+        return False
+    if nbytes < MIN_QUANT_BYTES:
+        return False  # exact-dtype fallback for small buckets
+    return bool(jnp.issubdtype(np.dtype(dtype), jnp.floating))
+
+
+def _int8_scale(maxabs, dtype):
+    # guard all-zero buckets: scale 1 keeps the payload exactly zero
+    return jnp.where(maxabs > 0, maxabs / 127.0,
+                     jnp.ones_like(maxabs)).astype(dtype)
+
+
+def emulate_quantized(flat, mode: str):
+    """Quantize→dequantize round-trip on a (reduced) value — the
+    global-view engine's stand-in for wire quantization (the
+    partitioner owns the pre-reduction partials; see module doc)."""
+    if mode == "bf16":
+        return flat.astype(jnp.bfloat16).astype(flat.dtype)
+    scale = _int8_scale(jnp.max(jnp.abs(flat)), flat.dtype)
+    q = jnp.clip(jnp.round(flat / scale), -127, 127).astype(jnp.int8)
+    return q.astype(flat.dtype) * scale
+
+
+def fused_axis_psum(flat, axis_name, mode: str = "",
+                    scale: Optional[float] = None):
+    """Per-device fused bucket reduction under a collective axis:
+    exact psum, or EQuARX-style quantized psum — one shared scale per
+    bucket (pmax of local max-abs), int8 payload summed in int32, or a
+    bf16 cast round-trip. `scale` is the post-reduction multiplier
+    (the transpiler's folded 1/nranks averaging)."""
+    if mode == "int8":
+        gmax = lax.pmax(jnp.max(jnp.abs(flat)), axis_name)
+        qs = _int8_scale(gmax, flat.dtype)
+        q = jnp.clip(jnp.round(flat / qs), -127, 127).astype(jnp.int8)
+        acc = lax.psum(q.astype(jnp.int32), axis_name)
+        out = acc.astype(flat.dtype) * qs
+    elif mode == "bf16":
+        out = lax.psum(flat.astype(jnp.bfloat16),
+                       axis_name).astype(flat.dtype)
+    else:
+        out = lax.psum(flat, axis_name)
+    if scale is not None:
+        out = out * jnp.asarray(scale, out.dtype)
+    return out
+
+
+def fused_stacked_sum(stacked, mode: str = ""):
+    """Dygraph-DP fused bucket reduction: `stacked` is (nranks, K) with
+    one per-process payload per row (sharded over a one-device-per-
+    process mesh); the sum over axis 0 IS the all-reduce. Quantization
+    here is real pre-reduction payload quantization: rows quantize
+    against a shared scale before the sum."""
+    if mode == "int8":
+        gmax = jnp.max(jnp.abs(stacked))
+        qs = _int8_scale(gmax, stacked.dtype)
+        q = jnp.clip(jnp.round(stacked / qs), -127,
+                     127).astype(jnp.int8)
+        return jnp.sum(q.astype(jnp.int32),
+                       axis=0).astype(stacked.dtype) * qs
+    if mode == "bf16":
+        return jnp.sum(stacked.astype(jnp.bfloat16),
+                       axis=0).astype(stacked.dtype)
+    return jnp.sum(stacked, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# sharded weight update (FLAGS_sharded_weight_update)
+# ---------------------------------------------------------------------------
+
+_ZERO_RULES_CACHE: Dict[str, Any] = {}
+
+
+def sharded_update_spec(name: str, shape, mesh, data_axis: str):
+    """PartitionSpec for `name` under the cross-replica sharded weight
+    update: optimizer accumulators and AMP master weights shard dim 0
+    over the data axis (zero_optimizer_rules, ZeRO-1); params and
+    everything else stay with the caller's default (None). Specs that
+    don't divide legalize back to replicated inside spec_for."""
+    from .strategy import zero_optimizer_rules
+    rules = _ZERO_RULES_CACHE.get(data_axis)
+    if rules is None:
+        rules = zero_optimizer_rules(dp_axis=data_axis)
+        _ZERO_RULES_CACHE[data_axis] = rules
+    if data_axis not in getattr(mesh, "shape", {}):
+        return None
+    return rules.spec_for(name, shape, mesh)
+
+
+# ---------------------------------------------------------------------------
+# engine integration
+# ---------------------------------------------------------------------------
+
+def _apply_bucket(env, bucket: GradBucket, repl_sharding,
+                  quantize_mode: str):
+    """Trace-time fused collective point: flatten the bucket members
+    present in env into one buffer, pin it replicated (the fused
+    all-reduce), optionally apply the quantization round-trip, and
+    rebind the members. Members regroup by their TRACED dtype (AMP may
+    disagree with the plan) and SelectedRows / missing members pass
+    through untouched."""
+    from ..core.selected_rows import is_selected_rows
+    groups: Dict[Any, List[Tuple[str, Any]]] = {}
+    for n in bucket.names:
+        v = env.get(n)
+        if v is None or is_selected_rows(v) or \
+                not hasattr(v, "dtype") or not hasattr(v, "shape"):
+            continue
+        groups.setdefault(jnp.result_type(v), []).append((n, v))
+    for dt, items in groups.items():
+        flats = [jnp.ravel(v) for _, v in items]
+        flat = flats[0] if len(flats) == 1 else jnp.concatenate(flats)
+        if repl_sharding is not None:
+            try:
+                flat = jax.lax.with_sharding_constraint(
+                    flat, repl_sharding)
+            except Exception:
+                pass  # abstract/incompatible context: keep identity
+        nbytes = flat.size * np.dtype(dt).itemsize
+        if should_quantize(dt, nbytes, quantize_mode):
+            flat = emulate_quantized(flat, quantize_mode)
+        off = 0
+        for n, v in items:
+            k = int(np.prod(v.shape)) if v.shape else 1
+            env[n] = flat[off:off + k].reshape(v.shape)
+            off += k
+
+
+class CommScheduler:
+    """Bucket plan + trace hooks for one (program, block, mesh)."""
+
+    def __init__(self, buckets: List[GradBucket], mesh,
+                 quantize_mode: str = "",
+                 last_backward_idx: int = -1):
+        self.buckets = buckets
+        self.mesh = mesh
+        self.quantize_mode = quantize_mode
+        self.last_backward_idx = last_backward_idx
+        self.stats = plan_stats(buckets, last_backward_idx,
+                                quantize_mode)
+
+    @classmethod
+    def for_program(cls, program, block_idx, mesh,
+                    data_axis: str = "dp", strategy=None
+                    ) -> Optional["CommScheduler"]:
+        """Build the engine-side scheduler, or None when bucketing
+        does not apply: flag off, single device, the program already
+        carries explicit collective ops (transpiled — it manages its
+        own comm), or no param grads. Params a strategy shards
+        non-trivially are excluded (their grads must KEEP the sharded
+        layout for the partitioner's reduce-scatter, not be pinned
+        replicated)."""
+        bucket_bytes = bucket_bytes_from_flags()
+        if bucket_bytes <= 0:
+            return None
+        if mesh is None or getattr(mesh, "size", 1) < 2:
+            return None
+        block = program.block(block_idx)
+        from ..analysis.passes import COLLECTIVE_OP_TYPES
+        if any(op.type in COLLECTIVE_OP_TYPES for op in block.ops):
+            return None
+        if int(getattr(program, "_gradient_accumulation_steps", 1)
+               or 1) > 1:
+            # grad-accum re-traces compute per slice; buckets apply
+            # once on the averaged grads (engine handles it) — no
+            # per-op interleave points
+            pass
+
+        def replicated(p):
+            if strategy is None:
+                return True
+            spec = strategy.param_spec(p.name, p.shape)
+            return spec is None or all(ax is None for ax in spec)
+
+        buckets = plan_program_buckets(program, block_idx,
+                                       bucket_bytes,
+                                       param_filter=replicated)
+        if not buckets:
+            return None
+        last_bwd = -1
+        for idx, op in enumerate(block.ops):
+            if (op.attr("op_role", "forward") == "backward" or
+                    op.type.endswith("_grad")):
+                last_bwd = idx
+        return cls(buckets, mesh, quantize_mode_from_flags(), last_bwd)
+
+    def comm_points(self) -> Dict[int, Any]:
+        """op_idx -> hook(env) applying every bucket sealed by that op
+        (run_block_ops calls the hook right after the op lowers)."""
+        repl = None
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            repl = NamedSharding(self.mesh, P())
+        by_idx: Dict[int, List[GradBucket]] = {}
+        for b in self.buckets:
+            by_idx.setdefault(b.last_op_idx, []).append(b)
+        points = {}
+        for idx, bs in by_idx.items():
+            def hook(env, _bs=bs):
+                for b in _bs:
+                    _apply_bucket(env, b, repl, self.quantize_mode)
+            points[idx] = hook
+        return points
+
+    def apply_all(self, env):
+        """Single collective point for the grad-accumulation path:
+        fuse every bucket on the averaged grads before the optimize
+        phase (correct, no backward overlap)."""
+        repl = None
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            repl = NamedSharding(self.mesh, P())
+        for b in self.buckets:
+            _apply_bucket(env, b, repl, self.quantize_mode)
+
+
+def static_collective_stats(program, block_idx: int = 0
+                            ) -> Optional[Dict[str, Any]]:
+    """Counter payload for programs that carry EXPLICIT collective ops
+    (transpiled): per-step comm bytes / fused-op count read off the
+    block. Returns None when the block has no collectives."""
+    from ..analysis.passes import COLLECTIVE_OP_TYPES
+    from ..core.types import dtype_to_np
+    block = program.block(block_idx)
+    nbytes = 0
+    buckets = 0
+    quant = 0
+    for op in block.ops:
+        if op.type not in COLLECTIVE_OP_TYPES:
+            continue
+        buckets += 1
+        if str(op.attr("quantize", "") or ""):
+            quant += 1
+        for name in op.input_arg_names:
+            base = name[:-len(GRAD_SUFFIX)] \
+                if name.endswith(GRAD_SUFFIX) else name
+            v = block._find_var_recursive(base) or \
+                block._find_var_recursive(name)
+            if v is None or not v.shape:
+                continue
+            shape = [d for d in v.shape if d > 0]
+            nbytes += int(np.prod(shape)) * \
+                np.dtype(dtype_to_np(v.dtype)).itemsize
+    if not buckets:
+        return None
+    return {"bytes": nbytes, "buckets": buckets, "quantized": quant,
+            "overlap_frac": 0.0}
+
+
+def max_grad_collectives(total_grad_bytes: int,
+                         bucket_bytes: int) -> int:
+    """Acceptance bound: with every tensor under the cap, the plan
+    issues at most ceil(total / cap) fused collectives (+1 slack per
+    dtype boundary, which callers account for separately)."""
+    if bucket_bytes <= 0:
+        return total_grad_bytes  # effectively unbounded
+    return max(1, math.ceil(total_grad_bytes / bucket_bytes))

@@ -23,6 +23,14 @@ __all__ = ["DataParallelEngine"]
 
 
 class DataParallelEngine:
+    """Grad communication goes through the comm scheduler
+    (comm_scheduler.py): with FLAGS_allreduce_bucket_mb > 0 the traced
+    step fuses param-grad all-reduces into size-capped buckets
+    interleaved with the backward, FLAGS_quantized_allreduce applies
+    the bucket quantization round-trip, and FLAGS_sharded_weight_update
+    shards the optimizer update over the mesh's data axis — all inside
+    the one Engine this class owns (counters on `self.counters`)."""
+
     def __init__(self, program, build_strategy=None, places=None,
                  data_axis: str = "dp"):
         self._program = program
@@ -41,6 +49,20 @@ class DataParallelEngine:
     @property
     def device_count(self):
         return self.mesh.size
+
+    @property
+    def counters(self):
+        """Engine dispatch + collective instrumentation
+        (collective_bytes / collective_buckets /
+        grad_collectives_per_step / comm_overlap_frac ... —
+        docs/COLLECTIVES.md)."""
+        return self._engine.counters
+
+    def comm_plan(self):
+        """The comm scheduler's bucket plan for this program under the
+        current FLAGS_allreduce_bucket_mb (introspection + benches)."""
+        from .comm_scheduler import plan_program_buckets
+        return plan_program_buckets(self._program)
 
     def run(self, feed, fetch_names, scope, return_numpy=True,
             loss_name=None, iterations=1):

@@ -74,15 +74,38 @@ class GradAllReduce(Collective):
     collective.py:178-267 inserts scale(1/nranks) + c_allreduce_sum).
 
     TPU-native twist: the 1/nranks averaging is folded into the
-    c_allreduce_sum op as a `scale` attr (applied by the lowering only
-    in per-device axis mode) so the transpiled program is
+    collective op as a `scale` attr (applied by the lowering only in
+    per-device axis mode) so the transpiled program is
     semantics-preserving when run on the global-view engine, where the
-    collective is identity and grads are already global values."""
+    collective is identity and grads are already global values.
 
-    def __init__(self, nrings=1):
+    With `bucket_mb` > 0 (default: FLAGS_allreduce_bucket_mb) grads are
+    planned into size-capped dtype-homogeneous buckets in production
+    order (parallel/comm_scheduler.py) and ONE `c_allreduce_fused` op
+    per bucket is inserted right after the op producing the bucket's
+    last member — the fused collective issues as soon as its payload is
+    complete and overlaps the remaining backward. `quantize` ("int8" /
+    "bf16", default FLAGS_quantized_allreduce) rides on the fused op as
+    an attr. bucket_mb <= 0 restores the per-tensor c_allreduce_sum
+    emission."""
+
+    def __init__(self, nrings=1, bucket_mb=None, quantize=None):
         super().__init__(nrings)
+        self.bucket_mb = bucket_mb
+        self.quantize = quantize
+
+    def _bucket_bytes(self):
+        if self.bucket_mb is None:
+            from ..parallel.comm_scheduler import bucket_bytes_from_flags
+            return bucket_bytes_from_flags()
+        return int(float(self.bucket_mb) * 1024 * 1024) \
+            if float(self.bucket_mb) > 0 else 0
 
     def _transpile_main_program(self):
+        bucket_bytes = self._bucket_bytes()
+        if bucket_bytes > 0:
+            self._transpile_bucketed(bucket_bytes)
+            return
         block = self.main_program.global_block()
         ring = 0
         # find grad vars: outputs of *_grad ops matching a parameter
@@ -105,6 +128,39 @@ class GradAllReduce(Collective):
                                "scale": 1.0 / self.nranks})
                     new_ops.append(op_ar)
                     ring = (ring + 1) % self.nrings
+        block.ops[:] = new_ops
+        self.main_program._bump_version()
+
+    def _transpile_bucketed(self, bucket_bytes):
+        """Emit one c_allreduce_fused per bucket, placed after the op
+        that seals it. The plan is deterministic over (program
+        structure, bucket size) so every shard builds identical bucket
+        membership in identical order — the analyzer's collective-
+        ordering check compares the membership sets across shards."""
+        from ..parallel.comm_scheduler import (
+            plan_program_buckets, quantize_mode_from_flags)
+        block = self.main_program.global_block()
+        buckets = plan_program_buckets(self.main_program, 0,
+                                       bucket_bytes)
+        mode = quantize_mode_from_flags() if self.quantize is None \
+            else str(self.quantize or "")
+        by_idx = {}
+        for bi, b in enumerate(buckets):
+            by_idx.setdefault(b.last_op_idx, []).append((bi, b))
+        new_ops = []
+        for idx, op in enumerate(block.ops):
+            new_ops.append(op)
+            for bi, b in by_idx.get(idx, ()):
+                op_ar = framework.Operator(
+                    block, "c_allreduce_fused",
+                    inputs={"X": list(b.names)},
+                    outputs={"Out": list(b.names)},
+                    attrs={"ring_id": bi % self.nrings,
+                           "scale": 1.0 / self.nranks,
+                           "quantize": mode,
+                           "bucket_id": bi,
+                           "bucket_bytes": int(b.bytes)})
+                new_ops.append(op_ar)
         block.ops[:] = new_ops
         self.main_program._bump_version()
 

@@ -28,7 +28,9 @@ def _simple_net():
 
 
 class TestTranspilerStructure:
-    def test_collective_mode_inserts_allreduce(self):
+    def test_collective_mode_inserts_bucketed_allreduce(self):
+        """Default FLAGS_allreduce_bucket_mb (32MB) fuses every param
+        grad of this small net into one c_allreduce_fused bucket."""
         main, startup, cost = _simple_net()
         cfg = fluid.DistributeTranspilerConfig()
         cfg.mode = "collective"
@@ -36,14 +38,37 @@ class TestTranspilerStructure:
         t.transpile(trainer_id=0, program=main, trainers=2,
                     startup_program=startup)
         trainer = t.get_trainer_program()
-        ops = [op.type for op in trainer.global_block().ops]
-        assert "c_allreduce_sum" in ops
-        # every param grad gets scale + allreduce after its grad op
+        blk = trainer.global_block()
+        ops = [op.type for op in blk.ops]
+        assert "c_allreduce_sum" not in ops
+        fused = [op for op in blk.ops if op.type == "c_allreduce_fused"]
+        assert len(fused) == 1
+        # bucket membership covers every param grad exactly once
         n_params = len(main.all_parameters())
-        assert ops.count("c_allreduce_sum") == n_params
+        members = [n for op in fused for n in op.input("X")]
+        assert len(members) == len(set(members)) == n_params
+        assert all(m.endswith("@GRAD") for m in members)
         start_ops = [op.type for op in startup.global_block().ops]
         assert "c_gen_nccl_id" in start_ops
         assert "c_comm_init" in start_ops
+
+    def test_collective_mode_per_tensor_with_bucketing_off(self):
+        main, startup, cost = _simple_net()
+        fluid.set_flags({"FLAGS_allreduce_bucket_mb": 0.0})
+        try:
+            cfg = fluid.DistributeTranspilerConfig()
+            cfg.mode = "collective"
+            t = fluid.DistributeTranspiler(config=cfg)
+            t.transpile(trainer_id=0, program=main, trainers=2,
+                        startup_program=startup)
+            ops = [op.type for op in
+                   t.get_trainer_program().global_block().ops]
+        finally:
+            fluid.set_flags({"FLAGS_allreduce_bucket_mb": 32.0})
+        # every param grad gets scale + allreduce after its grad op
+        n_params = len(main.all_parameters())
+        assert ops.count("c_allreduce_sum") == n_params
+        assert "c_allreduce_fused" not in ops
 
     def test_pserver_mode_transpiles_to_collective(self):
         main, startup, cost = _simple_net()
@@ -54,7 +79,7 @@ class TestTranspilerStructure:
                         trainers=2, startup_program=startup)
         ops = [op.type for op in
                t.get_trainer_program().global_block().ops]
-        assert "c_allreduce_sum" in ops
+        assert "c_allreduce_fused" in ops or "c_allreduce_sum" in ops
         assert "send" not in ops and "recv" not in ops
         ps = t.get_pserver_program("127.0.0.1:6174")
         assert [op.type for op in ps.global_block().ops] == \

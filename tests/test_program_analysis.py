@@ -275,8 +275,11 @@ def test_never_computed_fetch_is_error():
 # cross-program collective ordering
 # ---------------------------------------------------------------------------
 
-def _shard_programs(n=2):
-    return lint_program.transpile_shards("mlp", n)[0]
+def _shard_programs(n=2, bucket_mb=0):
+    # bucket_mb=0 keeps the per-tensor c_allreduce_sum layout most of
+    # these tests manipulate; pass a positive value for the bucketed
+    # c_allreduce_fused layout (the FLAGS default in production).
+    return lint_program.transpile_shards("mlp", n, bucket_mb=bucket_mb)[0]
 
 
 def test_aligned_shards_are_clean():
@@ -284,6 +287,31 @@ def test_aligned_shards_are_clean():
     assert check_collective_ordering(shards) == []
     diags = analyze_shard_programs(shards, feed_names=["img", "label"])
     assert _errors(diags) == [], format_report(diags)
+
+
+def test_aligned_bucketed_shards_are_clean():
+    shards = _shard_programs(bucket_mb=32)
+    fused = [op.type for op in shards[0].global_block().ops
+             if op.type == "c_allreduce_fused"]
+    assert fused, "bucketed transpile should emit c_allreduce_fused"
+    assert check_collective_ordering(shards) == []
+    diags = analyze_shard_programs(shards, feed_names=["img", "label"])
+    assert _errors(diags) == [], format_report(diags)
+
+
+def test_bucket_membership_divergence_is_error():
+    shards = _shard_programs(bucket_mb=32)
+    blk = shards[1].global_block()
+    op = next(op for op in blk.ops if op.type == "c_allreduce_fused")
+    # drop one member from shard 1's bucket: same op count/type but the
+    # fused payload shapes now differ across shards -> deadlock
+    names = list(op.input("X"))
+    assert len(names) >= 2
+    op._inputs["X"] = names[:-1]
+    op._outputs["Out"] = names[:-1]
+    shards[1]._bump_version()
+    diags = check_collective_ordering(shards)
+    assert any("bucket membership" in d.message for d in _errors(diags))
 
 
 def test_shuffled_collectives_are_error():

@@ -1,0 +1,207 @@
+"""Gradient-collective scheduler benchmark (docs/COLLECTIVES.md).
+
+Times the SAME data-parallel training step under the naive per-tensor
+gradient communication (FLAGS_allreduce_bucket_mb=0: the scheduler is
+off and collectives land wherever lazy placement puts them) and under
+the bucketed comm scheduler (parallel/comm_scheduler.py), and reports
+per-step comm accounting from Engine.counters: collective bytes,
+fused-bucket count, overlap-eligible fraction, quantized buckets.
+
+CLI::
+
+    python tools/comm_bench.py [--cpu 8] [--steps 20] [--batch 64]
+        [--hidden 512] [--layers 4] [--bucket-mb 4]
+        [--quantize int8|bf16] [--json] [--threshold X]
+
+``--threshold`` is the CI regression gate (step_overhead_bench.py
+--threshold-ms discipline): exit non-zero when the bucketed step is
+more than X times the naive step (e.g. --threshold 1.15 tolerates 15%
+— on the virtual CPU mesh the fused reshape/concat traffic is
+emulation overhead, on real ICI the bucketing is the win).
+
+``comm_overlap_report()`` is imported by bench.py to emit the same
+accounting in its BENCH json tail.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def comm_overlap_report(counters):
+    """Comm accounting dict for a bench json tail (+ '#' line), from
+    Engine.counters after at least one dispatched step. Returns
+    (dict, line) — ({}, None) when the run issued no collectives."""
+    if not counters or not counters.get("collective_buckets"):
+        return {}, None
+    stats = {
+        "comm_bytes_total": int(counters.get("collective_bytes", 0)),
+        "comm_buckets_total": int(
+            counters.get("collective_buckets", 0)),
+        "comm_quantized_total": int(
+            counters.get("collective_quantized", 0)),
+        "grad_collectives_per_step": int(
+            counters.get("grad_collectives_per_step", 0)),
+        "comm_overlap_frac": round(
+            float(counters.get("comm_overlap_frac", 0.0)), 4),
+    }
+    line = (f"# comm_overlap: {stats['grad_collectives_per_step']} "
+            f"fused collective(s)/step, "
+            f"{stats['comm_bytes_total']} B total, overlap-eligible "
+            f"{stats['comm_overlap_frac']:.0%}, "
+            f"{stats['comm_quantized_total']} quantized bucket(s)")
+    return stats, line
+
+
+def _build(hidden, layers_n, batch):
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [hidden], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        h = x
+        for _ in range(layers_n):
+            h = layers.fc(h, hidden, act="relu")
+        pred = layers.fc(h, 1)
+        cost = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.AdamOptimizer(1e-3).minimize(cost)
+    rng = np.random.default_rng(0)
+    feed = {"x": rng.normal(size=(batch, hidden)).astype(np.float32),
+            "y": rng.normal(size=(batch, 1)).astype(np.float32)}
+    return main, startup, cost, feed
+
+
+def _time_steps(main, startup, cost, feed, steps):
+    """Sync per-step wall time (median of the timed window) + the
+    engine's counters. Fresh Engine/Scope per call so every config
+    traces its own executable."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core.engine import Engine
+    from paddle_tpu.core.scope import Scope
+    from paddle_tpu.parallel import DistributedStrategy
+
+    n_dev = _jax().device_count()
+    strat = DistributedStrategy(axes={"dp": n_dev}) \
+        if n_dev > 1 else None
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        eng = Engine(strategy=strat)
+        run = lambda: float(np.asarray(  # noqa: E731 — fetch fence
+            eng.run(main, scope, None, feed, [cost.name])[0]))
+        run()  # trace + compile
+        run()  # steady state
+        times = []
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            loss = run()
+            times.append(time.perf_counter() - t0)
+        if not np.isfinite(loss):
+            raise SystemExit(f"non-finite loss {loss}")
+    return float(np.median(times)), dict(eng.counters)
+
+
+def _jax():
+    import jax
+    return jax
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", type=int, default=0, metavar="N",
+                    help="force an N-device virtual CPU mesh (the "
+                         "container's sitecustomize overrides "
+                         "JAX_PLATFORMS, so the env var alone is not "
+                         "enough)")
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--hidden", type=int, default=512)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--bucket-mb", type=float, default=4.0,
+                    help="bucket cap for the scheduled run")
+    ap.add_argument("--quantize", default="",
+                    choices=["", "int8", "bf16"])
+    ap.add_argument("--json", action="store_true",
+                    help="one JSON summary line on stdout")
+    ap.add_argument("--threshold", type=float, default=None,
+                    metavar="X", help="CI gate: exit 1 when bucketed "
+                    "step time > X * naive step time")
+    args = ap.parse_args()
+
+    if args.cpu:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                f"{args.cpu}").strip()
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as fluid
+
+    devs = jax.devices()
+    platform = devs[0].platform
+    print(f"# comm_bench: {len(devs)}x "
+          f"{getattr(devs[0], 'device_kind', platform)} ({platform})"
+          + ("" if len(devs) > 1 else
+             "  *** single device: collectives are identity ***"),
+          file=sys.stderr)
+
+    main_p, startup, cost, feed = _build(args.hidden, args.layers,
+                                         args.batch)
+
+    fluid.set_flags({"FLAGS_allreduce_bucket_mb": 0.0,
+                     "FLAGS_quantized_allreduce": ""})
+    naive_s, _ = _time_steps(main_p, startup, cost, feed, args.steps)
+
+    fluid.set_flags({"FLAGS_allreduce_bucket_mb": args.bucket_mb,
+                     "FLAGS_quantized_allreduce": args.quantize})
+    try:
+        bucketed_s, counters = _time_steps(main_p, startup, cost,
+                                           feed, args.steps)
+    finally:
+        fluid.set_flags({"FLAGS_allreduce_bucket_mb": 32.0,
+                         "FLAGS_quantized_allreduce": ""})
+
+    stats, line = comm_overlap_report(counters)
+    ratio = bucketed_s / naive_s if naive_s else float("nan")
+    print(f"# naive    {naive_s * 1e3:8.2f} ms/step", file=sys.stderr)
+    print(f"# bucketed {bucketed_s * 1e3:8.2f} ms/step "
+          f"(bucket {args.bucket_mb} MB"
+          + (f", {args.quantize}" if args.quantize else "")
+          + f")  ratio {ratio:.3f}", file=sys.stderr)
+    if line:
+        print(line, file=sys.stderr)
+
+    summary = {"devices": len(devs), "platform": platform,
+               "hidden": args.hidden, "layers": args.layers,
+               "batch": args.batch,
+               "bucket_mb": args.bucket_mb,
+               "quantize": args.quantize or None,
+               "naive_ms_per_step": round(naive_s * 1e3, 3),
+               "bucketed_ms_per_step": round(bucketed_s * 1e3, 3),
+               "ratio": round(ratio, 4), **stats}
+    if args.json:
+        print(json.dumps(summary))
+    if args.threshold is not None and ratio > args.threshold:
+        print(f"# FAIL: ratio {ratio:.3f} > threshold "
+              f"{args.threshold}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

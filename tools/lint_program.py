@@ -136,14 +136,19 @@ def inject_defect(program, kind: str):
     raise ValueError(f"unknown injection {kind!r}")
 
 
-def transpile_shards(model: str, n_shards: int):
-    """Build `model` once per rank and run the collective transpiler."""
+def transpile_shards(model: str, n_shards: int, bucket_mb=None):
+    """Build `model` once per rank and run the collective transpiler.
+
+    ``bucket_mb`` routes to GradAllReduce(bucket_mb=...): 0 forces the
+    per-tensor c_allreduce_sum layout, None follows
+    FLAGS_allreduce_bucket_mb (bucketed c_allreduce_fused by default).
+    """
     from paddle_tpu.transpiler.collective import GradAllReduce
     eps = [f"127.0.0.1:{6170 + i}" for i in range(n_shards)]
     shards, feed_names, loss_name = [], None, None
     for rank in range(n_shards):
         main, startup, feed_names, loss = build_model(model)
-        GradAllReduce().transpile(
+        GradAllReduce(bucket_mb=bucket_mb).transpile(
             startup_program=startup, main_program=main, rank=rank,
             endpoints=eps, current_endpoint=eps[rank], wait_port=False)
         shards.append(main)
@@ -209,6 +214,12 @@ def _parser():
     p.add_argument("--shards", type=int, default=1,
                    help="transpile the model into N data-parallel shard "
                         "programs and also check collective ordering")
+    p.add_argument("--bucket-mb", type=float, default=None,
+                   metavar="MB",
+                   help="all-reduce bucket size for --shards transpile: "
+                        "0 = per-tensor c_allreduce_sum, default follows "
+                        "FLAGS_allreduce_bucket_mb (bucketed "
+                        "c_allreduce_fused)")
     p.add_argument("--passes", nargs="*", default=None,
                    metavar="PASS", help=f"subset of passes to run "
                    f"(default all: {', '.join(analysis_passes())})")
@@ -238,8 +249,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         label = os.path.basename(ns.program)
         programs = [program]
     elif ns.shards > 1:
+        bucket_mb = ns.bucket_mb
+        if bucket_mb is None and ns.inject == "shuffled_collectives":
+            # swapping needs >= 2 collectives; the bucketed default can
+            # fuse a small model's grads into a single op
+            bucket_mb = 0
         programs, feed_names, loss_name = transpile_shards(
-            ns.model, ns.shards)
+            ns.model, ns.shards, bucket_mb=bucket_mb)
         label = ns.model
         if fetch_names is None:
             fetch_names = [loss_name]
