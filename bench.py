@@ -645,15 +645,27 @@ def main():
             (stats or {}).get("comm"))
     except Exception:
         pass   # accounting only; never fail the bench on it
+    chaos, chaos_line = {}, None
+    if os.environ.get("PT_BENCH_CHAOS"):
+        # opt-in: spawns a 2-trainer PS job twice (clean + faulted),
+        # ~1 min on CPU — too slow for the default bench path
+        try:
+            from tools.chaos_report import chaos_report_line
+            chaos, chaos_line = chaos_report_line()
+        except Exception:
+            pass   # survival accounting only; never fail the bench
     print(json.dumps({
         "metric": "transformer_base_train_tokens_per_sec",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec",
         "vs_baseline": round(tokens_per_sec / V100_TOKENS_PER_SEC, 3),
         "comm_overlap": comm or None,
+        "chaos": chaos or None,
     }))
     if comm_line:
         print(comm_line, file=sys.stderr)
+    if chaos_line:
+        print(chaos_line, file=sys.stderr)
     print(f"# transformer: steps/s={sps:.2f} "
           f"loss {traj[0]:.4f}->{traj[1]:.4f}->{traj[2]:.4f}",
           file=sys.stderr)

@@ -272,6 +272,20 @@ class CheckpointManager:
             _restore(scope, name, arr, lod, place)
         return int(step)
 
+    def maybe_restore(self, scope=None, program=None,
+                      vars: Optional[Sequence[str]] = None,
+                      place=None, **kw) -> Optional[int]:
+        """``restore()`` if any committed checkpoint exists, else None.
+
+        The elastic-restart entry point (docs/RESILIENCE.md): a worker
+        relaunched by the launch supervisor calls this unconditionally —
+        attempt 0 finds an empty directory and trains from scratch;
+        restarted attempts resume from the latest durable snapshot."""
+        if self.latest_step() is None:
+            return None
+        return self.restore(step=None, scope=scope, program=program,
+                            vars=vars, place=place, **kw)
+
     # -- preemption ---------------------------------------------------------
 
     def install_preemption_hook(self, step_fn=None) -> None:

@@ -103,6 +103,7 @@ class Communicator:
         self._running = False
         self._send_thread = None
         self._recv_thread = None
+        self._heartbeat = None
 
     # -- registry (reference Communicator::GetInstance) --------------------
     @staticmethod
@@ -252,6 +253,18 @@ class Communicator:
             self._recv_thread = threading.Thread(
                 target=self._recv_loop, daemon=True, name="comm-recv")
             self._recv_thread.start()
+        # liveness beacon: the pserver's trainer registry evicts
+        # trainers that stop beating (docs/RESILIENCE.md) — without
+        # this, a crashed trainer's missing send_complete hangs serve()
+        if not FLAGS.communicator_fake_rpc and \
+                float(FLAGS.heartbeat_interval_s) > 0:
+            from .distributed.resilience import Heartbeat
+            eps = sorted(
+                {c["endpoint"] for c in self._send_ctx.values()} |
+                set(self._recv_ctx.values()))
+            self._heartbeat = Heartbeat(
+                eps, self._trainer_id,
+                interval_s=float(FLAGS.heartbeat_interval_s)).start()
 
     def stop(self):
         """Flush pending grads, notify trainer completion (reference
@@ -266,6 +279,9 @@ class Communicator:
             self._send_thread.join(timeout=60)
         if self._recv_thread is not None:
             self._recv_thread.join(timeout=60)
+        if self._heartbeat is not None:
+            self._heartbeat.stop()
+            self._heartbeat = None
         eps = ({c["endpoint"] for c in self._send_ctx.values()} |
                set(self._recv_ctx.values()))
         if not FLAGS.communicator_fake_rpc:

@@ -38,6 +38,18 @@ _nan_check_ctx = threading.local()
 # ops the tracing engine handles itself / skips
 _ENGINE_OPS = {"feed", "fetch"}
 
+# lazily bound fault-injection module (avoids importing the distributed
+# package during core bootstrap); see paddle_tpu/distributed/faults.py
+_faults_mod = None
+
+
+def _fault_plan():
+    global _faults_mod
+    if _faults_mod is None:
+        from ..distributed import faults as _f
+        _faults_mod = _f
+    return _faults_mod.current()
+
 
 class _TrackingDict(dict):
     """env that records which names were (re)written during tracing."""
@@ -883,6 +895,30 @@ class Engine:
         # SPMD (shared tables, per-step constants) — globalized by
         # replication instead of batch-dim concatenation
         self.replicated_feeds = set(replicated_feeds)
+        # lazily built when FLAGS.step_timeout_s > 0 (docs/RESILIENCE.md)
+        self._watchdog = None
+
+    def _step_watchdog(self):
+        """The armed-per-dispatch hang detector (FLAGS_step_timeout_s);
+        None while the flag is off. Rebuilt if the timeout changes."""
+        t = float(FLAGS.step_timeout_s or 0)
+        if t <= 0:
+            return None
+        if self._watchdog is None or self._watchdog.timeout_s != t:
+            from ..distributed.resilience import StepWatchdog
+            self._watchdog = StepWatchdog(
+                t, context_fn=self._watchdog_context)
+        return self._watchdog
+
+    def _watchdog_context(self) -> str:
+        """Diagnosis attached to a watchdog trip: what the async
+        dispatch layer still has in flight when the step hung."""
+        pending = list(self._pending)
+        parts = [f"{len(pending)} pending async step(s)",
+                 f"{self.counters['runs']} run(s) dispatched"]
+        for rec in pending[-3:]:
+            parts.append(f"pending program {rec._fingerprint}")
+        return "; ".join(parts)
 
     def _normalize_feed(self, feed: Optional[Dict[str, Any]], place):
         self.counters["sig_builds"] += 1
@@ -1149,6 +1185,11 @@ class Engine:
             iterations: int = 1,
             use_program_cache: bool = True) -> List[Any]:
         self.counters["runs"] += 1
+        plan = _fault_plan()
+        if plan is not None:
+            # injected preemption: kill this process at step N (the
+            # supervised-restart path CI exercises without hardware)
+            plan.on_step(self.counters["runs"])
         iterations = int(iterations or 1)
         fast_key = None
         if use_program_cache:
@@ -1250,6 +1291,32 @@ class Engine:
 
     def _dispatch(self, program, scope, traced, arrays, donated_params,
                   const_params, return_numpy, updated_vars=None):
+        """Watchdog wrapper over :meth:`_dispatch_inner`: with
+        FLAGS_step_timeout_s > 0 the step runs armed, and a hang is
+        converted into the watchdog's diagnosable EnforceNotMet (the
+        monitor interrupts this thread; disarm() is inside the
+        converting try so a late interrupt cannot leak)."""
+        wd = self._step_watchdog()
+        if wd is None:
+            return self._dispatch_inner(
+                program, scope, traced, arrays, donated_params,
+                const_params, return_numpy, updated_vars)
+        try:
+            try:
+                wd.arm()
+                return self._dispatch_inner(
+                    program, scope, traced, arrays, donated_params,
+                    const_params, return_numpy, updated_vars)
+            finally:
+                wd.disarm()
+        except KeyboardInterrupt:
+            if wd.fired and wd.error is not None:
+                raise wd.error from None
+            raise
+
+    def _dispatch_inner(self, program, scope, traced, arrays,
+                        donated_params, const_params, return_numpy,
+                        updated_vars=None):
         """Shared dispatch tail of fast and slow paths: RNG split,
         executable call, device-resident scope writeback, NaN-check
         surfacing (inline or deferred), fetch wrapping. Under
@@ -1335,7 +1402,26 @@ class Engine:
         deferred NaN/Inf check (re-raising with the original op context)
         and block until the last step's updated persistables are
         resident — after this returns, the scope holds finished values
-        and any deferred XLA error has surfaced."""
+        and any deferred XLA error has surfaced. Runs under the step
+        watchdog (FLAGS_step_timeout_s): a barrier that never returns —
+        a dead collective peer, a wedged runtime — trips the same
+        diagnosable timeout as a hung step."""
+        wd = self._step_watchdog()
+        if wd is not None:
+            try:
+                try:
+                    wd.arm()
+                    self._synchronize_inner()
+                finally:
+                    wd.disarm()
+            except KeyboardInterrupt:
+                if wd.fired and wd.error is not None:
+                    raise wd.error from None
+                raise
+        else:
+            self._synchronize_inner()
+
+    def _synchronize_inner(self):
         pending, self._pending = self._pending, []
         for rec in pending:
             rec.check()

@@ -125,6 +125,50 @@ _define("communicator_fake_rpc", False, True,
 _define("communicator_merge_sparse_grad", True, True,
         "merge-add SelectedRows grads by row before push; False "
         "concatenates rows (communicator.cc:42)")
+# resilience layer (paddle_tpu/distributed/resilience.py,
+# docs/RESILIENCE.md) — the live successors of the reference's
+# FLAGS_rpc_deadline/FLAGS_rpc_retry_times (grpc_client.h:176)
+_define("rpc_deadline_s", 60.0, True,
+        "total per-RPC deadline in seconds across every retry of one "
+        "async_ps request (reference FLAGS_rpc_deadline was per-call "
+        "milliseconds with blind retries)")
+_define("rpc_max_retries", 5, True,
+        "retries after the first failed attempt of one async_ps RPC "
+        "(exponential backoff with jitter, bounded by rpc_deadline_s)")
+_define("rpc_backoff_base_s", 0.1, True,
+        "first-retry backoff; retry i sleeps base * 2**i (+ jitter), "
+        "capped at rpc_backoff_max_s")
+_define("rpc_backoff_max_s", 2.0, True,
+        "upper bound on a single backoff sleep (before jitter)")
+_define("rpc_backoff_jitter", 0.5, True,
+        "jitter fraction: each backoff is scaled by a uniform factor "
+        "in [1, 1+jitter] to decorrelate trainer retry storms")
+_define("rpc_breaker_failures", 5, True,
+        "consecutive failures to one endpoint before its circuit "
+        "breaker opens (fast-fail instead of full retry schedules)")
+_define("rpc_breaker_cooldown_s", 2.0, True,
+        "seconds an open breaker waits before allowing one half-open "
+        "probe to the endpoint")
+_define("rpc_max_message_mb", 1024, True,
+        "reject any wire message whose length prefix exceeds this many "
+        "MB before allocating — a corrupted/hostile 8-byte prefix must "
+        "not OOM the pserver")
+_define("pserver_handler_threads", 16, True,
+        "AsyncParameterServer request-handler pool size; a connection "
+        "flood degrades to queuing instead of unbounded thread "
+        "creation")
+_define("heartbeat_interval_s", 1.0, True,
+        "trainer->pserver liveness heartbeat cadence (the Communicator "
+        "starts the beacon); <= 0 disables heartbeating")
+_define("trainer_timeout_s", 0.0, True,
+        "pserver evicts a trainer silent (no heartbeat/push) for this "
+        "long: it is counted toward fanin so serve() cannot hang on a "
+        "crashed trainer's missing complete; <= 0 (default) disables "
+        "eviction")
+_define("step_timeout_s", 0.0, True,
+        "engine step watchdog: a step exceeding this raises a "
+        "diagnosable EnforceNotMet with pending-op context from the "
+        "async-dispatch layer; <= 0 (default) disables the watchdog")
 
 # -- subsumed flags: accepted, validated, no effect under XLA/PJRT ----------
 for _name, _default, _help in [
@@ -149,8 +193,9 @@ for _name, _default, _help in [
     ("enable_parallel_graph", False, "SPMD partitioner instead"),
     ("fuse_parameter_memory_size", -1, "XLA fusion instead"),
     ("inner_op_parallelism", 0, "XLA runtime owns threading"),
-    ("rpc_deadline", 180000, "no RPC runtime (pserver->collective)"),
-    ("dist_threadpool_size", 0, "no RPC runtime (pserver->collective)"),
+    ("rpc_deadline", 180000, "superseded by live FLAGS_rpc_deadline_s"),
+    ("dist_threadpool_size", 0,
+     "superseded by live FLAGS_pserver_handler_threads"),
 ]:
     _define(_name, _default, False, "subsumed: " + _help)
 

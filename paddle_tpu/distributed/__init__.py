@@ -1,6 +1,14 @@
-"""paddle.distributed namespace: the process launcher CLI.
+"""paddle.distributed namespace: the process launcher CLI plus the
+runtime-resilience toolkit.
 
 Parity: reference python/paddle/distributed/launch.py (spawn one
 trainer process per device with the PADDLE_* env contract).
+
+Resilience (docs/RESILIENCE.md): ``faults`` is the deterministic
+fault-injection plan the transport honours; ``resilience`` holds the
+retry policy, circuit breaker, trainer-liveness registry, heartbeat
+beacon, and step watchdog.
 """
+from . import faults  # noqa: F401
 from . import launch  # noqa: F401
+from . import resilience  # noqa: F401

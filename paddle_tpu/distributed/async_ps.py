@@ -33,20 +33,35 @@ policy threads (merge-by-sum queues, pull cadence) live in
 """
 from __future__ import annotations
 
+import logging
 import pickle
 import socket
 import struct
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from ..core.flags import FLAGS
+from . import faults
+from .resilience import (CircuitOpenError, RetryPolicy, TrainerRegistry,
+                         consume_retry, endpoint_health)
+
 __all__ = ["AsyncParameterServer", "push_grad", "pull_param",
            "pull_params", "send_complete", "notify_checkpoint",
-           "wait_server"]
+           "wait_server", "heartbeat", "MessageTooLargeError"]
+
+_log = logging.getLogger(__name__)
 
 _LEN = struct.Struct("<Q")
+
+
+class MessageTooLargeError(RuntimeError):
+    """Length prefix above FLAGS_rpc_max_message_mb — rejected BEFORE
+    allocation so a corrupted/hostile 8-byte prefix cannot OOM the
+    process. Not an OSError: the RPC layer must not retry it."""
 
 # every global a wire payload may construct: numpy array/scalar/dtype
 # reconstruction machinery (both the numpy 1.x "numpy.core" and 2.x
@@ -81,7 +96,24 @@ def _safe_loads(payload: bytes):
 
 def _send_msg(sock: socket.socket, obj) -> None:
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(_LEN.pack(len(payload)) + payload)
+    data = _LEN.pack(len(payload)) + payload
+    plan = faults.current()
+    if plan is not None:
+        action = plan.on_send(len(data))
+        if action is not None:
+            kind, n = action
+            try:
+                sock.sendall(data[:n])
+            finally:
+                try:
+                    sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+            if kind == "drop":
+                raise ConnectionResetError(
+                    "fault-injected mid-message drop")
+            return  # "truncate": sender pretends success
+    sock.sendall(data)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -96,6 +128,12 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 def _recv_msg(sock: socket.socket):
     (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    cap = int(FLAGS.rpc_max_message_mb) * 1024 * 1024
+    if cap > 0 and n > cap:
+        raise MessageTooLargeError(
+            f"refusing to allocate a {n}-byte wire message (> "
+            f"FLAGS_rpc_max_message_mb={FLAGS.rpc_max_message_mb}); "
+            f"corrupted or hostile length prefix")
     return _safe_loads(_recv_exact(sock, n))
 
 
@@ -105,24 +143,67 @@ def _parse_ep(endpoint: str):
     return host or "127.0.0.1", int(port)
 
 
-def _rpc(endpoint: str, msg, timeout: float = 60.0, retries: int = 3):
-    """One request/reply. Transient connection failures retry with
-    backoff (the reference gRPC client's deadline+retry,
-    grpc_client.h:176); semantics are at-least-once — a push whose
-    REPLY is lost may re-apply, same as the reference's async path."""
+def _rpc(endpoint: str, msg, timeout: Optional[float] = None,
+         retries: Optional[int] = None, track_health: bool = True):
+    """One request/reply under the resilience policy
+    (docs/RESILIENCE.md): total deadline FLAGS_rpc_deadline_s,
+    FLAGS_rpc_max_retries retries with exponential backoff + jitter,
+    and a per-endpoint circuit breaker that fast-fails while the
+    endpoint is known-dead (replacing the reference gRPC client's fixed
+    deadline+retry, grpc_client.h:176). Semantics are at-least-once — a
+    push whose REPLY is lost may re-apply, same as the reference's
+    async path.
+
+    ``timeout`` caps one attempt's socket ops (clipped to the remaining
+    deadline); ``track_health=False`` exempts pure liveness polls
+    (wait_server) from breaker bookkeeping so a not-yet-started server
+    is not recorded as a failing one.
+    """
     host, port = _parse_ep(endpoint)
-    last = None
-    for attempt in range(max(1, retries)):
+    policy = RetryPolicy.from_flags()
+    if retries is not None:
+        policy.max_retries = max(0, int(retries) - 1)
+    breaker = endpoint_health.get(endpoint) if track_health else None
+    plan = faults.current()
+    start = time.monotonic()
+    delays = iter(policy.delays())
+    last: Optional[OSError] = None
+    while True:
+        if breaker is not None and not breaker.allow():
+            consume_retry("breaker_fast_fails")
+            raise CircuitOpenError(
+                f"circuit breaker open for {endpoint} after "
+                f"{breaker.consecutive_failures} consecutive failures; "
+                f"next probe after FLAGS_rpc_breaker_cooldown_s") \
+                from last
         try:
+            if plan is not None:
+                plan.on_connect(endpoint)
+            att_timeout = policy.attempt_timeout(start, timeout)
             with socket.create_connection((host, port),
-                                          timeout=timeout) as s:
+                                          timeout=att_timeout) as s:
                 _send_msg(s, msg)
-                return _recv_msg(s)
+                rep = _recv_msg(s)
+            if breaker is not None:
+                breaker.record_success()
+            return rep
         except OSError as exc:
             last = exc
-            if attempt + 1 < retries:
-                time.sleep(0.3 * (attempt + 1))
-    raise last
+            if breaker is not None:
+                breaker.record_failure()
+            delay = next(delays, None)
+            if delay is None or \
+                    not policy.sleep_budgeted(delay, start):
+                raise last
+            consume_retry()
+
+
+def heartbeat(endpoint: str, trainer_id: int) -> None:
+    """One liveness beat to the pserver's trainer registry. Single
+    attempt — the Heartbeat thread provides the cadence; retrying a
+    missed beat is worse than sending the next one on time."""
+    _rpc(endpoint, {"t": "hb", "trainer": int(trainer_id)},
+         timeout=5.0, retries=1)
 
 
 def wait_server(endpoint: str, timeout: float = 60.0,
@@ -133,7 +214,8 @@ def wait_server(endpoint: str, timeout: float = 60.0,
     deadline = time.monotonic() + timeout
     while True:
         try:
-            if _rpc(endpoint, {"t": "ping"}, timeout=5.0) == "pong":
+            if _rpc(endpoint, {"t": "ping"}, timeout=5.0, retries=1,
+                    track_health=False) == "pong":
                 return
         except OSError:
             if time.monotonic() > deadline:
@@ -233,6 +315,15 @@ class AsyncParameterServer:
     semantics (the transpiled per-param sub-block); this class owns only
     the loop. A single lock serializes updates against pulls — the
     reference serializes per-var through its block queues the same way.
+
+    Liveness (docs/RESILIENCE.md): trainers heartbeat via the `hb`
+    message; every heartbeat or push refreshes the trainer's last-seen
+    timestamp. With FLAGS_trainer_timeout_s > 0, a seen-then-silent
+    trainer is EVICTED — counted toward fanin like an (abnormal)
+    complete — so `serve()` cannot hang forever on a crashed trainer's
+    missing `complete`. Request handling runs on a bounded pool
+    (FLAGS_pserver_handler_threads): a connection flood degrades to
+    queuing, not unbounded thread creation.
     """
 
     def __init__(self, endpoint: str, fanin: int,
@@ -252,6 +343,11 @@ class AsyncParameterServer:
         self._completed: set = set()
         self._done = threading.Event()
         self._push_count = 0
+        self.trainers = TrainerRegistry(
+            timeout_s=float(FLAGS.trainer_timeout_s))
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(2, int(FLAGS.pserver_handler_threads)),
+            thread_name_prefix="ps-handler")
         host, port = _parse_ep(endpoint)
         self._srv = socket.create_server((host, port))
         self._srv.settimeout(0.2)
@@ -259,11 +355,19 @@ class AsyncParameterServer:
     def _handle(self, conn: socket.socket) -> None:
         try:
             with conn:
+                plan = faults.current()
+                if plan is not None:
+                    plan.on_handle()
                 msg = _recv_msg(conn)
                 t = msg.get("t")
                 if t == "ping":
                     _send_msg(conn, "pong")
+                elif t == "hb":
+                    self.trainers.beat(msg["trainer"])
+                    _send_msg(conn, "ok")
                 elif t == "push":
+                    if "trainer" in msg:
+                        self.trainers.beat(msg["trainer"])
                     with self._lock:
                         self._apply(msg["name"], msg["v"],
                                     msg.get("merged_n", 1))
@@ -302,7 +406,7 @@ class AsyncParameterServer:
                 elif t == "complete":
                     with self._lock:
                         self._completed.add(msg["trainer"])
-                        done = len(self._completed) >= self.fanin
+                        done = self._effective_fanin_reached()
                     _send_msg(conn, "ok")
                     if done:
                         self._done.set()
@@ -316,16 +420,40 @@ class AsyncParameterServer:
             except OSError:
                 pass
 
+    def _effective_fanin_reached(self) -> bool:
+        """Caller holds self._lock. Completed and evicted trainers both
+        count: a crashed trainer will never send `complete`, and
+        waiting for it forever is the hang this exists to prevent."""
+        return len(self._completed
+                   | self.trainers.evicted) >= self.fanin
+
+    def _evict_dead_trainers(self) -> None:
+        with self._lock:
+            completed = set(self._completed)
+        newly = self.trainers.evict_dead(exclude=completed)
+        if not newly:
+            return
+        for tid in newly:
+            _log.warning(
+                "pserver %s: evicting trainer %s — silent for more "
+                "than FLAGS_trainer_timeout_s=%.1fs; counting it "
+                "toward fanin (docs/RESILIENCE.md)",
+                self.endpoint, tid, self.trainers.timeout_s)
+        with self._lock:
+            if self._effective_fanin_reached():
+                self._done.set()
+
     def serve(self) -> int:
         """Blocking loop; returns the number of pushes applied."""
         try:
             while not self._done.is_set():
+                self._evict_dead_trainers()
                 try:
                     conn, _ = self._srv.accept()
                 except socket.timeout:
                     continue
-                threading.Thread(target=self._handle, args=(conn,),
-                                 daemon=True).start()
+                self._pool.submit(self._handle, conn)
         finally:
             self._srv.close()
+            self._pool.shutdown(wait=False)
         return self._push_count

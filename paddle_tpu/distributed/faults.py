@@ -1,0 +1,217 @@
+"""Deterministic fault-injection for the distributed runtime.
+
+Every failure mode the resilience layer (docs/RESILIENCE.md) claims to
+survive must be reproducible in CI without real hardware or real
+network partitions. A :class:`FaultPlan` is a process-local, seeded
+source of injected faults, hooked into the `async_ps` transport and the
+Engine step loop:
+
+* ``connect_refuse`` — probability an outgoing connection is refused
+  before the socket is even opened (a dead/partitioned peer);
+* ``drop`` — probability a message send aborts mid-stream (connection
+  reset while the payload is in flight; BOTH ends see the failure);
+* ``truncate`` — probability a send silently delivers only a prefix and
+  closes (the sender "succeeds"; the receiver sees a short stream —
+  the corrupted-payload case);
+* ``delay`` — probability the pserver sleeps before handling a request
+  (a hung/slow peer, exercising deadlines and the step watchdog);
+* ``kill_at_step`` — the process calls ``os._exit(KILL_EXIT_CODE)``
+  when the engine dispatches step N (a preemption), limited to the
+  first ``kill_attempts`` incarnations so a supervised restart is not
+  re-killed forever.
+
+Determinism: one ``random.Random(seed)`` stream, consumed in hook-call
+order. Two processes running the same plan over the same operation
+sequence inject the same faults; CI failures replay exactly.
+
+Configuration: ``FaultPlan.from_spec("seed=7,connect_refuse=0.1,...")``
+or the ``PT_FAULT_PLAN`` environment variable (read by ``from_env``,
+which `launch.py` forwards to every worker). ``install()``/``current()``
+manage the process-local active plan; transport hooks are no-ops when
+no plan is installed.
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Dict, Optional
+
+__all__ = ["FaultPlan", "install", "current", "uninstall", "scoped",
+           "KILL_EXIT_CODE"]
+
+# distinctive exit code for an injected self-kill, so the launch.py
+# supervisor (and humans reading logs) can tell an injected preemption
+# from a real crash
+KILL_EXIT_CODE = 43
+
+_lock = threading.Lock()
+_active: Optional["FaultPlan"] = None
+
+_FLOAT_KEYS = ("connect_refuse", "drop", "truncate", "delay",
+               "delay_s")
+_INT_KEYS = ("seed", "kill_at_step", "kill_attempts")
+
+
+class FaultPlan:
+    """Seeded, deterministic fault decisions; thread-safe counters."""
+
+    def __init__(self, seed: int = 0, connect_refuse: float = 0.0,
+                 drop: float = 0.0, truncate: float = 0.0,
+                 delay: float = 0.0, delay_s: float = 0.05,
+                 kill_at_step: Optional[int] = None,
+                 kill_attempts: int = 1, restart_attempt: int = 0):
+        self.seed = int(seed)
+        self.connect_refuse = float(connect_refuse)
+        self.drop = float(drop)
+        self.truncate = float(truncate)
+        self.delay = float(delay)
+        self.delay_s = float(delay_s)
+        self.kill_at_step = (None if kill_at_step is None
+                             else int(kill_at_step))
+        self.kill_attempts = int(kill_attempts)
+        self.restart_attempt = int(restart_attempt)
+        self._rng = random.Random(self.seed)
+        self._lock = threading.Lock()
+        self.counts: Dict[str, int] = {
+            "connect_refuse": 0, "drop": 0, "truncate": 0,
+            "delay": 0, "kill": 0}
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def from_spec(cls, spec: str,
+                  restart_attempt: int = 0) -> "FaultPlan":
+        """Parse ``"seed=7,connect_refuse=0.1,kill_at_step=12"``.
+        Unknown keys raise — a typoed fault spec silently injecting
+        nothing would make a chaos run vacuous."""
+        kw = {"restart_attempt": restart_attempt}
+        for item in (spec or "").split(","):
+            item = item.strip()
+            if not item:
+                continue
+            k, _, v = item.partition("=")
+            k = k.strip()
+            if k in _INT_KEYS:
+                kw[k] = int(v)
+            elif k in _FLOAT_KEYS:
+                kw[k] = float(v)
+            else:
+                raise ValueError(
+                    f"unknown fault-plan key {k!r} in {spec!r}; known: "
+                    f"{sorted(_INT_KEYS + _FLOAT_KEYS)}")
+        return cls(**kw)
+
+    @classmethod
+    def from_env(cls) -> Optional["FaultPlan"]:
+        """The plan named by ``PT_FAULT_PLAN``, or None. The restart
+        attempt comes from ``PADDLE_RESTART_ATTEMPT`` (set by the
+        launch.py supervisor) so ``kill_attempts`` can stop re-killing
+        restarted incarnations."""
+        spec = os.environ.get("PT_FAULT_PLAN", "").strip()
+        if not spec:
+            return None
+        attempt = int(os.environ.get("PADDLE_RESTART_ATTEMPT", "0"))
+        return cls.from_spec(spec, restart_attempt=attempt)
+
+    # -- decision stream ----------------------------------------------------
+
+    def _roll(self, prob: float) -> bool:
+        # always consume exactly one draw per decision so the stream
+        # stays aligned across plans with different probabilities
+        with self._lock:
+            u = self._rng.random()
+        return u < prob
+
+    def _count(self, key: str) -> None:
+        with self._lock:
+            self.counts[key] += 1
+
+    # -- transport hooks (async_ps) -----------------------------------------
+
+    def on_connect(self, endpoint: str) -> None:
+        """Called before an outgoing connection; raises to refuse."""
+        if self._roll(self.connect_refuse):
+            self._count("connect_refuse")
+            raise ConnectionRefusedError(
+                f"fault-injected connection refusal to {endpoint} "
+                f"(FaultPlan seed={self.seed})")
+
+    def on_send(self, nbytes: int):
+        """Called with the framed message size before a send. Returns
+        ``None`` (send normally), ``("drop", n)`` (send n bytes then
+        fail loudly), or ``("truncate", n)`` (send n bytes, close,
+        report success)."""
+        if self._roll(self.drop):
+            self._count("drop")
+            with self._lock:
+                n = self._rng.randrange(max(1, nbytes))
+            return ("drop", n)
+        if self._roll(self.truncate):
+            self._count("truncate")
+            with self._lock:
+                n = self._rng.randrange(max(1, nbytes))
+            return ("truncate", n)
+        return None
+
+    def on_handle(self) -> None:
+        """Server-side pre-handling hook: injected reply delay."""
+        if self._roll(self.delay):
+            self._count("delay")
+            time.sleep(self.delay_s)
+
+    # -- step hook (engine / worker loops) ----------------------------------
+
+    def kill_armed(self) -> bool:
+        return (self.kill_at_step is not None
+                and self.restart_attempt < self.kill_attempts)
+
+    def on_step(self, step: int) -> None:
+        """Self-kill at the configured step — the injected preemption.
+        ``os._exit`` (not sys.exit): a real preemption gives no chance
+        to run atexit hooks or flush queues."""
+        if self.kill_armed() and step >= self.kill_at_step:
+            self._count("kill")
+            os._exit(KILL_EXIT_CODE)
+
+
+# -- process-local active plan ----------------------------------------------
+
+def install(plan: Optional[FaultPlan]) -> Optional[FaultPlan]:
+    """Make ``plan`` the process's active plan; returns the previous."""
+    global _active
+    with _lock:
+        prev, _active = _active, plan
+    return prev
+
+
+def uninstall() -> None:
+    install(None)
+
+
+def current() -> Optional[FaultPlan]:
+    return _active
+
+
+class scoped:
+    """``with faults.scoped(plan): ...`` — install for a block (tests)."""
+
+    def __init__(self, plan: Optional[FaultPlan]):
+        self._plan = plan
+        self._prev: Optional[FaultPlan] = None
+
+    def __enter__(self) -> Optional[FaultPlan]:
+        self._prev = install(self._plan)
+        return self._plan
+
+    def __exit__(self, *exc) -> None:
+        install(self._prev)
+
+
+# install the env-configured plan at import time so every process in a
+# chaos run (launch.py workers inherit PT_FAULT_PLAN) is armed without
+# code changes in the training script
+_env_plan = FaultPlan.from_env()
+if _env_plan is not None:
+    install(_env_plan)

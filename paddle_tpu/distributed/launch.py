@@ -16,10 +16,24 @@ TPU-native notes:
 * `--backend cpu` forces JAX_PLATFORMS=cpu in the children (virtual
   multi-process clusters on one machine — CI, dry runs).
 
+Resilience (docs/RESILIENCE.md):
+* First failure kills the surviving gang with SIGTERM, waits
+  `--grace` seconds (letting CheckpointManager's SIGTERM preemption
+  hook finish a final save), then SIGKILLs stragglers — and the
+  launcher exits with the ORIGINAL failing exit code, not a
+  straggler's.
+* `--max-restarts N` turns the launcher into a supervisor: a failed
+  gang is torn down and relaunched up to N times, each incarnation
+  seeing PADDLE_RESTART_ATTEMPT so the training script restores from
+  the latest CheckpointManager snapshot (and fault plans with
+  `kill_attempts` stop re-killing restarted runs).
+
 Usage:
   python -m paddle_tpu.distributed.launch --nproc 2 train.py --lr 0.1
   python -m paddle_tpu.distributed.launch --ips host1,host2 \
       --started_port 6170 train.py       # one process per listed host
+  python -m paddle_tpu.distributed.launch --nproc 2 --max-restarts 3 \
+      train.py                           # elastic supervisor
 """
 from __future__ import annotations
 
@@ -31,7 +45,12 @@ import subprocess
 import sys
 import time
 
-__all__ = ["launch", "main"]
+__all__ = ["launch", "supervise", "main"]
+
+# default seconds between SIGTERM and SIGKILL when tearing a gang down:
+# long enough for a SIGTERM-hooked final checkpoint of a small model,
+# short enough that a wedged worker cannot stall CI
+DEFAULT_GRACE_S = 10.0
 
 
 def _free_ports(n, start=None):
@@ -48,9 +67,41 @@ def _free_ports(n, start=None):
     return ports
 
 
-def launch(script_args, nproc=1, ips=None, started_port=None,
-           backend=None, log_dir=None, extra_env=None):
-    """Spawn the trainer processes; returns the list of exit codes."""
+def _terminate_gang(procs, grace_s=DEFAULT_GRACE_S):
+    """SIGTERM every live worker, wait up to ``grace_s`` for them to
+    exit (their checkpoint preemption hooks run in this window), then
+    SIGKILL stragglers. Never returns with a live worker — stragglers
+    outliving the launcher was the original first-failure bug."""
+    alive = [p for _, p, _ in procs if p.poll() is None]
+    for p in alive:
+        try:
+            p.send_signal(signal.SIGTERM)
+        except OSError:
+            pass
+    deadline = time.monotonic() + max(0.0, grace_s)
+    while time.monotonic() < deadline:
+        if all(p.poll() is not None for p in alive):
+            return
+        time.sleep(0.05)
+    for p in alive:
+        if p.poll() is None:
+            try:
+                p.kill()
+            except OSError:
+                pass
+    for p in alive:
+        try:
+            p.wait(timeout=10)
+        except Exception:
+            pass
+
+
+def _run_once(script_args, nproc=1, ips=None, started_port=None,
+              backend=None, log_dir=None, extra_env=None,
+              grace_s=DEFAULT_GRACE_S):
+    """One gang launch. Returns ``(codes, first_fail)``: exit codes in
+    rank order, and the FIRST nonzero exit code observed (in failure
+    order, not rank order) or 0 when every rank succeeded."""
     if ips:
         hosts = [h.strip() for h in ips.split(",") if h.strip()]
         # one process per host entry, rank ordered by list position;
@@ -103,13 +154,14 @@ def launch(script_args, nproc=1, ips=None, started_port=None,
         out = err = None
         if log_dir:
             out = open(os.path.join(log_dir,
-                                    f"workerlog.{rank}"), "w")
+                                    f"workerlog.{rank}"), "a")
             err = subprocess.STDOUT
         procs.append((rank, subprocess.Popen(
             [sys.executable] + list(script_args), env=env,
             stdout=out, stderr=err), out))
 
     codes = {}
+    first_fail = 0
     try:
         while len(codes) < len(procs):
             for rank, p, _ in procs:
@@ -118,20 +170,64 @@ def launch(script_args, nproc=1, ips=None, started_port=None,
                 rc = p.poll()
                 if rc is not None:
                     codes[rank] = rc
-                    if rc != 0:
-                        # first failure aborts the cluster (reference
-                        # terminate_procs behavior)
-                        for r2, p2, _ in procs:
-                            if r2 != rank and p2.poll() is None:
-                                p2.send_signal(signal.SIGTERM)
+                    if rc != 0 and first_fail == 0:
+                        # first failure aborts the cluster; the
+                        # escalating teardown guarantees no straggler
+                        # outlives the launcher, and ITS exit code —
+                        # the original failure — is what propagates
+                        first_fail = rc
+                        _terminate_gang(procs, grace_s)
             time.sleep(0.2)
     finally:
+        _terminate_gang(procs, grace_s=0 if first_fail else grace_s)
         for _, p, f in procs:
-            if p.poll() is None:
-                p.kill()
             if f:
                 f.close()
-    return [codes[r] for r, _, _ in procs]
+    for rank, p, _ in procs:
+        codes.setdefault(rank, p.poll())
+    return [codes[r] for r, _, _ in procs], first_fail
+
+
+def launch(script_args, nproc=1, ips=None, started_port=None,
+           backend=None, log_dir=None, extra_env=None,
+           grace_s=DEFAULT_GRACE_S):
+    """Spawn the trainer processes; returns the list of exit codes."""
+    codes, _ = _run_once(script_args, nproc=nproc, ips=ips,
+                         started_port=started_port, backend=backend,
+                         log_dir=log_dir, extra_env=extra_env,
+                         grace_s=grace_s)
+    return codes
+
+
+def supervise(script_args, max_restarts=0, nproc=1, ips=None,
+              started_port=None, backend=None, log_dir=None,
+              extra_env=None, grace_s=DEFAULT_GRACE_S):
+    """Elastic supervisor: relaunch a failed gang up to
+    ``max_restarts`` times. Returns ``(exit_code, restarts_used)`` —
+    exit_code is 0 when some incarnation finished clean, else the
+    first-failure code of the final attempt.
+
+    Every incarnation gets ``PADDLE_RESTART_ATTEMPT`` in its env; the
+    training script pairs this with ``CheckpointManager.maybe_restore``
+    to continue from the latest durable snapshot (PR 3's commit
+    protocol guarantees the snapshot is complete or absent —
+    docs/CHECKPOINTING.md)."""
+    attempt = 0
+    while True:
+        env = dict(extra_env or {})
+        env["PADDLE_RESTART_ATTEMPT"] = str(attempt)
+        codes, first_fail = _run_once(
+            script_args, nproc=nproc, ips=ips,
+            started_port=started_port, backend=backend,
+            log_dir=log_dir, extra_env=env, grace_s=grace_s)
+        if first_fail == 0:
+            return 0, attempt
+        if attempt >= max_restarts:
+            return first_fail, attempt
+        attempt += 1
+        print(f"paddle_tpu.distributed.launch: gang failed "
+              f"(exit {first_fail}); restart {attempt}/{max_restarts}",
+              file=sys.stderr, flush=True)
 
 
 def main(argv=None):
@@ -151,14 +247,24 @@ def main(argv=None):
                     help="cpu forces JAX_PLATFORMS=cpu in children")
     ap.add_argument("--log_dir", default=None,
                     help="write per-rank workerlog.N files here")
+    ap.add_argument("--max-restarts", "--max_restarts", type=int,
+                    default=0, dest="max_restarts",
+                    help="supervisor mode: relaunch a failed gang up "
+                         "to N times (workers resume via "
+                         "CheckpointManager; docs/RESILIENCE.md)")
+    ap.add_argument("--grace", type=float, default=DEFAULT_GRACE_S,
+                    dest="grace_s",
+                    help="seconds between SIGTERM and SIGKILL when "
+                         "tearing down a failed gang")
     ap.add_argument("script", help="training script")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
-    codes = launch([args.script] + args.script_args, nproc=args.nproc,
-                   ips=args.ips, started_port=args.started_port,
-                   backend=args.backend, log_dir=args.log_dir)
-    bad = [c for c in codes if c != 0]
-    sys.exit(bad[0] if bad else 0)
+    code, _restarts = supervise(
+        [args.script] + args.script_args, max_restarts=args.max_restarts,
+        nproc=args.nproc, ips=args.ips, started_port=args.started_port,
+        backend=args.backend, log_dir=args.log_dir,
+        grace_s=args.grace_s)
+    sys.exit(code)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,435 @@
+"""Distributed-runtime resilience primitives (docs/RESILIENCE.md).
+
+The reference Fluid stack's survival story is a fixed gRPC deadline and
+retry count (grpc_client.h:176) — a hung pserver or crashed trainer
+stalls the job until an operator intervenes. This module is the
+detection-and-survival layer the rebuild adds on top of PR 3's durable
+checkpointing:
+
+* :class:`RetryPolicy` — configurable deadlines and exponential backoff
+  with jitter for the `async_ps` RPC layer (replaces the fixed
+  ``retries=3, 0.3s linear`` schedule), driven by ``FLAGS_rpc_*``;
+* :class:`CircuitBreaker` / :class:`HealthRegistry` — per-endpoint
+  consecutive-failure tracking with open/half-open/closed states, so a
+  dead peer fails fast instead of consuming a full retry schedule per
+  call;
+* :class:`TrainerRegistry` / :class:`Heartbeat` — pserver-side liveness
+  tracking of trainers (last-seen timestamps, eviction of the silent)
+  and the trainer-side heartbeat thread that feeds it;
+* :class:`StepWatchdog` — a step-duration monitor that interrupts a
+  hung step and raises a diagnosable ``EnforceNotMet`` carrying the
+  async-dispatch layer's pending-op context.
+
+All clocks are injectable (``clock=``) so every state machine is
+testable without real waiting.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Set
+
+from ..core.enforce import EnforceNotMet
+from ..core.flags import FLAGS
+
+__all__ = ["RetryPolicy", "CircuitBreaker", "CircuitOpenError",
+           "HealthRegistry", "endpoint_health", "TrainerRegistry",
+           "Heartbeat", "StepWatchdog", "retry_stats",
+           "consume_retry", "reset_retry_stats"]
+
+_log = logging.getLogger(__name__)
+
+
+# -- retry accounting (read by tools/chaos_report.py) ------------------------
+
+_stats_lock = threading.Lock()
+_retry_stats: Dict[str, int] = {"retries": 0, "breaker_fast_fails": 0}
+
+
+def consume_retry(kind: str = "retries") -> None:
+    with _stats_lock:
+        _retry_stats[kind] = _retry_stats.get(kind, 0) + 1
+
+
+def retry_stats() -> Dict[str, int]:
+    with _stats_lock:
+        return dict(_retry_stats)
+
+
+def reset_retry_stats() -> None:
+    with _stats_lock:
+        for k in list(_retry_stats):
+            _retry_stats[k] = 0
+
+
+# -- retry policy ------------------------------------------------------------
+
+class RetryPolicy:
+    """Exponential backoff with jitter under a total deadline.
+
+    ``delays()`` yields the sleep before each RETRY (so ``max_retries``
+    retries = ``max_retries + 1`` total attempts). Delay ``i`` lies in
+    ``[base * mult**i, min(cap, base * mult**i) * (1 + jitter)]`` —
+    bounded below by the deterministic schedule and above by the cap
+    plus the jitter fraction. Jitter decorrelates the retry storms of
+    many trainers hammering one recovering pserver.
+    """
+
+    def __init__(self, deadline_s: float = 60.0, max_retries: int = 5,
+                 base_s: float = 0.1, multiplier: float = 2.0,
+                 max_backoff_s: float = 2.0, jitter: float = 0.5,
+                 rng=None, clock: Callable[[], float] = time.monotonic):
+        self.deadline_s = float(deadline_s)
+        self.max_retries = max(0, int(max_retries))
+        self.base_s = float(base_s)
+        self.multiplier = float(multiplier)
+        self.max_backoff_s = float(max_backoff_s)
+        self.jitter = float(jitter)
+        self._rng = rng  # None -> random.random (module fn, thread-safe)
+        self._clock = clock
+
+    @classmethod
+    def from_flags(cls, deadline_s: Optional[float] = None,
+                   max_retries: Optional[int] = None) -> "RetryPolicy":
+        return cls(
+            deadline_s=(FLAGS.rpc_deadline_s if deadline_s is None
+                        else deadline_s),
+            max_retries=(FLAGS.rpc_max_retries if max_retries is None
+                         else max_retries),
+            base_s=FLAGS.rpc_backoff_base_s,
+            max_backoff_s=FLAGS.rpc_backoff_max_s,
+            jitter=FLAGS.rpc_backoff_jitter)
+
+    def _uniform(self) -> float:
+        if self._rng is not None:
+            return self._rng.random()
+        import random
+        return random.random()
+
+    def delays(self) -> List[float]:
+        """The full backoff schedule (one entry per retry)."""
+        out = []
+        for i in range(self.max_retries):
+            det = min(self.max_backoff_s,
+                      self.base_s * self.multiplier ** i)
+            out.append(det * (1.0 + self.jitter * self._uniform()))
+        return out
+
+    def sleep_budgeted(self, delay: float, start: float) -> bool:
+        """Sleep ``delay`` unless it would cross the deadline; returns
+        False when the deadline is exhausted (caller stops retrying)."""
+        remaining = self.deadline_s - (self._clock() - start)
+        if remaining <= 0:
+            return False
+        time.sleep(min(delay, remaining))
+        return True
+
+    def attempt_timeout(self, start: float,
+                        per_attempt: Optional[float] = None) -> float:
+        """Socket timeout for the next attempt: the per-attempt cap
+        clipped to what is left of the total deadline."""
+        remaining = self.deadline_s - (self._clock() - start)
+        cap = per_attempt if per_attempt is not None else self.deadline_s
+        return max(0.001, min(cap, remaining))
+
+
+# -- circuit breaker ---------------------------------------------------------
+
+class CircuitOpenError(ConnectionError):
+    """Fast-fail: the endpoint's breaker is open (recent consecutive
+    failures); no connection was attempted. An OSError subclass so
+    existing transport error handling treats it as a transient network
+    failure."""
+
+
+class CircuitBreaker:
+    """closed -> (N consecutive failures) -> open -> (cooldown) ->
+    half-open (ONE probe) -> closed on success / open on failure.
+
+    The reference has nothing like this — its gRPC channel retries each
+    call blind. With many grad vars per step, a dead pserver otherwise
+    costs a full retry schedule per push; the breaker converts that to
+    one probe per cooldown window.
+    """
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, failure_threshold: int = 5,
+                 cooldown_s: float = 2.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.state = self.CLOSED
+        self.consecutive_failures = 0
+        self._opened_at = 0.0
+        self._probe_inflight = False
+
+    def allow(self) -> bool:
+        """May a request proceed right now? In half-open, exactly one
+        caller gets True (the probe) until it reports a result."""
+        with self._lock:
+            if self.state == self.CLOSED:
+                return True
+            now = self._clock()
+            if self.state == self.OPEN:
+                if now - self._opened_at < self.cooldown_s:
+                    return False
+                self.state = self.HALF_OPEN
+                self._probe_inflight = False
+            if self.state == self.HALF_OPEN:
+                if self._probe_inflight:
+                    return False
+                self._probe_inflight = True
+                return True
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self.state = self.CLOSED
+            self.consecutive_failures = 0
+            self._probe_inflight = False
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self.consecutive_failures += 1
+            if self.state == self.HALF_OPEN or \
+                    self.consecutive_failures >= self.failure_threshold:
+                if self.state != self.OPEN:
+                    _log.warning(
+                        "circuit breaker OPEN after %d consecutive "
+                        "failures (cooldown %.1fs)",
+                        self.consecutive_failures, self.cooldown_s)
+                self.state = self.OPEN
+                self._opened_at = self._clock()
+                self._probe_inflight = False
+
+
+class HealthRegistry:
+    """Per-endpoint breakers, process-wide. Thresholds come from
+    ``FLAGS_rpc_breaker_*`` at first use of each endpoint."""
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self._lock = threading.Lock()
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._clock = clock
+
+    def get(self, endpoint: str) -> CircuitBreaker:
+        with self._lock:
+            br = self._breakers.get(endpoint)
+            if br is None:
+                br = CircuitBreaker(
+                    failure_threshold=int(FLAGS.rpc_breaker_failures),
+                    cooldown_s=float(FLAGS.rpc_breaker_cooldown_s),
+                    clock=self._clock)
+                self._breakers[endpoint] = br
+            return br
+
+    def snapshot(self) -> Dict[str, Dict]:
+        with self._lock:
+            return {ep: {"state": b.state,
+                         "consecutive_failures": b.consecutive_failures}
+                    for ep, b in self._breakers.items()}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._breakers.clear()
+
+
+# the process-wide registry async_ps._rpc consults
+endpoint_health = HealthRegistry()
+
+
+# -- trainer liveness (pserver side) -----------------------------------------
+
+class TrainerRegistry:
+    """Last-seen timestamps per trainer id; eviction of the silent.
+
+    A trainer is *seen* on any heartbeat or push. Once seen, going
+    silent for longer than ``timeout_s`` marks it dead: ``evict_dead``
+    moves it to ``evicted`` so the server's fanin accounting can treat
+    it as (abnormally) complete and ``serve()`` cannot hang forever on
+    a crashed trainer's missing ``complete``. ``timeout_s <= 0``
+    disables eviction entirely.
+    """
+
+    def __init__(self, timeout_s: float = 0.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.timeout_s = float(timeout_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.last_seen: Dict[int, float] = {}
+        self.evicted: Set[int] = set()
+
+    def beat(self, trainer_id: int) -> None:
+        with self._lock:
+            self.last_seen[int(trainer_id)] = self._clock()
+            # a heartbeat from an "evicted" trainer means the partition
+            # healed; welcome it back (its pushes were served anyway)
+            self.evicted.discard(int(trainer_id))
+
+    def evict_dead(self, exclude: Optional[Set[int]] = None) -> List[int]:
+        """Evict every seen-but-silent trainer; returns the NEWLY
+        evicted ids. ``exclude`` (completed trainers) are never evicted
+        — silence after ``complete`` is normal exit."""
+        if self.timeout_s <= 0:
+            return []
+        now = self._clock()
+        newly = []
+        with self._lock:
+            for tid, seen in self.last_seen.items():
+                if exclude and tid in exclude:
+                    continue
+                if tid in self.evicted:
+                    continue
+                if now - seen > self.timeout_s:
+                    self.evicted.add(tid)
+                    newly.append(tid)
+        return newly
+
+
+class Heartbeat:
+    """Trainer-side liveness beacon: a daemon thread sending one
+    heartbeat per endpoint every ``interval_s``. Failures are swallowed
+    (a restarting pserver must not kill the trainer — the RPC layer's
+    breaker handles persistent death) but counted."""
+
+    def __init__(self, endpoints: List[str], trainer_id: int,
+                 interval_s: float = 1.0,
+                 send_fn: Optional[Callable[[str, int], None]] = None):
+        self.endpoints = [e for e in dict.fromkeys(endpoints) if e]
+        self.trainer_id = int(trainer_id)
+        self.interval_s = float(interval_s)
+        if send_fn is None:
+            from . import async_ps
+            send_fn = async_ps.heartbeat
+        self._send = send_fn
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.sent = 0
+        self.failed = 0
+
+    def start(self) -> "Heartbeat":
+        if self._thread is None and self.endpoints \
+                and self.interval_s > 0:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="pt-heartbeat")
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            for ep in self.endpoints:
+                try:
+                    self._send(ep, self.trainer_id)
+                    self.sent += 1
+                except OSError:
+                    self.failed += 1
+            self._stop.wait(self.interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+# -- step watchdog (engine side) ---------------------------------------------
+
+class StepWatchdog:
+    """Detects a hung step: ``arm()`` before dispatch, ``disarm()``
+    after. If a step stays armed past ``timeout_s``, the monitor thread
+    builds an ``EnforceNotMet`` carrying ``context_fn()``'s diagnosis
+    (the engine passes pending-op context from the async-dispatch
+    layer) and interrupts the hung thread via ``interrupt_main`` — the
+    dispatching code converts that KeyboardInterrupt back into the
+    stored error (``fired``/``error``).
+
+    The fire decision and ``disarm()`` share one lock, so once
+    ``disarm()`` returns no late interrupt can leak into unrelated
+    code.
+    """
+
+    def __init__(self, timeout_s: float,
+                 context_fn: Optional[Callable[[], str]] = None,
+                 on_timeout: Optional[Callable[[], None]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.timeout_s = float(timeout_s)
+        self._context_fn = context_fn
+        self._on_timeout = on_timeout
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._armed_at: Optional[float] = None
+        self._gen = 0
+        self.fired = False
+        self.error: Optional[EnforceNotMet] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def arm(self) -> None:
+        with self._cv:
+            self._armed_at = self._clock()
+            self._gen += 1
+            self.fired = False
+            self.error = None
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._monitor, daemon=True,
+                    name="pt-step-watchdog")
+                self._thread.start()
+            self._cv.notify_all()
+
+    def disarm(self) -> None:
+        with self._cv:
+            self._armed_at = None
+            self._cv.notify_all()
+
+    def _build_error(self) -> EnforceNotMet:
+        ctx = ""
+        if self._context_fn is not None:
+            try:
+                ctx = "; " + str(self._context_fn())
+            except Exception as exc:
+                ctx = f"; (context unavailable: {exc})"
+        return EnforceNotMet(
+            f"step watchdog: step exceeded FLAGS_step_timeout_s="
+            f"{self.timeout_s}s — a collective peer may be dead or an "
+            f"RPC hung (docs/RESILIENCE.md){ctx}")
+
+    def _monitor(self) -> None:
+        while True:
+            with self._cv:
+                if self._armed_at is None:
+                    # parked: wait for the next arm (bounded so an
+                    # abandoned watchdog thread eventually exits)
+                    if not self._cv.wait(timeout=60) \
+                            and self._armed_at is None:
+                        return
+                    continue
+                gen = self._gen
+                remaining = self.timeout_s - (self._clock()
+                                              - self._armed_at)
+                if remaining > 0:
+                    self._cv.wait(timeout=min(remaining, 0.5))
+                    continue
+                # still armed past the deadline: fire under the lock so
+                # disarm() can never race a late interrupt
+                if self._gen != gen or self._armed_at is None:
+                    continue
+                self.error = self._build_error()
+                self.fired = True
+                self._armed_at = None
+                cb = self._on_timeout
+                if cb is None:
+                    # under the lock: a disarm() racing this fire is
+                    # still blocked on the lock, so by the time it
+                    # returns the interrupt flag is already set and the
+                    # dispatcher's KeyboardInterrupt handler (which
+                    # wraps disarm too) converts it — no leak into
+                    # unrelated code
+                    import _thread
+                    _thread.interrupt_main()
+            if cb is not None:
+                cb()

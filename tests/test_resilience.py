@@ -1,0 +1,598 @@
+"""Distributed runtime resilience (docs/RESILIENCE.md): deterministic
+fault injection, RPC deadlines/backoff/breaker, trainer liveness and
+eviction, the engine step watchdog, and the launch supervisor's
+kill-escalation + elastic-restart paths."""
+import os
+import socket
+import struct
+import subprocess
+import sys
+import tempfile
+import textwrap
+import threading
+import time
+import unittest
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from paddle_tpu.core.flags import get_flags, set_flags  # noqa: E402
+from paddle_tpu.distributed import async_ps, faults  # noqa: E402
+from paddle_tpu.distributed import launch as pt_launch  # noqa: E402
+from paddle_tpu.distributed import resilience  # noqa: E402
+from paddle_tpu.distributed.faults import FaultPlan  # noqa: E402
+from paddle_tpu.distributed.resilience import (  # noqa: E402
+    CircuitBreaker, CircuitOpenError, Heartbeat, RetryPolicy,
+    StepWatchdog, TrainerRegistry)
+
+
+class _FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _flags_scope(test, flags):
+    names = list(flags)
+    old = get_flags(names)
+    set_flags(flags)
+    test.addCleanup(set_flags, old)
+
+
+def _free_ep():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return f"127.0.0.1:{s.getsockname()[1]}"
+
+
+# ---------------------------------------------------------------------------
+# fault plans
+# ---------------------------------------------------------------------------
+
+class TestFaultPlan(unittest.TestCase):
+    def test_seeded_decisions_are_deterministic(self):
+        def sequence(plan):
+            out = []
+            for _ in range(60):
+                try:
+                    plan.on_connect("ep")
+                    out.append(0)
+                except ConnectionRefusedError:
+                    out.append(1)
+            return out
+
+        a = sequence(FaultPlan(seed=5, connect_refuse=0.3))
+        b = sequence(FaultPlan(seed=5, connect_refuse=0.3))
+        self.assertEqual(a, b)
+        self.assertIn(1, a)   # the plan actually injects
+        self.assertIn(0, a)
+        self.assertNotEqual(
+            a, sequence(FaultPlan(seed=6, connect_refuse=0.3)))
+
+    def test_one_draw_per_decision_keeps_streams_aligned(self):
+        # a plan with some probabilities zeroed must make the SAME
+        # decisions for the remaining faults at every decision index
+        full = FaultPlan(seed=9, connect_refuse=0.4, drop=0.0)
+        sparse = FaultPlan(seed=9, connect_refuse=0.4, drop=0.0)
+        for plan in (full, sparse):
+            plan.on_send(100)     # consumes drop + truncate draws
+        refused = []
+        for plan in (full, sparse):
+            try:
+                plan.on_connect("ep")
+                refused.append(False)
+            except ConnectionRefusedError:
+                refused.append(True)
+        self.assertEqual(refused[0], refused[1])
+
+    def test_from_spec_rejects_unknown_keys(self):
+        with self.assertRaises(ValueError):
+            FaultPlan.from_spec("seed=1,connect_refuze=0.5")
+        p = FaultPlan.from_spec(
+            "seed=3, connect_refuse=0.25, kill_at_step=7")
+        self.assertEqual((p.seed, p.connect_refuse, p.kill_at_step),
+                         (3, 0.25, 7))
+
+    def test_kill_disarmed_after_supervised_restart(self):
+        armed = FaultPlan.from_spec("kill_at_step=4")
+        self.assertTrue(armed.kill_armed())
+        restarted = FaultPlan.from_spec("kill_at_step=4",
+                                        restart_attempt=1)
+        self.assertFalse(restarted.kill_armed())
+        restarted.on_step(100)   # must NOT os._exit
+        two_shot = FaultPlan.from_spec("kill_at_step=4,kill_attempts=2",
+                                       restart_attempt=1)
+        self.assertTrue(two_shot.kill_armed())
+
+    def test_scoped_install(self):
+        self.assertIsNone(faults.current())
+        plan = FaultPlan(seed=1)
+        with faults.scoped(plan):
+            self.assertIs(faults.current(), plan)
+        self.assertIsNone(faults.current())
+
+    def test_send_drop_and_truncate_actions(self):
+        plan = FaultPlan(seed=0, drop=1.0)
+        kind, n = plan.on_send(64)
+        self.assertEqual(kind, "drop")
+        self.assertTrue(0 <= n < 64)
+        plan = FaultPlan(seed=0, truncate=1.0)
+        self.assertEqual(plan.on_send(64)[0], "truncate")
+        self.assertEqual(plan.counts["truncate"], 1)
+
+
+# ---------------------------------------------------------------------------
+# retry policy + breaker
+# ---------------------------------------------------------------------------
+
+class TestRetryPolicy(unittest.TestCase):
+    def test_backoff_bounds_and_count(self):
+        class U:
+            def __init__(self, v):
+                self.v = v
+
+            def random(self):
+                return self.v
+
+        lo = RetryPolicy(max_retries=4, base_s=0.1, multiplier=2.0,
+                         max_backoff_s=2.0, jitter=0.5, rng=U(0.0))
+        hi = RetryPolicy(max_retries=4, base_s=0.1, multiplier=2.0,
+                         max_backoff_s=2.0, jitter=0.5, rng=U(1.0))
+        dlo, dhi = lo.delays(), hi.delays()
+        self.assertEqual(len(dlo), 4)
+        for i in range(4):
+            det = min(2.0, 0.1 * 2 ** i)
+            self.assertAlmostEqual(dlo[i], det)
+            self.assertAlmostEqual(dhi[i], det * 1.5)
+
+    def test_deadline_budget(self):
+        clk = _FakeClock()
+        pol = RetryPolicy(deadline_s=10.0, clock=clk)
+        start = clk()
+        clk.t += 9.999
+        self.assertTrue(pol.sleep_budgeted(0.0001, start))
+        clk.t += 1.0
+        self.assertFalse(pol.sleep_budgeted(0.1, start))
+        # per-attempt socket timeout is clipped to what's left
+        clk.t = start + 8.0
+        self.assertAlmostEqual(pol.attempt_timeout(start, 30.0), 2.0)
+        self.assertAlmostEqual(pol.attempt_timeout(start, 0.5), 0.5)
+
+    def test_from_flags(self):
+        _flags_scope(self, {"rpc_deadline_s": 7.0, "rpc_max_retries": 2})
+        pol = RetryPolicy.from_flags()
+        self.assertEqual((pol.deadline_s, pol.max_retries), (7.0, 2))
+
+
+class TestCircuitBreaker(unittest.TestCase):
+    def test_open_half_open_close_cycle(self):
+        clk = _FakeClock()
+        br = CircuitBreaker(failure_threshold=3, cooldown_s=5.0,
+                            clock=clk)
+        for _ in range(2):
+            br.record_failure()
+        self.assertEqual(br.state, br.CLOSED)
+        br.record_failure()
+        self.assertEqual(br.state, br.OPEN)
+        self.assertFalse(br.allow())
+        clk.t += 5.1                       # cooldown elapsed
+        self.assertTrue(br.allow())        # the single half-open probe
+        self.assertEqual(br.state, br.HALF_OPEN)
+        self.assertFalse(br.allow())       # concurrent callers blocked
+        br.record_success()
+        self.assertEqual(br.state, br.CLOSED)
+        self.assertTrue(br.allow())
+
+    def test_half_open_probe_failure_reopens(self):
+        clk = _FakeClock()
+        br = CircuitBreaker(failure_threshold=1, cooldown_s=1.0,
+                            clock=clk)
+        br.record_failure()
+        clk.t += 1.1
+        self.assertTrue(br.allow())
+        br.record_failure()                # probe failed
+        self.assertEqual(br.state, br.OPEN)
+        self.assertFalse(br.allow())
+
+
+# ---------------------------------------------------------------------------
+# hardened RPC layer
+# ---------------------------------------------------------------------------
+
+class TestHardenedRpc(unittest.TestCase):
+    def setUp(self):
+        resilience.endpoint_health.reset()
+        resilience.reset_retry_stats()
+        self.addCleanup(resilience.endpoint_health.reset)
+
+    def test_dead_endpoint_retries_then_raises_within_deadline(self):
+        _flags_scope(self, {"rpc_deadline_s": 2.0, "rpc_max_retries": 2,
+                            "rpc_backoff_base_s": 0.01,
+                            "rpc_backoff_max_s": 0.05,
+                            "rpc_breaker_failures": 50})
+        ep = _free_ep()   # nothing listening
+        t0 = time.monotonic()
+        with self.assertRaises(OSError):
+            async_ps._rpc(ep, {"t": "ping"}, timeout=0.2)
+        self.assertLess(time.monotonic() - t0, 5.0)
+        self.assertEqual(resilience.retry_stats()["retries"], 2)
+
+    def test_breaker_fast_fails_after_consecutive_failures(self):
+        _flags_scope(self, {"rpc_deadline_s": 1.0, "rpc_max_retries": 0,
+                            "rpc_breaker_failures": 2,
+                            "rpc_breaker_cooldown_s": 60.0})
+        ep = _free_ep()
+        for _ in range(2):
+            with self.assertRaises(OSError):
+                async_ps._rpc(ep, {"t": "ping"}, timeout=0.2)
+        t0 = time.monotonic()
+        with self.assertRaises(CircuitOpenError):
+            async_ps._rpc(ep, {"t": "ping"}, timeout=0.2)
+        self.assertLess(time.monotonic() - t0, 0.2)  # no connect attempt
+        self.assertEqual(
+            resilience.retry_stats()["breaker_fast_fails"], 1)
+        # liveness polls are exempt: wait_server must not be poisoned
+        # by (or poison) the breaker
+        with self.assertRaises(TimeoutError):
+            async_ps.wait_server(ep, timeout=0.3, interval=0.05)
+
+    def test_recv_msg_rejects_oversized_length_prefix(self):
+        _flags_scope(self, {"rpc_max_message_mb": 1})
+        a, b = socket.socketpair()
+        self.addCleanup(a.close)
+        self.addCleanup(b.close)
+        a.sendall(struct.pack("<Q", 2 * 1024 * 1024))
+        with self.assertRaises(async_ps.MessageTooLargeError):
+            async_ps._recv_msg(b)
+
+    def test_injected_refusals_are_retried(self):
+        # breaker threshold above any plausible refusal streak: this
+        # test is about the RETRY layer riding out a lossy network, not
+        # about the breaker declaring the endpoint dead
+        _flags_scope(self, {"rpc_backoff_base_s": 0.01,
+                            "rpc_backoff_max_s": 0.02,
+                            "rpc_breaker_failures": 1000})
+        values = {"w": np.zeros(2, np.float32)}
+        server = async_ps.AsyncParameterServer(
+            _free_ep(), fanin=1, get_var=values.__getitem__,
+            apply_update=lambda n, v, m: None, known_params=["w"])
+        t = threading.Thread(target=server.serve, daemon=True)
+        t.start()
+        try:
+            # refuse roughly half the connects: every pull still lands
+            with faults.scoped(FaultPlan(seed=2, connect_refuse=0.5)):
+                for _ in range(6):
+                    np.testing.assert_array_equal(
+                        async_ps.pull_param(server.endpoint, "w"),
+                        values["w"])
+                plan = faults.current()
+                self.assertGreater(plan.counts["connect_refuse"], 0)
+            self.assertGreater(resilience.retry_stats()["retries"], 0)
+        finally:
+            async_ps.send_complete(server.endpoint, 0)
+            t.join(timeout=10)
+        self.assertFalse(t.is_alive())
+
+
+# ---------------------------------------------------------------------------
+# liveness: registry, heartbeats, eviction
+# ---------------------------------------------------------------------------
+
+class TestTrainerRegistry(unittest.TestCase):
+    def test_eviction_semantics(self):
+        clk = _FakeClock()
+        reg = TrainerRegistry(timeout_s=10.0, clock=clk)
+        reg.beat(0)
+        reg.beat(1)
+        clk.t += 11.0
+        reg.beat(1)
+        self.assertEqual(reg.evict_dead(), [0])     # only the silent one
+        self.assertEqual(reg.evict_dead(), [])      # newly-evicted once
+        clk.t += 11.0
+        self.assertEqual(reg.evict_dead(exclude={1}), [])  # completed
+        reg.beat(0)                                 # partition healed
+        self.assertNotIn(0, reg.evicted)
+
+    def test_timeout_zero_disables(self):
+        clk = _FakeClock()
+        reg = TrainerRegistry(timeout_s=0.0, clock=clk)
+        reg.beat(0)
+        clk.t += 1e6
+        self.assertEqual(reg.evict_dead(), [])
+
+
+class TestHeartbeat(unittest.TestCase):
+    def test_beacon_sends_and_counts_failures(self):
+        beats = []
+
+        def send(ep, tid):
+            beats.append((ep, tid))
+            if ep == "bad:1":
+                raise ConnectionRefusedError()
+
+        hb = Heartbeat(["good:1", "bad:1"], trainer_id=3,
+                       interval_s=0.02, send_fn=send).start()
+        time.sleep(0.2)
+        hb.stop()
+        self.assertGreaterEqual(hb.sent, 2)
+        self.assertGreaterEqual(hb.failed, 2)
+        self.assertIn(("good:1", 3), beats)
+
+    def test_dead_trainer_eviction_unblocks_serve(self):
+        # fanin=2; trainer 1 beats once then goes silent (crash before
+        # send_complete); trainer 0 completes normally. serve() must
+        # exit via eviction instead of hanging — ISSUE acceptance.
+        _flags_scope(self, {"trainer_timeout_s": 0.5})
+        applied = []
+        server = async_ps.AsyncParameterServer(
+            _free_ep(), fanin=2,
+            get_var=lambda n: np.zeros(1, np.float32),
+            apply_update=lambda n, v, m: applied.append(n),
+            known_params=["w"])
+        t = threading.Thread(target=server.serve, daemon=True)
+        t.start()
+        async_ps.heartbeat(server.endpoint, 1)   # seen ... then silent
+        async_ps.push_grad(server.endpoint, "w@GRAD",
+                           np.ones(1, np.float32), trainer_id=0)
+        async_ps.send_complete(server.endpoint, 0)
+        t.join(timeout=15)
+        self.assertFalse(t.is_alive(),
+                         "serve() hung on the dead trainer")
+        self.assertIn(1, server.trainers.evicted)
+        self.assertEqual(applied, ["w@GRAD"])
+
+    def test_handler_pool_is_bounded(self):
+        _flags_scope(self, {"pserver_handler_threads": 3})
+        server = async_ps.AsyncParameterServer(
+            _free_ep(), fanin=1,
+            get_var=lambda n: np.zeros(1, np.float32),
+            apply_update=lambda n, v, m: None, known_params=["w"])
+        self.assertEqual(server._pool._max_workers, 3)
+        t = threading.Thread(target=server.serve, daemon=True)
+        t.start()
+        # a burst well above the pool size degrades to queuing — every
+        # request is still answered
+        with __import__("concurrent.futures", fromlist=["x"]) \
+                .ThreadPoolExecutor(max_workers=16) as pool:
+            futs = [pool.submit(async_ps.pull_param, server.endpoint,
+                                "w") for _ in range(32)]
+            for f in futs:
+                np.testing.assert_array_equal(
+                    f.result(timeout=30), np.zeros(1, np.float32))
+        async_ps.send_complete(server.endpoint, 0)
+        t.join(timeout=10)
+        self.assertFalse(t.is_alive())
+
+
+# ---------------------------------------------------------------------------
+# step watchdog
+# ---------------------------------------------------------------------------
+
+class TestStepWatchdog(unittest.TestCase):
+    def test_fires_with_context_custom_callback(self):
+        fired = threading.Event()
+        wd = StepWatchdog(0.1, context_fn=lambda: "3 pending steps",
+                          on_timeout=fired.set)
+        wd.arm()
+        try:
+            self.assertTrue(fired.wait(timeout=5))
+        finally:
+            wd.disarm()
+        self.assertTrue(wd.fired)
+        self.assertIn("FLAGS_step_timeout_s", str(wd.error))
+        self.assertIn("3 pending steps", str(wd.error))
+
+    def test_interrupts_hung_main_thread(self):
+        wd = StepWatchdog(0.15, context_fn=lambda: "CTX42")
+        interrupted = False
+        wd.arm()
+        try:
+            try:
+                time.sleep(10)   # the "hung step"
+            finally:
+                wd.disarm()
+        except KeyboardInterrupt:
+            interrupted = True
+        self.assertTrue(interrupted)
+        self.assertTrue(wd.fired)
+        self.assertIn("CTX42", str(wd.error))
+
+    def test_disarm_before_timeout_never_fires(self):
+        wd = StepWatchdog(0.1, context_fn=lambda: "nope")
+        for _ in range(3):
+            wd.arm()
+            wd.disarm()
+        time.sleep(0.4)
+        self.assertFalse(wd.fired)
+        self.assertIsNone(wd.error)
+
+    def test_engine_watchdog_flag_gates(self):
+        import paddle_tpu as fluid
+        _flags_scope(self, {"step_timeout_s": 0.0})
+        exe = fluid.Executor(fluid.CPUPlace())
+        main, startup = fluid.Program(), fluid.Program()
+        from paddle_tpu import layers
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", [2], dtype="float32")
+            y = layers.scale(x, scale=2.0)
+        exe.run(startup)
+        out = exe.run(main,
+                      feed={"x": np.ones((1, 2), np.float32)},
+                      fetch_list=[y.name])
+        np.testing.assert_allclose(np.asarray(out[0]),
+                                   [[2.0, 2.0]])
+        # timeout off -> no watchdog is constructed on the hot path
+        self.assertIsNone(exe._engine._step_watchdog())
+        # flipped on, the engine builds one with the flag's timeout
+        set_flags({"step_timeout_s": 30.0})
+        wd = exe._engine._step_watchdog()
+        self.assertIsNotNone(wd)
+        self.assertEqual(wd.timeout_s, 30.0)
+        self.assertIn("pending", exe._engine._watchdog_context())
+
+
+# ---------------------------------------------------------------------------
+# launch: kill escalation, exit-code propagation, elastic supervisor
+# ---------------------------------------------------------------------------
+
+class TestLaunchResilience(unittest.TestCase):
+    def _script(self, body):
+        d = tempfile.mkdtemp()
+        path = os.path.join(d, "worker.py")
+        with open(path, "w") as f:
+            f.write(textwrap.dedent(body))
+        return path
+
+    def test_first_failure_kills_sigterm_ignoring_straggler(self):
+        # rank 1 fails with code 7; rank 0 ignores SIGTERM and would
+        # sleep forever — the launcher must SIGKILL it after the grace
+        # window and still exit with the ORIGINAL code 7
+        script = self._script("""
+            import os, signal, sys, time
+            if os.environ["PADDLE_TRAINER_ID"] == "1":
+                sys.exit(7)
+            signal.signal(signal.SIGTERM, signal.SIG_IGN)
+            time.sleep(120)
+        """)
+        t0 = time.monotonic()
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc", "2", "--grace", "1.0", script],
+            capture_output=True, text=True, timeout=120, cwd=REPO)
+        self.assertEqual(r.returncode, 7, r.stdout + r.stderr)
+        self.assertLess(time.monotonic() - t0, 60)
+
+    def test_supervisor_restarts_and_exits_clean(self):
+        # attempt 0 dies with the injected-kill code; attempt 1 (which
+        # sees PADDLE_RESTART_ATTEMPT=1) finishes — supervisor exits 0
+        marker = os.path.join(tempfile.mkdtemp(), "attempts.log")
+        script = self._script(f"""
+            import os, sys
+            attempt = os.environ.get("PADDLE_RESTART_ATTEMPT", "?")
+            with open({marker!r}, "a") as f:
+                f.write(attempt + "\\n")
+            sys.exit(43 if attempt == "0" else 0)
+        """)
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc", "1", "--max-restarts", "2", "--grace", "1.0",
+             script],
+            capture_output=True, text=True, timeout=120, cwd=REPO)
+        self.assertEqual(r.returncode, 0, r.stdout + r.stderr)
+        with open(marker) as f:
+            self.assertEqual(f.read().split(), ["0", "1"])
+        self.assertIn("restart 1/2", r.stderr)
+
+    def test_supervisor_exhausts_restarts_with_original_code(self):
+        script = self._script("import sys; sys.exit(9)")
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc", "1", "--max-restarts", "1", "--grace", "0.5",
+             script],
+            capture_output=True, text=True, timeout=120, cwd=REPO)
+        self.assertEqual(r.returncode, 9, r.stdout + r.stderr)
+
+    def test_supervised_kill_at_step_resumes_with_loss_continuity(self):
+        # the tentpole end-to-end: a training loop checkpointing every
+        # step is killed at step 4 by its fault plan; the supervisor
+        # relaunches it; the relaunched incarnation maybe_restore()s
+        # and finishes the remaining steps — and the final loss matches
+        # an uninterrupted run of the same seeded loop exactly.
+        d = tempfile.mkdtemp()
+        script = self._script(f"""
+            import json, os, sys
+            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+            os.environ.pop("XLA_FLAGS", None)
+            sys.path.insert(0, {REPO!r})
+            import numpy as np
+            import paddle_tpu as fluid
+            from paddle_tpu.checkpoint import CheckpointManager
+            from paddle_tpu.distributed import faults
+
+            tag = os.environ["RUN_TAG"]
+            root = os.path.join({d!r}, "ckpt_" + tag)
+            scope = fluid.global_scope()
+            scope.var("w").set_value(np.zeros(4, np.float32))
+            m = CheckpointManager(root)
+            start = m.maybe_restore(scope=scope, vars=["w"]) or 0
+            rng = np.random.RandomState(123)
+            target = np.array([1., -2., .5, 3.], np.float32)
+            plan = faults.current()
+            losses = []
+            for step in range(start + 1, 9):
+                rng = np.random.RandomState(123 + step)  # per-step data
+                xb = rng.rand(8, 4).astype(np.float32)
+                w = np.asarray(scope.find_var("w").get_value())
+                err = xb @ (w - target)
+                losses.append(float(np.mean(err ** 2)))
+                w = w - 0.1 * (xb.T @ err) / len(xb)
+                scope.var("w").set_value(w.astype(np.float32))
+                m.save(step, scope=scope, vars=["w"], sync=True)
+                if plan is not None:
+                    plan.on_step(step)
+            m.close()
+            out = os.path.join({d!r}, "final_" + tag + ".json")
+            with open(out, "w") as f:
+                json.dump({{"loss": losses[-1],
+                           "w": np.asarray(
+                               scope.find_var("w").get_value()
+                               ).tolist()}}, f)
+        """)
+        env = dict(os.environ, RUN_TAG="clean")
+        env.pop("PT_FAULT_PLAN", None)
+        r = subprocess.run([sys.executable, script], env=env,
+                           capture_output=True, text=True, timeout=120,
+                           cwd=REPO)
+        self.assertEqual(r.returncode, 0, r.stdout + r.stderr)
+
+        env = dict(os.environ, RUN_TAG="faulted",
+                   PT_FAULT_PLAN="seed=7,kill_at_step=4")
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc", "1", "--max-restarts", "1", "--grace", "1.0",
+             script],
+            env=env, capture_output=True, text=True, timeout=240,
+            cwd=REPO)
+        self.assertEqual(r.returncode, 0, r.stdout + r.stderr)
+        self.assertIn("restart 1/1", r.stderr)
+
+        import json
+        with open(os.path.join(d, "final_clean.json")) as f:
+            clean = json.load(f)
+        with open(os.path.join(d, "final_faulted.json")) as f:
+            faulted = json.load(f)
+        # checkpoint-resumed state is bit-identical: same data stream,
+        # same updates, interrupted or not
+        np.testing.assert_allclose(faulted["w"], clean["w"],
+                                   rtol=0, atol=1e-6)
+        self.assertAlmostEqual(faulted["loss"], clean["loss"],
+                               places=5)
+
+
+# ---------------------------------------------------------------------------
+# chaos report (full 2-trainer PS acceptance run — slow)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestChaosReport(unittest.TestCase):
+    def test_faulted_ps_job_survives(self):
+        sys.path.insert(0, REPO)
+        from tools.chaos_report import run_job
+        rep = run_job(
+            steps=10,
+            fault_spec="seed=7,connect_refuse=0.1,kill_at_step=5",
+            max_restarts=1)
+        self.assertTrue(rep["completed"], rep)
+        self.assertTrue(rep["pserver_clean_exit"], rep)
+        self.assertEqual(rep["restarts"], 1, rep)
+        self.assertEqual(rep["trainer_exit_codes"][1][0],
+                         faults.KILL_EXIT_CODE)
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,0 +1,375 @@
+"""Chaos survival report: run a short async-PS training job under a
+seeded fault plan and report whether the runtime rode it out.
+
+The acceptance scenario from docs/RESILIENCE.md: a supervised
+2-trainer + 1-pserver job where trainer 1's fault plan kills it
+mid-run (``kill_at_step``) and refuses ~10% of its RPC connections
+must still complete — the supervisor relaunches the killed trainer
+(which resumes from its CheckpointManager snapshot), the retry layer
+absorbs the refused connections, the pserver's liveness registry keeps
+``serve()`` from hanging on the dead incarnation — and the final loss
+must land within tolerance of a fault-free run of the same job.
+
+Two modes:
+
+* orchestrator (default): run the job twice — clean, then faulted —
+  and print a JSON survival report:
+
+    {"clean": {...}, "faulted": {...}, "loss_delta": ..,
+     "survived": true}
+
+  `faulted` aggregates every worker's injected-fault counters and
+  retry/breaker statistics so a regression in ANY resilience layer
+  (injection not firing, retries not consumed, restart not happening)
+  is visible in the report, not just in the pass/fail bit.
+
+* worker (``--role pserver`` / ``--role trainer``): one process of the
+  job; spawned by the orchestrator, never run by hand.
+
+Usage:
+  python tools/chaos_report.py                      # full report
+  python tools/chaos_report.py --steps 20 \
+      --fault "seed=7,connect_refuse=0.1,kill_at_step=8"
+  PT_BENCH_CHAOS=1 python bench.py                  # bench tail line
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DEFAULT_STEPS = 24
+DEFAULT_FAULT = "seed=7,connect_refuse=0.1,kill_at_step=8"
+# |final_loss_faulted - final_loss_clean| bound for "survived": the job
+# is a 4-feature linear regression whose loss decays below 0.05 within
+# the step budget on BOTH runs, so an absolute tolerance is meaningful
+LOSS_TOL = 0.25
+JOB_TIMEOUT_S = 180.0
+
+
+# ---------------------------------------------------------------------------
+# worker mode
+# ---------------------------------------------------------------------------
+
+def _worker(role: str) -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.pop("XLA_FLAGS", None)
+    sys.path.insert(0, REPO)
+
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.core.flags import set_flags
+    from paddle_tpu.distributed import faults, resilience
+    from paddle_tpu.incubate.fleet.base.role_maker import (
+        Role, UserDefinedRoleMaker)
+    from paddle_tpu.incubate.fleet.parameter_server import (
+        DistributeTranspilerConfig, fleet)
+
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    n_trainers = int(os.environ["PADDLE_TRAINERS_NUM"])
+    server_ep = os.environ["PADDLE_PSERVER_EP"]
+    steps = int(os.environ.get("CHAOS_STEPS", str(DEFAULT_STEPS)))
+    ckpt_dir = os.environ.get("CHAOS_CKPT_DIR")
+
+    def dump_stats():
+        plan = faults.current()
+        print("CHAOS_STATS " + json.dumps({
+            "role": role, "rank": rank,
+            "faults": dict(plan.counts) if plan is not None else {},
+            "retry": resilience.retry_stats(),
+        }), flush=True)
+
+    fluid.framework.unique_name.reset()
+    role_obj = UserDefinedRoleMaker(
+        current_id=rank,
+        role=Role.SERVER if role == "pserver" else Role.WORKER,
+        worker_num=n_trainers, server_endpoints=[server_ep])
+    fleet.init(role_obj)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        pred = layers.fc(x, 1, param_attr=fluid.ParamAttr(name="w"),
+                         bias_attr=fluid.ParamAttr(name="b"))
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        opt = fluid.optimizer.SGDOptimizer(0.05)
+        cfg = DistributeTranspilerConfig()
+        cfg.sync_mode = False
+        cfg.fully_async = True
+        opt = fleet.distributed_optimizer(opt, cfg)
+        opt.minimize(loss)
+
+    if role == "pserver":
+        fleet.run_server()     # liveness registry keeps this from hanging
+        dump_stats()
+        print("SERVER_DONE", flush=True)
+        return
+
+    set_flags({"communicator_min_send_grad_num_before_recv": 2,
+               "communicator_max_merge_var_num": 2})
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fleet.startup_program or startup)
+    fleet.init_worker()
+
+    # elastic resume: attempt 0 starts fresh; a relaunched incarnation
+    # continues from the last committed snapshot of its OWN state
+    # (parameters re-sync from the pserver on the next pull anyway —
+    # the step counter is the part that must survive)
+    manager = None
+    start_step = 0
+    if ckpt_dir:
+        from paddle_tpu.checkpoint import CheckpointManager
+        manager = CheckpointManager(ckpt_dir)
+        restored = manager.maybe_restore(scope=fluid.global_scope(),
+                                         vars=["w", "b"])
+        if restored is not None:
+            start_step = int(restored)
+            print(f"CHAOS_RESUMED {start_step}", flush=True)
+
+    rng = np.random.RandomState(11 + rank)
+    w_true = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+    # replay the data stream up to the resume point so the faulted run
+    # sees the same batches the clean run saw
+    for _ in range(start_step):
+        rng.rand(16, 4)
+    losses = []
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for step in range(start_step + 1, steps + 1):
+            bx = rng.rand(16, 4).astype(np.float32)
+            by = bx @ w_true + 0.25
+            out = exe.run(fleet.main_program, feed={"x": bx, "y": by},
+                          fetch_list=[loss.name])
+            losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+            if manager is not None:
+                manager.save(step, scope=fluid.global_scope(),
+                             vars=["w", "b"])
+            time.sleep(0.05)
+    if manager is not None:
+        manager.close()
+    fleet.stop_worker()
+    final = float(np.mean(losses[-3:])) if losses else float("nan")
+    print("CHAOS_LOSS " + json.dumps(final), flush=True)
+    dump_stats()
+
+
+# ---------------------------------------------------------------------------
+# orchestrator
+# ---------------------------------------------------------------------------
+
+def _free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(role, rank, n_trainers, ep, steps, extra_env):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("PT_FAULT_PLAN", None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(n_trainers),
+        "PADDLE_PSERVER_EP": ep,
+        "CHAOS_STEPS": str(steps),
+    })
+    env.update(extra_env)
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--role", role],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True)
+
+
+def _parse_worker(out: str, agg: dict) -> None:
+    for line in out.splitlines():
+        if line.startswith("CHAOS_STATS "):
+            st = json.loads(line[len("CHAOS_STATS "):])
+            for k, v in st["faults"].items():
+                agg["faults"][k] = agg["faults"].get(k, 0) + int(v)
+            for k, v in st["retry"].items():
+                agg["retry"][k] = agg["retry"].get(k, 0) + int(v)
+        elif line.startswith("CHAOS_LOSS "):
+            agg["losses"].append(
+                float(json.loads(line[len("CHAOS_LOSS "):])))
+        elif line.startswith("CHAOS_RESUMED "):
+            agg["resumed_at"] = int(line.split()[1])
+
+
+def run_job(steps=DEFAULT_STEPS, fault_spec=None, max_restarts=1,
+            timeout_s=JOB_TIMEOUT_S) -> dict:
+    """One 1-pserver + 2-trainer job; ``fault_spec`` (if any) is the
+    PT_FAULT_PLAN for trainer 1 only. Returns the per-run report."""
+    ep = f"127.0.0.1:{_free_port()}"
+    agg = {"faults": {}, "retry": {}, "losses": [], "resumed_at": None}
+    t0 = time.monotonic()
+    with tempfile.TemporaryDirectory(prefix="chaos_ckpt_") as ckpt:
+        # liveness on: heartbeats (default interval) + a short eviction
+        # timeout so a dead trainer can never hang serve()
+        server = _spawn("pserver", 0, 2, ep, steps,
+                        {"FLAGS_trainer_timeout_s": "8"})
+        trainers = {}
+        attempts = {0: 0, 1: 0}
+        outs = {0: [], 1: []}
+
+        def spawn_trainer(rank):
+            extra = {"PADDLE_RESTART_ATTEMPT": str(attempts[rank]),
+                     "CHAOS_CKPT_DIR": os.path.join(ckpt, str(rank))}
+            if fault_spec and rank == 1:
+                extra["PT_FAULT_PLAN"] = fault_spec
+            trainers[rank] = _spawn("trainer", rank, 2, ep, steps,
+                                    extra)
+
+        for r in (0, 1):
+            spawn_trainer(r)
+
+        restarts = 0
+        hung = False
+        deadline = t0 + timeout_s
+        live = dict(trainers)
+        while live or server.poll() is None:
+            if time.monotonic() > deadline:
+                hung = True
+                break
+            for rank, p in list(live.items()):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                out, err = p.communicate()
+                outs[rank].append((rc, out, err))
+                del live[rank]
+                if rc != 0 and attempts[rank] < max_restarts:
+                    # supervised relaunch: next incarnation resumes
+                    # from its checkpoint; PADDLE_RESTART_ATTEMPT
+                    # disarms one-shot kill_at_step plans
+                    attempts[rank] += 1
+                    restarts += 1
+                    spawn_trainer(rank)
+                    live[rank] = trainers[rank]
+            if not live and server.poll() is None:
+                # trainers done: the server exits via fanin (or
+                # eviction, if an incarnation died unrecovered)
+                try:
+                    server.wait(timeout=max(
+                        0.1, deadline - time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    hung = True
+                break
+            time.sleep(0.1)
+
+        for p in list(live.values()) + [server]:
+            if p.poll() is None:
+                p.kill()
+        server_out, server_err = server.communicate()
+        elapsed = time.monotonic() - t0
+
+    trainer_codes = {r: [rc for rc, _, _ in outs[r]] for r in outs}
+    for r in outs:
+        for _, out, _ in outs[r]:
+            _parse_worker(out, agg)
+    _parse_worker(server_out, agg)
+    # a kill_at_step victim dies via os._exit and never reports its own
+    # counters — infer the injection from the exit code
+    # (faults.KILL_EXIT_CODE == 43)
+    kills = sum(1 for codes in trainer_codes.values()
+                for rc in codes if rc == 43)
+    if kills:
+        agg["faults"]["kill"] = agg["faults"].get("kill", 0) + kills
+    # final loss is taken from trainer 0 (never fault-injected) so the
+    # clean-vs-faulted comparison measures the CLUSTER's recovery, not
+    # the noise of the killed process
+    loss0 = None
+    for _, out, _ in outs[0]:
+        for line in out.splitlines():
+            if line.startswith("CHAOS_LOSS "):
+                loss0 = float(json.loads(line[len("CHAOS_LOSS "):]))
+    completed = (not hung and server.returncode == 0 and
+                 all(codes and codes[-1] == 0
+                     for codes in trainer_codes.values()))
+    rep = {
+        "final_loss": loss0,
+        "restarts": restarts,
+        "trainer_exit_codes": trainer_codes,
+        "pserver_clean_exit": (not hung and server.returncode == 0),
+        "resumed_at_step": agg["resumed_at"],
+        "faults_injected": agg["faults"],
+        "retries_consumed": agg["retry"].get("retries", 0),
+        "breaker_fast_fails": agg["retry"].get("breaker_fast_fails", 0),
+        "completed": completed,
+        "elapsed_s": round(elapsed, 2),
+    }
+    if not completed:
+        rep["stderr_tail"] = {
+            "pserver": server_err[-800:],
+            **{f"trainer{r}": outs[r][-1][2][-800:]
+               for r in outs if outs[r]},
+        }
+    return rep
+
+
+def chaos_report(steps=DEFAULT_STEPS, fault_spec=DEFAULT_FAULT,
+                 max_restarts=1) -> dict:
+    clean = run_job(steps=steps, fault_spec=None, max_restarts=0)
+    faulted = run_job(steps=steps, fault_spec=fault_spec,
+                      max_restarts=max_restarts)
+    delta = None
+    if clean["final_loss"] is not None and \
+            faulted["final_loss"] is not None:
+        delta = abs(clean["final_loss"] - faulted["final_loss"])
+    return {
+        "fault_plan": fault_spec,
+        "clean": clean,
+        "faulted": faulted,
+        "loss_delta": delta,
+        "loss_tolerance": LOSS_TOL,
+        "survived": bool(
+            clean["completed"] and faulted["completed"] and
+            delta is not None and delta <= LOSS_TOL),
+    }
+
+
+def chaos_report_line(steps=DEFAULT_STEPS, fault_spec=DEFAULT_FAULT,
+                      max_restarts=1):
+    """(dict, '# chaos: ...' stderr line) for bench.py's report tail."""
+    rep = chaos_report(steps=steps, fault_spec=fault_spec,
+                       max_restarts=max_restarts)
+    f = rep["faulted"]
+    line = (f"# chaos: survived={rep['survived']} "
+            f"restarts={f['restarts']} "
+            f"faults={sum(f['faults_injected'].values())} "
+            f"retries={f['retries_consumed']} "
+            f"loss_delta={rep['loss_delta']}")
+    return rep, line
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--role", choices=["pserver", "trainer"],
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--steps", type=int, default=DEFAULT_STEPS)
+    ap.add_argument("--fault", default=DEFAULT_FAULT,
+                    help="PT_FAULT_PLAN spec for trainer 1")
+    ap.add_argument("--max-restarts", type=int, default=1)
+    args = ap.parse_args(argv)
+    if args.role:
+        _worker(args.role)
+        return
+    rep = chaos_report(steps=args.steps, fault_spec=args.fault,
+                       max_restarts=args.max_restarts)
+    print(json.dumps(rep, indent=2))
+    sys.exit(0 if rep["survived"] else 1)
+
+
+if __name__ == "__main__":
+    main()
