@@ -654,6 +654,19 @@ def main():
             chaos, chaos_line = chaos_report_line()
         except Exception:
             pass   # survival accounting only; never fail the bench
+    metrics_tail = None
+    try:
+        # fleet-view tail: everything the run's registry accumulated
+        # (step-phase histograms, ckpt timings, rpc/heartbeat counters)
+        # so one BENCH json line carries the full telemetry snapshot
+        # for tools/metrics_report.py to diff across runs
+        from paddle_tpu.observability.export import metrics_snapshot
+        snap = metrics_snapshot()
+        metrics_tail = {name: fam for name, fam in snap.items()
+                        if any(s.get("count") or s.get("value")
+                               for s in fam.get("samples", []))}
+    except Exception:
+        pass   # accounting only; never fail the bench on it
     print(json.dumps({
         "metric": "transformer_base_train_tokens_per_sec",
         "value": round(tokens_per_sec, 1),
@@ -661,6 +674,7 @@ def main():
         "vs_baseline": round(tokens_per_sec / V100_TOKENS_PER_SEC, 3),
         "comm_overlap": comm or None,
         "chaos": chaos or None,
+        "metrics": metrics_tail or None,
     }))
     if comm_line:
         print(comm_line, file=sys.stderr)

@@ -22,6 +22,7 @@ import os
 import queue
 import signal
 import threading
+import time
 import warnings
 from typing import Dict, List, Optional, Sequence
 
@@ -29,6 +30,7 @@ from . import manifest as mf
 from . import writer as wr
 from .manifest import CheckpointCorrupt
 from .snapshot import Snapshot, persistable_names, snapshot_scope
+from ..observability import metrics as _obs
 
 __all__ = ["CheckpointManager", "SaveHandle", "CheckpointCorrupt"]
 
@@ -137,6 +139,7 @@ class CheckpointManager:
     def _execute(self, snapshot: Snapshot, handle: SaveHandle) -> None:
         committed = None
         error: Optional[BaseException] = None
+        t0 = time.perf_counter()
         try:
             tmp_dir = os.path.join(self.root,
                                    mf.tmp_dir_name(handle.step))
@@ -154,6 +157,9 @@ class CheckpointManager:
             error = exc
         finally:
             self._count("ckpt_inflight", -1)
+            if _obs.telemetry_active():
+                _obs.histogram("pt_ckpt_save_seconds").observe(
+                    time.perf_counter() - t0)
             handle._finish(error, committed)
 
     def _worker_loop(self) -> None:
@@ -230,6 +236,7 @@ class CheckpointManager:
         complete step when the pointer is stale/dangling — the
         crash-mid-save recovery path. Checksums are verified before any
         value reaches the scope. Returns the restored step."""
+        t0 = time.perf_counter()
         if scope is None:
             from ..core.scope import global_scope
             scope = global_scope()
@@ -270,6 +277,9 @@ class CheckpointManager:
             if not include_rng and name == RNG_STATE_VAR:
                 continue
             _restore(scope, name, arr, lod, place)
+        if _obs.telemetry_active():
+            _obs.histogram("pt_ckpt_restore_seconds").observe(
+                time.perf_counter() - t0)
         return int(step)
 
     def maybe_restore(self, scope=None, program=None,
@@ -305,6 +315,13 @@ class CheckpointManager:
             self._prev_sigterm = None
 
     def _on_sigterm(self, signum, frame) -> None:
+        try:
+            # flight postmortem first: the preemption save below can
+            # itself fail, and the last-N-step record must survive
+            from ..observability import recorder as _rec
+            _rec.dump("sigterm")
+        except Exception:
+            pass
         try:
             step = (self._preempt_step_fn()
                     if self._preempt_step_fn is not None

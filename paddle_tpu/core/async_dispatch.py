@@ -23,6 +23,20 @@ from .enforce import EnforceNotMet
 __all__ = ["FetchHandle", "PendingStep"]
 
 
+def _flight_dump(reason: str, exc: BaseException, fingerprint) -> None:
+    """A sticky async error poisons every later materialization — write
+    the flight postmortem the moment it is first recorded, while the
+    ring still holds the steps that led here. Best-effort by contract
+    (docs/OBSERVABILITY.md)."""
+    try:
+        from ..observability import recorder
+        recorder.dump(reason, extra={
+            "error": f"{type(exc).__name__}: {exc}",
+            "program": repr(fingerprint)})
+    except Exception:
+        pass
+
+
 class PendingStep:
     """One dispatched-but-unchecked step: holds the device-resident
     all-finite flags (check_nan_inf) until a materialization point.
@@ -60,6 +74,8 @@ class PendingStep:
                 f"surfaced at materialization (FLAGS_async_dispatch): "
                 f"{exc}")
             self._exc.__cause__ = exc
+            _flight_dump("sticky_async_error", self._exc,
+                         self._fingerprint)
             raise self._exc
         if not host.all():
             bad = int(np.argmin(host))
@@ -69,6 +85,8 @@ class PendingStep:
                 f"Inf (FLAGS_check_nan_inf, deferred by "
                 f"FLAGS_async_dispatch; reference operator.cc:953-983)",
                 op_type=op_type)
+            _flight_dump("sticky_async_error", self._exc,
+                         self._fingerprint)
             raise self._exc
 
 
@@ -123,6 +141,7 @@ class FetchHandle:
                 f"{self._name!r} of program {self._fingerprint} "
                 f"(FLAGS_async_dispatch): {exc}")
             err.__cause__ = exc
+            _flight_dump("sticky_async_error", err, self._fingerprint)
             raise err
 
     def block_until_ready(self) -> "FetchHandle":

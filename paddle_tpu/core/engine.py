@@ -28,6 +28,8 @@ from .flags import FLAGS
 from .registry import OPS, ExecContext, _RngCtx
 from .scope import LoDTensor, Scope
 from .types import dtype_to_np
+from ..observability import metrics as _obs
+from ..observability import recorder as _obs_recorder
 
 RNG_STATE_VAR = "@RNG_STATE@"
 
@@ -826,7 +828,7 @@ class _FastPathEntry:
 
     __slots__ = ("scope", "place", "dev", "feed_names", "shapes",
                  "dtypes", "lods", "traced", "donated_vars",
-                 "const_vars", "updated_vars")
+                 "const_vars", "updated_vars", "sig_hash")
 
     def __init__(self, scope, place, dev, arrays, lods, traced):
         self.scope = scope
@@ -843,6 +845,8 @@ class _FastPathEntry:
         # filled lazily by the writeback (eager fallbacks only discover
         # their updated set while running)
         self.updated_vars: Dict[str, Any] = {}
+        # short feed-sig identifier for flight-recorder step records
+        self.sig_hash: Optional[str] = None
 
 
 # deferred-check records kept in flight before the oldest is forced to
@@ -884,13 +888,17 @@ class Engine:
         # two per-step gauges — fused gradient collectives issued per
         # step and the fraction that can overlap remaining backward
         # (docs/COLLECTIVES.md)
-        self.counters: Dict[str, int] = {
+        # EngineCounters: still a plain dict to every reader, plus
+        # snapshot()/reset() and scrape-time export through the
+        # observability registry (docs/OBSERVABILITY.md)
+        self.counters: Dict[str, int] = _obs.EngineCounters({
             "runs": 0, "fast_path_hits": 0, "traces": 0,
             "sig_builds": 0, "device_puts": 0,
             "ckpt_saves": 0, "ckpt_inflight": 0,
             "collective_bytes": 0, "collective_buckets": 0,
             "collective_quantized": 0, "grad_collectives_per_step": 0,
-            "comm_overlap_frac": 0.0}
+            "comm_overlap_frac": 0.0})
+        _obs.register_engine(self)
         # feed names that are identical on every process under multihost
         # SPMD (shared tables, per-step constants) — globalized by
         # replication instead of batch-dim concatenation
@@ -1190,6 +1198,13 @@ class Engine:
             # injected preemption: kill this process at step N (the
             # supervised-restart path CI exercises without hardware)
             plan.on_step(self.counters["runs"])
+        # ONE boolean gates all per-step telemetry (phase spans, flight
+        # recorder); obs stays None on the cold path
+        obs = None
+        if _obs._HOT[0]:
+            obs = {"step": self.counters["runs"], "t_host": time.time(),
+                   "_t0": time.perf_counter(), "phases": {},
+                   "fast_path": False, "traced": False}
         iterations = int(iterations or 1)
         fast_key = None
         if use_program_cache:
@@ -1207,6 +1222,11 @@ class Engine:
                     arrays = self._fast_feed_arrays(entry, feed)
                     if arrays is not None:
                         self.counters["fast_path_hits"] += 1
+                        if obs is not None:
+                            obs["fast_path"] = True
+                            obs["sig"] = entry.sig_hash
+                            obs["phases"]["feed_ms"] = (
+                                time.perf_counter() - obs["_t0"]) * 1e3
                         donated = {n: _var_array(v)
                                    for n, v in entry.donated_vars}
                         const = {n: _var_array(v)
@@ -1214,7 +1234,7 @@ class Engine:
                         return self._dispatch(
                             program, scope, entry.traced, arrays,
                             donated, const, return_numpy,
-                            updated_vars=entry.updated_vars)
+                            updated_vars=entry.updated_vars, obs=obs)
         arrays, lods, feed_sig_key = self._normalize_feed(
             feed, None if self.mesh is not None else place)
         multihost = self._is_multihost()
@@ -1231,6 +1251,10 @@ class Engine:
                         for n, lod in lods.items()}
             feed_sig_key = self._global_sig_key(arrays, lods)
             arrays = self._globalize(arrays)
+        if obs is not None:
+            obs["sig"] = f"{hash(feed_sig_key) & 0xffffffff:08x}"
+            obs["phases"]["feed_ms"] = (time.perf_counter()
+                                        - obs["_t0"]) * 1e3
         if iterations > 1 and lods:
             raise NotImplementedError(
                 "num_iteration_per_run > 1 cannot scan over LoD "
@@ -1240,6 +1264,7 @@ class Engine:
         traced = self._cache.get(key) if use_program_cache else None
         if traced is None:
             self.counters["traces"] += 1
+            _tt0 = time.perf_counter() if obs is not None else 0.0
             feed_sig = {n: jax.ShapeDtypeStruct(a.shape, a.dtype)
                         for n, a in arrays.items()}
             traced = trace_step(program, block_idx, feed_sig, lods,
@@ -1249,6 +1274,10 @@ class Engine:
                                 iterations=iterations)
             if use_program_cache:
                 self._cache[key] = traced
+            if obs is not None:
+                obs["traced"] = True
+                obs["phases"]["trace_ms"] = (time.perf_counter()
+                                             - _tt0) * 1e3
 
         donated_params = {}
         const_params = {}
@@ -1279,18 +1308,21 @@ class Engine:
             # feed-sig, fetch) tuple skip signature reconstruction,
             # persistable re-walks, and no-op device_puts
             entries = self._fast.setdefault(fast_key, [])
-            entries.append(_FastPathEntry(
+            entry = _FastPathEntry(
                 scope, place, place.jax_device()
                 if place is not None and self.mesh is None else None,
-                arrays, lods, traced))
+                arrays, lods, traced)
+            entry.sig_hash = f"{hash(feed_sig_key) & 0xffffffff:08x}"
+            entries.append(entry)
             if len(entries) > _MAX_FAST_ENTRIES:
                 entries.pop(0)
         return self._dispatch(program, scope, traced, arrays,
                               donated_params, const_params,
-                              return_numpy)
+                              return_numpy, obs=obs)
 
     def _dispatch(self, program, scope, traced, arrays, donated_params,
-                  const_params, return_numpy, updated_vars=None):
+                  const_params, return_numpy, updated_vars=None,
+                  obs=None):
         """Watchdog wrapper over :meth:`_dispatch_inner`: with
         FLAGS_step_timeout_s > 0 the step runs armed, and a hang is
         converted into the watchdog's diagnosable EnforceNotMet (the
@@ -1300,13 +1332,13 @@ class Engine:
         if wd is None:
             return self._dispatch_inner(
                 program, scope, traced, arrays, donated_params,
-                const_params, return_numpy, updated_vars)
+                const_params, return_numpy, updated_vars, obs)
         try:
             try:
                 wd.arm()
                 return self._dispatch_inner(
                     program, scope, traced, arrays, donated_params,
-                    const_params, return_numpy, updated_vars)
+                    const_params, return_numpy, updated_vars, obs)
             finally:
                 wd.disarm()
         except KeyboardInterrupt:
@@ -1314,9 +1346,17 @@ class Engine:
                 raise wd.error from None
             raise
 
+    def _obs_finish(self, obs):
+        """Close out one step's flight/telemetry record: total span,
+        then hand it to the recorder (histogram observes + ring
+        append)."""
+        obs["phases"]["total_ms"] = (time.perf_counter()
+                                     - obs.pop("_t0")) * 1e3
+        _obs_recorder.record_step(obs)
+
     def _dispatch_inner(self, program, scope, traced, arrays,
                         donated_params, const_params, return_numpy,
-                        updated_vars=None):
+                        updated_vars=None, obs=None):
         """Shared dispatch tail of fast and slow paths: RNG split,
         executable call, device-resident scope writeback, NaN-check
         surfacing (inline or deferred), fetch wrapping. Under
@@ -1326,6 +1366,7 @@ class Engine:
         rng_key = _get_rng_state(scope, program)
         step_key, next_state = jax.random.split(rng_key)
         t0 = time.perf_counter() if FLAGS.benchmark else None
+        _d0 = time.perf_counter() if obs is not None else None
         from .. import profiler as _profiler
         if _profiler.profiling_active():
             with _profiler.RecordEvent(
@@ -1335,6 +1376,11 @@ class Engine:
         else:
             fetches, updated, nan_flags = traced.fn(
                 donated_params, const_params, arrays, step_key)
+        if obs is not None:
+            # async dispatch: this is the enqueue span; device time
+            # lands in fetch_ms (sync) or the materialization point
+            obs["phases"]["dispatch_ms"] = (time.perf_counter()
+                                            - _d0) * 1e3
         _set_rng_state(scope, next_state)
         comm_stats = getattr(traced, "comm_stats", None)
         if comm_stats:
@@ -1344,6 +1390,9 @@ class Engine:
             c["collective_quantized"] += comm_stats["quantized"]
             c["grad_collectives_per_step"] = comm_stats["buckets"]
             c["comm_overlap_frac"] = comm_stats["overlap_frac"]
+            if obs is not None:
+                obs["comm_plan"] = comm_stats.get(
+                    "plan_id", comm_stats["buckets"])
         for n, v in updated.items():
             var = updated_vars.get(n) if updated_vars is not None \
                 else None
@@ -1387,7 +1436,12 @@ class Engine:
             for n, v in zip(traced.fetch_names, fetches):
                 out.append(FetchHandle(v, traced.fetch_lods.get(n), rec,
                                        n, program.fingerprint))
+            if obs is not None:
+                obs["pending_fetches"] = len(self._pending)
+                obs["phases"]["fetch_ms"] = 0.0  # deferred to handles
+                self._obs_finish(obs)
             return out
+        _f0 = time.perf_counter() if obs is not None else None
         for n, v in zip(traced.fetch_names, fetches):
             lod = traced.fetch_lods.get(n)
             if return_numpy and not lod:
@@ -1395,6 +1449,11 @@ class Engine:
             else:
                 t = LoDTensor(v, lod or [])
                 out.append(t)
+        if obs is not None:
+            obs["pending_fetches"] = len(self._pending)
+            obs["phases"]["fetch_ms"] = (time.perf_counter()
+                                         - _f0) * 1e3
+            self._obs_finish(obs)
         return out
 
     def synchronize(self):

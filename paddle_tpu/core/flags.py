@@ -169,6 +169,17 @@ _define("step_timeout_s", 0.0, True,
         "engine step watchdog: a step exceeding this raises a "
         "diagnosable EnforceNotMet with pending-op context from the "
         "async-dispatch layer; <= 0 (default) disables the watchdog")
+# observability subsystem (paddle_tpu/observability, docs/OBSERVABILITY.md)
+_define("telemetry", False, True,
+        "per-step metric observation (paddle_tpu/observability): phase "
+        "latency histograms, flight-recorder appends, registry "
+        "collectors. Off (default) the step loop pays one boolean "
+        "check; the flight recorder still arms itself under a fault "
+        "plan or step watchdog so postmortems exist without telemetry")
+_define("flight_recorder_steps", 64, True,
+        "flight-recorder ring capacity: per-step span records retained "
+        "for the postmortem dump (watchdog trip, PT_FAULT_PLAN, sticky "
+        "async error, SIGTERM); sized at first use")
 
 # -- subsumed flags: accepted, validated, no effect under XLA/PJRT ----------
 for _name, _default, _help in [
@@ -219,6 +230,14 @@ def set_flags(flags: Dict[str, Any]):
                     f"unknown flag {raw!r}; known flags: "
                     f"{sorted(_REGISTRY)}")
             _VALUES[name] = _coerce(flag, value)
+            if name == "telemetry":
+                # route into the observability gate so a runtime
+                # set_flags toggle takes effect mid-training
+                try:
+                    from ..observability import metrics as _obs_metrics
+                    _obs_metrics.enable_telemetry(_VALUES[name])
+                except ImportError:
+                    pass
 
 
 def get_flags(names) -> Dict[str, Any]:

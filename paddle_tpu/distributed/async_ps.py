@@ -192,8 +192,13 @@ def _rpc(endpoint: str, msg, timeout: Optional[float] = None,
             if breaker is not None:
                 breaker.record_failure()
             delay = next(delays, None)
-            if delay is None or \
-                    not policy.sleep_budgeted(delay, start):
+            if delay is None:
+                # distinct accounting: out of retries vs. out of time
+                # (pt_rpc_*_total families, docs/OBSERVABILITY.md)
+                consume_retry("retries_exhausted")
+                raise last
+            if not policy.sleep_budgeted(delay, start):
+                consume_retry("deadline_exhausted")
                 raise last
             consume_retry()
 
@@ -410,6 +415,16 @@ class AsyncParameterServer:
                     _send_msg(conn, "ok")
                     if done:
                         self._done.set()
+                elif t == "metrics":
+                    # Prometheus-style exposition over the existing
+                    # hardened framing (docs/OBSERVABILITY.md) — the
+                    # launch supervisor scrapes pservers and trainers
+                    # with the same message
+                    from ..observability.export import render_exposition
+                    _send_msg(conn, render_exposition())
+                elif t == "metrics_json":
+                    from ..observability.export import metrics_snapshot
+                    _send_msg(conn, metrics_snapshot())
                 else:
                     _send_msg(conn, {"err": f"unknown message {t!r}"})
         except (ConnectionError, OSError):

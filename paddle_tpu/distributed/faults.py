@@ -173,16 +173,35 @@ class FaultPlan:
         to run atexit hooks or flush queues."""
         if self.kill_armed() and step >= self.kill_at_step:
             self._count("kill")
+            # flight postmortem inline — os._exit skips atexit, so this
+            # is the ONLY chance to persist the last-N-step record
+            # (docs/OBSERVABILITY.md); installing a plan armed the
+            # recorder, so the ring has content
+            try:
+                from ..observability import recorder as _rec
+                _rec.dump("injected_fault", extra={
+                    "fault": f"kill_at_step={self.kill_at_step}",
+                    "killed_at": int(step)})
+            except Exception:
+                pass
             os._exit(KILL_EXIT_CODE)
 
 
 # -- process-local active plan ----------------------------------------------
 
 def install(plan: Optional[FaultPlan]) -> Optional[FaultPlan]:
-    """Make ``plan`` the process's active plan; returns the previous."""
+    """Make ``plan`` the process's active plan; returns the previous.
+    Installing a real plan arms the step flight recorder so the
+    injected failure's dump has the last-N steps; uninstalling (plan
+    None) disarms it."""
     global _active
     with _lock:
         prev, _active = _active, plan
+    try:
+        from ..observability import recorder as _rec
+        _rec.set_fault_active(plan is not None)
+    except Exception:
+        pass
     return prev
 
 

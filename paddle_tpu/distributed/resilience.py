@@ -287,6 +287,13 @@ class TrainerRegistry:
                 if now - seen > self.timeout_s:
                     self.evicted.add(tid)
                     newly.append(tid)
+        if newly:
+            try:
+                from ..observability import metrics as _obs
+                _obs.counter("pt_trainers_evicted_total").inc(
+                    len(newly))
+            except Exception:
+                pass
         return newly
 
 
@@ -320,13 +327,18 @@ class Heartbeat:
         return self
 
     def _loop(self) -> None:
+        from ..observability import metrics as _obs
+        c_sent = _obs.counter("pt_heartbeats_sent_total")
+        c_failed = _obs.counter("pt_heartbeats_failed_total")
         while not self._stop.is_set():
             for ep in self.endpoints:
                 try:
                     self._send(ep, self.trainer_id)
                     self.sent += 1
+                    c_sent.inc()
                 except OSError:
                     self.failed += 1
+                    c_failed.inc()
             self._stop.wait(self.interval_s)
 
     def stop(self) -> None:
@@ -367,6 +379,14 @@ class StepWatchdog:
         self.fired = False
         self.error: Optional[EnforceNotMet] = None
         self._thread: Optional[threading.Thread] = None
+        # a configured watchdog arms the flight recorder for the life
+        # of the process: a trip must always have a postmortem
+        # (docs/OBSERVABILITY.md)
+        try:
+            from ..observability import recorder as _rec
+            _rec.set_watchdog_active(True)
+        except Exception:
+            pass
 
     def arm(self) -> None:
         with self._cv:
@@ -431,5 +451,13 @@ class StepWatchdog:
                     # unrelated code
                     import _thread
                     _thread.interrupt_main()
+            # outside the lock: postmortem file IO must not extend the
+            # fire/disarm critical section (only the fire path reaches
+            # here — every other branch continues inside the lock)
+            try:
+                from ..observability import recorder as _rec
+                _rec.dump("watchdog", extra={"error": str(self.error)})
+            except Exception:
+                pass
             if cb is not None:
                 cb()

@@ -1,0 +1,40 @@
+"""paddle_tpu.observability — unified telemetry subsystem.
+
+Three layers (docs/OBSERVABILITY.md):
+
+* :mod:`.metrics` — low-overhead registry (counters, gauges,
+  exponential-bucket histograms, scrape-time collectors) that
+  supersedes the ad-hoc per-PR stat dicts;
+* :mod:`.recorder` — step flight recorder: fixed ring of per-step span
+  records, dumped automatically on watchdog trip / injected fault /
+  sticky async error / SIGTERM;
+* :mod:`.export` — Prometheus-style exposition over the hardened RPC
+  framing, JSONL dumps, chrome-trace merge.
+
+Hot-path contract: one boolean (``metrics._HOT[0]``, folded into
+``profiler.profiling_active()``) gates all per-step work.
+"""
+from . import metrics, recorder, export  # noqa: F401
+from .metrics import (  # noqa: F401
+    Counter, Gauge, Histogram, MetricsRegistry, EngineCounters,
+    default_registry, counter, gauge, histogram,
+    enable_telemetry, telemetry_active, register_engine)
+from .recorder import (  # noqa: F401
+    FlightRecorder, flight_recorder, record_step, dump,
+    recording_active, find_dumps, read_dump, summarize_dumps)
+from .export import (  # noqa: F401
+    render_exposition, metrics_snapshot, dump_metrics, MetricsServer,
+    scrape, maybe_start_from_env, flight_to_chrome_trace)
+
+__all__ = [
+    "metrics", "recorder", "export",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "EngineCounters", "default_registry", "counter", "gauge",
+    "histogram", "enable_telemetry", "telemetry_active",
+    "register_engine",
+    "FlightRecorder", "flight_recorder", "record_step", "dump",
+    "recording_active", "find_dumps", "read_dump", "summarize_dumps",
+    "render_exposition", "metrics_snapshot", "dump_metrics",
+    "MetricsServer", "scrape", "maybe_start_from_env",
+    "flight_to_chrome_trace",
+]

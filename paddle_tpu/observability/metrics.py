@@ -1,0 +1,406 @@
+"""Low-overhead metrics registry: counters, gauges, histograms.
+
+The reference Fluid's observability is its platform/profiler +
+DeviceTracer; beyond traces it has no *metrics* surface — every PR of
+this rebuild grew a one-off reporting dict instead (``Engine.counters``,
+``retry_stats()``, ``FaultPlan.counts``). This module is the single
+registry those feed into, with a Prometheus-style data model:
+
+* :class:`Counter` — monotonically increasing total;
+* :class:`Gauge` — point-in-time value (optionally labeled);
+* :class:`Histogram` — exponential-bucket latency distribution
+  (``_bucket{le=...}`` / ``_sum`` / ``_count`` exposition);
+* *collectors* — callables sampled at scrape time, so existing stat
+  dicts (``Engine.counters``, ``resilience.retry_stats()``, circuit
+  breaker states) are exported with ZERO hot-path cost: nothing is
+  mirrored per increment, the registry reads them when asked.
+
+Hot-path contract (docs/OBSERVABILITY.md): the engine step loop checks
+exactly one boolean — ``_HOT[0]`` — before doing ANY telemetry work
+(phase timing, histogram observes, flight-recorder appends). ``_HOT``
+is true while telemetry is enabled (``FLAGS_telemetry`` /
+:func:`enable_telemetry`) or while the flight recorder is armed (fault
+plan installed, step watchdog configured). With everything off, a step
+pays one list index read.
+"""
+from __future__ import annotations
+
+import bisect
+import math
+import os
+import threading
+import weakref
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+__all__ = ["Counter", "Gauge", "Histogram", "Family", "MetricsRegistry",
+           "default_registry", "telemetry_active", "enable_telemetry",
+           "register_engine", "EngineCounters", "counter", "gauge",
+           "histogram"]
+
+# THE hot-path gate (see module docstring). Mutated only through
+# _recompute_hot(); read directly (``_HOT[0]``) by the engine.
+_HOT = [False]
+_TELEMETRY = [False]
+
+
+def telemetry_active() -> bool:
+    """True while metric observation is on (histogram observes, step
+    phase attribution). Cheap: one list read."""
+    return _TELEMETRY[0]
+
+
+def _recompute_hot() -> None:
+    rec = False
+    try:
+        from . import recorder
+        rec = recorder.recording_active()
+    except Exception:
+        pass
+    _HOT[0] = _TELEMETRY[0] or rec
+
+
+def enable_telemetry(on: bool = True) -> None:
+    """Turn per-step metric observation on/off. ``FLAGS_telemetry``
+    (env or ``set_flags``) routes here."""
+    _TELEMETRY[0] = bool(on)
+    _recompute_hot()
+
+
+# ---------------------------------------------------------------------------
+# data model
+# ---------------------------------------------------------------------------
+
+class Family:
+    """One exposition family: every sample shares name/type/help."""
+
+    __slots__ = ("name", "type", "help", "samples")
+
+    def __init__(self, name: str, mtype: str, help: str,
+                 samples: Optional[List[Tuple[Dict[str, str], float]]]
+                 = None):
+        self.name = name
+        self.type = mtype          # "counter" | "gauge" | "histogram"
+        self.help = help
+        # histogram families carry (labels, HistogramState) samples
+        self.samples = samples if samples is not None else []
+
+
+class Counter:
+    """Monotonic total. ``inc()`` is a plain float add under the GIL —
+    no lock; exact enough for telemetry (the same tradeoff
+    Engine.counters already makes)."""
+
+    __slots__ = ("name", "help", "value")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self.value = 0.0
+
+    def inc(self, v: float = 1.0) -> None:
+        self.value += v
+
+    def collect(self) -> Family:
+        return Family(self.name, "counter", self.help,
+                      [({}, self.value)])
+
+
+class Gauge:
+    """Point-in-time value, optionally labeled (one series per label
+    tuple)."""
+
+    __slots__ = ("name", "help", "_series")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._series: Dict[Tuple[Tuple[str, str], ...], float] = {}
+
+    def set(self, v: float, **labels) -> None:
+        self._series[tuple(sorted(labels.items()))] = float(v)
+
+    def inc(self, v: float = 1.0, **labels) -> None:
+        k = tuple(sorted(labels.items()))
+        self._series[k] = self._series.get(k, 0.0) + v
+
+    def get(self, **labels) -> float:
+        return self._series.get(tuple(sorted(labels.items())), 0.0)
+
+    def collect(self) -> Family:
+        return Family(self.name, "gauge", self.help,
+                      [(dict(k), v) for k, v in self._series.items()])
+
+
+def exponential_buckets(start: float, factor: float,
+                        count: int) -> List[float]:
+    """``count`` upper bounds: start, start*factor, ... (no +Inf — the
+    histogram adds the overflow bucket itself)."""
+    if start <= 0 or factor <= 1 or count < 1:
+        raise ValueError("need start > 0, factor > 1, count >= 1")
+    return [start * factor ** i for i in range(count)]
+
+
+# default latency buckets: 0.5ms .. ~16s, factor 2 — wide enough for a
+# CPU-backed test step and a real TPU step on one scale
+DEFAULT_BUCKETS = exponential_buckets(0.0005, 2.0, 16)
+
+
+class Histogram:
+    """Cumulative-bucket histogram over exponential bounds.
+
+    ``observe(v)`` does one ``bisect`` + two adds — cheap enough to sit
+    behind the telemetry gate on the step hot path. Bucket counts are
+    stored per-bucket (non-cumulative) and accumulated at collect time.
+    """
+
+    __slots__ = ("name", "help", "bounds", "counts", "sum", "count")
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: Optional[Iterable[float]] = None):
+        self.name = name
+        self.help = help
+        self.bounds = sorted(float(b) for b in
+                             (buckets if buckets is not None
+                              else DEFAULT_BUCKETS))
+        self.counts = [0] * (len(self.bounds) + 1)  # +1 overflow
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, v: float) -> None:
+        self.counts[bisect.bisect_left(self.bounds, v)] += 1
+        self.sum += v
+        self.count += 1
+
+    def cumulative(self) -> List[Tuple[float, int]]:
+        """[(upper_bound, cumulative_count)] including (+inf, total)."""
+        out, acc = [], 0
+        for b, c in zip(self.bounds, self.counts):
+            acc += c
+            out.append((b, acc))
+        out.append((math.inf, acc + self.counts[-1]))
+        return out
+
+    def reset(self) -> None:
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.sum = 0.0
+        self.count = 0
+
+    def collect(self) -> Family:
+        return Family(self.name, "histogram", self.help, [({}, self)])
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+class MetricsRegistry:
+    """Name -> metric, plus scrape-time collectors."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, object] = {}
+        self._collectors: List[Callable[[], Iterable[Family]]] = []
+
+    def register(self, metric):
+        with self._lock:
+            prev = self._metrics.get(metric.name)
+            if prev is not None:
+                return prev
+            self._metrics[metric.name] = metric
+            return metric
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self.register(Counter(name, help))
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self.register(Gauge(name, help))
+
+    def histogram(self, name: str, help: str = "",
+                  buckets=None) -> Histogram:
+        return self.register(Histogram(name, help, buckets))
+
+    def register_collector(
+            self, fn: Callable[[], Iterable[Family]]) -> None:
+        with self._lock:
+            self._collectors.append(fn)
+
+    def get(self, name: str):
+        return self._metrics.get(name)
+
+    def collect(self) -> List[Family]:
+        with self._lock:
+            metrics = list(self._metrics.values())
+            collectors = list(self._collectors)
+        fams = [m.collect() for m in metrics]
+        for fn in collectors:
+            try:
+                fams.extend(fn())
+            except Exception:
+                # a broken collector must never take down a scrape
+                continue
+        return fams
+
+
+_DEFAULT: Optional[MetricsRegistry] = None
+
+
+def default_registry() -> MetricsRegistry:
+    global _DEFAULT
+    if _DEFAULT is None:
+        _DEFAULT = MetricsRegistry()
+        _install_standard_families(_DEFAULT)
+    return _DEFAULT
+
+
+def counter(name: str, help: str = "") -> Counter:
+    return default_registry().counter(name, help)
+
+
+def gauge(name: str, help: str = "") -> Gauge:
+    return default_registry().gauge(name, help)
+
+
+def histogram(name: str, help: str = "", buckets=None) -> Histogram:
+    return default_registry().histogram(name, help, buckets)
+
+
+# ---------------------------------------------------------------------------
+# Engine.counters compatibility view
+# ---------------------------------------------------------------------------
+
+class EngineCounters(dict):
+    """``Engine.counters``: still a dict (every existing reader —
+    tests, tools, CheckpointManager — keeps working) with a stable
+    snapshot/reset API, exported into the registry by the engine
+    collector at scrape time (zero per-increment mirroring cost)."""
+
+    def snapshot(self) -> Dict[str, float]:
+        """Stable point-in-time copy (the dict itself keeps mutating
+        under async dispatch)."""
+        return dict(self)
+
+    def reset(self, keys=None) -> Dict[str, float]:
+        """Zero the named counters (all by default), returning the
+        pre-reset snapshot. Types are preserved (float gauges stay
+        float)."""
+        snap = dict(self)
+        for k in (list(self) if keys is None else keys):
+            v = self.get(k)
+            if v is not None:
+                self[k] = type(v)(0)
+        return snap
+
+
+# engine counters that are point-in-time gauges, not monotonic totals
+_ENGINE_GAUGE_KEYS = frozenset({
+    "ckpt_inflight", "grad_collectives_per_step", "comm_overlap_frac"})
+
+_ENGINES: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def register_engine(engine) -> None:
+    """Weakly track an Engine so its counters dict is exported by the
+    ``pt_engine_*`` scrape-time collector. Also auto-starts the
+    standalone metrics endpoint when ``PT_METRICS_PORT`` is set (so
+    every launched trainer is scrapeable without code changes)."""
+    default_registry()
+    _ENGINES.add(engine)
+    if os.environ.get("PT_METRICS_PORT"):
+        try:
+            from .export import maybe_start_from_env
+            maybe_start_from_env()
+        except Exception:
+            pass
+
+
+def _engine_families() -> List[Family]:
+    sums: Dict[str, float] = {}
+    gauges: Dict[str, float] = {}
+    for eng in list(_ENGINES):
+        for k, v in dict(getattr(eng, "counters", {})).items():
+            if k in _ENGINE_GAUGE_KEYS:
+                gauges[k] = max(gauges.get(k, 0.0), float(v))
+            else:
+                sums[k] = sums.get(k, 0.0) + float(v)
+    fams = [Family(f"pt_engine_{k}_total", "counter",
+                   f"Engine.counters[{k!r}] summed over live engines",
+                   [({}, v)])
+            for k, v in sorted(sums.items())]
+    fams.extend(Family(f"pt_engine_{k}", "gauge",
+                       f"Engine.counters[{k!r}] (max over live engines)",
+                       [({}, v)])
+                for k, v in sorted(gauges.items()))
+    return fams
+
+
+def _rpc_families() -> List[Family]:
+    """RPC retry/deadline/breaker accounting, sampled from the
+    resilience layer's own stores at scrape time."""
+    fams: List[Family] = []
+    try:
+        from ..distributed import resilience
+    except Exception:
+        return fams
+    for k, v in sorted(resilience.retry_stats().items()):
+        fams.append(Family(f"pt_rpc_{k}_total", "counter",
+                           f"resilience retry_stats[{k!r}]",
+                           [({}, float(v))]))
+    states = {"closed": 0.0, "half_open": 1.0, "open": 2.0}
+    snap = resilience.endpoint_health.snapshot()
+    state_samples = [({"endpoint": ep},
+                      states.get(info["state"], -1.0))
+                     for ep, info in sorted(snap.items())]
+    fail_samples = [({"endpoint": ep},
+                     float(info["consecutive_failures"]))
+                    for ep, info in sorted(snap.items())]
+    fams.append(Family("pt_rpc_breaker_state", "gauge",
+                       "circuit breaker state per endpoint "
+                       "(0=closed 1=half_open 2=open)", state_samples))
+    fams.append(Family("pt_rpc_breaker_consecutive_failures", "gauge",
+                       "consecutive failures per endpoint",
+                       fail_samples))
+    return fams
+
+
+def _install_standard_families(reg: MetricsRegistry) -> None:
+    """Pre-register every metric family this framework emits, so the
+    exposition endpoint advertises the full catalog even before the
+    first sample (docs/OBSERVABILITY.md)."""
+    # engine step phase latencies (seconds)
+    reg.histogram("pt_step_feed_seconds",
+                  "host feed conversion + H2D per step")
+    reg.histogram("pt_step_trace_seconds",
+                  "trace_step build time (only steps that traced)")
+    reg.histogram("pt_step_dispatch_seconds",
+                  "XLA executable dispatch call per step (includes "
+                  "compile on the first dispatch of a trace)")
+    reg.histogram("pt_step_fetch_seconds",
+                  "synchronous fetch D2H per step (0-cost deferred "
+                  "under FLAGS_async_dispatch)")
+    reg.histogram("pt_step_total_seconds", "whole Engine.run() call")
+    # checkpoint subsystem
+    reg.histogram("pt_ckpt_save_seconds",
+                  "background shard write + commit per save")
+    reg.histogram("pt_ckpt_restore_seconds",
+                  "checkpoint read + scope restore")
+    # distributed liveness
+    reg.counter("pt_heartbeats_sent_total",
+                "trainer heartbeats delivered")
+    reg.counter("pt_heartbeats_failed_total",
+                "trainer heartbeats that failed to send")
+    reg.counter("pt_trainers_evicted_total",
+                "trainers evicted by the pserver liveness registry")
+    # flight recorder
+    reg.counter("pt_flight_dumps_total",
+                "flight-recorder postmortem dumps written")
+    reg.register_collector(_engine_families)
+    reg.register_collector(_rpc_families)
+
+
+# honor FLAGS_telemetry set via environment before this import
+try:
+    from ..core.flags import FLAGS as _FLAGS
+    if getattr(_FLAGS, "telemetry", False):
+        enable_telemetry(True)
+except Exception:
+    pass

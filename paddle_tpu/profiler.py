@@ -12,27 +12,43 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import threading
 import time
-from collections import defaultdict
-from typing import Dict, List, Optional
+from collections import defaultdict, deque
+from typing import Deque, Dict, List, Optional
 
 import jax
 
+from .observability import metrics as _obs_metrics
+
 __all__ = ["profiler", "start_profiler", "stop_profiler",
            "reset_profiler", "RecordEvent", "cuda_profiler",
-           "profiling_active"]
+           "profiling_active", "set_max_events"]
 
-_events: List[dict] = []
+# Bounded host-event buffer: a week-long run with the profiler left on
+# must not grow memory without limit, so old spans fall off the left
+# (same policy as the flight recorder's ring).
+_MAX_EVENTS_DEFAULT = 100_000
+_events: Deque[dict] = deque(maxlen=_MAX_EVENTS_DEFAULT)
 _enabled = [False]
 _trace_dir = [None]
 
 
+def set_max_events(n: int) -> None:
+    """Resize the host-event ring (drops buffered events)."""
+    global _events
+    _events = deque(_events, maxlen=max(1, int(n)))
+
+
 def profiling_active() -> bool:
     """Cheap guard for per-step instrumentation on the engine's dispatch
-    hot path: True while host events are collected or a device trace is
-    live. The async pipeline skips RecordEvent allocation entirely when
-    this is False, so steady-state dispatch pays one boolean check."""
-    return _enabled[0] or _trace_dir[0] is not None
+    hot path: True while host events are collected, a device trace is
+    live, or the observability layer is hot (telemetry enabled or the
+    flight recorder armed — ``metrics._HOT``, docs/OBSERVABILITY.md).
+    The async pipeline skips RecordEvent allocation entirely when this
+    is False, so steady-state dispatch pays one boolean check."""
+    return (_enabled[0] or _trace_dir[0] is not None
+            or _obs_metrics._HOT[0])
 
 
 class RecordEvent:
@@ -52,9 +68,12 @@ class RecordEvent:
     def __exit__(self, *exc):
         t1 = time.perf_counter_ns()
         if _enabled[0]:
+            # real thread id: prefetcher / checkpoint-writer / RPC-pool
+            # spans must land on their own chrome-trace tracks
             _events.append({"name": self.name, "ts": self._t0 / 1e3,
                             "dur": (t1 - self._t0) / 1e3, "ph": "X",
-                            "pid": os.getpid(), "tid": 0})
+                            "pid": os.getpid(),
+                            "tid": threading.get_native_id()})
         if _trace_dir[0]:
             self._tc.__exit__(*exc)
         return False
@@ -83,7 +102,7 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
     # chrome trace export of host events (timeline.py parity)
     if _events and profile_path:
         with open(profile_path + ".chrome_trace.json", "w") as f:
-            json.dump({"traceEvents": _events}, f)
+            json.dump({"traceEvents": list(_events)}, f)
     _print_summary(sorted_key)
 
 

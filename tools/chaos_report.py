@@ -174,6 +174,23 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def summarize_flight_dumps(directory: str, last_n: int = 8) -> list:
+    """Ingest the flight-recorder postmortems the job's workers wrote
+    into ``directory`` (PT_FLIGHT_DIR): a kill_at_step victim dumps its
+    last-N step records inline before ``os._exit``, so the survival
+    report can show WHAT the dead incarnation was doing — per-phase
+    step latencies, fast-path state — not just that it died
+    (docs/OBSERVABILITY.md)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    try:
+        from paddle_tpu.observability import recorder
+        return recorder.summarize_dumps(directory, last_n=last_n)
+    except Exception as exc:  # a broken dump must not fail the report
+        return [{"error": f"{type(exc).__name__}: {exc}"}]
+
+
 def _spawn(role, rank, n_trainers, ep, steps, extra_env):
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
@@ -214,18 +231,23 @@ def run_job(steps=DEFAULT_STEPS, fault_spec=None, max_restarts=1,
     ep = f"127.0.0.1:{_free_port()}"
     agg = {"faults": {}, "retry": {}, "losses": [], "resumed_at": None}
     t0 = time.monotonic()
+    # flight dumps outlive the job's ckpt tempdir: summarized after the
+    # processes are reaped, removed by this function
+    flight_dir = tempfile.mkdtemp(prefix="chaos_flight_")
     with tempfile.TemporaryDirectory(prefix="chaos_ckpt_") as ckpt:
         # liveness on: heartbeats (default interval) + a short eviction
         # timeout so a dead trainer can never hang serve()
         server = _spawn("pserver", 0, 2, ep, steps,
-                        {"FLAGS_trainer_timeout_s": "8"})
+                        {"FLAGS_trainer_timeout_s": "8",
+                         "PT_FLIGHT_DIR": flight_dir})
         trainers = {}
         attempts = {0: 0, 1: 0}
         outs = {0: [], 1: []}
 
         def spawn_trainer(rank):
             extra = {"PADDLE_RESTART_ATTEMPT": str(attempts[rank]),
-                     "CHAOS_CKPT_DIR": os.path.join(ckpt, str(rank))}
+                     "CHAOS_CKPT_DIR": os.path.join(ckpt, str(rank)),
+                     "PT_FLIGHT_DIR": flight_dir}
             if fault_spec and rank == 1:
                 extra["PT_FAULT_PLAN"] = fault_spec
             trainers[rank] = _spawn("trainer", rank, 2, ep, steps,
@@ -297,6 +319,9 @@ def run_job(steps=DEFAULT_STEPS, fault_spec=None, max_restarts=1,
     completed = (not hung and server.returncode == 0 and
                  all(codes and codes[-1] == 0
                      for codes in trainer_codes.values()))
+    flight_records = summarize_flight_dumps(flight_dir)
+    import shutil
+    shutil.rmtree(flight_dir, ignore_errors=True)
     rep = {
         "final_loss": loss0,
         "restarts": restarts,
@@ -306,6 +331,7 @@ def run_job(steps=DEFAULT_STEPS, fault_spec=None, max_restarts=1,
         "faults_injected": agg["faults"],
         "retries_consumed": agg["retry"].get("retries", 0),
         "breaker_fast_fails": agg["retry"].get("breaker_fast_fails", 0),
+        "flight_records": flight_records,
         "completed": completed,
         "elapsed_s": round(elapsed, 2),
     }

@@ -1,0 +1,299 @@
+"""Fleet metrics report: aggregate per-trainer telemetry into one view,
+with CI gates.
+
+Inputs (any combination; all three default on):
+
+* **flight/metrics dump files** (``--flight-dir``, default
+  ``$PT_FLIGHT_DIR``): the ``flight_*.jsonl`` postmortems and
+  ``metrics_*.jsonl`` snapshot files written by
+  ``paddle_tpu/observability`` — one directory per job, many pids.
+* **live scrapes** (``--scrape host:port,host:port``): the
+  ``{"t": "metrics_json"}`` endpoint every trainer serves when
+  ``PT_METRICS_PORT`` is set (and every pserver serves natively).
+* **the local registry** — so running the tool inside a trainer
+  process (or bench.py) reports without any files.
+
+Fleet merge: counters sum across sources, gauges keep per-source
+samples (labeled by origin), histograms sum bucket counts / sums — so
+``pt_step_total_seconds`` becomes the cluster-wide step latency
+distribution.
+
+CI gates (exit 1 on failure):
+
+* ``--check-families``: every REQUIRED_FAMILIES name must be present —
+  a refactor silently dropping ``pt_step_dispatch_seconds`` (the
+  ROADMAP item 4 attribution metric) fails here, not in a dashboard
+  three weeks later.
+* ``--threshold-ms X``: disabled-telemetry host overhead per step must
+  stay under X (proves the one-boolean hot-path gate). Reads
+  ``--overhead-json`` (a ``step_overhead_bench --json`` output) when
+  given, else measures in-process.
+
+Usage::
+
+    python tools/metrics_report.py --flight-dir /tmp/flight --json
+    python tools/metrics_report.py --scrape 127.0.0.1:9460
+    python tools/metrics_report.py --threshold-ms 6 --check-families
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# the metric catalog the framework promises (docs/OBSERVABILITY.md);
+# removal of any of these is a CI failure under --check-families
+REQUIRED_FAMILIES = (
+    "pt_step_feed_seconds", "pt_step_trace_seconds",
+    "pt_step_dispatch_seconds", "pt_step_fetch_seconds",
+    "pt_step_total_seconds",
+    "pt_ckpt_save_seconds", "pt_ckpt_restore_seconds",
+    "pt_heartbeats_sent_total", "pt_heartbeats_failed_total",
+    "pt_trainers_evicted_total", "pt_flight_dumps_total",
+)
+
+
+# ---------------------------------------------------------------------------
+# fleet merge over metrics_snapshot()-shaped dicts
+# ---------------------------------------------------------------------------
+
+def merge_snapshots(sources: List[tuple]) -> Dict[str, dict]:
+    """``sources``: [(origin_label, families_dict)] where families_dict
+    is ``observability.export.metrics_snapshot()`` output. Returns one
+    merged families dict of the same shape."""
+    out: Dict[str, dict] = {}
+    for origin, families in sources:
+        for name, fam in (families or {}).items():
+            ftype = fam.get("type")
+            dst = out.setdefault(name, {"type": ftype, "samples": []})
+            for s in fam.get("samples", []):
+                if ftype == "histogram":
+                    _merge_hist_sample(dst, s)
+                elif ftype == "counter":
+                    _merge_counter_sample(dst, s)
+                else:  # gauge: point-in-time, keep per-origin series
+                    labels = dict(s.get("labels") or {})
+                    labels["origin"] = str(origin)
+                    dst["samples"].append(
+                        {"labels": labels,
+                         "value": float(s.get("value", 0.0))})
+    return out
+
+
+def _labels_key(s):
+    return tuple(sorted((s.get("labels") or {}).items()))
+
+
+def _merge_counter_sample(dst: dict, s: dict) -> None:
+    key = _labels_key(s)
+    for existing in dst["samples"]:
+        if _labels_key(existing) == key:
+            existing["value"] += float(s.get("value", 0.0))
+            return
+    dst["samples"].append({"labels": dict(s.get("labels") or {}),
+                           "value": float(s.get("value", 0.0))})
+
+
+def _merge_hist_sample(dst: dict, s: dict) -> None:
+    key = _labels_key(s)
+    for existing in dst["samples"]:
+        if _labels_key(existing) == key:
+            existing["sum"] += float(s.get("sum", 0.0))
+            existing["count"] += int(s.get("count", 0))
+            cum = {str(le): c for le, c in existing.get("buckets", [])}
+            for le, c in s.get("buckets", []):
+                cum[str(le)] = cum.get(str(le), 0) + int(c)
+            existing["buckets"] = [
+                [le if le == "+Inf" else float(le), c]
+                for le, c in sorted(
+                    cum.items(),
+                    key=lambda kv: (kv[0] == "+Inf",
+                                    float(kv[0]) if kv[0] != "+Inf"
+                                    else 0.0))]
+            return
+    dst["samples"].append({
+        "labels": dict(s.get("labels") or {}),
+        "sum": float(s.get("sum", 0.0)),
+        "count": int(s.get("count", 0)),
+        "buckets": [[le, int(c)] for le, c in s.get("buckets", [])]})
+
+
+# ---------------------------------------------------------------------------
+# sources
+# ---------------------------------------------------------------------------
+
+def collect_dump_sources(flight_dir: Optional[str]):
+    """(snapshot sources, flight summaries) from one dump directory."""
+    from paddle_tpu.observability import recorder, export
+    d = flight_dir or recorder.default_dir()
+    sources, flights = [], []
+    if not os.path.isdir(d):
+        return sources, flights
+    flights = recorder.summarize_dumps(d)
+    for name in sorted(os.listdir(d)):
+        if not (name.startswith("metrics_") and name.endswith(".jsonl")):
+            continue
+        try:
+            snaps = export.read_metrics_dump(os.path.join(d, name))
+        except (OSError, ValueError):
+            continue
+        if snaps:   # last snapshot per process wins (cumulative)
+            sources.append((name, snaps[-1].get("families", {})))
+    return sources, flights
+
+
+def collect_scrape_sources(endpoints: List[str]):
+    from paddle_tpu.observability import export
+    sources, errors = [], {}
+    for ep in endpoints:
+        try:
+            sources.append((ep, export.scrape(ep, as_json=True)))
+        except Exception as exc:
+            errors[ep] = f"{type(exc).__name__}: {exc}"
+    return sources, errors
+
+
+def local_registry_source():
+    from paddle_tpu.observability import export
+    return ("local", export.metrics_snapshot())
+
+
+# ---------------------------------------------------------------------------
+# gates
+# ---------------------------------------------------------------------------
+
+def missing_families(merged: Dict[str, dict]) -> List[str]:
+    return [n for n in REQUIRED_FAMILIES if n not in merged]
+
+
+def measure_disabled_overhead(batch: int = 256, steps: int = 20) -> dict:
+    """Disabled-telemetry host overhead, measured in-process with
+    ``step_overhead_bench``'s method. Every observability gate is
+    explicitly forced off first — this is the number the one-boolean
+    contract is judged by."""
+    from paddle_tpu.observability import metrics, recorder
+    from paddle_tpu.distributed import faults
+    import paddle_tpu as fluid
+    import step_overhead_bench as sob
+    faults.uninstall()
+    metrics.enable_telemetry(False)
+    recorder.enable(False)
+    recorder.set_watchdog_active(False)
+    eng, prog, scope, feed, fetch = sob._build_model(batch)
+    with fluid.scope_guard(scope):
+        return sob.measure_step_overhead(eng, prog, scope, feed, fetch,
+                                         steps=steps)
+
+
+# ---------------------------------------------------------------------------
+# report
+# ---------------------------------------------------------------------------
+
+def fleet_report(flight_dir=None, endpoints=(), include_local=True,
+                 last_n: int = 8) -> dict:
+    sources, flights = collect_dump_sources(flight_dir)
+    scraped, scrape_errors = collect_scrape_sources(list(endpoints))
+    sources.extend(scraped)
+    if include_local:
+        sources.append(local_registry_source())
+    merged = merge_snapshots(sources)
+    step_hist = merged.get("pt_step_total_seconds", {})
+    total_steps = sum(s.get("count", 0)
+                      for s in step_hist.get("samples", []))
+    return {
+        "sources": [origin for origin, _ in sources],
+        "scrape_errors": scrape_errors or None,
+        "flight_dumps": flights,
+        "total_steps_observed": total_steps,
+        "families": merged,
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--flight-dir", default=None,
+                   help="dump directory (default $PT_FLIGHT_DIR)")
+    p.add_argument("--scrape", default="",
+                   help="comma-separated host:port metrics endpoints")
+    p.add_argument("--no-local", action="store_true",
+                   help="exclude this process's own registry")
+    p.add_argument("--check-families", action="store_true",
+                   help="exit 1 if any required metric family is "
+                        "missing from the merged view")
+    p.add_argument("--threshold-ms", type=float, default=None,
+                   help="exit 1 if disabled-telemetry host overhead "
+                        "per step exceeds this")
+    p.add_argument("--overhead-json", default=None,
+                   help="step_overhead_bench --json output to gate on "
+                        "instead of measuring in-process")
+    p.add_argument("--last-n", type=int, default=8,
+                   help="steps summarized per flight dump")
+    p.add_argument("--json", action="store_true",
+                   help="print the full machine-readable report")
+    args = p.parse_args(argv)
+
+    endpoints = [e.strip() for e in args.scrape.split(",") if e.strip()]
+    rep = fleet_report(flight_dir=args.flight_dir, endpoints=endpoints,
+                       include_local=not args.no_local,
+                       last_n=args.last_n)
+    failures = []
+
+    if args.check_families:
+        missing = missing_families(rep["families"])
+        rep["missing_families"] = missing
+        if missing:
+            failures.append(f"required metric families missing: "
+                            f"{missing}")
+
+    if args.threshold_ms is not None:
+        if args.overhead_json:
+            with open(args.overhead_json) as f:
+                overhead = json.load(f)
+        else:
+            overhead = measure_disabled_overhead()
+        rep["disabled_overhead"] = {
+            "host_overhead_ms": overhead["host_overhead_ms"],
+            "sync_ms": overhead["sync_ms"],
+            "threshold_ms": args.threshold_ms,
+        }
+        if overhead["host_overhead_ms"] > args.threshold_ms:
+            failures.append(
+                f"disabled-telemetry host overhead "
+                f"{overhead['host_overhead_ms']:.2f} ms/step exceeds "
+                f"threshold {args.threshold_ms:.2f} ms (one-boolean "
+                f"hot-path gate regressed?)")
+
+    if args.json:
+        print(json.dumps(rep, indent=2, default=str))
+    else:
+        print(f"sources: {', '.join(rep['sources']) or '(none)'}")
+        print(f"steps observed (fleet): {rep['total_steps_observed']}")
+        print(f"metric families: {len(rep['families'])}")
+        for fl in rep["flight_dumps"]:
+            if "error" in fl:
+                print(f"  flight dump error: {fl['error']}")
+                continue
+            print(f"  flight {fl['file']}: reason={fl['reason']} "
+                  f"steps {fl['first_step']}..{fl['last_step']} "
+                  f"mean_phase_ms={fl['mean_phase_ms']}")
+        if "disabled_overhead" in rep:
+            d = rep["disabled_overhead"]
+            print(f"disabled-path overhead: "
+                  f"{d['host_overhead_ms']:.2f} ms/step "
+                  f"(threshold {d['threshold_ms']:.2f})")
+    if failures:
+        for f in failures:
+            print("GATE FAILURE: " + f, file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

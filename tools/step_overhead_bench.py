@@ -10,11 +10,19 @@ a perfectly overlapped loop pays ~0 ms of it.
 CLI::
 
     python tools/step_overhead_bench.py [--json] [--async-dispatch]
-        [--batch N] [--steps N] [--threshold-ms X]
+        [--batch N] [--steps N] [--threshold-ms X] [--telemetry]
+        [--compare-telemetry]
 
 exits non-zero when measured host overhead exceeds ``--threshold-ms``
 (the CI regression gate). ``overhead_report()`` is imported by bench.py
 to emit the same accounting line alongside tokens/sec.
+
+This bench is also the proof for the observability one-boolean
+contract (docs/OBSERVABILITY.md): without ``--telemetry`` every
+observability gate is forced OFF first, so the default run measures
+the disabled path — ``tools/metrics_report.py --threshold-ms`` gates
+on that number. ``--compare-telemetry`` measures both and reports the
+enabled-path delta.
 """
 from __future__ import annotations
 
@@ -120,6 +128,18 @@ def measure_step_overhead(eng, prog, scope, batch, fetch_names,
             "counters": counters}
 
 
+def set_telemetry(enabled):
+    """Force every observability hot-path gate to a known state so the
+    measurement is attributable: disabled means metrics + recorder +
+    watchdog-arming + fault-arming all off (``_HOT[0]`` False)."""
+    from paddle_tpu.observability import metrics, recorder
+    from paddle_tpu.distributed import faults
+    faults.uninstall()
+    recorder.set_watchdog_active(False)
+    recorder.enable(bool(enabled))
+    metrics.enable_telemetry(bool(enabled))
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--batch", type=int, default=256)
@@ -128,6 +148,12 @@ def main(argv=None):
                    help="exit 1 when host overhead/step exceeds this")
     p.add_argument("--async-dispatch", action="store_true",
                    help="measure with FLAGS_async_dispatch on")
+    p.add_argument("--telemetry", action="store_true",
+                   help="measure with FLAGS_telemetry + flight "
+                        "recorder ON (default: forced off)")
+    p.add_argument("--compare-telemetry", action="store_true",
+                   help="measure disabled then enabled, report both "
+                        "and the enabled-path delta")
     p.add_argument("--json", action="store_true")
     args = p.parse_args(argv)
 
@@ -137,16 +163,32 @@ def main(argv=None):
 
     eng, prog, scope, feed, fetch = _build_model(args.batch)
     import paddle_tpu as fluid
+    set_telemetry(args.telemetry)
     with fluid.scope_guard(scope):
         r = measure_step_overhead(eng, prog, scope, feed, fetch,
                                   steps=args.steps)
+        if args.compare_telemetry and not args.telemetry:
+            set_telemetry(True)
+            r_on = measure_step_overhead(eng, prog, scope, feed, fetch,
+                                         steps=args.steps)
+            set_telemetry(False)
+            r["telemetry_on"] = {k: r_on[k] for k in
+                                 ("sync_ms", "pipelined_ms",
+                                  "host_overhead_ms", "steps_per_sec")}
+            r["telemetry_delta_ms"] = (r_on["sync_ms"] - r["sync_ms"])
     r["async_dispatch"] = bool(args.async_dispatch)
+    r["telemetry"] = bool(args.telemetry)
     if args.json:
         print(json.dumps(r))
     else:
         print(overhead_report("step_overhead_bench", r["sync_ms"],
                               r["steps_per_sec"],
                               counters=r["counters"]))
+        if "telemetry_delta_ms" in r:
+            print(f"# telemetry-enabled sync "
+                  f"{r['telemetry_on']['sync_ms']:.2f} ms/step "
+                  f"(delta {r['telemetry_delta_ms']:+.3f} ms vs "
+                  f"disabled {r['sync_ms']:.2f})")
     bad = []
     if r["counters"].get("traces"):
         bad.append(f"steady state re-traced "

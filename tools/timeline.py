@@ -14,23 +14,43 @@ Each input is a `<name>=<path>` pair where path is the
 from each profile are remapped onto their own pid and labeled with a
 process_name metadata record so chrome://tracing shows one lane per
 trainer.
+
+A path ending in ``.jsonl`` is treated as an observability flight dump
+(``flight_*.jsonl``, docs/OBSERVABILITY.md) and converted to per-phase
+chrome-trace lanes via ``observability.export.flight_to_chrome_trace``
+— so a postmortem's last-N steps can be merged side by side with live
+profiler traces from surviving trainers.
 """
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import sys
+
+
+def _flight_events(path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from paddle_tpu.observability.export import flight_to_chrome_trace
+    return flight_to_chrome_trace(path)
 
 
 def merge(profile_paths):
     """profile_paths: list of (name, path). Returns chrome-trace dict."""
     events = []
     for pid, (name, path) in enumerate(profile_paths):
-        with open(path) as f:
-            data = json.load(f)
+        if path.endswith(".jsonl"):
+            src = _flight_events(path)
+        else:
+            with open(path) as f:
+                src = json.load(f).get("traceEvents", [])
         events.append({
             "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
             "args": {"name": name}})
-        for ev in data.get("traceEvents", []):
+        for ev in src:
             ev = dict(ev)
             ev["pid"] = pid
             events.append(ev)
