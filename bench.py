@@ -213,6 +213,49 @@ def _bench_checkpoint(exe, scope, main_prog):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def _probe_scheduler(eng, prog, scope, feed, fetch, sync_off_ms):
+    """A/B the op scheduler (FLAGS_op_scheduler, docs/SCHEDULING.md) on
+    the already-built transformer: flag on (flag-aware cache keys force
+    a fresh scheduled trace), 3 warmups, median of 5 fetch-fenced sync
+    steps. The scheduler's headline win is exactly this number: the
+    loss is a forward-island output, so its fetch completes while the
+    backward/optimizer islands still run — the whole-block executable
+    makes the same fetch wait for the optimizer."""
+    import jax
+    from paddle_tpu.core.flags import FLAGS, set_flags
+    prev = bool(FLAGS.op_scheduler)
+    out = {"sync_ms_off": round(sync_off_ms, 2)}
+
+    def _np(o):
+        return np.asarray(o.array if hasattr(o, "array") else o)
+
+    try:
+        set_flags({"FLAGS_op_scheduler": True})
+        batch = {k: jax.device_put(np.asarray(v))
+                 for k, v in feed.items()}
+        for _ in range(3):
+            o = eng.run(prog, scope, None, batch, fetch,
+                        return_numpy=False)
+        float(_np(o[0]))
+        ts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            float(_np(eng.run(prog, scope, None, batch, fetch,
+                              return_numpy=False)[0]))
+            ts.append(time.perf_counter() - t0)
+        out["sync_ms_on"] = round(sorted(ts)[len(ts) // 2] * 1e3, 2)
+        out["counters"] = {
+            "scheduled_steps": eng.counters["scheduled_steps"],
+            "islands_concurrent": eng.counters["islands_concurrent"],
+            "pipeline_fill_frac": eng.counters["pipeline_fill_frac"],
+            "lane_idle_ms": round(eng.counters["lane_idle_ms"], 2)}
+    except Exception as exc:   # accounting only; never fail the bench
+        out["error"] = f"{type(exc).__name__}: {exc}"[:200]
+    finally:
+        set_flags({"FLAGS_op_scheduler": prev})
+    return out
+
+
 def bench_transformer(batch=BATCH, seq=None, measure_ckpt=False):
     import paddle_tpu as fluid
     from paddle_tpu import models
@@ -254,6 +297,11 @@ def bench_transformer(batch=BATCH, seq=None, measure_ckpt=False):
             stats["comm"] = dict(eng.counters)
         if measure_ckpt:
             _bench_checkpoint(exe, scope, main_prog)
+            # headline run only: scheduler-on sync A/B for the
+            # scheduler_overlap JSON tail (ROADMAP open item 4)
+            stats = stats or {}
+            stats["scheduler"] = _probe_scheduler(
+                eng, main_prog, scope, feed, [cost.name], sync_ms)
     return sps * batch * s_trg, sps, traj, sync_ms, stats
 
 
@@ -645,6 +693,13 @@ def main():
             (stats or {}).get("comm"))
     except Exception:
         pass   # accounting only; never fail the bench on it
+    sched, sched_line = {}, None
+    try:
+        from tools.step_overhead_bench import scheduler_overlap_report
+        sched, sched_line = scheduler_overlap_report(
+            (stats or {}).get("scheduler"))
+    except Exception:
+        pass   # accounting only; never fail the bench on it
     chaos, chaos_line = {}, None
     if os.environ.get("PT_BENCH_CHAOS"):
         # opt-in: spawns a 2-trainer PS job twice (clean + faulted),
@@ -673,11 +728,14 @@ def main():
         "unit": "tokens/sec",
         "vs_baseline": round(tokens_per_sec / V100_TOKENS_PER_SEC, 3),
         "comm_overlap": comm or None,
+        "scheduler_overlap": sched or None,
         "chaos": chaos or None,
         "metrics": metrics_tail or None,
     }))
     if comm_line:
         print(comm_line, file=sys.stderr)
+    if sched_line:
+        print(sched_line, file=sys.stderr)
     if chaos_line:
         print(chaos_line, file=sys.stderr)
     print(f"# transformer: steps/s={sps:.2f} "

@@ -703,6 +703,22 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
                           list(fetch_names), [], fetch_lod_box, True,
                           nan_check_labels=nan_labels_box)
     updated_names = list(updated_box)
+    if (FLAGS.op_scheduler and mesh is None and iterations == 1
+            and not feed_lods):
+        # programmable operator scheduler (core/scheduler.py,
+        # docs/SCHEDULING.md): data-independent islands dispatched on
+        # concurrent lanes (accum_k == 1) or a pipelined micro-batch
+        # grad-accumulation loop (accum_k > 1). Returns None when the
+        # block is not schedulable (sub-blocks, single island, opaque
+        # state) — the whole-block jit below stays the fallback.
+        from .scheduler import build_scheduled_step
+        ts = build_scheduled_step(
+            program, block, params_sig, feed_sig, fetch_names, avail,
+            updated_names, amp_cfg, accum_k, check_nan, fetch_lod_box,
+            uses_rng=uses_rng_box[0])
+        if ts is not None:
+            ts.comm_stats = comm_stats
+            return ts
     donated = [n for n in avail if n in updated_names]
     const = [n for n in avail if n not in updated_names]
 
@@ -897,7 +913,13 @@ class Engine:
             "ckpt_saves": 0, "ckpt_inflight": 0,
             "collective_bytes": 0, "collective_buckets": 0,
             "collective_quantized": 0, "grad_collectives_per_step": 0,
-            "comm_overlap_frac": 0.0})
+            "comm_overlap_frac": 0.0,
+            # op scheduler (core/scheduler.py, docs/SCHEDULING.md):
+            # steps through a scheduled TracedStep, max same-phase
+            # island width, grad-accum pipeline host duty cycle, and
+            # cumulative same-phase lane idle time
+            "scheduled_steps": 0, "islands_concurrent": 0,
+            "pipeline_fill_frac": 0.0, "lane_idle_ms": 0.0})
         _obs.register_engine(self)
         # feed names that are identical on every process under multihost
         # SPMD (shared tables, per-step constants) — globalized by
@@ -1047,7 +1069,8 @@ class Engine:
                     or 1), int(iterations),
                 float(FLAGS.allreduce_bucket_mb),
                 str(FLAGS.quantized_allreduce),
-                bool(FLAGS.sharded_weight_update))
+                bool(FLAGS.sharded_weight_update),
+                bool(FLAGS.op_scheduler))
 
     def compiled_step(self, program, scope: Scope, feed, fetch_names,
                       block_idx: int = 0, iterations: int = 1):
@@ -1143,7 +1166,8 @@ class Engine:
                     or 1),
                 float(FLAGS.allreduce_bucket_mb),
                 str(FLAGS.quantized_allreduce),
-                bool(FLAGS.sharded_weight_update))
+                bool(FLAGS.sharded_weight_update),
+                bool(FLAGS.op_scheduler))
 
     def _fast_feed_arrays(self, entry: _FastPathEntry, feed):
         """Feed dict -> device arrays through the cached signature: no
@@ -1393,6 +1417,20 @@ class Engine:
             if obs is not None:
                 obs["comm_plan"] = comm_stats.get(
                     "plan_id", comm_stats["buckets"])
+        sched = getattr(traced, "op_sched", None)
+        if sched is not None and sched.last_stats:
+            st = sched.last_stats
+            c = self.counters
+            c["scheduled_steps"] += 1
+            if "islands_concurrent" in st:
+                c["islands_concurrent"] = st["islands_concurrent"]
+            if "pipeline_fill_frac" in st:
+                c["pipeline_fill_frac"] = st["pipeline_fill_frac"]
+            c["lane_idle_ms"] += st.get("lane_idle_ms", 0.0)
+            if obs is not None:
+                obs["lanes"] = st.get("spans")
+                obs["phases"]["lane_idle_ms"] = st.get(
+                    "lane_idle_ms", 0.0)
         for n, v in updated.items():
             var = updated_vars.get(n) if updated_vars is not None \
                 else None

@@ -176,6 +176,16 @@ _define("telemetry", False, True,
         "collectors. Off (default) the step loop pays one boolean "
         "check; the flight recorder still arms itself under a fault "
         "plan or step watchdog so postmortems exist without telemetry")
+_define("op_scheduler", False, True,
+        "programmable operator scheduler (paddle_tpu/core/scheduler): "
+        "partition the block into data-independent islands by def-use "
+        "analysis, dispatch same-phase islands concurrently on dispatch "
+        "lanes, and pipeline the gradient-accumulation micro-batch loop "
+        "so slice k+1's feed/dispatch overlaps slice k's device work. "
+        "Numerically identical to the whole-block jit (per-op RNG keys "
+        "on op uids, not positions); programs it cannot schedule "
+        "(meshes, sub-blocks, LoD feeds, single-island blocks) fall "
+        "back to the standard path (docs/SCHEDULING.md)")
 _define("flight_recorder_steps", 64, True,
         "flight-recorder ring capacity: per-step span records retained "
         "for the postmortem dump (watchdog trip, PT_FAULT_PLAN, sticky "

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from .registry import _RngCtx
+from .scheduler import last_read_table
 
 
 def _sig_of(v, lod):
@@ -99,6 +100,10 @@ class IslandRunner:
         if first_dynamic_idx is not None:
             self.dynamic_idx.add(first_dynamic_idx)
         self._segments: Dict[Tuple[int, int], _Segment] = {}
+        # suffix read-set table (scheduler.last_read_table): one O(ops)
+        # pass answers "read at/after index i" for every segment,
+        # instead of rescanning ops[end:] per segment (O(n²))
+        self._last_read = last_read_table(self.ops, self._op_reads)
         self._warned = set()
 
     # ---- static name analysis -------------------------------------------
@@ -120,8 +125,8 @@ class IslandRunner:
                     reads.append(n)
             writes.update(self._op_writes(op))
         used_later = set(self.fetch_names) | self.persistable_all
-        for op in self.ops[end:]:
-            used_later.update(self._op_reads(op))
+        used_later.update(n for n, last in self._last_read.items()
+                          if last >= end)
         out_names = sorted(writes & used_later)
         seg = _Segment(start, end, reads, out_names)
         self._segments[(start, end)] = seg
@@ -204,16 +209,11 @@ class IslandRunner:
                      list(captured.get("labels", [])))
             seg.cache[sig] = entry
         else:
-            jf, lod_delta, labels = entry
+            jf = entry[0]
             outs, flags = jf(ins, key)
-            for n, v in lod_delta.items():
-                lod_env[n] = [list(l) for l in v]
-            env.update(outs)
-            checks.extend((t, n, fl)
-                          for (t, n), fl in zip(labels, flags))
-            return
-        # first (tracing) call path
-        jf, lod_delta, labels = entry
+        # shared tail for the cache-hit and first-trace paths: replay
+        # the lod delta, publish outputs, attach flag labels
+        _, lod_delta, labels = entry
         for n, v in lod_delta.items():
             lod_env[n] = [list(l) for l in v]
         env.update(outs)
@@ -225,11 +225,12 @@ class IslandRunner:
         self._warned.add(idx)
         import warnings
         op = self.ops[idx]
+        compiled = len(self.ops) - len(self.dynamic_idx)
         warnings.warn(
             f"op {op.type!r} (block op #{idx}) runs on HOST between "
             f"compiled XLA islands (value-dependent shape or host "
-            f"side-effect); the other {len(self.ops) - 1} ops stay "
-            f"compiled.", stacklevel=3)
+            f"side-effect); {len(self.dynamic_idx)} host op(s) so far, "
+            f"the other {compiled} ops stay compiled.", stacklevel=3)
 
     def step(self, params, feeds, key):
         env: Dict[str, Any] = {}

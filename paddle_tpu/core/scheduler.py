@@ -1,0 +1,658 @@
+"""Programmable operator scheduler: concurrent island dispatch +
+micro-batch pipelining (docs/SCHEDULING.md).
+
+BENCH_r05 measured the transformer sync 1-step latency at 178.9 ms
+against a 59.1 ms device-pipeline bound: ~120 ms of every synchronous
+step is host dispatch + fetch serialization behind ONE monolithic
+whole-block executable. DynaFlow's observation (PAPERS.md) is that a
+block is rarely one dependence chain — forward, backward, and the
+per-parameter optimizer updates are data-independent subgraphs that a
+programmable scheduler can dispatch on separate lanes. This module
+generalizes ``core/islands.py`` from "split only at dynamic ops" to
+"split wherever subgraphs are data-independent":
+
+* the block is cut into contiguous *phases* at the forward/backward/
+  optimize ``op_role`` boundaries (any contiguous cut is dependence-
+  safe: program order only ever carries values forward);
+* within a phase, union-find over def-use connects every reader and
+  writer of a name that the phase WRITES (read-read sharing of params
+  or feeds does not merge), yielding data-independent *islands*;
+* each island compiles to its own ``jax.jit`` executable; same-phase
+  islands are dispatched concurrently on a small thread-pool of
+  dispatch lanes, and phases are dispatched back-to-back WITHOUT
+  waiting on device results — jax arrays are futures, so island k+1's
+  host dispatch overlaps island k's device compute.
+
+The payoff for the synchronous loop is structural: the loss is a
+*forward-phase* output, so fetching it completes as soon as the forward
+island finishes — the backward and optimizer islands are still running
+on-device when ``run()`` returns. The whole-block executable cannot
+offer that: one dispatch, one completion event, the fetch waits for the
+optimizer.
+
+For gradient accumulation (``engine._run_accumulated`` semantics,
+multi_batch_merge parity) the scheduler pipelines the micro-batch loop:
+one compiled compute executable dispatched K times with per-slice
+``fold_in`` keys (slice k+1's feed slicing + dispatch overlaps slice
+k's device work), grads averaged exactly as the host loop does, then
+one compiled optimizer executable.
+
+Numerical identity with the whole-block jit is by construction:
+per-op RNG keys fold the op's *uid* into the step key
+(``registry.ExecContext.rng``), never the op's position, so splitting
+the block cannot change any op's randomness; islands partition the ops
+(each op runs exactly once) and values flow through the same names.
+The parity tests in ``tests/test_op_scheduler.py`` assert bit-identical
+losses with the flag on and off.
+
+Everything here is gated behind ``FLAGS_op_scheduler`` and returns
+``None`` from :func:`build_scheduled_step` whenever a program is not
+eligible (SPMD meshes, sub-block ops, LoD feeds, iterations > 1,
+single-island blocks) — the engine's whole-block jit stays the
+fallback, with buffer donation; scheduled steps do not donate (an
+updated param crosses island boundaries, so the input buffer must stay
+alive until the consuming island has it).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .registry import _RngCtx
+
+__all__ = ["build_scheduled_step", "partition_block", "last_read_table",
+           "op_reads", "op_writes", "Island", "ScheduledStep",
+           "PipelinedAccumStep"]
+
+# dispatch lanes: submitting a jitted call is host work (arg flattening
+# + runtime enqueue), so a handful of threads is enough to keep the
+# device queue full; PT_SCHED_LANES overrides for experiments
+_LANES = max(2, int(os.environ.get("PT_SCHED_LANES", "4") or 4))
+
+_POOL: Optional[ThreadPoolExecutor] = None
+_POOL_LOCK = threading.Lock()
+
+
+def _pool() -> ThreadPoolExecutor:
+    global _POOL
+    if _POOL is None:
+        with _POOL_LOCK:
+            if _POOL is None:
+                _POOL = ThreadPoolExecutor(
+                    max_workers=_LANES,
+                    thread_name_prefix="pt-sched-lane")
+    return _POOL
+
+
+# ---------------------------------------------------------------------------
+# def-use analysis helpers (shared with islands.IslandRunner)
+# ---------------------------------------------------------------------------
+
+def op_reads(op) -> List[str]:
+    return [n for slot in op.input_slots() for n in op.input(slot) if n]
+
+
+def op_writes(op) -> List[str]:
+    return [n for slot in op.output_slots() for n in op.output(slot)
+            if n]
+
+
+def last_read_table(ops: Sequence, reads_fn=op_reads) -> Dict[str, int]:
+    """name -> highest op index that READS it. One O(ops) pass; lets a
+    partitioner answer "is this name used at/after index i" without
+    rescanning the op suffix per segment (the O(n²) the old
+    ``IslandRunner._segment_for`` paid)."""
+    table: Dict[str, int] = {}
+    for i, op in enumerate(ops):
+        for n in reads_fn(op):
+            table[n] = i
+    return table
+
+
+def _phase_ranges(ops) -> List[Tuple[int, int]]:
+    """Contiguous [start, end) phase ranges cut at the first backward
+    and first optimize ``op_role``. ANY contiguous cut is dependence-
+    safe — program order only carries values forward — so the roles are
+    purely a quality heuristic that separates the three naturally
+    independent op populations."""
+    n = len(ops)
+    b = next((i for i, op in enumerate(ops)
+              if op.attr("op_role", "forward") == "backward"), n)
+    o = next((i for i in range(b, n)
+              if ops[i].attr("op_role", "forward") == "optimize"), n)
+    cuts = sorted({0, b, o, n})
+    return [(s, e) for s, e in zip(cuts, cuts[1:]) if e > s]
+
+
+def _components(ops, start: int, end: int) -> List[List[int]]:
+    """Union-find connected components of ops[start:end] under the
+    def-use relation: ops are connected iff they touch a common name
+    that the range WRITES. Names nobody in the range writes (params,
+    feeds, activations from earlier phases) are shared read-only inputs
+    and must NOT merge their readers — that read-read sharing is
+    exactly the independence being harvested."""
+    size = end - start
+    parent = list(range(size))
+
+    def find(x):
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    def union(a, b):
+        ra, rb = find(a), find(b)
+        if ra != rb:
+            parent[rb] = ra
+
+    readers: Dict[str, List[int]] = {}
+    writers: Dict[str, List[int]] = {}
+    for i in range(start, end):
+        li = i - start
+        for n in op_reads(ops[i]):
+            readers.setdefault(n, []).append(li)
+        for n in op_writes(ops[i]):
+            writers.setdefault(n, []).append(li)
+    for n, ws in writers.items():
+        for w in ws[1:]:
+            union(ws[0], w)
+        for r in readers.get(n, ()):
+            union(ws[0], r)
+    groups: Dict[int, List[int]] = {}
+    for li in range(size):
+        groups.setdefault(find(li), []).append(start + li)
+    return sorted(groups.values(), key=lambda g: g[0])
+
+
+def _cap_components(comps: List[List[int]], cap: int) -> List[List[int]]:
+    """Merge the smallest components until at most `cap` remain — one
+    executable per tiny optimizer update would trade the dispatch win
+    back for per-call overhead."""
+    comps = list(comps)
+    while len(comps) > cap:
+        comps.sort(key=len)
+        merged = sorted(comps[0] + comps[1])
+        comps = comps[2:] + [merged]
+    return sorted(comps, key=lambda g: g[0])
+
+
+class Island:
+    """One data-independent subgraph: op indices plus its dataflow
+    interface (external reads in, externally-consumed writes out)."""
+
+    __slots__ = ("indices", "phase", "in_names", "out_names",
+                 "writes", "jfn", "labels")
+
+    def __init__(self, indices: List[int], phase: int):
+        self.indices = indices
+        self.phase = phase
+        self.in_names: List[str] = []
+        self.out_names: List[str] = []
+        self.writes: set = set()
+        self.jfn = None
+        self.labels: List[Tuple[str, str]] = []
+
+
+def _island_interface(ops, isl: Island) -> None:
+    """First-reads (names read before any local write) and the local
+    write set, in op order."""
+    reads: List[str] = []
+    writes: set = set()
+    for i in isl.indices:
+        for n in op_reads(ops[i]):
+            if n not in writes and n not in reads:
+                reads.append(n)
+        writes.update(op_writes(ops[i]))
+    isl.in_names = reads
+    isl.writes = writes
+
+
+def partition_block(ops, fetch_names: Sequence[str],
+                    updated_names: Sequence[str],
+                    cap: int = _LANES) -> List[List[Island]]:
+    """Partition `ops` into phases of data-independent islands.
+
+    Returns phases in program order; islands within a phase are mutually
+    data-independent (no name written by one is read by another — the
+    invariant ``tests/test_op_scheduler.py`` checks against
+    ``analysis.def_use.DefUseGraph``). Each op lands in exactly one
+    island. ``out_names`` is each island's externally-consumed write
+    set: reads of OTHER islands plus the step outputs (fetches, updated
+    persistables)."""
+    phases: List[List[Island]] = []
+    for pi, (s, e) in enumerate(_phase_ranges(ops)):
+        comps = _cap_components(_components(ops, s, e), cap)
+        phase = []
+        for comp in comps:
+            isl = Island(comp, pi)
+            _island_interface(ops, isl)
+            phase.append(isl)
+        phases.append(phase)
+    all_islands = [isl for phase in phases for isl in phase]
+    keep = set(fetch_names) | set(updated_names)
+    for isl in all_islands:
+        external: set = set(keep)
+        for other in all_islands:
+            if other is not isl:
+                external.update(other.in_names)
+        isl.out_names = sorted(isl.writes & external)
+    return phases
+
+
+def _has_sub_block(op) -> bool:
+    """Ops carrying sub-blocks (while/cond/py_func trampolines) need the
+    engine's block_runner recursion rooted in ONE env — splitting them
+    across islands is not worth modeling. Detected structurally so this
+    module needs no framework import."""
+    for _name, val in op._all_attrs():
+        if hasattr(val, "idx"):
+            return True
+        if isinstance(val, (list, tuple)) and val and \
+                all(hasattr(v, "idx") for v in val):
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# scheduled execution
+# ---------------------------------------------------------------------------
+
+class _TraceBase:
+    """Shared tracing machinery: run an op subset inside a jit trace
+    with amp + nan-check collection (the islands.py pattern)."""
+
+    def __init__(self, program, block, amp_cfg, check_nan):
+        self.program = program
+        self.block = block
+        self.ops = list(block.ops)
+        self.amp_cfg = amp_cfg
+        self.check_nan = check_nan
+        self.labels: List[Tuple[str, str]] = []
+        self.last_stats: Dict[str, Any] = {}
+
+    def _amp(self):
+        if self.amp_cfg:
+            from .amp import amp_guard
+            return amp_guard(True,
+                             self.amp_cfg.get("dtype", jnp.bfloat16),
+                             self.amp_cfg.get("black_ops", ()),
+                             self.amp_cfg.get("white_ops", ()))
+        import contextlib
+        return contextlib.nullcontext()
+
+    def _run_collecting(self, ops, env, rng_ctx, checks, use_amp=True):
+        from . import engine as _eng
+
+        def block_runner(idx, sub_env=None):
+            _eng.run_block_ops(self.program.block(idx),
+                               sub_env if sub_env is not None else env,
+                               rng_ctx, {}, block_runner)
+            return sub_env if sub_env is not None else env
+
+        if self.check_nan:
+            _eng._nan_check_ctx.items = []
+        try:
+            with self._amp() if use_amp else _nullctx():
+                _eng.run_block_ops(self.block, env, rng_ctx, {},
+                                   block_runner, ops=ops)
+        finally:
+            got = getattr(_eng._nan_check_ctx, "items", None)
+            _eng._nan_check_ctx.items = None
+        if self.check_nan and got:
+            checks.extend(got)
+
+
+def _nullctx():
+    import contextlib
+    return contextlib.nullcontext()
+
+
+class ScheduledStep(_TraceBase):
+    """TracedStep-compatible callable dispatching islands on lanes.
+
+    ``(donated_params, const_params, feeds, key) -> (fetches, updated,
+    nan_flags)`` — donated is always {} here (no donation under the
+    scheduler). The first call runs islands inline so every executable
+    traces deterministically; steady-state calls submit same-phase
+    islands to the lane pool and gather in build order, keeping fetch
+    tuples, updated dicts, and nan-flag stacking deterministic."""
+
+    def __init__(self, program, block, phases: List[List[Island]],
+                 fetch_names, updated_names, amp_cfg, check_nan):
+        super().__init__(program, block, amp_cfg, check_nan)
+        self.phases = phases
+        self.fetch_names = list(fetch_names)
+        self.updated_names = list(updated_names)
+        self.n_islands = sum(len(p) for p in phases)
+        self._traced_once = False
+
+    # -- build --------------------------------------------------------------
+    def _make_fn(self, isl: Island):
+        ops = [self.ops[i] for i in isl.indices]
+        captured: Dict[str, Any] = {}
+
+        def f(ins, key):
+            env = dict(ins)
+            checks: List = []
+            self._run_collecting(ops, env, _RngCtx(key), checks)
+            captured["labels"] = [(t, n) for t, n, _ in checks]
+            outs = {n: env[n] for n in isl.out_names if n in env}
+            return outs, tuple(fl for _, _, fl in checks)
+
+        return f, captured
+
+    def build(self, env_sig: Dict[str, Any], key_sig) -> None:
+        """Abstractly validate + wire every island (raises on anything
+        the per-island trace cannot express — the caller falls back to
+        the whole-block path)."""
+        sig = dict(env_sig)
+        for phase in self.phases:
+            outs_sigs = []
+            for isl in phase:
+                f, captured = self._make_fn(isl)
+                ins_sig = {n: sig[n] for n in isl.in_names if n in sig}
+                outs_sig, _flags = jax.eval_shape(f, ins_sig, key_sig)
+                isl.jfn = jax.jit(f)
+                isl.labels = list(captured.get("labels", ()))
+                self.labels.extend(isl.labels)
+                outs_sigs.append(outs_sig)
+            for outs_sig in outs_sigs:
+                sig.update(outs_sig)
+        self._final_sig = sig
+
+    # -- dispatch -----------------------------------------------------------
+    @staticmethod
+    def _call_island(isl: Island, ins, key):
+        t0 = time.perf_counter()
+        outs, flags = isl.jfn(ins, key)
+        t1 = time.perf_counter()
+        return outs, flags, t0, t1, threading.current_thread().name
+
+    def __call__(self, donated_params, const_params, feeds, key):
+        env: Dict[str, Any] = dict(const_params)
+        env.update(donated_params)
+        env.update(feeds)
+        t_step = time.perf_counter()
+        spans: List[dict] = []
+        flags_all: List = []
+        idle_ms = 0.0
+        inline = not self._traced_once
+        for pi, phase in enumerate(self.phases):
+            # snapshot inputs for the whole phase BEFORE any island of
+            # it writes back — islands of one phase are independent and
+            # must each see the pre-phase env
+            ins_list = [{n: env[n] for n in isl.in_names if n in env}
+                        for isl in phase]
+            if len(phase) == 1 or inline:
+                results = [self._call_island(isl, ins, key)
+                           for isl, ins in zip(phase, ins_list)]
+            else:
+                futs = [_pool().submit(self._call_island, isl, ins, key)
+                        for isl, ins in zip(phase, ins_list)]
+                results = [f.result() for f in futs]
+            if len(phase) > 1 and not inline:
+                t0s = [r[2] for r in results]
+                t1s = [r[3] for r in results]
+                window = max(t1s) - min(t0s)
+                idle_ms += sum(window - (t1 - t0)
+                               for t0, t1 in zip(t0s, t1s)) * 1e3
+            for isl, (outs, flags, t0, t1, lane) in zip(phase, results):
+                env.update(outs)
+                flags_all.extend(flags)
+                spans.append({"phase": pi, "ops": len(isl.indices),
+                              "lane": lane,
+                              "t0_ms": round((t0 - t_step) * 1e3, 3),
+                              "dur_ms": round((t1 - t0) * 1e3, 3)})
+        self._traced_once = True
+        fetches = []
+        for n in self.fetch_names:
+            if n not in env:
+                raise KeyError(
+                    f"fetch target {n!r} was not produced by the "
+                    f"program")
+            fetches.append(env[n])
+        updated = {n: env[n] for n in self.updated_names if n in env}
+        nan_flags = jnp.stack([jnp.asarray(f) for f in flags_all]) \
+            if flags_all else ()
+        self.last_stats = {"islands": self.n_islands,
+                           "islands_concurrent": max(
+                               len(p) for p in self.phases),
+                           "lane_idle_ms": round(idle_ms, 3),
+                           "spans": spans}
+        return tuple(fetches), updated, nan_flags
+
+
+class PipelinedAccumStep(_TraceBase):
+    """Micro-batch pipeline for the gradient-accumulation path.
+
+    Mirrors ``engine._run_accumulated`` exactly — dense slice per
+    micro-batch, per-slice ``fold_in(key, i)`` RNG, mean-of-slice-grads,
+    optimizer once with the step key, NO amp guard (the host loop
+    applies none) — but as one compiled compute executable dispatched K
+    times plus one compiled optimizer executable. Dispatches are
+    futures: slice k+1's host feed-slicing + dispatch overlaps slice
+    k's device work, and grad accumulation chains on-device."""
+
+    def __init__(self, program, block, accum_k: int, fetch_names,
+                 updated_names, check_nan):
+        # amp_cfg None: parity with the host accumulation loop
+        super().__init__(program, block, None, check_nan)
+        self.accum_k = int(accum_k)
+        self.fetch_names = list(fetch_names)
+        self.updated_names = list(updated_names)
+        self.compute_ops = [op for op in self.ops
+                            if op.attr("op_role", "forward")
+                            != "optimize"]
+        self.opt_ops = [op for op in self.ops
+                        if op.attr("op_role", "forward") == "optimize"]
+        self.grad_names = sorted({
+            n for op in self.opt_ops for slot in op.input_slots()
+            for n in op.input(slot) if n.endswith("@GRAD")})
+
+    def build(self, params_sig, feed_sig, key_sig) -> None:
+        if not self.opt_ops or not self.grad_names:
+            raise NotImplementedError(
+                "no optimize phase / grads to accumulate")
+        from .selected_rows import is_selected_rows  # noqa: F401
+        k = self.accum_k
+        # dense slice signatures (trace_step validated divisibility)
+        slice_sig = {n: jax.ShapeDtypeStruct(
+            (s.shape[0] // k,) + tuple(s.shape[1:]), s.dtype)
+            for n, s in feed_sig.items()}
+        c_writes: set = set()
+        for op in self.compute_ops:
+            c_writes.update(op_writes(op))
+        opt_reads: List[str] = []
+        opt_writes: set = set()
+        for op in self.opt_ops:
+            for n in op_reads(op):
+                if n not in opt_writes and n not in opt_reads:
+                    opt_reads.append(n)
+            opt_writes.update(op_writes(op))
+        keep = set(self.fetch_names) | set(self.updated_names)
+        self._compute_outs = sorted(
+            c_writes & (set(self.grad_names) | set(opt_reads) | keep))
+        self._opt_outs = sorted(opt_writes & keep)
+        self._opt_reads = opt_reads
+        captured_c: Dict[str, Any] = {}
+
+        def f_compute(params, feed_slice, key):
+            env = dict(params)
+            env.update(feed_slice)
+            checks: List = []
+            self._run_collecting(self.compute_ops, env, _RngCtx(key),
+                                 checks, use_amp=False)
+            captured_c["labels"] = [(t, n) for t, n, _ in checks]
+            outs = {n: env[n] for n in self._compute_outs if n in env}
+            return outs, tuple(fl for _, _, fl in checks)
+
+        outs_sig, _ = jax.eval_shape(f_compute, params_sig, slice_sig,
+                                     key_sig)
+        self._compute_labels = list(captured_c.get("labels", ()))
+        self._compute_jfn = jax.jit(f_compute)
+        captured_o: Dict[str, Any] = {}
+
+        def f_opt(ins, key):
+            env = dict(ins)
+            checks: List = []
+            self._run_collecting(self.opt_ops, env, _RngCtx(key),
+                                 checks, use_amp=False)
+            captured_o["labels"] = [(t, n) for t, n, _ in checks]
+            outs = {n: env[n] for n in self._opt_outs if n in env}
+            return outs, tuple(fl for _, _, fl in checks)
+
+        opt_ins_sig = {}
+        for n in opt_reads:
+            if n in outs_sig:
+                opt_ins_sig[n] = outs_sig[n]
+            elif n in params_sig:
+                opt_ins_sig[n] = params_sig[n]
+            elif n in slice_sig:
+                opt_ins_sig[n] = slice_sig[n]
+        jax.eval_shape(f_opt, opt_ins_sig, key_sig)
+        self._opt_labels = list(captured_o.get("labels", ()))
+        self._opt_jfn = jax.jit(f_opt)
+        # one label entry per flag in dispatch order: K compute slices
+        # then the optimizer
+        self.labels = self._compute_labels * self.accum_k \
+            + self._opt_labels
+
+    def __call__(self, donated_params, const_params, feeds, key):
+        from .selected_rows import SelectedRows, is_selected_rows
+        params = dict(const_params)
+        params.update(donated_params)
+        k = self.accum_k
+        t_step = time.perf_counter()
+        spans: List[dict] = []
+        flags_all: List = []
+        dispatch_ms = 0.0
+        g_acc: Dict[str, Any] = {}
+        outs = {}
+        sl = {}
+        for i in range(k):
+            sl = {}
+            for n, arr in feeds.items():
+                sz = arr.shape[0] // k
+                sl[n] = arr[i * sz:(i + 1) * sz]
+            t0 = time.perf_counter()
+            outs, flags = self._compute_jfn(
+                params, sl, jax.random.fold_in(key, i))
+            t1 = time.perf_counter()
+            dispatch_ms += (t1 - t0) * 1e3
+            spans.append({"phase": 0, "micro_batch": i,
+                          "ops": len(self.compute_ops),
+                          "t0_ms": round((t0 - t_step) * 1e3, 3),
+                          "dur_ms": round((t1 - t0) * 1e3, 3)})
+            flags_all.extend(flags)
+            for n in self.grad_names:
+                g = outs.get(n)
+                if g is None:
+                    continue
+                prev = g_acc.get(n)
+                if prev is None:
+                    g_acc[n] = g
+                elif is_selected_rows(g):
+                    g_acc[n] = SelectedRows(
+                        jnp.concatenate([prev.rows, g.rows]),
+                        jnp.concatenate([prev.values, g.values]),
+                        g.height)
+                else:
+                    g_acc[n] = prev + g
+        inv = 1.0 / k
+        g_avg = {}
+        for n, g in g_acc.items():
+            g_avg[n] = g.map_values(
+                lambda v: (v * inv).astype(v.dtype)) \
+                if is_selected_rows(g) else g * inv
+        opt_ins = {}
+        for n in self._opt_reads:
+            if n in g_avg:
+                opt_ins[n] = g_avg[n]
+            elif n in outs:
+                opt_ins[n] = outs[n]
+            elif n in params:
+                opt_ins[n] = params[n]
+            elif n in sl:
+                opt_ins[n] = sl[n]
+        t0 = time.perf_counter()
+        opt_outs, opt_flags = self._opt_jfn(opt_ins, key)
+        t1 = time.perf_counter()
+        dispatch_ms += (t1 - t0) * 1e3
+        spans.append({"phase": 1, "ops": len(self.opt_ops),
+                      "t0_ms": round((t0 - t_step) * 1e3, 3),
+                      "dur_ms": round((t1 - t0) * 1e3, 3)})
+        flags_all.extend(opt_flags)
+        window_ms = (time.perf_counter() - t_step) * 1e3
+        env = dict(outs)
+        env.update(g_avg)
+        env.update(opt_outs)
+        fetches = []
+        for n in self.fetch_names:
+            if n not in env:
+                raise KeyError(
+                    f"fetch target {n!r} was not produced by the "
+                    f"program")
+            fetches.append(env[n])
+        updated = {n: env[n] for n in self.updated_names if n in env}
+        nan_flags = jnp.stack([jnp.asarray(f) for f in flags_all]) \
+            if flags_all else ()
+        # host-side duty cycle of the accumulation window: 1.0 means
+        # micro-batch dispatches issued back-to-back with no host stall
+        fill = min(1.0, dispatch_ms / window_ms) if window_ms > 0 \
+            else 0.0
+        self.last_stats = {"micro_batches": k,
+                           "pipeline_fill_frac": round(fill, 4),
+                           "lane_idle_ms": 0.0,
+                           "spans": spans}
+        return tuple(fetches), updated, nan_flags
+
+
+# ---------------------------------------------------------------------------
+# entry point (called from engine.trace_step after phase-1 discovery)
+# ---------------------------------------------------------------------------
+
+def build_scheduled_step(program, block, params_sig, feed_sig,
+                         fetch_names, avail, updated_names, amp_cfg,
+                         accum_k, check_nan, fetch_lod_box,
+                         uses_rng=True):
+    """Build a scheduler-backed TracedStep, or None when the program is
+    not eligible (the caller's whole-block jit is the fallback).
+    Never raises: any build/validation failure means "not schedulable",
+    not "broken program" — the standard path will surface real errors.
+    """
+    from .engine import TracedStep
+    ops = list(block.ops)
+    try:
+        if any(_has_sub_block(op) for op in ops):
+            return None
+        env_sig = dict(params_sig)
+        env_sig.update(feed_sig)
+        key_sig = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        if accum_k > 1:
+            sched: Any = PipelinedAccumStep(
+                program, block, accum_k, fetch_names, updated_names,
+                check_nan)
+            sched.build(dict(params_sig), dict(feed_sig), key_sig)
+        else:
+            phases = partition_block(ops, fetch_names, updated_names)
+            if sum(len(p) for p in phases) <= 1:
+                # one island == the whole-block jit, which also gets
+                # buffer donation; nothing to schedule
+                return None
+            sched = ScheduledStep(program, block, phases, fetch_names,
+                                  updated_names, amp_cfg, check_nan)
+            sched.build(env_sig, key_sig)
+    except Exception:
+        return None
+    ts = TracedStep(sched, [], list(avail), sorted(feed_sig),
+                    list(fetch_names), list(updated_names),
+                    fetch_lod_box, uses_rng,
+                    nan_check_labels=sched.labels)
+    ts.op_sched = sched
+    return ts

@@ -188,7 +188,9 @@ def record_step(rec: dict) -> None:
                           ("trace_ms", "pt_step_trace_seconds"),
                           ("dispatch_ms", "pt_step_dispatch_seconds"),
                           ("fetch_ms", "pt_step_fetch_seconds"),
-                          ("total_ms", "pt_step_total_seconds")):
+                          ("total_ms", "pt_step_total_seconds"),
+                          ("lane_idle_ms",
+                           "pt_step_lane_idle_seconds")):
             v = phases.get(key)
             if v is not None:
                 h = reg.get(name)
@@ -272,7 +274,7 @@ def summarize_dumps(directory: Optional[str] = None,
                  if r.get("step") is not None]
         phases: Dict[str, float] = {}
         for key in ("feed_ms", "trace_ms", "dispatch_ms", "fetch_ms",
-                    "total_ms"):
+                    "total_ms", "lane_idle_ms"):
             vals = [r["phases"][key] for r in recs
                     if r.get("phases", {}).get(key) is not None]
             if vals:

@@ -1,0 +1,391 @@
+"""Programmable operator scheduler (core/scheduler.py,
+FLAGS_op_scheduler; docs/SCHEDULING.md).
+
+The scheduler's contract is *numerical identity* with the whole-block
+jit: per-op RNG keys fold op uids (not positions) into the step key, and
+islands partition the ops, so splitting the block must not change a
+single bit of any loss or parameter. These tests assert exactly that —
+bit-identical losses on an MLP-with-dropout and a transformer-style
+block, the grad-accum pipeline matching the host accumulation loop,
+partition independence against analysis.def_use, and determinism under
+fixed seeds.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.engine import Engine
+from paddle_tpu.core.flags import set_flags
+from paddle_tpu.core.scope import Scope
+
+
+@pytest.fixture(autouse=True)
+def _reset_flags():
+    yield
+    set_flags({"FLAGS_op_scheduler": False})
+
+
+def _run_steps(build_fn, feed, fetch, steps=4, scheduler=False,
+               accum=None, seed=7):
+    """Fresh program/scope/engine, `steps` runs, returns (losses,
+    params, engine)."""
+    set_flags({"FLAGS_op_scheduler": scheduler})
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        loss = build_fn()
+    scope = Scope()
+    exe = fluid.Executor()
+    losses = []
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        if accum:
+            bs = fluid.BuildStrategy()
+            bs.gradient_accumulation_steps = accum
+            prog = fluid.CompiledProgram(main, build_strategy=bs)
+            for _ in range(steps):
+                out = exe.run(prog, feed=feed, fetch_list=[loss.name])
+                losses.append(float(np.asarray(out[0])))
+        else:
+            eng = Engine()
+            for _ in range(steps):
+                out = eng.run(main, scope, None, feed, [loss.name])
+                losses.append(float(np.asarray(out[0])))
+        params = {
+            n: np.array(scope.var(n).get_tensor()._array)
+            for n in sorted(main.global_block().vars)
+            if main.global_block().vars[n].persistable
+            and scope.find_var(n) is not None
+            and scope.find_var(n).is_initialized()
+            and hasattr(scope.var(n).get_tensor(), "_array")}
+    eng_obj = exe._engine if accum else eng
+    return losses, params, eng_obj
+
+
+def _mlp_dropout():
+    x = layers.data(name="x", shape=[64], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="int64")
+    h = layers.fc(x, size=48, act="relu")
+    h = layers.dropout(h, dropout_prob=0.3)
+    pred = layers.fc(h, size=10, act="softmax")
+    loss = layers.mean(layers.cross_entropy(input=pred, label=y))
+    fluid.optimizer.AdamOptimizer(learning_rate=1e-2).minimize(loss)
+    return loss
+
+
+def _transformer_block():
+    """Self-attention + residual + layer_norm + dropout: the headline
+    bench's op population in miniature (matmul/softmax/layer_norm with
+    params, Adam backward + per-param optimizer islands)."""
+    x = layers.data(name="x", shape=[8, 32], dtype="float32")
+    q = layers.matmul(x, x, transpose_y=True)
+    attn = layers.softmax(q)
+    attn = layers.dropout(attn, dropout_prob=0.1)
+    ctx = layers.matmul(attn, x)
+    h = layers.elementwise_add(x, ctx)
+    h = layers.layer_norm(h, begin_norm_axis=2)
+    loss = layers.mean(layers.elementwise_mul(h, h))
+    fluid.optimizer.AdamOptimizer(learning_rate=1e-3).minimize(loss)
+    return loss
+
+
+def _mlp_feed(batch=16):
+    rng = np.random.RandomState(0)
+    return {"x": rng.rand(batch, 64).astype(np.float32),
+            "y": rng.randint(0, 10, (batch, 1)).astype(np.int64)}
+
+
+def _tf_feed(batch=4):
+    rng = np.random.RandomState(1)
+    return {"x": rng.rand(batch, 8, 32).astype(np.float32)}
+
+
+# ---------------------------------------------------------------------------
+# numerical parity (bit-identical)
+# ---------------------------------------------------------------------------
+
+def test_parity_mnist_mlp_dropout():
+    feed = _mlp_feed()
+    l_off, p_off, _ = _run_steps(_mlp_dropout, feed, None)
+    l_on, p_on, eng = _run_steps(_mlp_dropout, feed, None,
+                                 scheduler=True)
+    assert l_on == l_off          # bit-identical losses, dropout live
+    assert eng.counters["scheduled_steps"] > 0
+    assert eng.counters["islands_concurrent"] >= 2
+    assert set(p_on) == set(p_off)
+    for n in p_off:
+        np.testing.assert_array_equal(p_on[n], p_off[n], err_msg=n)
+
+
+def test_parity_transformer_block():
+    feed = _tf_feed()
+    l_off, p_off, _ = _run_steps(_transformer_block, feed, None)
+    l_on, p_on, eng = _run_steps(_transformer_block, feed, None,
+                                 scheduler=True)
+    assert l_on == l_off
+    assert eng.counters["scheduled_steps"] > 0
+    for n in p_off:
+        np.testing.assert_array_equal(p_on[n], p_off[n], err_msg=n)
+
+
+def test_determinism_fixed_seed():
+    feed = _mlp_feed()
+    l_a, p_a, _ = _run_steps(_mlp_dropout, feed, None, scheduler=True)
+    l_b, p_b, _ = _run_steps(_mlp_dropout, feed, None, scheduler=True)
+    assert l_a == l_b
+    for n in p_a:
+        np.testing.assert_array_equal(p_a[n], p_b[n], err_msg=n)
+
+
+# ---------------------------------------------------------------------------
+# grad-accum micro-batch pipeline vs the host loop
+# ---------------------------------------------------------------------------
+
+def test_pipeline_grad_accum_parity():
+    """Same slicing, same fold_in(key, i) RNG, same mean-of-slice-grads
+    math as engine._run_accumulated. Tolerance is ulp-level (not exact):
+    the host loop compiles all K slices into ONE XLA program while the
+    pipeline compiles one executable per slice, so fusion boundaries
+    (and hence FMA contraction) can differ."""
+    feed = _mlp_feed(batch=16)
+    l_off, p_off, _ = _run_steps(_mlp_dropout, feed, None, accum=4)
+    l_on, p_on, eng = _run_steps(_mlp_dropout, feed, None, accum=4,
+                                 scheduler=True)
+    np.testing.assert_allclose(l_on, l_off, rtol=1e-6)
+    assert eng.counters["scheduled_steps"] > 0
+    assert eng.counters["pipeline_fill_frac"] > 0
+    for n in p_off:
+        np.testing.assert_allclose(p_on[n], p_off[n], rtol=1e-6,
+                                   atol=1e-7, err_msg=n)
+
+
+def test_pipeline_matches_single_big_batch_params():
+    """The accum contract (mean-of-slice-grads == full-batch grad for
+    mean losses) must survive the pipeline: the PARAMETER trajectory
+    tracks the big-batch run to fp32 tolerance (the fetched loss is the
+    last slice's — a different quantity — so params are the invariant;
+    not bit-identical: the slice-mean reduction order differs)."""
+    def no_dropout():
+        x = layers.data(name="x", shape=[64], dtype="float32")
+        y = layers.data(name="y", shape=[1], dtype="int64")
+        h = layers.fc(x, size=48, act="relu")
+        pred = layers.fc(h, size=10, act="softmax")
+        loss = layers.mean(layers.cross_entropy(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        return loss
+
+    feed = _mlp_feed(batch=16)
+    _, p_big, _ = _run_steps(no_dropout, feed, None)
+    _, p_pipe, _ = _run_steps(no_dropout, feed, None, accum=4,
+                              scheduler=True)
+    for n in p_big:
+        np.testing.assert_allclose(p_pipe[n], p_big[n], rtol=2e-4,
+                                   atol=1e-6, err_msg=n)
+
+
+# ---------------------------------------------------------------------------
+# partition correctness against analysis.def_use
+# ---------------------------------------------------------------------------
+
+def _two_chain_program():
+    """Two data-independent forward chains sharing only the feed."""
+    x = layers.data(name="x", shape=[16], dtype="float32")
+    a = layers.fc(x, size=8, act="relu")
+    la = layers.mean(a)
+    b = layers.fc(x, size=8, act="tanh")
+    lb = layers.mean(b)
+    return la, lb
+
+
+def test_partition_matches_def_use_graph():
+    from paddle_tpu.analysis.def_use import DefUseGraph
+    from paddle_tpu.core.scheduler import partition_block
+
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        la, lb = _two_chain_program()
+    ops = list(main.global_block().ops)
+    phases = partition_block(ops, [la.name, lb.name], [])
+    islands = [isl for phase in phases for isl in phase]
+    # partition property: every op in exactly one island
+    all_idx = sorted(i for isl in islands for i in isl.indices)
+    assert all_idx == list(range(len(ops)))
+    # the two chains are data-independent -> more than one island
+    assert len(islands) >= 2
+    # independence within a phase, checked against the def-use graph:
+    # no name defined (written) in one island is used (read) by a
+    # same-phase sibling
+    graph = DefUseGraph(main)
+    for phase in phases:
+        for isl in phase:
+            for other in phase:
+                if other is isl:
+                    continue
+                for name in isl.writes:
+                    use_idx = {s.op_idx for s in graph.uses.get(
+                        name, ()) if s.block_idx == 0}
+                    assert not (use_idx & set(other.indices)), (
+                        f"{name} written by island {isl.indices} and "
+                        f"read by same-phase island {other.indices}")
+
+
+def test_two_chain_end_to_end_parity():
+    feed = {"x": np.random.RandomState(3).rand(4, 16)
+            .astype(np.float32)}
+
+    def run(flag):
+        set_flags({"FLAGS_op_scheduler": flag})
+        fluid.framework.unique_name.reset()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            la, lb = _two_chain_program()
+        scope = Scope()
+        with fluid.scope_guard(scope):
+            fluid.Executor().run(startup)
+            eng = Engine()
+            out = eng.run(main, scope, None, feed, [la.name, lb.name])
+        return [float(np.asarray(v)) for v in out], eng
+
+    off, _ = run(False)
+    on, eng = run(True)
+    assert on == off
+    assert eng.counters["scheduled_steps"] > 0
+    assert eng.counters["islands_concurrent"] >= 2
+
+
+# ---------------------------------------------------------------------------
+# gating / fallbacks / caching
+# ---------------------------------------------------------------------------
+
+def test_iterations_gt_one_falls_back():
+    """num_iteration_per_run compiles K steps into one scan — the
+    scheduler steps aside (scheduled_steps stays 0) and results match
+    the default path."""
+    set_flags({"FLAGS_op_scheduler": True})
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 7
+    startup.random_seed = 7
+    with fluid.program_guard(main, startup):
+        loss = _mlp_dropout()
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        fluid.Executor().run(startup)
+        eng = Engine()
+        out = eng.run(main, scope, None, _mlp_feed(), [loss.name],
+                      iterations=2)
+        assert np.isfinite(float(np.asarray(out[0])))
+    assert eng.counters["scheduled_steps"] == 0
+
+
+def test_flag_is_in_cache_key():
+    """Toggling FLAGS_op_scheduler mid-session must retrace (both the
+    slow-path cache and the fast path key on the flag), and both traces
+    agree numerically."""
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 7
+    startup.random_seed = 7
+    with fluid.program_guard(main, startup):
+        loss = _mlp_dropout()
+    scope = Scope()
+    feed = _mlp_feed()
+    with fluid.scope_guard(scope):
+        fluid.Executor().run(startup)
+        eng = Engine()
+        set_flags({"FLAGS_op_scheduler": False})
+        a = float(np.asarray(
+            eng.run(main, scope, None, feed, [loss.name])[0]))
+        t_off = eng.counters["traces"]
+        set_flags({"FLAGS_op_scheduler": True})
+        b = float(np.asarray(
+            eng.run(main, scope, None, feed, [loss.name])[0]))
+        assert eng.counters["traces"] == t_off + 1
+        assert eng.counters["scheduled_steps"] == 1
+    # same step index, same seed, different compiled path: identical
+    # except the flag-off step already advanced the scope RNG state —
+    # so only check finiteness here; parity is covered above with
+    # fresh scopes
+    assert np.isfinite(a) and np.isfinite(b)
+
+
+def test_check_nan_inf_composes():
+    """NaN checking threads through per-island flag stacking: a feed of
+    NaNs must trip EnforceNotMet naming an op, same as the default
+    path."""
+    from paddle_tpu.core.engine import EnforceNotMet
+    set_flags({"FLAGS_op_scheduler": True, "FLAGS_check_nan_inf": True})
+    try:
+        fluid.framework.unique_name.reset()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            loss = _mlp_dropout()
+        scope = Scope()
+        feed = _mlp_feed()
+        feed["x"] = np.full_like(feed["x"], np.nan)
+        with fluid.scope_guard(scope):
+            fluid.Executor().run(startup)
+            eng = Engine()
+            with pytest.raises(EnforceNotMet, match="NaN or Inf"):
+                eng.run(main, scope, None, feed, [loss.name])
+        assert eng.counters["scheduled_steps"] > 0
+    finally:
+        set_flags({"FLAGS_check_nan_inf": False})
+
+
+# ---------------------------------------------------------------------------
+# observability plumbing
+# ---------------------------------------------------------------------------
+
+def test_lane_spans_reach_flight_recorder():
+    from paddle_tpu.observability import recorder
+
+    set_flags({"FLAGS_op_scheduler": True})
+    recorder.enable(True)
+    try:
+        recorder.flight_recorder().clear()
+        fluid.framework.unique_name.reset()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            la, lb = _two_chain_program()
+        scope = Scope()
+        feed = {"x": np.random.RandomState(3).rand(4, 16)
+                .astype(np.float32)}
+        with fluid.scope_guard(scope):
+            fluid.Executor().run(startup)
+            eng = Engine()
+            for _ in range(2):
+                eng.run(main, scope, None, feed, [la.name, lb.name])
+        recs = recorder.flight_recorder().snapshot()
+        sched_recs = [r for r in recs if r.get("lanes")]
+        assert sched_recs, "no step record carried lane spans"
+        span = sched_recs[-1]["lanes"][0]
+        assert {"phase", "ops", "lane", "t0_ms", "dur_ms"} <= set(span)
+        assert "lane_idle_ms" in sched_recs[-1]["phases"]
+    finally:
+        recorder.enable(False)
+        recorder.flight_recorder().clear()
+
+
+def test_gauges_exported_via_registry():
+    from paddle_tpu.observability import metrics
+
+    set_flags({"FLAGS_op_scheduler": True})
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 7
+    startup.random_seed = 7
+    with fluid.program_guard(main, startup):
+        loss = _mlp_dropout()
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        fluid.Executor().run(startup)
+        eng = Engine()
+        eng.run(main, scope, None, _mlp_feed(), [loss.name])
+    fams = {f.name: f for f in metrics._engine_families()}
+    assert "pt_engine_islands_concurrent" in fams
+    assert "pt_engine_scheduled_steps_total" in fams

@@ -11,7 +11,7 @@ CLI::
 
     python tools/step_overhead_bench.py [--json] [--async-dispatch]
         [--batch N] [--steps N] [--threshold-ms X] [--telemetry]
-        [--compare-telemetry]
+        [--compare-telemetry] [--compare-scheduler]
 
 exits non-zero when measured host overhead exceeds ``--threshold-ms``
 (the CI regression gate). ``overhead_report()`` is imported by bench.py
@@ -54,6 +54,23 @@ def overhead_report(name, sync_ms, sps, stats=None, counters=None):
                  f"sig_builds={counters.get('sig_builds', 0)} "
                  f"traces={counters.get('traces', 0)}")
     return line
+
+
+def scheduler_overlap_report(sched):
+    """(dict, '#'-line) for the bench JSON tail from a scheduler A/B
+    probe result ({sync_ms_off, sync_ms_on, counters...}); (None, None)
+    when the probe did not run or errored before measuring."""
+    if not sched or "sync_ms_on" not in sched:
+        return (sched or None), None
+    off, on = sched["sync_ms_off"], sched["sync_ms_on"]
+    pct = (1 - on / off) * 100 if off else 0.0
+    c = sched.get("counters", {})
+    line = (f"# scheduler_overlap: sync {off:.1f} -> {on:.1f} ms/step "
+            f"({pct:+.0f}% vs scheduler-off); islands_concurrent="
+            f"{c.get('islands_concurrent', 0)} pipeline_fill_frac="
+            f"{c.get('pipeline_fill_frac', 0)} lane_idle="
+            f"{c.get('lane_idle_ms', 0):.1f} ms")
+    return sched, line
 
 
 def _build_model(batch):
@@ -154,6 +171,11 @@ def main(argv=None):
     p.add_argument("--compare-telemetry", action="store_true",
                    help="measure disabled then enabled, report both "
                         "and the enabled-path delta")
+    p.add_argument("--compare-scheduler", action="store_true",
+                   help="A/B FLAGS_op_scheduler: measure off (the "
+                        "default path, proving its overhead is "
+                        "unchanged) then on; --threshold-ms gates "
+                        "BOTH measurements")
     p.add_argument("--json", action="store_true")
     args = p.parse_args(argv)
 
@@ -176,6 +198,38 @@ def main(argv=None):
                                  ("sync_ms", "pipelined_ms",
                                   "host_overhead_ms", "steps_per_sec")}
             r["telemetry_delta_ms"] = (r_on["sync_ms"] - r["sync_ms"])
+        if args.compare_scheduler:
+            # A/B the op scheduler on a FRESH engine/model (flag-aware
+            # cache keys would retrace anyway; a fresh scope keeps the
+            # two measurements starting from identical params)
+            set_flags({"FLAGS_op_scheduler": True})
+            try:
+                eng2, prog2, scope2, feed2, fetch2 = \
+                    _build_model(args.batch)
+                with fluid.scope_guard(scope2):
+                    r_s = measure_step_overhead(
+                        eng2, prog2, scope2, feed2, fetch2,
+                        steps=args.steps)
+                r["scheduler_on"] = {
+                    **{k: r_s[k] for k in
+                       ("sync_ms", "pipelined_ms", "host_overhead_ms",
+                        "steps_per_sec")},
+                    # gauges read absolute (a steady-state delta of a
+                    # gauge is always 0); cumulative keys as deltas
+                    "counters": {
+                        "scheduled_steps":
+                            r_s["counters"].get("scheduled_steps", 0),
+                        "islands_concurrent":
+                            eng2.counters["islands_concurrent"],
+                        "pipeline_fill_frac":
+                            eng2.counters["pipeline_fill_frac"],
+                        "lane_idle_ms": round(
+                            r_s["counters"].get("lane_idle_ms", 0.0),
+                            2)}}
+                r["scheduler_delta_ms"] = (r_s["sync_ms"]
+                                           - r["sync_ms"])
+            finally:
+                set_flags({"FLAGS_op_scheduler": False})
     r["async_dispatch"] = bool(args.async_dispatch)
     r["telemetry"] = bool(args.telemetry)
     if args.json:
@@ -189,6 +243,13 @@ def main(argv=None):
                   f"{r['telemetry_on']['sync_ms']:.2f} ms/step "
                   f"(delta {r['telemetry_delta_ms']:+.3f} ms vs "
                   f"disabled {r['sync_ms']:.2f})")
+        if "scheduler_on" in r:
+            _, line = scheduler_overlap_report(
+                {"sync_ms_off": r["sync_ms"],
+                 "sync_ms_on": r["scheduler_on"]["sync_ms"],
+                 "counters": r["scheduler_on"]["counters"]})
+            if line:
+                print(line)
     bad = []
     if r["counters"].get("traces"):
         bad.append(f"steady state re-traced "
@@ -197,6 +258,12 @@ def main(argv=None):
             r["host_overhead_ms"] > args.threshold_ms:
         bad.append(f"host overhead {r['host_overhead_ms']:.1f} ms > "
                    f"threshold {args.threshold_ms:.1f} ms")
+    if args.threshold_ms is not None and "scheduler_on" in r and \
+            r["scheduler_on"]["host_overhead_ms"] > args.threshold_ms:
+        bad.append(
+            f"scheduler-on host overhead "
+            f"{r['scheduler_on']['host_overhead_ms']:.1f} ms > "
+            f"threshold {args.threshold_ms:.1f} ms")
     if bad:
         print("REGRESSION: " + "; ".join(bad), file=sys.stderr)
         return 1
