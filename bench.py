@@ -256,6 +256,52 @@ def _probe_scheduler(eng, prog, scope, feed, fetch, sync_off_ms):
     return out
 
 
+def _probe_guard(eng, prog, scope, feed, fetch, sync_off_ms):
+    """A/B the stability guard (FLAGS_stability_guard,
+    docs/STABILITY.md) on the already-built transformer: the verdict +
+    gate compile into the traced step, so the promised cost is one
+    fused reduction plus elementwise selects — this probe measures the
+    realized sync-step delta and the host-side controller overhead."""
+    import jax
+    from paddle_tpu.core.flags import FLAGS, set_flags
+    prev = bool(FLAGS.stability_guard)
+    out = {"sync_ms_off": round(sync_off_ms, 2)}
+
+    def _np(o):
+        return np.asarray(o.array if hasattr(o, "array") else o)
+
+    try:
+        set_flags({"FLAGS_stability_guard": True})
+        c0 = {k: eng.counters.get(k, 0)
+              for k in ("runs", "guard_overhead_ms",
+                        "ghost_snapshots", "anomalies")}
+        batch = {k: jax.device_put(np.asarray(v))
+                 for k, v in feed.items()}
+        for _ in range(3):
+            o = eng.run(prog, scope, None, batch, fetch,
+                        return_numpy=False)
+        float(_np(o[0]))
+        ts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            float(_np(eng.run(prog, scope, None, batch, fetch,
+                              return_numpy=False)[0]))
+            ts.append(time.perf_counter() - t0)
+        out["sync_ms_on"] = round(sorted(ts)[len(ts) // 2] * 1e3, 2)
+        n = max(1, eng.counters["runs"] - c0["runs"])
+        out["guard_host_ms_per_step"] = round(
+            (eng.counters["guard_overhead_ms"]
+             - c0["guard_overhead_ms"]) / n, 4)
+        out["ghost_snapshots"] = (eng.counters["ghost_snapshots"]
+                                  - c0["ghost_snapshots"])
+        out["anomalies"] = eng.counters["anomalies"] - c0["anomalies"]
+    except Exception as exc:   # accounting only; never fail the bench
+        out["error"] = f"{type(exc).__name__}: {exc}"[:200]
+    finally:
+        set_flags({"FLAGS_stability_guard": prev})
+    return out
+
+
 def bench_transformer(batch=BATCH, seq=None, measure_ckpt=False):
     import paddle_tpu as fluid
     from paddle_tpu import models
@@ -301,6 +347,9 @@ def bench_transformer(batch=BATCH, seq=None, measure_ckpt=False):
             # scheduler_overlap JSON tail (ROADMAP open item 4)
             stats = stats or {}
             stats["scheduler"] = _probe_scheduler(
+                eng, main_prog, scope, feed, [cost.name], sync_ms)
+            # guard-on sync A/B for the stability JSON tail
+            stats["stability"] = _probe_guard(
                 eng, main_prog, scope, feed, [cost.name], sync_ms)
     return sps * batch * s_trg, sps, traj, sync_ms, stats
 
@@ -700,6 +749,13 @@ def main():
             (stats or {}).get("scheduler"))
     except Exception:
         pass   # accounting only; never fail the bench on it
+    stab, stab_line = {}, None
+    try:
+        from tools.step_overhead_bench import guard_overhead_report
+        stab, stab_line = guard_overhead_report(
+            (stats or {}).get("stability"))
+    except Exception:
+        pass   # accounting only; never fail the bench on it
     chaos, chaos_line = {}, None
     if os.environ.get("PT_BENCH_CHAOS"):
         # opt-in: spawns a 2-trainer PS job twice (clean + faulted),
@@ -729,6 +785,7 @@ def main():
         "vs_baseline": round(tokens_per_sec / V100_TOKENS_PER_SEC, 3),
         "comm_overlap": comm or None,
         "scheduler_overlap": sched or None,
+        "stability": stab or None,
         "chaos": chaos or None,
         "metrics": metrics_tail or None,
     }))
@@ -736,6 +793,8 @@ def main():
         print(comm_line, file=sys.stderr)
     if sched_line:
         print(sched_line, file=sys.stderr)
+    if stab_line:
+        print(stab_line, file=sys.stderr)
     if chaos_line:
         print(chaos_line, file=sys.stderr)
     print(f"# transformer: steps/s={sps:.2f} "

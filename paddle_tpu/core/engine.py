@@ -13,6 +13,8 @@ liveness replaces the eager-deletion GC.
 """
 from __future__ import annotations
 
+import os
+
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -441,6 +443,22 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
             if v.persistable:
                 persistable_all.add(name)
 
+    # stability guard (docs/STABILITY.md): the verdict + update gate
+    # compile INTO the step, its persistent state (EMA, loss scale)
+    # joins the donated inputs, and its outputs ride the updated dict —
+    # uniform across the whole-block, scheduler, islands and eager
+    # paths, so the host controller always reads one scope var
+    guard_plan = None
+    if FLAGS.stability_guard:
+        from ..stability import build_plan, ensure_state
+        guard_plan = build_plan(program, block_idx)
+        if guard_plan is not None:
+            ensure_state(scope, guard_plan)
+            for n in guard_plan.input_state_names():
+                if n not in avail:
+                    avail.append(n)
+            persistable_all.update(guard_plan.state_var_names())
+
     fetch_lod_box: Dict[str, list] = {}
     updated_box: List[str] = []
     uses_rng_box = [False]
@@ -609,6 +627,10 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
             nan_labels_box.extend((t, n) for t, n, _ in checks)
             nan_flags = jnp.stack([f for _, _, f in checks])
 
+        if guard_plan is not None:
+            from ..stability.guard import apply_in_trace
+            apply_in_trace(env, params, guard_plan, fetch_names,
+                           persistable_all)
         updated = sorted(n for n in env.written if n in persistable_all)
         updated_box.clear()
         updated_box.extend(updated)
@@ -666,10 +688,12 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
                 params.update(donated_params)
                 return step(params, feeds, key)
 
-            return TracedStep(_loop_fallback(eager_fn, iterations),
-                              [], avail, sorted(feed_sig),
-                              list(fetch_names), [], fetch_lod_box,
-                              True, nan_check_labels=nan_labels_box)
+            ts = TracedStep(_loop_fallback(eager_fn, iterations),
+                            [], avail, sorted(feed_sig),
+                            list(fetch_names), [], fetch_lod_box,
+                            True, nan_check_labels=nan_labels_box)
+            ts.guard_plan = guard_plan  # guard ran inside step()
+            return ts
 
         from .islands import IslandRunner
         opaque_names = set()
@@ -696,12 +720,24 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
         def islands_fn(donated_params, const_params, feeds, key):
             params = dict(const_params)
             params.update(donated_params)
-            return runner.step(params, feeds, key)
+            fetches, updated, nan_flags = runner.step(params, feeds,
+                                                      key)
+            if guard_plan is not None:
+                # islands ran outside one trace: guard from the step's
+                # outputs (grads consumed inside a compiled segment
+                # degrade the spike detector, never the finite check
+                # on the loss)
+                from ..stability.guard import apply_post
+                fetches, updated = apply_post(
+                    guard_plan, fetches, updated, params, fetch_names)
+            return fetches, updated, nan_flags
 
-        return TracedStep(_loop_fallback(islands_fn, iterations),
-                          [], avail, sorted(feed_sig),
-                          list(fetch_names), [], fetch_lod_box, True,
-                          nan_check_labels=nan_labels_box)
+        ts = TracedStep(_loop_fallback(islands_fn, iterations),
+                        [], avail, sorted(feed_sig),
+                        list(fetch_names), [], fetch_lod_box, True,
+                        nan_check_labels=nan_labels_box)
+        ts.guard_plan = guard_plan
+        return ts
     updated_names = list(updated_box)
     if (FLAGS.op_scheduler and mesh is None and iterations == 1
             and not feed_lods):
@@ -715,9 +751,10 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
         ts = build_scheduled_step(
             program, block, params_sig, feed_sig, fetch_names, avail,
             updated_names, amp_cfg, accum_k, check_nan, fetch_lod_box,
-            uses_rng=uses_rng_box[0])
+            uses_rng=uses_rng_box[0], guard_plan=guard_plan)
         if ts is not None:
             ts.comm_stats = comm_stats
+            ts.guard_plan = guard_plan
             return ts
     donated = [n for n in avail if n in updated_names]
     const = [n for n in avail if n not in updated_names]
@@ -819,6 +856,7 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
                     fetch_lod_box, uses_rng_box[0],
                     nan_check_labels=nan_labels_box)
     ts.comm_stats = comm_stats
+    ts.guard_plan = guard_plan
     return ts
 
 
@@ -919,8 +957,22 @@ class Engine:
             # island width, grad-accum pipeline host duty cycle, and
             # cumulative same-phase lane idle time
             "scheduled_steps": 0, "islands_concurrent": 0,
-            "pipeline_fill_frac": 0.0, "lane_idle_ms": 0.0})
+            "pipeline_fill_frac": 0.0, "lane_idle_ms": 0.0,
+            # stability guard (paddle_tpu/stability,
+            # docs/STABILITY.md): anomaly verdicts handled, ghost
+            # snapshots captured + capture time, rollbacks performed,
+            # re-executed steps that tripped again, quantized-allreduce
+            # exact-bucket fallbacks, repro bundles written, host-side
+            # controller time
+            "anomalies": 0, "ghost_snapshots": 0, "ghost_ms": 0.0,
+            "rollbacks": 0, "rollback_reexec_failures": 0,
+            "quant_fallbacks": 0, "replay_bundles": 0,
+            "guard_aborts": 0,
+            "guard_overhead_ms": 0.0})
         _obs.register_engine(self)
+        # lazily built per-engine stability controller
+        # (FLAGS_stability_guard; paddle_tpu/stability/guard.py)
+        self._stability = None
         # feed names that are identical on every process under multihost
         # SPMD (shared tables, per-step constants) — globalized by
         # replication instead of batch-dim concatenation
@@ -1070,7 +1122,9 @@ class Engine:
                 float(FLAGS.allreduce_bucket_mb),
                 str(FLAGS.quantized_allreduce),
                 bool(FLAGS.sharded_weight_update),
-                bool(FLAGS.op_scheduler))
+                bool(FLAGS.op_scheduler),
+                bool(FLAGS.stability_guard),
+                os.environ.get("PT_STABILITY_POLICY", ""))
 
     def compiled_step(self, program, scope: Scope, feed, fetch_names,
                       block_idx: int = 0, iterations: int = 1):
@@ -1167,7 +1221,11 @@ class Engine:
                 float(FLAGS.allreduce_bucket_mb),
                 str(FLAGS.quantized_allreduce),
                 bool(FLAGS.sharded_weight_update),
-                bool(FLAGS.op_scheduler))
+                bool(FLAGS.op_scheduler),
+                # the guard's gate (and its policy's damping) is baked
+                # into the trace
+                bool(FLAGS.stability_guard),
+                os.environ.get("PT_STABILITY_POLICY", ""))
 
     def _fast_feed_arrays(self, entry: _FastPathEntry, feed):
         """Feed dict -> device arrays through the cached signature: no
@@ -1222,6 +1280,11 @@ class Engine:
             # injected preemption: kill this process at step N (the
             # supervised-restart path CI exercises without hardware)
             plan.on_step(self.counters["runs"])
+            # injected numeric anomaly (nan / grad_spike fault kinds):
+            # corrupt the feed so the stability guard's detection +
+            # recovery path is exercised end to end in chaos runs
+            if feed:
+                feed = plan.corrupt_feed(self.counters["runs"], feed)
         # ONE boolean gates all per-step telemetry (phase spans, flight
         # recorder); obs stays None on the cold path
         obs = None
@@ -1380,7 +1443,8 @@ class Engine:
 
     def _dispatch_inner(self, program, scope, traced, arrays,
                         donated_params, const_params, return_numpy,
-                        updated_vars=None, obs=None):
+                        updated_vars=None, obs=None,
+                        _guard_reexec=False):
         """Shared dispatch tail of fast and slow paths: RNG split,
         executable call, device-resident scope writeback, NaN-check
         surfacing (inline or deferred), fetch wrapping. Under
@@ -1445,6 +1509,38 @@ class Engine:
         self._last_updated = tuple(updated.values())
         async_defer = (bool(FLAGS.async_dispatch) and not return_numpy
                        and t0 is None)
+        guard_plan = getattr(traced, "guard_plan", None)
+        if guard_plan is not None:
+            _g0 = time.perf_counter()
+            ctl = self._stability
+            if ctl is None:
+                from ..stability import StabilityGuard
+                ctl = self._stability = StabilityGuard()
+            action = ctl.after_step(
+                self, program, scope, traced, arrays, fetches,
+                updated, rng_key, async_defer, obs=obs,
+                reexec=_guard_reexec)
+            self.counters["guard_overhead_ms"] += (
+                time.perf_counter() - _g0) * 1e3
+            if _obs.telemetry_active():
+                _obs.histogram(
+                    "pt_guard_overhead_seconds",
+                    "host-side stability-guard controller time per "
+                    "step (verdict read + policy + ghost capture)"
+                ).observe(time.perf_counter() - _g0)
+            if action == "reexecute":
+                # the scope now holds the restored ghost (params,
+                # optimizer state, loss scale, RNG); re-run THIS step
+                # from it — recursion depth is bounded to one by the
+                # controller's reexec handling
+                donated2 = {n: _scope_array(scope, n)
+                            for n in traced.donated_names}
+                const2 = {n: _scope_array(scope, n)
+                          for n in traced.const_names}
+                return self._dispatch_inner(
+                    program, scope, traced, arrays, donated2, const2,
+                    return_numpy, updated_vars, obs,
+                    _guard_reexec=True)
         rec = None
         if traced.nan_check_labels:
             if async_defer:

@@ -190,6 +190,19 @@ _define("flight_recorder_steps", 64, True,
         "flight-recorder ring capacity: per-step span records retained "
         "for the postmortem dump (watchdog trip, PT_FAULT_PLAN, sticky "
         "async error, SIGTERM); sized at first use")
+# training stability guard (paddle_tpu/stability, docs/STABILITY.md)
+_define("stability_guard", False, True,
+        "training stability guard (paddle_tpu/stability): fuse a "
+        "finite/overflow check over the loss and gradient tensors plus "
+        "an EMA grad-global-norm spike detector INTO the traced step, "
+        "so the anomaly verdict is one on-device scalar instead of "
+        "FLAGS_check_nan_inf's per-op host-visible flags. Anomalous "
+        "parameter/optimizer-state updates are gated on device; the "
+        "host-side policy (PT_STABILITY_POLICY: skip|clip|rescale|"
+        "rollback|abort per anomaly class) decides recovery — rollback "
+        "restores the in-memory ghost-snapshot ring captured every "
+        "PT_GHOST_EVERY steps and re-executes the step "
+        "(docs/STABILITY.md)")
 
 # -- subsumed flags: accepted, validated, no effect under XLA/PJRT ----------
 for _name, _default, _help in [

@@ -275,6 +275,12 @@ class _TraceBase:
         self.check_nan = check_nan
         self.labels: List[Tuple[str, str]] = []
         self.last_stats: Dict[str, Any] = {}
+        # stability guard epilogue (paddle_tpu/stability/guard.py):
+        # the scheduled step runs as many small executables, so the
+        # verdict + update gate run as ONE cached jitted epilogue over
+        # the step's final arrays instead of inside the (nonexistent)
+        # whole-block trace
+        self.guard_plan = None
 
     def _amp(self):
         if self.amp_cfg:
@@ -378,6 +384,14 @@ class ScheduledStep(_TraceBase):
         env: Dict[str, Any] = dict(const_params)
         env.update(donated_params)
         env.update(feeds)
+        guard_orig = None
+        if self.guard_plan is not None:
+            # pre-step values of everything the gate may revert, plus
+            # the guard's own input state
+            guard_orig = {n: env[n]
+                          for n in set(self.updated_names)
+                          | set(self.guard_plan.input_state_names())
+                          if n in env}
         t_step = time.perf_counter()
         spans: List[dict] = []
         flags_all: List = []
@@ -410,6 +424,10 @@ class ScheduledStep(_TraceBase):
                               "t0_ms": round((t0 - t_step) * 1e3, 3),
                               "dur_ms": round((t1 - t0) * 1e3, 3)})
         self._traced_once = True
+        if self.guard_plan is not None:
+            self.guard_plan.run_epilogue(env, guard_orig,
+                                         self.fetch_names,
+                                         self.updated_names)
         fetches = []
         for n in self.fetch_names:
             if n not in env:
@@ -592,6 +610,13 @@ class PipelinedAccumStep(_TraceBase):
         env = dict(outs)
         env.update(g_avg)
         env.update(opt_outs)
+        if self.guard_plan is not None:
+            # guard over the AVERAGED grads (same tensors the host
+            # accumulation loop's guard sees); pre-step values come
+            # from params
+            self.guard_plan.run_epilogue(env, params,
+                                         self.fetch_names,
+                                         self.updated_names)
         fetches = []
         for n in self.fetch_names:
             if n not in env:
@@ -620,7 +645,7 @@ class PipelinedAccumStep(_TraceBase):
 def build_scheduled_step(program, block, params_sig, feed_sig,
                          fetch_names, avail, updated_names, amp_cfg,
                          accum_k, check_nan, fetch_lod_box,
-                         uses_rng=True):
+                         uses_rng=True, guard_plan=None):
     """Build a scheduler-backed TracedStep, or None when the program is
     not eligible (the caller's whole-block jit is the fallback).
     Never raises: any build/validation failure means "not schedulable",
@@ -640,7 +665,14 @@ def build_scheduled_step(program, block, params_sig, feed_sig,
                 check_nan)
             sched.build(dict(params_sig), dict(feed_sig), key_sig)
         else:
-            phases = partition_block(ops, fetch_names, updated_names)
+            keep_names = list(fetch_names)
+            if guard_plan is not None:
+                # islands must EXPORT the watched gradients so the
+                # guard epilogue sees them even when producer and
+                # consumer share an island
+                keep_names += [g for g in guard_plan.grad_names
+                               if g not in keep_names]
+            phases = partition_block(ops, keep_names, updated_names)
             if sum(len(p) for p in phases) <= 1:
                 # one island == the whole-block jit, which also gets
                 # buffer donation; nothing to schedule
@@ -648,6 +680,7 @@ def build_scheduled_step(program, block, params_sig, feed_sig,
             sched = ScheduledStep(program, block, phases, fetch_names,
                                   updated_names, amp_cfg, check_nan)
             sched.build(env_sig, key_sig)
+        sched.guard_plan = guard_plan
     except Exception:
         return None
     ts = TracedStep(sched, [], list(avail), sorted(feed_sig),

@@ -18,7 +18,15 @@ Engine step loop:
 * ``kill_at_step`` — the process calls ``os._exit(KILL_EXIT_CODE)``
   when the engine dispatches step N (a preemption), limited to the
   first ``kill_attempts`` incarnations so a supervised restart is not
-  re-killed forever.
+  re-killed forever;
+* ``nan`` — probability a step's feed (engine) or gradient bucket
+  (dygraph allreduce) gets a NaN planted in its first element — the
+  numeric-anomaly case the stability guard (docs/STABILITY.md,
+  ``FLAGS_stability_guard``) must detect and recover from;
+* ``grad_spike`` — probability a step's feed / gradient bucket is
+  scaled by ``spike_mag`` (default 1e4), tripping the guard's
+  EMA-based gradient-norm spike detector without any non-finite
+  value.
 
 Determinism: one ``random.Random(seed)`` stream, consumed in hook-call
 order. Two processes running the same plan over the same operation
@@ -50,7 +58,7 @@ _lock = threading.Lock()
 _active: Optional["FaultPlan"] = None
 
 _FLOAT_KEYS = ("connect_refuse", "drop", "truncate", "delay",
-               "delay_s")
+               "delay_s", "nan", "grad_spike", "spike_mag")
 _INT_KEYS = ("seed", "kill_at_step", "kill_attempts")
 
 
@@ -61,7 +69,9 @@ class FaultPlan:
                  drop: float = 0.0, truncate: float = 0.0,
                  delay: float = 0.0, delay_s: float = 0.05,
                  kill_at_step: Optional[int] = None,
-                 kill_attempts: int = 1, restart_attempt: int = 0):
+                 kill_attempts: int = 1, restart_attempt: int = 0,
+                 nan: float = 0.0, grad_spike: float = 0.0,
+                 spike_mag: float = 1e4):
         self.seed = int(seed)
         self.connect_refuse = float(connect_refuse)
         self.drop = float(drop)
@@ -72,11 +82,14 @@ class FaultPlan:
                              else int(kill_at_step))
         self.kill_attempts = int(kill_attempts)
         self.restart_attempt = int(restart_attempt)
+        self.nan = float(nan)
+        self.grad_spike = float(grad_spike)
+        self.spike_mag = float(spike_mag)
         self._rng = random.Random(self.seed)
         self._lock = threading.Lock()
         self.counts: Dict[str, int] = {
             "connect_refuse": 0, "drop": 0, "truncate": 0,
-            "delay": 0, "kill": 0}
+            "delay": 0, "kill": 0, "nan": 0, "grad_spike": 0}
 
     # -- construction -------------------------------------------------------
 
@@ -160,6 +173,66 @@ class FaultPlan:
         if self._roll(self.delay):
             self._count("delay")
             time.sleep(self.delay_s)
+
+    # -- anomaly hooks (stability guard, docs/STABILITY.md) -----------------
+
+    def _anomaly_kind(self) -> Optional[str]:
+        # both draws ALWAYS happen so the decision stream stays aligned
+        # across plans with different probabilities; nan wins a tie
+        nan_hit = self._roll(self.nan)
+        spike_hit = self._roll(self.grad_spike)
+        if nan_hit:
+            return "nan"
+        if spike_hit:
+            return "grad_spike"
+        return None
+
+    def corrupt_feed(self, step: int, feed):
+        """Engine-mode anomaly injection: plant a NaN in (or scale up)
+        the first float feed array, by sorted name, so the traced
+        step's loss/gradients trip the stability guard. Returns the
+        (possibly shallow-copied) feed dict; the caller's dict is
+        never mutated."""
+        if not feed or (self.nan <= 0.0 and self.grad_spike <= 0.0):
+            return feed
+        kind = self._anomaly_kind()
+        if kind is None:
+            return feed
+        import numpy as np
+        for name in sorted(feed):
+            arr = np.asarray(feed[name])
+            if arr.dtype.kind != "f" or arr.size == 0:
+                continue
+            arr = arr.copy()
+            if kind == "nan":
+                arr.flat[0] = np.nan
+            else:
+                arr *= self.spike_mag
+            self._count(kind)
+            out = dict(feed)
+            out[name] = arr
+            return out
+        return feed
+
+    def on_grad_bucket(self, flat):
+        """Dygraph-mode anomaly injection: corrupt one flattened
+        gradient bucket before the collective reduce (called from
+        DataParallel.apply_collective_grads)."""
+        if self.nan <= 0.0 and self.grad_spike <= 0.0:
+            return flat
+        kind = self._anomaly_kind()
+        if kind is None:
+            return flat
+        import numpy as np
+        flat = np.asarray(flat).copy()
+        if flat.dtype.kind != "f" or flat.size == 0:
+            return flat
+        if kind == "nan":
+            flat.flat[0] = np.nan
+        else:
+            flat *= self.spike_mag
+        self._count(kind)
+        return flat
 
     # -- step hook (engine / worker loops) ----------------------------------
 

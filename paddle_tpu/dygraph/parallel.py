@@ -122,15 +122,21 @@ class DataParallel(Layer):
             if getattr(ivar, "grad", None) is not None:
                 ivars.append(ivar)
         locals_ = [np.asarray(iv.grad) for iv in ivars]
+        fault = self._fault_plan()
         bucket_bytes = _cs.bucket_bytes_from_flags()
         if bucket_bytes <= 0:
             # pre-scheduler behavior: one collective per tensor
             fn = self._fused_fn("")
             for iv, local in zip(ivars, locals_):
+                flat = local.ravel()
+                if fault is not None:
+                    flat = np.asarray(
+                        fault.on_grad_bucket(flat)).ravel()
                 garr = jax.make_array_from_process_local_data(
-                    stacked, local.ravel()[None],
+                    stacked, flat[None],
                     (nproc, local.size))
                 out = np.asarray(fn(garr))
+                self._guard_reduced(out, [iv], [local.shape])
                 iv.grad = jnp.asarray(out.reshape(local.shape))
             return
         mode = _cs.quantize_mode_from_flags()
@@ -142,6 +148,8 @@ class DataParallel(Layer):
             parts = [locals_[i].ravel() for i in idxs]
             flat = parts[0] if len(parts) == 1 else \
                 np.concatenate(parts)
+            if fault is not None:
+                flat = np.asarray(fault.on_grad_bucket(flat)).ravel()
             use = mode if _cs.should_quantize(
                 flat.dtype, flat.nbytes, mode) else ""
             garr = jax.make_array_from_process_local_data(
@@ -149,12 +157,62 @@ class DataParallel(Layer):
             # pull the replicated result back to a process-local array
             # so subsequent eager ops don't mix global/local devices
             out = np.asarray(self._fused_fn(use)(garr))
+            self._guard_reduced(out, [ivars[i] for i in idxs],
+                                [locals_[i].shape for i in idxs])
             off = 0
             for i in idxs:
                 k = locals_[i].size
                 ivars[i].grad = jnp.asarray(
                     out[off:off + k].reshape(locals_[i].shape))
                 off += k
+
+    @staticmethod
+    def _fault_plan():
+        try:
+            from ..distributed import faults
+            return faults.current()
+        except Exception:
+            return None
+
+    def _guard_reduced(self, out, bucket_ivars, shapes):
+        """Eager-mode stability guard over one reduced gradient
+        bucket (docs/STABILITY.md). The dygraph allreduce already
+        lands on the host as numpy, so the non-finite check is a
+        cheap host reduction — no extra device sync. Non-finite
+        bucket: 'skip' (default) zeroes the bucket so the optimizer
+        step is a no-op for those params; 'abort' raises. clip/
+        rescale/rollback have no eager meaning (no traced state to
+        gate or ghost to restore) and degrade to skip."""
+        from ..core.flags import FLAGS
+        if not FLAGS.stability_guard or np.isfinite(out).all():
+            return
+        import os as _os
+        import warnings
+        from ..stability.guard import policy_map
+        policy = policy_map(
+            _os.environ.get("PT_STABILITY_POLICY", "")).get(
+                "nonfinite", "skip")
+        try:
+            from ..observability import metrics as _m
+            if _m.telemetry_active():
+                _m.counter(
+                    "pt_anomalies_total",
+                    "anomalous steps detected by the stability "
+                    "guard").inc(
+                        1.0, **{"class": "nonfinite",
+                                "policy": policy})
+        except Exception:
+            pass
+        if policy == "abort":
+            from ..core.enforce import EnforceNotMet
+            raise EnforceNotMet(
+                "stability guard: non-finite gradient bucket after "
+                "collective allreduce (PT_STABILITY_POLICY=abort)")
+        warnings.warn(
+            f"stability guard: non-finite gradient bucket of "
+            f"{len(bucket_ivars)} tensor(s) after allreduce -> "
+            f"zeroed (policy {policy!r})")
+        out[:] = 0.0
 
     def _allreduce_ctx(self):
         """Cached (stacked sharding, nproc): built once. The allreduce

@@ -88,21 +88,37 @@ class Family:
 class Counter:
     """Monotonic total. ``inc()`` is a plain float add under the GIL —
     no lock; exact enough for telemetry (the same tradeoff
-    Engine.counters already makes)."""
+    Engine.counters already makes).
 
-    __slots__ = ("name", "help", "value")
+    ``inc(v, **labels)`` additionally tracks one labeled series per
+    label tuple (e.g. ``pt_anomalies_total{class=...,policy=...}``);
+    the unlabeled sample stays first in the exposition and always
+    carries the grand total, so pre-label readers keep working."""
+
+    __slots__ = ("name", "help", "value", "_series")
 
     def __init__(self, name: str, help: str = ""):
         self.name = name
         self.help = help
         self.value = 0.0
+        self._series: Dict[Tuple[Tuple[str, str], ...], float] = {}
 
-    def inc(self, v: float = 1.0) -> None:
+    def inc(self, v: float = 1.0, **labels) -> None:
         self.value += v
+        if labels:
+            k = tuple(sorted(labels.items()))
+            self._series[k] = self._series.get(k, 0.0) + v
+
+    def get(self, **labels) -> float:
+        if not labels:
+            return self.value
+        return self._series.get(tuple(sorted(labels.items())), 0.0)
 
     def collect(self) -> Family:
-        return Family(self.name, "counter", self.help,
-                      [({}, self.value)])
+        samples = [({}, self.value)]
+        samples.extend((dict(k), v)
+                       for k, v in sorted(self._series.items()))
+        return Family(self.name, "counter", self.help, samples)
 
 
 class Gauge:
@@ -399,6 +415,16 @@ def _install_standard_families(reg: MetricsRegistry) -> None:
     # flight recorder
     reg.counter("pt_flight_dumps_total",
                 "flight-recorder postmortem dumps written")
+    # stability guard (FLAGS_stability_guard; docs/STABILITY.md)
+    reg.counter("pt_anomalies_total",
+                "stability-guard anomaly verdicts by class and "
+                "applied policy (docs/STABILITY.md)")
+    reg.counter("pt_rollbacks_total",
+                "ghost-snapshot rollbacks performed by the stability "
+                "guard")
+    reg.histogram("pt_guard_overhead_seconds",
+                  "host-side stability-guard controller time per step "
+                  "(verdict read + policy + ghost capture)")
     reg.register_collector(_engine_families)
     reg.register_collector(_rpc_families)
 

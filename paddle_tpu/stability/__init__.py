@@ -1,0 +1,23 @@
+"""Training stability subsystem (docs/STABILITY.md).
+
+Behind ``FLAGS_stability_guard``: a fused on-device anomaly verdict
+compiled into the traced step (guard), a rolling in-memory snapshot
+ring (ghost), per-anomaly-class recovery policies with escalation, and
+a deterministic bad-step repro bundle + CLI (replay,
+tools/replay_step.py). The guard exists because NaN/Inf detection via
+``FLAGS_check_nan_inf`` pays a per-op host sync at fetch time; the
+guard's verdict is ONE on-device scalar, and anomalous parameter /
+optimizer-state updates are gated on device before they ever reach the
+scope.
+"""
+from .guard import (  # noqa: F401
+    GUARD_EMA_VAR, GUARD_NORM_VAR, GUARD_VERDICT_VAR, LOSS_SCALE_VAR,
+    LOSS_SCALE_GOOD_VAR, NONFINITE, SPIKE, GuardPlan, StabilityGuard,
+    build_plan, ensure_state, policy_map)
+from .ghost import GhostRing  # noqa: F401
+
+__all__ = [
+    "GUARD_EMA_VAR", "GUARD_NORM_VAR", "GUARD_VERDICT_VAR",
+    "LOSS_SCALE_VAR", "LOSS_SCALE_GOOD_VAR", "NONFINITE", "SPIKE",
+    "GuardPlan", "StabilityGuard", "GhostRing", "build_plan",
+    "ensure_state", "policy_map"]

@@ -1,0 +1,124 @@
+"""Rolling in-memory ghost-snapshot ring for anomaly rollback.
+
+A ghost is a device-resident copy of the step's mutable state (updated
+persistables + optimizer state + loss scale + RNG state), captured
+every ``PT_GHOST_EVERY`` steps through the SAME batched jitted copy
+``checkpoint/snapshot.py`` uses — one dispatch, and the copies are
+fresh buffers the engine's ``donate_argnums`` can never invalidate.
+
+Unlike a disk checkpoint there is no D2H, no serialization and no
+commit protocol: capture cost is one on-device copy, restore cost is
+one more (restore copies AGAIN so the ring entry survives repeated
+rollbacks of the same ghost). The price is durability — a ghost dies
+with the process; the async checkpoint subsystem (docs/CHECKPOINTING
+.md) remains the recovery story for crashes. See docs/STABILITY.md.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence
+
+import jax
+
+from ..core.scope import LoDTensor, Scope
+
+__all__ = ["GhostEntry", "GhostRing"]
+
+
+class GhostEntry:
+    """One captured state: step number + name -> device array (+ lod)."""
+
+    __slots__ = ("step", "values", "lods", "captured_at")
+
+    def __init__(self, step: int, values: Dict[str, object],
+                 lods: Dict[str, list]):
+        self.step = step
+        self.values = values
+        self.lods = lods
+        self.captured_at = time.time()
+
+    def nbytes(self) -> int:
+        total = 0
+        for v in self.values.values():
+            total += int(getattr(v, "nbytes", 0) or 0)
+        return total
+
+
+class GhostRing:
+    """Bounded ring of :class:`GhostEntry`; oldest entries are dropped
+    (and their device buffers released to the allocator) as new ones
+    arrive, so memory is bounded by ``capacity * state_bytes``."""
+
+    def __init__(self, capacity: int = 2):
+        self.capacity = max(1, int(capacity))
+        self._ring: List[GhostEntry] = []
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def latest(self) -> Optional[GhostEntry]:
+        return self._ring[-1] if self._ring else None
+
+    def nbytes(self) -> int:
+        return sum(e.nbytes() for e in self._ring)
+
+    def capture(self, scope: Scope, names: Sequence[str],
+                step: int) -> Optional[GhostEntry]:
+        """Copy ``names`` out of ``scope`` on device (one batched jitted
+        dispatch). Non-array host state is skipped — it cannot be
+        rolled back tensor-wise. Returns the new entry (None if nothing
+        was capturable)."""
+        from ..checkpoint.snapshot import _copy_on_device
+        items = []  # (name, lod, arr)
+        host_values = {}
+        for name in names:
+            var = scope.find_var(name)
+            if var is None or not var.is_initialized():
+                continue
+            value = var.get_value()
+            lod = value.lod() if isinstance(value, LoDTensor) else []
+            arr = value.array if isinstance(value, LoDTensor) else value
+            if isinstance(arr, jax.Array):
+                items.append((name, lod, arr))
+            elif hasattr(arr, "copy"):
+                host_values[name] = (arr.copy(), lod)
+        copies = _copy_on_device([a for _, _, a in items])
+        values: Dict[str, object] = {}
+        lods: Dict[str, list] = {}
+        for (name, lod, _), copy in zip(items, copies):
+            values[name] = copy
+            if lod:
+                lods[name] = [list(level) for level in lod]
+        for name, (arr, lod) in host_values.items():
+            values[name] = arr
+            if lod:
+                lods[name] = [list(level) for level in lod]
+        if not values:
+            return None
+        entry = GhostEntry(step, values, lods)
+        self._ring.append(entry)
+        while len(self._ring) > self.capacity:
+            self._ring.pop(0)
+        return entry
+
+    def restore(self, scope: Scope) -> Optional[GhostEntry]:
+        """Write the latest ghost back into ``scope``. The restored
+        arrays are FRESH device copies — the ring entry stays valid, so
+        a re-executed step that trips again can roll back to the same
+        ghost (escalation decides when to stop trying)."""
+        entry = self.latest()
+        if entry is None:
+            return None
+        from ..checkpoint.snapshot import _copy_on_device
+        names = list(entry.values)
+        device_names = [n for n in names
+                        if isinstance(entry.values[n], jax.Array)]
+        copies = _copy_on_device([entry.values[n]
+                                  for n in device_names])
+        restored = dict(zip(device_names, copies))
+        for name in names:
+            val = restored.get(name, entry.values[name])
+            lod = entry.lods.get(name)
+            scope.var(name).set_value(
+                LoDTensor(val, lod) if lod else val)
+        return entry

@@ -1,0 +1,234 @@
+"""Deterministic bad-step repro bundles.
+
+When the guard trips, the step that produced the anomaly is fully
+determined by five things: the program (serialized desc), the feed
+values, the pre-step state (params + optimizer + guard state), the
+pre-split RNG state, and the flag set. :func:`dump_bundle` captures all
+five plus the observed verdict/fetches; :func:`replay` re-executes the
+step from the bundle and byte-compares — the debugging loop becomes
+"scp the bundle, run tools/replay_step.py" instead of "rerun 40k steps
+and hope".
+
+The pre-step state is readable AFTER the step because the guard gates
+anomalous updates on device: on a NONFINITE verdict every gated
+persistable holds its pre-step bits, and the guard's EMA is defined to
+hold on anomalies. The loss scale DOES move on the anomalous step, so
+the trace also emits ``@GUARD_PRESCALE@`` and the bundle stores that as
+the scale. The one inexact case is a pure SPIKE under a damping policy
+(clip/rescale) — params were dampened, not reverted — flagged as
+``state_exact: false`` in meta.json. See docs/STABILITY.md.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .guard import (GUARD_PRESCALE_VAR, GUARD_VERDICT_VAR,
+                    LOSS_SCALE_VAR, NONFINITE, SPIKE)
+
+RNG_STATE_VAR = "@RNG_STATE@"
+_FLIGHT_TAIL = 8
+
+__all__ = ["dump_bundle", "load_bundle", "replay", "default_dir"]
+
+
+def default_dir() -> str:
+    d = os.environ.get("PT_REPLAY_DIR")
+    if d:
+        return d
+    return os.path.join(tempfile.gettempdir(),
+                        f"pt_replay_{os.getpid()}")
+
+
+def _save_named(path: str, values: Dict[str, np.ndarray]) -> List[str]:
+    """npz keys must survive names like ``@GUARD_EMA@`` and
+    ``fc_0.w_0@GRAD`` — store positionally, return the name order (the
+    caller records it in meta.json)."""
+    names = sorted(values)
+    np.savez(path, *[np.asarray(values[n]) for n in names])
+    return names
+
+
+def _load_named(path: str, names: List[str]) -> Dict[str, np.ndarray]:
+    with np.load(path, allow_pickle=False) as z:
+        return {n: z[f"arr_{i}"] for i, n in enumerate(names)}
+
+
+def _flight_tail() -> list:
+    try:
+        from ..observability import recorder
+        return recorder.flight_recorder().snapshot()[-_FLIGHT_TAIL:]
+    except Exception:
+        return []
+
+
+def _flags_snapshot() -> Dict[str, object]:
+    from ..core.flags import _REGISTRY, get_flags
+    return get_flags(sorted(_REGISTRY))
+
+
+def dump_bundle(program, scope, traced, arrays, fetches, updated,
+                rng_key, verdict: int, classes, policy: str, step: int,
+                guard=None, directory: Optional[str] = None) -> str:
+    """Write one repro bundle; returns its directory path."""
+    base = directory or default_dir()
+    fp = "_".join(str(x) for x in program.fingerprint)
+    bundle = os.path.join(base, f"replay_{fp}_step{step}")
+    os.makedirs(bundle, exist_ok=True)
+
+    state: Dict[str, np.ndarray] = {}
+    for n in list(traced.donated_names) + list(traced.const_names):
+        v = scope.find_var(n)
+        if v is None or not v.is_initialized():
+            continue
+        val = v.get_value()
+        arr = getattr(val, "array", val)
+        try:
+            state[n] = np.asarray(arr)
+        except Exception:
+            continue
+    # the loss scale already shrank on this (anomalous) step; the trace
+    # emitted its pre-step value for exactly this bundle
+    pre = updated.get(GUARD_PRESCALE_VAR)
+    if pre is not None and LOSS_SCALE_VAR in state:
+        state[LOSS_SCALE_VAR] = np.asarray(pre).reshape(
+            state[LOSS_SCALE_VAR].shape).astype(
+            state[LOSS_SCALE_VAR].dtype)
+    state_names = _save_named(os.path.join(bundle, "state.npz"), state)
+
+    feed_vals = {n: np.asarray(a) for n, a in arrays.items()}
+    feed_names = _save_named(os.path.join(bundle, "feeds.npz"),
+                             feed_vals)
+    fetch_vals = {f"f{i}": np.asarray(v)
+                  for i, v in enumerate(fetches)}
+    _save_named(os.path.join(bundle, "fetches.npz"), fetch_vals)
+    with open(os.path.join(bundle, "program.pb"), "wb") as f:
+        f.write(program.serialize_to_string())
+
+    plan = getattr(traced, "guard_plan", None)
+    state_exact = not (("spike" in classes)
+                       and ("nonfinite" not in classes)
+                       and plan is not None and plan.spike_damps)
+    meta = {
+        "fingerprint": list(program.fingerprint),
+        "step": int(step),
+        "verdict": int(verdict),
+        "classes": list(classes),
+        "policy": policy,
+        "fetch_names": list(traced.fetch_names),
+        "feed_names": feed_names,
+        # dense feeds only: LoD offsets are trace-level metadata the
+        # dispatch tail no longer sees (ragged-feed bundles replay the
+        # values with empty lod)
+        "feed_lods": {},
+        "state_names": state_names,
+        "state_exact": state_exact,
+        "rng_state": [int(x) for x in
+                      np.asarray(rng_key).reshape(-1).tolist()],
+        "flags": _flags_snapshot(),
+        "stability_policy": os.environ.get("PT_STABILITY_POLICY", ""),
+        "flight_tail": _flight_tail(),
+        "created": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    with open(os.path.join(bundle, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=1, default=str)
+    return bundle
+
+
+def load_bundle(bundle: str):
+    """(meta, feeds, state, fetches) from a bundle directory."""
+    with open(os.path.join(bundle, "meta.json")) as f:
+        meta = json.load(f)
+    feeds = _load_named(os.path.join(bundle, "feeds.npz"),
+                        meta["feed_names"])
+    state = _load_named(os.path.join(bundle, "state.npz"),
+                        meta["state_names"])
+    fetches = _load_named(
+        os.path.join(bundle, "fetches.npz"),
+        [f"f{i}" for i in range(len(meta["fetch_names"]))])
+    return meta, feeds, state, fetches
+
+
+def replay(bundle: str, quiet: bool = False) -> dict:
+    """Re-execute a bundle's bad step deterministically and compare.
+
+    Restores the flag set, pre-step state and pre-split RNG state, runs
+    ONE step of the deserialized program through the normal Executor
+    path, and byte-compares the fetches and the guard verdict against
+    what the original step produced. The replay runs with
+    ``PT_STABILITY_POLICY=skip`` and bundle dumping off, so replaying
+    an anomaly cannot recurse."""
+    meta, feeds, state, saved_fetches = load_bundle(bundle)
+
+    from ..core.flags import _REGISTRY, set_flags
+    known = {k: v for k, v in meta["flags"].items()
+             if k[6:] in _REGISTRY}
+    set_flags(known)
+    env_backup = {k: os.environ.get(k)
+                  for k in ("PT_STABILITY_POLICY",
+                            "PT_GUARD_REPLAY_MAX")}
+    os.environ["PT_STABILITY_POLICY"] = "skip"
+    os.environ["PT_GUARD_REPLAY_MAX"] = "0"
+    try:
+        from .. import framework
+        from ..core.scope import LoDTensor, Scope
+        from ..executor import Executor
+
+        with open(os.path.join(bundle, "program.pb"), "rb") as f:
+            program = framework.Program.parse_from_string(f.read())
+        scope = Scope()
+        for n, arr in state.items():
+            scope.var(n).set_value(jnp.asarray(arr))
+        scope.var(RNG_STATE_VAR).set_value(
+            jnp.asarray(np.asarray(meta["rng_state"],
+                                   dtype=np.uint32)))
+        feed = {}
+        for n, arr in feeds.items():
+            lod = meta.get("feed_lods", {}).get(n)
+            feed[n] = LoDTensor(jnp.asarray(arr), lod) if lod \
+                else arr
+        exe = Executor()
+        out = exe.run(program=program, feed=feed,
+                      fetch_list=list(meta["fetch_names"]),
+                      scope=scope, return_numpy=True)
+
+        fetch_match = []
+        for i, name in enumerate(meta["fetch_names"]):
+            got = np.asarray(out[i])
+            want = saved_fetches[f"f{i}"]
+            same = (got.shape == want.shape
+                    and got.dtype == want.dtype
+                    and got.tobytes() == want.tobytes())
+            fetch_match.append({"name": name, "match": bool(same)})
+        vvar = scope.find_var(GUARD_VERDICT_VAR)
+        verdict = int(np.asarray(vvar.get_value()).reshape(-1)[0]) \
+            if vvar is not None and vvar.is_initialized() else 0
+        classes = [c for c, bit in (("nonfinite", NONFINITE),
+                                    ("spike", SPIKE)) if verdict & bit]
+        report = {
+            "bundle": bundle,
+            "original_verdict": int(meta["verdict"]),
+            "replayed_verdict": verdict,
+            "replayed_classes": classes,
+            "verdict_match": verdict == int(meta["verdict"]),
+            "fetch_match": fetch_match,
+            "state_exact": bool(meta.get("state_exact", True)),
+            "reproduced": (verdict == int(meta["verdict"])
+                           and all(m["match"] for m in fetch_match)),
+        }
+        if not quiet:
+            print(json.dumps(report, indent=1))
+        return report
+    finally:
+        for k, v in env_backup.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v

@@ -30,7 +30,14 @@ Usage:
   python tools/chaos_report.py                      # full report
   python tools/chaos_report.py --steps 20 \
       --fault "seed=7,connect_refuse=0.1,kill_at_step=8"
+  python tools/chaos_report.py --steps 16 \
+      --fault "seed=7,nan=0.2"                      # stability guard
   PT_BENCH_CHAOS=1 python bench.py                  # bench tail line
+
+``nan`` / ``grad_spike`` fault plans automatically arm
+``FLAGS_stability_guard`` in every trainer of both runs and add an
+``anomalies`` section (detected / recovered_by_rollback /
+degraded_to_skip / aborted) to the report — docs/STABILITY.md.
 """
 from __future__ import annotations
 
@@ -46,6 +53,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 DEFAULT_STEPS = 24
 DEFAULT_FAULT = "seed=7,connect_refuse=0.1,kill_at_step=8"
+# per-class default for numeric-anomaly plans: injected NaNs roll back
+# to the last ghost, while grad-norm spikes — routine in early async-PS
+# training, where pulled params jump between steps — are clipped in
+# place instead of burning a rollback each time
+DEFAULT_STABILITY_POLICY = "nonfinite=rollback,spike=clip"
 # |final_loss_faulted - final_loss_clean| bound for "survived": the job
 # is a 4-feature linear regression whose loss decays below 0.05 within
 # the step budget on BOTH runs, so an absolute tolerance is meaningful
@@ -79,13 +91,23 @@ def _worker(role: str) -> None:
     steps = int(os.environ.get("CHAOS_STEPS", str(DEFAULT_STEPS)))
     ckpt_dir = os.environ.get("CHAOS_CKPT_DIR")
 
-    def dump_stats():
+    def dump_stats(engine=None):
         plan = faults.current()
-        print("CHAOS_STATS " + json.dumps({
+        stats = {
             "role": role, "rank": rank,
             "faults": dict(plan.counts) if plan is not None else {},
             "retry": resilience.retry_stats(),
-        }), flush=True)
+        }
+        if engine is not None:
+            # stability-guard accounting (docs/STABILITY.md): lets the
+            # orchestrator report anomalies recovered-by-rollback vs
+            # aborted, not just that the job finished
+            stats["stability"] = {
+                k: engine.counters.get(k, 0)
+                for k in ("anomalies", "rollbacks",
+                          "rollback_reexec_failures", "guard_aborts",
+                          "ghost_snapshots", "replay_bundles")}
+        print("CHAOS_STATS " + json.dumps(stats), flush=True)
 
     fluid.framework.unique_name.reset()
     role_obj = UserDefinedRoleMaker(
@@ -116,6 +138,10 @@ def _worker(role: str) -> None:
 
     set_flags({"communicator_min_send_grad_num_before_recv": 2,
                "communicator_max_merge_var_num": 2})
+    if os.environ.get("CHAOS_STABILITY"):
+        # numeric-anomaly chaos (nan / grad_spike fault kinds): arm the
+        # stability guard so detection + recovery is what's under test
+        set_flags({"FLAGS_stability_guard": True})
     exe = fluid.Executor(fluid.CPUPlace())
     exe.run(fleet.startup_program or startup)
     fleet.init_worker()
@@ -143,24 +169,32 @@ def _worker(role: str) -> None:
         rng.rand(16, 4)
     losses = []
     import warnings
-    with warnings.catch_warnings():
-        warnings.simplefilter("ignore")
-        for step in range(start_step + 1, steps + 1):
-            bx = rng.rand(16, 4).astype(np.float32)
-            by = bx @ w_true + 0.25
-            out = exe.run(fleet.main_program, feed={"x": bx, "y": by},
-                          fetch_list=[loss.name])
-            losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
-            if manager is not None:
-                manager.save(step, scope=fluid.global_scope(),
-                             vars=["w", "b"])
-            time.sleep(0.05)
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for step in range(start_step + 1, steps + 1):
+                bx = rng.rand(16, 4).astype(np.float32)
+                by = bx @ w_true + 0.25
+                out = exe.run(fleet.main_program,
+                              feed={"x": bx, "y": by},
+                              fetch_list=[loss.name])
+                losses.append(
+                    float(np.asarray(out[0]).reshape(-1)[0]))
+                if manager is not None:
+                    manager.save(step, scope=fluid.global_scope(),
+                                 vars=["w", "b"])
+                time.sleep(0.05)
+    except Exception:
+        # a guard abort (PT_STABILITY_POLICY=abort) still reports its
+        # counters so the orchestrator can count aborted anomalies
+        dump_stats(engine=exe._engine)
+        raise
     if manager is not None:
         manager.close()
     fleet.stop_worker()
     final = float(np.mean(losses[-3:])) if losses else float("nan")
     print("CHAOS_LOSS " + json.dumps(final), flush=True)
-    dump_stats()
+    dump_stats(engine=exe._engine)
 
 
 # ---------------------------------------------------------------------------
@@ -217,6 +251,9 @@ def _parse_worker(out: str, agg: dict) -> None:
                 agg["faults"][k] = agg["faults"].get(k, 0) + int(v)
             for k, v in st["retry"].items():
                 agg["retry"][k] = agg["retry"].get(k, 0) + int(v)
+            for k, v in st.get("stability", {}).items():
+                agg["stability"][k] = (agg["stability"].get(k, 0)
+                                       + int(v))
         elif line.startswith("CHAOS_LOSS "):
             agg["losses"].append(
                 float(json.loads(line[len("CHAOS_LOSS "):])))
@@ -225,11 +262,15 @@ def _parse_worker(out: str, agg: dict) -> None:
 
 
 def run_job(steps=DEFAULT_STEPS, fault_spec=None, max_restarts=1,
-            timeout_s=JOB_TIMEOUT_S) -> dict:
+            timeout_s=JOB_TIMEOUT_S, stability=False,
+            stability_policy=DEFAULT_STABILITY_POLICY) -> dict:
     """One 1-pserver + 2-trainer job; ``fault_spec`` (if any) is the
-    PT_FAULT_PLAN for trainer 1 only. Returns the per-run report."""
+    PT_FAULT_PLAN for trainer 1 only. ``stability`` arms
+    FLAGS_stability_guard in every trainer (for nan / grad_spike
+    fault plans). Returns the per-run report."""
     ep = f"127.0.0.1:{_free_port()}"
-    agg = {"faults": {}, "retry": {}, "losses": [], "resumed_at": None}
+    agg = {"faults": {}, "retry": {}, "stability": {}, "losses": [],
+           "resumed_at": None}
     t0 = time.monotonic()
     # flight dumps outlive the job's ckpt tempdir: summarized after the
     # processes are reaped, removed by this function
@@ -248,6 +289,21 @@ def run_job(steps=DEFAULT_STEPS, fault_spec=None, max_restarts=1,
             extra = {"PADDLE_RESTART_ATTEMPT": str(attempts[rank]),
                      "CHAOS_CKPT_DIR": os.path.join(ckpt, str(rank)),
                      "PT_FLIGHT_DIR": flight_dir}
+            if stability:
+                # guard on BOTH trainers (and on the clean run too, via
+                # the caller) so the clean-vs-faulted comparison also
+                # checks guard-on parity, not just recovery
+                extra["CHAOS_STABILITY"] = "1"
+                extra["PT_STABILITY_POLICY"] = stability_policy
+                # async-PS tuning: ghost every 2 steps so a rollback
+                # lands on a recent state; spike threshold above the
+                # natural step-to-step norm variance of async pulled
+                # params (injected grad_spike is x1e4, still caught);
+                # no escalation — repeated clips must not degrade into
+                # stale-ghost rollbacks that stall the whole cluster
+                extra["PT_GHOST_EVERY"] = "2"
+                extra["PT_GUARD_SPIKE_FACTOR"] = "100"
+                extra["PT_GUARD_ESCALATE_AFTER"] = "1000000"
             if fault_spec and rank == 1:
                 extra["PT_FAULT_PLAN"] = fault_spec
             trainers[rank] = _spawn("trainer", rank, 2, ep, steps,
@@ -331,6 +387,7 @@ def run_job(steps=DEFAULT_STEPS, fault_spec=None, max_restarts=1,
         "faults_injected": agg["faults"],
         "retries_consumed": agg["retry"].get("retries", 0),
         "breaker_fast_fails": agg["retry"].get("breaker_fast_fails", 0),
+        "stability": agg["stability"],
         "flight_records": flight_records,
         "completed": completed,
         "elapsed_s": round(elapsed, 2),
@@ -345,15 +402,23 @@ def run_job(steps=DEFAULT_STEPS, fault_spec=None, max_restarts=1,
 
 
 def chaos_report(steps=DEFAULT_STEPS, fault_spec=DEFAULT_FAULT,
-                 max_restarts=1) -> dict:
-    clean = run_job(steps=steps, fault_spec=None, max_restarts=0)
+                 max_restarts=1,
+                 stability_policy=DEFAULT_STABILITY_POLICY) -> dict:
+    # numeric-anomaly plans arm the stability guard in every trainer of
+    # BOTH runs: the clean run doubles as a guard-on parity check
+    stability = any(k in (fault_spec or "")
+                    for k in ("nan=", "grad_spike="))
+    clean = run_job(steps=steps, fault_spec=None, max_restarts=0,
+                    stability=stability,
+                    stability_policy=stability_policy)
     faulted = run_job(steps=steps, fault_spec=fault_spec,
-                      max_restarts=max_restarts)
+                      max_restarts=max_restarts, stability=stability,
+                      stability_policy=stability_policy)
     delta = None
     if clean["final_loss"] is not None and \
             faulted["final_loss"] is not None:
         delta = abs(clean["final_loss"] - faulted["final_loss"])
-    return {
+    rep = {
         "fault_plan": fault_spec,
         "clean": clean,
         "faulted": faulted,
@@ -363,6 +428,15 @@ def chaos_report(steps=DEFAULT_STEPS, fault_spec=DEFAULT_FAULT,
             clean["completed"] and faulted["completed"] and
             delta is not None and delta <= LOSS_TOL),
     }
+    if stability:
+        st = faulted["stability"]
+        rep["anomalies"] = {
+            "detected": st.get("anomalies", 0),
+            "recovered_by_rollback": st.get("rollbacks", 0),
+            "degraded_to_skip": st.get("rollback_reexec_failures", 0),
+            "aborted": st.get("guard_aborts", 0),
+        }
+    return rep
 
 
 def chaos_report_line(steps=DEFAULT_STEPS, fault_spec=DEFAULT_FAULT,
@@ -387,12 +461,17 @@ def main(argv=None):
     ap.add_argument("--fault", default=DEFAULT_FAULT,
                     help="PT_FAULT_PLAN spec for trainer 1")
     ap.add_argument("--max-restarts", type=int, default=1)
+    ap.add_argument("--stability-policy",
+                    default=DEFAULT_STABILITY_POLICY,
+                    help="PT_STABILITY_POLICY for nan/grad_spike "
+                         "fault plans (guard armed automatically)")
     args = ap.parse_args(argv)
     if args.role:
         _worker(args.role)
         return
     rep = chaos_report(steps=args.steps, fault_spec=args.fault,
-                       max_restarts=args.max_restarts)
+                       max_restarts=args.max_restarts,
+                       stability_policy=args.stability_policy)
     print(json.dumps(rep, indent=2))
     sys.exit(0 if rep["survived"] else 1)
 

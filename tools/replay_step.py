@@ -1,0 +1,68 @@
+"""Deterministically re-execute a stability-guard repro bundle.
+
+When ``FLAGS_stability_guard`` trips, the guard dumps a bundle
+(program desc, feed values, pre-step state, pre-split RNG state, flag
+set, verdict, observed fetches — see paddle_tpu/stability/replay.py)
+under ``PT_REPLAY_DIR``. This CLI re-runs the bad step from that
+bundle and byte-compares the fetches and the anomaly verdict, turning
+"it NaN'd at step 41832" into a one-command local repro.
+
+Usage:
+  python tools/replay_step.py --bundle /tmp/pt_replay_123/replay_4_9_step41832
+  python tools/replay_step.py --list [--dir DIR]     # inspect bundles
+
+Exit code 0 when the anomaly reproduced (verdict AND every fetch
+byte-identical), 1 otherwise. docs/STABILITY.md.
+"""
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _list_bundles(directory):
+    from paddle_tpu.stability.replay import default_dir
+    directory = directory or default_dir()
+    rows = []
+    for bundle in sorted(glob.glob(os.path.join(directory,
+                                                "replay_*"))):
+        meta_path = os.path.join(bundle, "meta.json")
+        if not os.path.isfile(meta_path):
+            continue
+        with open(meta_path) as f:
+            meta = json.load(f)
+        rows.append({"bundle": bundle, "step": meta.get("step"),
+                     "classes": meta.get("classes"),
+                     "policy": meta.get("policy"),
+                     "created": meta.get("created"),
+                     "state_exact": meta.get("state_exact")})
+    print(json.dumps(rows, indent=1))
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="re-execute a stability-guard repro bundle")
+    ap.add_argument("--bundle", help="bundle directory to replay")
+    ap.add_argument("--list", action="store_true",
+                    help="list bundles under --dir / PT_REPLAY_DIR")
+    ap.add_argument("--dir", default=None,
+                    help="bundle root for --list")
+    ap.add_argument("--quiet", action="store_true",
+                    help="suppress the JSON report (exit code only)")
+    args = ap.parse_args(argv)
+    if args.list:
+        return _list_bundles(args.dir)
+    if not args.bundle:
+        ap.error("--bundle (or --list) is required")
+    from paddle_tpu.stability.replay import replay
+    report = replay(args.bundle, quiet=args.quiet)
+    return 0 if report["reproduced"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

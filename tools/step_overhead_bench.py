@@ -11,7 +11,7 @@ CLI::
 
     python tools/step_overhead_bench.py [--json] [--async-dispatch]
         [--batch N] [--steps N] [--threshold-ms X] [--telemetry]
-        [--compare-telemetry] [--compare-scheduler]
+        [--compare-telemetry] [--compare-scheduler] [--compare-guard]
 
 exits non-zero when measured host overhead exceeds ``--threshold-ms``
 (the CI regression gate). ``overhead_report()`` is imported by bench.py
@@ -71,6 +71,21 @@ def scheduler_overlap_report(sched):
             f"{c.get('pipeline_fill_frac', 0)} lane_idle="
             f"{c.get('lane_idle_ms', 0):.1f} ms")
     return sched, line
+
+
+def guard_overhead_report(guard):
+    """(dict, '#'-line) for the bench JSON tail from a stability-guard
+    A/B probe result ({sync_ms_off, sync_ms_on, ...}); (None, None)
+    when the probe did not run or errored before measuring."""
+    if not guard or "sync_ms_on" not in guard:
+        return (guard or None), None
+    off, on = guard["sync_ms_off"], guard["sync_ms_on"]
+    line = (f"# stability_guard: sync {off:.2f} -> {on:.2f} ms/step "
+            f"(delta {on - off:+.3f} ms); host guard overhead "
+            f"{guard.get('guard_host_ms_per_step', 0.0):.3f} ms/step, "
+            f"ghosts={guard.get('ghost_snapshots', 0)} "
+            f"anomalies={guard.get('anomalies', 0)}")
+    return guard, line
 
 
 def _build_model(batch):
@@ -176,6 +191,12 @@ def main(argv=None):
                         "default path, proving its overhead is "
                         "unchanged) then on; --threshold-ms gates "
                         "BOTH measurements")
+    p.add_argument("--compare-guard", action="store_true",
+                   help="A/B FLAGS_stability_guard: measure off then "
+                        "on (verdict compiled into the step, ONE "
+                        "scalar fetch); --threshold-ms gates the "
+                        "guard-on DELTA, the number "
+                        "docs/STABILITY.md promises stays small")
     p.add_argument("--json", action="store_true")
     args = p.parse_args(argv)
 
@@ -230,6 +251,32 @@ def main(argv=None):
                                            - r["sync_ms"])
             finally:
                 set_flags({"FLAGS_op_scheduler": False})
+        if args.compare_guard:
+            # A/B the stability guard on a FRESH engine/model so both
+            # measurements start from identical params and the
+            # guard-off numbers above stay uncontaminated
+            set_flags({"FLAGS_stability_guard": True})
+            try:
+                eng3, prog3, scope3, feed3, fetch3 = \
+                    _build_model(args.batch)
+                with fluid.scope_guard(scope3):
+                    r_g = measure_step_overhead(
+                        eng3, prog3, scope3, feed3, fetch3,
+                        steps=args.steps)
+                n_steps = max(1, r_g["counters"].get("runs", 0))
+                r["guard_on"] = {
+                    **{k: r_g[k] for k in
+                       ("sync_ms", "pipelined_ms", "host_overhead_ms",
+                        "steps_per_sec")},
+                    "guard_host_ms_per_step": round(
+                        r_g["counters"].get("guard_overhead_ms", 0.0)
+                        / n_steps, 4),
+                    "ghost_snapshots":
+                        r_g["counters"].get("ghost_snapshots", 0),
+                    "anomalies": r_g["counters"].get("anomalies", 0)}
+                r["guard_delta_ms"] = r_g["sync_ms"] - r["sync_ms"]
+            finally:
+                set_flags({"FLAGS_stability_guard": False})
     r["async_dispatch"] = bool(args.async_dispatch)
     r["telemetry"] = bool(args.telemetry)
     if args.json:
@@ -250,6 +297,16 @@ def main(argv=None):
                  "counters": r["scheduler_on"]["counters"]})
             if line:
                 print(line)
+        if "guard_on" in r:
+            _, line = guard_overhead_report(
+                {"sync_ms_off": r["sync_ms"],
+                 "sync_ms_on": r["guard_on"]["sync_ms"],
+                 "guard_host_ms_per_step":
+                     r["guard_on"]["guard_host_ms_per_step"],
+                 "ghost_snapshots": r["guard_on"]["ghost_snapshots"],
+                 "anomalies": r["guard_on"]["anomalies"]})
+            if line:
+                print(line)
     bad = []
     if r["counters"].get("traces"):
         bad.append(f"steady state re-traced "
@@ -264,6 +321,12 @@ def main(argv=None):
             f"scheduler-on host overhead "
             f"{r['scheduler_on']['host_overhead_ms']:.1f} ms > "
             f"threshold {args.threshold_ms:.1f} ms")
+    if args.threshold_ms is not None and "guard_delta_ms" in r and \
+            r["guard_delta_ms"] > args.threshold_ms:
+        bad.append(
+            f"stability-guard sync delta "
+            f"{r['guard_delta_ms']:.2f} ms > threshold "
+            f"{args.threshold_ms:.1f} ms")
     if bad:
         print("REGRESSION: " + "; ".join(bad), file=sys.stderr)
         return 1
