@@ -1124,7 +1124,10 @@ class Engine:
                 bool(FLAGS.sharded_weight_update),
                 bool(FLAGS.op_scheduler),
                 bool(FLAGS.stability_guard),
-                os.environ.get("PT_STABILITY_POLICY", ""))
+                os.environ.get("PT_STABILITY_POLICY", ""),
+                # GuardPlan bakes these into the compiled gate too
+                os.environ.get("PT_GUARD_SPIKE_FACTOR", ""),
+                os.environ.get("PT_GUARD_EMA_BETA", ""))
 
     def compiled_step(self, program, scope: Scope, feed, fetch_names,
                       block_idx: int = 0, iterations: int = 1):
@@ -1222,10 +1225,12 @@ class Engine:
                 str(FLAGS.quantized_allreduce),
                 bool(FLAGS.sharded_weight_update),
                 bool(FLAGS.op_scheduler),
-                # the guard's gate (and its policy's damping) is baked
-                # into the trace
+                # the guard's gate (and its policy's damping, spike
+                # threshold, and EMA decay) is baked into the trace
                 bool(FLAGS.stability_guard),
-                os.environ.get("PT_STABILITY_POLICY", ""))
+                os.environ.get("PT_STABILITY_POLICY", ""),
+                os.environ.get("PT_GUARD_SPIKE_FACTOR", ""),
+                os.environ.get("PT_GUARD_EMA_BETA", ""))
 
     def _fast_feed_arrays(self, entry: _FastPathEntry, feed):
         """Feed dict -> device arrays through the cached signature: no

@@ -135,8 +135,8 @@ class DataParallel(Layer):
                 garr = jax.make_array_from_process_local_data(
                     stacked, flat[None],
                     (nproc, local.size))
-                out = np.asarray(fn(garr))
-                self._guard_reduced(out, [iv], [local.shape])
+                out = self._guard_reduced(
+                    np.asarray(fn(garr)), [iv], [local.shape])
                 iv.grad = jnp.asarray(out.reshape(local.shape))
             return
         mode = _cs.quantize_mode_from_flags()
@@ -156,9 +156,10 @@ class DataParallel(Layer):
                 stacked, flat[None], (nproc, flat.size))
             # pull the replicated result back to a process-local array
             # so subsequent eager ops don't mix global/local devices
-            out = np.asarray(self._fused_fn(use)(garr))
-            self._guard_reduced(out, [ivars[i] for i in idxs],
-                                [locals_[i].shape for i in idxs])
+            out = self._guard_reduced(
+                np.asarray(self._fused_fn(use)(garr)),
+                [ivars[i] for i in idxs],
+                [locals_[i].shape for i in idxs])
             off = 0
             for i in idxs:
                 k = locals_[i].size
@@ -178,14 +179,17 @@ class DataParallel(Layer):
         """Eager-mode stability guard over one reduced gradient
         bucket (docs/STABILITY.md). The dygraph allreduce already
         lands on the host as numpy, so the non-finite check is a
-        cheap host reduction — no extra device sync. Non-finite
-        bucket: 'skip' (default) zeroes the bucket so the optimizer
-        step is a no-op for those params; 'abort' raises. clip/
-        rescale/rollback have no eager meaning (no traced state to
-        gate or ghost to restore) and degrade to skip."""
+        cheap host reduction — no extra device sync. Returns the
+        bucket to write back: `out` itself when finite, a zeroed
+        replacement when not ('skip', the default, makes the
+        optimizer step a no-op for those params; `out` is a
+        read-only view of a jax.Array, so it can't be zeroed in
+        place); 'abort' raises. clip/rescale/rollback have no eager
+        meaning (no traced state to gate or ghost to restore) and
+        degrade to skip."""
         from ..core.flags import FLAGS
         if not FLAGS.stability_guard or np.isfinite(out).all():
-            return
+            return out
         import os as _os
         import warnings
         from ..stability.guard import policy_map
@@ -212,7 +216,7 @@ class DataParallel(Layer):
             f"stability guard: non-finite gradient bucket of "
             f"{len(bucket_ivars)} tensor(s) after allreduce -> "
             f"zeroed (policy {policy!r})")
-        out[:] = 0.0
+        return np.zeros_like(out)
 
     def _allreduce_ctx(self):
         """Cached (stacked sharding, nproc): built once. The allreduce

@@ -562,8 +562,11 @@ class StabilityGuard:
             # materialization point. Ghosts still refresh on cadence —
             # gating keeps even an anomalous step's state clean, so a
             # captured ghost is always a valid restore target.
+            from ..core.engine import _MAX_PENDING_STEPS
             engine._pending.append(_GuardPending(
                 verdict_dev, self, engine, program.fingerprint))
+            while len(engine._pending) > _MAX_PENDING_STEPS:
+                engine._pending.pop(0).check()
             self._maybe_capture(engine, scope, updated, step_no)
             return "ok"
 

@@ -167,9 +167,13 @@ def replay(bundle: str, quiet: bool = False) -> dict:
     an anomaly cannot recurse."""
     meta, feeds, state, saved_fetches = load_bundle(bundle)
 
-    from ..core.flags import _REGISTRY, set_flags
+    from ..core.flags import _REGISTRY, get_flags, set_flags
     known = {k: v for k, v in meta["flags"].items()
              if k[6:] in _REGISTRY}
+    # snapshot the in-process flag values we are about to overwrite so
+    # an in-process caller (tests, notebooks) isn't left with the
+    # bundle's flags after the replay returns
+    flags_backup = get_flags(list(known))
     set_flags(known)
     env_backup = {k: os.environ.get(k)
                   for k in ("PT_STABILITY_POLICY",
@@ -227,6 +231,7 @@ def replay(bundle: str, quiet: bool = False) -> dict:
             print(json.dumps(report, indent=1))
         return report
     finally:
+        set_flags(flags_backup)
         for k, v in env_backup.items():
             if v is None:
                 os.environ.pop(k, None)

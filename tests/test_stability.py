@@ -324,3 +324,28 @@ def test_policy_map_parsing():
         "nonfinite": "abort", "spike": "rescale"}
     with pytest.raises(ValueError):
         policy_map("nonfinite=explode")
+
+
+def test_dygraph_guard_reduced_readonly_buffer():
+    # regression: the dygraph allreduce hands _guard_reduced a numpy
+    # VIEW of a jax.Array (writeable=False) — the skip recovery must
+    # return a zeroed replacement bucket, not mutate in place
+    import jax.numpy as jnp
+    from paddle_tpu.dygraph.parallel import DataParallel
+    set_flags({"FLAGS_stability_guard": True})
+    os.environ["PT_STABILITY_POLICY"] = "skip"
+    dp = DataParallel.__new__(DataParallel)
+    bad = np.asarray(jnp.asarray([np.nan, 2.0], dtype=jnp.float32))
+    assert not bad.flags.writeable
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        fixed = dp._guard_reduced(bad, [None], [(2,)])
+    np.testing.assert_array_equal(
+        fixed, np.zeros(2, np.float32))
+    # a finite bucket passes through unchanged
+    ok = np.asarray(jnp.asarray([1.0, 2.0], dtype=jnp.float32))
+    assert dp._guard_reduced(ok, [None], [(2,)]) is ok
+    # abort raises instead of zeroing
+    os.environ["PT_STABILITY_POLICY"] = "abort"
+    with pytest.raises(EnforceNotMet):
+        dp._guard_reduced(bad, [None], [(2,)])
