@@ -302,6 +302,61 @@ def _probe_guard(eng, prog, scope, feed, fetch, sync_off_ms):
     return out
 
 
+def _probe_kernels(eng, prog, scope, feed, fetch, sync_on_ms):
+    """A/B the custom-kernel registry (FLAGS_use_custom_kernels,
+    docs/KERNELS.md) on the already-built transformer. The headline
+    sync step already ran with kernels ON (the flag defaults on); this
+    re-times the same step with the registry forced off — flag-aware
+    cache keys force a fresh all-lowered trace — so the delta is the
+    kernels' step-time contribution (dominated by the fused optimizer
+    sweep on TPU). Also snapshots the registry's trace-time dispatch
+    stats, after an interpret-mode dispatch self-check: one eligible
+    adam signature selected and executed through the registry on the
+    current backend, so the hit-rate is live even on CPU hosts where
+    the engine trace itself keeps the lowered paths."""
+    import jax
+    from paddle_tpu.core.flags import FLAGS, set_flags
+    from paddle_tpu.kernels import registry as kreg
+    prev = bool(FLAGS.use_custom_kernels)
+    out = {"sync_ms_on": round(sync_on_ms, 2)}
+
+    def _np(o):
+        return np.asarray(o.array if hasattr(o, "array") else o)
+
+    try:
+        prev_hook = kreg._INTERPRET
+        kreg._INTERPRET = True
+        try:
+            n = max(65536 * 2, kreg.min_numel())
+            z = jax.numpy.zeros((n,), jax.numpy.float32)
+            sel = kreg.select("adam",
+                              kreg.signature("adam", z, z, z, z))
+            if sel is not None:
+                sel.run(z, z, z, z + 1.0, 1e-3)[0].block_until_ready()
+        finally:
+            kreg._INTERPRET = prev_hook
+        out["dispatch"] = kreg.dispatch_stats()
+        set_flags({"FLAGS_use_custom_kernels": False})
+        batch = {k: jax.device_put(np.asarray(v))
+                 for k, v in feed.items()}
+        for _ in range(3):
+            o = eng.run(prog, scope, None, batch, fetch,
+                        return_numpy=False)
+        float(_np(o[0]))
+        ts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            float(_np(eng.run(prog, scope, None, batch, fetch,
+                              return_numpy=False)[0]))
+            ts.append(time.perf_counter() - t0)
+        out["sync_ms_off"] = round(sorted(ts)[len(ts) // 2] * 1e3, 2)
+    except Exception as exc:   # accounting only; never fail the bench
+        out["error"] = f"{type(exc).__name__}: {exc}"[:200]
+    finally:
+        set_flags({"FLAGS_use_custom_kernels": prev})
+    return out
+
+
 def bench_transformer(batch=BATCH, seq=None, measure_ckpt=False):
     import paddle_tpu as fluid
     from paddle_tpu import models
@@ -350,6 +405,10 @@ def bench_transformer(batch=BATCH, seq=None, measure_ckpt=False):
                 eng, main_prog, scope, feed, [cost.name], sync_ms)
             # guard-on sync A/B for the stability JSON tail
             stats["stability"] = _probe_guard(
+                eng, main_prog, scope, feed, [cost.name], sync_ms)
+            # kernels-off sync A/B + registry hit rates for the
+            # kernels JSON tail (ROADMAP open item 3)
+            stats["kernels"] = _probe_kernels(
                 eng, main_prog, scope, feed, [cost.name], sync_ms)
     return sps * batch * s_trg, sps, traj, sync_ms, stats
 
@@ -756,6 +815,12 @@ def main():
             (stats or {}).get("stability"))
     except Exception:
         pass   # accounting only; never fail the bench on it
+    kern, kern_line = {}, None
+    try:
+        from tools.kernel_bench import kernels_report
+        kern, kern_line = kernels_report((stats or {}).get("kernels"))
+    except Exception:
+        pass   # accounting only; never fail the bench on it
     chaos, chaos_line = {}, None
     if os.environ.get("PT_BENCH_CHAOS"):
         # opt-in: spawns a 2-trainer PS job twice (clean + faulted),
@@ -786,6 +851,7 @@ def main():
         "comm_overlap": comm or None,
         "scheduler_overlap": sched or None,
         "stability": stab or None,
+        "kernels": kern or None,
         "chaos": chaos or None,
         "metrics": metrics_tail or None,
     }))
@@ -795,6 +861,8 @@ def main():
         print(sched_line, file=sys.stderr)
     if stab_line:
         print(stab_line, file=sys.stderr)
+    if kern_line:
+        print(kern_line, file=sys.stderr)
     if chaos_line:
         print(chaos_line, file=sys.stderr)
     print(f"# transformer: steps/s={sps:.2f} "

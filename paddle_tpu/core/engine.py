@@ -1127,7 +1127,12 @@ class Engine:
                 os.environ.get("PT_STABILITY_POLICY", ""),
                 # GuardPlan bakes these into the compiled gate too
                 os.environ.get("PT_GUARD_SPIKE_FACTOR", ""),
-                os.environ.get("PT_GUARD_EMA_BETA", ""))
+                os.environ.get("PT_GUARD_EMA_BETA", ""),
+                # kernel-registry selection happens at trace time
+                bool(FLAGS.use_custom_kernels),
+                os.environ.get("PT_KERNEL_DENY", ""),
+                os.environ.get("PT_KERNEL_MIN_NUMEL", ""),
+                os.environ.get("PT_KERNEL_QUANT_MATMUL", ""))
 
     def compiled_step(self, program, scope: Scope, feed, fetch_names,
                       block_idx: int = 0, iterations: int = 1):
@@ -1230,7 +1235,12 @@ class Engine:
                 bool(FLAGS.stability_guard),
                 os.environ.get("PT_STABILITY_POLICY", ""),
                 os.environ.get("PT_GUARD_SPIKE_FACTOR", ""),
-                os.environ.get("PT_GUARD_EMA_BETA", ""))
+                os.environ.get("PT_GUARD_EMA_BETA", ""),
+                # kernel-registry selection happens at trace time
+                bool(FLAGS.use_custom_kernels),
+                os.environ.get("PT_KERNEL_DENY", ""),
+                os.environ.get("PT_KERNEL_MIN_NUMEL", ""),
+                os.environ.get("PT_KERNEL_QUANT_MATMUL", ""))
 
     def _fast_feed_arrays(self, entry: _FastPathEntry, feed):
         """Feed dict -> device arrays through the cached signature: no

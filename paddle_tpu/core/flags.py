@@ -190,6 +190,18 @@ _define("flight_recorder_steps", 64, True,
         "flight-recorder ring capacity: per-step span records retained "
         "for the postmortem dump (watchdog trip, PT_FAULT_PLAN, sticky "
         "async error, SIGTERM); sized at first use")
+# custom-kernel registry (paddle_tpu/kernels, docs/KERNELS.md)
+_define("use_custom_kernels", True, True,
+        "route eligible ops through the Pallas custom-kernel registry "
+        "(paddle_tpu/kernels/registry.py): fused Adam/SGD update, "
+        "quantized matmul, flash attention. Selection happens at trace "
+        "time inside the op lowerings, so the whole-block trace, the "
+        "FLAGS_op_scheduler island path, and dygraph all dispatch from "
+        "the same table; ops with no eligible kernel keep the lowered "
+        "path bit-identically. Per-kernel denial: PT_KERNEL_DENY="
+        "name[,name]; eligibility floor: PT_KERNEL_MIN_NUMEL. On CPU "
+        "backends kernels stay off unless the Pallas interpret-mode "
+        "test hook is armed (docs/KERNELS.md)")
 # training stability guard (paddle_tpu/stability, docs/STABILITY.md)
 _define("stability_guard", False, True,
         "training stability guard (paddle_tpu/stability): fuse a "

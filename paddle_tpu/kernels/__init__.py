@@ -6,4 +6,7 @@ a kernel pool, jit/README.md). On TPU the same role — hand-written
 kernels for ops the compiler doesn't fuse optimally — is filled by
 Pallas (pallas_call over VMEM blocks feeding the MXU/VPU).
 """
+from . import registry  # noqa: F401
 from .flash_attention import flash_attention  # noqa: F401
+from .fused_optimizer import bucket_sweep, fused_adam, fused_sgd  # noqa: F401,E501
+from .quantized_matmul import quantized_matmul  # noqa: F401

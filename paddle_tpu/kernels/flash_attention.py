@@ -958,16 +958,24 @@ _KERNEL_MIN_SEQ_PRODUCT = 1024 * 1024      # Sq * Sk
 
 def use_kernel_path(q, k, block_q=128, block_k=128, layout="bhsd"):
     """True when the fused-attention op should route through the Pallas
-    kernels rather than the composed einsum formulation."""
+    kernels rather than the composed einsum formulation.
+
+    Registry-governed: FLAGS_use_custom_kernels off (or
+    "flash_attention" in PT_KERNEL_DENY) forces the composed path, and
+    every trace-time decision lands in the dispatch stats /
+    pt_kernel_dispatch_total, like registry-selected kernels."""
     import os
-    if not _kernel_ok(q, k, block_q, block_k, layout):
+    from . import registry as _kreg
+    if not _kreg.allowed("flash_attention"):
+        _kreg.count("flash_attention", "denied")
         return False
-    if _INTERPRET:
-        return True
-    if os.environ.get("PT_FORCE_KERNEL"):   # A/B-measurement knob
-        return True
-    return (_seq_len(q, layout) * _seq_len(k, layout)
-            >= _KERNEL_MIN_SEQ_PRODUCT)
+    ok = _kernel_ok(q, k, block_q, block_k, layout)
+    if ok and not _INTERPRET \
+            and not os.environ.get("PT_FORCE_KERNEL"):
+        ok = (_seq_len(q, layout) * _seq_len(k, layout)
+              >= _KERNEL_MIN_SEQ_PRODUCT)
+    _kreg.count("flash_attention", "custom" if ok else "lowered")
+    return ok
 
 
 def _attn_reference(q, k, v, bias, scale, layout="bhsd",
@@ -1015,12 +1023,17 @@ def _attn_reference_lse(q, k, v, bias, scale, causal=False):
     return out, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
 def flash_attention(q, k, v, bias=None, scale=1.0, block_q=128,
-                    block_k=128, layout="bhsd", causal=False):
+                    block_k=128, layout="bhsd", causal=False,
+                    need_dbias=None):
     """q [B,H,Sq,D] (bhsd) or [B,Sq,H,D] (bshd); k/v likewise;
     bias [B,1|H,Sq|1,Sk] additive in either layout; causal masks to
-    rows >= cols and SKIPS fully-masked KV blocks in the kernels."""
+    rows >= cols and SKIPS fully-masked KV blocks in the kernels.
+    need_dbias (static): False suppresses the ds/dbias backward output
+    entirely — a multi-output Pallas call cannot DCE the ds tile, so
+    callers that never read the bias gradient must say so here; None
+    (default) keeps the historical behavior (dbias iff bias given)."""
     if _kernel_ok(q, k, block_q, block_k, layout):
         return _fa_forward(q, k, v, bias, scale, block_q, block_k,
                            layout=layout, causal=causal)
@@ -1031,7 +1044,8 @@ def flash_attention(q, k, v, bias=None, scale=1.0, block_q=128,
     return jnp.moveaxis(out, 1, 2) if layout == "bshd" else out
 
 
-def _fa_fwd(q, k, v, bias, scale, block_q, block_k, layout, causal):
+def _fa_fwd(q, k, v, bias, scale, block_q, block_k, layout, causal,
+            need_dbias):
     if _kernel_ok(q, k, block_q, block_k, layout):
         # lse residual stays in the kernel's wide carrier layout;
         # _kernel_ok is static, so _fa_bwd re-derives the same branch
@@ -1049,21 +1063,25 @@ def _fa_fwd(q, k, v, bias, scale, block_q, block_k, layout, causal):
     return out, (q, k, v, bias, out, lse)
 
 
-def _fa_bwd(scale, block_q, block_k, layout, causal, res, g):
+def _fa_bwd(scale, block_q, block_k, layout, causal, need_dbias, res,
+            g):
     q, k, v, bias, out, lse = res
+    want_dbias = (bias is not None) if need_dbias is None \
+        else bool(need_dbias)
     if use_kernel_path(q, k, block_q, block_k, layout):
         dq, dk, dv, dbias = _fa_backward(
             q, k, v, bias, out, lse, g, scale, block_q, block_k,
-            layout=layout, causal=causal,
+            layout=layout, causal=causal, want_dbias=want_dbias,
             lse_wide=_kernel_ok(q, k, block_q, block_k, layout))
-        return dq, dk, dv, dbias
+        return dq, dk, dv, dbias if want_dbias else None
 
     def f(q, k, v, bias):
         return _attn_reference(q, k, v, bias, scale, layout=layout,
                                causal=causal)
     _, vjp = jax.vjp(f, q, k, v, bias)
     dq, dk, dv, dbias = vjp(g)
-    return dq, dk, dv, None if bias is None else dbias
+    return dq, dk, dv, dbias if want_dbias and bias is not None \
+        else None
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
@@ -1115,3 +1133,28 @@ def _fal_bwd(scale, block_q, block_k, res, g):
 
 
 flash_attention_lse.defvjp(_fal_fwd, _fal_bwd)
+
+
+# ---------------------------------------------------------------------------
+# registry entry — dispatch itself stays in use_kernel_path (the
+# sequence-keyed crossover above needs more context than a Signature
+# carries), but registering here puts flash attention in the same
+# deny/flag/stats/parity surface as every other custom kernel.
+# ---------------------------------------------------------------------------
+from . import registry as _kreg  # noqa: E402
+
+
+def _fa_eligible(sig):
+    # shape-keyed dispatch lives in use_kernel_path/_kernel_ok; the
+    # registry entry exists for governance (flag/deny), attribution,
+    # and parity completeness.
+    return True
+
+
+_kreg.register_kernel(
+    "flash_attention", op_types=("fused_attention",),
+    eligible=_fa_eligible, run=flash_attention,
+    source_tag="flash_attention.py",
+    doc="online-softmax attention fwd + dq/dkv bwd (O(S) memory); "
+        "sequence-keyed crossover vs the composed path in "
+        "use_kernel_path")

@@ -1,0 +1,267 @@
+"""Numerics-parity harness: every registered kernel vs its lowered op.
+
+The generate-and-verify loop (PAPERS.md "Agentic Operator Generation
+for ML ASICs"): a custom kernel is only trusted while a parity case
+demonstrates, on every test run, that it matches the lowered-op
+baseline it replaces.  The harness
+
+* runs each case's BASELINE through the real op lowering
+  (``core.registry.OPS``) with the kernel registry force-disabled, so
+  the reference really is the path users get with kernels off;
+* runs the kernel directly (kernels execute under the Pallas
+  interpreter on CPU — see ``registry.interpret()`` — so this gates in
+  tier-1 CI under ``JAX_PLATFORMS=cpu``);
+* compares under a per-dtype tolerance: **ulp** bounds for
+  value-preserving kernels (fused optimizer: same math, same
+  operation order, tolerance a handful of ulp), **relative-error**
+  bounds for value-approximating kernels (quantized matmul, flash
+  attention's online softmax).
+
+``tools/lint_program.py --check-kernels`` fails the build when a
+registered kernel has no parity case (:func:`missing_parity`);
+``tests/test_kernels.py`` runs :func:`run_all` case by case.
+
+Tolerance policy (docs/KERNELS.md): f32 value-preserving <= 4 ulp;
+rel-error kernels get per-mode bounds (int8 5e-2, bf16 1e-2, flash
+attention 2e-3 on f32 data) measured on unit-scale random data with a
+fixed seed — loosening a bound is a reviewed change, not a test edit.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Dict, List
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from . import registry
+
+__all__ = ["cases", "run_case", "run_all", "missing_parity",
+           "max_ulp", "rel_err"]
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+def max_ulp(ref, got) -> float:
+    """Largest elementwise |got - ref| in units of ref's last place."""
+    ref = np.asarray(ref)
+    got = np.asarray(got)
+    dt = ref.dtype if ref.dtype.kind == "f" else np.dtype(np.float32)
+    if ref.size == 0:
+        return 0.0
+    spacing = np.spacing(
+        np.maximum(np.abs(ref), np.finfo(dt).tiny).astype(dt)
+    ).astype(np.float64)
+    diff = np.abs(ref.astype(np.float64) - got.astype(np.float64))
+    return float(np.max(diff / spacing))
+
+
+def rel_err(ref, got) -> float:
+    ref = np.asarray(ref, np.float64)
+    got = np.asarray(got, np.float64)
+    denom = np.linalg.norm(ref.ravel())
+    return float(np.linalg.norm((got - ref).ravel())
+                 / max(denom, 1e-30))
+
+
+@contextlib.contextmanager
+def _kernels_disabled():
+    """Run the baseline with registry selection off, so the lowered
+    path is the real lowered path even when a test armed the
+    interpret-mode hook."""
+    from ..core.flags import FLAGS, set_flags
+    prev = bool(FLAGS.use_custom_kernels)
+    set_flags({"FLAGS_use_custom_kernels": False})
+    try:
+        yield
+    finally:
+        set_flags({"FLAGS_use_custom_kernels": prev})
+
+
+def _run_lowered(op_type: str, inputs: Dict[str, List[str]],
+                 outputs: Dict[str, List[str]],
+                 attrs: Dict[str, Any], env: Dict[str, Any]):
+    """Execute one op through its registered lowering; returns env.
+
+    The lowering runs under jax.jit, like it does inside the engine's
+    whole-block trace — XLA's instruction contraction (FMA) is part of
+    the baseline numerics, and eager op-by-op execution would misstate
+    them (cancellation-heavy terms land tens of ulp away)."""
+    import jax
+    from ..core.registry import OPS, ExecContext, _SlotView
+    names = sorted(env)
+    out_names = [n for ns in outputs.values() for n in ns]
+
+    def f(vals):
+        local = dict(zip(names, vals))
+        op = _SlotView(op_type, inputs, outputs, attrs)
+        OPS.get(op_type).lowering(ExecContext(op, local))
+        return {n: local[n] for n in out_names}
+
+    with _kernels_disabled():
+        env.update(jax.jit(f)([env[n] for n in names]))
+    return env
+
+
+# ---------------------------------------------------------------------------
+# cases
+# ---------------------------------------------------------------------------
+
+class Case:
+    """One (kernel, configuration) parity check."""
+
+    __slots__ = ("kernel", "label", "runner")
+
+    def __init__(self, kernel: str, label: str,
+                 runner: Callable[[], Dict[str, Any]]):
+        self.kernel = kernel      # registered kernel name
+        self.label = label
+        self.runner = runner
+
+    def __repr__(self):
+        return "Case(%s)" % (self.label,)
+
+
+def _rng(seed=0):
+    return np.random.default_rng(seed)
+
+
+def _adam_case(shape):
+    def run():
+        r = _rng(7)
+        p = r.standard_normal(shape, dtype=np.float32)
+        g = r.standard_normal(shape, dtype=np.float32)
+        m = 0.1 * r.standard_normal(shape, dtype=np.float32)
+        v = np.abs(0.01 * r.standard_normal(shape, dtype=np.float32))
+        lr = np.float32(1e-3)
+        b1p, b2p = np.float32(0.9 ** 3), np.float32(0.999 ** 3)
+        env = {"p": jnp.asarray(p), "g": jnp.asarray(g),
+               "m": jnp.asarray(m), "v": jnp.asarray(v),
+               "lr": jnp.asarray(lr).reshape(1),
+               "b1p": jnp.asarray(b1p).reshape(1),
+               "b2p": jnp.asarray(b2p).reshape(1)}
+        _run_lowered(
+            "adam",
+            {"Param": ["p"], "Grad": ["g"], "Moment1": ["m"],
+             "Moment2": ["v"], "LearningRate": ["lr"],
+             "Beta1Pow": ["b1p"], "Beta2Pow": ["b2p"]},
+            {"ParamOut": ["po"], "Moment1Out": ["mo"],
+             "Moment2Out": ["vo"], "Beta1PowOut": [],
+             "Beta2PowOut": []},
+            {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8}, env)
+        from .fused_optimizer import fused_adam
+        lr_t = lr * np.sqrt(1 - b2p) / (1 - b1p)
+        po, mo, vo = fused_adam(jnp.asarray(p), jnp.asarray(g),
+                                jnp.asarray(m), jnp.asarray(v),
+                                jnp.asarray(lr_t), beta1=0.9,
+                                beta2=0.999, epsilon=1e-8)
+        return {"metric": "ulp", "tol": 4.0,
+                "value": max(max_ulp(env["po"], po),
+                             max_ulp(env["mo"], mo),
+                             max_ulp(env["vo"], vo))}
+    return Case("fused_adam", "fused_adam/f32/%s" % (shape,), run)
+
+
+def _sgd_case(shape):
+    def run():
+        r = _rng(11)
+        p = r.standard_normal(shape, dtype=np.float32)
+        g = r.standard_normal(shape, dtype=np.float32)
+        lr = np.float32(0.05)
+        env = {"p": jnp.asarray(p), "g": jnp.asarray(g),
+               "lr": jnp.asarray(lr).reshape(1)}
+        _run_lowered(
+            "sgd",
+            {"Param": ["p"], "Grad": ["g"], "LearningRate": ["lr"]},
+            {"ParamOut": ["po"]}, {}, env)
+        from .fused_optimizer import fused_sgd
+        po = fused_sgd(jnp.asarray(p), jnp.asarray(g),
+                       jnp.asarray(lr))
+        return {"metric": "ulp", "tol": 4.0,
+                "value": max_ulp(env["po"], po)}
+    return Case("fused_sgd", "fused_sgd/f32/%s" % (shape,), run)
+
+
+def _qmm_case(mode, tol):
+    def run():
+        r = _rng(13)
+        x = r.standard_normal((256, 384), dtype=np.float32)
+        y = r.standard_normal((384, 128), dtype=np.float32)
+        env = {"x": jnp.asarray(x), "y": jnp.asarray(y)}
+        _run_lowered("mul", {"X": ["x"], "Y": ["y"]},
+                     {"Out": ["out"]},
+                     {"x_num_col_dims": 1, "y_num_col_dims": 1}, env)
+        from .quantized_matmul import quantized_matmul
+        got = quantized_matmul(jnp.asarray(x), jnp.asarray(y),
+                               mode=mode)
+        return {"metric": "rel", "tol": tol,
+                "value": rel_err(env["out"], got)}
+    return Case("quantized_matmul",
+                "quantized_matmul/%s/256x384x128" % mode, run)
+
+
+def _fa_case():
+    def run():
+        import importlib
+        # the package re-exports the flash_attention FUNCTION under the
+        # module's name; go through importlib for the module itself
+        fa = importlib.import_module(
+            "paddle_tpu.kernels.flash_attention")
+        r = _rng(17)
+        q = r.standard_normal((1, 2, 256, 64), dtype=np.float32)
+        k = r.standard_normal((1, 2, 256, 64), dtype=np.float32)
+        v = r.standard_normal((1, 2, 256, 64), dtype=np.float32)
+        scale = 0.125
+        ref = fa._attn_reference(jnp.asarray(q), jnp.asarray(k),
+                                 jnp.asarray(v), None, scale)
+        prev = fa._INTERPRET
+        fa._INTERPRET = True
+        try:
+            got = fa.flash_attention(jnp.asarray(q), jnp.asarray(k),
+                                     jnp.asarray(v), None, scale,
+                                     128, 128)
+        finally:
+            fa._INTERPRET = prev
+        return {"metric": "rel", "tol": 2e-3,
+                "value": rel_err(ref, got)}
+    return Case("flash_attention", "flash_attention/f32/1x2x256x64",
+                run)
+
+
+def cases() -> List[Case]:
+    """Every parity case; keyed to registered kernel names."""
+    # import for side effect: ensure all kernels are registered before
+    # completeness is judged
+    import importlib
+    from . import fused_optimizer, quantized_matmul  # noqa: F401
+    importlib.import_module("paddle_tpu.kernels.flash_attention")
+    return [
+        _adam_case((4096,)),
+        _adam_case((513, 7)),       # padding tail exercised
+        _sgd_case((2048,)),
+        _sgd_case((129, 5)),
+        _qmm_case("int8", 5e-2),
+        _qmm_case("bf16", 1e-2),
+        _fa_case(),
+    ]
+
+
+def run_case(case: Case) -> Dict[str, Any]:
+    res = case.runner()
+    res.update(kernel=case.kernel, label=case.label,
+               passed=bool(res["value"] <= res["tol"]))
+    return res
+
+
+def run_all() -> List[Dict[str, Any]]:
+    return [run_case(c) for c in cases()]
+
+
+def missing_parity() -> List[str]:
+    """Registered kernels with no parity case (lint surface)."""
+    covered = {c.kernel for c in cases()}
+    return [n for n in registry.kernel_names() if n not in covered]

@@ -425,6 +425,12 @@ def _install_standard_families(reg: MetricsRegistry) -> None:
     reg.histogram("pt_guard_overhead_seconds",
                   "host-side stability-guard controller time per step "
                   "(verdict read + policy + ghost capture)")
+    # custom-kernel registry (FLAGS_use_custom_kernels; docs/KERNELS.md)
+    reg.counter("pt_kernel_dispatch_total",
+                "trace-time kernel-registry decisions, labeled "
+                "{kernel, outcome} with outcome one of custom "
+                "(kernel selected), lowered (eligibility/backend kept "
+                "the lowered path), denied (flag or PT_KERNEL_DENY)")
     reg.register_collector(_engine_families)
     reg.register_collector(_rpc_families)
 

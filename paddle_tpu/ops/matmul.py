@@ -44,9 +44,17 @@ def mul(ctx):
     res_t = jnp.result_type(x, y)
     x2, y2 = _flat2d(x, xn), _flat2d(y, yn)
     x2, y2 = amp_cast("mul", x2, y2)
-    out = jnp.matmul(x2, y2,
-                     preferred_element_type=_acc_type(x2, y2) or res_t)
-    out = out.astype(res_t)
+    from ..kernels import registry as kreg
+    sel = None
+    if kreg.routable("mul"):
+        sel = kreg.select("mul", kreg.signature("mul", x2, y2))
+    if sel is not None:
+        out = sel.run(x2, y2, out_dtype=res_t)
+    else:
+        out = jnp.matmul(
+            x2, y2,
+            preferred_element_type=_acc_type(x2, y2) or res_t)
+        out = out.astype(res_t)
     ctx.set_output("Out", out.reshape(out_shape))
 
 
@@ -66,11 +74,20 @@ def matmul(ctx):
         y = jnp.swapaxes(y, -1, -2)
     res_t = jnp.result_type(x, y)
     x, y = amp_cast("matmul", x, y)
-    out = jnp.matmul(x, y,
-                     preferred_element_type=_acc_type(x, y) or res_t)
-    out = out.astype(res_t)
-    if alpha != 1.0:
-        out = out * alpha
+    sel = None
+    if x.ndim == 2 and y.ndim == 2 and alpha == 1.0:
+        from ..kernels import registry as kreg
+        if kreg.routable("matmul"):
+            sel = kreg.select("matmul",
+                              kreg.signature("matmul", x, y))
+    if sel is not None:
+        out = sel.run(x, y, out_dtype=res_t)
+    else:
+        out = jnp.matmul(
+            x, y, preferred_element_type=_acc_type(x, y) or res_t)
+        out = out.astype(res_t)
+        if alpha != 1.0:
+            out = out * alpha
     ctx.set_output("Out", out)
 
 

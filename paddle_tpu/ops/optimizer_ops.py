@@ -34,6 +34,13 @@ def sgd(ctx):
         ctx.set_output("ParamOut", p.at[g.rows].add(
             -lr * g.values, mode="drop"))
         return
+    from ..kernels import registry as kreg
+    sel = None
+    if kreg.routable("sgd"):
+        sel = kreg.select("sgd", kreg.signature("sgd", p, g))
+    if sel is not None:
+        ctx.set_output("ParamOut", sel.run(p, g, lr))
+        return
     ctx.set_output("ParamOut", p - lr * g)
 
 
@@ -113,9 +120,18 @@ def adam(ctx):
         ctx.set_output("Moment2Out", v.at[rows].set(
             v_new_r, mode="drop"))
     else:
-        m_new = b1 * m + (1 - b1) * g
-        v_new = b2 * v + (1 - b2) * g * g
-        p_new = p - lr_t * m_new / (jnp.sqrt(v_new) + eps)
+        from ..kernels import registry as kreg
+        sel = None
+        if kreg.routable("adam"):
+            sel = kreg.select("adam",
+                              kreg.signature("adam", p, g, m, v))
+        if sel is not None:
+            p_new, m_new, v_new = sel.run(p, g, m, v, lr_t, beta1=b1,
+                                          beta2=b2, epsilon=eps)
+        else:
+            m_new = b1 * m + (1 - b1) * g
+            v_new = b2 * v + (1 - b2) * g * g
+            p_new = p - lr_t * m_new / (jnp.sqrt(v_new) + eps)
         ctx.set_output("ParamOut", p_new)
         ctx.set_output("Moment1Out", m_new)
         ctx.set_output("Moment2Out", v_new)

@@ -119,13 +119,30 @@ _CATEGORIES = [
 ]
 
 
-def categorize(instr: Instr) -> str:
+def _registry_categories():
+    """Registry-derived (source fragment, category) pairs, checked
+    BEFORE the static table: instructions whose HLO metadata points
+    into a custom-kernel source file are attributed to that kernel by
+    name, so the breakdown says which buckets are custom Pallas vs
+    lowered XLA (docs/KERNELS.md). Static entries keep covering the
+    lowered paths (e.g. optimizer_ops.py when the fused kernel was not
+    selected)."""
+    try:
+        from paddle_tpu.kernels import registry as kreg
+        return [(tag, f"kernel:{names} (custom pallas)")
+                for tag, names in kreg.source_tags()
+                if tag != "flash_attention.py"]  # legacy label kept
+    except Exception:
+        return []
+
+
+def categorize(instr: Instr, extra=None) -> str:
     if instr.opcode == "parameter":
         return "(parameters)"
     if instr.opcode in ("constant", "iota"):
         return "(constants)"
     src = instr.src or ""
-    for frag, cat in _CATEGORIES:
+    for frag, cat in (extra or []) + _CATEGORIES:
         if frag in src:
             return cat
     if instr.op_name:
@@ -140,13 +157,14 @@ def breakdown(hlo_text: str, top: int = 25):
     instrs = parse_entry_computation(hlo_text)
     by_name = {i.name: i for i in instrs}
     agg = collections.defaultdict(lambda: [0, 0, 0, None])
+    reg_cats = _registry_categories()
     for i in instrs:
         if i.opcode in ("parameter", "constant", "tuple",
                         "get-tuple-element", "bitcast"):
             continue  # no HBM traffic of their own (reads counted at uses)
         read = sum(by_name[o].out_bytes for o in i.operands
                    if o in by_name)
-        cat = categorize(i)
+        cat = categorize(i, reg_cats)
         a = agg[cat]
         a[0] += read + i.out_bytes
         a[1] += i.out_bytes

@@ -174,8 +174,35 @@ def measure(B=4, H=8, S=4096, D=64, bq=512, bk=1024, k_short=2,
     return floor, rows
 
 
+def registry_attribution(file=None):
+    """Name which kernels are custom vs lowered for the roofline.
+
+    One line per registered kernel: the op types it claims, whether the
+    registry would currently route them to it (flag/deny state), and
+    the process-local dispatch counts — so a roofline row can be read
+    against which implementation actually produced it.  Backend-
+    independent (prints before the CPU bail)."""
+    from paddle_tpu.kernels import registry as kreg
+    stats = kreg.dispatch_stats()["per_kernel"]
+    print("# kernel registry (custom vs lowered):", file=file)
+    for kern in kreg.kernels():
+        gov = "custom" if kreg.allowed(kern.name) else "lowered (denied)"
+        c = stats.get(kern.name, {})
+        hits = ", ".join(f"{k}={v}" for k, v in sorted(c.items())) \
+            or "no dispatches yet"
+        print(f"#   {kern.name:<20} ops={','.join(kern.op_types):<18} "
+              f"{gov:<16} [{hits}]", file=file)
+    uncovered = sorted(
+        {"mul", "matmul", "adam", "sgd", "fused_attention"}
+        - {op for kern in kreg.kernels() for op in kern.op_types})
+    if uncovered:
+        print(f"#   (always lowered: {', '.join(uncovered)})",
+              file=file)
+
+
 def main():
     import jax
+    registry_attribution()
     if jax.default_backend() == "cpu":
         print("kernel_roofline: needs TPU hardware")
         return

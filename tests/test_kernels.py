@@ -1,0 +1,508 @@
+"""Custom-kernel subsystem (paddle_tpu/kernels, FLAGS_use_custom_kernels;
+docs/KERNELS.md).
+
+Covers the registry contract end to end on the CPU backend (kernels
+execute under the Pallas interpreter via the ``_INTERPRET`` hook):
+selection/fallback/deny gating, the numerics-parity harness for every
+registered kernel, fused-optimizer trajectory parity against the host
+optimizer through the real engine (plain, stability-guard-gated),
+bucket_sweep ZeRO-1 shard composition and in-kernel guard gating,
+quantized-matmul opt-in wiring, bit-identical fallback when nothing is
+eligible, cache-key awareness of the kernel flag and PT_KERNEL_* env,
+and the need_dbias ds-suppression regression for flash attention.
+"""
+import importlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.engine import Engine
+from paddle_tpu.core.flags import FLAGS, set_flags
+from paddle_tpu.core.scope import Scope
+from paddle_tpu.kernels import fused_optimizer as fo
+from paddle_tpu.kernels import parity
+from paddle_tpu.kernels import registry as kreg
+
+fa = importlib.import_module("paddle_tpu.kernels.flash_attention")
+
+
+@pytest.fixture(autouse=True)
+def _restore_flags():
+    yield
+    set_flags({"FLAGS_use_custom_kernels": True,
+               "FLAGS_stability_guard": False})
+
+
+@pytest.fixture
+def interp(monkeypatch):
+    """Arm the interpret-mode hook + drop the numel floor so the
+    registry selects kernels on the CPU backend."""
+    monkeypatch.setattr(kreg, "_INTERPRET", True)
+    monkeypatch.setenv("PT_KERNEL_MIN_NUMEL", "1")
+    yield
+
+
+def _sig_f32(op, *shapes):
+    arrs = [jnp.zeros(s, jnp.float32) for s in shapes]
+    return kreg.signature(op, *arrs)
+
+
+# ---------------------------------------------------------------------------
+# registry selection / fallback
+# ---------------------------------------------------------------------------
+
+def test_select_picks_fused_adam(interp):
+    sel = kreg.select("adam", _sig_f32("adam", (256,), (256,), (256,),
+                                       (256,)))
+    assert sel is not None and sel.name == "fused_adam"
+
+
+def test_select_respects_flag(interp):
+    set_flags({"FLAGS_use_custom_kernels": False})
+    assert kreg.select("adam", _sig_f32("adam", (256,))) is None
+    set_flags({"FLAGS_use_custom_kernels": True})
+    assert kreg.select("adam", _sig_f32("adam", (256,))) is not None
+
+
+def test_select_respects_deny(interp, monkeypatch):
+    monkeypatch.setenv("PT_KERNEL_DENY", "fused_adam, fused_sgd")
+    assert kreg.select("adam", _sig_f32("adam", (256,))) is None
+    assert kreg.select("sgd", _sig_f32("sgd", (256,))) is None
+    assert not kreg.allowed("fused_adam")
+    assert kreg.allowed("quantized_matmul")
+
+
+def test_select_rejects_wrong_dtype_and_size(interp, monkeypatch):
+    sig = kreg.signature("adam", jnp.zeros((256,), jnp.int32))
+    assert kreg.select("adam", sig) is None
+    monkeypatch.setenv("PT_KERNEL_MIN_NUMEL", "100000")
+    assert kreg.select("adam", _sig_f32("adam", (256,))) is None
+
+
+def test_select_off_on_cpu_without_hook():
+    # no interp fixture: the CPU backend must keep the lowered path
+    assert not kreg._INTERPRET
+    assert kreg.select("adam", _sig_f32("adam", (1 << 20,))) is None
+
+
+def test_routable_pre_gate(interp):
+    # lowerings consult routable() before paying for a Signature: it
+    # must agree with select()'s structural gates
+    assert kreg.routable("adam") and kreg.routable("mul")
+    assert not kreg.routable("layer_norm")
+    set_flags({"FLAGS_use_custom_kernels": False})
+    assert not kreg.routable("adam")
+    set_flags({"FLAGS_use_custom_kernels": True})
+
+
+def test_routable_off_on_cpu_without_hook():
+    assert not kreg._INTERPRET
+    assert not kreg.routable("adam")
+
+
+def test_dispatch_stats_and_metric(interp):
+    from paddle_tpu.observability import metrics
+    kreg.reset_stats()
+    before = metrics.counter("pt_kernel_dispatch_total").get(
+        kernel="fused_adam", outcome="custom")
+    assert kreg.select("adam", _sig_f32("adam", (256,))) is not None
+    st = kreg.dispatch_stats()
+    assert st["per_kernel"]["fused_adam"]["custom"] == 1
+    assert st["custom"] == 1 and st["hit_rate"] > 0
+    after = metrics.counter("pt_kernel_dispatch_total").get(
+        kernel="fused_adam", outcome="custom")
+    assert after == before + 1
+
+
+def test_unknown_op_selects_nothing(interp):
+    assert kreg.select("layer_norm", _sig_f32("layer_norm",
+                                              (256,))) is None
+
+
+# ---------------------------------------------------------------------------
+# numerics parity (the tier-1 gate for every registered kernel)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("case", parity.cases(),
+                         ids=lambda c: c.label)
+def test_parity(case):
+    res = parity.run_case(case)
+    assert res["passed"], (
+        f"{res['label']}: {res['metric']}={res['value']:.4g} "
+        f"exceeds tol {res['tol']}")
+
+
+def test_parity_covers_every_kernel():
+    assert parity.missing_parity() == []
+
+
+def test_lint_check_kernels_exit_code():
+    from tools.lint_program import main as lint_main
+    assert lint_main(["--check-kernels"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# engine trajectory parity: fused optimizer vs host optimizer
+# ---------------------------------------------------------------------------
+
+def _mlp_adam():
+    x = layers.data(name="x", shape=[64], dtype="float32")
+    y = layers.data(name="y", shape=[1], dtype="int64")
+    h = layers.fc(x, size=48, act="relu")
+    pred = layers.fc(h, size=10, act="softmax")
+    loss = layers.mean(layers.cross_entropy(input=pred, label=y))
+    fluid.optimizer.AdamOptimizer(learning_rate=1e-2).minimize(loss)
+    return loss
+
+
+def _feed(batch=16, seed=0):
+    r = np.random.default_rng(seed)
+    return {"x": r.standard_normal((batch, 64)).astype(np.float32),
+            "y": r.integers(0, 10, (batch, 1)).astype(np.int64)}
+
+
+def _train(steps=4, seed=7):
+    """Fresh program/scope/engine; returns (losses, params)."""
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    startup.random_seed = seed
+    with fluid.program_guard(main, startup):
+        loss = _mlp_adam()
+    scope = Scope()
+    feed = _feed()
+    losses = []
+    with fluid.scope_guard(scope):
+        fluid.Executor().run(startup)
+        eng = Engine()
+        for _ in range(steps):
+            out = eng.run(main, scope, None, feed, [loss.name])
+            losses.append(float(np.asarray(out[0])))
+        params = {n: np.array(scope.var(n).get_tensor()._array)
+                  for n in sorted(main.global_block().vars)
+                  if main.global_block().vars[n].persistable
+                  and scope.find_var(n) is not None
+                  and scope.find_var(n).is_initialized()
+                  and hasattr(scope.var(n).get_tensor(), "_array")}
+    return losses, params
+
+
+def _assert_params_close(a, b, ulp_tol):
+    assert a.keys() == b.keys()
+    for n in a:
+        if a[n].dtype.kind != "f":
+            np.testing.assert_array_equal(a[n], b[n], err_msg=n)
+            continue
+        u = parity.max_ulp(a[n], b[n])
+        assert u <= ulp_tol, f"{n}: {u} ulp > {ulp_tol}"
+
+
+def test_engine_trajectory_parity(interp):
+    set_flags({"FLAGS_use_custom_kernels": False})
+    l_host, p_host = _train()
+    set_flags({"FLAGS_use_custom_kernels": True})
+    l_kern, p_kern = _train()
+    # losses come off the forward (identical either way); params go
+    # through 4 fused adam steps — same math, same op order, a few
+    # ulp of XLA-fusion slack
+    np.testing.assert_allclose(l_host, l_kern, rtol=1e-6)
+    _assert_params_close(p_host, p_kern, ulp_tol=32.0)
+
+
+def test_engine_trajectory_parity_with_guard(interp):
+    set_flags({"FLAGS_stability_guard": True,
+               "FLAGS_use_custom_kernels": False})
+    l_host, p_host = _train()
+    set_flags({"FLAGS_use_custom_kernels": True})
+    l_kern, p_kern = _train()
+    np.testing.assert_allclose(l_host, l_kern, rtol=1e-6)
+    _assert_params_close(p_host, p_kern, ulp_tol=32.0)
+
+
+def test_kernels_on_no_eligible_bit_identical():
+    """With kernels on but nothing eligible (CPU backend, no interpret
+    hook) the trace must be the lowered trace, bit for bit."""
+    set_flags({"FLAGS_use_custom_kernels": False})
+    l_off, p_off = _train()
+    set_flags({"FLAGS_use_custom_kernels": True})
+    l_on, p_on = _train()
+    assert l_off == l_on
+    for n in p_off:
+        np.testing.assert_array_equal(p_off[n], p_on[n], err_msg=n)
+
+
+# ---------------------------------------------------------------------------
+# bucket sweep: ZeRO-1 shards + stability-guard gate
+# ---------------------------------------------------------------------------
+
+def _host_adam_flat(p, g, m, v, lr, b1=0.9, b2=0.999, eps=1e-8,
+                    b1p=0.9 ** 2, b2p=0.999 ** 2):
+    @jax.jit
+    def f(p, g, m, v):
+        # pows are f32 tensors in the engine (Beta1Pow/Beta2Pow scope
+        # vars), so 1 - pow cancels in f32 — replicate that here or the
+        # folded lr_t differs by ~1e-5 relative
+        lr_t = (lr * jnp.sqrt(1.0 - jnp.float32(b2p))
+                / (1.0 - jnp.float32(b1p)))
+        m2 = b1 * m + (1 - b1) * g
+        v2 = b2 * v + (1 - b2) * g * g
+        return p - lr_t * m2 / (jnp.sqrt(v2) + eps), m2, v2
+    return f(p, g, m, v)
+
+
+# jit the sweeps like the engine does (a whole-block jit): an eager
+# interpret-mode run skips XLA's FMA contraction and diverges from the
+# jitted host baseline by O(1000) ulp on near-zero params — see the
+# rationale in kernels/parity.py
+_sweep_adam = jax.jit(lambda p, g, m, v: fo.bucket_sweep(
+    "adam", p, g, m, v, lr=1e-3, beta1_pow=0.9 ** 2,
+    beta2_pow=0.999 ** 2))
+_sweep_adam_shard = jax.jit(lambda p, g, m, v, idx: fo.bucket_sweep(
+    "adam", p, g, m, v, lr=1e-3, beta1_pow=0.9 ** 2,
+    beta2_pow=0.999 ** 2, shard=(idx, 2)))
+_sweep_adam_guard = jax.jit(lambda p, g, m, v, nf, sp, damp:
+                            fo.bucket_sweep(
+                                "adam", p, g, m, v, lr=1e-3,
+                                beta1_pow=0.9 ** 2,
+                                beta2_pow=0.999 ** 2,
+                                guard=(nf, sp, damp)))
+_sweep_sgd = jax.jit(lambda p, g: fo.bucket_sweep("sgd", p, g, lr=0.1))
+
+
+def _flats(n, seed=5):
+    r = np.random.default_rng(seed)
+    return (jnp.asarray(r.standard_normal(n, dtype=np.float32)),
+            jnp.asarray(r.standard_normal(n, dtype=np.float32)),
+            jnp.asarray(0.1 * r.standard_normal(n, dtype=np.float32)),
+            jnp.asarray(np.abs(
+                0.01 * r.standard_normal(n, dtype=np.float32))))
+
+
+def test_bucket_sweep_matches_host():
+    n = 256 * 128          # one block, no padding
+    p, g, m, v = _flats(n)
+    ph, mh, vh = _host_adam_flat(p, g, m, v, 1e-3)
+    pk, mk, vk = _sweep_adam(p, g, m, v)
+    assert parity.max_ulp(ph, pk) <= 4
+    assert parity.max_ulp(mh, mk) <= 4
+    assert parity.max_ulp(vh, vk) <= 4
+
+
+def test_bucket_sweep_zero1_shards():
+    """Each replica's sharded sweep updates only its slice; the
+    concatenation of per-shard slices is the full host update — the
+    ZeRO-1 composition (sharded_update_spec shards dim 0 evenly)."""
+    n = 2 * 256 * 128      # two blocks -> two 128-lane-aligned shards
+    p, g, m, v = _flats(n)
+    ph, _, _ = _host_adam_flat(p, g, m, v, 1e-3)
+    half = n // 2
+    got = np.empty(n, np.float32)
+    for idx in (0, 1):
+        pk, _, _ = _sweep_adam_shard(p, g, m, v, jnp.int32(idx))
+        pk = np.asarray(pk)
+        lo, hi = idx * half, (idx + 1) * half
+        # inside the shard: updated; outside: old values untouched
+        other = np.r_[0:lo, hi:n]
+        np.testing.assert_array_equal(pk[other], np.asarray(p)[other])
+        got[lo:hi] = pk[lo:hi]
+    assert parity.max_ulp(ph, got) <= 4
+
+
+def test_bucket_sweep_guard_gate():
+    """In-kernel gate == stability/guard.py _gate_value: nonfinite
+    reverts to old, spike damps old + (new-old)*damp, clean selects
+    new bit-exactly."""
+    n = 256 * 128
+    p, g, m, v = _flats(n)
+    ph, mh, vh = _host_adam_flat(p, g, m, v, 1e-3)
+
+    def sweep(guard):
+        return _sweep_adam_guard(p, g, m, v, *guard)
+
+    # clean step: gate must not perturb a single bit
+    pk, mk, vk = sweep((jnp.float32(0), jnp.float32(0),
+                        jnp.float32(0)))
+    assert parity.max_ulp(ph, pk) <= 4
+    # nonfinite verdict: full revert of param AND moments
+    pk, mk, vk = sweep((jnp.float32(1), jnp.float32(0),
+                        jnp.float32(0)))
+    np.testing.assert_array_equal(np.asarray(pk), np.asarray(p))
+    np.testing.assert_array_equal(np.asarray(mk), np.asarray(m))
+    np.testing.assert_array_equal(np.asarray(vk), np.asarray(v))
+    # spike with damping 0.5: old + (new - old)*0.5
+    pk, _, _ = sweep((jnp.float32(0), jnp.float32(1),
+                      jnp.float32(0.5)))
+    want = np.asarray(p) + (np.asarray(ph) - np.asarray(p)) * 0.5
+    np.testing.assert_allclose(np.asarray(pk), want, rtol=1e-6,
+                               atol=1e-7)
+    # spike with damping 0 == revert policies
+    pk, _, _ = sweep((jnp.float32(0), jnp.float32(1), jnp.float32(0)))
+    np.testing.assert_array_equal(np.asarray(pk), np.asarray(p))
+
+
+def test_bucket_sweep_sgd_and_padding():
+    n = 1000                    # forces a padded tail
+    r = np.random.default_rng(9)
+    p = jnp.asarray(r.standard_normal(n, dtype=np.float32))
+    g = jnp.asarray(r.standard_normal(n, dtype=np.float32))
+    pk = _sweep_sgd(p, g)
+    np.testing.assert_allclose(np.asarray(pk),
+                               np.asarray(p) - 0.1 * np.asarray(g),
+                               rtol=1e-6, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# quantized matmul wiring
+# ---------------------------------------------------------------------------
+
+def test_quant_matmul_requires_opt_in(interp):
+    sig = _sig_f32("mul", (128, 256), (256, 128))
+    assert kreg.select("mul", sig) is None   # env not set
+
+
+def test_quant_matmul_selected_and_wired(interp, monkeypatch):
+    monkeypatch.setenv("PT_KERNEL_QUANT_MATMUL", "int8")
+    sig = _sig_f32("mul", (128, 256), (256, 128))
+    sel = kreg.select("mul", sig)
+    assert sel is not None and sel.name == "quantized_matmul"
+    # shape gates: non-128-multiple dims keep the lowered path
+    assert kreg.select("mul", _sig_f32("mul", (100, 256),
+                                       (256, 128))) is None
+
+    # through the real mul lowering (what the engine traces)
+    from paddle_tpu.core.registry import OPS, ExecContext, _SlotView
+    r = np.random.default_rng(2)
+    x = jnp.asarray(r.standard_normal((128, 256), dtype=np.float32))
+    y = jnp.asarray(r.standard_normal((256, 128), dtype=np.float32))
+    env = {"x": x, "y": y}
+    op = _SlotView("mul", {"X": ["x"], "Y": ["y"]}, {"Out": ["o"]},
+                   {"x_num_col_dims": 1, "y_num_col_dims": 1})
+    OPS.get("mul").lowering(ExecContext(op, env))
+    ref = np.asarray(jnp.matmul(x, y))
+    assert parity.rel_err(ref, env["o"]) < 5e-2
+    # the int8 path is NOT the f32 path (it actually quantized)
+    assert not np.array_equal(ref, np.asarray(env["o"]))
+
+
+# ---------------------------------------------------------------------------
+# cache keys (stale-artifact bug class, PR 8 review)
+# ---------------------------------------------------------------------------
+
+def test_kernel_flag_in_cache_key():
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 7
+    startup.random_seed = 7
+    with fluid.program_guard(main, startup):
+        loss = _mlp_adam()
+    scope = Scope()
+    feed = _feed()
+    with fluid.scope_guard(scope):
+        fluid.Executor().run(startup)
+        eng = Engine()
+        set_flags({"FLAGS_use_custom_kernels": True})
+        eng.run(main, scope, None, feed, [loss.name])
+        t0 = eng.counters["traces"]
+        set_flags({"FLAGS_use_custom_kernels": False})
+        eng.run(main, scope, None, feed, [loss.name])
+        assert eng.counters["traces"] == t0 + 1
+
+
+def test_kernel_env_in_cache_key(monkeypatch):
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = 7
+    startup.random_seed = 7
+    with fluid.program_guard(main, startup):
+        loss = _mlp_adam()
+    scope = Scope()
+    feed = _feed()
+    with fluid.scope_guard(scope):
+        fluid.Executor().run(startup)
+        eng = Engine()
+        eng.run(main, scope, None, feed, [loss.name])
+        t0 = eng.counters["traces"]
+        monkeypatch.setenv("PT_KERNEL_DENY", "fused_adam")
+        eng.run(main, scope, None, feed, [loss.name])
+        assert eng.counters["traces"] == t0 + 1
+        monkeypatch.setenv("PT_KERNEL_QUANT_MATMUL", "int8")
+        eng.run(main, scope, None, feed, [loss.name])
+        assert eng.counters["traces"] == t0 + 2
+
+
+# ---------------------------------------------------------------------------
+# flash attention: need_dbias ds suppression (satellite regression)
+# ---------------------------------------------------------------------------
+
+def _fa_shapes():
+    r = np.random.default_rng(4)
+    q = jnp.asarray(r.standard_normal((1, 2, 128, 64)) * 0.3,
+                    jnp.float32)
+    k = jnp.asarray(r.standard_normal((1, 2, 128, 64)) * 0.3,
+                    jnp.float32)
+    v = jnp.asarray(r.standard_normal((1, 2, 128, 64)) * 0.3,
+                    jnp.float32)
+    b = jnp.asarray(r.standard_normal((1, 2, 128, 128)) * 0.1,
+                    jnp.float32)
+    return q, k, v, b
+
+
+def test_need_dbias_false_has_no_ds_output(monkeypatch):
+    monkeypatch.setattr(fa, "_INTERPRET", True)
+    q, k, v, b = _fa_shapes()
+
+    def loss(need_dbias):
+        def f(q):
+            return fa.flash_attention(q, k, v, b, 0.125, 128, 128,
+                                      "bhsd", False, need_dbias).sum()
+        return f
+
+    with_ds = str(jax.make_jaxpr(jax.grad(loss(True)))(q))
+    no_ds = str(jax.make_jaxpr(jax.grad(loss(False)))(q))
+    # the forward bias reshape contributes [B*H, Sq, Sk] avals to both
+    # jaxprs; the EXTRA one in the need_dbias=True trace is the ds
+    # output of the dq pallas kernel — suppression must drop exactly it
+    ds_shape = "f32[2,128,128]"
+    assert with_ds.count(ds_shape) == no_ds.count(ds_shape) + 1
+
+
+def test_need_dbias_values_and_grads_agree(monkeypatch):
+    monkeypatch.setattr(fa, "_INTERPRET", True)
+    q, k, v, b = _fa_shapes()
+
+    def f(need):
+        return lambda q: fa.flash_attention(
+            q, k, v, b, 0.125, 128, 128, "bhsd", False, need).sum()
+
+    np.testing.assert_array_equal(np.asarray(f(True)(q)),
+                                  np.asarray(f(False)(q)))
+    dq_t = jax.grad(f(True))(q)
+    dq_f = jax.grad(f(False))(q)
+    np.testing.assert_allclose(np.asarray(dq_t), np.asarray(dq_f),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_need_dbias_none_keeps_dbias(monkeypatch):
+    monkeypatch.setattr(fa, "_INTERPRET", True)
+    q, k, v, b = _fa_shapes()
+
+    def f(b):
+        return fa.flash_attention(q, k, v, b, 0.125, 128, 128).sum()
+
+    db = jax.grad(f)(b)
+    assert db.shape == b.shape
+    assert float(jnp.abs(db).max()) > 0
+
+
+def test_flash_attention_respects_deny(monkeypatch):
+    monkeypatch.setattr(fa, "_INTERPRET", True)
+    monkeypatch.setenv("PT_KERNEL_DENY", "flash_attention")
+    q, k, v, _ = _fa_shapes()
+    assert not fa.use_kernel_path(q, k, 128, 128)
+    monkeypatch.delenv("PT_KERNEL_DENY")
+    assert fa.use_kernel_path(q, k, 128, 128)

@@ -1,0 +1,201 @@
+#!/usr/bin/env python
+"""Per-kernel A/B bench: each registered custom kernel vs its lowered
+baseline, with a ``--threshold`` regression gate.
+
+For every benchable registry entry this times the kernel call and the
+equivalent lowered (pure-XLA) computation on identical data — median of
+``--iters`` fetch-fenced reps after warmup — and reports the speedup.
+On TPU, ``--threshold R`` exits nonzero when any kernel's speedup falls
+below R (the CI gate for "did this kernel stop paying for itself").
+On CPU backends kernels execute under the Pallas interpreter, so the
+timing is not meaningful hardware A/B: results are printed with an
+``interpret_mode`` marker and the threshold gate is skipped (exit 0).
+
+Also exports :func:`kernels_report`, the bench.py JSON-tail formatter
+(same (dict, "#"-line) shape as tools/step_overhead_bench's
+scheduler/guard reports).
+
+Usage:
+  python tools/kernel_bench.py [--iters N] [--threshold R] [--json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def kernels_report(kern):
+    """(dict, '#'-line) for the bench JSON tail from a kernel-registry
+    A/B probe result ({sync_ms_on, sync_ms_off, dispatch...}); (None,
+    None) when the probe did not run or errored before measuring."""
+    if not kern or "dispatch" not in kern:
+        return (kern or None), None
+    d = kern.get("dispatch", {})
+    rate = d.get("hit_rate", 0.0)
+    line = (f"# kernels: registry hit-rate {rate * 100:.1f}% "
+            f"({d.get('custom', 0)}/{d.get('decisions', 0)} custom, "
+            f"{len(d.get('registered', []))} registered)")
+    if "sync_ms_off" in kern:
+        on, off = kern["sync_ms_on"], kern["sync_ms_off"]
+        line += (f"; sync {off:.2f} ms (kernels off) -> {on:.2f} ms "
+                 f"(on), delta {on - off:+.2f} ms/step")
+    return kern, line
+
+
+def _med_ms(fn, iters):
+    fn()  # warmup / compile
+    fn()
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        ts.append((time.perf_counter() - t0) * 1e3)
+    return sorted(ts)[len(ts) // 2]
+
+
+def _bench_cases():
+    """(name, make() -> (kernel_fn, baseline_fn)) pairs on matched
+    data. Flash attention's A/B lives in tools/kernel_roofline.py
+    (sequence-keyed crossover needs its own sweep); here we cover the
+    registry's elementwise/GEMM kernels."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.kernels import fused_optimizer as fo
+    from paddle_tpu.kernels import quantized_matmul as qm
+
+    r = np.random.default_rng(3)
+
+    def mk_adam():
+        n = 1 << 22
+        p = jnp.asarray(r.standard_normal(n, dtype=np.float32))
+        g = jnp.asarray(r.standard_normal(n, dtype=np.float32))
+        m = p * 0.1
+        v = jnp.abs(p) * 0.01
+        lr_t = jnp.float32(1e-3)
+
+        @jax.jit
+        def base(p, g, m, v):
+            m2 = 0.9 * m + 0.1 * g
+            v2 = 0.999 * v + 0.001 * g * g
+            return p - lr_t * m2 / (jnp.sqrt(v2) + 1e-8), m2, v2
+
+        def kern():
+            fo.fused_adam(p, g, m, v, lr_t)[0].block_until_ready()
+
+        def low():
+            base(p, g, m, v)[0].block_until_ready()
+
+        return kern, low
+
+    def mk_sgd():
+        n = 1 << 22
+        p = jnp.asarray(r.standard_normal(n, dtype=np.float32))
+        g = jnp.asarray(r.standard_normal(n, dtype=np.float32))
+        lr = jnp.float32(0.05)
+
+        @jax.jit
+        def base(p, g):
+            return p - lr * g
+
+        def kern():
+            fo.fused_sgd(p, g, lr).block_until_ready()
+
+        def low():
+            base(p, g).block_until_ready()
+
+        return kern, low
+
+    def mk_qmm(mode):
+        def make():
+            x = jnp.asarray(
+                r.standard_normal((1024, 1024), dtype=np.float32))
+            y = jnp.asarray(
+                r.standard_normal((1024, 1024), dtype=np.float32))
+
+            @jax.jit
+            def base(x, y):
+                return jnp.matmul(x, y)
+
+            def kern():
+                qm.quantized_matmul(x, y,
+                                    mode=mode).block_until_ready()
+
+            def low():
+                base(x, y).block_until_ready()
+
+            return kern, low
+        return make
+
+    return [("fused_adam", mk_adam), ("fused_sgd", mk_sgd),
+            ("quantized_matmul/int8", mk_qmm("int8")),
+            ("quantized_matmul/bf16", mk_qmm("bf16"))]
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--iters", type=int, default=5,
+                    help="timed reps per side (median reported)")
+    ap.add_argument("--threshold", type=float, default=None,
+                    help="min kernel/baseline speedup; any kernel "
+                    "below it fails the run (TPU only)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON line instead of the table")
+    args = ap.parse_args(argv)
+
+    import jax
+    from paddle_tpu.kernels import registry as kreg
+    interp = kreg.interpret()
+
+    rows = []
+    for name, make in _bench_cases():
+        try:
+            kern, low = make()
+            k_ms = _med_ms(kern, args.iters)
+            l_ms = _med_ms(low, args.iters)
+            rows.append({"kernel": name, "kernel_ms": round(k_ms, 3),
+                         "lowered_ms": round(l_ms, 3),
+                         "speedup": round(l_ms / k_ms, 3)
+                         if k_ms else 0.0})
+        except Exception as exc:
+            rows.append({"kernel": name,
+                         "error": f"{type(exc).__name__}: {exc}"[:200]})
+
+    out = {"backend": jax.default_backend(),
+           "interpret_mode": interp, "iters": args.iters,
+           "kernels": rows}
+    if args.json:
+        print(json.dumps(out))
+    else:
+        note = " (interpret mode — timings not hardware A/B)" \
+            if interp else ""
+        print(f"# kernel_bench on {out['backend']}{note}")
+        for row in rows:
+            if "error" in row:
+                print(f"  {row['kernel']:28s} ERROR {row['error']}")
+            else:
+                print(f"  {row['kernel']:28s} kernel "
+                      f"{row['kernel_ms']:9.3f} ms   lowered "
+                      f"{row['lowered_ms']:9.3f} ms   speedup "
+                      f"{row['speedup']:6.3f}x")
+
+    if args.threshold is not None and not interp:
+        slow = [row for row in rows
+                if row.get("speedup", 0.0) < args.threshold]
+        if slow:
+            print(f"# FAIL: {len(slow)} kernel(s) below "
+                  f"{args.threshold}x: "
+                  + ", ".join(row["kernel"] for row in slow),
+                  file=sys.stderr)
+            return 1
+    elif args.threshold is not None:
+        print("# threshold gate skipped: interpret mode",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

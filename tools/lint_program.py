@@ -198,7 +198,7 @@ def _parser():
     p = argparse.ArgumentParser(
         prog="lint_program",
         description="static analysis over a paddle_tpu Program")
-    src = p.add_mutually_exclusive_group(required=True)
+    src = p.add_mutually_exclusive_group(required=False)
     src.add_argument("--model", choices=sorted(MODELS),
                      help="build this book model in-process and lint it")
     src.add_argument("--program", metavar="FILE",
@@ -225,11 +225,43 @@ def _parser():
                    f"(default all: {', '.join(analysis_passes())})")
     p.add_argument("--warnings-as-errors", action="store_true",
                    help="exit non-zero on warnings too")
+    p.add_argument("--check-kernels", action="store_true",
+                   help="registry-completeness lint: every kernel "
+                        "registered in paddle_tpu/kernels must have a "
+                        "numerics-parity entry (kernels/parity.py); "
+                        "exits non-zero on gaps")
     return p
+
+
+def _check_kernels() -> int:
+    """Registry-completeness lint (docs/KERNELS.md): a custom kernel
+    with no parity case is unverifiable and fails the build."""
+    from paddle_tpu.kernels import parity, registry
+    case_count = {}
+    for c in parity.cases():
+        case_count[c.kernel] = case_count.get(c.kernel, 0) + 1
+    missing = parity.missing_parity()
+    for name in registry.kernel_names():
+        n = case_count.get(name, 0)
+        mark = "MISSING" if name in missing else f"{n} case(s)"
+        print(f"  {name:24s} parity: {mark}")
+    if missing:
+        print(f"check-kernels: {len(missing)} registered kernel(s) "
+              f"without a parity entry: {', '.join(missing)}",
+              file=sys.stderr)
+        return EXIT_ERRORS
+    print(f"check-kernels: {len(case_count)} kernel(s), all covered")
+    return EXIT_CLEAN
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     ns = _parser().parse_args(argv)
+    if ns.check_kernels:
+        return _check_kernels()
+    if not ns.model and not ns.program:
+        print("lint_program: one of --model/--program (or "
+              "--check-kernels) is required", file=sys.stderr)
+        return EXIT_USAGE
     if ns.program and ns.shards > 1:
         print("lint_program: --shards requires --model", file=sys.stderr)
         return EXIT_USAGE
