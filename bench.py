@@ -357,6 +357,38 @@ def _probe_kernels(eng, prog, scope, feed, fetch, sync_on_ms):
     return out
 
 
+def _probe_tracing(eng, prog, scope, feed, fetch, sync_ms):
+    """Device-time attribution probe (docs/TRACING.md) on the
+    already-built transformer: compiled cost_analysis() FLOPs/bytes,
+    HBM peak, a short jax.profiler device capture, per-island
+    apportionment — the bench's first MEASURED MFU number (the
+    existing MFU line is analytic, from host steps/s). Device fields
+    are None on CPU hosts; mfu_estimate then falls back to host wall
+    time (labeled via mfu_basis)."""
+    out = {"sync_ms": round(sync_ms, 2)}
+    try:
+        from paddle_tpu.observability import attribution, tracing
+        rep = attribution.attribute(eng, prog, scope, feed, fetch,
+                                    profile_steps=3)
+        cost = rep.get("cost") or {}
+        dev = rep.get("device") or {}
+        out.update({
+            "flops_per_step": cost.get("flops"),
+            "hbm_peak_bytes": rep.get("hbm_peak_bytes"),
+            "device_ms_per_step": dev.get("device_ms_per_step"),
+            "host_ms_per_step": dev.get("host_ms_per_step"),
+            "islands": rep.get("islands") or None,
+            "mfu_estimate": rep.get("mfu_estimate"),
+            "mfu_basis": rep.get("mfu_basis"),
+            "skew": tracing.skew_snapshot(),
+        })
+        if rep.get("error"):
+            out["error"] = str(rep["error"])[:200]
+    except Exception as exc:   # accounting only; never fail the bench
+        out["error"] = f"{type(exc).__name__}: {exc}"[:200]
+    return out
+
+
 def bench_transformer(batch=BATCH, seq=None, measure_ckpt=False):
     import paddle_tpu as fluid
     from paddle_tpu import models
@@ -409,6 +441,10 @@ def bench_transformer(batch=BATCH, seq=None, measure_ckpt=False):
             # kernels-off sync A/B + registry hit rates for the
             # kernels JSON tail (ROADMAP open item 3)
             stats["kernels"] = _probe_kernels(
+                eng, main_prog, scope, feed, [cost.name], sync_ms)
+            # measured device-time attribution + measured MFU for the
+            # tracing JSON tail (docs/TRACING.md)
+            stats["tracing"] = _probe_tracing(
                 eng, main_prog, scope, feed, [cost.name], sync_ms)
     return sps * batch * s_trg, sps, traj, sync_ms, stats
 
@@ -821,6 +857,15 @@ def main():
         kern, kern_line = kernels_report((stats or {}).get("kernels"))
     except Exception:
         pass   # accounting only; never fail the bench on it
+    trac, trac_line = (stats or {}).get("tracing") or {}, None
+    if trac:
+        mfu = trac.get("mfu_estimate")
+        dev = trac.get("device_ms_per_step")
+        trac_line = (f"# tracing: device_ms="
+                     f"{dev if dev is not None else 'n/a'} "
+                     f"mfu_estimate={mfu if mfu is not None else 'n/a'}"
+                     f" ({trac.get('mfu_basis') or 'n/a'}) "
+                     f"hbm_peak={trac.get('hbm_peak_bytes') or 'n/a'}")
     chaos, chaos_line = {}, None
     if os.environ.get("PT_BENCH_CHAOS"):
         # opt-in: spawns a 2-trainer PS job twice (clean + faulted),
@@ -852,6 +897,7 @@ def main():
         "scheduler_overlap": sched or None,
         "stability": stab or None,
         "kernels": kern or None,
+        "tracing": trac or None,
         "chaos": chaos or None,
         "metrics": metrics_tail or None,
     }))
@@ -863,6 +909,8 @@ def main():
         print(stab_line, file=sys.stderr)
     if kern_line:
         print(kern_line, file=sys.stderr)
+    if trac_line:
+        print(trac_line, file=sys.stderr)
     if chaos_line:
         print(chaos_line, file=sys.stderr)
     print(f"# transformer: steps/s={sps:.2f} "

@@ -39,13 +39,17 @@ class SaveHandle:
     """Future for one queued save. ``wait()`` blocks until the write is
     durable (or failed) and re-raises the writer's exception."""
 
-    __slots__ = ("step", "_event", "_error", "committed_dir")
+    __slots__ = ("step", "_event", "_error", "committed_dir", "_tctx")
 
     def __init__(self, step: int):
         self.step = step
         self._event = threading.Event()
         self._error: Optional[BaseException] = None
         self.committed_dir: Optional[str] = None
+        # trace context of the step that queued this save: the
+        # background write's span correlates back to it even though it
+        # runs on the ckpt-writer thread (docs/TRACING.md)
+        self._tctx = None
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -123,6 +127,12 @@ class CheckpointManager:
         self._last_save_spec = (scope, program, vars)
         self._last_step = int(step)
         handle = SaveHandle(int(step))
+        if _obs._HOT[0]:
+            try:
+                from ..observability import tracing as _tracing
+                handle._tctx = _tracing.current_context()
+            except Exception:
+                pass
         with self._lock:
             self._handles.append(handle)
         self._count("ckpt_saves", 1)
@@ -140,6 +150,7 @@ class CheckpointManager:
         committed = None
         error: Optional[BaseException] = None
         t0 = time.perf_counter()
+        t_wall = time.time()
         try:
             tmp_dir = os.path.join(self.root,
                                    mf.tmp_dir_name(handle.step))
@@ -160,6 +171,21 @@ class CheckpointManager:
             if _obs.telemetry_active():
                 _obs.histogram("pt_ckpt_save_seconds").observe(
                     time.perf_counter() - t0)
+            if _obs._HOT[0]:
+                try:
+                    from ..observability import tracing as _tracing
+                    tctx = handle._tctx or {}
+                    _tracing.record_span(
+                        "ckpt_save", t_wall,
+                        (time.perf_counter() - t0) * 1e3, kind="ckpt",
+                        trace=tctx.get("trace"),
+                        parent=tctx.get("span"),
+                        ann={"step": handle.step,
+                             "committed": bool(committed),
+                             "error": (f"{type(error).__name__}"
+                                       if error is not None else None)})
+                except Exception:
+                    pass
             handle._finish(error, committed)
 
     def _worker_loop(self) -> None:
@@ -280,6 +306,17 @@ class CheckpointManager:
         if _obs.telemetry_active():
             _obs.histogram("pt_ckpt_restore_seconds").observe(
                 time.perf_counter() - t0)
+        if _obs._HOT[0]:
+            try:
+                from ..observability import tracing as _tracing
+                _tracing.record_span(
+                    "ckpt_restore", time.time()
+                    - (time.perf_counter() - t0),
+                    (time.perf_counter() - t0) * 1e3, kind="ckpt",
+                    ann={"step": int(step),
+                         "tensors": len(tensors)})
+            except Exception:
+                pass
         return int(step)
 
     def maybe_restore(self, scope=None, program=None,

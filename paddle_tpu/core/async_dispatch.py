@@ -95,15 +95,20 @@ class FetchHandle:
     deferred-check record. Duck-types the LoDTensor surface the fetch
     consumers already use (``.array``, ``.lod()``, ``np.asarray``)."""
 
-    __slots__ = ("_value", "_lod", "_rec", "_name", "_fingerprint")
+    __slots__ = ("_value", "_lod", "_rec", "_name", "_fingerprint",
+                 "_tctx")
 
     def __init__(self, value, lod, rec: Optional[PendingStep], name,
-                 fingerprint):
+                 fingerprint, tctx=None):
         self._value = value
         self._lod = [list(level) for level in (lod or [])]
         self._rec = rec
         self._name = name
         self._fingerprint = fingerprint
+        # trace context captured at dispatch time: the materialization
+        # span below correlates to the step that enqueued this fetch
+        # even though it runs steps later (docs/TRACING.md)
+        self._tctx = tctx
 
     # -- live (non-materializing) surface ----------------------------------
     @property
@@ -129,6 +134,7 @@ class FetchHandle:
     def numpy(self) -> np.ndarray:
         """Sync: block for the value, surfacing any deferred step error
         (NaN/Inf trip or XLA runtime failure) with its op context."""
+        self._record_wait_span()
         if self._rec is not None:
             self._rec.check()
         try:
@@ -143,6 +149,36 @@ class FetchHandle:
             err.__cause__ = exc
             _flight_dump("sticky_async_error", err, self._fingerprint)
             raise err
+
+    def _record_wait_span(self) -> None:
+        """One pending-fetch span per handle, parented under the
+        dispatching step's trace: how long materialization blocked for
+        the device (the async pipeline's real depth cost). Best-effort
+        and once-only; zero work with tracing off."""
+        tctx, self._tctx = self._tctx, None
+        if not tctx:
+            return
+        try:
+            import time
+            from ..observability import metrics as _m
+            from ..observability import tracing as _t
+            if not _m._HOT[0]:
+                return
+            t0 = time.time()
+            ready = self.is_ready()
+            if not ready:
+                try:
+                    self._value.block_until_ready()
+                except Exception:
+                    pass  # the materialization path surfaces errors
+            _t.record_span(f"pending_fetch:{self._name}", t0,
+                           (time.time() - t0) * 1e3, kind="fetch",
+                           trace=tctx.get("trace"),
+                           parent=tctx.get("span"),
+                           ann={"name": self._name,
+                                "was_ready": bool(ready)})
+        except Exception:
+            pass
 
     def block_until_ready(self) -> "FetchHandle":
         self.numpy()

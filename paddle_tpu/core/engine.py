@@ -32,6 +32,7 @@ from .scope import LoDTensor, Scope
 from .types import dtype_to_np
 from ..observability import metrics as _obs
 from ..observability import recorder as _obs_recorder
+from ..observability import tracing as _obs_tracing
 
 RNG_STATE_VAR = "@RNG_STATE@"
 
@@ -1307,6 +1308,10 @@ class Engine:
             obs = {"step": self.counters["runs"], "t_host": time.time(),
                    "_t0": time.perf_counter(), "phases": {},
                    "fast_path": False, "traced": False}
+            # deterministic trace id for this step: RPCs, deferred
+            # fetches and checkpoint saves issued below inherit it
+            # (docs/TRACING.md)
+            _obs_tracing.begin_step(obs["step"])
         iterations = int(iterations or 1)
         fast_key = None
         if use_program_cache:
@@ -1451,10 +1456,18 @@ class Engine:
     def _obs_finish(self, obs):
         """Close out one step's flight/telemetry record: total span,
         then hand it to the recorder (histogram observes + ring
-        append)."""
+        append), derive the step's trace spans from the same timings,
+        and tick the deep-profile trigger — all behind the one _HOT
+        boolean that built obs."""
         obs["phases"]["total_ms"] = (time.perf_counter()
                                      - obs.pop("_t0")) * 1e3
         _obs_recorder.record_step(obs)
+        _obs_tracing.finish_step(obs)
+        try:
+            from ..observability import attribution as _obs_attr
+            _obs_attr.deep_profile_tick()
+        except Exception:
+            pass
 
     def _dispatch_inner(self, program, scope, traced, arrays,
                         donated_params, const_params, return_numpy,
@@ -1582,9 +1595,15 @@ class Engine:
         out = []
         if async_defer:
             from .async_dispatch import FetchHandle
+            # capture the step's trace context NOW — materialization
+            # happens on a later step (or another thread), after this
+            # thread's context has moved on
+            tctx = _obs_tracing.current_context() \
+                if obs is not None else None
             for n, v in zip(traced.fetch_names, fetches):
                 out.append(FetchHandle(v, traced.fetch_lods.get(n), rec,
-                                       n, program.fingerprint))
+                                       n, program.fingerprint,
+                                       tctx=tctx))
             if obs is not None:
                 obs["pending_fetches"] = len(self._pending)
                 obs["phases"]["fetch_ms"] = 0.0  # deferred to handles

@@ -396,6 +396,8 @@ class ScheduledStep(_TraceBase):
         spans: List[dict] = []
         flags_all: List = []
         idle_ms = 0.0
+        isl_base = 0   # global island index across phases — the key
+        # device-time attribution joins on (docs/TRACING.md)
         inline = not self._traced_once
         for pi, phase in enumerate(self.phases):
             # snapshot inputs for the whole phase BEFORE any island of
@@ -416,13 +418,16 @@ class ScheduledStep(_TraceBase):
                 window = max(t1s) - min(t0s)
                 idle_ms += sum(window - (t1 - t0)
                                for t0, t1 in zip(t0s, t1s)) * 1e3
-            for isl, (outs, flags, t0, t1, lane) in zip(phase, results):
+            for ii, (isl, (outs, flags, t0, t1, lane)) in enumerate(
+                    zip(phase, results)):
                 env.update(outs)
                 flags_all.extend(flags)
-                spans.append({"phase": pi, "ops": len(isl.indices),
+                spans.append({"phase": pi, "i": isl_base + ii,
+                              "ops": len(isl.indices),
                               "lane": lane,
                               "t0_ms": round((t0 - t_step) * 1e3, 3),
                               "dur_ms": round((t1 - t0) * 1e3, 3)})
+            isl_base += len(phase)
         self._traced_once = True
         if self.guard_plan is not None:
             self.guard_plan.run_epilogue(env, guard_orig,

@@ -45,6 +45,8 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from ..core.flags import FLAGS
+from ..observability import metrics as _obs_metrics
+from ..observability import tracing as _obs_tracing
 from . import faults
 from .resilience import (CircuitOpenError, RetryPolicy, TrainerRegistry,
                          consume_retry, endpoint_health)
@@ -158,6 +160,13 @@ def _rpc(endpoint: str, msg, timeout: Optional[float] = None,
     deadline); ``track_health=False`` exempts pure liveness polls
     (wait_server) from breaker bookkeeping so a not-yet-started server
     is not recorded as a failing one.
+
+    While tracing is hot (docs/TRACING.md) the client span id is
+    allocated UP FRONT and rides the message header as ``tctx`` —
+    builtins-only str values, so it passes the restricted unpickler —
+    letting the pserver record a server span parented under this call.
+    The client span itself is recorded on every exit path, annotated
+    with the retry count, outcome, and breaker state.
     """
     host, port = _parse_ep(endpoint)
     policy = RetryPolicy.from_flags()
@@ -165,50 +174,96 @@ def _rpc(endpoint: str, msg, timeout: Optional[float] = None,
         policy.max_retries = max(0, int(retries) - 1)
     breaker = endpoint_health.get(endpoint) if track_health else None
     plan = faults.current()
+    tctx = parent = None
+    t0 = retried = 0
+    if _obs_metrics._HOT[0] and isinstance(msg, dict):
+        ctx = _obs_tracing.current_context()
+        sid = _obs_tracing.new_span_id()
+        trace = (ctx["trace"] if ctx
+                 else f"{_obs_tracing.worker_id()}-detached")
+        parent = ctx["span"] if ctx else None
+        tctx = {"trace": trace, "span": sid,
+                "worker": _obs_tracing.worker_id()}
+        msg = dict(msg)
+        msg["tctx"] = tctx
+        t0 = time.time()
     start = time.monotonic()
     delays = iter(policy.delays())
     last: Optional[OSError] = None
-    while True:
-        if breaker is not None and not breaker.allow():
-            consume_retry("breaker_fast_fails")
-            raise CircuitOpenError(
-                f"circuit breaker open for {endpoint} after "
-                f"{breaker.consecutive_failures} consecutive failures; "
-                f"next probe after FLAGS_rpc_breaker_cooldown_s") \
-                from last
-        try:
-            if plan is not None:
-                plan.on_connect(endpoint)
-            att_timeout = policy.attempt_timeout(start, timeout)
-            with socket.create_connection((host, port),
-                                          timeout=att_timeout) as s:
-                _send_msg(s, msg)
-                rep = _recv_msg(s)
-            if breaker is not None:
-                breaker.record_success()
-            return rep
-        except OSError as exc:
-            last = exc
-            if breaker is not None:
-                breaker.record_failure()
-            delay = next(delays, None)
-            if delay is None:
-                # distinct accounting: out of retries vs. out of time
-                # (pt_rpc_*_total families, docs/OBSERVABILITY.md)
-                consume_retry("retries_exhausted")
-                raise last
-            if not policy.sleep_budgeted(delay, start):
-                consume_retry("deadline_exhausted")
-                raise last
-            consume_retry()
+    outcome = "error"
+    try:
+        while True:
+            if breaker is not None and not breaker.allow():
+                consume_retry("breaker_fast_fails")
+                outcome = "breaker_fast_fail"
+                raise CircuitOpenError(
+                    f"circuit breaker open for {endpoint} after "
+                    f"{breaker.consecutive_failures} consecutive "
+                    f"failures; next probe after "
+                    f"FLAGS_rpc_breaker_cooldown_s") \
+                    from last
+            try:
+                if plan is not None:
+                    plan.on_connect(endpoint)
+                att_timeout = policy.attempt_timeout(start, timeout)
+                with socket.create_connection((host, port),
+                                              timeout=att_timeout) as s:
+                    _send_msg(s, msg)
+                    rep = _recv_msg(s)
+                if breaker is not None:
+                    breaker.record_success()
+                outcome = "ok"
+                return rep
+            except OSError as exc:
+                last = exc
+                if breaker is not None:
+                    breaker.record_failure()
+                delay = next(delays, None)
+                if delay is None:
+                    # distinct accounting: out of retries vs. out of
+                    # time (pt_rpc_*_total, docs/OBSERVABILITY.md)
+                    consume_retry("retries_exhausted")
+                    outcome = "retries_exhausted"
+                    raise last
+                if not policy.sleep_budgeted(delay, start):
+                    consume_retry("deadline_exhausted")
+                    outcome = "deadline_exhausted"
+                    raise last
+                consume_retry()
+                retried += 1
+    finally:
+        if tctx is not None:
+            try:
+                _obs_tracing.record_span(
+                    f"rpc.{msg.get('t')}", t0,
+                    (time.time() - t0) * 1e3, kind="rpc.client",
+                    trace=tctx["trace"], span_id=tctx["span"],
+                    parent=parent,
+                    ann={"endpoint": endpoint,
+                         "type": str(msg.get("t")), "retries": retried,
+                         "outcome": outcome,
+                         "breaker": (breaker.state
+                                     if breaker is not None else None)})
+            except Exception:
+                pass
 
 
-def heartbeat(endpoint: str, trainer_id: int) -> None:
+def heartbeat(endpoint: str, trainer_id: int):
     """One liveness beat to the pserver's trainer registry. Single
     attempt — the Heartbeat thread provides the cadence; retrying a
-    missed beat is worse than sending the next one on time."""
-    _rpc(endpoint, {"t": "hb", "trainer": int(trainer_id)},
-         timeout=5.0, retries=1)
+    missed beat is worse than sending the next one on time.
+
+    Piggybacks this worker's step-duration summary (docs/TRACING.md)
+    when one exists and returns the server's reply so the Heartbeat
+    loop can feed the fleet-skew echo to ``observe_skew_reply``."""
+    msg = {"t": "hb", "trainer": int(trainer_id)}
+    try:
+        summary = _obs_tracing.step_summary()
+    except Exception:
+        summary = None
+    if summary is not None:
+        msg["summary"] = summary
+    return _rpc(endpoint, msg, timeout=5.0, retries=1)
 
 
 def wait_server(endpoint: str, timeout: float = 60.0,
@@ -354,6 +409,12 @@ class AsyncParameterServer:
             max_workers=max(2, int(FLAGS.pserver_handler_threads)),
             thread_name_prefix="ps-handler")
         host, port = _parse_ep(endpoint)
+        # span worker id for server-side spans — only when nothing
+        # (PT_WORKER / PADDLE_TRAINER_ID) chose one (docs/TRACING.md)
+        try:
+            _obs_tracing.default_worker(f"ps{port}")
+        except Exception:
+            pass
         self._srv = socket.create_server((host, port))
         self._srv.settimeout(0.2)
 
@@ -364,69 +425,16 @@ class AsyncParameterServer:
                 if plan is not None:
                     plan.on_handle()
                 msg = _recv_msg(conn)
-                t = msg.get("t")
-                if t == "ping":
-                    _send_msg(conn, "pong")
-                elif t == "hb":
-                    self.trainers.beat(msg["trainer"])
-                    _send_msg(conn, "ok")
-                elif t == "push":
-                    if "trainer" in msg:
-                        self.trainers.beat(msg["trainer"])
-                    with self._lock:
-                        self._apply(msg["name"], msg["v"],
-                                    msg.get("merged_n", 1))
-                        self._push_count += 1
-                    _send_msg(conn, "ok")
-                elif t == "pull":
-                    with self._lock:
-                        v = np.asarray(self._get_var(msg["name"]))
-                    _send_msg(conn, v)
-                elif t == "pull_all":
-                    names = msg.get("names") or self._known
-                    with self._lock:
-                        out = {n: np.asarray(self._get_var(n))
-                               for n in names}
-                    _send_msg(conn, out)
-                elif t == "checkpoint":
-                    # snapshot this shard in the framework's own save
-                    # format (one file per var, io.load_vars-readable)
-                    import os
-                    d = msg["dir"]
-                    os.makedirs(d, exist_ok=True)
-                    from ..io import _serialize_tensor
-                    from ..checkpoint.writer import atomic_write
-                    with self._lock:
-                        saved = []
-                        for n in self._ckpt_vars:
-                            # atomic per-var write: a server killed
-                            # mid-snapshot leaves the previous complete
-                            # file (or nothing), never a truncated one
-                            # load_shard would trust
-                            with atomic_write(os.path.join(d, n)) as f:
-                                _serialize_tensor(
-                                    f, n, np.asarray(self._get_var(n)))
-                            saved.append(n)
-                    _send_msg(conn, saved)
-                elif t == "complete":
-                    with self._lock:
-                        self._completed.add(msg["trainer"])
-                        done = self._effective_fanin_reached()
-                    _send_msg(conn, "ok")
-                    if done:
-                        self._done.set()
-                elif t == "metrics":
-                    # Prometheus-style exposition over the existing
-                    # hardened framing (docs/OBSERVABILITY.md) — the
-                    # launch supervisor scrapes pservers and trainers
-                    # with the same message
-                    from ..observability.export import render_exposition
-                    _send_msg(conn, render_exposition())
-                elif t == "metrics_json":
-                    from ..observability.export import metrics_snapshot
-                    _send_msg(conn, metrics_snapshot())
-                else:
-                    _send_msg(conn, {"err": f"unknown message {t!r}"})
+                t = msg.get("t") if isinstance(msg, dict) else None
+                # propagation context off the hardened wire: builtins
+                # only (the restricted unpickler already enforced it);
+                # the server span's trace/parent come from the CLIENT
+                # so both sides correlate (docs/TRACING.md)
+                tctx = msg.pop("tctx", None) \
+                    if isinstance(msg, dict) else None
+                with _obs_tracing.server_span(tctx, f"rpc.{t}",
+                                              endpoint=self.endpoint):
+                    self._dispatch(conn, t, msg)
         except (ConnectionError, OSError):
             pass
         except Exception as exc:  # surface optimizer errors to the client
@@ -434,6 +442,80 @@ class AsyncParameterServer:
                 _send_msg(conn, {"err": f"{type(exc).__name__}: {exc}"})
             except OSError:
                 pass
+
+    def _dispatch(self, conn: socket.socket, t, msg) -> None:
+        if t == "ping":
+            _send_msg(conn, "pong")
+        elif t == "hb":
+            self.trainers.beat(msg["trainer"],
+                               summary=msg.get("summary"))
+            # fleet skew from the piggybacked summaries rides the
+            # reply, so every trainer sees the same number and can
+            # arm its own straggler dump (docs/TRACING.md)
+            skew = None
+            try:
+                skew = _obs_tracing.update_skew(
+                    self.trainers.summaries())
+            except Exception:
+                pass
+            _send_msg(conn, {"ok": True, "skew": skew})
+        elif t == "push":
+            if "trainer" in msg:
+                self.trainers.beat(msg["trainer"])
+            with self._lock:
+                self._apply(msg["name"], msg["v"],
+                            msg.get("merged_n", 1))
+                self._push_count += 1
+            _send_msg(conn, "ok")
+        elif t == "pull":
+            with self._lock:
+                v = np.asarray(self._get_var(msg["name"]))
+            _send_msg(conn, v)
+        elif t == "pull_all":
+            names = msg.get("names") or self._known
+            with self._lock:
+                out = {n: np.asarray(self._get_var(n))
+                       for n in names}
+            _send_msg(conn, out)
+        elif t == "checkpoint":
+            # snapshot this shard in the framework's own save
+            # format (one file per var, io.load_vars-readable)
+            import os
+            d = msg["dir"]
+            os.makedirs(d, exist_ok=True)
+            from ..io import _serialize_tensor
+            from ..checkpoint.writer import atomic_write
+            with self._lock:
+                saved = []
+                for n in self._ckpt_vars:
+                    # atomic per-var write: a server killed
+                    # mid-snapshot leaves the previous complete
+                    # file (or nothing), never a truncated one
+                    # load_shard would trust
+                    with atomic_write(os.path.join(d, n)) as f:
+                        _serialize_tensor(
+                            f, n, np.asarray(self._get_var(n)))
+                    saved.append(n)
+            _send_msg(conn, saved)
+        elif t == "complete":
+            with self._lock:
+                self._completed.add(msg["trainer"])
+                done = self._effective_fanin_reached()
+            _send_msg(conn, "ok")
+            if done:
+                self._done.set()
+        elif t == "metrics":
+            # Prometheus-style exposition over the existing
+            # hardened framing (docs/OBSERVABILITY.md) — the
+            # launch supervisor scrapes pservers and trainers
+            # with the same message
+            from ..observability.export import render_exposition
+            _send_msg(conn, render_exposition())
+        elif t == "metrics_json":
+            from ..observability.export import metrics_snapshot
+            _send_msg(conn, metrics_snapshot())
+        else:
+            _send_msg(conn, {"err": f"unknown message {t!r}"})
 
     def _effective_fanin_reached(self) -> bool:
         """Caller holds self._lock. Completed and evicted trainers both

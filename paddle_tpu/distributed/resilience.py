@@ -262,13 +262,25 @@ class TrainerRegistry:
         self._lock = threading.Lock()
         self.last_seen: Dict[int, float] = {}
         self.evicted: Set[int] = set()
+        self._summaries: Dict[str, dict] = {}
 
-    def beat(self, trainer_id: int) -> None:
+    def beat(self, trainer_id: int, summary: Optional[dict] = None) -> None:
         with self._lock:
             self.last_seen[int(trainer_id)] = self._clock()
             # a heartbeat from an "evicted" trainer means the partition
             # healed; welcome it back (its pushes were served anyway)
             self.evicted.discard(int(trainer_id))
+            # step-duration summary piggybacked on the heartbeat
+            # (docs/TRACING.md); keyed by worker id so the skew math
+            # survives trainer-id reuse across restarts
+            if isinstance(summary, dict) and summary.get("worker"):
+                self._summaries[str(summary["worker"])] = dict(summary)
+
+    def summaries(self) -> Dict[str, dict]:
+        """Latest per-worker step-duration summaries (the fleet-skew
+        input, tracing.update_skew)."""
+        with self._lock:
+            return {w: dict(s) for w, s in self._summaries.items()}
 
     def evict_dead(self, exclude: Optional[Set[int]] = None) -> List[int]:
         """Evict every seen-but-silent trainer; returns the NEWLY
@@ -328,14 +340,23 @@ class Heartbeat:
 
     def _loop(self) -> None:
         from ..observability import metrics as _obs
+        from ..observability import tracing as _tracing
         c_sent = _obs.counter("pt_heartbeats_sent_total")
         c_failed = _obs.counter("pt_heartbeats_failed_total")
         while not self._stop.is_set():
             for ep in self.endpoints:
                 try:
-                    self._send(ep, self.trainer_id)
+                    rep = self._send(ep, self.trainer_id)
                     self.sent += 1
                     c_sent.inc()
+                    # the pserver echoes fleet skew on the reply; every
+                    # worker mirrors the gauge + runs the dump-threshold
+                    # check (docs/TRACING.md). Tolerates None/"ok" from
+                    # custom send_fn implementations.
+                    try:
+                        _tracing.observe_skew_reply(rep)
+                    except Exception:
+                        pass
                 except OSError:
                     self.failed += 1
                     c_failed.inc()

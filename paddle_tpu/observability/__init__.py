@@ -9,12 +9,18 @@ Three layers (docs/OBSERVABILITY.md):
   records, dumped automatically on watchdog trip / injected fault /
   sticky async error / SIGTERM;
 * :mod:`.export` — Prometheus-style exposition over the hardened RPC
-  framing, JSONL dumps, chrome-trace merge.
+  framing, JSONL dumps, chrome-trace merge;
+* :mod:`.tracing` — correlated cross-worker spans with deterministic
+  per-step trace ids, RPC context propagation, and fleet skew
+  detection (docs/TRACING.md);
+* :mod:`.attribution` — HLO cost/memory + measured device-time
+  attribution per op category and scheduler island, the measured-MFU
+  gauge, and the deep-profile merged-timeline trigger.
 
 Hot-path contract: one boolean (``metrics._HOT[0]``, folded into
 ``profiler.profiling_active()``) gates all per-step work.
 """
-from . import metrics, recorder, export  # noqa: F401
+from . import metrics, recorder, export, tracing, attribution  # noqa: F401
 from .metrics import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry, EngineCounters,
     default_registry, counter, gauge, histogram,
@@ -27,7 +33,7 @@ from .export import (  # noqa: F401
     scrape, maybe_start_from_env, flight_to_chrome_trace)
 
 __all__ = [
-    "metrics", "recorder", "export",
+    "metrics", "recorder", "export", "tracing", "attribution",
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "EngineCounters", "default_registry", "counter", "gauge",
     "histogram", "enable_telemetry", "telemetry_active",

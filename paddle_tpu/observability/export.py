@@ -32,7 +32,8 @@ from . import recorder as _recorder
 
 __all__ = ["render_exposition", "metrics_snapshot", "dump_metrics",
            "read_metrics_dump", "MetricsServer", "scrape",
-           "maybe_start_from_env", "flight_to_chrome_trace"]
+           "maybe_start_from_env", "flight_to_chrome_trace",
+           "spans_to_chrome_trace", "merge_chrome_traces"]
 
 
 # ---------------------------------------------------------------------------
@@ -128,9 +129,15 @@ def dump_metrics(directory: Optional[str] = None,
         d = directory or _recorder.default_dir()
         os.makedirs(d, exist_ok=True)
         path = os.path.join(d, f"metrics_{os.getpid()}.jsonl")
+        try:
+            from . import tracing as _tracing
+            worker = _tracing.worker_id()
+        except Exception:
+            worker = None
         line = {"kind": "metrics_snapshot", "pid": os.getpid(),
                 "time": time.time(),
                 "trainer_id": os.environ.get("PADDLE_TRAINER_ID"),
+                "worker": worker,
                 "families": metrics_snapshot(registry)}
         if extra:
             line.update(extra)
@@ -302,3 +309,79 @@ def flight_to_chrome_trace(path: str) -> List[dict]:
                 "pid": pid, "tid": lane + 1, "args": args})
             off += dur
     return events
+
+
+# one lane (tid) per span kind so the timeline groups step roots,
+# phases, scheduler islands, RPC pairs, fetch waits and ckpt writes
+_SPAN_LANES = {"step": 1, "phase": 2, "lane": 3, "rpc.client": 4,
+               "rpc.server": 5, "fetch": 6, "ckpt": 7}
+
+
+def spans_to_chrome_trace(path: str) -> List[dict]:
+    """Convert one span dump (``spans_<pid>_*.jsonl``,
+    docs/TRACING.md) into chrome trace events: complete ('X') events
+    anchored at each span's wall time, one lane per span kind, with
+    trace/span/parent ids in args so correlated client/server pairs
+    are inspectable across merged processes."""
+    from . import tracing as _tracing
+    d = _tracing.read_span_dump(path)
+    pid = d["header"].get("pid", 0)
+    events: List[dict] = []
+    for s in d["spans"]:
+        args = {k: s.get(k)
+                for k in ("trace", "span", "parent", "worker")
+                if s.get(k) is not None}
+        ann = s.get("ann")
+        if isinstance(ann, dict):
+            args.update(ann)
+        kind = s.get("kind", "host")
+        events.append({
+            "name": s.get("name", "?"), "cat": f"span.{kind}",
+            "ph": "X", "ts": float(s.get("t0") or 0.0) * 1e6,
+            "dur": max(float(s.get("dur_ms") or 0.0) * 1e3, 1.0),
+            "pid": pid,
+            "tid": _SPAN_LANES.get(kind, len(_SPAN_LANES) + 1),
+            "args": args})
+    return events
+
+
+def _load_trace_events(path: str) -> List[dict]:
+    """Events of one timeline input: span/flight JSONL dumps convert,
+    chrome traces (.json / .json.gz, incl. jax.profiler output) pass
+    through."""
+    base = os.path.basename(path)
+    if path.endswith(".jsonl"):
+        if base.startswith("spans_"):
+            return spans_to_chrome_trace(path)
+        return flight_to_chrome_trace(path)
+    import gzip
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        data = json.load(f)
+    if isinstance(data, list):
+        return data
+    return data.get("traceEvents", [])
+
+
+def merge_chrome_traces(inputs) -> dict:
+    """Merge ``[(name, path)]`` timeline inputs into ONE chrome trace
+    dict: every input gets its own pid (named via a process_name
+    metadata record) so a 2-trainer + 1-pserver run's span dumps,
+    flight dumps and device profiles sit side by side, correlated by
+    the trace ids in span args. Unreadable inputs are skipped — a
+    postmortem merge must render whatever survived."""
+    events: List[dict] = []
+    for pid, (name, path) in enumerate(inputs):
+        try:
+            evs = _load_trace_events(path)
+        except Exception:
+            continue
+        for e in evs:
+            if not isinstance(e, dict):
+                continue
+            e = dict(e)
+            e["pid"] = pid
+            events.append(e)
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"name": name}})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

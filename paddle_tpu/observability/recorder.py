@@ -202,10 +202,23 @@ def record_step(rec: dict) -> None:
 
 def dump(reason: str, directory: Optional[str] = None,
          extra: Optional[dict] = None) -> Optional[str]:
-    """Dump the process-wide recorder (no-op on an empty ring)."""
+    """Dump the process-wide recorder (no-op on an empty ring). The
+    span ring rides along: every postmortem trigger (watchdog,
+    injected fault, sticky async error, SIGTERM, skew) leaves both the
+    step shapes AND the correlated spans, so straggler attribution
+    (tools/chaos_report.py) works on any dump directory."""
     if _RECORDER is None:
         return None
-    return _RECORDER.dump(reason, directory=directory, extra=extra)
+    path = _RECORDER.dump(reason, directory=directory, extra=extra)
+    if reason not in ("skew", "deep_profile"):
+        # those two call dump_spans themselves (tracing.check_skew /
+        # attribution._emit_timeline) — avoid double span dumps
+        try:
+            from . import tracing
+            tracing.dump_spans(reason, directory=directory)
+        except Exception:
+            pass
+    return path
 
 
 def install_sigterm_hook() -> None:

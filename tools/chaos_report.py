@@ -225,6 +225,56 @@ def summarize_flight_dumps(directory: str, last_n: int = 8) -> list:
         return [{"error": f"{type(exc).__name__}: {exc}"}]
 
 
+def span_straggler_report(directory: str, top: int = 5,
+                          stall_ms: float = 50.0) -> list:
+    """Ingest the span dumps (``spans_*.jsonl``, docs/TRACING.md) the
+    job's workers wrote next to their flight dumps and attribute each
+    death to the RPC activity that preceded it: for every dump — a
+    ``kill_at_step`` victim writes one inline before ``os._exit``, an
+    evicted trainer's last dump shows what it was stuck on — list the
+    client/server RPC spans that stalled (non-ok outcome, consumed
+    retries, or duration >= ``stall_ms``), slowest first, with their
+    endpoint and breaker state. The survival report then shows WHICH
+    endpoint the dead incarnation was waiting on, not just that it
+    died."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    try:
+        from paddle_tpu.observability import tracing
+        out = []
+        for path in tracing.find_span_dumps(directory):
+            d = tracing.read_span_dump(path)
+            hdr = d["header"]
+            rpc = [s for s in d["spans"]
+                   if str(s.get("kind", "")).startswith("rpc.")]
+            stalls = []
+            for s in rpc:
+                ann = s.get("ann") or {}
+                if (ann.get("outcome") not in (None, "ok")
+                        or int(ann.get("retries") or 0) > 0
+                        or float(s.get("dur_ms") or 0.0) >= stall_ms):
+                    stalls.append(s)
+            stalls.sort(key=lambda s: -float(s.get("dur_ms") or 0.0))
+            out.append({
+                "file": os.path.basename(path),
+                "worker": hdr.get("worker"),
+                "reason": hdr.get("reason"),
+                "rpc_spans": len(rpc),
+                "stalls": [{
+                    "name": s.get("name"),
+                    "endpoint": (s.get("ann") or {}).get("endpoint"),
+                    "outcome": (s.get("ann") or {}).get("outcome"),
+                    "retries": (s.get("ann") or {}).get("retries"),
+                    "breaker": (s.get("ann") or {}).get("breaker"),
+                    "dur_ms": s.get("dur_ms"),
+                } for s in stalls[:top]],
+            })
+        return out
+    except Exception as exc:  # a broken dump must not fail the report
+        return [{"error": f"{type(exc).__name__}: {exc}"}]
+
+
 def _spawn(role, rank, n_trainers, ep, steps, extra_env):
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
@@ -376,6 +426,7 @@ def run_job(steps=DEFAULT_STEPS, fault_spec=None, max_restarts=1,
                  all(codes and codes[-1] == 0
                      for codes in trainer_codes.values()))
     flight_records = summarize_flight_dumps(flight_dir)
+    straggler = span_straggler_report(flight_dir)
     import shutil
     shutil.rmtree(flight_dir, ignore_errors=True)
     rep = {
@@ -389,6 +440,7 @@ def run_job(steps=DEFAULT_STEPS, fault_spec=None, max_restarts=1,
         "breaker_fast_fails": agg["retry"].get("breaker_fast_fails", 0),
         "stability": agg["stability"],
         "flight_records": flight_records,
+        "straggler_attribution": straggler,
         "completed": completed,
         "elapsed_s": round(elapsed, 2),
     }

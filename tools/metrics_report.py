@@ -57,6 +57,11 @@ REQUIRED_FAMILIES = (
     "pt_ckpt_save_seconds", "pt_ckpt_restore_seconds",
     "pt_heartbeats_sent_total", "pt_heartbeats_failed_total",
     "pt_trainers_evicted_total", "pt_flight_dumps_total",
+    # distributed tracing + device-time attribution (docs/TRACING.md)
+    "pt_spans_recorded_total", "pt_span_dumps_total",
+    "pt_step_skew_seconds", "pt_step_slowest_worker_seconds",
+    "pt_island_device_seconds", "pt_hbm_peak_bytes",
+    "pt_mfu_estimate", "pt_deep_profiles_total",
 )
 
 
@@ -65,11 +70,17 @@ REQUIRED_FAMILIES = (
 # ---------------------------------------------------------------------------
 
 def merge_snapshots(sources: List[tuple]) -> Dict[str, dict]:
-    """``sources``: [(origin_label, families_dict)] where families_dict
-    is ``observability.export.metrics_snapshot()`` output. Returns one
-    merged families dict of the same shape."""
+    """``sources``: [(origin_label, families_dict)] or
+    [(origin_label, families_dict, worker_id)] where families_dict is
+    ``observability.export.metrics_snapshot()`` output. Returns one
+    merged families dict of the same shape. Gauge samples keep one
+    series per source, labeled with ``origin`` (which file/endpoint)
+    and ``worker`` (which fleet member, docs/TRACING.md) — so
+    ``pt_step_skew_seconds`` etc. stay attributable after the merge."""
     out: Dict[str, dict] = {}
-    for origin, families in sources:
+    for src in sources:
+        origin, families = src[0], src[1]
+        worker = src[2] if len(src) > 2 and src[2] else str(origin)
         for name, fam in (families or {}).items():
             ftype = fam.get("type")
             dst = out.setdefault(name, {"type": ftype, "samples": []})
@@ -78,9 +89,10 @@ def merge_snapshots(sources: List[tuple]) -> Dict[str, dict]:
                     _merge_hist_sample(dst, s)
                 elif ftype == "counter":
                     _merge_counter_sample(dst, s)
-                else:  # gauge: point-in-time, keep per-origin series
+                else:  # gauge: point-in-time, keep per-source series
                     labels = dict(s.get("labels") or {})
                     labels["origin"] = str(origin)
+                    labels.setdefault("worker", str(worker))
                     dst["samples"].append(
                         {"labels": labels,
                          "value": float(s.get("value", 0.0))})
@@ -145,7 +157,12 @@ def collect_dump_sources(flight_dir: Optional[str]):
         except (OSError, ValueError):
             continue
         if snaps:   # last snapshot per process wins (cumulative)
-            sources.append((name, snaps[-1].get("families", {})))
+            snap = snaps[-1]
+            tid = snap.get("trainer_id")
+            worker = (snap.get("worker")
+                      or (f"trainer{tid}" if tid not in (None, "")
+                          else f"pid{snap.get('pid', '?')}"))
+            sources.append((name, snap.get("families", {}), worker))
     return sources, flights
 
 
@@ -154,15 +171,15 @@ def collect_scrape_sources(endpoints: List[str]):
     sources, errors = [], {}
     for ep in endpoints:
         try:
-            sources.append((ep, export.scrape(ep, as_json=True)))
+            sources.append((ep, export.scrape(ep, as_json=True), ep))
         except Exception as exc:
             errors[ep] = f"{type(exc).__name__}: {exc}"
     return sources, errors
 
 
 def local_registry_source():
-    from paddle_tpu.observability import export
-    return ("local", export.metrics_snapshot())
+    from paddle_tpu.observability import export, tracing
+    return ("local", export.metrics_snapshot(), tracing.worker_id())
 
 
 # ---------------------------------------------------------------------------
@@ -208,7 +225,8 @@ def fleet_report(flight_dir=None, endpoints=(), include_local=True,
     total_steps = sum(s.get("count", 0)
                       for s in step_hist.get("samples", []))
     return {
-        "sources": [origin for origin, _ in sources],
+        "sources": [s[0] for s in sources],
+        "workers": sorted({str(s[2]) for s in sources if len(s) > 2}),
         "scrape_errors": scrape_errors or None,
         "flight_dumps": flights,
         "total_steps_observed": total_steps,

@@ -15,46 +15,65 @@ from each profile are remapped onto their own pid and labeled with a
 process_name metadata record so chrome://tracing shows one lane per
 trainer.
 
-A path ending in ``.jsonl`` is treated as an observability flight dump
-(``flight_*.jsonl``, docs/OBSERVABILITY.md) and converted to per-phase
-chrome-trace lanes via ``observability.export.flight_to_chrome_trace``
-— so a postmortem's last-N steps can be merged side by side with live
-profiler traces from surviving trainers.
+Beyond the reference contract, an input may also be:
+
+* a ``flight_*.jsonl`` observability flight dump
+  (docs/OBSERVABILITY.md) — converted to per-phase lanes;
+* a ``spans_*.jsonl`` distributed-tracing span dump (docs/TRACING.md)
+  — converted to one lane per span kind, carrying trace/span/parent
+  ids so client and server spans from different processes correlate;
+* a ``*.trace.json.gz`` device profile (jax.profiler) — passed through;
+* a **directory or glob** — expanded to every flight/span dump (and
+  chrome trace) inside, each auto-assigned its own lane named after
+  the file. ``--profile_path /tmp/flight_dir`` merges a whole
+  postmortem (2 trainers + 1 pserver) in one command.
 """
 from __future__ import annotations
 
 import argparse
+import glob as _glob
 import json
 import os
 import sys
 
 
-def _flight_events(path):
+def _export():
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     if repo not in sys.path:
         sys.path.insert(0, repo)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    from paddle_tpu.observability.export import flight_to_chrome_trace
-    return flight_to_chrome_trace(path)
+    from paddle_tpu.observability import export
+    return export
 
 
 def merge(profile_paths):
     """profile_paths: list of (name, path). Returns chrome-trace dict."""
-    events = []
-    for pid, (name, path) in enumerate(profile_paths):
-        if path.endswith(".jsonl"):
-            src = _flight_events(path)
-        else:
-            with open(path) as f:
-                src = json.load(f).get("traceEvents", [])
-        events.append({
-            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
-            "args": {"name": name}})
-        for ev in src:
-            ev = dict(ev)
-            ev["pid"] = pid
-            events.append(ev)
-    return {"traceEvents": events, "displayTimeUnit": "ms"}
+    return _export().merge_chrome_traces(profile_paths)
+
+
+def _lane_name(path):
+    base = os.path.basename(path)
+    for ext in (".jsonl", ".trace.json.gz", ".json.gz", ".json"):
+        if base.endswith(ext):
+            return base[:-len(ext)]
+    return base
+
+
+def _expand(name, path, explicit_name):
+    """One CLI item -> [(lane, path)]: files stay one lane; a directory
+    or glob becomes one lane PER matched dump, auto-named after the
+    file (the explicit ``name=`` prefix then becomes a lane prefix)."""
+    if os.path.isdir(path):
+        matches = sorted(
+            os.path.join(path, n) for n in os.listdir(path)
+            if (n.startswith(("flight_", "spans_")) and
+                n.endswith(".jsonl")) or n.endswith(".trace.json.gz"))
+    elif any(c in path for c in "*?["):
+        matches = sorted(_glob.glob(path))
+    else:
+        return [(name, path)]
+    prefix = f"{name}/" if explicit_name else ""
+    return [(prefix + _lane_name(m), m) for m in matches]
 
 
 def _parse_profile_arg(arg):
@@ -65,24 +84,32 @@ def _parse_profile_arg(arg):
             continue
         if "=" in item:
             name, path = item.split("=", 1)
+            explicit = True
         else:
-            name, path = f"profile{len(out)}", item
-        out.append((name, path))
+            name, path, explicit = f"profile{len(out)}", item, False
+        out.extend(_expand(name, path, explicit))
     return out
 
 
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--profile_path", required=True,
-                   help="comma-separated name=path chrome_trace inputs")
+                   help="comma-separated name=path chrome_trace inputs; "
+                        "a path may be a directory or glob of "
+                        "flight_*/spans_* dumps (one lane per file)")
     p.add_argument("--timeline_path", default="/tmp/timeline.json")
     args = p.parse_args()
-    trace = merge(_parse_profile_arg(args.profile_path))
+    inputs = _parse_profile_arg(args.profile_path)
+    if not inputs:
+        print("no inputs matched --profile_path", file=sys.stderr)
+        return 1
+    trace = merge(inputs)
     with open(args.timeline_path, "w") as f:
         json.dump(trace, f)
     print(f"wrote {args.timeline_path} "
-          f"({len(trace['traceEvents'])} events)")
+          f"({len(trace['traceEvents'])} events, {len(inputs)} lanes)")
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main() or 0)
