@@ -389,6 +389,61 @@ def _probe_tracing(eng, prog, scope, feed, fetch, sync_ms):
     return out
 
 
+def _probe_tuning(eng, prog, scope, feed, fetch, sync_ms):
+    """Feedback-directed autotune probe (FLAGS_autotune path,
+    docs/TUNING.md) on the already-built transformer: run the
+    cache-or-search driver (scope-snapshotted trials, so the bench's
+    params are untouched), report trials run + winning config +
+    tuned-vs-default search delta (<= 0 by construction), then prove
+    the persistence loop by re-running on a FRESH engine — the second
+    run must be a pure cache hit with zero trials. Knob + applied
+    state are restored after; a throwaway cache dir is used unless
+    PT_TUNING_CACHE_DIR is set. Search shape via PT_TUNE_KNOBS /
+    PT_TUNE_BUDGETS (default: host-side knobs, cheap)."""
+    import shutil
+    import tempfile
+    from paddle_tpu.core.engine import Engine
+    from paddle_tpu.tuning import driver as tdriver
+    from paddle_tpu.tuning import knobs as tknobs
+    from paddle_tpu.tuning import state as tstate
+    out = {"sync_ms_default": round(sync_ms, 2)}
+    snap = tknobs.snapshot()
+    own_cache = None
+    if not os.environ.get("PT_TUNING_CACHE_DIR"):
+        own_cache = tempfile.mkdtemp(prefix="pt_tune_bench_")
+        os.environ["PT_TUNING_CACHE_DIR"] = own_cache
+    os.environ.setdefault("PT_TUNE_KNOBS", "prefetch_depth,ghost_every")
+    os.environ.setdefault("PT_TUNE_BUDGETS", "1,3")
+    try:
+        info = tdriver.autotune_for_run(eng, prog, scope, None, feed,
+                                        fetch)
+        out.update({
+            "source": info["source"],
+            "trials": info["trials"],
+            "config": info["config"],
+            "objective_ms": None if info["objective_ms"] is None
+            else round(info["objective_ms"], 3),
+            "delta_ms": None if info.get("delta_ms") is None
+            else round(info["delta_ms"], 3)})
+        # persistence proof: ambient baseline back, fresh engine, the
+        # stored winner must replay with ZERO trials
+        tknobs.restore(snap)
+        tstate.clear_applied()
+        info2 = tdriver.autotune_for_run(Engine(), prog, scope, None,
+                                         feed, fetch)
+        out["cache_hit_second_run"] = (info2["source"] == "cache"
+                                       and info2["trials"] == 0)
+    except Exception as exc:   # accounting only; never fail the bench
+        out["error"] = f"{type(exc).__name__}: {exc}"[:200]
+    finally:
+        tknobs.restore(snap)
+        tstate.clear_applied()
+        if own_cache:
+            os.environ.pop("PT_TUNING_CACHE_DIR", None)
+            shutil.rmtree(own_cache, ignore_errors=True)
+    return out
+
+
 def bench_transformer(batch=BATCH, seq=None, measure_ckpt=False):
     import paddle_tpu as fluid
     from paddle_tpu import models
@@ -445,6 +500,10 @@ def bench_transformer(batch=BATCH, seq=None, measure_ckpt=False):
             # measured device-time attribution + measured MFU for the
             # tracing JSON tail (docs/TRACING.md)
             stats["tracing"] = _probe_tracing(
+                eng, main_prog, scope, feed, [cost.name], sync_ms)
+            # feedback-directed autotune loop (search -> persist ->
+            # cache hit) for the tuning JSON tail (docs/TUNING.md)
+            stats["tuning"] = _probe_tuning(
                 eng, main_prog, scope, feed, [cost.name], sync_ms)
     return sps * batch * s_trg, sps, traj, sync_ms, stats
 
@@ -866,6 +925,12 @@ def main():
                      f"mfu_estimate={mfu if mfu is not None else 'n/a'}"
                      f" ({trac.get('mfu_basis') or 'n/a'}) "
                      f"hbm_peak={trac.get('hbm_peak_bytes') or 'n/a'}")
+    tun, tun_line = {}, None
+    try:
+        from tools.step_overhead_bench import tuning_report
+        tun, tun_line = tuning_report((stats or {}).get("tuning"))
+    except Exception:
+        pass   # accounting only; never fail the bench on it
     chaos, chaos_line = {}, None
     if os.environ.get("PT_BENCH_CHAOS"):
         # opt-in: spawns a 2-trainer PS job twice (clean + faulted),
@@ -898,6 +963,7 @@ def main():
         "stability": stab or None,
         "kernels": kern or None,
         "tracing": trac or None,
+        "tuning": tun or None,
         "chaos": chaos or None,
         "metrics": metrics_tail or None,
     }))
@@ -911,6 +977,8 @@ def main():
         print(kern_line, file=sys.stderr)
     if trac_line:
         print(trac_line, file=sys.stderr)
+    if tun_line:
+        print(tun_line, file=sys.stderr)
     if chaos_line:
         print(chaos_line, file=sys.stderr)
     print(f"# transformer: steps/s={sps:.2f} "

@@ -100,9 +100,11 @@ def _compiler_options():
     backend tuning the same way (conv_workspace_size_limit,
     cudnn_exhaustive_search — gflags through the env); XLA_FLAGS cannot
     carry TPU-only flags here because the CLIENT-side XLA parses them
-    and aborts on flags only the tunneled TPU compiler knows."""
-    import os
-    spec = os.environ.get("PT_COMPILER_OPTIONS", "").strip()
+    and aborts on flags only the tunneled TPU compiler knows. Read
+    through the knob registry (tuning/knobs.py) so an applied tuning
+    config takes effect without re-import."""
+    from ..tuning import knobs as _knobs
+    spec = str(_knobs.value("compiler_options") or "").strip()
     if not spec:
         return None
     opts = {}
@@ -201,9 +203,11 @@ def _recompute_types():
     only). The barriers that keep XLA from CSE-ing the recompute away
     also keep it from fusing the recomputed ops into their consumers,
     so the pass materializes MORE buffers than the stash it frees. The
-    knob stays for experimentation; default off."""
-    import os
-    spec = os.environ.get("PT_RECOMPUTE", "").strip()
+    knob stays for experimentation; default off. Read through the knob
+    registry (tuning/knobs.py): runtime changes take effect, and the
+    value is key-audited into both trace cache keys."""
+    from ..tuning import knobs as _knobs
+    spec = str(_knobs.value("recompute") or "").strip()
     return frozenset(t for t in spec.split(",") if t) if spec else None
 
 
@@ -969,11 +973,19 @@ class Engine:
             "rollbacks": 0, "rollback_reexec_failures": 0,
             "quant_fallbacks": 0, "replay_bundles": 0,
             "guard_aborts": 0,
-            "guard_overhead_ms": 0.0})
+            "guard_overhead_ms": 0.0,
+            # feedback-directed autotuner (FLAGS_autotune,
+            # paddle_tpu/tuning, docs/TUNING.md): searches run, trials
+            # measured, winners replayed from the on-disk cache
+            "tuning_searches": 0, "tuning_trials": 0,
+            "tuning_cache_hits": 0})
         _obs.register_engine(self)
         # lazily built per-engine stability controller
         # (FLAGS_stability_guard; paddle_tpu/stability/guard.py)
         self._stability = None
+        # program fingerprints already autotuned this process
+        # (FLAGS_autotune; paddle_tpu/tuning/driver.py)
+        self._tuned = set()
         # feed names that are identical on every process under multihost
         # SPMD (shared tables, per-step constants) — globalized by
         # replication instead of batch-dim concatenation
@@ -1114,6 +1126,23 @@ class Engine:
                 for n, a in params.items()}
 
     @staticmethod
+    def _tuning_key_items():
+        """Trace-affecting inputs BOTH cache keys must carry beyond the
+        long-standing flag set: the applied-tuning token (an applied
+        config changes flag/env values the trace read — the token makes
+        pre/post-apply traces distinct even if a knob round-trips), and
+        the env knobs the key audit found missing (the scheduler lane
+        cap shapes the island partition; compiler options and recompute
+        types are baked into the compiled step). The audit test in
+        tests/test_tuning.py asserts every trace-affecting knob in the
+        tuning catalog moves both keys."""
+        from ..tuning import state as _tuning_state
+        return (_tuning_state.applied_token(),
+                os.environ.get("PT_SCHED_LANES", ""),
+                os.environ.get("PT_COMPILER_OPTIONS", ""),
+                os.environ.get("PT_RECOMPUTE", ""))
+
+    @staticmethod
     def _cache_key(program, block_idx, feed_sig_key, fetch_names,
                    iterations=1):
         return (program.fingerprint, block_idx, feed_sig_key,
@@ -1133,7 +1162,8 @@ class Engine:
                 bool(FLAGS.use_custom_kernels),
                 os.environ.get("PT_KERNEL_DENY", ""),
                 os.environ.get("PT_KERNEL_MIN_NUMEL", ""),
-                os.environ.get("PT_KERNEL_QUANT_MATMUL", ""))
+                os.environ.get("PT_KERNEL_QUANT_MATMUL", ""),
+                *Engine._tuning_key_items())
 
     def compiled_step(self, program, scope: Scope, feed, fetch_names,
                       block_idx: int = 0, iterations: int = 1):
@@ -1241,7 +1271,8 @@ class Engine:
                 bool(FLAGS.use_custom_kernels),
                 os.environ.get("PT_KERNEL_DENY", ""),
                 os.environ.get("PT_KERNEL_MIN_NUMEL", ""),
-                os.environ.get("PT_KERNEL_QUANT_MATMUL", ""))
+                os.environ.get("PT_KERNEL_QUANT_MATMUL", ""),
+                *Engine._tuning_key_items())
 
     def _fast_feed_arrays(self, entry: _FastPathEntry, feed):
         """Feed dict -> device arrays through the cached signature: no
@@ -1285,11 +1316,45 @@ class Engine:
             arrays[n] = arr
         return arrays
 
+    def _maybe_autotune(self, program, scope, place, feed,
+                        fetch_names) -> None:
+        """FLAGS_autotune: once per program fingerprint, replay (cache
+        hit) or search for (cache miss) the winning knob config before
+        the first trace (paddle_tpu/tuning/driver.py). Trials recurse
+        into run() — the search_in_progress guard keeps them from
+        autotuning themselves. A tuning failure degrades to untuned
+        execution, never breaks the step."""
+        from ..tuning import state as _tuning_state
+        if _tuning_state.search_in_progress():
+            return
+        if not fetch_names:
+            # nothing to fetch-fence a measurement on — init/startup
+            # programs run once, tuning them is pure waste. Not marked
+            # tuned: a later fetching run of this program still tunes.
+            return
+        fp = program.fingerprint
+        if fp in self._tuned:
+            return
+        self._tuned.add(fp)
+        try:
+            from ..tuning import driver as _tuning_driver
+            _tuning_driver.autotune_for_run(self, program, scope,
+                                            place, feed, fetch_names)
+        except Exception as exc:  # degrade, don't break training
+            import warnings
+            warnings.warn(f"autotune skipped: {exc!r}")
+
     def run(self, program, scope: Scope, place, feed, fetch_names,
             block_idx: int = 0,
             return_numpy: bool = True,
             iterations: int = 1,
             use_program_cache: bool = True) -> List[Any]:
+        if FLAGS.autotune:
+            # before the fast-path lookup: applying a tuning config
+            # changes both cache keys (applied token + knob values),
+            # so the winner must be live before the first trace
+            self._maybe_autotune(program, scope, place, feed,
+                                 fetch_names)
         self.counters["runs"] += 1
         plan = _fault_plan()
         if plan is not None:

@@ -215,6 +215,21 @@ _define("stability_guard", False, True,
         "restores the in-memory ghost-snapshot ring captured every "
         "PT_GHOST_EVERY steps and re-executes the step "
         "(docs/STABILITY.md)")
+# feedback-directed autotuner (paddle_tpu/tuning, docs/TUNING.md)
+_define("autotune", False, True,
+        "feedback-directed autotuner (paddle_tpu/tuning): at the first "
+        "step of a program, look the program up in the persistent "
+        "tuning cache (PT_TUNING_CACHE_DIR) and apply the stored "
+        "winning knob config before the first trace; on a miss, run a "
+        "scope-snapshotted coordinate-descent search over the knob "
+        "registry (measured step ms objective, successive-halving "
+        "budgets), persist the winner atomically, then apply it. "
+        "Lossy knobs (quantized allreduce / quantized matmul) are "
+        "excluded from the search unless PT_TUNE_ALLOW_LOSSY=1, so "
+        "the tuned trajectory stays value-preserving. Search extras: "
+        "PT_TUNE_BUDGETS, PT_TUNE_ROUNDS, PT_TUNE_SEED, "
+        "PT_TUNE_VARIANTS (Pallas kernel variant search) "
+        "(docs/TUNING.md)")
 
 # -- subsumed flags: accepted, validated, no effect under XLA/PJRT ----------
 for _name, _default, _help in [

@@ -72,21 +72,35 @@ __all__ = ["build_scheduled_step", "partition_block", "last_read_table",
 
 # dispatch lanes: submitting a jitted call is host work (arg flattening
 # + runtime enqueue), so a handful of threads is enough to keep the
-# device queue full; PT_SCHED_LANES overrides for experiments
-_LANES = max(2, int(os.environ.get("PT_SCHED_LANES", "4") or 4))
+# device queue full; PT_SCHED_LANES overrides (read at runtime through
+# the knob registry, tuning/knobs.py — an import-time read here froze
+# the lane count before the autotuner or a test could change it)
+def lanes() -> int:
+    from ..tuning import knobs
+    try:
+        return max(2, int(knobs.value("sched_lanes")))
+    except (TypeError, ValueError):
+        return 4
+
 
 _POOL: Optional[ThreadPoolExecutor] = None
 _POOL_LOCK = threading.Lock()
 
 
 def _pool() -> ThreadPoolExecutor:
+    """The shared dispatch pool, rebuilt when the lane knob changes.
+
+    Rebuild is safe mid-flight: the old executor keeps draining the
+    futures already submitted to it (shutdown(wait=False) only stops
+    NEW submissions), while new steps land on the resized pool."""
     global _POOL
-    if _POOL is None:
-        with _POOL_LOCK:
-            if _POOL is None:
-                _POOL = ThreadPoolExecutor(
-                    max_workers=_LANES,
-                    thread_name_prefix="pt-sched-lane")
+    n = lanes()
+    with _POOL_LOCK:
+        if _POOL is None or _POOL._max_workers != n:
+            old, _POOL = _POOL, ThreadPoolExecutor(
+                max_workers=n, thread_name_prefix="pt-sched-lane")
+            if old is not None:
+                old.shutdown(wait=False)
     return _POOL
 
 
@@ -215,7 +229,7 @@ def _island_interface(ops, isl: Island) -> None:
 
 def partition_block(ops, fetch_names: Sequence[str],
                     updated_names: Sequence[str],
-                    cap: int = _LANES) -> List[List[Island]]:
+                    cap: Optional[int] = None) -> List[List[Island]]:
     """Partition `ops` into phases of data-independent islands.
 
     Returns phases in program order; islands within a phase are mutually
@@ -224,7 +238,11 @@ def partition_block(ops, fetch_names: Sequence[str],
     ``analysis.def_use.DefUseGraph``). Each op lands in exactly one
     island. ``out_names`` is each island's externally-consumed write
     set: reads of OTHER islands plus the step outputs (fetches, updated
-    persistables)."""
+    persistables). ``cap`` (same-phase island bound) defaults to the
+    CURRENT lane count — resolved per call, not at import, so the
+    sched_lanes knob shapes the partition the step is traced with."""
+    if cap is None:
+        cap = lanes()
     phases: List[List[Island]] = []
     for pi, (s, e) in enumerate(_phase_ranges(ops)):
         comps = _cap_components(_components(ops, s, e), cap)

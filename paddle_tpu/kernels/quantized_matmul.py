@@ -49,8 +49,15 @@ __all__ = ["quantized_matmul", "quant_mode"]
 
 
 def quant_mode() -> str:
-    """Requested quantization mode ("" = kernel disabled)."""
-    mode = os.environ.get("PT_KERNEL_QUANT_MATMUL", "").strip().lower()
+    """Requested quantization mode ("" = kernel disabled). Read via
+    the knob registry (a LOSSY knob — the autotuner only searches it
+    under PT_TUNE_ALLOW_LOSSY=1)."""
+    try:
+        from ..tuning import knobs
+        mode = str(knobs.value("kernel_quant_matmul") or "")
+    except Exception:
+        mode = os.environ.get("PT_KERNEL_QUANT_MATMUL", "")
+    mode = mode.strip().lower()
     return mode if mode in ("int8", "bf16") else ""
 
 

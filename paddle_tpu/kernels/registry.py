@@ -146,11 +146,13 @@ def get(name: str) -> Optional[Kernel]:
 
 
 def min_numel() -> int:
-    """Eligibility floor for size-gated kernels (env-tunable)."""
+    """Eligibility floor for size-gated kernels, via the knob registry
+    (tuning/knobs.py) so the autotuner and env agree on one read
+    path."""
     try:
-        return int(os.environ.get("PT_KERNEL_MIN_NUMEL",
-                                  _DEFAULT_MIN_NUMEL))
-    except ValueError:
+        from ..tuning import knobs
+        return int(knobs.value("kernel_min_numel"))
+    except Exception:
         return _DEFAULT_MIN_NUMEL
 
 
@@ -186,7 +188,11 @@ def _platform() -> Optional[str]:
 
 
 def _deny() -> Tuple[str, ...]:
-    raw = os.environ.get("PT_KERNEL_DENY", "")
+    try:
+        from ..tuning import knobs
+        raw = str(knobs.value("kernel_deny") or "")
+    except Exception:
+        raw = os.environ.get("PT_KERNEL_DENY", "")
     return tuple(p.strip() for p in raw.split(",") if p.strip())
 
 

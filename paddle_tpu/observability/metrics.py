@@ -458,6 +458,23 @@ def _install_standard_families(reg: MetricsRegistry) -> None:
     reg.counter("pt_deep_profiles_total",
                 "deep-profile captures that emitted a merged timeline "
                 "(PT_DEEP_PROFILE_EVERY / request_deep_profile)")
+    # feedback-directed autotuner (FLAGS_autotune, paddle_tpu/tuning,
+    # docs/TUNING.md)
+    reg.counter("pt_tuning_searches_total",
+                "knob searches run to completion (one per program that "
+                "missed the tuning cache)")
+    reg.counter("pt_tuning_trials_total",
+                "objective evaluations performed by the search driver "
+                "(each = restore scope, apply config, measure steps)")
+    reg.counter("pt_tuning_cache_hits_total",
+                "programs whose winning config was replayed from the "
+                "persistent tuning cache (zero trials)")
+    reg.gauge("pt_tuning_best_ms",
+              "objective (median fetch-fenced step ms) of the applied "
+              "winning config for the most recently tuned program")
+    reg.histogram("pt_tuning_trial_seconds",
+                  "wall time of one search trial, including the trace "
+                  "+ compile a trace-affecting candidate pays")
     reg.register_collector(_engine_families)
     reg.register_collector(_rpc_families)
 

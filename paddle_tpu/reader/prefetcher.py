@@ -46,11 +46,17 @@ class DeviceFeedPrefetcher:
 
     ``depth`` bounds the number of staged batches (2 = classic double
     buffering: the conversion + H2D of batch K+1 overlaps batch K's
-    device compute under JAX async dispatch). Worker exceptions are
-    re-raised at the consumer, never swallowed.
+    device compute under JAX async dispatch); the default comes from
+    the ``prefetch_depth`` knob (``PT_PREFETCH_DEPTH``,
+    tuning/knobs.py) so the autotuner can trade staging memory for
+    overlap. Worker exceptions are re-raised at the consumer, never
+    swallowed.
     """
 
-    def __init__(self, reader, place=None, depth: int = 2):
+    def __init__(self, reader, place=None, depth: Optional[int] = None):
+        if depth is None:
+            from ..tuning import knobs
+            depth = max(1, int(knobs.value("prefetch_depth")))
         if depth < 1:
             raise ValueError(f"depth must be >= 1, got {depth}")
         self._reader = reader

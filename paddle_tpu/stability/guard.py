@@ -504,8 +504,12 @@ class StabilityGuard:
     fallback, and the replay-bundle dump."""
 
     def __init__(self):
-        self.ghost = GhostRing(max(1, _env_int("PT_GHOST_KEEP", 2)))
-        self.ghost_every = max(1, _env_int("PT_GHOST_EVERY", 10))
+        # ghost cadence/depth through the knob registry
+        # (tuning/knobs.py): the autotuner searches ghost_every —
+        # snapshot cost vs rollback loss window, host-side only
+        from ..tuning import knobs as _knobs
+        self.ghost = GhostRing(max(1, int(_knobs.value("ghost_keep"))))
+        self.ghost_every = max(1, int(_knobs.value("ghost_every")))
         self.escalate_after = max(1, _env_int(
             "PT_GUARD_ESCALATE_AFTER", 3))
         self.replay_max = _env_int("PT_GUARD_REPLAY_MAX", 4)

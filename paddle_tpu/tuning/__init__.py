@@ -1,0 +1,25 @@
+"""Feedback-directed autotuner (docs/TUNING.md).
+
+Layout:
+
+* :mod:`.state`   — applied-config token the engine folds into its
+  trace cache keys (imports nothing; safe for core.engine)
+* :mod:`.knobs`   — declarative registry of every tunable knob
+* :mod:`.search`  — seeded coordinate descent + successive halving
+* :mod:`.cache`   — persistent per-program tuning cache (atomic JSON)
+* :mod:`.driver`  — engine-facing cache-or-search orchestration
+* :mod:`.variants`— Pallas kernel variant search (parity-gated)
+
+Only ``state`` and ``knobs`` import eagerly; everything that touches
+jax or the engine loads on first use.
+"""
+from . import knobs, state  # noqa: F401
+
+__all__ = ["knobs", "state", "search", "cache", "driver", "variants"]
+
+
+def __getattr__(name):
+    if name in ("search", "cache", "driver", "variants"):
+        import importlib
+        return importlib.import_module("." + name, __name__)
+    raise AttributeError(name)

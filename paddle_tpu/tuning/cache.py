@@ -1,0 +1,185 @@
+"""On-disk tuning cache: a tuned program pays the search cost once.
+
+Keyed like the engine fast-path cache — program fingerprint + device
+topology + the ambient values of every trace-affecting knob — so a
+winner is only replayed into the exact world it was measured in: a
+different chip count, backend, or hand-set knob baseline gets its own
+entry. Entries are one JSON file per key digest, written atomically
+through the checkpoint writer primitives (tmp sibling + fsync +
+os.replace + directory fsync), so a crash mid-store can never leave a
+half-written winner for the next run to trust.
+
+Fallback policy (tests/test_tuning.py): a corrupt file, a stale schema
+version, or a digest/fingerprint mismatch reads as a MISS — the engine
+then searches again (or runs on defaults), never raises.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from . import knobs
+
+__all__ = ["SCHEMA_VERSION", "cache_dir", "topology", "cache_key",
+           "key_digest", "path_for", "lookup", "store",
+           "entry_errors", "scan", "content_fingerprint"]
+
+SCHEMA_VERSION = 1
+
+
+def content_fingerprint(program) -> str:
+    """Content hash of a program — NOT ``program.fingerprint``.
+
+    The engine's ``(uid, version)`` fingerprint is a process-local
+    identity: perfect for the in-memory trace caches, useless for a
+    cache that must survive the process (an identical model built
+    tomorrow gets a different uid). The canonical proto serialization
+    captures exactly what the trace consumes — ops, slots, attrs, var
+    shapes/dtypes — so it IS the cross-process identity."""
+    try:
+        payload = program.serialize_to_string()
+    except Exception:
+        # not a Program (tests pass sentinels): identity by repr
+        payload = repr(program).encode()
+    return hashlib.sha1(payload).hexdigest()
+
+
+def cache_dir() -> str:
+    """PT_TUNING_CACHE_DIR, else ~/.cache/paddle_tpu/tuning."""
+    return os.environ.get("PT_TUNING_CACHE_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "paddle_tpu", "tuning")
+
+
+def topology() -> Dict[str, Any]:
+    """Device topology half of the key. Initializes the backend (the
+    engine has it up by the time tuning runs)."""
+    import jax
+    return {"backend": jax.default_backend(),
+            "devices": int(jax.device_count()),
+            "processes": int(jax.process_count())}
+
+
+def cache_key(fingerprint) -> Dict[str, Any]:
+    """Identity of one tuning problem. ``knob_baseline`` holds the
+    AMBIENT (pre-apply) trace-affecting knob values: both the search
+    run and every later cache-hit run start from the same hand-set
+    baseline, so they compute the same key."""
+    return {"schema": SCHEMA_VERSION,
+            "fingerprint": list(map(str, fingerprint))
+            if isinstance(fingerprint, (tuple, list))
+            else str(fingerprint),
+            "topology": topology(),
+            "knob_baseline": [list(kv) for kv in knobs.key_items()]}
+
+
+def key_digest(key: Dict[str, Any]) -> str:
+    return hashlib.sha1(
+        json.dumps(key, sort_keys=True).encode()).hexdigest()
+
+
+def path_for(key: Dict[str, Any]) -> str:
+    return os.path.join(cache_dir(), key_digest(key) + ".json")
+
+
+def _read(path: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(path, "r") as f:
+            entry = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return entry if isinstance(entry, dict) else None
+
+
+def entry_errors(entry: Optional[Dict[str, Any]],
+                 path: str = "") -> List[str]:
+    """Schema validation shared with ``tools/lint_program.py
+    --check-tuning-cache``. Empty list = valid."""
+    if entry is None:
+        return ["unreadable or not a JSON object"]
+    errs = []
+    if entry.get("schema") != SCHEMA_VERSION:
+        errs.append(f"stale schema version {entry.get('schema')!r} "
+                    f"(current {SCHEMA_VERSION})")
+    key = entry.get("key")
+    if not isinstance(key, dict):
+        errs.append("missing key object")
+    else:
+        digest = key_digest(key)
+        if entry.get("digest") != digest:
+            errs.append("digest does not match key (stale or edited "
+                        "entry)")
+        if path:
+            base = os.path.basename(path)
+            if base != digest + ".json":
+                errs.append(f"file name {base!r} does not match key "
+                            f"digest (fingerprint-stale)")
+        if not key.get("fingerprint"):
+            errs.append("key has no program fingerprint")
+    config = entry.get("config")
+    if not isinstance(config, dict):
+        errs.append("missing config object")
+    else:
+        for name in config:
+            try:
+                knobs.get(name)
+            except KeyError:
+                errs.append(f"config names unknown knob {name!r}")
+    return errs
+
+
+def lookup(key: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """The stored entry for ``key``, or None (miss / corrupt / stale)."""
+    path = path_for(key)
+    entry = _read(path)
+    if entry is None or entry_errors(entry, path):
+        return None
+    # the digest already pins the key; double-check the fingerprint so
+    # a hand-copied file cannot cross programs
+    if entry["key"].get("fingerprint") != key.get("fingerprint"):
+        return None
+    return entry
+
+
+def store(key: Dict[str, Any], config: Dict[str, Any], *,
+          objective_ms: Optional[float] = None, trials: int = 0,
+          kernel_variants: Optional[Dict[str, Any]] = None,
+          extras: Optional[Dict[str, Any]] = None) -> str:
+    """Atomically persist one winner; returns the entry path."""
+    from ..checkpoint.writer import atomic_write
+    os.makedirs(cache_dir(), exist_ok=True)
+    entry = {"schema": SCHEMA_VERSION,
+             "key": key,
+             "digest": key_digest(key),
+             "config": {k: v for k, v in config.items()},
+             "config_digest": knobs.config_digest(config),
+             "objective_ms": objective_ms,
+             "trials": int(trials),
+             "created_unix": time.time()}
+    if kernel_variants:
+        entry["kernel_variants"] = kernel_variants
+    if extras:
+        entry.update(extras)
+    path = path_for(key)
+    with atomic_write(path, "w") as f:
+        json.dump(entry, f, indent=1, sort_keys=True)
+    return path
+
+
+def scan(directory: Optional[str] = None
+         ) -> List[Dict[str, Any]]:
+    """[{path, errors}] for every *.json entry in the cache dir (the
+    lint surface). Missing directory scans as empty, not an error."""
+    d = directory or cache_dir()
+    out = []
+    if not os.path.isdir(d):
+        return out
+    for name in sorted(os.listdir(d)):
+        if not name.endswith(".json") or name.endswith(".tmp"):
+            continue
+        path = os.path.join(d, name)
+        out.append({"path": path,
+                    "errors": entry_errors(_read(path), path)})
+    return out

@@ -1,0 +1,57 @@
+"""Process-wide applied-tuning state, import-cycle free.
+
+The engine folds :func:`applied_token` into BOTH of its trace cache
+keys (``Engine._cache_key`` / ``Engine._fast_key``), so a tuning config
+applied mid-process can never serve a compiled artifact traced under a
+different config. This module therefore must be importable from
+``core.engine`` without dragging in the rest of the tuning package —
+it holds plain data and imports nothing from paddle_tpu.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+_LOCK = threading.Lock()
+
+# token: short stable digest of the applied config ("" = nothing
+# applied, the pre-autotuner world); config: the applied knob dict;
+# source: "cache" | "search" | "manual" for diagnostics.
+_APPLIED: Dict[str, Any] = {"token": "", "config": None, "source": ""}
+
+# Reentry guard: while a search trial is running the engine must not
+# start a nested search from the trial's own run() calls.
+_IN_PROGRESS = [False]
+
+
+def applied_token() -> str:
+    """Digest of the currently-applied tuning config ("" when none)."""
+    return _APPLIED["token"]
+
+
+def applied_config() -> Optional[Dict[str, Any]]:
+    return _APPLIED["config"]
+
+
+def applied_source() -> str:
+    return _APPLIED["source"]
+
+
+def set_applied(token: str, config: Optional[Dict[str, Any]],
+                source: str) -> None:
+    with _LOCK:
+        _APPLIED["token"] = token or ""
+        _APPLIED["config"] = dict(config) if config else None
+        _APPLIED["source"] = source
+
+
+def clear_applied() -> None:
+    set_applied("", None, "")
+
+
+def search_in_progress() -> bool:
+    return _IN_PROGRESS[0]
+
+
+def set_search_in_progress(on: bool) -> None:
+    _IN_PROGRESS[0] = bool(on)

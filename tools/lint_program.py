@@ -230,6 +230,13 @@ def _parser():
                         "registered in paddle_tpu/kernels must have a "
                         "numerics-parity entry (kernels/parity.py); "
                         "exits non-zero on gaps")
+    p.add_argument("--check-tuning-cache", nargs="?", const="",
+                   default=None, metavar="DIR",
+                   help="validate every entry in the persistent tuning "
+                        "cache (default dir: PT_TUNING_CACHE_DIR, "
+                        "docs/TUNING.md): schema version, key/digest "
+                        "consistency, known knob names; exits non-zero "
+                        "on invalid entries")
     return p
 
 
@@ -254,13 +261,43 @@ def _check_kernels() -> int:
     return EXIT_CLEAN
 
 
+def _check_tuning_cache(directory: str) -> int:
+    """Tuning-cache hygiene lint (docs/TUNING.md): an entry the engine
+    would silently treat as a miss — stale schema, digest mismatch,
+    unknown knob — is surfaced here instead of costing a re-search."""
+    from paddle_tpu.tuning import cache
+    rows = cache.scan(directory or None)
+    bad = 0
+    for row in rows:
+        errs = row["errors"]
+        name = os.path.basename(row["path"])
+        if errs:
+            bad += 1
+            for e in errs:
+                print(f"  {name}: ERROR {e}")
+        else:
+            print(f"  {name}: ok")
+    d = directory or cache.cache_dir()
+    if bad:
+        print(f"check-tuning-cache: {bad}/{len(rows)} invalid "
+              f"entr{'y' if bad == 1 else 'ies'} in {d}",
+              file=sys.stderr)
+        return EXIT_ERRORS
+    print(f"check-tuning-cache: {len(rows)} entr"
+          f"{'y' if len(rows) == 1 else 'ies'} in {d}, all valid")
+    return EXIT_CLEAN
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ns = _parser().parse_args(argv)
     if ns.check_kernels:
         return _check_kernels()
+    if ns.check_tuning_cache is not None:
+        return _check_tuning_cache(ns.check_tuning_cache)
     if not ns.model and not ns.program:
         print("lint_program: one of --model/--program (or "
-              "--check-kernels) is required", file=sys.stderr)
+              "--check-kernels/--check-tuning-cache) is required",
+              file=sys.stderr)
         return EXIT_USAGE
     if ns.program and ns.shards > 1:
         print("lint_program: --shards requires --model", file=sys.stderr)

@@ -62,6 +62,10 @@ REQUIRED_FAMILIES = (
     "pt_step_skew_seconds", "pt_step_slowest_worker_seconds",
     "pt_island_device_seconds", "pt_hbm_peak_bytes",
     "pt_mfu_estimate", "pt_deep_profiles_total",
+    # feedback-directed autotuner (FLAGS_autotune, docs/TUNING.md)
+    "pt_tuning_searches_total", "pt_tuning_trials_total",
+    "pt_tuning_cache_hits_total", "pt_tuning_best_ms",
+    "pt_tuning_trial_seconds",
 )
 
 

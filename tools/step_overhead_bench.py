@@ -88,6 +88,24 @@ def guard_overhead_report(guard):
     return guard, line
 
 
+def tuning_report(tun):
+    """(dict, '#'-line) for the bench JSON tail from an autotune probe
+    result; (None, None) when the probe did not run or errored before
+    measuring."""
+    if not tun or "source" not in tun:
+        return (tun or None), None
+    obj = tun.get("objective_ms")
+    line = (f"# autotune[{tun['source']}]: {tun.get('trials', 0)} "
+            f"trial(s), objective "
+            f"{obj if obj is None else format(obj, '.3f')} ms/step, "
+            f"tuned-vs-default delta "
+            f"{tun.get('delta_ms') or 0.0:+.3f} ms")
+    if "cache_hit_second_run" in tun:
+        line += (f"; second run cache_hit="
+                 f"{tun['cache_hit_second_run']}")
+    return tun, line
+
+
 def _build_model(batch):
     import paddle_tpu as fluid
     from paddle_tpu import layers
@@ -197,6 +215,16 @@ def main(argv=None):
                         "scalar fetch); --threshold-ms gates the "
                         "guard-on DELTA, the number "
                         "docs/STABILITY.md promises stays small")
+    p.add_argument("--compare-tuned", action="store_true",
+                   help="run the feedback-directed autotuner on a "
+                        "fresh engine/model (docs/TUNING.md), measure "
+                        "with the winner applied, report the tuned-vs-"
+                        "default search delta (<= 0 by construction); "
+                        "--threshold-ms gates that delta. Search shape "
+                        "via PT_TUNE_KNOBS/PT_TUNE_BUDGETS (default: "
+                        "host-side knobs only, so the probe stays "
+                        "cheap); cache dir: PT_TUNING_CACHE_DIR "
+                        "(a throwaway dir when unset)")
     p.add_argument("--json", action="store_true")
     args = p.parse_args(argv)
 
@@ -277,6 +305,48 @@ def main(argv=None):
                 r["guard_delta_ms"] = r_g["sync_ms"] - r["sync_ms"]
             finally:
                 set_flags({"FLAGS_stability_guard": False})
+        if args.compare_tuned:
+            # autotune a FRESH engine/model, then measure with the
+            # winner applied; knob + applied state restored after, so
+            # the probe never leaks tuning into the caller's process
+            import shutil
+            import tempfile
+            from paddle_tpu.tuning import driver as tdriver
+            from paddle_tpu.tuning import knobs as tknobs
+            from paddle_tpu.tuning import state as tstate
+            snap = tknobs.snapshot()
+            own_cache = None
+            if not os.environ.get("PT_TUNING_CACHE_DIR"):
+                own_cache = tempfile.mkdtemp(prefix="pt_tune_bench_")
+                os.environ["PT_TUNING_CACHE_DIR"] = own_cache
+            os.environ.setdefault("PT_TUNE_KNOBS",
+                                  "prefetch_depth,ghost_every")
+            os.environ.setdefault("PT_TUNE_BUDGETS", "1,3")
+            try:
+                eng4, prog4, scope4, feed4, fetch4 = \
+                    _build_model(args.batch)
+                with fluid.scope_guard(scope4):
+                    info = tdriver.autotune_for_run(
+                        eng4, prog4, scope4, None, feed4, fetch4)
+                    r_t = measure_step_overhead(
+                        eng4, prog4, scope4, feed4, fetch4,
+                        steps=args.steps)
+                r["tuning"] = {
+                    "source": info["source"],
+                    "trials": info["trials"],
+                    "config": info["config"],
+                    "objective_ms": info["objective_ms"],
+                    "delta_ms": info.get("delta_ms"),
+                    "tuned": {k: r_t[k] for k in
+                              ("sync_ms", "pipelined_ms",
+                               "host_overhead_ms", "steps_per_sec")}}
+                r["tuned_delta_ms"] = info.get("delta_ms") or 0.0
+            finally:
+                tknobs.restore(snap)
+                tstate.clear_applied()
+                if own_cache:
+                    os.environ.pop("PT_TUNING_CACHE_DIR", None)
+                    shutil.rmtree(own_cache, ignore_errors=True)
     r["async_dispatch"] = bool(args.async_dispatch)
     r["telemetry"] = bool(args.telemetry)
     if args.json:
@@ -307,6 +377,10 @@ def main(argv=None):
                  "anomalies": r["guard_on"]["anomalies"]})
             if line:
                 print(line)
+        if "tuning" in r:
+            _, line = tuning_report(r["tuning"])
+            if line:
+                print(line)
     bad = []
     if r["counters"].get("traces"):
         bad.append(f"steady state re-traced "
@@ -326,6 +400,12 @@ def main(argv=None):
         bad.append(
             f"stability-guard sync delta "
             f"{r['guard_delta_ms']:.2f} ms > threshold "
+            f"{args.threshold_ms:.1f} ms")
+    if args.threshold_ms is not None and "tuned_delta_ms" in r and \
+            r["tuned_delta_ms"] > args.threshold_ms:
+        bad.append(
+            f"tuned-vs-default sync delta "
+            f"{r['tuned_delta_ms']:.3f} ms > threshold "
             f"{args.threshold_ms:.1f} ms")
     if bad:
         print("REGRESSION: " + "; ".join(bad), file=sys.stderr)
