@@ -444,6 +444,43 @@ def _probe_tuning(eng, prog, scope, feed, fetch, sync_ms):
     return out
 
 
+def _probe_memory(eng, prog, scope, feed, fetch, sync_ms):
+    """HBM memory-observatory probe (docs/MEMORY.md) on the
+    already-built transformer: one owner-attributed live-buffer
+    census — coverage vs jax.live_arrays() is the acceptance number
+    (the census must see >=95% of live bytes) — plus donation
+    effectiveness over the compiled entries and the per-island peak
+    rows when the scheduler split the step. Census enablement is
+    restored after, so the bench's telemetry-off numbers stay
+    uncontaminated."""
+    out = {"sync_ms": round(sync_ms, 2)}
+    try:
+        from paddle_tpu.observability import memory as obs_memory
+        was = obs_memory.census_enabled()
+        obs_memory.enable(True)
+        try:
+            c = obs_memory.census()
+        finally:
+            obs_memory.enable(was)
+        out.update({
+            "live_bytes": c["live_bytes"],
+            "tagged_bytes": c["tagged_bytes"],
+            "orphan_bytes": c["orphan_bytes"],
+            "coverage_frac": round(c["coverage_frac"], 4),
+            "census_ms": round(c["census_ms"], 3),
+            "owners": {o: r.get("bytes", 0)
+                       for o, r in c["owners"].items()},
+            "donation": obs_memory.donation_stats()})
+        rows = obs_memory.island_attribution()
+        if rows:
+            out["island_peak_bytes"] = max(
+                int(r.get("peak_bytes", 0) or 0) for r in rows)
+            out["islands"] = len(rows)
+    except Exception as exc:   # accounting only; never fail the bench
+        out["error"] = f"{type(exc).__name__}: {exc}"[:200]
+    return out
+
+
 def bench_transformer(batch=BATCH, seq=None, measure_ckpt=False):
     import paddle_tpu as fluid
     from paddle_tpu import models
@@ -504,6 +541,10 @@ def bench_transformer(batch=BATCH, seq=None, measure_ckpt=False):
             # feedback-directed autotune loop (search -> persist ->
             # cache hit) for the tuning JSON tail (docs/TUNING.md)
             stats["tuning"] = _probe_tuning(
+                eng, main_prog, scope, feed, [cost.name], sync_ms)
+            # owner-attributed live-buffer census + donation
+            # effectiveness for the memory JSON tail (docs/MEMORY.md)
+            stats["memory"] = _probe_memory(
                 eng, main_prog, scope, feed, [cost.name], sync_ms)
     return sps * batch * s_trg, sps, traj, sync_ms, stats
 
@@ -931,6 +972,18 @@ def main():
         tun, tun_line = tuning_report((stats or {}).get("tuning"))
     except Exception:
         pass   # accounting only; never fail the bench on it
+    memr, mem_line = (stats or {}).get("memory") or {}, None
+    if memr and "coverage_frac" in memr:
+        don = memr.get("donation") or {}
+        eff = don.get("effectiveness_frac")
+        mem_line = (f"# memory: census coverage="
+                    f"{memr['coverage_frac']:.2f} live="
+                    f"{memr['live_bytes']} B orphan="
+                    f"{memr['orphan_bytes']} B in "
+                    f"{memr['census_ms']:.1f} ms; donation "
+                    f"effectiveness="
+                    f"{eff if eff is None else format(eff, '.2f')} "
+                    f"({don.get('donated_names', 0)} donated vars)")
     chaos, chaos_line = {}, None
     if os.environ.get("PT_BENCH_CHAOS"):
         # opt-in: spawns a 2-trainer PS job twice (clean + faulted),
@@ -964,6 +1017,7 @@ def main():
         "kernels": kern or None,
         "tracing": trac or None,
         "tuning": tun or None,
+        "memory": memr or None,
         "chaos": chaos or None,
         "metrics": metrics_tail or None,
     }))
@@ -979,6 +1033,8 @@ def main():
         print(trac_line, file=sys.stderr)
     if tun_line:
         print(tun_line, file=sys.stderr)
+    if mem_line:
+        print(mem_line, file=sys.stderr)
     if chaos_line:
         print(chaos_line, file=sys.stderr)
     print(f"# transformer: steps/s={sps:.2f} "

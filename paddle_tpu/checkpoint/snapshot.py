@@ -81,10 +81,17 @@ class Snapshot:
     """An immutable capture of training state, safe to serialize from a
     background thread while the step loop keeps running."""
 
-    __slots__ = ("entries",)
+    # __weakref__ so the memory census can weak-track live snapshots
+    # (owner "ckpt_snapshot") without pinning their device copies
+    __slots__ = ("entries", "__weakref__")
 
     def __init__(self, entries: Sequence[SnapshotEntry]):
         self.entries = list(entries)
+        try:
+            from ..observability import memory as _obs_memory
+            _obs_memory.track_snapshot(self)
+        except Exception:
+            pass
 
     def names(self):
         return [e.name for e in self.entries]

@@ -100,6 +100,11 @@ class Communicator:
         self._failed: Optional[BaseException] = None
         self._grad_num = 0
         self._grad_num_cv = threading.Condition()
+        # completed pull rounds, notified under _recv_cv: lets a
+        # training loop pace itself on "params actually refreshed"
+        # instead of sleep-and-hope (wait_recv_rounds)
+        self._recv_rounds = 0
+        self._recv_cv = threading.Condition()
         self._running = False
         self._send_thread = None
         self._recv_thread = None
@@ -203,6 +208,30 @@ class Communicator:
             fresh = async_ps.pull_params(ep, names)
             for n, v in fresh.items():
                 self._scope.var(n).set_value(np.asarray(v))
+        with self._recv_cv:
+            self._recv_rounds += 1
+            self._recv_cv.notify_all()
+
+    def recv_rounds(self) -> int:
+        """Completed parameter pull rounds since start()."""
+        with self._recv_cv:
+            return self._recv_rounds
+
+    def wait_recv_rounds(self, target: int, timeout: float) -> bool:
+        """Block until at least ``target`` pull rounds have completed
+        (True) or ``timeout`` seconds elapsed (False). Deterministic
+        replacement for sleep/poll pacing loops: a worker that wants
+        fresh params waits for the NEXT round
+        (``wait_recv_rounds(recv_rounds() + 1, t)``) instead of
+        guessing how long a pull takes. Returns immediately once the
+        communicator stops (the final stop() pull also counts)."""
+        deadline = None if timeout is None else \
+            (threading.TIMEOUT_MAX if timeout < 0 else timeout)
+        with self._recv_cv:
+            self._recv_cv.wait_for(
+                lambda: self._recv_rounds >= int(target) or
+                not self._running, timeout=deadline)
+            return self._recv_rounds >= int(target)
 
     def _recv_loop(self):
         thresh = int(FLAGS.communicator_min_send_grad_num_before_recv)
@@ -275,6 +304,8 @@ class Communicator:
         self._running = False
         with self._grad_num_cv:
             self._grad_num_cv.notify_all()
+        with self._recv_cv:
+            self._recv_cv.notify_all()  # release wait_recv_rounds waiters
         if self._send_thread is not None:
             self._send_thread.join(timeout=60)
         if self._recv_thread is not None:

@@ -37,6 +37,18 @@ def _flight_dump(reason: str, exc: BaseException, fingerprint) -> None:
         pass
 
 
+def _oom_postmortem(exc: BaseException, where: str) -> None:
+    """Deferred RESOURCE_EXHAUSTED surfacing at a materialization point
+    gets a memory postmortem too — who owned the HBM when the step that
+    OOMed was dispatched (docs/MEMORY.md). Deduped per exception chain,
+    no-op for non-OOM errors."""
+    try:
+        from ..observability import memory
+        memory.oom_postmortem(exc, where=where)
+    except Exception:
+        pass
+
+
 class PendingStep:
     """One dispatched-but-unchecked step: holds the device-resident
     all-finite flags (check_nan_inf) until a materialization point.
@@ -74,6 +86,7 @@ class PendingStep:
                 f"surfaced at materialization (FLAGS_async_dispatch): "
                 f"{exc}")
             self._exc.__cause__ = exc
+            _oom_postmortem(self._exc, "pending_step_check")
             _flight_dump("sticky_async_error", self._exc,
                          self._fingerprint)
             raise self._exc
@@ -95,8 +108,10 @@ class FetchHandle:
     deferred-check record. Duck-types the LoDTensor surface the fetch
     consumers already use (``.array``, ``.lod()``, ``np.asarray``)."""
 
+    # __weakref__ so the memory census can weak-track live handles
+    # (owner "pending_fetch") without pinning them
     __slots__ = ("_value", "_lod", "_rec", "_name", "_fingerprint",
-                 "_tctx")
+                 "_tctx", "__weakref__")
 
     def __init__(self, value, lod, rec: Optional[PendingStep], name,
                  fingerprint, tctx=None):
@@ -147,6 +162,7 @@ class FetchHandle:
                 f"{self._name!r} of program {self._fingerprint} "
                 f"(FLAGS_async_dispatch): {exc}")
             err.__cause__ = exc
+            _oom_postmortem(err, "fetch_materialize")
             _flight_dump("sticky_async_error", err, self._fingerprint)
             raise err
 

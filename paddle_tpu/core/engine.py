@@ -33,6 +33,7 @@ from .types import dtype_to_np
 from ..observability import metrics as _obs
 from ..observability import recorder as _obs_recorder
 from ..observability import tracing as _obs_tracing
+from ..observability import memory as _obs_memory
 
 RNG_STATE_VAR = "@RNG_STATE@"
 
@@ -930,6 +931,7 @@ class Engine:
         self._fast: Dict[Any, _FastPathEntry] = {}
         self._pending: List[Any] = []
         self._last_updated = ()
+        self._census_feed = None  # owner "feed" in the memory census
         self._multihost_cached: Optional[bool] = None
         self.mesh = mesh
         self.data_axis = data_axis
@@ -1488,6 +1490,9 @@ class Engine:
             entries.append(entry)
             if len(entries) > _MAX_FAST_ENTRIES:
                 entries.pop(0)
+        # cold path only: register the scope with the memory census
+        # (one weak-set add per trace, nothing per steady-state step)
+        _obs_memory.track_scope(scope)
         return self._dispatch(program, scope, traced, arrays,
                               donated_params, const_params,
                               return_numpy, obs=obs)
@@ -1518,7 +1523,7 @@ class Engine:
                 raise wd.error from None
             raise
 
-    def _obs_finish(self, obs):
+    def _obs_finish(self, obs, feed_arrays=None):
         """Close out one step's flight/telemetry record: total span,
         then hand it to the recorder (histogram observes + ring
         append), derive the step's trace spans from the same timings,
@@ -1526,11 +1531,20 @@ class Engine:
         boolean that built obs."""
         obs["phases"]["total_ms"] = (time.perf_counter()
                                      - obs.pop("_t0")) * 1e3
+        # census attribution for the step's device-side feed batch:
+        # held until the next step replaces it (owner "feed"), cleared
+        # when the census is off so the batch is not kept alive
+        self._census_feed = (feed_arrays
+                             if _obs_memory.census_active() else None)
         _obs_recorder.record_step(obs)
         _obs_tracing.finish_step(obs)
         try:
             from ..observability import attribution as _obs_attr
             _obs_attr.deep_profile_tick()
+        except Exception:
+            pass
+        try:
+            _obs_memory.step_tick()
         except Exception:
             pass
 
@@ -1549,14 +1563,20 @@ class Engine:
         t0 = time.perf_counter() if FLAGS.benchmark else None
         _d0 = time.perf_counter() if obs is not None else None
         from .. import profiler as _profiler
-        if _profiler.profiling_active():
-            with _profiler.RecordEvent(
-                    f"engine_step(program={program.fingerprint[0]})"):
+        try:
+            if _profiler.profiling_active():
+                with _profiler.RecordEvent(
+                        f"engine_step(program={program.fingerprint[0]})"):
+                    fetches, updated, nan_flags = traced.fn(
+                        donated_params, const_params, arrays, step_key)
+            else:
                 fetches, updated, nan_flags = traced.fn(
                     donated_params, const_params, arrays, step_key)
-        else:
-            fetches, updated, nan_flags = traced.fn(
-                donated_params, const_params, arrays, step_key)
+        except Exception as exc:
+            # RESOURCE_EXHAUSTED here = compile/alloc OOM: capture who
+            # owns the HBM before unwinding (one dump per exception)
+            _obs_memory.oom_postmortem(exc, where="engine_dispatch")
+            raise
         if obs is not None:
             # async dispatch: this is the enqueue span; device time
             # lands in fetch_ms (sync) or the materialization point
@@ -1666,27 +1686,34 @@ class Engine:
             tctx = _obs_tracing.current_context() \
                 if obs is not None else None
             for n, v in zip(traced.fetch_names, fetches):
-                out.append(FetchHandle(v, traced.fetch_lods.get(n), rec,
-                                       n, program.fingerprint,
-                                       tctx=tctx))
+                h = FetchHandle(v, traced.fetch_lods.get(n), rec,
+                                n, program.fingerprint, tctx=tctx)
+                if obs is not None:
+                    _obs_memory.track_fetch_handle(h)
+                out.append(h)
             if obs is not None:
                 obs["pending_fetches"] = len(self._pending)
                 obs["phases"]["fetch_ms"] = 0.0  # deferred to handles
-                self._obs_finish(obs)
+                self._obs_finish(obs, arrays)
             return out
         _f0 = time.perf_counter() if obs is not None else None
-        for n, v in zip(traced.fetch_names, fetches):
-            lod = traced.fetch_lods.get(n)
-            if return_numpy and not lod:
-                out.append(np.asarray(v))
-            else:
-                t = LoDTensor(v, lod or [])
-                out.append(t)
+        try:
+            for n, v in zip(traced.fetch_names, fetches):
+                lod = traced.fetch_lods.get(n)
+                if return_numpy and not lod:
+                    out.append(np.asarray(v))
+                else:
+                    t = LoDTensor(v, lod or [])
+                    out.append(t)
+        except Exception as exc:
+            # deferred XLA OOM surfaces at the sync D2H
+            _obs_memory.oom_postmortem(exc, where="fetch")
+            raise
         if obs is not None:
             obs["pending_fetches"] = len(self._pending)
             obs["phases"]["fetch_ms"] = (time.perf_counter()
                                          - _f0) * 1e3
-            self._obs_finish(obs)
+            self._obs_finish(obs, arrays)
         return out
 
     def synchronize(self):
@@ -1724,6 +1751,7 @@ class Engine:
             except EnforceNotMet:
                 raise
             except Exception as exc:
+                _obs_memory.oom_postmortem(exc, where="synchronize")
                 err = EnforceNotMet(
                     f"deferred XLA error surfaced at synchronize(): "
                     f"{exc}")

@@ -15,12 +15,17 @@ Three layers (docs/OBSERVABILITY.md):
   detection (docs/TRACING.md);
 * :mod:`.attribution` — HLO cost/memory + measured device-time
   attribution per op category and scheduler island, the measured-MFU
-  gauge, and the deep-profile merged-timeline trigger.
+  gauge, and the deep-profile merged-timeline trigger;
+* :mod:`.memory` — HBM memory observatory: owner-attributed
+  live-buffer census reconciled against ``jax.live_arrays()``,
+  OOM/pressure postmortem dumps, and the leak sentinel
+  (docs/MEMORY.md).
 
 Hot-path contract: one boolean (``metrics._HOT[0]``, folded into
 ``profiler.profiling_active()``) gates all per-step work.
 """
-from . import metrics, recorder, export, tracing, attribution  # noqa: F401
+from . import metrics, recorder, export, tracing, attribution, \
+    memory  # noqa: F401
 from .metrics import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry, EngineCounters,
     default_registry, counter, gauge, histogram,
@@ -34,6 +39,7 @@ from .export import (  # noqa: F401
 
 __all__ = [
     "metrics", "recorder", "export", "tracing", "attribution",
+    "memory",
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "EngineCounters", "default_registry", "counter", "gauge",
     "histogram", "enable_telemetry", "telemetry_active",

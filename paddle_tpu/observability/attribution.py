@@ -51,8 +51,8 @@ from . import recorder as _recorder
 from . import tracing as _tracing
 
 __all__ = ["attribute", "measure_device_time", "mfu_estimate",
-           "island_rows", "program_ops", "hlo_text",
-           "request_deep_profile", "deep_profile_tick",
+           "island_rows", "island_memory_rows", "program_ops",
+           "hlo_text", "request_deep_profile", "deep_profile_tick",
            "deep_profile_active"]
 
 # dense bf16 matmul peak TFLOP/s per chip (public spec sheets; same
@@ -156,6 +156,76 @@ def island_rows(engine, device_ms_total: Optional[float] = None
     return rows
 
 
+def island_memory_rows(engine) -> List[Dict]:
+    """Per-island compiled-memory attribution: lower each scheduler
+    island's own executable against the signatures recorded by the
+    build pass and read its ``memory_analysis()`` —
+    argument/temp/output byte split plus the island peak (argument +
+    temp), exported as ``pt_island_hbm_peak_bytes{island}`` on the
+    same global island index the device-time rows use. Rows are cached
+    on the scheduled step (island signatures are fixed after build, so
+    the lowering cost is paid once) and pushed to the memory
+    observatory so postmortem dumps carry them. Empty when no
+    scheduler-split trace exists (whole-step ``pt_hbm_peak_bytes``
+    covers that case)."""
+    for traced in list(getattr(engine, "_cache", {}).values()):
+        sched = getattr(traced, "op_sched", None)
+        if sched is None or not getattr(sched, "phases", None):
+            continue
+        rows = getattr(sched, "_mem_rows", None)
+        if rows is None:
+            rows = _island_memory_rows(sched)
+            sched._mem_rows = rows
+        if not rows:
+            continue
+        for r in rows:
+            try:
+                _metrics.gauge("pt_island_hbm_peak_bytes").set(
+                    float(r["peak_bytes"]), island=str(r["island"]))
+            except Exception:
+                pass
+        try:
+            from . import memory as _memory
+            _memory.set_island_attribution(rows)
+        except Exception:
+            pass
+        return [dict(r) for r in rows]
+    return []
+
+
+def _island_memory_rows(sched) -> List[Dict]:
+    sig = getattr(sched, "_final_sig", None)
+    if not sig:
+        return []
+    try:
+        import jax
+        import jax.numpy as jnp
+        # same key signature convention as Engine._compiled_entry
+        key_sig = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    except Exception:
+        return []
+    rows: List[Dict] = []
+    idx = 0
+    for phase in sched.phases:
+        for isl in phase:
+            try:
+                ins_sig = {n: sig[n] for n in isl.in_names if n in sig}
+                ma = isl.jfn.lower(ins_sig, key_sig).compile() \
+                    .memory_analysis()
+                arg = float(getattr(ma, "argument_size_in_bytes", 0.0))
+                tmp = float(getattr(ma, "temp_size_in_bytes", 0.0))
+                outb = float(getattr(ma, "output_size_in_bytes", 0.0))
+                rows.append({
+                    "island": idx, "phase": isl.phase,
+                    "ops": len(isl.indices),
+                    "argument_bytes": arg, "temp_bytes": tmp,
+                    "output_bytes": outb, "peak_bytes": arg + tmp})
+            except Exception:
+                pass  # one un-lowerable island must not kill the rest
+            idx += 1
+    return rows
+
+
 # ---------------------------------------------------------------------------
 # measured device time (on-demand jax.profiler capture)
 # ---------------------------------------------------------------------------
@@ -233,10 +303,23 @@ def attribute(engine, program, scope, feed, fetch_names,
             (stats.get("argument_bytes") or 0.0)
         if peak_bytes:
             rep["hbm_peak_bytes"] = peak_bytes
-            try:
-                _metrics.gauge("pt_hbm_peak_bytes").set(peak_bytes)
-            except Exception:
-                pass
+    # scheduler-aware HBM peak: when FLAGS_op_scheduler split the step,
+    # compiled_stats is None (a ScheduledStep has no .lower) and the
+    # whole-step gauge used to go stale/unset — the step's footprint is
+    # then the max over its islands' own compiled peaks
+    mem_rows = island_memory_rows(engine)
+    if mem_rows:
+        rep["islands_memory"] = mem_rows
+        island_peak = max(float(r.get("peak_bytes") or 0.0)
+                          for r in mem_rows)
+        rep["hbm_peak_bytes"] = max(
+            float(rep.get("hbm_peak_bytes") or 0.0), island_peak)
+    if rep.get("hbm_peak_bytes"):
+        try:
+            _metrics.gauge("pt_hbm_peak_bytes").set(
+                rep["hbm_peak_bytes"])
+        except Exception:
+            pass
     hlo = hlo_text(engine, program, scope, feed, fetch_names,
                    block_idx=block_idx, iterations=iterations)
     if hlo:

@@ -33,7 +33,8 @@ from . import recorder as _recorder
 __all__ = ["render_exposition", "metrics_snapshot", "dump_metrics",
            "read_metrics_dump", "MetricsServer", "scrape",
            "maybe_start_from_env", "flight_to_chrome_trace",
-           "spans_to_chrome_trace", "merge_chrome_traces"]
+           "spans_to_chrome_trace", "memdump_to_chrome_trace",
+           "merge_chrome_traces"]
 
 
 # ---------------------------------------------------------------------------
@@ -345,14 +346,72 @@ def spans_to_chrome_trace(path: str) -> List[dict]:
     return events
 
 
+def memdump_to_chrome_trace(path: str) -> List[dict]:
+    """Convert one HBM memory dump (``memdump_<pid>_*.jsonl``,
+    docs/MEMORY.md) into chrome trace events rendered as a memory
+    lane: a counter ('C') event per owner so the owner breakdown
+    graphs as stacked area, one counter for live/tagged/orphan
+    totals, plus complete ('X') events for the top live buffers and
+    per-island peaks so the dump's heaviest allocations are
+    inspectable at the dump instant."""
+    from . import memory as _memory
+    d = _memory.read_memdump(path)
+    header = d.get("header") or {}
+    census = d.get("census") or {}
+    pid = header.get("pid", 0)
+    ts = float(census.get("t") or header.get("time") or 0.0) * 1e6
+    events: List[dict] = []
+    owners = census.get("owners") or {}
+    if owners:
+        events.append({
+            "name": "hbm_owner_bytes", "cat": "memory", "ph": "C",
+            "ts": ts, "pid": pid, "tid": 0,
+            "args": {o: int((r or {}).get("bytes", 0))
+                     for o, r in owners.items()}})
+    events.append({
+        "name": "hbm_bytes", "cat": "memory", "ph": "C",
+        "ts": ts, "pid": pid, "tid": 0,
+        "args": {"live": int(census.get("live_bytes") or 0),
+                 "tagged": int(census.get("tagged_bytes") or 0),
+                 "orphan": int(census.get("orphan_bytes") or 0)}})
+    # top buffers: one lane, biggest first; fixed 1ms width — the dump
+    # is a snapshot, duration only exists so chrome renders a bar
+    for i, b in enumerate(d.get("buffers") or []):
+        events.append({
+            "name": f"{b.get('owner', '?')}:{b.get('label', '?')}",
+            "cat": "memory.buffer", "ph": "X",
+            "ts": ts + i * 1e3, "dur": 1e3, "pid": pid, "tid": 1,
+            "args": {k: b.get(k)
+                     for k in ("owner", "label", "bytes", "shape",
+                               "dtype") if b.get(k) is not None}})
+    for i, r in enumerate(d.get("islands") or []):
+        events.append({
+            "name": f"island{r.get('island', i)}",
+            "cat": "memory.island", "ph": "X",
+            "ts": ts + i * 1e3, "dur": 1e3, "pid": pid, "tid": 2,
+            "args": {k: r.get(k)
+                     for k in ("island", "phase", "ops",
+                               "argument_bytes", "temp_bytes",
+                               "output_bytes", "peak_bytes")
+                     if r.get(k) is not None}})
+    if d.get("donation"):
+        events.append({
+            "name": "donation", "cat": "memory", "ph": "I",
+            "ts": ts, "pid": pid, "tid": 0, "s": "p",
+            "args": d["donation"]})
+    return events
+
+
 def _load_trace_events(path: str) -> List[dict]:
-    """Events of one timeline input: span/flight JSONL dumps convert,
-    chrome traces (.json / .json.gz, incl. jax.profiler output) pass
-    through."""
+    """Events of one timeline input: span/flight/memdump JSONL dumps
+    convert, chrome traces (.json / .json.gz, incl. jax.profiler
+    output) pass through."""
     base = os.path.basename(path)
     if path.endswith(".jsonl"):
         if base.startswith("spans_"):
             return spans_to_chrome_trace(path)
+        if base.startswith("memdump_"):
+            return memdump_to_chrome_trace(path)
         return flight_to_chrome_trace(path)
     import gzip
     opener = gzip.open if path.endswith(".gz") else open

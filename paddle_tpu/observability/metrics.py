@@ -56,6 +56,14 @@ def _recompute_hot() -> None:
         rec = recorder.recording_active()
     except Exception:
         pass
+    if not rec and not _TELEMETRY[0]:
+        # memory.enable(True) arms the per-step HBM census on its own
+        # (bench --compare-memory, tests) without full telemetry
+        try:
+            from . import memory
+            rec = memory.census_enabled()
+        except Exception:
+            pass
     _HOT[0] = _TELEMETRY[0] or rec
 
 
@@ -450,7 +458,8 @@ def _install_standard_families(reg: MetricsRegistry) -> None:
               "island's host dispatch-span share)")
     reg.gauge("pt_hbm_peak_bytes",
               "compiled-step HBM footprint: memory_analysis temp + "
-              "argument bytes")
+              "argument bytes (max over scheduler islands when "
+              "FLAGS_op_scheduler splits the step)")
     reg.gauge("pt_mfu_estimate",
               "measured MFU: analytic FLOPs/step over measured device "
               "(or host-wall) seconds per step against the chip's "
@@ -475,6 +484,29 @@ def _install_standard_families(reg: MetricsRegistry) -> None:
     reg.histogram("pt_tuning_trial_seconds",
                   "wall time of one search trial, including the trace "
                   "+ compile a trace-affecting candidate pays")
+    # HBM memory observatory (observability/memory.py, docs/MEMORY.md)
+    reg.gauge("pt_hbm_owner_bytes",
+              "owner-attributed live HBM bytes from the buffer census, "
+              "labeled {owner} (scope, ghost_ring, ckpt_snapshot, "
+              "prefetch, pending_step, pending_fetch, engine_updated, "
+              "orphan = live_arrays bytes nobody claimed)")
+    reg.gauge("pt_hbm_live_bytes",
+              "total non-deleted jax.live_arrays() bytes at the last "
+              "census (the census denominator)")
+    reg.gauge("pt_island_hbm_peak_bytes",
+              "per-scheduler-island compiled HBM peak, labeled "
+              "{island}: memory_analysis temp + argument bytes of the "
+              "island's own executable")
+    reg.gauge("pt_hbm_leak_suspect_bytes",
+              "leak-sentinel verdict, labeled {owner}: window growth "
+              "in bytes for owners whose census bytes rose "
+              "monotonically across the sliding window, 0 otherwise")
+    reg.counter("pt_memdumps_total",
+                "memory postmortem dumps written (memdump_*.jsonl: "
+                "oom, watermark, or explicit)")
+    reg.counter("pt_oom_postmortems_total",
+                "RESOURCE_EXHAUSTED exceptions that produced a memory "
+                "postmortem (deduped: one per exception chain)")
     reg.register_collector(_engine_families)
     reg.register_collector(_rpc_families)
 

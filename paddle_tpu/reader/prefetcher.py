@@ -62,6 +62,12 @@ class DeviceFeedPrefetcher:
         self._reader = reader
         self._place = place
         self._depth = depth
+        self._live_q = None  # set while iterating; census peeks it
+        try:
+            from ..observability import memory as _obs_memory
+            _obs_memory.track_prefetcher(self)  # owner "prefetch"
+        except Exception:
+            pass
 
     def _device(self):
         if self._place is not None and hasattr(self._place,
@@ -88,6 +94,7 @@ class DeviceFeedPrefetcher:
             else self._reader
         dev = self._device()
         q: queue.Queue = queue.Queue(maxsize=self._depth)
+        self._live_q = q  # staged device batches, visible to the census
         stop = object()
 
         def _fill():

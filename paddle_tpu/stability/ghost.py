@@ -52,6 +52,11 @@ class GhostRing:
     def __init__(self, capacity: int = 2):
         self.capacity = max(1, int(capacity))
         self._ring: List[GhostEntry] = []
+        try:
+            from ..observability import memory as _obs_memory
+            _obs_memory.track_ghost_ring(self)  # owner "ghost_ring"
+        except Exception:
+            pass
 
     def __len__(self) -> int:
         return len(self._ring)

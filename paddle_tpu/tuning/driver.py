@@ -144,6 +144,13 @@ def search_config(engine, program, scope, place, feed, fetch_names,
     rounds = _env_int("PT_TUNE_ROUNDS", 2)
     scope_snap = snapshot_scope(scope)
     knob_snap = knobs.snapshot()
+    try:
+        from ..observability import memory as _obs_memory
+        _obs_memory.note_host_bytes(
+            "tuning_snapshot",
+            sum(int(a.nbytes) for a in scope_snap.values()))
+    except Exception:
+        _obs_memory = None
     trials_c = metrics.counter("pt_tuning_trials_total")
     trial_h = metrics.histogram("pt_tuning_trial_seconds")
 
@@ -175,6 +182,8 @@ def search_config(engine, program, scope, place, feed, fetch_names,
         state.set_search_in_progress(False)
         knobs.restore(knob_snap)
         restore_scope(scope, scope_snap)
+        if _obs_memory is not None:
+            _obs_memory.note_host_bytes("tuning_snapshot", 0)
     return best, trials, start, budgets[-1]
 
 

@@ -15,7 +15,6 @@ Trainer prints per-step losses; the server prints its push count.
 import json
 import os
 import sys
-import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.pop("XLA_FLAGS", None)
@@ -27,13 +26,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 import paddle_tpu as fluid  # noqa: E402
 from paddle_tpu import layers  # noqa: E402
+from paddle_tpu.communicator import Communicator  # noqa: E402
 from paddle_tpu.core.flags import set_flags  # noqa: E402
 from paddle_tpu.incubate.fleet.base.role_maker import (  # noqa: E402
     Role, UserDefinedRoleMaker)
 from paddle_tpu.incubate.fleet.parameter_server import (  # noqa: E402
     DistributeTranspilerConfig, fleet)
 
-STEPS = 40
+# enough lr-0.01 SGD updates (x2 trainers) for the loss to reliably
+# halve; 40 steps left convergence at the mercy of scheduling luck
+STEPS = 120
 
 
 def build():
@@ -84,19 +86,28 @@ def main():
     rng = np.random.RandomState(11 + rank)     # different data per rank
     w_true = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
     losses = []
+    comm = Communicator.get_instance()
     import warnings
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")  # island demotion warnings
         for _ in range(STEPS):
+            # pace the loop: async staleness is unbounded, and a tight
+            # host loop can record every loss before a pull lands.
+            # Deterministic pacing — wait for one parameter pull
+            # completed at-or-after this step. The target round is
+            # captured BEFORE the step: this step's sends trigger the
+            # pull, which can finish while exe.run is still returning
+            # (bounded wait: a stalled pull falls through instead of
+            # deadlocking the step loop)
+            target = comm.recv_rounds() + 1 if comm is not None else 0
             bx = rng.rand(16, 4).astype(np.float32)
             by = bx @ w_true + 0.25
             out = exe.run(fleet.main_program,
                           feed={"x": bx, "y": by},
                           fetch_list=[loss.name])
             losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
-            # pace the loop: async staleness is unbounded, and a tight
-            # host loop can record every loss before a pull lands
-            time.sleep(0.05)
+            if comm is not None:
+                comm.wait_recv_rounds(target, timeout=2.0)
     fleet.stop_worker()  # flush + final param pull + SendComplete
     wv = fluid.global_scope().find_var("w").get_value()
     w = np.asarray(wv.array if hasattr(wv, "array") else wv)

@@ -66,6 +66,10 @@ REQUIRED_FAMILIES = (
     "pt_tuning_searches_total", "pt_tuning_trials_total",
     "pt_tuning_cache_hits_total", "pt_tuning_best_ms",
     "pt_tuning_trial_seconds",
+    # HBM memory observatory (docs/MEMORY.md)
+    "pt_hbm_owner_bytes", "pt_hbm_live_bytes",
+    "pt_island_hbm_peak_bytes", "pt_hbm_leak_suspect_bytes",
+    "pt_memdumps_total", "pt_oom_postmortems_total",
 )
 
 

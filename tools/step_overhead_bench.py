@@ -12,6 +12,7 @@ CLI::
     python tools/step_overhead_bench.py [--json] [--async-dispatch]
         [--batch N] [--steps N] [--threshold-ms X] [--telemetry]
         [--compare-telemetry] [--compare-scheduler] [--compare-guard]
+        [--compare-tuned] [--compare-memory]
 
 exits non-zero when measured host overhead exceeds ``--threshold-ms``
 (the CI regression gate). ``overhead_report()`` is imported by bench.py
@@ -104,6 +105,23 @@ def tuning_report(tun):
         line += (f"; second run cache_hit="
                  f"{tun['cache_hit_second_run']}")
     return tun, line
+
+
+def memory_report(mem):
+    """(dict, '#'-line) for the bench JSON tail from a memory-census
+    A/B probe result ({sync_ms_off, sync_ms_on, censuses, ...});
+    (None, None) when the probe did not run or errored before
+    measuring."""
+    if not mem or "sync_ms_on" not in mem:
+        return (mem or None), None
+    off, on = mem["sync_ms_off"], mem["sync_ms_on"]
+    cov = mem.get("coverage_frac")
+    line = (f"# memory_observatory: sync {off:.2f} -> {on:.2f} ms/step "
+            f"(delta {on - off:+.3f} ms); censuses="
+            f"{mem.get('censuses', 0)} coverage="
+            f"{cov if cov is None else format(cov, '.2f')} live="
+            f"{mem.get('live_bytes', 0)} B")
+    return mem, line
 
 
 def _build_model(batch):
@@ -225,6 +243,14 @@ def main(argv=None):
                         "host-side knobs only, so the probe stays "
                         "cheap); cache dir: PT_TUNING_CACHE_DIR "
                         "(a throwaway dir when unset)")
+    p.add_argument("--compare-memory", action="store_true",
+                   help="A/B the HBM memory-observatory census "
+                        "(docs/MEMORY.md): measure with the census "
+                        "disabled (the default path above, proving "
+                        "the one-boolean gate does zero work) then "
+                        "with memory.enable(True); --threshold-ms "
+                        "gates the census-on sync DELTA. Census "
+                        "cadence via PT_HBM_CENSUS_EVERY")
     p.add_argument("--json", action="store_true")
     args = p.parse_args(argv)
 
@@ -347,6 +373,38 @@ def main(argv=None):
                 if own_cache:
                     os.environ.pop("PT_TUNING_CACHE_DIR", None)
                     shutil.rmtree(own_cache, ignore_errors=True)
+        if args.compare_memory:
+            # A/B the live-buffer census on a FRESH engine/model; the
+            # census-off numbers above stay uncontaminated, and the
+            # baseline census count proves the disabled path did no
+            # census work at all
+            from paddle_tpu.observability import memory as obs_memory
+            censuses_off = obs_memory.stats()["censuses"]
+            obs_memory.reset()
+            obs_memory.enable(True)
+            try:
+                eng5, prog5, scope5, feed5, fetch5 = \
+                    _build_model(args.batch)
+                with fluid.scope_guard(scope5):
+                    r_m = measure_step_overhead(
+                        eng5, prog5, scope5, feed5, fetch5,
+                        steps=args.steps)
+                c = obs_memory.last_census() or {}
+                r["memory_on"] = {
+                    **{k: r_m[k] for k in
+                       ("sync_ms", "pipelined_ms", "host_overhead_ms",
+                        "steps_per_sec")},
+                    "censuses": obs_memory.stats()["censuses"],
+                    "censuses_disabled_baseline": censuses_off,
+                    "coverage_frac": c.get("coverage_frac"),
+                    "live_bytes": c.get("live_bytes"),
+                    "orphan_bytes": c.get("orphan_bytes"),
+                    "owners": {o: rec.get("bytes", 0) for o, rec in
+                               (c.get("owners") or {}).items()}}
+                r["memory_delta_ms"] = r_m["sync_ms"] - r["sync_ms"]
+            finally:
+                obs_memory.enable(False)
+                obs_memory.reset()
     r["async_dispatch"] = bool(args.async_dispatch)
     r["telemetry"] = bool(args.telemetry)
     if args.json:
@@ -381,6 +439,15 @@ def main(argv=None):
             _, line = tuning_report(r["tuning"])
             if line:
                 print(line)
+        if "memory_on" in r:
+            _, line = memory_report(
+                {"sync_ms_off": r["sync_ms"],
+                 "sync_ms_on": r["memory_on"]["sync_ms"],
+                 "censuses": r["memory_on"]["censuses"],
+                 "coverage_frac": r["memory_on"]["coverage_frac"],
+                 "live_bytes": r["memory_on"]["live_bytes"]})
+            if line:
+                print(line)
     bad = []
     if r["counters"].get("traces"):
         bad.append(f"steady state re-traced "
@@ -406,6 +473,12 @@ def main(argv=None):
         bad.append(
             f"tuned-vs-default sync delta "
             f"{r['tuned_delta_ms']:.3f} ms > threshold "
+            f"{args.threshold_ms:.1f} ms")
+    if args.threshold_ms is not None and "memory_delta_ms" in r and \
+            r["memory_delta_ms"] > args.threshold_ms:
+        bad.append(
+            f"memory-census sync delta "
+            f"{r['memory_delta_ms']:.2f} ms > threshold "
             f"{args.threshold_ms:.1f} ms")
     if bad:
         print("REGRESSION: " + "; ".join(bad), file=sys.stderr)

@@ -22,6 +22,9 @@ Beyond the reference contract, an input may also be:
 * a ``spans_*.jsonl`` distributed-tracing span dump (docs/TRACING.md)
   — converted to one lane per span kind, carrying trace/span/parent
   ids so client and server spans from different processes correlate;
+* a ``memdump_*.jsonl`` HBM memory dump (docs/MEMORY.md) — rendered
+  as a memory lane: per-owner byte counters plus the top live
+  buffers at dump time;
 * a ``*.trace.json.gz`` device profile (jax.profiler) — passed through;
 * a **directory or glob** — expanded to every flight/span dump (and
   chrome trace) inside, each auto-assigned its own lane named after
@@ -66,7 +69,7 @@ def _expand(name, path, explicit_name):
     if os.path.isdir(path):
         matches = sorted(
             os.path.join(path, n) for n in os.listdir(path)
-            if (n.startswith(("flight_", "spans_")) and
+            if (n.startswith(("flight_", "spans_", "memdump_")) and
                 n.endswith(".jsonl")) or n.endswith(".trace.json.gz"))
     elif any(c in path for c in "*?["):
         matches = sorted(_glob.glob(path))
