@@ -17,6 +17,10 @@ from .manifest import (  # noqa: F401
 from .snapshot import (  # noqa: F401
     Snapshot, SnapshotEntry, persistable_names, snapshot_scope,
 )
+from .train_state import (  # noqa: F401
+    TRAIN_STATE_VERSION, TrainState, read_train_state, register_reader,
+    registered_readers, unregister_reader,
+)
 from .writer import atomic_write  # noqa: F401
 
 __all__ = [
@@ -24,4 +28,6 @@ __all__ = [
     "Snapshot", "SnapshotEntry", "snapshot_scope", "persistable_names",
     "is_checkpoint_dir", "list_steps", "read_latest", "step_dir_name",
     "atomic_write",
+    "TRAIN_STATE_VERSION", "TrainState", "read_train_state",
+    "register_reader", "registered_readers", "unregister_reader",
 ]

@@ -92,10 +92,14 @@ class CheckpointManager:
         self._handles: List[SaveHandle] = []
         self._lock = threading.Lock()
         self._closed = False
-        self._last_save_spec = None   # (scope, program, vars) for SIGTERM
+        # (scope, program, vars, train_state mode) for SIGTERM
+        self._last_save_spec = None
         self._last_step: Optional[int] = None
         self._prev_sigterm = None
         self._preempt_step_fn = None
+        # TrainState of the last restore (None = legacy tensor-only
+        # checkpoint or nothing restored yet) — docs/RESILIENCE.md
+        self.restored_train_state = None
 
     # -- save ---------------------------------------------------------------
 
@@ -103,19 +107,26 @@ class CheckpointManager:
              vars: Optional[Sequence[str]] = None,
              snapshot: Optional[Snapshot] = None, sync: bool = False,
              raise_on_missing: bool = True,
-             include_rng: bool = True) -> SaveHandle:
+             include_rng: bool = True,
+             train_state=None) -> SaveHandle:
         """Queue an async save of ``step``. The snapshot (immutable
         refs + device-side copies) is taken HERE, on the caller's
         thread, so later scope mutations / engine buffer donation cannot
         corrupt it; everything slow (D2H, disk, fsync) happens on the
         background writer. ``sync=True`` writes inline and returns a
-        completed handle."""
+        completed handle.
+
+        ``train_state`` adds the exactly-once-resume section to the
+        manifest (docs/RESILIENCE.md): ``True`` captures it here (same
+        thread discipline as the snapshot — registered reader cursors +
+        guard scalars are read before the step loop moves on), or pass
+        a prepared :class:`~.train_state.TrainState` / dict."""
         if self._closed:
             raise RuntimeError("CheckpointManager is closed")
+        if scope is None:
+            from ..core.scope import global_scope
+            scope = global_scope()
         if snapshot is None:
-            if scope is None:
-                from ..core.scope import global_scope
-                scope = global_scope()
             if vars is None:
                 if program is None:
                     from ..framework import default_main_program
@@ -124,7 +135,19 @@ class CheckpointManager:
             snapshot = snapshot_scope(scope, vars,
                                       raise_on_missing=raise_on_missing,
                                       include_rng=include_rng)
-        self._last_save_spec = (scope, program, vars)
+        ts_dict = None
+        if train_state is not None and train_state is not False:
+            from .train_state import TrainState
+            if train_state is True:
+                train_state = TrainState.capture(
+                    int(step), scope=scope,
+                    process_index=self.process_index)
+            ts_dict = (train_state.to_dict()
+                       if isinstance(train_state, TrainState)
+                       else dict(train_state))
+        self._last_save_spec = (scope, program, vars,
+                                train_state is not None
+                                and train_state is not False)
         self._last_step = int(step)
         handle = SaveHandle(int(step))
         if _obs._HOT[0]:
@@ -138,15 +161,16 @@ class CheckpointManager:
         self._count("ckpt_saves", 1)
         self._count("ckpt_inflight", 1)
         if sync:
-            self._execute(snapshot, handle)
+            self._execute(snapshot, handle, ts_dict)
             if handle.error is not None:
                 raise handle.error
             return handle
         self._ensure_worker()
-        self._queue.put((snapshot, handle))
+        self._queue.put((snapshot, handle, ts_dict))
         return handle
 
-    def _execute(self, snapshot: Snapshot, handle: SaveHandle) -> None:
+    def _execute(self, snapshot: Snapshot, handle: SaveHandle,
+                 train_state: Optional[dict] = None) -> None:
         committed = None
         error: Optional[BaseException] = None
         t0 = time.perf_counter()
@@ -157,7 +181,8 @@ class CheckpointManager:
             os.makedirs(self.root, exist_ok=True)
             wr.write_process_shard(tmp_dir, snapshot, handle.step,
                                    self.process_index,
-                                   self.process_count)
+                                   self.process_count,
+                                   train_state=train_state)
             if self.process_index == 0:
                 committed = wr.commit_step(
                     self.root, handle.step, self.process_count,
@@ -194,9 +219,9 @@ class CheckpointManager:
             if item is None:
                 self._queue.task_done()
                 return
-            snapshot, handle = item
+            snapshot, handle, ts_dict = item
             try:
-                self._execute(snapshot, handle)
+                self._execute(snapshot, handle, ts_dict)
             finally:
                 self._queue.task_done()
 
@@ -256,12 +281,19 @@ class CheckpointManager:
     def restore(self, step: Optional[int] = None, scope=None,
                 program=None, vars: Optional[Sequence[str]] = None,
                 place=None, verify: bool = True, strict: bool = True,
-                include_rng: bool = True) -> int:
+                include_rng: bool = True,
+                apply_train_state: bool = True) -> int:
         """Load a committed checkpoint into ``scope``. ``step=None``
         follows LATEST, falling back (with a warning) to the newest
         complete step when the pointer is stale/dangling — the
         crash-mid-save recovery path. Checksums are verified before any
-        value reaches the scope. Returns the restored step."""
+        value reaches the scope. Returns the restored step.
+
+        When the manifest carries a ``train_state`` section and
+        ``apply_train_state`` is on, it is re-applied here (reader
+        cursors, guard scalars — train_state.py) and kept on
+        ``self.restored_train_state``; legacy tensor-only checkpoints
+        leave it None."""
         t0 = time.perf_counter()
         if scope is None:
             from ..core.scope import global_scope
@@ -280,8 +312,8 @@ class CheckpointManager:
         names = list(vars) if vars is not None else (
             persistable_names(program) if program is not None else None)
         from ..core.engine import RNG_STATE_VAR
+        man = wr._manifest_for_step(self.root, step)
         if names is not None and include_rng:
-            man = wr._manifest_for_step(self.root, step)
             if RNG_STATE_VAR in man["tensors"] and \
                     RNG_STATE_VAR not in names:
                 names.append(RNG_STATE_VAR)
@@ -303,6 +335,23 @@ class CheckpointManager:
             if not include_rng and name == RNG_STATE_VAR:
                 continue
             _restore(scope, name, arr, lod, place)
+        # a restore is a LEGITIMATE out-of-band parameter write: tell
+        # the integrity sentinel to rebuild its continuity shadow
+        # instead of raising a false anomaly (docs/RESILIENCE.md)
+        try:
+            from ..stability.integrity import invalidate_shadow
+            invalidate_shadow(scope)
+        except Exception:
+            pass
+        self.restored_train_state = None
+        ts_sec = man.get("train_state")
+        if ts_sec is not None:
+            from .train_state import TrainState
+            ts = TrainState.from_dict(ts_sec)
+            self.restored_train_state = ts
+            if apply_train_state:
+                ts.apply(scope=scope,
+                         process_index=self.process_index)
         if _obs.telemetry_active():
             _obs.histogram("pt_ckpt_restore_seconds").observe(
                 time.perf_counter() - t0)
@@ -365,9 +414,13 @@ class CheckpointManager:
                     else (self._last_step or 0) + 1)
             spec = self._last_save_spec
             if spec is not None:
-                scope, program, vars = spec
+                scope, program, vars, with_ts = spec
+                # re-capture the train state AT preemption time when
+                # the run was checkpointing it: the cursors have moved
+                # since the last periodic save
                 self.save(int(step), scope=scope, program=program,
-                          vars=vars, sync=True)
+                          vars=vars, sync=True,
+                          train_state=True if with_ts else None)
             self.wait()
         finally:
             prev = self._prev_sigterm

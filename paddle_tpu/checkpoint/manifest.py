@@ -59,8 +59,9 @@ def process_manifest_name(process_index: int) -> str:
 
 
 def build_manifest(step: int, process_index: Optional[int],
-                   process_count: int, tensors: Dict[str, dict]) -> dict:
-    return {
+                   process_count: int, tensors: Dict[str, dict],
+                   train_state: Optional[dict] = None) -> dict:
+    m = {
         "format_version": FORMAT_VERSION,
         "framework": "paddle_tpu",
         "step": int(step),
@@ -68,6 +69,13 @@ def build_manifest(step: int, process_index: Optional[int],
         "process_count": int(process_count),
         "tensors": tensors,
     }
+    # non-tensor training state (train_state.py) rides the manifest as
+    # an OPTIONAL section: absent = legacy checkpoint, same
+    # format_version — old readers ignore it, old checkpoints restore
+    # tensors-only (docs/CHECKPOINTING.md)
+    if train_state is not None:
+        m["train_state"] = train_state
+    return m
 
 
 def tensor_entry(global_shape, dtype: str, lod, sharding: str,
@@ -145,7 +153,9 @@ def merge_manifests(manifests: List[dict]) -> dict:
             prev["shards"].extend(t["shards"])
             if t["sharding"] == "sharded":
                 prev["sharding"] = "sharded"
-    return build_manifest(step, None, count, tensors)
+    from .train_state import merge_train_state
+    ts = merge_train_state([m.get("train_state") for m in manifests])
+    return build_manifest(step, None, count, tensors, train_state=ts)
 
 
 # ---------------------------------------------------------------------------

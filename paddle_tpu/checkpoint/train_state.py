@@ -1,0 +1,327 @@
+"""Versioned non-parameter training state for exactly-once resume.
+
+A checkpoint that only carries tensors resumes the *parameters* but
+restarts the *run* from scratch: the data pipeline re-reads from batch
+0 (silently repeating data), the dynamic loss scale and guard EMA
+(docs/STABILITY.md) reset to their seeds, and the autotuner's applied
+config (docs/TUNING.md) is forgotten. :class:`TrainState` captures
+everything outside the tensor payload — the global step counter,
+per-reader data cursors (epoch / batch offset / shuffle seed, via the
+``state_dict()/load_state_dict()`` cursor protocol on
+``paddle_tpu.reader`` iterators), the host RNG stream, the dynamic
+loss scale + guard EMA scope vars, and the autotuner token — as a
+``train_state`` section of the checkpoint manifest, written through
+the same atomic commit protocol as the tensors (manifest.py) and
+re-applied by ``CheckpointManager.maybe_restore``. A supervised
+restart (distributed/launch.py) then replays the exact batch sequence
+the dead incarnation would have seen: no sample is repeated, none is
+skipped (docs/RESILIENCE.md).
+
+The section is versioned independently of the tensor manifest
+(``TRAIN_STATE_VERSION``); a manifest without the section is a legacy
+checkpoint and restores tensors-only with a warning, never an error.
+"""
+from __future__ import annotations
+
+import warnings
+import weakref
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = ["TRAIN_STATE_VERSION", "TrainState", "register_reader",
+           "unregister_reader", "registered_readers",
+           "merge_train_state", "read_train_state"]
+
+TRAIN_STATE_VERSION = 1
+
+# scope vars carried by the section (stability/guard.py seeds them;
+# they are scope-only state, invisible to persistable_names, so a
+# tensor-only checkpoint loses them)
+_SCOPE_SCALARS = (
+    ("loss_scale", "@LOSS_SCALE@", np.float32, (1,)),
+    ("loss_scale_good", "@LOSS_SCALE_GOOD@", np.int32, ()),
+    ("guard_ema", "@GUARD_EMA@", np.float32, ()),
+)
+
+
+def _metrics():
+    try:
+        from ..observability import metrics
+        return metrics
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# reader registry: names -> live reader objects implementing the cursor
+# protocol. Weak references: registering a reader must not leak it past
+# its pipeline's lifetime. Cursors restored before the reader exists
+# (maybe_restore runs before the data pipeline is built) park in
+# _pending and are delivered on registration.
+# ---------------------------------------------------------------------------
+
+_readers: "weakref.WeakValueDictionary[str, object]" = \
+    weakref.WeakValueDictionary()
+_pending: Dict[str, dict] = {}
+
+
+def register_reader(name: str, reader) -> None:
+    """Register ``reader`` under ``name`` for TrainState capture. If a
+    cursor for ``name`` was restored before registration, it is applied
+    now (``load_state_dict``)."""
+    _readers[name] = reader
+    cur = _pending.pop(name, None)
+    if cur is not None:
+        load = getattr(reader, "load_state_dict", None)
+        if callable(load):
+            load(cur)
+        else:
+            warnings.warn(
+                f"TrainState: restored cursor for reader {name!r} but "
+                f"the registered object has no load_state_dict()",
+                stacklevel=2)
+
+
+def unregister_reader(name: str) -> None:
+    _readers.pop(name, None)
+
+
+def registered_readers() -> Dict[str, object]:
+    return dict(_readers)
+
+
+def _host_rng_state() -> Optional[list]:
+    """np.random global state, JSON-serializable (the MT19937 key is
+    624 uint32s — small next to any parameter shard)."""
+    try:
+        name, keys, pos, has_gauss, cached = np.random.get_state()
+        return [str(name), [int(k) for k in keys], int(pos),
+                int(has_gauss), float(cached)]
+    except Exception:
+        return None
+
+
+def _scope_scalar(scope, var_name):
+    try:
+        v = scope.find_var(var_name)
+        if v is None or not v.is_initialized():
+            return None
+        return float(np.asarray(v.get_value()).reshape(-1)[0])
+    except Exception:
+        return None
+
+
+class TrainState:
+    """One process's non-tensor training state (plus, after a manifest
+    merge, every process's)."""
+
+    def __init__(self, global_step: int = 0, workers=None,
+                 loss_scale=None, loss_scale_good=None, guard_ema=None,
+                 autotune_token=None, version: int = TRAIN_STATE_VERSION):
+        self.version = int(version)
+        self.global_step = int(global_step)
+        # process_index (str in JSON) -> {"readers": {...}, "host_rng": ...}
+        self.workers: Dict[str, dict] = dict(workers or {})
+        self.loss_scale = loss_scale
+        self.loss_scale_good = loss_scale_good
+        self.guard_ema = guard_ema
+        self.autotune_token = autotune_token
+
+    # -- capture ---------------------------------------------------------
+    @classmethod
+    def capture(cls, global_step: int, scope=None, readers=None,
+                process_index: int = 0,
+                include_host_rng: bool = True) -> "TrainState":
+        """Capture this process's state. ``readers`` overrides the
+        registry (a ``{name: reader}`` dict); ``scope`` supplies the
+        loss-scale / guard-EMA scalars when present."""
+        if readers is None:
+            readers = registered_readers()
+        cursors = {}
+        stale = 0
+        for name, r in sorted(readers.items()):
+            sd = getattr(r, "state_dict", None)
+            if not callable(sd):
+                stale += 1
+                warnings.warn(
+                    f"TrainState: reader {name!r} has no state_dict() —"
+                    f" its cursor cannot be checkpointed", stacklevel=2)
+                continue
+            try:
+                cursors[name] = sd()
+            except Exception as exc:
+                stale += 1
+                warnings.warn(
+                    f"TrainState: reader {name!r} state_dict() failed: "
+                    f"{exc}", stacklevel=2)
+        if stale:
+            m = _metrics()
+            if m is not None:
+                m.counter(
+                    "pt_resume_cursor_stale_total",
+                    "reader cursors that could not be captured into "
+                    "TrainState (docs/RESILIENCE.md)").inc(float(stale))
+        worker = {"readers": cursors}
+        if include_host_rng:
+            worker["host_rng"] = _host_rng_state()
+        kw = {}
+        if scope is not None:
+            for field, var_name, _, _ in _SCOPE_SCALARS:
+                val = _scope_scalar(scope, var_name)
+                if val is not None:
+                    kw[field] = val
+        try:
+            from ..tuning import state as _tstate
+            tok = _tstate.applied_token()
+        except Exception:
+            tok = None
+        return cls(global_step=global_step,
+                   workers={str(int(process_index)): worker},
+                   autotune_token=tok or None, **kw)
+
+    # -- apply -----------------------------------------------------------
+    def apply(self, scope=None, readers=None, process_index: int = 0,
+              restore_host_rng: bool = False) -> dict:
+        """Re-apply this state on a restarted process: deliver reader
+        cursors (immediately for registered/passed readers, parked for
+        late registrations), re-seed the guard scalars into ``scope``,
+        and check the autotuner token. Returns a summary dict."""
+        worker = self.workers.get(str(int(process_index))) or {}
+        cursors = dict(worker.get("readers") or {})
+        if readers is None:
+            readers = registered_readers()
+        applied = []
+        for name, cur in sorted(cursors.items()):
+            r = readers.get(name)
+            load = getattr(r, "load_state_dict", None) \
+                if r is not None else None
+            if callable(load):
+                load(cur)
+                applied.append(name)
+            else:
+                _pending[name] = cur
+        if restore_host_rng and worker.get("host_rng"):
+            name, keys, pos, has_gauss, cached = worker["host_rng"]
+            np.random.set_state((name,
+                                 np.asarray(keys, np.uint32),
+                                 int(pos), int(has_gauss),
+                                 float(cached)))
+        if scope is not None:
+            for field, var_name, np_dtype, shape in _SCOPE_SCALARS:
+                val = getattr(self, field)
+                if val is None:
+                    continue
+                # shapes must match what stability.ensure_state seeds,
+                # or the restored var breaks the trace signature
+                arr = np.full(shape, val, np_dtype) if shape \
+                    else np.asarray(np_dtype(val))
+                scope.var(var_name).set_value(arr)
+        token_match = None
+        if self.autotune_token:
+            try:
+                from ..tuning import state as _tstate
+                cur_tok = _tstate.applied_token()
+                token_match = (cur_tok == self.autotune_token)
+                if cur_tok and not token_match:
+                    warnings.warn(
+                        f"TrainState: checkpoint was written under "
+                        f"autotuner config {self.autotune_token!r} but "
+                        f"this process applied {cur_tok!r}; the resumed"
+                        f" trajectory may not be bit-identical",
+                        stacklevel=2)
+            except Exception:
+                pass
+        m = _metrics()
+        if m is not None:
+            m.counter(
+                "pt_resume_restores_total",
+                "TrainState sections applied on restore "
+                "(docs/RESILIENCE.md)").inc(1.0)
+            m.gauge(
+                "pt_resume_resumed_step",
+                "global step the last TrainState restore resumed "
+                "from").set(float(self.global_step))
+        return {"global_step": self.global_step,
+                "cursors_applied": applied,
+                "cursors_pending": sorted(set(cursors) - set(applied)),
+                "autotune_token_match": token_match}
+
+    # -- (de)serialization ----------------------------------------------
+    def to_dict(self) -> dict:
+        return {
+            "version": self.version,
+            "global_step": self.global_step,
+            "workers": self.workers,
+            "loss_scale": self.loss_scale,
+            "loss_scale_good": self.loss_scale_good,
+            "guard_ema": self.guard_ema,
+            "autotune_token": self.autotune_token,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TrainState":
+        ver = int(d.get("version", 1))
+        if ver > TRAIN_STATE_VERSION:
+            raise ValueError(
+                f"train_state section version {ver} is newer than this "
+                f"build supports ({TRAIN_STATE_VERSION}); upgrade "
+                f"before restoring this checkpoint")
+        return cls(global_step=d.get("global_step", 0),
+                   workers=d.get("workers"),
+                   loss_scale=d.get("loss_scale"),
+                   loss_scale_good=d.get("loss_scale_good"),
+                   guard_ema=d.get("guard_ema"),
+                   autotune_token=d.get("autotune_token"),
+                   version=ver)
+
+    def __repr__(self):
+        return (f"TrainState(step={self.global_step}, "
+                f"workers={sorted(self.workers)}, "
+                f"readers={sorted(set().union(*[set((w.get('readers') or {}))for w in self.workers.values()]) if self.workers else [])})")
+
+
+def merge_train_state(sections) -> Optional[dict]:
+    """Merge per-process ``train_state`` dicts at commit time
+    (manifest.merge_manifests): worker sub-dicts union (each process
+    owns its own cursors/RNG); process-global scalars come from the
+    first section that has them (process 0 commits first in the
+    protocol). ``None`` entries (processes built without TrainState)
+    are tolerated; all-None yields None (no section)."""
+    sections = [s for s in sections if s]
+    if not sections:
+        return None
+    base = dict(sections[0])
+    workers: Dict[str, dict] = {}
+    for s in sections:
+        ver = int(s.get("version", 1))
+        if ver > TRAIN_STATE_VERSION:
+            raise ValueError(
+                f"train_state section version {ver} not supported")
+        if int(s.get("global_step", base.get("global_step", 0))) != \
+                int(base.get("global_step", 0)):
+            raise ValueError(
+                "train_state merge: processes disagree on global_step "
+                f"({s.get('global_step')} vs {base.get('global_step')})")
+        for k in ("loss_scale", "loss_scale_good", "guard_ema",
+                  "autotune_token"):
+            if base.get(k) is None and s.get(k) is not None:
+                base[k] = s[k]
+        for pid, w in (s.get("workers") or {}).items():
+            workers[str(pid)] = w
+    base["workers"] = workers
+    return base
+
+
+def read_train_state(root: str, step: Optional[int] = None):
+    """The :class:`TrainState` committed at ``step`` (default: latest),
+    or None when the checkpoint predates TrainState (legacy)."""
+    from . import writer as wr
+    from .manifest import read_latest
+    if step is None:
+        step = read_latest(root)
+        if step is None:
+            return None
+    man = wr._manifest_for_step(root, int(step))
+    sec = man.get("train_state")
+    return TrainState.from_dict(sec) if sec else None

@@ -465,6 +465,21 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
                     avail.append(n)
             persistable_all.update(guard_plan.state_var_names())
 
+    # integrity sentinel (docs/RESILIENCE.md): per-bucket parameter
+    # fingerprints + continuity checksums compile into the step the
+    # same way; its accumulators ride the updated dict and the host
+    # controller reads them every PT_INTEGRITY_EVERY steps
+    integrity_plan = None
+    if FLAGS.integrity_sentinel:
+        from ..stability import integrity as _integrity
+        integrity_plan = _integrity.build_plan(program, block_idx)
+        if integrity_plan is not None:
+            _integrity.ensure_state(scope, integrity_plan)
+            for n in integrity_plan.input_state_names():
+                if n not in avail:
+                    avail.append(n)
+            persistable_all.update(integrity_plan.state_var_names())
+
     fetch_lod_box: Dict[str, list] = {}
     updated_box: List[str] = []
     uses_rng_box = [False]
@@ -637,6 +652,12 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
             from ..stability.guard import apply_in_trace
             apply_in_trace(env, params, guard_plan, fetch_names,
                            persistable_all)
+        if integrity_plan is not None:
+            # AFTER the guard: the post fingerprint must cover the
+            # gated values that actually reach the scope
+            from ..stability.integrity import \
+                apply_in_trace as _integrity_in_trace
+            _integrity_in_trace(env, params, integrity_plan)
         updated = sorted(n for n in env.written if n in persistable_all)
         updated_box.clear()
         updated_box.extend(updated)
@@ -699,6 +720,7 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
                             list(fetch_names), [], fetch_lod_box,
                             True, nan_check_labels=nan_labels_box)
             ts.guard_plan = guard_plan  # guard ran inside step()
+            ts.integrity_plan = integrity_plan  # ditto (eager step())
             return ts
 
         from .islands import IslandRunner
@@ -743,9 +765,18 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
                         list(fetch_names), [], fetch_lod_box, True,
                         nan_check_labels=nan_labels_box)
         ts.guard_plan = guard_plan
+        if integrity_plan is not None:
+            import warnings as _warnings
+            _warnings.warn(
+                "integrity sentinel is unavailable on the island-"
+                "partitioned path (the fingerprint cannot span host-"
+                "interpreted ops); sentinel disabled for this program",
+                stacklevel=2)
+        ts.integrity_plan = None
         return ts
     updated_names = list(updated_box)
-    if (FLAGS.op_scheduler and mesh is None and iterations == 1
+    if (FLAGS.op_scheduler and integrity_plan is None
+            and mesh is None and iterations == 1
             and not feed_lods):
         # programmable operator scheduler (core/scheduler.py,
         # docs/SCHEDULING.md): data-independent islands dispatched on
@@ -761,6 +792,7 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
         if ts is not None:
             ts.comm_stats = comm_stats
             ts.guard_plan = guard_plan
+            ts.integrity_plan = None  # scheduler path: sentinel off
             return ts
     donated = [n for n in avail if n in updated_names]
     const = [n for n in avail if n not in updated_names]
@@ -863,6 +895,7 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
                     nan_check_labels=nan_labels_box)
     ts.comm_stats = comm_stats
     ts.guard_plan = guard_plan
+    ts.integrity_plan = integrity_plan
     return ts
 
 
@@ -976,6 +1009,14 @@ class Engine:
             "quant_fallbacks": 0, "replay_bundles": 0,
             "guard_aborts": 0,
             "guard_overhead_ms": 0.0,
+            # integrity sentinel (FLAGS_integrity_sentinel,
+            # paddle_tpu/stability/integrity.py,
+            # docs/RESILIENCE.md): verification windows completed,
+            # corrupt windows detected, ghost rollbacks, aborts, and
+            # host-side controller time on window steps
+            "integrity_checks": 0, "integrity_mismatches": 0,
+            "integrity_rollbacks": 0, "integrity_aborts": 0,
+            "integrity_overhead_ms": 0.0,
             # feedback-directed autotuner (FLAGS_autotune,
             # paddle_tpu/tuning, docs/TUNING.md): searches run, trials
             # measured, winners replayed from the on-disk cache
@@ -985,6 +1026,9 @@ class Engine:
         # lazily built per-engine stability controller
         # (FLAGS_stability_guard; paddle_tpu/stability/guard.py)
         self._stability = None
+        # lazily built per-engine integrity sentinel controller
+        # (FLAGS_integrity_sentinel; paddle_tpu/stability/integrity.py)
+        self._integrity = None
         # program fingerprints already autotuned this process
         # (FLAGS_autotune; paddle_tpu/tuning/driver.py)
         self._tuned = set()
@@ -1156,6 +1200,10 @@ class Engine:
                 bool(FLAGS.sharded_weight_update),
                 bool(FLAGS.op_scheduler),
                 bool(FLAGS.stability_guard),
+                # the sentinel's fingerprint + shadow checksums are
+                # compiled into the step (bucket layout follows
+                # allreduce_bucket_mb, already keyed above)
+                bool(FLAGS.integrity_sentinel),
                 os.environ.get("PT_STABILITY_POLICY", ""),
                 # GuardPlan bakes these into the compiled gate too
                 os.environ.get("PT_GUARD_SPIKE_FACTOR", ""),
@@ -1264,8 +1312,10 @@ class Engine:
                 bool(FLAGS.sharded_weight_update),
                 bool(FLAGS.op_scheduler),
                 # the guard's gate (and its policy's damping, spike
-                # threshold, and EMA decay) is baked into the trace
+                # threshold, and EMA decay) is baked into the trace,
+                # as are the sentinel's fingerprints
                 bool(FLAGS.stability_guard),
+                bool(FLAGS.integrity_sentinel),
                 os.environ.get("PT_STABILITY_POLICY", ""),
                 os.environ.get("PT_GUARD_SPIKE_FACTOR", ""),
                 os.environ.get("PT_GUARD_EMA_BETA", ""),
@@ -1363,6 +1413,11 @@ class Engine:
             # injected preemption: kill this process at step N (the
             # supervised-restart path CI exercises without hardware)
             plan.on_step(self.counters["runs"])
+            # injected silent corruption (bitflip fault kind): XOR one
+            # bit of a parameter in scope BEFORE the step reads it, so
+            # the integrity sentinel's detect + rollback path is
+            # exercised end to end in chaos runs
+            plan.corrupt_scope(self.counters["runs"], scope, program)
             # injected numeric anomaly (nan / grad_spike fault kinds):
             # corrupt the feed so the stability guard's detection +
             # recovery path is exercised end to end in chaos runs
@@ -1654,6 +1709,20 @@ class Engine:
                     program, scope, traced, arrays, donated2, const2,
                     return_numpy, updated_vars, obs,
                     _guard_reexec=True)
+        integrity_plan = getattr(traced, "integrity_plan", None)
+        if integrity_plan is not None:
+            ctl = self._integrity
+            if ctl is None:
+                from ..stability import IntegritySentinel
+                ctl = self._integrity = IntegritySentinel()
+            # cheap increment off-window; device->host accumulator
+            # read + verdict every PT_INTEGRITY_EVERY steps. A
+            # rollback restores the scope in place — the NEXT step
+            # picks the rewound params up from the scope; nothing to
+            # re-execute here (the corruption happened outside the
+            # step, not inside it)
+            ctl.after_step(self, program, scope, traced, updated,
+                           obs=obs)
         rec = None
         if traced.nan_check_labels:
             if async_defer:

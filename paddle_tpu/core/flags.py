@@ -215,6 +215,25 @@ _define("stability_guard", False, True,
         "restores the in-memory ghost-snapshot ring captured every "
         "PT_GHOST_EVERY steps and re-executes the step "
         "(docs/STABILITY.md)")
+# cross-replica integrity sentinel (paddle_tpu/stability/integrity.py,
+# docs/RESILIENCE.md)
+_define("integrity_sentinel", False, True,
+        "parameter integrity sentinel (paddle_tpu/stability/"
+        "integrity.py): fold a per-bucket parameter fingerprint "
+        "(float sum + bit-level checksum over the comm-scheduler "
+        "bucket layout) into the traced step every PT_INTEGRITY_EVERY "
+        "steps. The host controller compares the pre-step fingerprint "
+        "against the post-step fingerprint of the previous sentinel "
+        "step: any bit that changed OUTSIDE the traced update (silent "
+        "HBM corruption, a diverged replica's write, an injected "
+        "bitflip fault) raises a classified 'integrity' anomaly "
+        "through the stability-guard policy machinery "
+        "(PT_STABILITY_POLICY: integrity=rollback by default), writes "
+        "exactly one attributed postmortem (worker, bucket, params, "
+        "drift) via the flight recorder, and restores the sentinel's "
+        "ghost ring. Escalates to abort after "
+        "PT_INTEGRITY_ESCALATE_AFTER consecutive mismatches "
+        "(docs/RESILIENCE.md)")
 # feedback-directed autotuner (paddle_tpu/tuning, docs/TUNING.md)
 _define("autotune", False, True,
         "feedback-directed autotuner (paddle_tpu/tuning): at the first "

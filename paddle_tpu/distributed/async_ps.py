@@ -311,6 +311,22 @@ def pull_params(endpoint: str, names: List[str]) -> Dict[str, np.ndarray]:
     return rep
 
 
+def pull_fingerprints(endpoint: str,
+                      names: Optional[List[str]] = None
+                      ) -> Dict[str, tuple]:
+    """Integrity-sentinel compare support (docs/RESILIENCE.md): the
+    server's ``{name: (float_sum, bit_checksum)}`` fingerprints of its
+    authoritative parameter copies — the cheap half of a
+    worker-vs-server integrity compare (full tensors never cross the
+    wire)."""
+    rep = _rpc(endpoint, {"t": "fingerprint",
+                          "names": list(names) if names else None})
+    if isinstance(rep, dict) and rep.get("err"):
+        raise RuntimeError(
+            f"pserver {endpoint} fingerprint: {rep['err']}")
+    return {n: tuple(v) for n, v in rep.items()}
+
+
 def send_complete(endpoint: str, trainer_id: int) -> None:
     """Trainer-exit notification (reference Executor::Close →
     SendComplete, executor.cc:95-103): the server exits its loop once
@@ -514,6 +530,22 @@ class AsyncParameterServer:
         elif t == "metrics_json":
             from ..observability.export import metrics_snapshot
             _send_msg(conn, metrics_snapshot())
+        elif t == "fingerprint":
+            # integrity sentinel, pserver flavor
+            # (stability/integrity.py, docs/RESILIENCE.md): the
+            # fingerprints of this shard's authoritative copies, so a
+            # worker can compare its local view without pulling the
+            # full tensors over the wire
+            from ..stability.integrity import _np_fingerprint
+            names = msg.get("names") or self._known
+            out = {}
+            with self._lock:
+                for n in names:
+                    try:
+                        out[n] = _np_fingerprint(self._get_var(n))
+                    except KeyError:
+                        continue
+            _send_msg(conn, out)
         else:
             _send_msg(conn, {"err": f"unknown message {t!r}"})
 

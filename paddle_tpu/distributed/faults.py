@@ -26,7 +26,18 @@ Engine step loop:
 * ``grad_spike`` — probability a step's feed / gradient bucket is
   scaled by ``spike_mag`` (default 1e4), tripping the guard's
   EMA-based gradient-norm spike detector without any non-finite
-  value.
+  value;
+* ``bitflip_step`` — XOR one bit (``bitflip_bit``, default 21 — a
+  mantissa-high bit, a visible but finite value change) into element 0
+  of one parameter (``bitflip_param``, default the first float param by
+  sorted name) in the scope BEFORE the step at that index runs — the
+  silent-corruption case the integrity sentinel
+  (``FLAGS_integrity_sentinel``, docs/RESILIENCE.md) must detect,
+  attribute and roll back;
+* ``data_dup_step`` — re-feed the previous step's batch at step N (a
+  reader that replayed a batch after a botched resume) — the
+  exactly-once accounting case chaos runs check against the resume
+  cursors.
 
 Determinism: one ``random.Random(seed)`` stream, consumed in hook-call
 order. Two processes running the same plan over the same operation
@@ -59,7 +70,9 @@ _active: Optional["FaultPlan"] = None
 
 _FLOAT_KEYS = ("connect_refuse", "drop", "truncate", "delay",
                "delay_s", "nan", "grad_spike", "spike_mag")
-_INT_KEYS = ("seed", "kill_at_step", "kill_attempts")
+_INT_KEYS = ("seed", "kill_at_step", "kill_attempts", "bitflip_step",
+             "bitflip_bit", "data_dup_step")
+_STR_KEYS = ("bitflip_param",)
 
 
 class FaultPlan:
@@ -71,7 +84,11 @@ class FaultPlan:
                  kill_at_step: Optional[int] = None,
                  kill_attempts: int = 1, restart_attempt: int = 0,
                  nan: float = 0.0, grad_spike: float = 0.0,
-                 spike_mag: float = 1e4):
+                 spike_mag: float = 1e4,
+                 bitflip_step: Optional[int] = None,
+                 bitflip_bit: int = 21,
+                 bitflip_param: Optional[str] = None,
+                 data_dup_step: Optional[int] = None):
         self.seed = int(seed)
         self.connect_refuse = float(connect_refuse)
         self.drop = float(drop)
@@ -85,11 +102,20 @@ class FaultPlan:
         self.nan = float(nan)
         self.grad_spike = float(grad_spike)
         self.spike_mag = float(spike_mag)
+        self.bitflip_step = (None if bitflip_step is None
+                             else int(bitflip_step))
+        self.bitflip_bit = int(bitflip_bit)
+        self.bitflip_param = bitflip_param
+        self.data_dup_step = (None if data_dup_step is None
+                              else int(data_dup_step))
+        self._bitflip_done = False
+        self._last_feed = None  # previous step's feed, for data_dup
         self._rng = random.Random(self.seed)
         self._lock = threading.Lock()
         self.counts: Dict[str, int] = {
             "connect_refuse": 0, "drop": 0, "truncate": 0,
-            "delay": 0, "kill": 0, "nan": 0, "grad_spike": 0}
+            "delay": 0, "kill": 0, "nan": 0, "grad_spike": 0,
+            "bitflip": 0, "data_dup": 0}
 
     # -- construction -------------------------------------------------------
 
@@ -110,10 +136,12 @@ class FaultPlan:
                 kw[k] = int(v)
             elif k in _FLOAT_KEYS:
                 kw[k] = float(v)
+            elif k in _STR_KEYS:
+                kw[k] = v.strip()
             else:
                 raise ValueError(
                     f"unknown fault-plan key {k!r} in {spec!r}; known: "
-                    f"{sorted(_INT_KEYS + _FLOAT_KEYS)}")
+                    f"{sorted(_INT_KEYS + _FLOAT_KEYS + _STR_KEYS)}")
         return cls(**kw)
 
     @classmethod
@@ -192,8 +220,19 @@ class FaultPlan:
         the first float feed array, by sorted name, so the traced
         step's loss/gradients trip the stability guard. Returns the
         (possibly shallow-copied) feed dict; the caller's dict is
-        never mutated."""
-        if not feed or (self.nan <= 0.0 and self.grad_spike <= 0.0):
+        never mutated. Also the ``data_dup`` hook: at
+        ``data_dup_step`` the PREVIOUS step's feed is returned instead
+        (a batch replayed twice), deterministically — no rng draws, so
+        the other kinds' decision streams stay aligned."""
+        if not feed:
+            return feed
+        if self.data_dup_step is not None:
+            prev = self._last_feed
+            if int(step) == self.data_dup_step and prev is not None:
+                self._count("data_dup")
+                return dict(prev)
+            self._last_feed = dict(feed)
+        if self.nan <= 0.0 and self.grad_spike <= 0.0:
             return feed
         kind = self._anomaly_kind()
         if kind is None:
@@ -233,6 +272,46 @@ class FaultPlan:
             flat *= self.spike_mag
         self._count(kind)
         return flat
+
+    def corrupt_scope(self, step: int, scope, program) -> None:
+        """Silent-corruption injection (integrity sentinel,
+        docs/RESILIENCE.md): XOR ``bitflip_bit`` into element 0 of
+        ``bitflip_param`` (default: first float parameter by sorted
+        name), ONCE, at the first step >= ``bitflip_step``, before the
+        engine reads the scope. Deterministic — consumes no rng draws,
+        so the other kinds' decision streams stay aligned."""
+        if (self.bitflip_step is None or self._bitflip_done
+                or int(step) < self.bitflip_step):
+            return
+        import numpy as np
+        if self.bitflip_param:
+            candidates = [self.bitflip_param]
+        else:
+            prog = getattr(program, "_program", program)
+            try:
+                candidates = sorted(
+                    p.name for p in prog.all_parameters())
+            except Exception:
+                return
+        for name in candidates:
+            v = scope.find_var(name)
+            if v is None or not v.is_initialized():
+                continue
+            val = v.get_value()
+            arr = np.array(getattr(val, "array", val), copy=True)
+            if arr.dtype.kind != "f" or arr.size == 0:
+                continue
+            view_t = {2: np.uint16, 4: np.uint32,
+                      8: np.uint64}.get(arr.dtype.itemsize)
+            if view_t is None:
+                continue
+            bit = self.bitflip_bit % (arr.dtype.itemsize * 8)
+            bits = arr.reshape(-1).view(view_t)
+            bits[0] ^= view_t(1 << bit)
+            v.set_value(arr)
+            self._bitflip_done = True
+            self._count("bitflip")
+            return
 
     # -- step hook (engine / worker loops) ----------------------------------
 

@@ -199,9 +199,23 @@ def launch(script_args, nproc=1, ips=None, started_port=None,
     return codes
 
 
+def _restart_backoff_s(attempt, base_s, cap_s):
+    """Exponential backoff with full jitter in [0.5x, 1x]: a crashing
+    gang must not hammer a shared checkpoint store / cluster scheduler
+    at full speed, and jitter keeps multiple supervisors (one per host
+    with --ips) from relaunching in lockstep. base_s <= 0 disables
+    (tests)."""
+    if base_s <= 0:
+        return 0.0
+    import random
+    d = min(cap_s, base_s * (2.0 ** max(0, attempt - 1)))
+    return d * (0.5 + random.random() / 2.0)
+
+
 def supervise(script_args, max_restarts=0, nproc=1, ips=None,
               started_port=None, backend=None, log_dir=None,
-              extra_env=None, grace_s=DEFAULT_GRACE_S):
+              extra_env=None, grace_s=DEFAULT_GRACE_S,
+              backoff_base_s=0.5, backoff_cap_s=15.0):
     """Elastic supervisor: relaunch a failed gang up to
     ``max_restarts`` times. Returns ``(exit_code, restarts_used)`` —
     exit_code is 0 when some incarnation finished clean, else the
@@ -211,23 +225,41 @@ def supervise(script_args, max_restarts=0, nproc=1, ips=None,
     training script pairs this with ``CheckpointManager.maybe_restore``
     to continue from the latest durable snapshot (PR 3's commit
     protocol guarantees the snapshot is complete or absent —
-    docs/CHECKPOINTING.md)."""
+    docs/CHECKPOINTING.md).
+
+    Hardening (docs/RESILIENCE.md): restarts are separated by
+    exponential backoff with jitter (``backoff_base_s`` doubling up to
+    ``backoff_cap_s``; 0 disables), and when ``started_port`` pins the
+    port range, each incarnation shifts to a fresh range
+    (``started_port + attempt * nproc``) so a dying worker's socket
+    lingering in TIME_WAIT cannot make every restart fail on bind."""
     attempt = 0
     while True:
         env = dict(extra_env or {})
         env["PADDLE_RESTART_ATTEMPT"] = str(attempt)
+        port = started_port
+        if port is not None and attempt:
+            # fresh range per incarnation; ips-mode endpoints must be
+            # identical on every host, so the shift is deterministic
+            port = started_port + attempt * max(
+                1, nproc if not ips else 1)
         codes, first_fail = _run_once(
             script_args, nproc=nproc, ips=ips,
-            started_port=started_port, backend=backend,
+            started_port=port, backend=backend,
             log_dir=log_dir, extra_env=env, grace_s=grace_s)
         if first_fail == 0:
             return 0, attempt
         if attempt >= max_restarts:
             return first_fail, attempt
         attempt += 1
+        delay = _restart_backoff_s(attempt, backoff_base_s,
+                                   backoff_cap_s)
         print(f"paddle_tpu.distributed.launch: gang failed "
-              f"(exit {first_fail}); restart {attempt}/{max_restarts}",
+              f"(exit {first_fail}); restart {attempt}/{max_restarts}"
+              f" in {delay:.2f}s",
               file=sys.stderr, flush=True)
+        if delay:
+            time.sleep(delay)
 
 
 def main(argv=None):
@@ -256,6 +288,14 @@ def main(argv=None):
                     dest="grace_s",
                     help="seconds between SIGTERM and SIGKILL when "
                          "tearing down a failed gang")
+    ap.add_argument("--restart-backoff", type=float, default=0.5,
+                    dest="backoff_base_s",
+                    help="base seconds of the exponential backoff "
+                         "between gang restarts (doubles per attempt, "
+                         "jittered; 0 disables)")
+    ap.add_argument("--restart-backoff-cap", type=float, default=15.0,
+                    dest="backoff_cap_s",
+                    help="ceiling seconds for the restart backoff")
     ap.add_argument("script", help="training script")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
@@ -263,7 +303,8 @@ def main(argv=None):
         [args.script] + args.script_args, max_restarts=args.max_restarts,
         nproc=args.nproc, ips=args.ips, started_port=args.started_port,
         backend=args.backend, log_dir=args.log_dir,
-        grace_s=args.grace_s)
+        grace_s=args.grace_s, backoff_base_s=args.backoff_base_s,
+        backoff_cap_s=args.backoff_cap_s)
     sys.exit(code)
 
 

@@ -433,6 +433,31 @@ def _install_standard_families(reg: MetricsRegistry) -> None:
     reg.histogram("pt_guard_overhead_seconds",
                   "host-side stability-guard controller time per step "
                   "(verdict read + policy + ghost capture)")
+    # integrity sentinel (FLAGS_integrity_sentinel; docs/RESILIENCE.md)
+    reg.counter("pt_integrity_checks_total",
+                "sentinel verification windows completed "
+                "(docs/RESILIENCE.md)")
+    reg.counter("pt_integrity_mismatch_total",
+                "parameter-integrity mismatches by worker and bucket "
+                "(docs/RESILIENCE.md)")
+    reg.counter("pt_integrity_rollbacks_total",
+                "integrity incidents recovered by ghost-ring rollback "
+                "(docs/RESILIENCE.md)")
+    reg.gauge("pt_integrity_drift",
+              "max |fingerprint sum drift| of the last integrity "
+              "incident")
+    # exactly-once elastic resume (checkpoint/train_state.py;
+    # docs/RESILIENCE.md)
+    reg.counter("pt_resume_restores_total",
+                "TrainState restores applied by CheckpointManager")
+    reg.counter("pt_resume_replayed_batches_total",
+                "batches skipped-to on reader-cursor resume (the "
+                "replay fast-forward, not duplicate training)")
+    reg.counter("pt_resume_cursor_stale_total",
+                "registered readers whose cursor could not be "
+                "captured or applied on save/restore")
+    reg.gauge("pt_resume_resumed_step",
+              "global step the last TrainState restore resumed at")
     # custom-kernel registry (FLAGS_use_custom_kernels; docs/KERNELS.md)
     reg.counter("pt_kernel_dispatch_total",
                 "trace-time kernel-registry decisions, labeled "

@@ -26,17 +26,98 @@ __all__ = ["DataFeeder", "batch", "PyReader", "cache",
            "DataFeedDesc"]
 
 
-def batch(reader, batch_size, drop_last=False):
-    def batch_reader():
+class _BatchReader:
+    """``batch()``'s return value: still a callable reader (``r()`` ->
+    iterable of sample lists), now also a resumable cursor
+    (docs/RESILIENCE.md). ``state_dict()`` is ``{epoch, offset,
+    reader?}`` — offset counts batches already yielded this epoch;
+    ``load_state_dict()`` arms the NEXT call to replay the epoch from
+    the top (the inner reader re-yields deterministically, e.g. a
+    seeded ``shuffle``) and skip the first ``offset`` batches, so a
+    restarted run consumes exactly the batches the dead one did not."""
+
+    def __init__(self, reader, batch_size, drop_last):
+        self._reader = reader
+        self._batch_size = batch_size
+        self._drop_last = drop_last
+        self._epoch = 0
+        self._offset = 0
+        self._resume = None
+
+    def state_dict(self):
+        d = {"epoch": self._epoch, "offset": self._offset}
+        inner = getattr(self._reader, "state_dict", None)
+        if callable(inner):
+            d["reader"] = inner()
+        return d
+
+    def load_state_dict(self, state):
+        self._resume = dict(state)
+
+    def __call__(self):
+        resume, self._resume = self._resume, None
+        skip = 0
+        if resume is not None:
+            self._epoch = int(resume.get("epoch", 0))
+            skip = max(0, int(resume.get("offset", 0)))
+            inner_state = resume.get("reader")
+            inner_load = getattr(self._reader, "load_state_dict", None)
+            if inner_state is not None and callable(inner_load):
+                inner_load(inner_state)
+            if skip:
+                try:
+                    from ..observability import metrics as _m
+                    _m.counter(
+                        "pt_resume_replayed_batches_total",
+                        "batches re-read and skipped while replaying "
+                        "a reader cursor after restore "
+                        "(docs/RESILIENCE.md)").inc(float(skip))
+                except Exception:
+                    pass
+        self._offset = 0
         b = []
-        for item in reader():
+        for item in self._reader():
             b.append(item)
-            if len(b) == batch_size:
-                yield b
+            if len(b) == self._batch_size:
+                self._offset += 1
+                if skip:
+                    skip -= 1
+                else:
+                    yield b
                 b = []
-        if b and not drop_last:
-            yield b
-    return batch_reader
+        if b and not self._drop_last:
+            self._offset += 1
+            if not skip:
+                yield b
+        self._epoch += 1
+        self._offset = 0
+
+
+def batch(reader, batch_size, drop_last=False):
+    return _BatchReader(reader, batch_size, drop_last)
+
+
+class _CursorForwardingReader:
+    """A callable reader wrapper that keeps the wrapped reader's cursor
+    protocol reachable: iteration runs ``fn()``, state_dict /
+    load_state_dict delegate to ``inner`` (no-ops when the inner reader
+    is not resumable)."""
+
+    def __init__(self, fn, inner):
+        self._fn = fn
+        self._inner = inner
+
+    def __call__(self):
+        return self._fn()
+
+    def state_dict(self):
+        sd = getattr(self._inner, "state_dict", None)
+        return sd() if callable(sd) else {}
+
+    def load_state_dict(self, state):
+        load = getattr(self._inner, "load_state_dict", None)
+        if callable(load):
+            load(state)
 
 
 class DataFeeder:
@@ -51,11 +132,14 @@ class DataFeeder:
     def decorate_reader(self, reader, multi_devices=False,
                         num_places=None, drop_last=True):
         """Reference DataFeeder.decorate_reader: wrap a sample-batch
-        reader into a feed-dict reader."""
+        reader into a feed-dict reader. The wrapper forwards the
+        cursor protocol (state_dict/load_state_dict) to the wrapped
+        reader, so a decorated pipeline stays checkpointable
+        (docs/RESILIENCE.md)."""
         def wrapped():
             for samples in reader():
                 yield self.feed(samples)
-        return wrapped
+        return _CursorForwardingReader(wrapped, reader)
 
     def feed_parallel(self, iterable, num_places=None):
         """Reference DataFeeder.feed_parallel: one feed dict per place.
@@ -234,22 +318,57 @@ def map_readers(func, *readers):
     return reader
 
 
-def shuffle(reader, buf_size):
-    import random as _random
+class _ShuffleReader:
+    """``shuffle()``'s return value: callable reader with a resumable
+    cursor. The permutation is drawn from ``Random(f"{seed}:{epoch}")``
+    — deterministic per (seed, epoch) — so a restarted run that reloads
+    ``{seed, epoch}`` replays the exact shuffle order the dead run saw
+    (exactly-once resume, docs/RESILIENCE.md). When the caller passes
+    no seed, one is drawn once from the module-global ``random`` stream
+    at construction (legacy call sites keep their randomness but become
+    resumable, because the draw is recorded in the cursor)."""
 
-    def shuffled_reader():
+    def __init__(self, reader, buf_size, seed=None):
+        import random as _random
+        self._reader = reader
+        self._buf_size = buf_size
+        self._seed = int(_random.randrange(2 ** 31)) if seed is None \
+            else int(seed)
+        self._epoch = 0
+
+    def state_dict(self):
+        d = {"seed": self._seed, "epoch": self._epoch}
+        inner = getattr(self._reader, "state_dict", None)
+        if callable(inner):
+            d["reader"] = inner()
+        return d
+
+    def load_state_dict(self, state):
+        self._seed = int(state.get("seed", self._seed))
+        self._epoch = int(state.get("epoch", 0))
+        inner_state = state.get("reader")
+        inner_load = getattr(self._reader, "load_state_dict", None)
+        if inner_state is not None and callable(inner_load):
+            inner_load(inner_state)
+
+    def __call__(self):
+        import random as _random
+        rng = _random.Random(f"{self._seed}:{self._epoch}")
         buf = []
-        for item in reader():
+        for item in self._reader():
             buf.append(item)
-            if len(buf) >= buf_size:
-                _random.shuffle(buf)
+            if len(buf) >= self._buf_size:
+                rng.shuffle(buf)
                 yield from buf
                 buf = []
         if buf:
-            _random.shuffle(buf)
+            rng.shuffle(buf)
             yield from buf
+        self._epoch += 1
 
-    return shuffled_reader
+
+def shuffle(reader, buf_size, seed=None):
+    return _ShuffleReader(reader, buf_size, seed=seed)
 
 
 def chain(*readers):

@@ -63,6 +63,12 @@ class DeviceFeedPrefetcher:
         self._place = place
         self._depth = depth
         self._live_q = None  # set while iterating; census peeks it
+        # cursor bookkeeping (docs/RESILIENCE.md): batches the fill
+        # thread pulled from the source vs batches the consumer was
+        # actually handed — the difference is the in-flight window
+        self._lock = threading.Lock()
+        self._produced = 0
+        self._consumed = 0
         try:
             from ..observability import memory as _obs_memory
             _obs_memory.track_prefetcher(self)  # owner "prefetch"
@@ -89,17 +95,45 @@ class DeviceFeedPrefetcher:
                 out[name] = jax.device_put(np.asarray(val), dev)
         return out
 
+    def state_dict(self) -> Dict[str, Any]:
+        """Drain-or-replay cursor capture: the wrapped reader's cursor,
+        REWOUND by the number of staged-but-unconsumed batches, so the
+        in-flight slots (converted/transferred but never fed to a step)
+        are replayed after a restore instead of silently dropped. With
+        depth D at most D batches replay; a window that straddles an
+        epoch boundary clamps to the epoch start."""
+        sd = getattr(self._reader, "state_dict", None)
+        base = sd() if callable(sd) else {}
+        with self._lock:
+            inflight = max(0, self._produced - self._consumed)
+        if inflight and "offset" in base:
+            base = dict(base)
+            base["offset"] = max(0, int(base["offset"]) - inflight)
+        return base
+
+    def load_state_dict(self, state) -> None:
+        load = getattr(self._reader, "load_state_dict", None)
+        if callable(load):
+            load(state)
+
     def __iter__(self):
         src: Iterable = self._reader() if callable(self._reader) \
             else self._reader
         dev = self._device()
         q: queue.Queue = queue.Queue(maxsize=self._depth)
         self._live_q = q  # staged device batches, visible to the census
+        with self._lock:
+            self._produced = 0
+            self._consumed = 0
         stop = object()
 
         def _fill():
             try:
                 for feed in src:
+                    # count at pull time: the source's cursor advanced
+                    # the moment the fill thread took this batch
+                    with self._lock:
+                        self._produced += 1
                     q.put(self._to_device(feed, dev))
                 q.put(stop)
             except BaseException as e:   # propagate, never truncate
@@ -113,4 +147,6 @@ class DeviceFeedPrefetcher:
                 raise item.exc
             if item is stop:
                 return
+            with self._lock:
+                self._consumed += 1
             yield item

@@ -9,15 +9,31 @@ tools/replay_step.py). The guard exists because NaN/Inf detection via
 guard's verdict is ONE on-device scalar, and anomalous parameter /
 optimizer-state updates are gated on device before they ever reach the
 scope.
+
+Behind ``FLAGS_integrity_sentinel`` (docs/RESILIENCE.md): a per-bucket
+parameter fingerprint folded into the traced step that detects silent
+corruption (bit flips, diverged replicas) and routes it through the
+same policy machinery as an ``integrity`` anomaly class.
 """
 from .guard import (  # noqa: F401
     GUARD_EMA_VAR, GUARD_NORM_VAR, GUARD_VERDICT_VAR, LOSS_SCALE_VAR,
     LOSS_SCALE_GOOD_VAR, NONFINITE, SPIKE, GuardPlan, StabilityGuard,
     build_plan, ensure_state, policy_map)
 from .ghost import GhostRing  # noqa: F401
+from .integrity import (  # noqa: F401
+    INTEGRITY_BAD_VAR, INTEGRITY_CK_VAR, INTEGRITY_STEP_VAR,
+    INTEGRITY_SUM_VAR, IntegrityPlan, IntegritySentinel,
+    compare_param_sets, fingerprint_arrays, worker_server_compare,
+)
+from .integrity import build_plan as build_integrity_plan  # noqa: F401
+from .integrity import ensure_state as ensure_integrity_state  # noqa: F401
 
 __all__ = [
     "GUARD_EMA_VAR", "GUARD_NORM_VAR", "GUARD_VERDICT_VAR",
     "LOSS_SCALE_VAR", "LOSS_SCALE_GOOD_VAR", "NONFINITE", "SPIKE",
     "GuardPlan", "StabilityGuard", "GhostRing", "build_plan",
-    "ensure_state", "policy_map"]
+    "ensure_state", "policy_map",
+    "INTEGRITY_STEP_VAR", "INTEGRITY_SUM_VAR", "INTEGRITY_CK_VAR",
+    "INTEGRITY_BAD_VAR", "IntegrityPlan", "IntegritySentinel",
+    "build_integrity_plan", "ensure_integrity_state",
+    "compare_param_sets", "fingerprint_arrays", "worker_server_compare"]

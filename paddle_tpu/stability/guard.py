@@ -50,7 +50,10 @@ LOSS_SCALE_GOOD_VAR = "@LOSS_SCALE_GOOD@"  # i32 consecutive good steps
 NONFINITE = 1
 SPIKE = 2
 
-CLASSES = ("nonfinite", "spike")
+# "integrity" verdicts come from the integrity sentinel
+# (stability/integrity.py), not the in-trace guard math, but share the
+# policy vocabulary so PT_STABILITY_POLICY configures all three
+CLASSES = ("nonfinite", "spike", "integrity")
 POLICIES = ("skip", "clip", "rescale", "rollback", "abort")
 
 _MIN_SCALE = 2.0 ** -14
@@ -58,10 +61,14 @@ _MAX_SCALE = 2.0 ** 31
 
 # state vars the gate must never revert: the guard's own outputs and
 # the loss scale (which must shrink ON the anomalous step), plus RNG
+# and the integrity sentinel's shadow fingerprints (gating those would
+# make the sentinel compare a reverted shadow against live params)
 _NO_GATE = frozenset({
     GUARD_EMA_VAR, GUARD_NORM_VAR, GUARD_VERDICT_VAR,
     GUARD_PRESCALE_VAR, LOSS_SCALE_VAR, LOSS_SCALE_GOOD_VAR,
-    "@RNG_STATE@"})
+    "@RNG_STATE@",
+    "@INTEGRITY_STEP@", "@INTEGRITY_SUM@", "@INTEGRITY_CK@",
+    "@INTEGRITY_BAD@", "@INTEGRITY_DRIFT@", "@INTEGRITY_AGREE@"})
 
 
 def _env_float(name: str, default: float) -> float:
@@ -81,10 +88,14 @@ def _env_int(name: str, default: int) -> int:
 def policy_map(spec: Optional[str] = None) -> Dict[str, str]:
     """Parse ``PT_STABILITY_POLICY``: one token for all classes
     (``rollback``) or per-class pairs (``nonfinite=rollback,
-    spike=clip``). Default: nonfinite=skip, spike=clip."""
+    spike=clip``). Default: nonfinite=skip, spike=clip,
+    integrity=rollback (corrupt params can't be "skipped" — the
+    corruption persists in the scope — so the default rewinds to a
+    clean ghost)."""
     if spec is None:
         spec = os.environ.get("PT_STABILITY_POLICY", "")
-    out = {"nonfinite": "skip", "spike": "clip"}
+    out = {"nonfinite": "skip", "spike": "clip",
+           "integrity": "rollback"}
     spec = (spec or "").strip()
     if not spec:
         return out

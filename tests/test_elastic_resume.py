@@ -193,6 +193,223 @@ def test_crash_between_shard_write_and_latest_falls_back(
         np.asarray(scope2.find_var("rw1").get_value()), w1)
 
 
+# ---------------------------------------------------------------------------
+# exactly-once elastic resume: TrainState + reader cursors
+# (checkpoint/train_state.py, docs/RESILIENCE.md)
+# ---------------------------------------------------------------------------
+
+def _sample_source():
+    def r():
+        rng = np.random.RandomState(77)
+        for _ in range(64):
+            x = rng.rand(6).astype(np.float32)
+            yield x, np.float32(x.sum())
+    return r
+
+
+def _pipeline():
+    """batch(shuffle(src)) — both layers carry a resumable cursor."""
+    from paddle_tpu import reader as rd
+    return rd.batch(rd.shuffle(_sample_source(), 16, seed=5),
+                    batch_size=8)
+
+
+def _feed_of(samples):
+    return {"x": np.stack([s[0] for s in samples]),
+            "y": np.asarray([[s[1]] for s in samples], np.float32)}
+
+
+def _train_steps(exe, main, loss, rdr, total, start=0, kill_at=None,
+                 manager=None, scope=None):
+    """Drive ``total - start`` steps off the reader pipeline; the
+    reader's own cursor decides WHICH batches those are (after a
+    ``load_state_dict`` the first ``rdr()`` call fast-forwards).
+    Returns (losses, last completed step)."""
+    losses = []
+    step = start
+    while step < total:
+        for samples in rdr():
+            if step >= total:
+                break
+            out = exe.run(main, feed=_feed_of(samples),
+                          fetch_list=[loss.name])
+            losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+            step += 1
+            if manager is not None:
+                manager.save(step, scope=scope, program=main,
+                             sync=True, train_state=True)
+            if kill_at is not None and step == kill_at:
+                return losses, step
+    return losses, step
+
+
+@pytest.mark.parametrize("kill_at,variant", [
+    (2, "plain"), (5, "scheduler"), (7, "async_dispatch"),
+], ids=["kill2_plain", "kill5_scheduler", "kill7_async"])
+def test_kill_at_step_resume_is_bit_identical(tmp_path, kill_at,
+                                              variant):
+    """Exactly-once resume: kill the run at an arbitrary step, restart
+    from the TrainState checkpoint (global step + reader cursors), and
+    the stitched trajectory must be BIT-identical to an uninterrupted
+    run — no batch repeated, none skipped — on the plain, op-scheduler
+    and async-dispatch engine paths alike."""
+    from paddle_tpu.checkpoint import (CheckpointManager,
+                                       register_reader,
+                                       unregister_reader)
+    flags = {"scheduler": {"FLAGS_op_scheduler": True},
+             "async_dispatch": {"FLAGS_async_dispatch": True}}.get(
+                 variant, {})
+    total = 12
+    ckpt = str(tmp_path / "ckpt")
+    fluid.set_flags(flags)
+    try:
+        # uninterrupted reference run (snapshot the init for the rest)
+        main, startup, loss = _build()
+        scope = Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            init = {p.name: np.asarray(
+                scope.find_var(p.name).get_value()).copy()
+                for p in main.all_parameters()}
+            ref, _ = _train_steps(exe, main, loss, _pipeline(), total)
+            ref_params = {n: np.asarray(
+                scope.find_var(n).get_value()).copy() for n in init}
+
+        # killed run: same init, TrainState-checkpoint every step,
+        # stop cold at kill_at (scope + engine + reader all dropped)
+        main2, startup2, loss2 = _build()
+        scope_a = Scope()
+        rdr_a = _pipeline()
+        register_reader("train", rdr_a)
+        try:
+            with fluid.scope_guard(scope_a):
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup2)
+                for name, arr in init.items():
+                    scope_a.var(name).set_value(arr.copy())
+                with CheckpointManager(ckpt) as m:
+                    first, stopped = _train_steps(
+                        exe, main2, loss2, rdr_a, total,
+                        kill_at=kill_at, manager=m, scope=scope_a)
+            assert stopped == kill_at
+        finally:
+            unregister_reader("train")
+        del scope_a, rdr_a  # the preemption
+
+        # relaunched incarnation: fresh everything; maybe_restore
+        # delivers params + global step + the reader cursor
+        main3, startup3, loss3 = _build()
+        scope_b = Scope()
+        rdr_b = _pipeline()
+        register_reader("train", rdr_b)
+        try:
+            with fluid.scope_guard(scope_b):
+                exe = fluid.Executor(fluid.CPUPlace())
+                exe.run(startup3)
+                with CheckpointManager(ckpt) as m2:
+                    restored = m2.maybe_restore(scope=scope_b,
+                                                program=main3)
+                    assert restored == kill_at
+                    ts = m2.restored_train_state
+                    assert ts is not None
+                    assert ts.global_step == kill_at
+                resumed, _ = _train_steps(exe, main3, loss3, rdr_b,
+                                          total, start=kill_at)
+                end_params = {n: np.asarray(
+                    scope_b.find_var(n).get_value()).copy()
+                    for n in init}
+        finally:
+            unregister_reader("train")
+
+        # bit-identical stitch: losses AND final parameters
+        assert first == ref[:kill_at]
+        assert resumed == ref[kill_at:]
+        for n in init:
+            np.testing.assert_array_equal(end_params[n], ref_params[n])
+    finally:
+        fluid.set_flags({k: False for k in flags})
+
+
+def test_prefetcher_cursor_rewinds_inflight_batches():
+    """Drain-or-replay: DeviceFeedPrefetcher.state_dict() rewinds the
+    wrapped reader's cursor by the staged-but-unconsumed batches, so a
+    restore replays exactly the batches no step ever saw — the
+    prefetch queue can never silently swallow data across a restart."""
+    from paddle_tpu import reader as rd
+    from paddle_tpu.reader.decorators import _CursorForwardingReader
+
+    def src():
+        def r():
+            for i in range(32):
+                yield (np.full((2,), i, np.float32),)
+        return r
+
+    def feed_pipeline():
+        b = rd.batch(src(), batch_size=2)
+        return _CursorForwardingReader(
+            lambda: ({"x": np.stack([s[0] for s in samples])}
+                     for samples in b()), b)
+
+    clean = [d["x"].copy() for d in feed_pipeline()()]
+
+    pf = rd.DeviceFeedPrefetcher(feed_pipeline(), depth=3)
+    it = iter(pf)
+    consumed = [np.asarray(next(it)["x"]) for _ in range(5)]
+    for got, want in zip(consumed, clean):
+        np.testing.assert_array_equal(got, want)
+    import time
+    time.sleep(0.3)  # let the fill thread block on the full queue
+    cur = pf.state_dict()
+    # the cursor points at the NEXT unconsumed batch, not at the fill
+    # thread's read-ahead position
+    assert cur["offset"] == 5
+
+    fresh = feed_pipeline()
+    fresh.load_state_dict(cur)
+    pf2 = rd.DeviceFeedPrefetcher(fresh, depth=3)
+    rest = [np.asarray(d["x"]) for d in pf2]
+    assert len(rest) == len(clean) - 5
+    for got, want in zip(rest, clean[5:]):
+        np.testing.assert_array_equal(got, want)
+
+
+def test_train_state_survives_in_manifest_and_lints_clean(tmp_path):
+    """The train_state section rides the atomic manifest commit and is
+    what ckpt_inspect --train-state audits."""
+    import subprocess
+    import sys as _sys
+    from paddle_tpu.checkpoint import (CheckpointManager,
+                                       read_train_state,
+                                       register_reader,
+                                       unregister_reader)
+    ckpt = str(tmp_path / "ckpt")
+    main, startup, loss = _build()
+    rdr = _pipeline()
+    next(iter(rdr()))  # advance the cursor past batch 0
+    register_reader("train", rdr)
+    try:
+        scope = Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            exe.run(main, feed=_batch(0), fetch_list=[loss.name])
+            with CheckpointManager(ckpt) as m:
+                m.save(1, scope=scope, program=main, sync=True,
+                       train_state=True)
+    finally:
+        unregister_reader("train")
+    ts = read_train_state(ckpt)
+    assert ts is not None and ts.global_step == 1
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [_sys.executable, os.path.join(repo, "tools", "ckpt_inspect.py"),
+         ckpt, "--train-state", "--verify"],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "train_state: v1 global_step=1" in proc.stdout
+
+
 def test_partial_checkpoint_fails_loudly(tmp_path):
     ckpt = str(tmp_path / "ckpt3")
     main, startup, loss = _build()

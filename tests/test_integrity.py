@@ -1,0 +1,293 @@
+"""Cross-replica / cross-step integrity sentinel
+(FLAGS_integrity_sentinel, docs/RESILIENCE.md).
+
+Pins the robustness contract of stability/integrity.py:
+
+* fingerprints are deterministic, order-independent at the bit level,
+  and sensitive to a single flipped bit;
+* the sentinel arms only for programs that update parameters in-trace
+  (a startup program's host-side init writes are legitimate);
+* sentinel ON is bit-identical to sentinel OFF on a clean run (losses
+  AND final parameters);
+* an injected HBM-style bitflip (distributed/faults) is detected
+  within one sentinel window, classified as an ``integrity`` anomaly,
+  recovered by ghost-ring rollback, and attributed in EXACTLY ONE
+  flight-recorder postmortem (worker / step / bucket / member params /
+  drift);
+* a duplicated batch (``data_dup``) is honestly NOT flagged — feeding
+  the same batch twice is a legitimate update twice, the data-cursor's
+  problem (checkpoint/train_state.py), not the sentinel's.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.scope import Scope
+from paddle_tpu.distributed import faults
+from paddle_tpu.stability import integrity
+
+
+def _build():
+    # every parameter named EXPLICITLY (biases too): auto bias names
+    # are globally unique-ified per build, which silently breaks the
+    # fixed-init determinism these tests rely on
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [6], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        h = layers.fc(x, 8, act="relu",
+                      param_attr=fluid.ParamAttr(name="iw0"),
+                      bias_attr=fluid.ParamAttr(name="ib0"))
+        pred = layers.fc(h, 1,
+                         param_attr=fluid.ParamAttr(name="iw1"),
+                         bias_attr=fluid.ParamAttr(name="ib1"))
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.AdamOptimizer(learning_rate=0.05).minimize(loss)
+    return main, startup, loss
+
+
+_INIT = {
+    "iw0": np.random.RandomState(1).randn(6, 8).astype(np.float32) * .3,
+    "ib0": np.zeros(8, np.float32),
+    "iw1": np.random.RandomState(2).randn(8, 1).astype(np.float32) * .3,
+    "ib1": np.zeros(1, np.float32),
+}
+
+
+def _batch(step):
+    rng = np.random.RandomState(1000 + step)
+    return {"x": rng.rand(8, 6).astype(np.float32),
+            "y": rng.rand(8, 1).astype(np.float32)}
+
+
+def _run(n, sentinel, fault=None):
+    """(losses, params, engine counters, fault counts) of an n-step
+    run from the fixed init."""
+    fluid.set_flags({"FLAGS_integrity_sentinel": sentinel})
+    scope = Scope()
+    plan = faults.FaultPlan.from_spec(fault) if fault else None
+    try:
+        with fluid.scope_guard(scope), faults.scoped(plan):
+            main, startup, loss = _build()
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            for name, arr in _INIT.items():
+                scope.var(name).set_value(arr.copy())
+            losses = [float(np.asarray(exe.run(
+                main, feed=_batch(i), fetch_list=[loss.name])[0]))
+                for i in range(n)]
+            params = {name: np.asarray(
+                scope.find_var(name).get_value()).copy()
+                for name in _INIT}
+            counters = dict(exe._engine.counters)
+    finally:
+        fluid.set_flags({"FLAGS_integrity_sentinel": False})
+    return losses, params, counters, (dict(plan.counts) if plan else {})
+
+
+# ---------------------------------------------------------------------------
+# fingerprint math
+# ---------------------------------------------------------------------------
+
+def test_np_fingerprint_exact_order_independent_bit_sensitive():
+    rng = np.random.RandomState(3)
+    a = rng.randn(64).astype(np.float32)
+    s1, ck1 = integrity._np_fingerprint(a)
+    s2, ck2 = integrity._np_fingerprint(a.copy())
+    assert (s1, ck1) == (s2, ck2)
+    # the checksum is an order-independent wrap-sum of bit patterns
+    _, ck_rev = integrity._np_fingerprint(a[::-1].copy())
+    assert ck_rev == ck1
+    # ... and flips when a single bit flips
+    b = a.copy()
+    b.view(np.uint32)[0] ^= np.uint32(1 << 21)
+    _, ck_flip = integrity._np_fingerprint(b)
+    assert ck_flip != ck1
+    # int32 range (wraps instead of overflowing)
+    assert -(1 << 31) <= ck1 < (1 << 31)
+
+
+def test_compare_param_sets_detects_and_tolerates():
+    rng = np.random.RandomState(4)
+    local = {"w": rng.randn(8, 4).astype(np.float32),
+             "b": rng.randn(4).astype(np.float32)}
+    remote = {k: v.copy() for k, v in local.items()}
+    assert integrity.compare_param_sets(local, remote) == []
+    remote["w"] = remote["w"].copy()
+    remote["w"][0, 0] += np.float32(0.25)
+    bad = integrity.compare_param_sets(local, remote)
+    assert [r["param"] for r in bad] == ["w"]
+    assert bad[0]["drift"] == pytest.approx(0.25, rel=1e-3)
+    # atol: small reported drift below the bound is tolerated
+    assert integrity.compare_param_sets(local, remote, atol=1.0) == []
+
+
+# ---------------------------------------------------------------------------
+# arming rules
+# ---------------------------------------------------------------------------
+
+def test_build_plan_arms_training_programs_only():
+    main, startup, _ = _build()
+    plan = integrity.build_plan(main)
+    assert plan is not None
+    assert sorted(plan.param_names()) == sorted(_INIT)
+    # a startup program initializes params HOST-SIDE between runs —
+    # arming it would misread every init write as corruption
+    assert integrity.build_plan(startup) is None
+    # the fully-async transpiled trainer program keeps optimize-ROLE
+    # send/recv ops but no in-trace update ops (Param/ParamOut); the
+    # communicator's recv thread refreshes params out-of-band, so the
+    # sentinel must not arm there either
+    prog = fluid.Program()
+    blk = prog.global_block()
+    blk.create_parameter(name="p", shape=[2], dtype="float32")
+    blk.append_op("send", inputs={"X": ["p@GRAD"]}, outputs={},
+                  attrs={"op_role": "optimize"}, infer_shape=False)
+    assert integrity.build_plan(prog) is None
+
+
+# ---------------------------------------------------------------------------
+# clean-run parity, detection, rollback, attribution
+# ---------------------------------------------------------------------------
+
+def test_sentinel_on_is_bit_identical_to_off(monkeypatch):
+    monkeypatch.setenv("PT_INTEGRITY_EVERY", "2")
+    l_off, p_off, _, _ = _run(8, sentinel=False)
+    l_on, p_on, c_on, _ = _run(8, sentinel=True)
+    assert l_on == l_off
+    for name in _INIT:
+        np.testing.assert_array_equal(p_on[name], p_off[name])
+    assert c_on["integrity_checks"] == 4
+    assert c_on["integrity_mismatches"] == 0
+    assert c_on["integrity_rollbacks"] == 0
+
+
+def test_bitflip_detected_rolled_back_and_attributed(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("PT_INTEGRITY_EVERY", "2")
+    monkeypatch.setenv("PT_FLIGHT_DIR", str(tmp_path))
+    _, _, counters, fcounts = _run(
+        8, sentinel=True, fault="bitflip_step=4,bitflip_param=iw0")
+    assert fcounts["bitflip"] == 1
+    assert counters["integrity_mismatches"] == 1
+    assert counters["integrity_rollbacks"] == 1
+    assert counters["integrity_aborts"] == 0
+    assert counters["anomalies"] >= 1
+
+    # exactly ONE attributed postmortem for the incident
+    from paddle_tpu.observability import recorder
+    dumps = [p for p in recorder.find_dumps(str(tmp_path))]
+    assert len(dumps) == 1
+    hdr = recorder.read_dump(dumps[0])["header"]
+    assert hdr["reason"] == "integrity_mismatch"
+    assert hdr["policy"] == "rollback"
+    assert hdr["worker"] == "0"
+    assert hdr["step"] > 0
+    buckets = hdr["buckets"]
+    assert len(buckets) >= 1
+    flat = [n for b in buckets for n in b["params"]]
+    assert "iw0" in flat
+    assert all(b["mismatched_steps"] >= 1 for b in buckets)
+    assert max(b["drift"] for b in buckets) > 0
+
+
+def test_bitflip_without_sentinel_goes_unnoticed(monkeypatch):
+    """The control: the same corruption with the sentinel OFF is
+    absorbed silently — the regression the sentinel exists to catch."""
+    monkeypatch.setenv("PT_INTEGRITY_EVERY", "2")
+    l_clean, _, _, _ = _run(8, sentinel=False)
+    l_flip, _, counters, fcounts = _run(
+        8, sentinel=False, fault="bitflip_step=4,bitflip_param=iw0")
+    assert fcounts["bitflip"] == 1
+    assert counters["integrity_mismatches"] == 0
+    assert counters["anomalies"] == 0
+    # the engine's run counter counts the startup run too, so
+    # bitflip_step=4 lands on training step index 2
+    assert l_flip[:2] == l_clean[:2]
+    assert l_flip[2:] != l_clean[2:]   # trajectory silently diverged
+
+
+def test_data_dup_is_honestly_missed(monkeypatch):
+    """A duplicated batch is a LEGITIMATE update twice: the parameters
+    stay continuous, so the sentinel must not cry wolf. Exactly-once
+    delivery is the reader cursor's contract (test_elastic_resume)."""
+    monkeypatch.setenv("PT_INTEGRITY_EVERY", "2")
+    losses, _, counters, fcounts = _run(
+        8, sentinel=True, fault="data_dup_step=3")
+    assert fcounts["data_dup"] == 1
+    assert counters["integrity_mismatches"] == 0
+    # the duplicated feed really was used: steps 2 and 3 saw the same
+    # batch but different (already-updated) params, so losses differ
+    # from a clean run's
+    l_clean, _, _, _ = _run(8, sentinel=True)
+    assert losses != l_clean
+
+
+def test_escalation_to_abort(monkeypatch):
+    """Persistent corruption (re-injected every window faster than
+    rollback can heal it) escalates to an abort after
+    PT_INTEGRITY_ESCALATE_AFTER consecutive bad windows."""
+    monkeypatch.setenv("PT_INTEGRITY_EVERY", "1")
+    monkeypatch.setenv("PT_INTEGRITY_ESCALATE_AFTER", "2")
+    from paddle_tpu.core.enforce import EnforceNotMet
+
+    class _EveryStepFlip(faults.FaultPlan):
+        def corrupt_scope(self, step, scope, program):
+            if step >= 2:
+                self.bitflip_step = step
+                self._bitflip_done = False
+            return super().corrupt_scope(step, scope, program)
+
+    fluid.set_flags({"FLAGS_integrity_sentinel": True})
+    scope = Scope()
+    plan = _EveryStepFlip(seed=7, bitflip_step=2, bitflip_param="iw0")
+    try:
+        with fluid.scope_guard(scope), faults.scoped(plan):
+            main, startup, loss = _build()
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            with pytest.raises(EnforceNotMet, match="integrity"):
+                for i in range(8):
+                    exe.run(main, feed=_batch(i),
+                            fetch_list=[loss.name])
+            assert exe._engine.counters["integrity_aborts"] == 1
+    finally:
+        fluid.set_flags({"FLAGS_integrity_sentinel": False})
+
+
+# ---------------------------------------------------------------------------
+# restore interaction
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_restore_does_not_false_positive(
+        tmp_path, monkeypatch):
+    """CheckpointManager.restore rewrites every parameter host-side —
+    a legitimate out-of-band write. It must invalidate the shadow
+    (integrity.invalidate_shadow) instead of tripping the sentinel."""
+    monkeypatch.setenv("PT_INTEGRITY_EVERY", "2")
+    from paddle_tpu.checkpoint import CheckpointManager
+    fluid.set_flags({"FLAGS_integrity_sentinel": True})
+    scope = Scope()
+    try:
+        with fluid.scope_guard(scope):
+            main, startup, loss = _build()
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            for i in range(4):
+                exe.run(main, feed=_batch(i), fetch_list=[loss.name])
+            with CheckpointManager(str(tmp_path / "ck")) as m:
+                m.save(4, scope=scope, program=main, sync=True)
+                for i in range(4, 6):
+                    exe.run(main, feed=_batch(i),
+                            fetch_list=[loss.name])
+                # restore rolls the params back mid-scope ...
+                m.restore(scope=scope, program=main)
+            # ... and training continues without an integrity anomaly
+            for i in range(4, 8):
+                exe.run(main, feed=_batch(i), fetch_list=[loss.name])
+            assert exe._engine.counters["integrity_mismatches"] == 0
+            assert exe._engine.counters["anomalies"] == 0
+    finally:
+        fluid.set_flags({"FLAGS_integrity_sentinel": False})

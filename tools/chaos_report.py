@@ -32,12 +32,24 @@ Usage:
       --fault "seed=7,connect_refuse=0.1,kill_at_step=8"
   python tools/chaos_report.py --steps 16 \
       --fault "seed=7,nan=0.2"                      # stability guard
+  python tools/chaos_report.py --steps 16 \
+      --fault "seed=7,bitflip_step=6"               # integrity sentinel
   PT_BENCH_CHAOS=1 python bench.py                  # bench tail line
 
 ``nan`` / ``grad_spike`` fault plans automatically arm
 ``FLAGS_stability_guard`` in every trainer of both runs and add an
 ``anomalies`` section (detected / recovered_by_rollback /
 degraded_to_skip / aborted) to the report — docs/STABILITY.md.
+
+``bitflip`` / ``data_dup`` fault plans additionally run a single-
+process sentinel probe (``FLAGS_integrity_sentinel`` armed, the
+in-trace shadow-checksum path of docs/RESILIENCE.md — the async-PS
+trainers can't arm it, their params are refreshed out-of-band by the
+communicator's recv thread) and add an ``integrity`` section with
+honest ``{injected, detected, recovered, missed}`` accounting: a
+bitflip must be detected and rolled back; a duplicated batch is a
+LEGITIMATE update twice and is correctly not flagged (missed=1 —
+that's the data-pipeline cursor's job, not the sentinel's).
 """
 from __future__ import annotations
 
@@ -106,7 +118,9 @@ def _worker(role: str) -> None:
                 k: engine.counters.get(k, 0)
                 for k in ("anomalies", "rollbacks",
                           "rollback_reexec_failures", "guard_aborts",
-                          "ghost_snapshots", "replay_bundles")}
+                          "ghost_snapshots", "replay_bundles",
+                          "integrity_checks", "integrity_mismatches",
+                          "integrity_rollbacks", "integrity_aborts")}
         print("CHAOS_STATS " + json.dumps(stats), flush=True)
 
     fluid.framework.unique_name.reset()
@@ -195,6 +209,59 @@ def _worker(role: str) -> None:
     final = float(np.mean(losses[-3:])) if losses else float("nan")
     print("CHAOS_LOSS " + json.dumps(final), flush=True)
     dump_stats(engine=exe._engine)
+
+
+def _sentinel_worker() -> None:
+    """Single-process sentinel probe: same 4-feature regression, local
+    SGD (update ops stay in-trace, so the integrity sentinel arms),
+    fault plan from PT_FAULT_PLAN. Spawned by the orchestrator for
+    bitflip / data_dup plans."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.pop("XLA_FLAGS", None)
+    sys.path.insert(0, REPO)
+
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.core.flags import set_flags
+    from paddle_tpu.distributed import faults
+
+    steps = int(os.environ.get("CHAOS_STEPS", str(DEFAULT_STEPS)))
+    set_flags({"FLAGS_integrity_sentinel": True})
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        pred = layers.fc(x, 1, param_attr=fluid.ParamAttr(name="w"),
+                         bias_attr=fluid.ParamAttr(name="b"))
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.SGDOptimizer(0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(11)
+    w_true = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+    losses = []
+    for _ in range(steps):
+        bx = rng.rand(16, 4).astype(np.float32)
+        by = bx @ w_true + 0.25
+        out = exe.run(main, feed={"x": bx, "y": by},
+                      fetch_list=[loss.name])
+        losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+    final = float(np.mean(losses[-3:])) if losses else float("nan")
+    print("CHAOS_LOSS " + json.dumps(final), flush=True)
+    plan = faults.current()
+    stats = {
+        "role": "sentinel", "rank": 0,
+        "faults": dict(plan.counts) if plan is not None else {},
+        "retry": {},
+        "stability": {
+            k: exe._engine.counters.get(k, 0)
+            for k in ("anomalies", "rollbacks", "ghost_snapshots",
+                      "integrity_checks", "integrity_mismatches",
+                      "integrity_rollbacks", "integrity_aborts")}}
+    print("CHAOS_STATS " + json.dumps(stats), flush=True)
 
 
 # ---------------------------------------------------------------------------
@@ -432,6 +499,8 @@ def run_job(steps=DEFAULT_STEPS, fault_spec=None, max_restarts=1,
     rep = {
         "final_loss": loss0,
         "restarts": restarts,
+        "restart_attempts": {f"trainer{r}": attempts[r]
+                             for r in sorted(attempts)},
         "trainer_exit_codes": trainer_codes,
         "pserver_clean_exit": (not hung and server.returncode == 0),
         "resumed_at_step": agg["resumed_at"],
@@ -450,6 +519,54 @@ def run_job(steps=DEFAULT_STEPS, fault_spec=None, max_restarts=1,
             **{f"trainer{r}": outs[r][-1][2][-800:]
                for r in outs if outs[r]},
         }
+    return rep
+
+
+def _sentinel_probe(steps: int, fault_spec: str,
+                    timeout_s=JOB_TIMEOUT_S) -> dict:
+    """Run the single-process sentinel worker under ``fault_spec`` and
+    fold its counters into ``{injected, detected, recovered, missed}``
+    accounting (docs/RESILIENCE.md)."""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "CHAOS_STEPS": str(steps),
+        "PT_FAULT_PLAN": fault_spec,
+        # verdict every 2 steps so the injection's window closes well
+        # inside the step budget
+        "PT_INTEGRITY_EVERY": "2",
+    })
+    env.pop("PADDLE_RESTART_ATTEMPT", None)
+    p = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__),
+         "--role", "sentinel"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True)
+    try:
+        out, err = p.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        p.kill()
+        out, err = p.communicate()
+    agg = {"faults": {}, "retry": {}, "stability": {}, "losses": [],
+           "resumed_at": None}
+    _parse_worker(out, agg)
+    f, st = agg["faults"], agg["stability"]
+    injected = int(f.get("bitflip", 0)) + int(f.get("data_dup", 0))
+    detected = int(st.get("integrity_mismatches", 0))
+    rep = {
+        "injected": injected,
+        "detected": detected,
+        "recovered": int(st.get("integrity_rollbacks", 0)),
+        "missed": max(0, injected - detected),
+        "aborted": int(st.get("integrity_aborts", 0)),
+        "checks": int(st.get("integrity_checks", 0)),
+        "faults_injected": f,
+        "final_loss": (agg["losses"][0] if agg["losses"] else None),
+        "completed": p.returncode == 0,
+    }
+    if p.returncode != 0:
+        rep["stderr_tail"] = (err or "")[-800:]
     return rep
 
 
@@ -488,6 +605,18 @@ def chaos_report(steps=DEFAULT_STEPS, fault_spec=DEFAULT_FAULT,
             "degraded_to_skip": st.get("rollback_reexec_failures", 0),
             "aborted": st.get("guard_aborts", 0),
         }
+    # integrity-class chaos (bitflip / data_dup): single-process
+    # sentinel probe with {injected, detected, recovered, missed}
+    # accounting; an undetected bitflip (missed > 0) fails survival
+    integrity = any(k in (fault_spec or "")
+                    for k in ("bitflip", "data_dup"))
+    if integrity:
+        probe = _sentinel_probe(steps, fault_spec)
+        rep["integrity"] = probe
+        if "bitflip" in (fault_spec or ""):
+            rep["survived"] = bool(
+                rep["survived"] and probe["completed"]
+                and probe["missed"] == 0 and probe["injected"] > 0)
     return rep
 
 
@@ -502,12 +631,17 @@ def chaos_report_line(steps=DEFAULT_STEPS, fault_spec=DEFAULT_FAULT,
             f"faults={sum(f['faults_injected'].values())} "
             f"retries={f['retries_consumed']} "
             f"loss_delta={rep['loss_delta']}")
+    if "integrity" in rep:
+        i = rep["integrity"]
+        line += (f" integrity={i['detected']}/{i['injected']} "
+                 f"recovered={i['recovered']} missed={i['missed']}")
     return rep, line
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--role", choices=["pserver", "trainer"],
+    ap.add_argument("--role", choices=["pserver", "trainer",
+                                       "sentinel"],
                     help=argparse.SUPPRESS)
     ap.add_argument("--steps", type=int, default=DEFAULT_STEPS)
     ap.add_argument("--fault", default=DEFAULT_FAULT,
@@ -518,6 +652,9 @@ def main(argv=None):
                     help="PT_STABILITY_POLICY for nan/grad_spike "
                          "fault plans (guard armed automatically)")
     args = ap.parse_args(argv)
+    if args.role == "sentinel":
+        _sentinel_worker()
+        return
     if args.role:
         _worker(args.role)
         return

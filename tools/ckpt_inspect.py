@@ -9,10 +9,17 @@ incomplete shard coverage. Same exit-code convention as
 ``tools/lint_program.py``, suitable for CI gating or a pre-restore
 sanity check on a copied/rsynced checkpoint directory.
 
+``--train-state`` additionally prints and lints the manifest's
+``train_state`` section (checkpoint/train_state.py): a checkpoint
+missing the section is merely noted as legacy (tensors-only restore),
+but a section whose ``global_step`` disagrees with the step directory
+it lives in, or a worker entry with no reader cursors at all, is a
+resume hazard and exits non-zero.
+
 Usage:
   python tools/ckpt_inspect.py /path/to/ckpt
   python tools/ckpt_inspect.py /path/to/ckpt --step 42 --tensors
-  python tools/ckpt_inspect.py /path/to/ckpt --verify
+  python tools/ckpt_inspect.py /path/to/ckpt --verify --train-state
 """
 from __future__ import annotations
 
@@ -74,8 +81,45 @@ def _print_tensors(root: str, step: int) -> None:
               f"shards={len(t['shards'])} {_fmt_bytes(nbytes)}")
 
 
+def _check_train_state(root: str, step: int) -> List[str]:
+    """Print the train_state section for ``step`` and return lint
+    problems (empty for a clean or legacy checkpoint)."""
+    man = wr._manifest_for_step(root, step)
+    sec = man.get("train_state")
+    if not sec:
+        print("    train_state: (none — legacy checkpoint, restores "
+              "tensors-only; data cursors / loss scale / guard EMA "
+              "restart from scratch)")
+        return []
+    problems: List[str] = []
+    gstep = sec.get("global_step")
+    workers = sec.get("workers") or {}
+    print(f"    train_state: v{sec.get('version')} global_step={gstep} "
+          f"workers={sorted(workers)} "
+          f"loss_scale={sec.get('loss_scale')} "
+          f"guard_ema={sec.get('guard_ema')} "
+          f"autotune_token={sec.get('autotune_token')}")
+    if int(gstep or 0) != int(step):
+        msg = (f"train_state.global_step={gstep} disagrees with the "
+               f"step directory ({step}) — resume would replay from "
+               f"the wrong batch")
+        print(f"    CORRUPT: {msg}")
+        problems.append(f"step {step}: {msg}")
+    for pid, w in sorted(workers.items()):
+        cursors = (w or {}).get("readers") or {}
+        if not cursors:
+            msg = (f"worker {pid} has no reader cursors — its data "
+                   f"pipeline restarts from batch 0 on resume")
+            print(f"    CORRUPT: {msg}")
+            problems.append(f"step {step}: {msg}")
+            continue
+        for name, cur in sorted(cursors.items()):
+            print(f"      reader {name}: {cur}")
+    return problems
+
+
 def inspect(root: str, step=None, verify=False,
-            show_tensors=False) -> int:
+            show_tensors=False, train_state=False) -> int:
     if not os.path.isdir(root):
         print(f"error: {root!r} is not a directory", file=sys.stderr)
         return EXIT_USAGE
@@ -116,6 +160,8 @@ def inspect(root: str, step=None, verify=False,
               f"{mark}")
         if show_tensors:
             _print_tensors(root, s)
+        if train_state:
+            problems.extend(_check_train_state(root, s))
         if verify:
             bad = wr.verify_step(root, s)
             for b in bad:
@@ -149,12 +195,16 @@ def main(argv=None) -> int:
                          "mismatch)")
     ap.add_argument("--tensors", action="store_true",
                     help="list per-tensor shape/dtype/sharding")
+    ap.add_argument("--train-state", action="store_true",
+                    help="print + lint the train_state section "
+                         "(exit 1 on step skew / missing cursors)")
     try:
         args = ap.parse_args(argv)
     except SystemExit:
         return EXIT_USAGE
     return inspect(args.root, step=args.step, verify=args.verify,
-                   show_tensors=args.tensors)
+                   show_tensors=args.tensors,
+                   train_state=args.train_state)
 
 
 if __name__ == "__main__":

@@ -70,6 +70,11 @@ REQUIRED_FAMILIES = (
     "pt_hbm_owner_bytes", "pt_hbm_live_bytes",
     "pt_island_hbm_peak_bytes", "pt_hbm_leak_suspect_bytes",
     "pt_memdumps_total", "pt_oom_postmortems_total",
+    # integrity sentinel + exactly-once resume (docs/RESILIENCE.md)
+    "pt_integrity_checks_total", "pt_integrity_mismatch_total",
+    "pt_integrity_rollbacks_total", "pt_integrity_drift",
+    "pt_resume_restores_total", "pt_resume_replayed_batches_total",
+    "pt_resume_cursor_stale_total", "pt_resume_resumed_step",
 )
 
 

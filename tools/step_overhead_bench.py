@@ -12,7 +12,7 @@ CLI::
     python tools/step_overhead_bench.py [--json] [--async-dispatch]
         [--batch N] [--steps N] [--threshold-ms X] [--telemetry]
         [--compare-telemetry] [--compare-scheduler] [--compare-guard]
-        [--compare-tuned] [--compare-memory]
+        [--compare-tuned] [--compare-memory] [--compare-integrity]
 
 exits non-zero when measured host overhead exceeds ``--threshold-ms``
 (the CI regression gate). ``overhead_report()`` is imported by bench.py
@@ -87,6 +87,21 @@ def guard_overhead_report(guard):
             f"ghosts={guard.get('ghost_snapshots', 0)} "
             f"anomalies={guard.get('anomalies', 0)}")
     return guard, line
+
+
+def integrity_report(integ):
+    """(dict, '#'-line) for the bench JSON tail from an integrity-
+    sentinel A/B probe result ({sync_ms_off, sync_ms_on, ...});
+    (None, None) when the probe did not run or errored before
+    measuring."""
+    if not integ or "sync_ms_on" not in integ:
+        return (integ or None), None
+    off, on = integ["sync_ms_off"], integ["sync_ms_on"]
+    line = (f"# integrity_sentinel: sync {off:.2f} -> {on:.2f} ms/step "
+            f"(delta {on - off:+.3f} ms); checks="
+            f"{integ.get('integrity_checks', 0)} mismatches="
+            f"{integ.get('integrity_mismatches', 0)}")
+    return integ, line
 
 
 def tuning_report(tun):
@@ -233,6 +248,13 @@ def main(argv=None):
                         "scalar fetch); --threshold-ms gates the "
                         "guard-on DELTA, the number "
                         "docs/STABILITY.md promises stays small")
+    p.add_argument("--compare-integrity", action="store_true",
+                   help="A/B FLAGS_integrity_sentinel: measure off "
+                        "then on (per-bucket fingerprints compiled "
+                        "into the step, host verdict every "
+                        "PT_INTEGRITY_EVERY steps); --threshold-ms "
+                        "gates the sentinel-on sync DELTA, the number "
+                        "docs/RESILIENCE.md promises stays small")
     p.add_argument("--compare-tuned", action="store_true",
                    help="run the feedback-directed autotuner on a "
                         "fresh engine/model (docs/TUNING.md), measure "
@@ -331,6 +353,30 @@ def main(argv=None):
                 r["guard_delta_ms"] = r_g["sync_ms"] - r["sync_ms"]
             finally:
                 set_flags({"FLAGS_stability_guard": False})
+        if args.compare_integrity:
+            # A/B the integrity sentinel on a FRESH engine/model (the
+            # sentinel flag is part of the trace cache key; a fresh
+            # scope keeps both measurements starting from identical
+            # params and the sentinel-off numbers uncontaminated)
+            set_flags({"FLAGS_integrity_sentinel": True})
+            try:
+                eng6, prog6, scope6, feed6, fetch6 = \
+                    _build_model(args.batch)
+                with fluid.scope_guard(scope6):
+                    r_i = measure_step_overhead(
+                        eng6, prog6, scope6, feed6, fetch6,
+                        steps=args.steps)
+                r["integrity_on"] = {
+                    **{k: r_i[k] for k in
+                       ("sync_ms", "pipelined_ms", "host_overhead_ms",
+                        "steps_per_sec")},
+                    "integrity_checks":
+                        r_i["counters"].get("integrity_checks", 0),
+                    "integrity_mismatches":
+                        r_i["counters"].get("integrity_mismatches", 0)}
+                r["integrity_delta_ms"] = r_i["sync_ms"] - r["sync_ms"]
+            finally:
+                set_flags({"FLAGS_integrity_sentinel": False})
         if args.compare_tuned:
             # autotune a FRESH engine/model, then measure with the
             # winner applied; knob + applied state restored after, so
@@ -435,6 +481,16 @@ def main(argv=None):
                  "anomalies": r["guard_on"]["anomalies"]})
             if line:
                 print(line)
+        if "integrity_on" in r:
+            _, line = integrity_report(
+                {"sync_ms_off": r["sync_ms"],
+                 "sync_ms_on": r["integrity_on"]["sync_ms"],
+                 "integrity_checks":
+                     r["integrity_on"]["integrity_checks"],
+                 "integrity_mismatches":
+                     r["integrity_on"]["integrity_mismatches"]})
+            if line:
+                print(line)
         if "tuning" in r:
             _, line = tuning_report(r["tuning"])
             if line:
@@ -467,6 +523,12 @@ def main(argv=None):
         bad.append(
             f"stability-guard sync delta "
             f"{r['guard_delta_ms']:.2f} ms > threshold "
+            f"{args.threshold_ms:.1f} ms")
+    if args.threshold_ms is not None and "integrity_delta_ms" in r \
+            and r["integrity_delta_ms"] > args.threshold_ms:
+        bad.append(
+            f"integrity-sentinel sync delta "
+            f"{r['integrity_delta_ms']:.2f} ms > threshold "
             f"{args.threshold_ms:.1f} ms")
     if args.threshold_ms is not None and "tuned_delta_ms" in r and \
             r["tuned_delta_ms"] > args.threshold_ms:
