@@ -481,6 +481,57 @@ def _probe_memory(eng, prog, scope, feed, fetch, sync_ms):
     return out
 
 
+def _probe_analysis(eng, prog, scope, feed, fetch, stats, batch):
+    """Program-verifier calibration probe (docs/STATIC_ANALYSIS.md) on
+    the already-built transformer: the liveness-based static HBM plan
+    reconciled against the measured owner census and per-island
+    ``memory_analysis`` rows (``*_error_ratio`` is the acceptance
+    number — the static plan must land within 25% of the measured
+    census), the static cost model correlated against per-island
+    dispatch spans and XLA's own flops figure, and the verifier's own
+    wall time (it runs pre-compile, so it must stay cheap)."""
+    out = {}
+    try:
+        from paddle_tpu.analysis import (analyze_program, plan_memory,
+                                         reconcile)
+        from paddle_tpu.observability import attribution as obs_attr
+        from paddle_tpu.observability import memory as obs_memory
+
+        t0 = time.perf_counter()
+        diags = analyze_program(prog, feed_names=sorted(feed),
+                                fetch_names=fetch)
+        out["verifier_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+        out["diagnostics"] = len(diags)
+
+        plan = plan_memory(prog, feed_names=sorted(feed),
+                           fetch_names=fetch, dynamic_dim=batch)
+        was = obs_memory.census_enabled()
+        obs_memory.enable(True)
+        try:
+            c = obs_memory.census()
+        finally:
+            obs_memory.enable(was)
+        rec = reconcile(plan, census=c,
+                        island_rows=obs_attr.island_memory_rows(eng)
+                        or None,
+                        measured_step=stats)
+        out["static_peak_bytes"] = plan.peak_bytes
+        for k in ("resident_error_ratio", "island_mean_error_ratio",
+                  "temp_error_ratio"):
+            if k in rec:
+                out[k] = rec[k]
+
+        cal = obs_attr.cost_calibration(eng, prog, dynamic_dim=batch,
+                                        compiled_stats=stats)
+        for k in ("static_total_flops", "flop_time_correlation",
+                  "flops_ratio", "islands_matched"):
+            if cal.get(k) is not None:
+                out[k] = cal[k]
+    except Exception as exc:   # accounting only; never fail the bench
+        out["error"] = f"{type(exc).__name__}: {exc}"[:200]
+    return out
+
+
 def bench_transformer(batch=BATCH, seq=None, measure_ckpt=False):
     import paddle_tpu as fluid
     from paddle_tpu import models
@@ -546,6 +597,10 @@ def bench_transformer(batch=BATCH, seq=None, measure_ckpt=False):
             # effectiveness for the memory JSON tail (docs/MEMORY.md)
             stats["memory"] = _probe_memory(
                 eng, main_prog, scope, feed, [cost.name], sync_ms)
+            # static-vs-measured verifier calibration for the analysis
+            # JSON tail (docs/STATIC_ANALYSIS.md)
+            stats["analysis"] = _probe_analysis(
+                eng, main_prog, scope, feed, [cost.name], stats, batch)
     return sps * batch * s_trg, sps, traj, sync_ms, stats
 
 
@@ -650,6 +705,12 @@ def bench_lenet():
               f"noisy; the dispatch-bound diagnosis rests on sync "
               f"latency vs device-only below)", file=sys.stderr)
         stats = eng.compiled_stats(main_prog, scope, batch, [cost.name], iterations=16)
+        if stats is not None:
+            # static-vs-measured verifier calibration (second model
+            # class for the acceptance bar: MLP/conv alongside the
+            # headline transformer)
+            stats["analysis"] = _probe_analysis(
+                eng, main_prog, scope, batch, [cost.name], stats, B)
     return sps * B, sps, traj, sync_ms, stats
 
 

@@ -245,6 +245,16 @@ def _check_liveness(ctx: AnalysisContext) -> List[Diagnostic]:
         if last.slot in _MARKER_SLOTS or \
                 last.slot in _exempt_slots(last.op_type):
             continue
+        if name.endswith("@GRAD") and (
+                last.op_type.endswith("_grad") or
+                last.op.attr("op_role", "forward") == "backward"):
+            # autodiff byproduct: a grad op emits gradients for every
+            # differentiable input, including ones nothing consumes
+            # (e.g. the divisor grad of a mean's elementwise_div when
+            # the count is constant); the reference prunes these in
+            # backward.py and this engine drops them at trace, so an
+            # unread grad output is expected, not a defect
+            continue
         if ctx.feed_names is not None and name in ctx.feed_names:
             continue
         diags.append(ctx.diag(
@@ -461,21 +471,23 @@ def _collective_signature(program: Program):
     reordered pair silently mixes tensors or hangs on a shape mismatch.
 
     A bucketed collective (c_allreduce_fused, comm_scheduler) carries a
-    whole bucket as operands: its name tuple is the bucket MEMBERSHIP
-    SET (sorted — member order inside one fused payload is a local
-    layout choice), so shards agreeing on membership but differing in
-    emission order inside the bucket do NOT false-positive, while a
-    grad assigned to different buckets on different shards (a real
-    payload-shape divergence that hangs the ring) is an error."""
+    whole bucket as operands: membership is compared as a SET first
+    (so the report can name exactly the members that moved buckets),
+    then the RAW member order — the fused lowering concatenates
+    operands in slot order into one flat payload, so ranks agreeing on
+    membership but disagreeing on member order place tensors at
+    different offsets and the element-wise ring reduce mixes them with
+    no error. Both divergences are reported, with distinct messages."""
     seq = []
     for block in program.blocks:
         for op_idx, op in enumerate(block.ops):
             if op.type not in COLLECTIVE_OP_TYPES:
                 continue
-            names = tuple(sorted(n for n in op.input_arg_names if n))
+            raw = tuple(n for n in op.input_arg_names if n)
+            names = tuple(sorted(raw))
             sig = (op.type, int(op.attr("ring_id", 0) or 0),
                    int(op.attr("root", 0) or 0),
-                   int(op.attr("reduce_type", 0) or 0), names)
+                   int(op.attr("reduce_type", 0) or 0), names, raw)
             seq.append((block.idx, op_idx, sig))
     return seq
 
@@ -498,7 +510,14 @@ def check_collective_ordering(
                 zip(ref_seq, seq)):
             if rsig == ssig:
                 continue
-            if rsig[:4] == ssig[:4] and rsig[0] == "c_allreduce_fused":
+            if rsig[:5] == ssig[:5] and rsig[0] == "c_allreduce_fused":
+                detail = (f"bucket member ORDER diverges: {labels[0]} "
+                          f"fuses {list(rsig[5])} where {labels[i]} "
+                          f"fuses {list(ssig[5])} — member order "
+                          f"defines each tensor's offset in the flat "
+                          f"fused payload, so the element-wise ring "
+                          f"reduce mixes tensors silently")
+            elif rsig[:4] == ssig[:4] and rsig[0] == "c_allreduce_fused":
                 ronly = sorted(set(rsig[4]) - set(ssig[4]))
                 sonly = sorted(set(ssig[4]) - set(rsig[4]))
                 detail = (f"bucket membership diverges: {labels[0]} "
@@ -558,3 +577,12 @@ def analyze_shard_programs(
                                      label=label))
     diags.extend(check_collective_ordering(programs, labels))
     return diags
+
+
+# verifier pass families (PR 14) live in their own modules and
+# register themselves on import; pulled in here so any entry point
+# that can run passes (analyze_program, validate_cached, the lint CLI)
+# sees the full registry
+from . import races  # noqa: E402,F401  (island-race)
+from . import memplan  # noqa: E402,F401  (memory-plan)
+from . import cost_model  # noqa: E402,F401  (cost-model)

@@ -1,0 +1,342 @@
+"""Island race / donation-hazard detection (the concurrency half of
+the program verifier).
+
+PR 7's op scheduler dispatches same-phase islands concurrently on
+thread-pool lanes, and the engine donates updated-persistable input
+buffers to XLA; both are safe only under invariants that used to live
+in the builders' heads:
+
+* no two same-phase islands may touch a common name one of them
+  writes (write-write or read-write on scope vars) — lane timing
+  would otherwise pick the final value;
+* program ops must not read or write the engine's *in-trace* state
+  (``@LOSS_SCALE@``, ``@GUARD_*@``, ``@INTEGRITY_*@``,
+  ``@RNG_STATE@``): the engine appends guard / loss-scale /
+  fingerprint epilogues to the same trace, so a user op racing them
+  is a same-trace conflict no scheduler barrier orders;
+* a donated / aliased buffer (an updated persistable's input) must
+  not be read by a concurrent island or held by a pending async
+  fetch when the next step's donation invalidates it;
+* a ``c_allreduce_fused`` bucket plan must tile the program's grad
+  production order exactly — a dropped, duplicated, or reordered
+  member changes the fused payload layout and silently mixes
+  tensors (or hangs) on a real ring.
+
+The pass does NOT trust the scheduler's own interface bookkeeping: it
+re-derives each island's first-read and write sets from the op slots
+and proves the pairwise independence afresh, so a partitioner
+regression (union-find, capping, interface computation) surfaces here
+as an ERROR naming the islands, ops, and vars — before any executable
+is built.  `verify_partition` also accepts an externally supplied
+(possibly corrupted) `PartitionInfo`, which is how
+``tools/lint_program.py --check-races --inject ...`` demonstrates each
+defect class.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .diagnostics import Diagnostic, Severity
+
+__all__ = ["verify_partition", "donation_plan", "ENGINE_STATE_RE"]
+
+# engine-managed in-trace state: fully-enclosed upper-case @NAME@ vars
+# (core/engine.py RNG_STATE_VAR, stability/guard.py @GUARD_*@ /
+# @LOSS_SCALE@, stability/integrity.py @INTEGRITY_*@). Suffix-style
+# decorations (p.name + "@SNAPSHOT", grad @RENAME@ accumulation) do
+# NOT match — those are ordinary scope vars.
+ENGINE_STATE_RE = re.compile(r"^@[A-Z][A-Z0-9_]*@$")
+
+
+def _op_reads(op) -> List[str]:
+    return [n for slot in op.input_slots() for n in op.input(slot) if n]
+
+
+def _op_writes(op) -> List[str]:
+    return [n for slot in op.output_slots() for n in op.output(slot)
+            if n]
+
+
+def _island_sets(ops, isl) -> Tuple[Set[str], Set[str]]:
+    """(first_reads, writes) re-derived from the op slots — the proof
+    deliberately ignores ``isl.in_names``/``isl.writes`` so a stale or
+    buggy interface cannot vouch for itself."""
+    reads: Set[str] = set()
+    writes: Set[str] = set()
+    for i in isl.indices:
+        for n in _op_reads(ops[i]):
+            if n not in writes:
+                reads.add(n)
+        writes.update(_op_writes(ops[i]))
+    return reads, writes
+
+
+def _site_of(ops, indices, name, want_write: bool) -> Tuple[int, str]:
+    """(op_idx, op_type) of the first op in `indices` touching `name`
+    on the relevant side — makes the diagnostic actionable."""
+    for i in indices:
+        names = _op_writes(ops[i]) if want_write else _op_reads(ops[i])
+        if name in names:
+            return i, ops[i].type
+    return indices[0] if indices else -1, "?"
+
+
+def verify_partition(program, info, donated_names=None,
+                     label: Optional[str] = None) -> List[Diagnostic]:
+    """Prove every same-phase island pair of `info` conflict-free.
+
+    `info` is a ``core.scheduler.PartitionInfo`` — normally the one
+    ``partition_metadata`` recomputes from the program, at validation
+    tier 2 the engine's actual traced partition. `donated_names`
+    defaults to the partition's updated persistables (the engine's
+    static donation set); a read-write hazard on a donated name is
+    reported as a donation hazard, since the concurrent reader may
+    observe the donated/aliased buffer mid-update.
+    """
+    ops = info.ops
+    donated = set(donated_names) if donated_names is not None \
+        else set(info.updated_names)
+    diags: List[Diagnostic] = []
+    for phase in info.phases:
+        if len(phase) < 2:
+            continue
+        sets = [_island_sets(ops, isl) for isl in phase]
+        for a in range(len(phase)):
+            for b in range(a + 1, len(phase)):
+                ra, wa = sets[a]
+                rb, wb = sets[b]
+                ww = sorted(wa & wb)
+                for name in ww:
+                    ia, ta = _site_of(ops, phase[a].indices, name, True)
+                    ib, tb = _site_of(ops, phase[b].indices, name, True)
+                    diags.append(Diagnostic(
+                        Severity.ERROR, "island-race",
+                        f"write-write hazard: islands {a} and {b} of "
+                        f"phase {phase[a].phase} both write {name!r} "
+                        f"(op #{ia} {ta!r} vs op #{ib} {tb!r}) — "
+                        f"same-phase islands dispatch concurrently on "
+                        f"scheduler lanes, so the surviving value "
+                        f"depends on lane timing",
+                        op_type=ta, block_idx=info.block_idx,
+                        op_idx=ia, var_names=(name,),
+                        program_label=label))
+                for (ri, wi, i_r, i_w) in ((ra, wb, a, b),
+                                           (rb, wa, b, a)):
+                    for name in sorted((ri & wi) - set(ww)):
+                        ir, tr = _site_of(
+                            ops, phase[i_r].indices, name, False)
+                        iw, tw = _site_of(
+                            ops, phase[i_w].indices, name, True)
+                        if name in donated:
+                            msg = (
+                                f"donation hazard: island {i_r} reads "
+                                f"{name!r} (op #{ir} {tr!r}) while "
+                                f"island {i_w} updates it in place "
+                                f"(op #{iw} {tw!r}) in the same phase "
+                                f"— {name!r} is an updated persistable "
+                                f"whose input buffer the engine "
+                                f"donates, so the concurrent reader "
+                                f"may observe the donated/aliased "
+                                f"buffer mid-update")
+                        else:
+                            msg = (
+                                f"read-write hazard: island {i_r} "
+                                f"reads {name!r} (op #{ir} {tr!r}) "
+                                f"while island {i_w} writes it "
+                                f"(op #{iw} {tw!r}) in the same phase "
+                                f"— concurrent dispatch makes the "
+                                f"observed value depend on lane "
+                                f"timing")
+                        diags.append(Diagnostic(
+                            Severity.ERROR, "island-race", msg,
+                            op_type=tr, block_idx=info.block_idx,
+                            op_idx=ir, var_names=(name,),
+                            program_label=label))
+    return diags
+
+
+def donation_plan(program, block_idx: int = 0,
+                  updated_names: Optional[Sequence[str]] = None
+                  ) -> Dict[str, object]:
+    """Static donation metadata: which buffers the engine will donate
+    (updated persistables — ``core/engine.py`` computes the same set
+    from its phase-1 trace) and which of them a fetch could alias.
+    Consumed by the island-race pass and by observability dashboards.
+    """
+    from ..core.scheduler import static_updated_names
+    if updated_names is None:
+        updated_names = static_updated_names(program, block_idx)
+    block = program.block(block_idx)
+    donated = []
+    for n in updated_names:
+        v = block._find_var_recursive(n)
+        if v is not None and getattr(v, "persistable", False):
+            donated.append(n)
+    return {"donated": donated, "block_idx": block_idx}
+
+
+def _implicit_state_diags(ctx) -> List[Diagnostic]:
+    """Program ops racing the engine's in-trace state epilogues."""
+    diags: List[Diagnostic] = []
+    for block_idx, block in enumerate(ctx.program.blocks):
+        for op_idx, op in enumerate(block.ops):
+            for name in _op_writes(op):
+                if ENGINE_STATE_RE.match(name):
+                    diags.append(ctx.diag(
+                        Severity.ERROR, "island-race",
+                        f"op {op.type!r} writes engine-managed "
+                        f"in-trace state {name!r} — the engine's "
+                        f"guard/loss-scale/fingerprint epilogue "
+                        f"updates this var inside the same trace, so "
+                        f"a program-op write races it with no "
+                        f"ordering",
+                        op=op, block_idx=block_idx, op_idx=op_idx,
+                        var_names=(name,)))
+            for name in _op_reads(op):
+                if ENGINE_STATE_RE.match(name):
+                    diags.append(ctx.diag(
+                        Severity.WARNING, "island-race",
+                        f"op {op.type!r} reads engine-managed "
+                        f"in-trace state {name!r} — the value is "
+                        f"only defined after the engine epilogue "
+                        f"runs, so an in-program read observes the "
+                        f"previous step's state",
+                        op=op, block_idx=block_idx, op_idx=op_idx,
+                        var_names=(name,)))
+    return diags
+
+
+def _donated_fetch_diags(ctx) -> List[Diagnostic]:
+    """A fetch target that is also a donated (updated-persistable)
+    buffer: under FLAGS_async_dispatch the pending fetch handle and
+    the next step's donated input alias the same array."""
+    if not ctx.fetch_names:
+        return []
+    plan = donation_plan(ctx.program)
+    hot = sorted(set(ctx.fetch_names) & set(plan["donated"]))
+    diags: List[Diagnostic] = []
+    for name in hot:
+        diags.append(ctx.diag(
+            Severity.WARNING, "island-race",
+            f"fetch target {name!r} is an updated persistable whose "
+            f"input buffer is donated to the compiled step — under "
+            f"FLAGS_async_dispatch a still-pending fetch handle "
+            f"aliases a buffer the next step's donation invalidates; "
+            f"fetch a copy or synchronize before the next run",
+            var_names=(name,)))
+    return diags
+
+
+def _bucket_plan_diags(ctx) -> List[Diagnostic]:
+    """Cross-path ``c_allreduce_fused`` bucket-plan consistency.
+
+    The engine plans buckets through ``parallel/comm_scheduler``
+    (greedy, production-order, dtype-homogeneous, size-capped); the
+    transpiler materializes the same plan as fused ops; the dygraph
+    path buckets through the same planner. Whatever path produced the
+    program, a *valid* plan must tile the block's param-grad
+    production order: every grad in exactly one bucket, members
+    contiguous and in production order, one dtype per bucket. Those
+    invariants hold for any bucket-size cap, so the check needs no
+    knowledge of the cap the producer used — it catches dropped /
+    duplicated / reordered members, which change the fused payload
+    layout and silently mix tensors (or hang) on a real ring.
+    """
+    from ..parallel.comm_scheduler import grad_production_order
+    program = ctx.program
+    diags: List[Diagnostic] = []
+    for block_idx, block in enumerate(program.blocks):
+        fused = [(i, op) for i, op in enumerate(block.ops)
+                 if op.type == "c_allreduce_fused"]
+        if not fused:
+            continue
+        order = [n for n, _, _, _ in
+                 grad_production_order(program, block_idx)]
+        pos = {n: i for i, n in enumerate(order)}
+        seen: Dict[str, int] = {}
+        cursor = 0
+        for op_idx, op in fused:
+            names = [n for n in op.input("X") if n]
+            for n in names:
+                if n in seen:
+                    diags.append(ctx.diag(
+                        Severity.ERROR, "island-race",
+                        f"bucket plan divergence: grad {n!r} is a "
+                        f"member of two c_allreduce_fused buckets "
+                        f"(ops #{seen[n]} and #{op_idx}) — it would "
+                        f"be reduced twice",
+                        op=op, block_idx=block_idx, op_idx=op_idx,
+                        var_names=(n,)))
+                seen[n] = op_idx
+            known = [n for n in names if n in pos]
+            if known != sorted(known, key=lambda n: pos[n]):
+                diags.append(ctx.diag(
+                    Severity.ERROR, "island-race",
+                    f"bucket plan divergence: c_allreduce_fused "
+                    f"members {known} are not in grad production "
+                    f"order — member order defines the fused payload "
+                    f"offsets, so ranks disagreeing on it mix "
+                    f"tensors element-wise with no error",
+                    op=op, block_idx=block_idx, op_idx=op_idx,
+                    var_names=tuple(known)))
+            if known and pos[known[0]] < cursor:
+                diags.append(ctx.diag(
+                    Severity.ERROR, "island-race",
+                    f"bucket plan divergence: bucket at op "
+                    f"#{op_idx} starts at grad {known[0]!r} which "
+                    f"precedes a grad already fused — buckets must "
+                    f"tile the production order contiguously",
+                    op=op, block_idx=block_idx, op_idx=op_idx,
+                    var_names=(known[0],)))
+            if known:
+                cursor = max(cursor, pos[known[-1]] + 1)
+            dtypes = set()
+            for n in names:
+                v = block._find_var_recursive(n) or \
+                    block._find_var_recursive(n.split("@GRAD")[0])
+                if v is not None:
+                    dtypes.add(str(v.dtype))
+            if len(dtypes) > 1:
+                diags.append(ctx.diag(
+                    Severity.ERROR, "island-race",
+                    f"bucket plan divergence: c_allreduce_fused op "
+                    f"#{op_idx} mixes dtypes {sorted(dtypes)} in one "
+                    f"bucket — the fused flat payload is single-dtype",
+                    op=op, block_idx=block_idx, op_idx=op_idx,
+                    var_names=tuple(names)))
+        missing = [n for n in order if n not in seen]
+        if missing:
+            diags.append(ctx.diag(
+                Severity.ERROR, "island-race",
+                f"bucket plan divergence: param grads {missing} are "
+                f"in the block's production order but in no "
+                f"c_allreduce_fused bucket — their updates silently "
+                f"skip the ring on this rank and desync replicas",
+                block_idx=block_idx, var_names=tuple(missing)))
+    return diags
+
+
+# registered last so importing either module order works: passes.py
+# pulls this module in at its own bottom, by which point
+# register_analysis_pass is already defined
+from .passes import register_analysis_pass  # noqa: E402
+
+
+@register_analysis_pass("island-race")
+def island_race_pass(ctx) -> List[Diagnostic]:
+    """Recompute the scheduler's partition and prove it conflict-free;
+    plus the partition-independent hazards (engine-state conflicts,
+    donated-fetch aliasing, fused-bucket plan divergence)."""
+    from ..core.scheduler import partition_metadata
+    diags = _implicit_state_diags(ctx)
+    diags += _donated_fetch_diags(ctx)
+    diags += _bucket_plan_diags(ctx)
+    try:
+        info = partition_metadata(ctx.program, 0,
+                                  fetch_names=ctx.fetch_names)
+    except Exception:
+        return diags  # unpartitionable = never dispatched concurrently
+    if info.eligible:
+        diags += verify_partition(ctx.program, info, label=ctx.label)
+    return diags

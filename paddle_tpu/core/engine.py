@@ -1186,7 +1186,11 @@ class Engine:
         return (_tuning_state.applied_token(),
                 os.environ.get("PT_SCHED_LANES", ""),
                 os.environ.get("PT_COMPILER_OPTIONS", ""),
-                os.environ.get("PT_RECOMPUTE", ""))
+                os.environ.get("PT_RECOMPUTE", ""),
+                # flash-attention A/B dispatch overrides pick the kernel
+                # at trace time (tools/lint_flags.py found these unkeyed)
+                os.environ.get("PT_FORCE_KERNEL", ""),
+                os.environ.get("PT_FORCE_COMPOSED", ""))
 
     @staticmethod
     def _cache_key(program, block_idx, feed_sig_key, fetch_names,
@@ -1301,6 +1305,23 @@ class Engine:
             pass
         traced._stats_cache = out
         return out
+
+    def donation_metadata(self) -> List[Dict[str, Any]]:
+        """Per-trace donation metadata for the verifier and the memory
+        observatory: which buffers each cached step donates to XLA
+        (updated persistables, aliased in-place) and which it keeps
+        const. The static analyzer's ``analysis.donation_plan``
+        predicts this set pre-trace; this is the ground truth to
+        reconcile against."""
+        rows: List[Dict[str, Any]] = []
+        for traced in list(self._cache.values()):
+            rows.append({
+                "donated": list(traced.donated_names),
+                "const_count": len(traced.const_names),
+                "updated": list(traced.updated_names),
+                "scheduled": getattr(traced, "op_sched", None)
+                is not None})
+        return rows
 
     def _fast_key(self, program, block_idx, fetch_names, iterations):
         return (program.fingerprint, block_idx, tuple(fetch_names),
@@ -1501,6 +1522,18 @@ class Engine:
                                 data_axis=self.data_axis,
                                 strategy=self.strategy,
                                 iterations=iterations)
+            if FLAGS.validate_program and \
+                    int(FLAGS.validate_tier) >= 2:
+                # tier 2: re-verify the step we ACTUALLY traced — the
+                # partition the scheduler would dispatch, proven
+                # conflict-free under the ground-truth updated/donated
+                # sets phase 1 discovered (vs tier 1's static
+                # inference at the executor boundary). Runs once per
+                # trace build; raises before anything compiles.
+                from ..analysis.validate import validate_traced
+                validate_traced(program, block_idx,
+                                traced.updated_names,
+                                traced.donated_names, fetch_names)
             if use_program_cache:
                 self._cache[key] = traced
             if obs is not None:

@@ -102,6 +102,13 @@ _define("validate_program", False, True,
         "before execution and raise EnforceNotMet on error-severity "
         "findings; cached per program fingerprint so steady-state "
         "training pays the cost once")
+_define("validate_tier", 1, True,
+        "validation depth when FLAGS_validate_program is on: tier 1 "
+        "analyzes the program at the executor boundary with statically "
+        "inferred feed/update sets; tier 2 additionally re-verifies "
+        "each traced step inside the engine against the ground-truth "
+        "updated/donated sets the trace discovered (island races, "
+        "donation hazards) before it compiles — docs/STATIC_ANALYSIS.md")
 # fully-async communicator knobs (reference communicator.cc:29-41)
 _define("communicator_independent_recv_thread", True, True,
         "pull params on an independent thread (reference "

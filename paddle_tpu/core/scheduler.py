@@ -68,7 +68,8 @@ from .registry import _RngCtx
 
 __all__ = ["build_scheduled_step", "partition_block", "last_read_table",
            "op_reads", "op_writes", "Island", "ScheduledStep",
-           "PipelinedAccumStep"]
+           "PipelinedAccumStep", "PartitionInfo", "partition_metadata",
+           "static_updated_names"]
 
 # dispatch lanes: submitting a jitted call is host work (arg flattening
 # + runtime enqueue), so a handful of threads is enough to keep the
@@ -261,6 +262,106 @@ def partition_block(ops, fetch_names: Sequence[str],
                 external.update(other.in_names)
         isl.out_names = sorted(isl.writes & external)
     return phases
+
+
+# ---------------------------------------------------------------------------
+# analysis-facing partition metadata (paddle_tpu/analysis/races.py,
+# memplan.py, cost_model.py) — the verifier reasons about the SAME
+# partition the dispatcher would run, instead of re-deriving its own
+# approximation of the phase-cut union-find
+# ---------------------------------------------------------------------------
+
+class PartitionInfo:
+    """The scheduler's partition decision, packaged for the static
+    analyzer: the phases-of-islands (each with its dataflow
+    interface), the ops they index into, and — when the block cannot
+    be scheduled — the reason, so a pass can distinguish "verified
+    conflict-free" from "never dispatched concurrently"."""
+
+    __slots__ = ("phases", "ops", "eligible", "reason", "cap",
+                 "block_idx", "updated_names", "fetch_names")
+
+    def __init__(self, phases, ops, eligible, reason, cap, block_idx,
+                 updated_names, fetch_names):
+        self.phases = phases          # List[List[Island]] ([] if inel.)
+        self.ops = ops                # the block's op list
+        self.eligible = eligible      # statically schedulable?
+        self.reason = reason          # "" when eligible
+        self.cap = cap                # same-phase island bound used
+        self.block_idx = block_idx
+        self.updated_names = list(updated_names)
+        self.fetch_names = list(fetch_names)
+
+    def islands(self):
+        """(global_island_idx, phase_idx, Island) in dispatch order —
+        the same global indices attribution/memory rows use."""
+        idx = 0
+        for pi, phase in enumerate(self.phases):
+            for isl in phase:
+                yield idx, pi, isl
+                idx += 1
+
+    def island_count(self) -> int:
+        return sum(len(p) for p in self.phases)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "eligible": self.eligible, "reason": self.reason,
+            "cap": self.cap, "block_idx": self.block_idx,
+            "phases": [
+                [{"ops": len(isl.indices), "in": list(isl.in_names),
+                  "out": list(isl.out_names)} for isl in phase]
+                for phase in self.phases],
+        }
+
+
+def static_updated_names(program, block_idx: int = 0) -> List[str]:
+    """Static approximation of the engine's traced ``updated_names``:
+    every persistable var the block writes (param updates, optimizer
+    state, BN running stats). The trace-time set can only be smaller
+    (an op may write a persistable a value identical to its input),
+    which errs conservative for hazard analysis."""
+    block = program.block(block_idx)
+    out: List[str] = []
+    seen: set = set()
+    for op in block.ops:
+        for n in op_writes(op):
+            if n in seen:
+                continue
+            seen.add(n)
+            v = block._find_var_recursive(n)
+            if v is not None and getattr(v, "persistable", False):
+                out.append(n)
+    return out
+
+
+def partition_metadata(program, block_idx: int = 0,
+                       fetch_names: Sequence[str] = (),
+                       updated_names: Optional[Sequence[str]] = None,
+                       cap: Optional[int] = None) -> PartitionInfo:
+    """Compute the partition the op scheduler WOULD dispatch for this
+    block, without building executables. ``updated_names=None`` infers
+    the static persistable-write set (the engine passes its traced set
+    at validation tier 2). Mirrors ``build_scheduled_step``'s static
+    eligibility gates; runtime-only gates (mesh, accumulation,
+    LoD feeds, integrity sentinel) are the caller's to apply."""
+    block = program.block(block_idx)
+    ops = list(block.ops)
+    if updated_names is None:
+        updated_names = static_updated_names(program, block_idx)
+    if cap is None:
+        cap = lanes()
+    if any(_has_sub_block(op) for op in ops):
+        return PartitionInfo([], ops, False, "control-flow sub-block",
+                             cap, block_idx, updated_names, fetch_names)
+    phases = partition_block(ops, fetch_names, updated_names, cap=cap)
+    n = sum(len(p) for p in phases)
+    if n <= 1:
+        return PartitionInfo(phases, ops, False,
+                             "single island (whole-block jit)",
+                             cap, block_idx, updated_names, fetch_names)
+    return PartitionInfo(phases, ops, True, "", cap, block_idx,
+                         updated_names, fetch_names)
 
 
 def _has_sub_block(op) -> bool:

@@ -53,7 +53,7 @@ from . import tracing as _tracing
 __all__ = ["attribute", "measure_device_time", "mfu_estimate",
            "island_rows", "island_memory_rows", "program_ops",
            "hlo_text", "request_deep_profile", "deep_profile_tick",
-           "deep_profile_active"]
+           "deep_profile_active", "cost_calibration"]
 
 # dense bf16 matmul peak TFLOP/s per chip (public spec sheets; same
 # table bench.py uses for its analytic MFU line — longest prefix wins)
@@ -224,6 +224,49 @@ def _island_memory_rows(sched) -> List[Dict]:
                 pass  # one un-lowerable island must not kill the rest
             idx += 1
     return rows
+
+
+def cost_calibration(engine, program, device_ms_total: Optional[float] = None,
+                     dynamic_dim: int = 1,
+                     compiled_stats: Optional[Dict] = None) -> Dict:
+    """Static-vs-measured cost comparison on the shared island index:
+    the analysis cost model's per-island FLOP shares against the
+    measured per-island device-time shares (``island_rows``), plus the
+    whole-program static FLOP count against XLA's own
+    ``compiled_stats`` figure. The Pearson correlation is the headline
+    calibration number — it says whether the static model *ranks*
+    islands the way the hardware does, which is all the placement
+    search needs from it."""
+    from ..analysis import cost_model
+    out: Dict = {}
+    try:
+        cost = cost_model.program_cost(program, dynamic_dim=dynamic_dim)
+        static_rows = cost_model.island_cost_rows(program, cost)
+        out["static_total_flops"] = cost.total_flops
+        out["static_total_bytes"] = cost.total_bytes
+    except Exception as exc:
+        return {"error": f"{type(exc).__name__}: {exc}"}
+    measured = island_rows(engine, device_ms_total=device_ms_total)
+    by_idx = {r["island"]: r for r in measured
+              if r.get("island") is not None}
+    xs, ys = [], []
+    for r in static_rows:
+        m = by_idx.get(r["island"])
+        if m is None:
+            continue
+        t = m.get("device_ms_est", m.get("host_ms"))
+        if t is None:
+            continue
+        xs.append(float(r["flops"]))
+        ys.append(float(t))
+    out["islands_matched"] = len(xs)
+    out["flop_time_correlation"] = cost_model.correlation(xs, ys)
+    if compiled_stats:
+        xla = float(compiled_stats.get("flops") or 0.0)
+        if xla > 0:
+            out["xla_flops"] = xla
+            out["flops_ratio"] = cost.total_flops / xla
+    return out
 
 
 # ---------------------------------------------------------------------------

