@@ -481,6 +481,61 @@ def _probe_memory(eng, prog, scope, feed, fetch, sync_ms):
     return out
 
 
+def _probe_parallelism(eng, prog, scope, feed, fetch, sync_ms):
+    """Multi-axis placement-search probe (docs/PARALLELISM.md) on the
+    already-built transformer: run the cost-driven placement search
+    (purely static — nothing executes), report the chosen mesh +
+    reduction strategy, the per-axis collective-bytes breakdown, the
+    search wall time, and the static-vs-measured step-cost ratio (the
+    measured headline step calibrates the cost model). Then prove the
+    persistence loop: a second plan_for_program on the same program
+    must replay from the tuning cache with ZERO search trials. A
+    throwaway cache dir is used unless PT_TUNING_CACHE_DIR is set."""
+    import shutil
+    import tempfile
+    out = {"sync_ms": round(sync_ms, 2)}
+    own_cache = None
+    if not os.environ.get("PT_TUNING_CACHE_DIR"):
+        own_cache = tempfile.mkdtemp(prefix="pt_place_bench_")
+        os.environ["PT_TUNING_CACHE_DIR"] = own_cache
+    try:
+        import jax
+        from paddle_tpu.analysis import placement
+        # search an 8-way mesh even on smaller hosts: the plan is
+        # static, and 8 is the smallest count where data/fsdp/tp all
+        # have room to trade off
+        n = max(8, len(jax.devices()))
+        t0 = time.perf_counter()
+        plan = placement.plan_for_program(
+            prog, n_devices=n, measured={"step_ms": sync_ms})
+        search_ms = (time.perf_counter() - t0) * 1e3
+        out.update({
+            "n_devices": n,
+            "mesh": plan.spec.to_dict(),
+            "reduction": plan.reduction,
+            "multi_axis": plan.multi_axis,
+            "predicted_ms": round(plan.predicted_ms, 3),
+            "baseline_data_parallel_ms": round(plan.baseline_ms, 3),
+            "per_axis_collective_bytes": dict(plan.per_axis_bytes),
+            "hbm_bytes": plan.hbm_bytes,
+            "placement_search_ms": round(search_ms, 2),
+            # uncalibrated pure-data prediction over the measured
+            # step: how honest the static cost model is on this host
+            "static_vs_measured_ratio": round(
+                1.0 / plan.calibration, 4) if plan.calibration > 0
+            else None})
+        plan2 = placement.plan_for_program(prog, n_devices=n)
+        out["cache_hit_second_run"] = bool(plan2.cached and
+                                           plan2.trials == 0)
+    except Exception as exc:   # accounting only; never fail the bench
+        out["error"] = f"{type(exc).__name__}: {exc}"[:200]
+    finally:
+        if own_cache:
+            os.environ.pop("PT_TUNING_CACHE_DIR", None)
+            shutil.rmtree(own_cache, ignore_errors=True)
+    return out
+
+
 def _probe_analysis(eng, prog, scope, feed, fetch, stats, batch):
     """Program-verifier calibration probe (docs/STATIC_ANALYSIS.md) on
     the already-built transformer: the liveness-based static HBM plan
@@ -601,6 +656,10 @@ def bench_transformer(batch=BATCH, seq=None, measure_ckpt=False):
             # JSON tail (docs/STATIC_ANALYSIS.md)
             stats["analysis"] = _probe_analysis(
                 eng, main_prog, scope, feed, [cost.name], stats, batch)
+            # cost-driven multi-axis placement search for the
+            # parallelism JSON tail (docs/PARALLELISM.md)
+            stats["parallelism"] = _probe_parallelism(
+                eng, main_prog, scope, feed, [cost.name], sync_ms)
     return sps * batch * s_trg, sps, traj, sync_ms, stats
 
 

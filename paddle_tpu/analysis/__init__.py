@@ -22,6 +22,9 @@ from .memplan import MemoryPlan, plan_memory, reconcile
 from .cost_model import (OpCost, ProgramCost, program_cost,
                          island_cost_rows, correlation)
 from . import cost_model as cost
+from .placement import (PlacementPlan, plan_for_program,
+                        search_placement, strategy_for_plan)
+from . import placement
 
 __all__ = [
     "Diagnostic", "Severity", "format_report", "has_errors",
@@ -36,4 +39,6 @@ __all__ = [
     "MemoryPlan", "plan_memory", "reconcile",
     "OpCost", "ProgramCost", "program_cost", "island_cost_rows",
     "correlation", "cost",
+    "PlacementPlan", "plan_for_program", "search_placement",
+    "strategy_for_plan", "placement",
 ]

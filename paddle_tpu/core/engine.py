@@ -408,6 +408,23 @@ def _loop_fallback(fn, iterations):
     return looped
 
 
+def _activation_scope(mesh, strategy):
+    """Trace-time activation-sharding scope (parallel/strategy.py):
+    the tp-sharded matmul/attention lowerings in ops/ consult it while
+    the step body traces. Only live for multi-axis (fsdp/tp) meshes or
+    explicit activation rules, so the long-standing dp path traces
+    byte-identically."""
+    import contextlib
+    if mesh is None or strategy is None:
+        return contextlib.nullcontext()
+    rules = getattr(strategy, "activation_rules", None)
+    multi = any(a in getattr(mesh, "shape", {}) for a in ("fsdp", "tp"))
+    if not multi and (rules is None or len(rules) == 0):
+        return contextlib.nullcontext()
+    from ..parallel.strategy import activation_sharding_scope
+    return activation_sharding_scope(mesh, strategy)
+
+
 def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
                feed_lods: Dict[str, list], fetch_names: Sequence[str],
                scope: Scope, mesh=None, data_axis: str = "dp",
@@ -626,7 +643,7 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
     check_nan = bool(FLAGS.check_nan_inf)
     nan_labels_box: List[Tuple[str, str]] = []
 
-    def step(params, feeds, key):
+    def _step_body(params, feeds, key):
         lod_env = {k: [list(l) for l in v] for k, v in feed_lods.items()}
         rng_ctx = _Rng(key)
         if check_nan:
@@ -671,6 +688,14 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
                     f"fetch target {n!r} was not produced by the program")
             fetches.append(env[n])
         return tuple(fetches), {n: env[n] for n in updated}, nan_flags
+
+    def step(params, feeds, key):
+        # the activation scope must be LIVE while the body traces (the
+        # ops/ lowerings consult it at lowering time, which happens on
+        # the jitted function's first dispatch) — so it enters inside
+        # the traced function, not around the jit call
+        with _activation_scope(mesh, strategy):
+            return _step_body(params, feeds, key)
 
     # --- phase 1: abstract trace to discover updated persistables ---------
     params_sig = {}
@@ -1021,7 +1046,11 @@ class Engine:
             # paddle_tpu/tuning, docs/TUNING.md): searches run, trials
             # measured, winners replayed from the on-disk cache
             "tuning_searches": 0, "tuning_trials": 0,
-            "tuning_cache_hits": 0})
+            "tuning_cache_hits": 0,
+            # automatic SPMD placement (PT_PLACEMENT_AUTO,
+            # analysis/placement.py, docs/PARALLELISM.md): cost-model
+            # searches run vs plans replayed from the tuning cache
+            "placement_searches": 0, "placement_cache_hits": 0})
         _obs.register_engine(self)
         # lazily built per-engine stability controller
         # (FLAGS_stability_guard; paddle_tpu/stability/guard.py)
@@ -1032,6 +1061,10 @@ class Engine:
         # program fingerprints already autotuned this process
         # (FLAGS_autotune; paddle_tpu/tuning/driver.py)
         self._tuned = set()
+        # automatic placement runs once per engine (PT_PLACEMENT_AUTO;
+        # analysis/placement.py) and only when the caller passed no
+        # mesh/strategy of their own
+        self._placed = False
         # feed names that are identical on every process under multihost
         # SPMD (shared tables, per-step constants) — globalized by
         # replication instead of batch-dim concatenation
@@ -1190,7 +1223,15 @@ class Engine:
                 # flash-attention A/B dispatch overrides pick the kernel
                 # at trace time (tools/lint_flags.py found these unkeyed)
                 os.environ.get("PT_FORCE_KERNEL", ""),
-                os.environ.get("PT_FORCE_COMPOSED", ""))
+                os.environ.get("PT_FORCE_COMPOSED", ""),
+                # multi-axis SPMD placement (analysis/placement.py):
+                # the chosen mesh layout changes the traced shardings,
+                # and the pins/budget steer which layout is chosen
+                os.environ.get("PT_PLACEMENT_AUTO", ""),
+                os.environ.get("PT_PLACEMENT_BUDGET", ""),
+                os.environ.get("PT_MESH_AXES", ""),
+                os.environ.get("PT_MESH_FSDP", ""),
+                os.environ.get("PT_MESH_TP", ""))
 
     @staticmethod
     def _cache_key(program, block_idx, feed_sig_key, fetch_names,
@@ -1417,6 +1458,36 @@ class Engine:
             import warnings
             warnings.warn(f"autotune skipped: {exc!r}")
 
+    def _maybe_place(self, program, fetch_names) -> None:
+        """PT_PLACEMENT_AUTO: once per engine, pick the multi-axis
+        mesh layout for this program — cache hit replays the stored
+        PlacementPlan with zero search trials, a miss runs the static
+        cost-model search (analysis/placement.py). Degrades to the
+        un-meshed path on any failure, never breaks the step."""
+        import jax as _jax
+        if not fetch_names:
+            # init/startup programs run once; placing them is pure
+            # waste. Not marked placed: the training program that
+            # follows still gets its layout.
+            return
+        self._placed = True
+        if len(_jax.devices()) < 2:
+            return
+        try:
+            from ..analysis import placement as _placement
+            plan = _placement.plan_for_program(program)
+            self.counters["placement_cache_hits" if plan.cached
+                          else "placement_searches"] += 1
+            strategy = _placement.strategy_for_plan(plan)
+            if strategy is None:
+                return
+            self.strategy = strategy
+            self.mesh = strategy.mesh
+            self.data_axis = strategy.data_axis
+        except Exception as exc:  # degrade, don't break training
+            import warnings
+            warnings.warn(f"automatic placement skipped: {exc!r}")
+
     def run(self, program, scope: Scope, place, feed, fetch_names,
             block_idx: int = 0,
             return_numpy: bool = True,
@@ -1428,6 +1499,13 @@ class Engine:
             # so the winner must be live before the first trace
             self._maybe_autotune(program, scope, place, feed,
                                  fetch_names)
+        if self.mesh is None and self.strategy is None and \
+                not self._placed and \
+                os.environ.get("PT_PLACEMENT_AUTO", ""):
+            # cost-driven automatic SPMD placement: resolve (or replay
+            # from the tuning cache) the mesh layout before the first
+            # trace — a caller-supplied mesh/strategy always wins
+            self._maybe_place(program, fetch_names)
         self.counters["runs"] += 1
         plan = _fault_plan()
         if plan is not None:

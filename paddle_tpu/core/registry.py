@@ -422,3 +422,33 @@ def _make_generic_grad_lowering(fwd_type: str):
     grad_lowering.__name__ = f"{fwd_type}_grad_lowering"
     grad_lowering._generic_vjp_of = fwd_type
     return grad_lowering
+
+
+# ---------------------------------------------------------------------------
+# trace-time activation sharding hook (multi-axis SPMD; ops/ lowerings)
+# ---------------------------------------------------------------------------
+
+def shard_hint(ctx: ExecContext, slot: str, value,
+               weight_slot: Optional[str] = None):
+    """Pin an op's ``slot`` output with the engine's activation-scope
+    sharding constraint (parallel/strategy.py), identity when no scope
+    is live. With ``weight_slot``, the constraint is the Megatron
+    dispatch derived from that weight's PartitionSpec (column-split
+    keeps tp on the output, row-split pins the all-reduce point);
+    otherwise it is the name-based/batch-dim pin. The strategy module
+    is consulted only if already imported — no import cycle, zero cost
+    on the single-device path."""
+    import sys
+    strat_mod = sys.modules.get("paddle_tpu.parallel.strategy")
+    if strat_mod is None or strat_mod.activation_scope() is None:
+        return value
+    out_names = ctx.op.output(slot)
+    out_name = out_names[0] if out_names else ""
+    if weight_slot:
+        w_names = ctx.op.input(weight_slot)
+        w_name = w_names[0] if w_names else None
+        w = ctx.env.get(w_name) if w_name and \
+            hasattr(ctx.env, "get") else None
+        return strat_mod.constrain_matmul(
+            out_name, w_name, getattr(w, "shape", None), value)
+    return strat_mod.constrain_activation(out_name, value)

@@ -509,6 +509,22 @@ def _install_standard_families(reg: MetricsRegistry) -> None:
     reg.histogram("pt_tuning_trial_seconds",
                   "wall time of one search trial, including the trace "
                   "+ compile a trace-affecting candidate pays")
+    # SPMD placement search (analysis/placement.py, docs/PARALLELISM.md)
+    reg.counter("pt_placement_searches_total",
+                "placement searches run to completion (one per program "
+                "that missed the placement plan cache)")
+    reg.counter("pt_placement_cache_hits_total",
+                "programs whose placement plan was replayed from the "
+                "tuning cache (zero search trials)")
+    reg.gauge("pt_placement_search_seconds",
+              "wall time of the last placement search (candidate "
+              "enumeration + static scoring)")
+    reg.gauge("pt_placement_predicted_ms",
+              "static cost-model predicted step ms of the chosen "
+              "placement plan")
+    reg.gauge("pt_placement_collective_bytes",
+              "predicted per-device collective bytes per step of the "
+              "chosen plan, labeled {axis} (data / fsdp / tp)")
     # HBM memory observatory (observability/memory.py, docs/MEMORY.md)
     reg.gauge("pt_hbm_owner_bytes",
               "owner-attributed live HBM bytes from the buffer census, "

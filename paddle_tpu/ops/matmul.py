@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..core.registry import register_op
+from ..core.registry import register_op, shard_hint
 from ..core.amp import amp_cast
 
 
@@ -55,7 +55,11 @@ def mul(ctx):
             x2, y2,
             preferred_element_type=_acc_type(x2, y2) or res_t)
         out = out.astype(res_t)
-    ctx.set_output("Out", out.reshape(out_shape))
+    out = out.reshape(out_shape)
+    # tp-sharded matmul: under an active multi-axis activation scope
+    # the output is pinned per Y's PartitionSpec (Megatron dispatch)
+    out = shard_hint(ctx, "Out", out, weight_slot="Y")
+    ctx.set_output("Out", out)
 
 
 @register_op("matmul")
@@ -88,6 +92,7 @@ def matmul(ctx):
         out = out.astype(res_t)
         if alpha != 1.0:
             out = out * alpha
+    out = shard_hint(ctx, "Out", out, weight_slot="Y")
     ctx.set_output("Out", out)
 
 

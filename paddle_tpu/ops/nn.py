@@ -16,13 +16,15 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core.registry import (register_op, register_no_grad_op,
-                             override_grad_lowering)
+                             override_grad_lowering, shard_hint)
 
 
 @register_op("softmax")
 def softmax(ctx):
     x = ctx.input("X")
-    ctx.set_output("Out", jax.nn.softmax(x, axis=-1))
+    out = jax.nn.softmax(x, axis=-1)
+    # attention probabilities stay batch-sharded under a multi-axis mesh
+    ctx.set_output("Out", shard_hint(ctx, "Out", out))
 
 
 @register_op("log_softmax")

@@ -5,14 +5,18 @@ Parity targets (SURVEY.md §2.2-2.3): ParallelExecutor -> DataParallelEngine
 XLA collectives), transpiler/fleet APIs -> paddle_tpu.parallel.fleet /
 transpiler.
 """
-from .mesh import CommContext, get_mesh, set_mesh, make_mesh  # noqa: F401
+from .mesh import (  # noqa: F401
+    CommContext, MeshSpec, get_mesh, set_mesh, make_mesh,
+)
 from .data_parallel import DataParallelEngine  # noqa: F401
 from .strategy import (  # noqa: F401
-    DistributedStrategy, ShardingRules, P,
+    DistributedStrategy, ShardingRules, SpecLayout, P,
+    activation_sharding_scope, mesh_layout_rules, sharding_tree,
     transformer_rules, transformer_feed_rules, ctr_rules,
 )
 from .comm_scheduler import (  # noqa: F401
     CommScheduler, GradBucket, plan_program_buckets,
+    update_shard_axes,
 )
 from .pipeline import PipelineEngine  # noqa: F401
 from .mpmd_pipeline import MPMDPipelineEngine  # noqa: F401

@@ -65,7 +65,7 @@ __all__ = [
     "plan_program_buckets", "grad_production_order", "plan_stats",
     "bucket_bytes_from_flags", "quantize_mode_from_flags",
     "should_quantize", "emulate_quantized", "fused_axis_psum",
-    "fused_stacked_sum", "sharded_update_spec",
+    "fused_stacked_sum", "sharded_update_spec", "update_shard_axes",
     "static_collective_stats", "MIN_QUANT_BYTES",
 ]
 
@@ -319,22 +319,42 @@ def fused_stacked_sum(stacked, mode: str = ""):
 # sharded weight update (FLAGS_sharded_weight_update)
 # ---------------------------------------------------------------------------
 
-_ZERO_RULES_CACHE: Dict[str, Any] = {}
+_ZERO_RULES_CACHE: Dict[Tuple[str, ...], Any] = {}
+
+
+def update_shard_axes(mesh, data_axis: str) -> Tuple[str, ...]:
+    """Every DATA-parallel mesh axis the sharded weight update may
+    shard optimizer state over: the engine's data axis plus the named
+    multi-axis mesh's "fsdp" axis when present (fsdp IS data
+    parallelism with sharded storage, so state shards over the JOINT
+    extent). Axes absent from the mesh, or of size 1, drop out —
+    on the long-standing single-axis dp mesh this returns exactly
+    ("dp",), keeping the ZeRO-1 path byte-identical."""
+    shape = getattr(mesh, "shape", {}) or {}
+    out = []
+    for a in dict.fromkeys((data_axis, "fsdp")):
+        if a in shape and int(shape[a]) > 1:
+            out.append(a)
+    return tuple(out)
 
 
 def sharded_update_spec(name: str, shape, mesh, data_axis: str):
     """PartitionSpec for `name` under the cross-replica sharded weight
     update: optimizer accumulators and AMP master weights shard dim 0
-    over the data axis (zero_optimizer_rules, ZeRO-1); params and
-    everything else stay with the caller's default (None). Specs that
-    don't divide legalize back to replicated inside spec_for."""
+    over the data-parallel axes (zero_optimizer_rules, ZeRO-1 —
+    generalized to the JOINT (data, fsdp) extent on a multi-axis
+    mesh); params and everything else stay with the caller's default
+    (None). Specs that don't divide legalize back to replicated
+    inside spec_for."""
     from .strategy import zero_optimizer_rules
-    rules = _ZERO_RULES_CACHE.get(data_axis)
-    if rules is None:
-        rules = zero_optimizer_rules(dp_axis=data_axis)
-        _ZERO_RULES_CACHE[data_axis] = rules
-    if data_axis not in getattr(mesh, "shape", {}):
+    axes = update_shard_axes(mesh, data_axis)
+    if not axes:
         return None
+    rules = _ZERO_RULES_CACHE.get(axes)
+    if rules is None:
+        rules = zero_optimizer_rules(
+            dp_axis=axes[0] if len(axes) == 1 else axes)
+        _ZERO_RULES_CACHE[axes] = rules
     return rules.spec_for(name, shape, mesh)
 
 

@@ -13,6 +13,7 @@ gen_nccl_id TCP bootstrap is replaced by PJRT coordination service).
 from __future__ import annotations
 
 import os
+import warnings
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -21,7 +22,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = ["CommContext", "get_mesh", "set_mesh", "make_mesh",
-           "init_distributed_env"]
+           "init_distributed_env", "MeshSpec"]
 
 _current_mesh: List[Optional[Mesh]] = [None]
 
@@ -29,7 +30,14 @@ _current_mesh: List[Optional[Mesh]] = [None]
 def make_mesh(axis_shapes: Dict[str, int] = None,
               devices: Sequence = None) -> Mesh:
     """Build a named mesh. axis_shapes e.g. {"dp": 4, "mp": 2}; -1 on one
-    axis means 'rest of the devices'."""
+    axis means 'rest of the devices'.
+
+    The axis-shape product must DIVIDE the device count: a remainder is
+    always a typo (the stranded devices would silently idle), so it
+    raises. A product strictly smaller than the device count is legal
+    (an intentionally partial mesh, e.g. a pipeline stage's slice) but
+    warns, because every device past the product is left out of the
+    mesh."""
     devices = list(devices if devices is not None else jax.devices())
     if not axis_shapes:
         axis_shapes = {"dp": len(devices)}
@@ -37,10 +45,127 @@ def make_mesh(axis_shapes: Dict[str, int] = None,
     sizes = list(axis_shapes.values())
     if -1 in sizes:
         known = int(np.prod([s for s in sizes if s != -1]))
+        if known <= 0 or len(devices) % known:
+            raise ValueError(
+                f"make_mesh: axis shapes {axis_shapes} with -1 need the "
+                f"known product ({known}) to divide the device count "
+                f"({len(devices)})")
         sizes[sizes.index(-1)] = len(devices) // known
     n = int(np.prod(sizes))
+    if n <= 0:
+        raise ValueError(f"make_mesh: axis shapes {axis_shapes} have a "
+                         f"non-positive product")
+    if n > len(devices):
+        raise ValueError(
+            f"make_mesh: axis shapes {axis_shapes} need {n} devices "
+            f"but only {len(devices)} are available")
+    if len(devices) % n:
+        raise ValueError(
+            f"make_mesh: axis-shape product {n} does not divide the "
+            f"device count {len(devices)}; {len(devices) % n} device(s) "
+            f"would be silently stranded — fix the axis shapes or pass "
+            f"an explicit device slice")
+    if n < len(devices):
+        warnings.warn(
+            f"make_mesh: partial mesh — axis shapes {axis_shapes} use "
+            f"{n} of {len(devices)} devices; the rest are NOT in the "
+            f"mesh (pass devices=... explicitly to silence)",
+            stacklevel=2)
     grid = np.array(devices[:n]).reshape(sizes)
     return Mesh(grid, tuple(names))
+
+
+class MeshSpec:
+    """The named multi-axis mesh request: ``MeshSpec(data=4, fsdp=2,
+    tp=1)``. Axis vocabulary and semantics:
+
+    * ``data`` — pure data parallelism: batch sharded, params
+      replicated, grads all-reduced;
+    * ``fsdp`` — data parallelism with fully-sharded parameter storage:
+      batch sharded over it too, params/optimizer state shard dim 0,
+      XLA all-gathers each weight where used and reduce-scatters its
+      grad;
+    * ``tp`` — tensor (Megatron) parallelism: weight matrices split
+      column/row-wise, activations exchange over the axis.
+
+    ``build()`` materializes a ``jax.sharding.Mesh`` whose axis ORDER is
+    (data, fsdp, tp) — outer to inner, so tp lands on the
+    fastest-varying (nearest-neighbour ICI) device dimension. Axes of
+    size 1 are dropped from the mesh entirely, which keeps a
+    ``MeshSpec(data=N)`` mesh byte-identical in behaviour to the
+    long-standing single-axis data-parallel path. ``-1`` on exactly one
+    axis means "rest of the devices" (resolved by :func:`make_mesh`).
+    """
+
+    AXES = ("data", "fsdp", "tp")
+    __slots__ = ("data", "fsdp", "tp")
+
+    def __init__(self, data: int = 1, fsdp: int = 1, tp: int = 1):
+        self.data = int(data)
+        self.fsdp = int(fsdp)
+        self.tp = int(tp)
+        for name in self.AXES:
+            v = getattr(self, name)
+            if v == 0 or v < -1:
+                raise ValueError(
+                    f"MeshSpec axis {name}={v}; sizes must be >= 1 "
+                    f"(or -1 on one axis for 'rest of the devices')")
+        if [getattr(self, a) for a in self.AXES].count(-1) > 1:
+            raise ValueError("MeshSpec: at most one axis may be -1")
+
+    @property
+    def size(self) -> int:
+        return self.data * self.fsdp * self.tp
+
+    def axis_shapes(self) -> Dict[str, int]:
+        """Ordered {axis: size} with size-1 axes dropped (a trivial
+        axis in the mesh would change nothing but the spec names)."""
+        return {a: getattr(self, a) for a in self.AXES
+                if getattr(self, a) != 1}
+
+    def build(self, devices: Sequence = None) -> Optional[Mesh]:
+        """The jax Mesh, or None when every axis is trivial (single
+        device — no mesh, the engine's plain jit path)."""
+        shapes = self.axis_shapes()
+        if not shapes:
+            return None
+        return make_mesh(shapes, devices=devices)
+
+    def to_dict(self) -> Dict[str, int]:
+        return {a: getattr(self, a) for a in self.AXES}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, int]) -> "MeshSpec":
+        return cls(**{a: int(d.get(a, 1)) for a in cls.AXES})
+
+    @classmethod
+    def from_string(cls, s: str) -> "MeshSpec":
+        """Parse the PT_MESH_AXES form: ``"data=4,fsdp=2,tp=1"``."""
+        out = {}
+        for part in (s or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            name, _, val = part.partition("=")
+            name = name.strip()
+            if name not in cls.AXES:
+                raise ValueError(
+                    f"PT_MESH_AXES names unknown axis {name!r}; the "
+                    f"vocabulary is {'/'.join(cls.AXES)}")
+            out[name] = int(val)
+        return cls(**out)
+
+    def __repr__(self):
+        return (f"MeshSpec(data={self.data}, fsdp={self.fsdp}, "
+                f"tp={self.tp})")
+
+    def __eq__(self, other):
+        return isinstance(other, MeshSpec) and \
+            all(getattr(self, a) == getattr(other, a)
+                for a in self.AXES)
+
+    def __hash__(self):
+        return hash(tuple(getattr(self, a) for a in self.AXES))
 
 
 def get_mesh() -> Optional[Mesh]:

@@ -19,6 +19,7 @@ axes) for free.
 """
 from __future__ import annotations
 
+import contextlib
 import re
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -27,11 +28,17 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .mesh import make_mesh
+from .mesh import make_mesh, MeshSpec
 
-__all__ = ["ShardingRules", "DistributedStrategy", "P",
+__all__ = ["ShardingRules", "DistributedStrategy", "P", "SpecLayout",
            "transformer_rules", "ctr_rules", "zero_optimizer_rules",
-           "fsdp_rules"]
+           "fsdp_rules", "mesh_layout_rules", "sharding_tree",
+           "activation_sharding_scope", "activation_scope",
+           "constrain_activation", "KNOWN_AXES"]
+
+# every axis name a rule set may mention: the long-standing dp/mp/sp/
+# pp/ep vocabulary plus the named multi-axis mesh (MeshSpec) axes
+KNOWN_AXES = ("dp", "mp", "sp", "pp", "ep", "data", "fsdp", "tp")
 
 
 class ShardingRules:
@@ -45,6 +52,9 @@ class ShardingRules:
         self._rules.append((re.compile(pattern), spec))
         return self
 
+    def __len__(self) -> int:
+        return len(self._rules)
+
     def spec_for(self, name: str, shape: Sequence[int],
                  mesh: Mesh) -> Optional[P]:
         """Resolve a spec; returns None (caller default) if no rule hits or
@@ -53,6 +63,16 @@ class ShardingRules:
             if pat.search(name):
                 return _legalize(spec, shape, mesh)
         return None
+
+    def matching_specs(self, name: str) -> List[P]:
+        """Every DISTINCT raw spec whose pattern matches ``name`` —
+        first-match-wins hides rule-set ambiguity; lint_program's
+        --check-placement flags names where two rules disagree."""
+        out: List[P] = []
+        for pat, spec in self._rules:
+            if pat.search(name) and spec not in out:
+                out.append(spec)
+        return out
 
 
 def _legalize(spec: Optional[P], shape, mesh: Mesh) -> Optional[P]:
@@ -76,13 +96,12 @@ def _legalize(spec: Optional[P], shape, mesh: Mesh) -> Optional[P]:
             # a KNOWN axis this mesh simply doesn't define (dp-only
             # mesh with the standard mp/sp rule set) -> replicate;
             # an unknown name is a rule typo -> loud error
-            bad = [a for a in missing
-                   if a not in ("dp", "mp", "sp", "pp", "ep")]
+            bad = [a for a in missing if a not in KNOWN_AXES]
             if bad:
                 raise ValueError(
                     f"sharding rule names unknown mesh axis {bad}; "
                     f"mesh has {sorted(mesh.shape)} and the known "
-                    "vocabulary is dp/mp/sp/pp/ep")
+                    f"vocabulary is {'/'.join(KNOWN_AXES)}")
             out.append(None)
             continue
         n = int(np.prod([mesh.shape[a] for a in axes]))
@@ -101,15 +120,38 @@ _ACC_RE = re.compile(r"^(?P<param>.+\.[wb]_\d+)_[A-Za-z0-9_]+_\d+$")
 class DistributedStrategy:
     """Mesh + rules + feed layout: everything the engine needs to compile a
     program SPMD. Axis names: "dp" (data), "mp" (tensor/model), "sp"
-    (sequence), "pp" (pipeline, handled by PipelineOptimizer)."""
+    (sequence), "pp" (pipeline, handled by PipelineOptimizer) — plus the
+    named multi-axis mesh vocabulary "data"/"fsdp"/"tp" (MeshSpec /
+    SpecLayout, docs/PARALLELISM.md)."""
 
     def __init__(self, axes: Dict[str, int] = None, rules: ShardingRules
-                 = None, devices=None, feed_rules: ShardingRules = None):
+                 = None, devices=None, feed_rules: ShardingRules = None,
+                 activation_rules: ShardingRules = None):
         self.mesh = make_mesh(axes or {"dp": -1}, devices=devices)
         self.rules = rules or ShardingRules()
         self.feed_rules = feed_rules or ShardingRules()
-        self.data_axis = "dp" if "dp" in self.mesh.axis_names else \
-            self.mesh.axis_names[0]
+        # matched against op OUTPUT names at trace time: the engine pins
+        # matching activations with with_sharding_constraint (tp-sharded
+        # matmul/attention lowerings consult the scope in ops/)
+        self.activation_rules = activation_rules or ShardingRules()
+        names = self.mesh.axis_names
+        self.data_axis = next((a for a in ("dp", "data") if a in names),
+                              names[0])
+
+    @classmethod
+    def from_mesh_spec(cls, spec: MeshSpec,
+                       layout: "SpecLayout" = None,
+                       devices=None) -> "DistributedStrategy":
+        """Strategy for a named data/fsdp/tp mesh: the SpecLayout table
+        (default layout when None) supplies param + feed + activation
+        rules sized to the axes the spec actually has."""
+        if layout is None:
+            layout = SpecLayout(fsdp=spec.fsdp != 1, tp=spec.tp != 1)
+        shapes = spec.axis_shapes() or {"data": 1}
+        return cls(axes=shapes, rules=layout.param_rules(spec),
+                   feed_rules=layout.feed_rules(spec),
+                   activation_rules=layout.activation_rules(spec),
+                   devices=devices)
 
     def param_spec(self, name: str, shape) -> Optional[P]:
         spec = self.rules.spec_for(name, shape, self.mesh)
@@ -194,6 +236,249 @@ def fsdp_rules(dp_axis="dp") -> ShardingRules:
         (r"\.(w|b)_\d+$", P(dp_axis)),
         (r"\.master$", P(dp_axis)),
     ])
+
+
+class SpecLayout:
+    """Per-parameter PartitionSpec layout table over the named
+    data/fsdp/tp mesh (MeshSpec): the single place that decides, per
+    parameter CLASS, which mesh axes each tensor dimension shards over
+    — qkv/ffn-in weights column-split over tp with fsdp storage
+    sharding on the input dim, out-proj/ffn-out row-split, embeddings
+    vocab-split over the joint (fsdp, tp) extent, everything else
+    dim-0 over fsdp. ``param_rules``/``feed_rules``/``activation_rules``
+    compile the table into ShardingRules sized to the axes a MeshSpec
+    actually has (a size-1 axis is never mentioned, so a data-only
+    layout degenerates EXACTLY to the long-standing data-parallel
+    path — the bit-identity contract tests/test_mesh_spmd.py pins).
+    """
+
+    __slots__ = ("data_axis", "fsdp_axis", "tp_axis", "fsdp", "tp",
+                 "extra_param_rules", "extra_activation_rules")
+
+    def __init__(self, data_axis: str = "data", fsdp_axis: str = "fsdp",
+                 tp_axis: str = "tp", fsdp: bool = True, tp: bool = True,
+                 extra_param_rules: Sequence[Tuple[str, P]] = (),
+                 extra_activation_rules: Sequence[Tuple[str, P]] = ()):
+        self.data_axis = data_axis
+        self.fsdp_axis = fsdp_axis
+        self.tp_axis = tp_axis
+        self.fsdp = bool(fsdp)
+        self.tp = bool(tp)
+        self.extra_param_rules = tuple(extra_param_rules)
+        self.extra_activation_rules = tuple(extra_activation_rules)
+
+    # -- axis resolution against a concrete MeshSpec -------------------
+
+    def _axes(self, spec: MeshSpec) -> Tuple[Optional[str],
+                                             Optional[str],
+                                             Tuple[str, ...]]:
+        """(fsdp axis or None, tp axis or None, batch axes) actually
+        live for this MeshSpec — an axis the spec sizes at 1 does not
+        exist in the mesh and must not be named by any rule."""
+        fs = self.fsdp_axis if self.fsdp and spec.fsdp != 1 else None
+        tp = self.tp_axis if self.tp and spec.tp != 1 else None
+        batch = tuple(a for a, n in
+                      ((self.data_axis, spec.data), (fs, spec.fsdp))
+                      if a is not None and n != 1)
+        return fs, tp, batch
+
+    @staticmethod
+    def _entry(*axes):
+        """One PartitionSpec entry from live axis names: None when none
+        survive, the bare name for one, a tuple for a joint extent."""
+        live = tuple(a for a in axes if a)
+        if not live:
+            return None
+        return live[0] if len(live) == 1 else live
+
+    def param_rules(self, spec: MeshSpec) -> ShardingRules:
+        """The layout table, compiled for ``spec``. Transformer naming
+        (models/transformer.py) gets the Megatron split; the trailing
+        catch-alls give every remaining weight dim-0 fsdp storage
+        sharding (optimizer accumulators inherit via _ACC_RE)."""
+        fs, tp, _ = self._axes(spec)
+        if fs is None and tp is None:
+            return ShardingRules(self.extra_param_rules)
+        e = self._entry
+        rules: List[Tuple[str, Optional[P]]] = list(
+            self.extra_param_rules)
+        rules += [
+            # column-split: output dim over tp, input dim fsdp storage
+            (r"_(q|k|v)\.w_0$", P(e(fs), e(tp))),
+            (r"_fc1\.w_0$", P(e(fs), e(tp))),
+            (r"_(q|k|v)\.b_0$", P(e(tp))),
+            (r"_fc1\.b_0$", P(e(tp))),
+            # row-split: input dim over tp, output dim fsdp storage
+            (r"_o\.w_0$", P(e(tp), e(fs))),
+            (r"_fc2\.w_0$", P(e(tp), e(fs))),
+            (r"_o\.b_0$", P(e(fs))),
+            (r"_fc2\.b_0$", P(e(fs))),
+            # vocab rows over the joint (fsdp, tp) extent
+            (r"(src|trg)_word_emb\.w_0$", P(e(fs, tp), None)),
+            (r"trg_proj\.w_0$", P(e(fs), e(tp))),
+            (r"_ln\.(w|b)_0$", P(e(fs))),
+        ]
+        if fs is not None:
+            rules += [(r"\.(w|b)_\d+$", P(fs)),
+                      (r"\.master$", P(fs))]
+        return ShardingRules([(pat, s) for pat, s in rules])
+
+    def feed_rules(self, spec: MeshSpec) -> ShardingRules:
+        """Feeds batch-shard over EVERY data-parallel axis — data and
+        fsdp jointly (fsdp IS data parallelism with sharded storage).
+        Non-dividing or scalar feeds legalize back to replicated."""
+        _, _, batch = self._axes(spec)
+        if not batch:
+            return ShardingRules()
+        return ShardingRules([(r".*", P(self._entry(*batch)))])
+
+    def activation_rules(self, spec: MeshSpec) -> ShardingRules:
+        """Name-based overrides for the trace-time activation pins;
+        the default derivation (constrain_matmul) needs none."""
+        return ShardingRules(self.extra_activation_rules)
+
+    def to_dict(self) -> Dict[str, object]:
+        return {"data_axis": self.data_axis, "fsdp_axis": self.fsdp_axis,
+                "tp_axis": self.tp_axis, "fsdp": self.fsdp,
+                "tp": self.tp}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, object]) -> "SpecLayout":
+        return cls(data_axis=str(d.get("data_axis", "data")),
+                   fsdp_axis=str(d.get("fsdp_axis", "fsdp")),
+                   tp_axis=str(d.get("tp_axis", "tp")),
+                   fsdp=bool(d.get("fsdp", True)),
+                   tp=bool(d.get("tp", True)))
+
+
+def mesh_layout_rules(spec: MeshSpec,
+                      layout: SpecLayout = None) -> ShardingRules:
+    """Convenience: the compiled param rule set for a MeshSpec."""
+    return (layout or SpecLayout()).param_rules(spec)
+
+
+def sharding_tree(names_shapes, mesh: Mesh, rules: ShardingRules,
+                  default: P = P()) -> Dict[str, NamedSharding]:
+    """Sharding-tree helper: resolve every (name, shape) to a concrete
+    NamedSharding on ``mesh`` with the divisibility legalization
+    applied — what a pjit-style dispatcher passes as in_shardings."""
+    out: Dict[str, NamedSharding] = {}
+    for n, s in names_shapes:
+        spec = rules.spec_for(n, s, mesh)
+        out[n] = NamedSharding(mesh, spec if spec is not None
+                               else default)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# trace-time activation sharding scope (the engine installs it around
+# the traced step body; ops/matmul.py + ops/nn.py consult it)
+# ---------------------------------------------------------------------------
+
+_ACTIVATION_SCOPE: List[Optional[Tuple[Mesh, "DistributedStrategy"]]] \
+    = [None]
+
+
+@contextlib.contextmanager
+def activation_sharding_scope(mesh: Mesh, strategy: "DistributedStrategy"):
+    """While active, matmul/attention lowerings pin their outputs with
+    with_sharding_constraint per the strategy's layout (Megatron
+    dispatch derived from the WEIGHT's spec + optional name-based
+    activation_rules). Trace-time only; nesting restores the outer
+    scope."""
+    prev = _ACTIVATION_SCOPE[0]
+    _ACTIVATION_SCOPE[0] = (mesh, strategy)
+    try:
+        yield
+    finally:
+        _ACTIVATION_SCOPE[0] = prev
+
+
+def activation_scope() -> Optional[Tuple[Mesh, "DistributedStrategy"]]:
+    return _ACTIVATION_SCOPE[0]
+
+
+def _mesh_axis_prod(mesh: Mesh, axes) -> int:
+    n = 1
+    for a in axes:
+        n *= int(mesh.shape.get(a, 1))
+    return n
+
+
+def _batch_axes(mesh: Mesh, strategy) -> Tuple[str, ...]:
+    data = getattr(strategy, "data_axis", "data")
+    return tuple(a for a in dict.fromkeys((data, "fsdp"))
+                 if a in mesh.shape and int(mesh.shape[a]) > 1)
+
+
+def _pin(value, mesh: Mesh, spec: P):
+    try:
+        return jax.lax.with_sharding_constraint(
+            value, NamedSharding(mesh, spec))
+    except Exception:
+        return value  # abstract/incompatible context: identity
+
+
+def constrain_activation(name: str, value):
+    """Name-based activation pin: apply the scope strategy's
+    activation_rules to op output ``name``; no scope / no match /
+    unshardable value -> identity. Used by the attention-path
+    lowerings (softmax) to keep probabilities batch-sharded."""
+    ctx = _ACTIVATION_SCOPE[0]
+    if ctx is None:
+        return value
+    mesh, strat = ctx
+    shape = getattr(value, "shape", None)
+    if shape is None or len(shape) < 1:
+        return value
+    rules = getattr(strat, "activation_rules", None)
+    if rules is not None and len(rules) and name:
+        spec = rules.spec_for(name, shape, mesh)
+        if spec is not None:
+            return _pin(value, mesh, spec)
+    batch = _batch_axes(mesh, strat)
+    if batch and shape[0] % _mesh_axis_prod(mesh, batch) == 0:
+        return _pin(value, mesh,
+                    P(batch[0] if len(batch) == 1 else batch))
+    return value
+
+
+def constrain_matmul(out_name: str, weight_name: Optional[str],
+                     weight_shape, value):
+    """Megatron-style dispatch for a matmul output, derived from the
+    WEIGHT's spec in the layout table: a weight column-split over tp
+    (tp in its LAST spec entry) keeps tp on the output's last dim; a
+    row-split weight (tp on dim 0) pins the output tp-replicated —
+    which is exactly where XLA must materialize the partial-sum
+    all-reduce; no tp involvement pins only the batch dim. Name-based
+    activation_rules override the derivation."""
+    ctx = _ACTIVATION_SCOPE[0]
+    if ctx is None:
+        return value
+    mesh, strat = ctx
+    shape = getattr(value, "shape", None)
+    if shape is None or len(shape) < 1:
+        return value
+    rules = getattr(strat, "activation_rules", None)
+    if rules is not None and len(rules) and out_name:
+        spec = rules.spec_for(out_name, shape, mesh)
+        if spec is not None:
+            return _pin(value, mesh, spec)
+    parts: List[object] = [None] * len(shape)
+    batch = _batch_axes(mesh, strat)
+    if batch and shape[0] % _mesh_axis_prod(mesh, batch) == 0:
+        parts[0] = batch[0] if len(batch) == 1 else batch
+    tp_size = int(mesh.shape.get("tp", 1))
+    if tp_size > 1 and weight_name and weight_shape is not None:
+        wspec = strat.rules.spec_for(weight_name, weight_shape, mesh)
+        if wspec is not None and len(wspec):
+            last = wspec[len(wspec) - 1]
+            in_tp = (last == "tp" or
+                     (isinstance(last, tuple) and "tp" in last))
+            if (in_tp and len(shape) >= 2 and
+                    shape[-1] % tp_size == 0):
+                parts[-1] = "tp"
+    return _pin(value, mesh, P(*parts))
 
 
 def zero_optimizer_rules(dp_axis="dp",

@@ -179,6 +179,31 @@ _def("recompute", "env", "PT_RECOMPUTE", str, "", ("",),
      help="op types re-derived at the fwd/bwd boundary (core/engine.py "
           "_recompute_types); measured loss on ResNet (BASELINE r5) so "
           "not searched, but trace-affecting and key-audited")
+_def("mesh_axes", "env", "PT_MESH_AXES", str, "", ("",),
+     trace_affecting=True,
+     help="hand-pinned mesh layout 'data=4,fsdp=2,tp=1' — short-"
+          "circuits the placement search (analysis/placement.py); "
+          "single-candidate (an operator decision, not a search axis)")
+_def("mesh_fsdp", "env", "PT_MESH_FSDP", int, 0, (0,),
+     trace_affecting=True,
+     help="pin the fsdp axis size in the placement search (0 = free); "
+          "single-candidate — the search itself explores the axis, "
+          "this knob only constrains it (docs/PARALLELISM.md)")
+_def("mesh_tp", "env", "PT_MESH_TP", int, 0, (0,),
+     trace_affecting=True,
+     help="pin the tensor-parallel axis size in the placement search "
+          "(0 = free); single-candidate like mesh_fsdp")
+_def("placement_auto", "env", "PT_PLACEMENT_AUTO", bool, False,
+     (False,), trace_affecting=True,
+     help="arm cost-driven automatic SPMD placement: Engine.run "
+          "resolves (or replays from the tuning cache) a mesh layout "
+          "before the first trace (analysis/placement.py); the chosen "
+          "layout changes the traced shardings, so trace-affecting")
+_def("placement_budget", "env", "PT_PLACEMENT_BUDGET", int, 64, (64,),
+     trace_affecting=True,
+     help="candidate cap for the placement search (deterministic cut "
+          "after the sorted enumeration); a different budget can pick "
+          "a different layout, so trace-affecting")
 
 
 # -- registry access --------------------------------------------------------

@@ -1,0 +1,344 @@
+"""Multi-axis SPMD tests: MeshSpec / SpecLayout / placement search.
+
+Covers the docs/PARALLELISM.md contract: a data-only MeshSpec is
+bit-identical to the existing data-parallel engine, FSDP and tp
+layouts match the single-device trajectory, and the cost-driven
+placement search is HBM-feasible, deterministic, cached, and picks a
+multi-axis layout that beats pure data-parallel on the transformer
+bench model.
+"""
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as fluid
+from paddle_tpu import models
+from paddle_tpu.core.engine import Engine
+from paddle_tpu.core.scope import Scope
+from paddle_tpu.parallel import DistributedStrategy, MeshSpec, make_mesh
+from paddle_tpu.parallel.comm_scheduler import update_shard_axes
+from paddle_tpu.parallel.strategy import SpecLayout, P
+
+
+def _build_transformer(d_model=32, d_inner=64):
+    fluid.framework.unique_name.reset()
+    cfg = models.transformer.TransformerConfig(
+        src_vocab_size=64, trg_vocab_size=64, d_model=d_model,
+        d_inner=d_inner, n_head=4, n_layer=2, dropout=0.0)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        cost, logits, feeds = models.transformer_train(cfg)
+        opt = fluid.optimizer.SGDOptimizer(learning_rate=0.1)
+        opt.minimize(cost)
+    return cfg, main, startup, cost
+
+
+def _run_steps(main, startup, cost, batches, strategy=None,
+               param_names=()):
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        eng = Engine(strategy=strategy)
+        losses = []
+        for b in batches:
+            out = eng.run(main, scope, None, b, [cost.name])
+            losses.append(np.asarray(out[0]))
+        params = {}
+        for n in param_names:
+            v = scope.find_var(n).get_value()
+            arr = v.array if hasattr(v, "array") else v
+            params[n] = np.asarray(arr)
+    return losses, params
+
+
+# ---------------------------------------------------------------------------
+# make_mesh validation (satellite 1)
+# ---------------------------------------------------------------------------
+
+def test_make_mesh_raises_on_nondivisible():
+    n = len(jax.devices())
+    with pytest.raises(ValueError, match="stranded"):
+        make_mesh({"dp": n - 1})
+
+
+def test_make_mesh_rejects_bad_sizes():
+    n = len(jax.devices())
+    with pytest.raises(ValueError):
+        make_mesh({"dp": 0})
+    with pytest.raises(ValueError):
+        make_mesh({"dp": n * 2})
+
+
+def test_make_mesh_warns_on_partial():
+    n = len(jax.devices())
+    if n < 4:
+        pytest.skip("needs >= 4 devices")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        mesh = make_mesh({"dp": n // 2})
+    assert any("partial" in str(x.message) for x in w), \
+        [str(x.message) for x in w]
+    assert mesh.shape["dp"] == n // 2
+
+
+def test_make_mesh_full_cover_no_warning():
+    n = len(jax.devices())
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        make_mesh({"dp": n})
+    assert not w, [str(x.message) for x in w]
+
+
+# ---------------------------------------------------------------------------
+# MeshSpec
+# ---------------------------------------------------------------------------
+
+def test_mesh_spec_basics():
+    s = MeshSpec(data=2, fsdp=2, tp=2)
+    assert s.size == 8
+    assert s.axis_shapes() == {"data": 2, "fsdp": 2, "tp": 2}
+    # size-1 axes are dropped from the mesh shape (bit-identity rule)
+    assert MeshSpec(data=8).axis_shapes() == {"data": 8}
+    assert MeshSpec().axis_shapes() == {}
+    assert MeshSpec.from_dict(s.to_dict()) == s
+
+
+def test_mesh_spec_from_string():
+    s = MeshSpec.from_string("data=2,fsdp=4")
+    assert (s.data, s.fsdp, s.tp) == (2, 4, 1)
+    with pytest.raises(ValueError):
+        MeshSpec.from_string("data=2,bogus=4")
+
+
+def test_mesh_spec_validation():
+    with pytest.raises(ValueError):
+        MeshSpec(data=0)
+    with pytest.raises(ValueError):
+        MeshSpec(data=-2)
+    with pytest.raises(ValueError):
+        MeshSpec(data=-1, fsdp=-1)
+
+
+def test_mesh_spec_infer_axis():
+    n = len(jax.devices())
+    if n % 2:
+        pytest.skip("needs even device count")
+    s = MeshSpec(data=-1, tp=2)
+    mesh = s.build()
+    assert mesh.shape["data"] * 2 == n
+
+
+# ---------------------------------------------------------------------------
+# axis-aware ZeRO shard axes
+# ---------------------------------------------------------------------------
+
+def test_update_shard_axes():
+    n = len(jax.devices())
+    if n < 8:
+        pytest.skip("needs 8 devices")
+    old = make_mesh({"dp": n})
+    assert update_shard_axes(old, "dp") == ("dp",)
+    multi = MeshSpec(data=2, fsdp=2, tp=2).build()
+    assert update_shard_axes(multi, "data") == ("data", "fsdp")
+    tp_only = MeshSpec(tp=n).build()
+    assert update_shard_axes(tp_only, "data") == ()
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: Mesh(data=N) == existing data-parallel engine
+# ---------------------------------------------------------------------------
+
+def test_data_only_spec_bit_identical_to_dp_engine():
+    n = len(jax.devices())
+    if n < 2:
+        pytest.skip("needs multiple devices")
+    cfg, main, startup, cost = _build_transformer()
+    batch = models.transformer.make_batch(
+        cfg, 8, 16, 16, rng=np.random.default_rng(0))
+    batches = [batch] * 3
+    params = ("src_word_emb.w_0",)
+    dp_losses, dp_params = _run_steps(
+        main, startup, cost, batches,
+        DistributedStrategy(axes={"dp": n}), params)
+    spec_losses, spec_params = _run_steps(
+        main, startup, cost, batches,
+        DistributedStrategy.from_mesh_spec(MeshSpec(data=n)), params)
+    for a, b in zip(dp_losses, spec_losses):
+        np.testing.assert_array_equal(a, b)
+    for name in params:
+        np.testing.assert_array_equal(dp_params[name],
+                                      spec_params[name])
+
+
+# ---------------------------------------------------------------------------
+# FSDP / tp layouts match the single-device trajectory
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("spec", [
+    MeshSpec(fsdp=4, tp=2),
+    MeshSpec(data=2, fsdp=2, tp=2),
+    MeshSpec(fsdp=8),
+], ids=["fsdp4_tp2", "data2_fsdp2_tp2", "fsdp8"])
+def test_mesh_layouts_match_single_device(spec):
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    cfg, main, startup, cost = _build_transformer()
+    batch = models.transformer.make_batch(
+        cfg, 8, 16, 16, rng=np.random.default_rng(0))
+    batches = [batch] * 3
+    single, _ = _run_steps(main, startup, cost, batches)
+    sharded, _ = _run_steps(
+        main, startup, cost, batches,
+        DistributedStrategy.from_mesh_spec(spec))
+    np.testing.assert_allclose(
+        [float(x) for x in single], [float(x) for x in sharded],
+        rtol=2e-4, atol=2e-5)
+
+
+def test_fsdp_param_actually_sharded():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    cfg, main, startup, cost = _build_transformer()
+    batch = models.transformer.make_batch(
+        cfg, 8, 16, 16, rng=np.random.default_rng(0))
+    strat = DistributedStrategy.from_mesh_spec(MeshSpec(fsdp=8))
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        eng = Engine(strategy=strat)
+        eng.run(main, scope, None, batch, [cost.name])
+        w = scope.find_var("src_word_emb.w_0").get_value()
+        arr = w.array if hasattr(w, "array") else w
+        assert tuple(arr.sharding.spec)[:1] == ("fsdp",), arr.sharding
+        assert arr.sharding.shard_shape(arr.shape)[0] * 8 == \
+            arr.shape[0]
+
+
+def test_spec_layout_data_only_emits_no_param_rules():
+    layout = SpecLayout(fsdp=False, tp=False)
+    spec = MeshSpec(data=8)
+    assert len(layout.param_rules(spec)) == 0
+    feed = layout.feed_rules(spec)
+    assert feed.spec_for("src_word", (8, 16), spec.build()) == \
+        P("data")
+
+
+# ---------------------------------------------------------------------------
+# placement search (tentpole: analysis/placement.py)
+# ---------------------------------------------------------------------------
+
+def _placement_program(d_model=256):
+    cfg, main, startup, cost = _build_transformer(
+        d_model=d_model, d_inner=2 * d_model)
+    return main, cost
+
+
+def test_placement_deterministic():
+    from paddle_tpu.analysis.placement import search_placement
+    main, _ = _placement_program()
+    a = search_placement(main, n_devices=8, dynamic_dim=32)
+    b = search_placement(main, n_devices=8, dynamic_dim=32)
+    assert a.to_dict() == b.to_dict()
+
+
+def test_placement_beats_pure_data_parallel():
+    from paddle_tpu.analysis.placement import search_placement
+    main, _ = _placement_program()
+    plan = search_placement(main, n_devices=8, dynamic_dim=32)
+    assert plan.multi_axis, plan.to_dict()
+    assert plan.predicted_ms < plan.baseline_ms, plan.to_dict()
+    assert plan.spec.size == 8
+    # the per-axis collective-bytes breakdown only names live axes
+    assert all(k in ("data", "fsdp", "tp")
+               for k in plan.per_axis_bytes)
+
+
+def test_placement_hbm_constraint(monkeypatch):
+    from paddle_tpu.analysis import placement
+    main, _ = _placement_program()
+    stats = placement.program_stats(main, dynamic_dim=32)
+    pure = placement.candidate_hbm_bytes(stats["memplan"],
+                                         MeshSpec(data=8))
+    # a limit below the pure-data footprint forces param sharding
+    # (transients shard only over the batch extent, so the floor is
+    # transient/8 — 0.8x pure keeps fsdp feasible, pure data not)
+    limit = int(pure * 0.8)
+    monkeypatch.setenv("PT_STATIC_HBM_LIMIT", str(limit))
+    plan = placement.search_placement(main, n_devices=8,
+                                      dynamic_dim=32)
+    assert plan.spec.fsdp * plan.spec.tp > 1, plan.to_dict()
+    assert plan.hbm_bytes <= limit, plan.to_dict()
+
+
+def test_placement_respects_pins(monkeypatch):
+    from paddle_tpu.analysis.placement import search_placement
+    main, _ = _placement_program()
+    monkeypatch.setenv("PT_MESH_TP", "2")
+    plan = search_placement(main, n_devices=8, dynamic_dim=32)
+    assert plan.spec.tp == 2, plan.to_dict()
+    monkeypatch.setenv("PT_MESH_AXES", "data=2,fsdp=4")
+    plan = search_placement(main, n_devices=8, dynamic_dim=32)
+    assert (plan.spec.data, plan.spec.fsdp, plan.spec.tp) == (2, 4, 1)
+
+
+def test_placement_cache_replay(monkeypatch, tmp_path):
+    from paddle_tpu.analysis.placement import plan_for_program
+    monkeypatch.setenv("PT_TUNING_CACHE_DIR", str(tmp_path))
+    main, _ = _placement_program()
+    first = plan_for_program(main, n_devices=8)
+    assert not first.cached and first.trials > 0
+    second = plan_for_program(main, n_devices=8)
+    assert second.cached and second.trials == 0
+    assert second.to_dict() == first.to_dict()
+
+
+def test_placement_calibration(monkeypatch, tmp_path):
+    from paddle_tpu.analysis.placement import search_placement
+    main, _ = _placement_program()
+    plan = search_placement(main, n_devices=8, dynamic_dim=32,
+                            measured={"step_ms": 42.0})
+    assert plan.calibration > 0
+    # calibration rescales predicted against the measured baseline
+    base = search_placement(main, n_devices=8, dynamic_dim=32)
+    np.testing.assert_allclose(
+        plan.predicted_ms,
+        base.predicted_ms * plan.calibration, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# engine auto-placement (PT_PLACEMENT_AUTO)
+# ---------------------------------------------------------------------------
+
+def test_engine_auto_placement(monkeypatch, tmp_path):
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    monkeypatch.setenv("PT_PLACEMENT_AUTO", "1")
+    monkeypatch.setenv("PT_TUNING_CACHE_DIR", str(tmp_path))
+    cfg, main, startup, cost = _build_transformer()
+    batch = models.transformer.make_batch(
+        cfg, 8, 16, 16, rng=np.random.default_rng(0))
+    losses, _ = _run_steps(main, startup, cost, [batch] * 2)
+    assert all(np.isfinite(x).all() for x in losses)
+
+    # the engine picked a plan and installed a strategy
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        eng = Engine()
+        eng.run(main, scope, None, batch, [cost.name])
+        assert eng.counters["placement_searches"] + \
+            eng.counters["placement_cache_hits"] == 1
+        assert eng.strategy is not None and eng.mesh is not None
+
+        # second engine replays the plan from cache: zero trials
+        eng2 = Engine()
+        eng2.run(main, scope, None, batch, [cost.name])
+        assert eng2.counters["placement_cache_hits"] == 1
+        assert eng2.counters["placement_searches"] == 0

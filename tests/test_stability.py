@@ -317,11 +317,14 @@ def test_fault_plan_anomaly_kinds():
 
 def test_policy_map_parsing():
     from paddle_tpu.stability.guard import policy_map
-    assert policy_map("") == {"nonfinite": "skip", "spike": "clip"}
+    assert policy_map("") == {"nonfinite": "skip", "spike": "clip",
+                              "integrity": "rollback"}
     assert policy_map("rollback") == {"nonfinite": "rollback",
-                                      "spike": "rollback"}
+                                      "spike": "rollback",
+                                      "integrity": "rollback"}
     assert policy_map("nonfinite=abort,spike=rescale") == {
-        "nonfinite": "abort", "spike": "rescale"}
+        "nonfinite": "abort", "spike": "rescale",
+        "integrity": "rollback"}
     with pytest.raises(ValueError):
         policy_map("nonfinite=explode")
 

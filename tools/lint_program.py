@@ -264,6 +264,13 @@ def _parser():
     p.add_argument("--check-cost", action="store_true",
                    help="print the static per-op cost model (FLOPs / "
                         "bytes moved, per-island aggregation)")
+    p.add_argument("--check-placement", action="store_true",
+                   help="multi-axis layout lint (docs/PARALLELISM.md): "
+                        "every trainable parameter must resolve to "
+                        "exactly one PartitionSpec under the SpecLayout "
+                        "table, and the transpiled shard programs must "
+                        "issue an identical collective sequence; exits "
+                        "non-zero on gaps, ambiguity, or divergence")
     p.add_argument("--batch", type=int, default=64, metavar="N",
                    help="value substituted for dynamic (-1) dims in "
                         "--check-memory/--check-cost plans (default 64)")
@@ -435,6 +442,58 @@ def _check_cost(program, batch: int, label="") -> int:
     return EXIT_CLEAN
 
 
+def _check_placement(model: str, batch: int, n_shards: int = 2,
+                     label="") -> int:
+    """Multi-axis layout lint (docs/PARALLELISM.md).
+
+    Two invariants: (1) the SpecLayout table must give every trainable
+    parameter exactly ONE PartitionSpec — zero matches means the
+    parameter silently replicates under FSDP (an HBM regression), two
+    distinct matches means first-match-wins is hiding a rule-set
+    ambiguity; (2) the collective sequence must be identical across
+    transpiled shard programs (reuses check_collective_ordering —
+    layout-induced divergence hangs every rank on hardware)."""
+    from paddle_tpu.analysis import (check_collective_ordering,
+                                     format_report, has_errors)
+    from paddle_tpu.parallel.mesh import MeshSpec
+    from paddle_tpu.parallel.strategy import SpecLayout
+
+    program, _, feed_names, loss = build_model(model)
+    # a full multi-axis spec keeps every rule in the table live
+    rules = SpecLayout().param_rules(MeshSpec(data=2, fsdp=2, tp=2))
+    rc = EXIT_CLEAN
+    params = program.global_block().all_parameters()
+    bad = 0
+    for p in sorted(params, key=lambda v: v.name):
+        specs = rules.matching_specs(p.name)
+        if len(specs) == 1:
+            continue
+        bad += 1
+        rc = EXIT_ERRORS
+        if not specs:
+            print(f"  {p.name}: ERROR no PartitionSpec rule matches "
+                  f"(would replicate under FSDP)")
+        else:
+            print(f"  {p.name}: ERROR ambiguous — {len(specs)} "
+                  f"distinct specs match: "
+                  + ", ".join(str(s) for s in specs))
+    print(f"check-placement {label}: {len(params)} parameter(s), "
+          f"{bad} without a unique PartitionSpec")
+
+    shards, _, _ = transpile_shards(model, n_shards)
+    diags = check_collective_ordering(shards)
+    if diags:
+        print(format_report(
+            diags, header=f"check-placement {label}: collective "
+                          f"ordering over {n_shards} shards"))
+    else:
+        print(f"check-placement {label}: collective sequence "
+              f"consistent across {n_shards} shards")
+    if has_errors(diags):
+        rc = EXIT_ERRORS
+    return rc
+
+
 def _all_models(batch: int) -> int:
     """CI gate: every named book model must pass the full pipeline
     (zero errors) AND verify race-free under the scheduler partition."""
@@ -448,6 +507,8 @@ def _all_models(batch: int) -> int:
         if has_errors(diags):
             rc = EXIT_ERRORS
         if _check_races(program, [loss.name], label=name) != EXIT_CLEAN:
+            rc = EXIT_ERRORS
+        if _check_placement(name, batch, label=name) != EXIT_CLEAN:
             rc = EXIT_ERRORS
     return rc
 
@@ -507,7 +568,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         if fetch_names is None:
             fetch_names = [loss.name]
 
-    if ns.check_races or ns.check_memory is not None or ns.check_cost:
+    if ns.check_races or ns.check_memory is not None or ns.check_cost \
+            or ns.check_placement:
         rc = EXIT_CLEAN
         if ns.check_races:
             inj = ns.inject if ns.inject in _partition_injects else None
@@ -519,6 +581,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                                        ns.batch, label=label))
         if ns.check_cost:
             rc = max(rc, _check_cost(programs[0], ns.batch, label=label))
+        if ns.check_placement:
+            if not ns.model:
+                print("lint_program: --check-placement requires "
+                      "--model", file=sys.stderr)
+                return EXIT_USAGE
+            rc = max(rc, _check_placement(ns.model, ns.batch,
+                                          max(2, ns.shards),
+                                          label=label))
         return rc
 
     if ns.inject:

@@ -139,7 +139,19 @@ def memory_report(mem):
     return mem, line
 
 
-def _build_model(batch):
+def mesh_report(mesh):
+    """(dict, '#'-line) for the bench JSON tail from a named-mesh A/B
+    probe result ({sync_ms_off, sync_ms_on, mesh}); (None, None) when
+    the probe did not run or errored before measuring."""
+    if not mesh or "sync_ms_on" not in mesh:
+        return (mesh or None), None
+    off, on = mesh["sync_ms_off"], mesh["sync_ms_on"]
+    line = (f"# mesh_spmd: sync {off:.2f} -> {on:.2f} ms/step "
+            f"(delta {on - off:+.3f} ms) over mesh {mesh.get('mesh')}")
+    return mesh, line
+
+
+def _build_model(batch, strategy=None):
     import paddle_tpu as fluid
     from paddle_tpu import layers
     from paddle_tpu.core.engine import Engine
@@ -161,7 +173,7 @@ def _build_model(batch):
     rng = np.random.RandomState(0)
     feed = {"x": rng.rand(batch, 256).astype(np.float32),
             "y": rng.randint(0, 10, (batch, 1)).astype(np.int64)}
-    return Engine(), main, scope, feed, [loss.name]
+    return Engine(strategy=strategy), main, scope, feed, [loss.name]
 
 
 def measure_step_overhead(eng, prog, scope, batch, fetch_names,
@@ -265,6 +277,13 @@ def main(argv=None):
                         "host-side knobs only, so the probe stays "
                         "cheap); cache dir: PT_TUNING_CACHE_DIR "
                         "(a throwaway dir when unset)")
+    p.add_argument("--compare-mesh", action="store_true",
+                   help="A/B the named-mesh SPMD path "
+                        "(docs/PARALLELISM.md): measure the plain "
+                        "single-engine step, then the SAME model under "
+                        "a data-only MeshSpec over every host device "
+                        "(bit-identical math, GSPMD-partitioned); "
+                        "--threshold-ms gates the mesh-on sync DELTA")
     p.add_argument("--compare-memory", action="store_true",
                    help="A/B the HBM memory-observatory census "
                         "(docs/MEMORY.md): measure with the census "
@@ -419,6 +438,30 @@ def main(argv=None):
                 if own_cache:
                     os.environ.pop("PT_TUNING_CACHE_DIR", None)
                     shutil.rmtree(own_cache, ignore_errors=True)
+        if args.compare_mesh:
+            # A/B the named mesh on a FRESH engine/model: the data-only
+            # MeshSpec is the bit-identity layout (test_mesh_spmd.py),
+            # so any sync delta is pure partitioner/dispatch overhead
+            import jax
+            from paddle_tpu.parallel import DistributedStrategy, MeshSpec
+            n = len(jax.devices())
+            if n < 2:
+                r["mesh_on"] = {"skipped": "single-device host"}
+            else:
+                strat = DistributedStrategy.from_mesh_spec(
+                    MeshSpec(data=n))
+                eng7, prog7, scope7, feed7, fetch7 = \
+                    _build_model(args.batch, strategy=strat)
+                with fluid.scope_guard(scope7):
+                    r_x = measure_step_overhead(
+                        eng7, prog7, scope7, feed7, fetch7,
+                        steps=args.steps)
+                r["mesh_on"] = {
+                    **{k: r_x[k] for k in
+                       ("sync_ms", "pipelined_ms", "host_overhead_ms",
+                        "steps_per_sec")},
+                    "mesh": {"data": n}}
+                r["mesh_delta_ms"] = r_x["sync_ms"] - r["sync_ms"]
         if args.compare_memory:
             # A/B the live-buffer census on a FRESH engine/model; the
             # census-off numbers above stay uncontaminated, and the
@@ -495,6 +538,13 @@ def main(argv=None):
             _, line = tuning_report(r["tuning"])
             if line:
                 print(line)
+        if "mesh_on" in r and "sync_ms" in r.get("mesh_on", {}):
+            _, line = mesh_report(
+                {"sync_ms_off": r["sync_ms"],
+                 "sync_ms_on": r["mesh_on"]["sync_ms"],
+                 "mesh": r["mesh_on"]["mesh"]})
+            if line:
+                print(line)
         if "memory_on" in r:
             _, line = memory_report(
                 {"sync_ms_off": r["sync_ms"],
@@ -541,6 +591,12 @@ def main(argv=None):
         bad.append(
             f"memory-census sync delta "
             f"{r['memory_delta_ms']:.2f} ms > threshold "
+            f"{args.threshold_ms:.1f} ms")
+    if args.threshold_ms is not None and "mesh_delta_ms" in r and \
+            r["mesh_delta_ms"] > args.threshold_ms:
+        bad.append(
+            f"mesh-on sync delta "
+            f"{r['mesh_delta_ms']:.2f} ms > threshold "
             f"{args.threshold_ms:.1f} ms")
     if bad:
         print("REGRESSION: " + "; ".join(bad), file=sys.stderr)
