@@ -587,6 +587,36 @@ def _probe_analysis(eng, prog, scope, feed, fetch, stats, batch):
     return out
 
 
+def _probe_conformance(prog, fetch, batch):
+    """Cross-path lowering conformance probe (docs/STATIC_ANALYSIS.md):
+    extract the canonical lowering trace of the bench model on all four
+    execution paths and diff them against the declared support matrix.
+    The acceptance number is ``undeclared_divergences == 0`` — any
+    undeclared drift between engine / scheduler / transpiled / dygraph
+    lowering is a regression; ``verify_ms`` keeps the verifier honest
+    about its pre-compile cost."""
+    out = {}
+    try:
+        from paddle_tpu.analysis import (conformance_summary,
+                                         extract_traces,
+                                         verify_conformance)
+        from paddle_tpu.analysis.conformance import TraceConfig
+
+        cfg = TraceConfig.capability(dynamic_dim=batch)
+        t0 = time.perf_counter()
+        traces = extract_traces(prog, fetch_names=fetch, config=cfg)
+        diags = verify_conformance(prog, fetch_names=fetch, config=cfg,
+                                   traces=traces, label="bench")
+        out["verify_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+        out["paths"] = sorted(traces)
+        s = conformance_summary(diags)
+        out["declared_divergences"] = s["declared"]
+        out["undeclared_divergences"] = s["undeclared"]
+    except Exception as exc:   # accounting only; never fail the bench
+        out["error"] = f"{type(exc).__name__}: {exc}"[:200]
+    return out
+
+
 def bench_transformer(batch=BATCH, seq=None, measure_ckpt=False):
     import paddle_tpu as fluid
     from paddle_tpu import models
@@ -656,6 +686,10 @@ def bench_transformer(batch=BATCH, seq=None, measure_ckpt=False):
             # JSON tail (docs/STATIC_ANALYSIS.md)
             stats["analysis"] = _probe_analysis(
                 eng, main_prog, scope, feed, [cost.name], stats, batch)
+            # cross-path lowering conformance for the conformance
+            # JSON tail (docs/STATIC_ANALYSIS.md)
+            stats["conformance"] = _probe_conformance(
+                main_prog, [cost.name], batch)
             # cost-driven multi-axis placement search for the
             # parallelism JSON tail (docs/PARALLELISM.md)
             stats["parallelism"] = _probe_parallelism(
@@ -770,6 +804,8 @@ def bench_lenet():
             # headline transformer)
             stats["analysis"] = _probe_analysis(
                 eng, main_prog, scope, batch, [cost.name], stats, B)
+            stats["conformance"] = _probe_conformance(
+                main_prog, [cost.name], B)
     return sps * B, sps, traj, sync_ms, stats
 
 

@@ -16,7 +16,14 @@ from .passes import (AnalysisContext, COLLECTIVE_OP_TYPES, analysis_passes,
                      analyze_program, analyze_shard_programs,
                      check_collective_ordering, register_analysis_pass)
 from .validate import (clear_validation_cache, validate_cached,
-                       validate_program, validate_traced)
+                       validate_collective_plan, validate_program,
+                       validate_traced)
+from .conformance import (LoweringTrace, TraceConfig,
+                          conformance_summary, crosscheck_traced,
+                          diff_traces, extract_trace, extract_traces,
+                          inject_drift, verify_conformance)
+from .support_matrix import SupportMatrix, default_matrix
+from . import conformance, support_matrix
 from .races import verify_partition, donation_plan
 from .memplan import MemoryPlan, plan_memory, reconcile
 from .cost_model import (OpCost, ProgramCost, program_cost,
@@ -33,8 +40,13 @@ __all__ = [
     "AnalysisContext", "COLLECTIVE_OP_TYPES", "analysis_passes",
     "analyze_program", "analyze_shard_programs",
     "check_collective_ordering", "register_analysis_pass",
-    "clear_validation_cache", "validate_cached", "validate_program",
-    "validate_traced",
+    "clear_validation_cache", "validate_cached",
+    "validate_collective_plan", "validate_program", "validate_traced",
+    "LoweringTrace", "TraceConfig", "conformance_summary",
+    "crosscheck_traced", "diff_traces",
+    "extract_trace", "extract_traces", "inject_drift",
+    "verify_conformance", "conformance",
+    "SupportMatrix", "default_matrix", "support_matrix",
     "verify_partition", "donation_plan",
     "MemoryPlan", "plan_memory", "reconcile",
     "OpCost", "ProgramCost", "program_cost", "island_cost_rows",

@@ -586,3 +586,4 @@ def analyze_shard_programs(
 from . import races  # noqa: E402,F401  (island-race)
 from . import memplan  # noqa: E402,F401  (memory-plan)
 from . import cost_model  # noqa: E402,F401  (cost-model)
+from . import conformance  # noqa: E402,F401  (cross-path conformance)

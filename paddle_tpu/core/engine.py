@@ -800,15 +800,20 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
         ts.integrity_plan = None
         return ts
     updated_names = list(updated_box)
-    if (FLAGS.op_scheduler and integrity_plan is None
-            and mesh is None and iterations == 1
-            and not feed_lods):
+    from .scheduler import scheduler_gate
+    if scheduler_gate(program, block_idx, fetch_names, mesh=mesh,
+                      iterations=iterations, feed_lods=feed_lods,
+                      integrity_plan=integrity_plan)[0]:
         # programmable operator scheduler (core/scheduler.py,
         # docs/SCHEDULING.md): data-independent islands dispatched on
         # concurrent lanes (accum_k == 1) or a pipelined micro-batch
-        # grad-accumulation loop (accum_k > 1). Returns None when the
-        # block is not schedulable (sub-blocks, single island, opaque
-        # state) — the whole-block jit below stays the fallback.
+        # grad-accumulation loop (accum_k > 1). The gate predicate is
+        # shared with the conformance verifier
+        # (analysis/conformance.py) so the static claim about when
+        # islands apply cannot drift from this call site. Returns None
+        # when the block is not schedulable (sub-blocks, single
+        # island, opaque state) — the whole-block jit below stays the
+        # fallback.
         from .scheduler import build_scheduled_step
         ts = build_scheduled_step(
             program, block, params_sig, feed_sig, fetch_names, avail,
@@ -1612,6 +1617,16 @@ class Engine:
                 validate_traced(program, block_idx,
                                 traced.updated_names,
                                 traced.donated_names, fetch_names)
+                # ... and cross-check the step's lowering decisions
+                # (guard gate, collective plan, island-gate choice)
+                # against the static conformance trace — same tier,
+                # same once-per-trace-build cost
+                # (analysis/conformance.py).
+                from ..analysis.conformance import crosscheck_traced
+                crosscheck_traced(program, block_idx, traced,
+                                  mesh=self.mesh,
+                                  data_axis=self.data_axis,
+                                  strategy=self.strategy)
             if use_program_cache:
                 self._cache[key] = traced
             if obs is not None:

@@ -22,7 +22,7 @@ and GradOpDescMaker (grad_op_desc_maker.h). TPU-first twists:
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -452,3 +452,46 @@ def shard_hint(ctx: ExecContext, slot: str, value,
         return strat_mod.constrain_matmul(
             out_name, w_name, getattr(w, "shape", None), value)
     return strat_mod.constrain_activation(out_name, value)
+
+
+_SHARD_HINT_SLOTS: Dict[str, Tuple[str, ...]] = {}
+
+
+def shard_hinted_slots(op_type: str) -> Tuple[str, ...]:
+    """Output slots whose registered lowering routes through
+    :func:`shard_hint`, read off the lowering's own source (AST walk
+    for ``shard_hint(ctx, "<slot>", ...)`` calls).
+
+    This is the conformance verifier's ground truth for which ops
+    attach sharding constraints (analysis/conformance.py): discovering
+    the call sites statically means a new hinted lowering is tracked
+    the moment it is written, with no parallel registry to forget.
+    Returns () for unknown ops or unreadable source; memoized per op
+    type (lowerings are module-level functions, fixed after import).
+    """
+    hit = _SHARD_HINT_SLOTS.get(op_type)
+    if hit is not None:
+        return hit
+    slots: List[str] = []
+    try:
+        import ast
+        import inspect
+        import textwrap
+        fn = OPS.get(op_type).lowering
+        tree = ast.parse(textwrap.dedent(inspect.getsource(fn)))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = f.id if isinstance(f, ast.Name) \
+                else getattr(f, "attr", "")
+            if name == "shard_hint" and len(node.args) >= 2:
+                s = node.args[1]
+                if isinstance(s, ast.Constant) and \
+                        isinstance(s.value, str):
+                    slots.append(s.value)
+    except Exception:
+        slots = []
+    out = tuple(dict.fromkeys(slots))
+    _SHARD_HINT_SLOTS[op_type] = out
+    return out

@@ -364,6 +364,47 @@ def partition_metadata(program, block_idx: int = 0,
                          updated_names, fetch_names)
 
 
+def scheduler_gate(program, block_idx: int = 0,
+                   fetch_names: Sequence[str] = (),
+                   mesh=None, iterations: int = 1, feed_lods=None,
+                   integrity_plan=None,
+                   updated_names: Optional[Sequence[str]] = None,
+                   check_partition: bool = False
+                   ) -> Tuple[bool, str]:
+    """The island-path gate as ONE shared predicate: could the op
+    scheduler take this (program, runtime state)?
+
+    ``engine.trace_step`` calls this (``check_partition=False``) before
+    attempting ``build_scheduled_step``; the conformance verifier and
+    the tier-2 cross-check (analysis/conformance.py) call the same
+    predicate so the static claim "islands are impossible here" can
+    never drift from what the engine actually does.  Returns
+    (eligible, reason) — with ``check_partition=True`` the static
+    partition eligibility is folded in too (build_scheduled_step still
+    has runtime-only outs, so True means "possible", not "certain")."""
+    from .flags import FLAGS
+    if not FLAGS.op_scheduler:
+        return False, "FLAGS_op_scheduler is off"
+    if integrity_plan is not None:
+        return False, ("integrity sentinel requires the whole-block "
+                       "trace (fingerprint cannot span islands)")
+    if mesh is not None:
+        return False, ("a device mesh forces the whole-block SPMD "
+                       "path: islands never run multi-device")
+    if int(iterations) != 1:
+        return False, ("num_iteration_per_run > 1 compiles one "
+                       "scanned whole-block executable")
+    if feed_lods:
+        return False, "LoD feeds take the whole-block path"
+    if check_partition:
+        info = partition_metadata(program, block_idx,
+                                  fetch_names=fetch_names,
+                                  updated_names=updated_names)
+        if not info.eligible:
+            return False, f"partition ineligible: {info.reason}"
+    return True, "eligible"
+
+
 def _has_sub_block(op) -> bool:
     """Ops carrying sub-blocks (while/cond/py_func trampolines) need the
     engine's block_runner recursion rooted in ONE env — splitting them

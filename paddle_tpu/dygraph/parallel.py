@@ -140,9 +140,18 @@ class DataParallel(Layer):
                 iv.grad = jnp.asarray(out.reshape(local.shape))
             return
         mode = _cs.quantize_mode_from_flags()
-        buckets = _cs.plan_named_buckets(
-            [(i, a.shape, a.dtype) for i, a in enumerate(locals_)],
-            bucket_bytes)
+        items = [(i, a.shape, a.dtype) for i, a in enumerate(locals_)]
+        buckets = _cs.plan_named_buckets(items, bucket_bytes)
+        from ..core.flags import FLAGS
+        if FLAGS.validate_program and int(FLAGS.validate_tier) >= 2:
+            # validation tier 2 on the dygraph path (PR 14 covered the
+            # engine only): re-prove the plan we are about to reduce —
+            # every grad in exactly one bucket, contiguous tiling, one
+            # dtype per payload — before any collective issues
+            from ..analysis.validate import validate_collective_plan
+            validate_collective_plan(
+                items, buckets, bucket_bytes,
+                label="dygraph apply_collective_grads")
         for b in buckets:
             idxs = list(b.names)
             parts = [locals_[i].ravel() for i in idxs]

@@ -41,6 +41,7 @@ __all__ = [
     "Signature", "Kernel", "register_kernel", "select", "signature",
     "routable", "allowed", "count", "kernels", "kernel_names", "get",
     "dispatch_stats", "reset_stats", "min_numel", "interpret",
+    "abstract_select", "candidate_op_types",
 ]
 
 # Test hook: arm to let the registry (and the kernels it selects) run in
@@ -283,6 +284,44 @@ def select(op_type: str, sig: Signature) -> Optional[Kernel]:
             count(kern.name, "custom")
             return kern
         count(kern.name, "lowered")
+    return None
+
+
+def candidate_op_types() -> Tuple[str, ...]:
+    """Op types with at least one registered kernel, sorted — the ops
+    whose lowering a :func:`select` decision can change."""
+    return tuple(sorted(_BY_OP))
+
+
+def abstract_select(op_type: str, sig: Signature,
+                    platform: str = "tpu") -> Optional[str]:
+    """Replay :func:`select`'s dispatch decision under an ASSUMED live
+    platform — no backend probe, no stats traffic, no side effects.
+
+    This is the conformance verifier's view of kernel routing
+    (analysis/conformance.py): on a CPU tier-1 host ``select`` always
+    keeps the lowered path, so the cross-path comparison instead asks
+    which kernel each path WOULD route to once the real backend is up.
+    Same gating order as ``select``: candidates, platform, master
+    flag, deny list, per-kernel eligibility; first eligible wins.
+    """
+    cands = _BY_OP.get(op_type)
+    if not cands:
+        return None
+    if platform == "cpu" and not _INTERPRET:
+        return None
+    from ..core.flags import FLAGS
+    if not FLAGS.use_custom_kernels:
+        return None
+    deny = _deny()
+    for kern in cands:
+        if kern.name in deny:
+            continue
+        try:
+            if bool(kern.eligible(sig)):
+                return kern.name
+        except Exception:
+            continue
     return None
 
 

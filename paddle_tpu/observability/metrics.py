@@ -548,6 +548,19 @@ def _install_standard_families(reg: MetricsRegistry) -> None:
     reg.counter("pt_oom_postmortems_total",
                 "RESOURCE_EXHAUSTED exceptions that produced a memory "
                 "postmortem (deduped: one per exception chain)")
+    # cross-path lowering conformance (analysis/conformance.py,
+    # docs/STATIC_ANALYSIS.md)
+    reg.counter("pt_conformance_checks_total",
+                "verify_conformance runs (one per program × config "
+                "verified across the four execution paths)")
+    reg.counter("pt_conformance_divergences_total",
+                "cross-path lowering divergences observed, labeled "
+                "{declared}: yes = justified support-matrix cell "
+                "(INFO), no = undeclared drift (ERROR)")
+    reg.gauge("pt_conformance_verify_seconds",
+              "wall time of the last conformance verification "
+              "(trace extraction + pairwise diff; runs pre-compile, "
+              "so it must stay cheap)")
     reg.register_collector(_engine_families)
     reg.register_collector(_rpc_families)
 

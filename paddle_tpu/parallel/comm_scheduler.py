@@ -226,6 +226,30 @@ def plan_program_buckets(program, block_idx: int = 0,
     return plan_named_buckets(items, bucket_bytes, last)
 
 
+def bucket_plan_records(program, block_idx: int = 0,
+                        bucket_bytes: Optional[int] = None,
+                        quantize_mode: Optional[str] = None,
+                        param_filter=None) -> List[Dict[str, Any]]:
+    """Canonical, path-comparable view of the bucket plan for a static
+    Program — the single record format the cross-path conformance
+    verifier (analysis/conformance.py) diffs: one dict per bucket with
+    membership, order, dtype, bytes, seal point, and the quantize
+    decision, derived from the SAME planner every consumer calls
+    (engine CommScheduler, transpiler _transpile_bucketed)."""
+    if quantize_mode is None:
+        quantize_mode = quantize_mode_from_flags()
+    buckets = plan_program_buckets(program, block_idx, bucket_bytes,
+                                   param_filter=param_filter)
+    return [{"bucket": i,
+             "names": tuple(b.names),
+             "dtype": str(np.dtype(b.dtype)),
+             "bytes": int(b.bytes),
+             "last_op_idx": int(b.last_op_idx),
+             "quantized": bool(should_quantize(b.dtype, b.bytes,
+                                               quantize_mode))}
+            for i, b in enumerate(buckets)]
+
+
 def plan_stats(buckets: Sequence[GradBucket],
                last_backward_idx: int = -1,
                quantize_mode: str = "") -> Dict[str, Any]:

@@ -46,7 +46,25 @@ class Collective:
         self.nranks = len(endpoints)
         self._transpile_startup_program()
         self._transpile_main_program()
+        self._validate_emitted()
         return self
+
+    def _validate_emitted(self):
+        """Validation tier 2 at EMISSION time: re-verify the collective
+        plan this transpiler just wrote into the main program
+        (analysis/validate.py validate_transpiled), closing the gap PR
+        14 left — the engine's tier-2 hook only fires when the program
+        is later traced, but a malformed emitted plan should fail in
+        the rank that produced it, before the ring can hang."""
+        from ..core.flags import FLAGS
+        if not (FLAGS.validate_program
+                and int(FLAGS.validate_tier) >= 2):
+            return
+        from ..analysis.validate import validate_transpiled
+        validate_transpiled(
+            self.main_program,
+            label=f"transpiled rank {self.rank}/{self.nranks} "
+                  f"({type(self).__name__})")
 
     # -- startup: comm bootstrap (reference collective.py:113-123) ---------
     def _transpile_startup_program(self):

@@ -51,16 +51,21 @@ EXIT_USAGE = 2
 ENGINE_PATH = os.path.join(_REPO, "paddle_tpu", "core", "engine.py")
 KEY_FUNCTIONS = ("_cache_key", "_fast_key", "_tuning_key_items")
 
-# Modules whose code executes while the engine traces a step (kernel
-# selection, partitioning, stability gates, bucket planning). A flag
-# read anywhere else happens at dispatch/observation time and cannot
-# poison the trace cache.
+# Modules whose code executes while a step's lowering is DECIDED — for
+# the engine that is trace time (kernel selection, partitioning,
+# stability gates, bucket planning); for the transpiler it is emission
+# time (the c_allreduce_* plan is baked into the program); for dygraph
+# it is the per-call eager path whose fused-allreduce callable is
+# memoized per quantize mode. A flag read anywhere else happens at
+# dispatch/observation time and cannot poison a cached artifact.
 TRACE_MODULES = (
     "paddle_tpu/core/engine.py",
     "paddle_tpu/core/scheduler.py",
     "paddle_tpu/kernels/",
     "paddle_tpu/stability/",
     "paddle_tpu/parallel/comm_scheduler.py",
+    "paddle_tpu/transpiler/",
+    "paddle_tpu/dygraph/",
 )
 
 # Reads inside TRACE_MODULES that are deliberately NOT part of the
@@ -88,13 +93,27 @@ def _const_str(node) -> Optional[str]:
     return None
 
 
-def _is_os_environ(node) -> bool:
+def _os_aliases(tree) -> Set[str]:
+    """Every name the module binds to the os module (``import os``,
+    ``import os as _os``) — an aliased import must not hide an env
+    read from the scan (dygraph/parallel.py imports ``os as _os``)."""
+    names = {"os"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "os":
+                    names.add(alias.asname or "os")
+    return names
+
+
+def _is_os_environ(node, os_names: Set[str]) -> bool:
     return (isinstance(node, ast.Attribute) and node.attr == "environ"
             and isinstance(node.value, ast.Name)
-            and node.value.id == "os")
+            and node.value.id in os_names)
 
 
-def _read_name(node) -> Optional[str]:
+def _read_name(node, os_names: Set[str] = frozenset(("os",))
+               ) -> Optional[str]:
     """The canonical name of a flag/env read at this AST node, or None.
 
     Returns "FLAGS.<attr>" or the "PT_*" env var name.
@@ -115,17 +134,19 @@ def _read_name(node) -> Optional[str]:
                     return f"FLAGS.{s}"
         # os.environ.get("PT_...") / os.getenv("PT_...")
         if isinstance(f, ast.Attribute):
-            if f.attr == "get" and _is_os_environ(f.value) and node.args:
+            if f.attr == "get" and _is_os_environ(f.value, os_names) \
+                    and node.args:
                 s = _const_str(node.args[0])
                 if s and s.startswith("PT_"):
                     return s
             if f.attr == "getenv" and isinstance(f.value, ast.Name) \
-                    and f.value.id == "os" and node.args:
+                    and f.value.id in os_names and node.args:
                 s = _const_str(node.args[0])
                 if s and s.startswith("PT_"):
                     return s
     # os.environ["PT_..."]
-    if isinstance(node, ast.Subscript) and _is_os_environ(node.value):
+    if isinstance(node, ast.Subscript) and \
+            _is_os_environ(node.value, os_names):
         s = _const_str(node.slice)
         if s and s.startswith("PT_"):
             return s
@@ -170,6 +191,7 @@ def scan_reads(paths: List[str]) -> List[Tuple[str, int, str]]:
                 out.append((path, exc.lineno or 0,
                             f"<unparseable: {exc.msg}>"))
                 continue
+        os_names = _os_aliases(tree)
         if os.path.abspath(path) == os.path.abspath(ENGINE_PATH):
             # the key functions READ the flags to key them; those
             # sites are the fix, not the bug
@@ -181,7 +203,7 @@ def scan_reads(paths: List[str]) -> List[Tuple[str, int, str]]:
                                    ast.AsyncFunctionDef))
                 and fn.name in KEY_FUNCTIONS]
         for node in ast.walk(tree):
-            name = _read_name(node)
+            name = _read_name(node, os_names)
             if name is None:
                 continue
             lineno = getattr(node, "lineno", 0)

@@ -18,6 +18,9 @@ Usage:
       --inject island_conflict
   python tools/lint_program.py --model mlp --check-memory 2e9 --batch 64
   python tools/lint_program.py --model mlp --check-cost
+  python tools/lint_program.py --model mlp --check-conformance
+  python tools/lint_program.py --model mlp --check-conformance \
+      --inject dropped_bucket
   python tools/lint_program.py --all-models
 
 ``--inject`` corrupts the program before linting (dev aid + the CLI's
@@ -221,10 +224,16 @@ def _parser():
                                         "dead_output",
                                         "shuffled_collectives",
                                         "island_conflict",
-                                        "donated_read"],
+                                        "donated_read",
+                                        "dropped_bucket",
+                                        "skipped_guard",
+                                        "missing_shard_hint"],
                    help="corrupt the program before linting "
                         "(island_conflict / donated_read corrupt the "
-                        "scheduler partition and need --check-races)")
+                        "scheduler partition and need --check-races; "
+                        "dropped_bucket / skipped_guard / "
+                        "missing_shard_hint corrupt one path's lowering "
+                        "trace and need --check-conformance)")
     p.add_argument("--shards", type=int, default=1,
                    help="transpile the model into N data-parallel shard "
                         "programs and also check collective ordering")
@@ -271,6 +280,13 @@ def _parser():
                         "table, and the transpiled shard programs must "
                         "issue an identical collective sequence; exits "
                         "non-zero on gaps, ambiguity, or divergence")
+    p.add_argument("--check-conformance", action="store_true",
+                   help="cross-path lowering conformance (docs/"
+                        "STATIC_ANALYSIS.md): extract the canonical "
+                        "lowering trace on the engine / scheduler / "
+                        "transpiled / dygraph paths and diff them "
+                        "against the declared support matrix; exits "
+                        "non-zero on any undeclared divergence")
     p.add_argument("--batch", type=int, default=64, metavar="N",
                    help="value substituted for dynamic (-1) dims in "
                         "--check-memory/--check-cost plans (default 64)")
@@ -494,6 +510,37 @@ def _check_placement(model: str, batch: int, n_shards: int = 2,
     return rc
 
 
+def _check_conformance(model: str, batch: int, inject=None,
+                       label="") -> int:
+    """Cross-path lowering conformance (docs/STATIC_ANALYSIS.md): the
+    engine / scheduler / transpiled / dygraph paths must lower `model`
+    identically modulo the declared support matrix. Undeclared drift
+    is an error; ``--inject dropped_bucket/skipped_guard/
+    missing_shard_hint`` simulates a one-path lowering regression and
+    must flip the exit code (the CLI's own self-test)."""
+    from paddle_tpu.analysis import (conformance_summary, extract_traces,
+                                     format_report, has_errors,
+                                     inject_drift, verify_conformance)
+    from paddle_tpu.analysis.conformance import TraceConfig
+    program, _, feed_names, loss = build_model(model)
+    shards, _, _ = transpile_shards(model, 2)
+    cfg = TraceConfig.capability(dynamic_dim=batch)
+    traces = extract_traces(program, fetch_names=[loss.name], config=cfg,
+                            transpiled_program=shards[0])
+    if inject:
+        print(f"injected: {inject_drift(traces, inject)}")
+    diags = verify_conformance(program, fetch_names=[loss.name],
+                               config=cfg, traces=traces,
+                               transpiled_program=shards[0], label=label)
+    s = conformance_summary(diags)
+    print(format_report(
+        diags, header=f"check-conformance {label}: "
+                      f"{len(traces)} paths, "
+                      f"{s['declared']} declared / "
+                      f"{s['undeclared']} undeclared divergence(s)"))
+    return EXIT_ERRORS if has_errors(diags) else EXIT_CLEAN
+
+
 def _all_models(batch: int) -> int:
     """CI gate: every named book model must pass the full pipeline
     (zero errors) AND verify race-free under the scheduler partition."""
@@ -509,6 +556,8 @@ def _all_models(batch: int) -> int:
         if _check_races(program, [loss.name], label=name) != EXIT_CLEAN:
             rc = EXIT_ERRORS
         if _check_placement(name, batch, label=name) != EXIT_CLEAN:
+            rc = EXIT_ERRORS
+        if _check_conformance(name, batch, label=name) != EXIT_CLEAN:
             rc = EXIT_ERRORS
     return rc
 
@@ -538,6 +587,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("lint_program: --inject island_conflict/donated_read "
               "corrupts the scheduler partition and requires "
               "--check-races", file=sys.stderr)
+        return EXIT_USAGE
+    from paddle_tpu.analysis.conformance import DRIFT_KINDS
+    if ns.inject in DRIFT_KINDS and not ns.check_conformance:
+        print("lint_program: --inject dropped_bucket/skipped_guard/"
+              "missing_shard_hint corrupts a lowering trace and "
+              "requires --check-conformance", file=sys.stderr)
+        return EXIT_USAGE
+    if ns.check_conformance and not ns.model:
+        print("lint_program: --check-conformance requires --model",
+              file=sys.stderr)
         return EXIT_USAGE
 
     feed_names = None
@@ -569,7 +628,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             fetch_names = [loss.name]
 
     if ns.check_races or ns.check_memory is not None or ns.check_cost \
-            or ns.check_placement:
+            or ns.check_placement or ns.check_conformance:
         rc = EXIT_CLEAN
         if ns.check_races:
             inj = ns.inject if ns.inject in _partition_injects else None
@@ -589,6 +648,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             rc = max(rc, _check_placement(ns.model, ns.batch,
                                           max(2, ns.shards),
                                           label=label))
+        if ns.check_conformance:
+            inj = ns.inject if ns.inject in DRIFT_KINDS else None
+            rc = max(rc, _check_conformance(ns.model, ns.batch,
+                                            inject=inj, label=label))
         return rc
 
     if ns.inject:

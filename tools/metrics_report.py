@@ -79,6 +79,9 @@ REQUIRED_FAMILIES = (
     "pt_placement_searches_total", "pt_placement_cache_hits_total",
     "pt_placement_search_seconds", "pt_placement_predicted_ms",
     "pt_placement_collective_bytes",
+    # cross-path lowering conformance (docs/STATIC_ANALYSIS.md)
+    "pt_conformance_checks_total", "pt_conformance_divergences_total",
+    "pt_conformance_verify_seconds",
 )
 
 
