@@ -150,10 +150,23 @@ def _mfu_lines(name, sps, sync_ms, stats):
     kind, peak = _device_peak()
     lines = []
     if stats and stats.get("flops"):
-        fl = stats["flops"]
-        tfs = fl * sps / 1e12
-        line = (f"# {name}: roofline: {fl/1e12:.3f} TFLOPs/step x "
-                f"{sps:.2f} steps/s = {tfs:.1f} TFLOP/s")
+        # XLA cost_analysis counts a while/scan body ONCE, so
+        # stats["flops"] is ~per-substep even for scanned executables
+        # (num_iteration_per_run / PT_MULTI_STEP); `sps` counts
+        # substeps too. Scale both to per-DISPATCH with the trip count
+        # so every substep is counted exactly once and the scanned
+        # path can't report impossibly low MFU.
+        trip = float(stats.get("trip_count") or 1.0)
+        fl = stats["flops"] * trip
+        tfs = fl * (sps / trip) / 1e12
+        if trip > 1:
+            line = (f"# {name}: roofline: {fl/1e12:.3f} "
+                    f"TFLOPs/dispatch ({stats['flops']/1e12:.3f} "
+                    f"body x trip {trip:.0f}) x {sps/trip:.2f} "
+                    f"dispatches/s = {tfs:.1f} TFLOP/s")
+        else:
+            line = (f"# {name}: roofline: {fl/1e12:.3f} TFLOPs/step x "
+                    f"{sps:.2f} steps/s = {tfs:.1f} TFLOP/s")
         if peak:
             mfu = tfs / peak
             line += f" -> MFU {mfu*100:.1f}% of {kind} peak {peak:.0f}"
@@ -253,6 +266,61 @@ def _probe_scheduler(eng, prog, scope, feed, fetch, sync_off_ms):
         out["error"] = f"{type(exc).__name__}: {exc}"[:200]
     finally:
         set_flags({"FLAGS_op_scheduler": prev})
+    return out
+
+
+def _probe_multistep(eng, prog, scope, feed, fetch, sync_ms_k1):
+    """A/B multi-step dispatch (PT_MULTI_STEP, docs/ASYNC_DISPATCH.md
+    "Multi-step dispatch"): stack K copies of the batch into one
+    FeedSlab, dispatch the K-substep scanned executable, and compare
+    the amortized per-substep fetch-fenced latency against the K=1
+    sync step above. The host-phase share (host dispatches per device
+    substep) before/after says where the win comes from: K substeps
+    now pay ONE tunnel RTT + one dispatch."""
+    import jax
+    from paddle_tpu.reader.prefetcher import FeedSlab
+    k = int(os.environ.get("PT_BENCH_MULTISTEP_K", "4"))
+    out = {"k": k, "sync_ms_k1": round(sync_ms_k1, 2)}
+
+    def _np(o):
+        return np.asarray(o.array if hasattr(o, "array") else o)
+
+    try:
+        batch = {kk: jax.device_put(np.asarray(v))
+                 for kk, v in feed.items()}
+        slab = FeedSlab.stack([batch] * k)
+        d0 = eng.counters["multistep_dispatches"]
+        s0 = eng.counters["multistep_substeps"]
+        for _ in range(3):
+            rows = eng.run_multi(prog, scope, None, slab, fetch,
+                                 return_numpy=False)
+        float(_np(rows[-1][0]))
+        ts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            rows = eng.run_multi(prog, scope, None, slab, fetch,
+                                 return_numpy=False)
+            float(_np(rows[-1][0]))
+            ts.append(time.perf_counter() - t0)
+        slab_ms = sorted(ts)[len(ts) // 2] * 1e3
+        d = eng.counters["multistep_dispatches"] - d0
+        s = eng.counters["multistep_substeps"] - s0
+        out["slab_ms"] = round(slab_ms, 2)
+        out["amortized_ms_per_step"] = round(slab_ms / k, 2)
+        if sync_ms_k1:
+            out["improvement_frac"] = round(
+                1.0 - (slab_ms / k) / sync_ms_k1, 3)
+        # host-phase share: dispatches per substep (K=1 pays one host
+        # dispatch EVERY substep by definition)
+        out["host_share_before"] = 1.0
+        out["host_share_after"] = round(d / s, 3) if s else None
+        out["counters"] = {
+            "multistep_dispatches": d,
+            "multistep_substeps": s,
+            "multistep_early_exits":
+                eng.counters["multistep_early_exits"]}
+    except Exception as exc:   # accounting only; never fail the bench
+        out["error"] = f"{type(exc).__name__}: {exc}"[:200]
     return out
 
 
@@ -662,6 +730,10 @@ def bench_transformer(batch=BATCH, seq=None, measure_ckpt=False):
             # scheduler_overlap JSON tail (ROADMAP open item 4)
             stats = stats or {}
             stats["scheduler"] = _probe_scheduler(
+                eng, main_prog, scope, feed, [cost.name], sync_ms)
+            # K-substep fused-dispatch A/B for the multistep JSON
+            # tail (PT_MULTI_STEP, docs/ASYNC_DISPATCH.md)
+            stats["multistep"] = _probe_multistep(
                 eng, main_prog, scope, feed, [cost.name], sync_ms)
             # guard-on sync A/B for the stability JSON tail
             stats["stability"] = _probe_guard(
@@ -1100,6 +1172,13 @@ def main():
             (stats or {}).get("scheduler"))
     except Exception:
         pass   # accounting only; never fail the bench on it
+    mstep, mstep_line = {}, None
+    try:
+        from tools.step_overhead_bench import multistep_report
+        mstep, mstep_line = multistep_report(
+            (stats or {}).get("multistep"))
+    except Exception:
+        pass   # accounting only; never fail the bench on it
     stab, stab_line = {}, None
     try:
         from tools.step_overhead_bench import guard_overhead_report
@@ -1169,6 +1248,7 @@ def main():
         "vs_baseline": round(tokens_per_sec / V100_TOKENS_PER_SEC, 3),
         "comm_overlap": comm or None,
         "scheduler_overlap": sched or None,
+        "multistep": mstep or None,
         "stability": stab or None,
         "kernels": kern or None,
         "tracing": trac or None,
@@ -1181,6 +1261,8 @@ def main():
         print(comm_line, file=sys.stderr)
     if sched_line:
         print(sched_line, file=sys.stderr)
+    if mstep_line:
+        print(mstep_line, file=sys.stderr)
     if stab_line:
         print(stab_line, file=sys.stderr)
     if kern_line:

@@ -511,6 +511,29 @@ def extract_trace(program, path: str, block_idx: int = 0,
         tr.record("shard_hints", False, (),
                   note="no activation scope on this path")
 
+    # multi-step dispatch (PT_MULTI_STEP): only the engine whole-block
+    # trace compiles the K-substep scan driver; the other paths
+    # dispatch per step (declared in analysis/support_matrix.py)
+    if path == "engine":
+        tr.record("multi_step", True,
+                  ("driver=scan-carry-freeze",
+                   "early_exit=guard-verdict",
+                   "per_substep_phase_spans=false"),
+                  note="lax.scan over K stacked feed batches; a guard "
+                       "verdict freezes the carry for early break-out "
+                       "(core/engine.py trace_step)")
+    elif path == "scheduler":
+        tr.record("multi_step", False, (),
+                  note="scheduler_gate returns False for "
+                       "multi_step > 1 (core/scheduler.py)")
+    elif path == "transpiled":
+        tr.record("multi_step", False, (),
+                  note="explicit-collective programs dispatch per "
+                       "step; no scan driver is emitted")
+    else:  # dygraph
+        tr.record("multi_step", False, (),
+                  note="eager execution has no compiled step to scan")
+
     # cache keying + tier-2 verifier coverage
     tr.record("cache_key", True, _cache_key_content(path))
     tr.record("tier2_verifier", True, _tier2_content(path))

@@ -46,6 +46,7 @@ FEATURES: Tuple[str, ...] = (
     "shard_hints",               # multi-axis sharding constraints attached
     "cache_key",                 # which knobs key the compiled artifact
     "tier2_verifier",            # runtime re-verification coverage
+    "multi_step",                # PT_MULTI_STEP K-substep scan driver
 )
 
 SUPPORTED = "supported"
@@ -225,6 +226,33 @@ def default_matrix() -> SupportMatrix:
         "(should_quantize) is shared, the wire format is not "
         "(parallel/comm_scheduler.py _apply_bucket vs "
         "ops/collective.py c_allreduce_fused).")
+
+    # -- multi-step dispatch (PT_MULTI_STEP, docs/ASYNC_DISPATCH.md):
+    #    only the engine whole-block trace compiles the K-substep scan
+    #    driver, and even there observability is coarser per substep.
+    m.declare(
+        "multi_step", "engine", DEGRADED,
+        "the K-substep lax.scan driver runs bit-identical to K "
+        "sequential steps, but per-substep flight-recorder phase "
+        "spans collapse into ONE dispatch span (the recorder sees one "
+        "run()), ghost-snapshot cadence counts slabs rather than "
+        "substeps, and a guard-on slab pays one verdict sync per slab "
+        "with the whole-slab re-dispatch standing in for per-step "
+        "re-execution (core/engine.py trace_step multi-step branch).")
+    m.declare(
+        "multi_step", "scheduler", UNSUPPORTED,
+        "scheduler_gate returns False for multi_step > 1: island "
+        "lanes dispatch per step and cannot carry the cross-substep "
+        "scan carry (core/scheduler.py scheduler_gate).")
+    m.declare(
+        "multi_step", "transpiled", UNSUPPORTED,
+        "transpiled programs run process-level SPMD with explicit "
+        "c_* collective ops executed per step; no jitted scan driver "
+        "exists to fuse K substeps (transpiler/collective.py).")
+    m.declare(
+        "multi_step", "dygraph", UNSUPPORTED,
+        "eager per-op execution has no compiled step to scan; K "
+        "substeps are simply K eager steps (dygraph/parallel.py).")
 
     assert not m.validate()
     return m

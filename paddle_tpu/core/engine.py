@@ -83,6 +83,10 @@ class TracedStep:
         self.updated_names = updated_names
         self.fetch_lods = fetch_lods  # name -> lod (host metadata)
         self.uses_rng = uses_rng
+        # PT_MULTI_STEP: K > 1 means fn scans K stacked batches through
+        # one executable and returns (stacked_fetches, updated,
+        # nan_flags, ms_info) instead of the 3-tuple contract
+        self.multi_step = 1
         # live reference to the trace's (op_type, var_name) label box, one
         # entry per all-finite flag when check_nan_inf is on. A reference,
         # not a snapshot: on the eager-interpreter path the box is only
@@ -408,6 +412,60 @@ def _loop_fallback(fn, iterations):
     return looped
 
 
+def _multi_loop_fallback(fn, k):
+    """PT_MULTI_STEP on the eager/islands paths: host loop over the K
+    stacked batches with the same split-per-substep RNG chain the
+    compiled scan driver uses, so trajectories stay bit-identical to K
+    sequential dispatches. The guard verdict is checked per substep
+    (these paths are host-bound anyway) so an anomaly breaks out early
+    exactly like the compiled carry freeze."""
+
+    def multi(donated_params, const_params, feeds, key):
+        from ..stability.guard import GUARD_VERDICT_VAR
+        donated = dict(donated_params)
+        const = dict(const_params)
+        merged_upd = {}
+        nf_acc = None
+        fs_list = []
+        rng = key
+        valid = 0
+        for _j in range(k):
+            pair = jax.random.split(rng)
+            step_key, rng_next = pair[0], pair[1]
+            sub = {n: v[_j] for n, v in feeds.items()}
+            f, upd, nf = fn(donated, const, sub, step_key)
+            fs_list.append(f)
+            if nf_acc is None or (isinstance(nf_acc, tuple)
+                                  and not nf_acc):
+                nf_acc = nf
+            else:
+                nf_acc = jax.tree_util.tree_map(jnp.logical_and,
+                                                nf_acc, nf)
+            merged_upd.update(upd)
+            for n, v in upd.items():
+                if n in donated:
+                    donated[n] = v
+                elif n in const:
+                    const[n] = v
+            rng = rng_next
+            valid += 1
+            verdict = upd.get(GUARD_VERDICT_VAR)
+            if verdict is not None and int(np.asarray(verdict)) != 0:
+                break
+        # pad to K rows so the stacked fetch shape is stable; consumers
+        # only read rows [:valid] (the host replays the rest)
+        while len(fs_list) < k:
+            fs_list.append(fs_list[-1])
+        fetches = tuple(
+            jnp.stack([fs_list[j][i] for j in range(k)])
+            for i in range(len(fs_list[0])))
+        ms_info = {"rng_state": rng,
+                   "valid": jnp.asarray(valid, jnp.int32)}
+        return fetches, merged_upd, nf_acc, ms_info
+
+    return multi
+
+
 def _activation_scope(mesh, strategy):
     """Trace-time activation-sharding scope (parallel/strategy.py):
     the tp-sharded matmul/attention lowerings in ops/ consult it while
@@ -428,7 +486,8 @@ def _activation_scope(mesh, strategy):
 def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
                feed_lods: Dict[str, list], fetch_names: Sequence[str],
                scope: Scope, mesh=None, data_axis: str = "dp",
-               strategy=None, iterations: int = 1) -> TracedStep:
+               strategy=None, iterations: int = 1,
+               multi_step: int = 1) -> TracedStep:
     """Build + jit the step function for one (program, feed-sig) pair.
 
     With `mesh`, the step is compiled SPMD: feeds sharded on their batch
@@ -436,8 +495,42 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
     partitioner inserts the gradient all-reduces over ICI. This one code
     path replaces the reference's ParallelExecutor graph-cloning +
     AllReduceOpHandle machinery (parallel_executor.cc:356-606,
-    multi_devices_graph_pass.cc:454)."""
+    multi_devices_graph_pass.cc:454).
+
+    With ``multi_step`` K > 1 (PT_MULTI_STEP, docs/ASYNC_DISPATCH.md)
+    ``feed_sig`` describes K-stacked feed slabs (leading K axis) and the
+    compiled step scans K DIFFERENT batches through one dispatched
+    executable; the RNG state, guard/loss-scale state and integrity
+    fingerprints ride the scan carry and a verdict-conditioned carry
+    freeze breaks out early on anomaly."""
     block = program.block(block_idx)
+    multi_step = int(multi_step or 1)
+    if multi_step > 1:
+        if iterations > 1:
+            raise NotImplementedError(
+                "PT_MULTI_STEP cannot combine with "
+                "num_iteration_per_run > 1 — the multi-step scan "
+                "already amortizes dispatch over K batches")
+        if feed_lods:
+            raise NotImplementedError(
+                "PT_MULTI_STEP cannot scan over LoD (ragged) feeds; "
+                "pad to dense first")
+        if mesh is not None and getattr(mesh, "size", 1) > 1:
+            raise NotImplementedError(
+                "PT_MULTI_STEP under a multi-device mesh is not "
+                "supported yet: feed slabs carry a leading K axis the "
+                "batch-dim shardings would mis-shard")
+        sub_sig = {}
+        for n, s in feed_sig.items():
+            if not s.shape or int(s.shape[0]) != multi_step:
+                raise EnforceNotMet(
+                    f"multi-step feed {n!r} must be stacked with a "
+                    f"leading K={multi_step} axis; got shape {s.shape}")
+            sub_sig[n] = jax.ShapeDtypeStruct(tuple(s.shape[1:]),
+                                              s.dtype)
+        # everything below traces the PER-SUBSTEP body; only the final
+        # jitted entry point sees the stacked slabs (as lax.scan xs)
+        feed_sig = sub_sig
     persist_names = _collect_persistable_inputs(program, block, scope)
     # only those actually initialized in scope can be inputs; others must be
     # produced by the block itself (e.g. startup program initializers)
@@ -740,12 +833,15 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
                 params.update(donated_params)
                 return step(params, feeds, key)
 
-            ts = TracedStep(_loop_fallback(eager_fn, iterations),
+            ts = TracedStep(_multi_loop_fallback(eager_fn, multi_step)
+                            if multi_step > 1
+                            else _loop_fallback(eager_fn, iterations),
                             [], avail, sorted(feed_sig),
                             list(fetch_names), [], fetch_lod_box,
                             True, nan_check_labels=nan_labels_box)
             ts.guard_plan = guard_plan  # guard ran inside step()
             ts.integrity_plan = integrity_plan  # ditto (eager step())
+            ts.multi_step = multi_step
             return ts
 
         from .islands import IslandRunner
@@ -785,11 +881,14 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
                     guard_plan, fetches, updated, params, fetch_names)
             return fetches, updated, nan_flags
 
-        ts = TracedStep(_loop_fallback(islands_fn, iterations),
+        ts = TracedStep(_multi_loop_fallback(islands_fn, multi_step)
+                        if multi_step > 1
+                        else _loop_fallback(islands_fn, iterations),
                         [], avail, sorted(feed_sig),
                         list(fetch_names), [], fetch_lod_box, True,
                         nan_check_labels=nan_labels_box)
         ts.guard_plan = guard_plan
+        ts.multi_step = multi_step
         if integrity_plan is not None:
             import warnings as _warnings
             _warnings.warn(
@@ -803,7 +902,8 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
     from .scheduler import scheduler_gate
     if scheduler_gate(program, block_idx, fetch_names, mesh=mesh,
                       iterations=iterations, feed_lods=feed_lods,
-                      integrity_plan=integrity_plan)[0]:
+                      integrity_plan=integrity_plan,
+                      multi_step=multi_step)[0]:
         # programmable operator scheduler (core/scheduler.py,
         # docs/SCHEDULING.md): data-independent islands dispatched on
         # concurrent lanes (accum_k == 1) or a pipelined micro-batch
@@ -863,6 +963,77 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
             nan_flags = jax.tree_util.tree_map(
                 lambda x: jnp.all(x, axis=0), nfs)
             return fetches, upd_out, nan_flags
+    elif multi_step > 1:
+        # PT_MULTI_STEP (docs/ASYNC_DISPATCH.md): K DIFFERENT batches —
+        # stacked on a leading K axis — scan through ONE dispatched
+        # executable, amortizing the per-step host dispatch cost the
+        # bench measures at ~3x the device time. Three invariants:
+        #   1. Bit-identity: the RNG state rides the carry and splits
+        #      per substep exactly like K sequential host dispatches
+        #      (_dispatch_inner's jax.random.split), and guard EMA /
+        #      loss scale / integrity fingerprints chain through the
+        #      donated carry just as they chain through the scope — so
+        #      anomaly-free trajectories match K=1 bit-for-bit.
+        #   2. Early break-out: a nonzero guard verdict at substep j
+        #      freezes the carry (params, RNG) for substeps > j — the
+        #      gate already kept the pre-anomaly params at substep j
+        #      itself, so the slab lands on the pre-anomaly step and the
+        #      host replays the unconsumed batches after running policy.
+        #   3. Frozen substeps still execute (a scan body cannot
+        #      shrink) but every output is discarded: fetches/extras
+        #      index the last VALID substep and frozen nan flags are
+        #      masked so garbage compute cannot trip check_nan_inf.
+        donated_set = set(donated)
+        has_guard = guard_plan is not None
+        if has_guard:
+            from ..stability.guard import GUARD_VERDICT_VAR as _verd
+
+        def step2(donated_params, const_params, feeds, key):
+            # `key` here is the RAW rng STATE, not a step key: the
+            # per-substep split happens inside the carry
+            def body(carry, sub_feeds):
+                state, rng, halted = carry
+                pair = jax.random.split(rng)
+                step_key, rng_next = pair[0], pair[1]
+                f, upd, nf = step1(state, const_params, sub_feeds,
+                                   step_key)
+                new_state = {n: upd.get(n, state[n]) for n in state}
+                if has_guard:
+                    frozen = {n: jnp.where(halted, state[n],
+                                           new_state[n])
+                              for n in state}
+                    rng2 = jnp.where(halted, rng, rng_next)
+                    trip = jnp.any(upd[_verd] != 0) \
+                        if _verd in upd else jnp.zeros((), dtype=bool)
+                    halted2 = jnp.logical_or(halted, trip)
+                    nf2 = jax.tree_util.tree_map(
+                        lambda x: jnp.logical_or(x, halted), nf)
+                else:
+                    frozen, rng2, halted2, nf2 = (new_state, rng_next,
+                                                  halted, nf)
+                extra = {n: v for n, v in upd.items()
+                         if n not in donated_set}
+                return (frozen, rng2, halted2), (f, extra, nf2, halted)
+
+            halted0 = jnp.zeros((), dtype=bool)
+            (carry, rng_out, _h), (fs, extras, nfs, halted_before) = \
+                jax.lax.scan(body, (dict(donated_params), key, halted0),
+                             feeds)
+            valid = jnp.sum(
+                jnp.logical_not(halted_before)).astype(jnp.int32)
+            last_valid = valid - 1
+            upd_out = {n: carry[n] for n in updated_names
+                       if n in carry}
+            upd_out.update({
+                n: jax.lax.dynamic_index_in_dim(
+                    v, last_valid, axis=0, keepdims=False)
+                for n, v in extras.items()})
+            nan_flags = jax.tree_util.tree_map(
+                lambda x: jnp.all(x, axis=0), nfs)
+            ms_info = {"rng_state": rng_out, "valid": valid}
+            # fetches stay stacked (K, ...): the dispatch slices per
+            # substep lazily so losses materialize without a sync
+            return tuple(fs), upd_out, nan_flags, ms_info
     else:
         step2 = step1
 
@@ -926,6 +1097,7 @@ def trace_step(program, block_idx: int, feed_sig: Dict[str, Any],
     ts.comm_stats = comm_stats
     ts.guard_plan = guard_plan
     ts.integrity_plan = integrity_plan
+    ts.multi_step = multi_step
     return ts
 
 
@@ -1055,7 +1227,13 @@ class Engine:
             # automatic SPMD placement (PT_PLACEMENT_AUTO,
             # analysis/placement.py, docs/PARALLELISM.md): cost-model
             # searches run vs plans replayed from the tuning cache
-            "placement_searches": 0, "placement_cache_hits": 0})
+            "placement_searches": 0, "placement_cache_hits": 0,
+            # multi-step scan driver (PT_MULTI_STEP,
+            # docs/ASYNC_DISPATCH.md): slab dispatches, substeps that
+            # actually executed, slabs that broke out early on a guard
+            # verdict, and frozen substeps replayed sequentially
+            "multistep_dispatches": 0, "multistep_substeps": 0,
+            "multistep_early_exits": 0, "multistep_replays": 0})
         _obs.register_engine(self)
         # lazily built per-engine stability controller
         # (FLAGS_stability_guard; paddle_tpu/stability/guard.py)
@@ -1076,6 +1254,11 @@ class Engine:
         self.replicated_feeds = set(replicated_feeds)
         # lazily built when FLAGS.step_timeout_s > 0 (docs/RESILIENCE.md)
         self._watchdog = None
+        # last multi-step dispatch record ({"k", "valid"}) + the
+        # per-substep fetch rows of the last multi-step run()
+        # (docs/ASYNC_DISPATCH.md "Multi-step dispatch")
+        self._last_multi = None
+        self.last_multi_fetches = None
 
     def _step_watchdog(self):
         """The armed-per-dispatch hang detector (FLAGS_step_timeout_s);
@@ -1236,15 +1419,20 @@ class Engine:
                 os.environ.get("PT_PLACEMENT_BUDGET", ""),
                 os.environ.get("PT_MESH_AXES", ""),
                 os.environ.get("PT_MESH_FSDP", ""),
-                os.environ.get("PT_MESH_TP", ""))
+                os.environ.get("PT_MESH_TP", ""),
+                # multi-step scan driver (docs/ASYNC_DISPATCH.md): K is
+                # also an explicit key component where the slab arrives,
+                # but the env knob arms the prefetcher's slab mode, so a
+                # flip must invalidate steady-state entries too
+                os.environ.get("PT_MULTI_STEP", ""))
 
     @staticmethod
     def _cache_key(program, block_idx, feed_sig_key, fetch_names,
-                   iterations=1):
+                   iterations=1, multi_step=1):
         return (program.fingerprint, block_idx, feed_sig_key,
                 tuple(fetch_names), bool(FLAGS.check_nan_inf),
                 int(getattr(program, "_gradient_accumulation_steps", 1)
-                    or 1), int(iterations),
+                    or 1), int(iterations), int(multi_step),
                 float(FLAGS.allreduce_bucket_mb),
                 str(FLAGS.quantized_allreduce),
                 bool(FLAGS.sharded_weight_update),
@@ -1266,7 +1454,8 @@ class Engine:
                 *Engine._tuning_key_items())
 
     def compiled_step(self, program, scope: Scope, feed, fetch_names,
-                      block_idx: int = 0, iterations: int = 1):
+                      block_idx: int = 0, iterations: int = 1,
+                      multi_step: int = 1):
         """The XLA-compiled executable of the already-run step (lowered
         once and cached on the traced entry). Returns None on the
         eager-interpreter fallback. The single source for everything
@@ -1275,17 +1464,19 @@ class Engine:
         tools/time_report.py)."""
         compiled, _ = self._compiled_entry(program, scope, feed,
                                            fetch_names, block_idx,
-                                           iterations)
+                                           iterations, multi_step)
         return compiled
 
     def _compiled_entry(self, program, scope, feed, fetch_names,
-                        block_idx=0, iterations=1):
+                        block_idx=0, iterations=1, multi_step=1):
         """(compiled, traced) as ONE pair — no cross-call state."""
+        multi_step = max(int(multi_step or 1),
+                         int(getattr(feed, "multi_step", 1) or 1))
         arrays, lods, feed_sig_key = self._normalize_feed(feed, None)
         if self._is_multihost():
             feed_sig_key = self._global_sig_key(arrays, lods)
         key = self._cache_key(program, block_idx, feed_sig_key,
-                              fetch_names, iterations)
+                              fetch_names, iterations, multi_step)
         traced = self._cache.get(key)
         if traced is None:
             if self._cache:
@@ -1318,8 +1509,9 @@ class Engine:
         return compiled, traced
 
     def compiled_stats(self, program, scope: Scope, feed, fetch_names,
-                       block_idx: int = 0,
-                       iterations: int = 1) -> Optional[Dict[str, float]]:
+                       block_idx: int = 0, iterations: int = 1,
+                       multi_step: int = 1
+                       ) -> Optional[Dict[str, float]]:
         """XLA analytical cost of the already-compiled step: flops,
         bytes accessed, and temp (scratch) memory per step. Returns None
         on the eager-interpreter fallback (nothing is compiled there).
@@ -1328,7 +1520,8 @@ class Engine:
         (/root/reference/paddle/fluid/operators/benchmark/op_tester.cc).
         """
         compiled, traced = self._compiled_entry(
-            program, scope, feed, fetch_names, block_idx, iterations)
+            program, scope, feed, fetch_names, block_idx, iterations,
+            multi_step)
         if compiled is None:
             return None
         cached = getattr(traced, "_stats_cache", None)
@@ -1338,11 +1531,19 @@ class Engine:
         if isinstance(ca, (list, tuple)):
             ca = ca[0] if ca else {}
         # XLA cost_analysis counts a while/scan body ONCE (trip counts
-        # are not multiplied in), so a num_iteration_per_run executable
-        # already reports ~per-step costs — no normalization needed
+        # are not multiplied in), so flops/bytes here are ~per-STEP
+        # costs even for scanned executables. `trip_count` carries the
+        # steps-per-DISPATCH multiplier (num_iteration_per_run x
+        # PT_MULTI_STEP): anything dividing by per-dispatch device time
+        # (pt_mfu_estimate, the bench roofline) must multiply body
+        # FLOPs by it or the scanned path reports impossibly low MFU.
         out = {"flops": float(ca.get("flops", 0.0)),
                "bytes_accessed":
-                   float(ca.get("bytes accessed", 0.0))}
+                   float(ca.get("bytes accessed", 0.0)),
+               "trip_count": float(
+                   max(1, int(iterations)) *
+                   max(1, int(multi_step or 1),
+                       int(getattr(traced, "multi_step", 1) or 1)))}
         try:
             ma = compiled.memory_analysis()
             out["temp_bytes"] = float(ma.temp_size_in_bytes)
@@ -1369,9 +1570,11 @@ class Engine:
                 is not None})
         return rows
 
-    def _fast_key(self, program, block_idx, fetch_names, iterations):
+    def _fast_key(self, program, block_idx, fetch_names, iterations,
+                  multi_step=1):
         return (program.fingerprint, block_idx, tuple(fetch_names),
-                int(iterations), bool(FLAGS.check_nan_inf),
+                int(iterations), int(multi_step),
+                bool(FLAGS.check_nan_inf),
                 int(getattr(program, "_gradient_accumulation_steps", 1)
                     or 1),
                 float(FLAGS.allreduce_bucket_mb),
@@ -1511,6 +1714,11 @@ class Engine:
             # from the tuning cache) the mesh layout before the first
             # trace — a caller-supplied mesh/strategy always wins
             self._maybe_place(program, fetch_names)
+        # multi-step slab feed (PT_MULTI_STEP, docs/ASYNC_DISPATCH.md):
+        # a FeedSlab (reader/prefetcher.py) carries K stacked batches
+        # and its K on the `multi_step` attribute — captured before the
+        # fault plan may swap the dict out under us
+        multi_step = int(getattr(feed, "multi_step", 1) or 1)
         self.counters["runs"] += 1
         plan = _fault_plan()
         if plan is not None:
@@ -1542,7 +1750,7 @@ class Engine:
         fast_key = None
         if use_program_cache:
             fast_key = self._fast_key(program, block_idx, fetch_names,
-                                      iterations)
+                                      iterations, multi_step)
             # one entry per live feed signature (entries disagree on
             # shapes, so at most one converts the feed); small list —
             # a training loop sees 1-2 signatures (train + eval tail)
@@ -1564,10 +1772,16 @@ class Engine:
                                    for n, v in entry.donated_vars}
                         const = {n: _var_array(v)
                                  for n, v in entry.const_vars}
-                        return self._dispatch(
+                        outs = self._dispatch(
                             program, scope, entry.traced, arrays,
                             donated, const, return_numpy,
                             updated_vars=entry.updated_vars, obs=obs)
+                        if multi_step > 1:
+                            return self._finish_multi(
+                                outs, program, scope, place, feed,
+                                fetch_names, block_idx,
+                                return_numpy, multi_step)
+                        return outs
         arrays, lods, feed_sig_key = self._normalize_feed(
             feed, None if self.mesh is not None else place)
         multihost = self._is_multihost()
@@ -1592,8 +1806,12 @@ class Engine:
             raise NotImplementedError(
                 "num_iteration_per_run > 1 cannot scan over LoD "
                 "(ragged) feeds; pad to dense first")
+        if multi_step > 1 and lods:
+            raise NotImplementedError(
+                "PT_MULTI_STEP > 1 cannot scan over LoD (ragged) "
+                "feeds; pad to dense first")
         key = self._cache_key(program, block_idx, feed_sig_key,
-                              fetch_names, iterations)
+                              fetch_names, iterations, multi_step)
         traced = self._cache.get(key) if use_program_cache else None
         if traced is None:
             self.counters["traces"] += 1
@@ -1604,7 +1822,8 @@ class Engine:
                                 fetch_names, scope, mesh=self.mesh,
                                 data_axis=self.data_axis,
                                 strategy=self.strategy,
-                                iterations=iterations)
+                                iterations=iterations,
+                                multi_step=multi_step)
             if FLAGS.validate_program and \
                     int(FLAGS.validate_tier) >= 2:
                 # tier 2: re-verify the step we ACTUALLY traced — the
@@ -1674,9 +1893,70 @@ class Engine:
         # cold path only: register the scope with the memory census
         # (one weak-set add per trace, nothing per steady-state step)
         _obs_memory.track_scope(scope)
-        return self._dispatch(program, scope, traced, arrays,
+        outs = self._dispatch(program, scope, traced, arrays,
                               donated_params, const_params,
                               return_numpy, obs=obs)
+        if multi_step > 1:
+            return self._finish_multi(outs, program, scope, place,
+                                      feed, fetch_names, block_idx,
+                                      return_numpy, multi_step)
+        return outs
+
+    def _finish_multi(self, outs, program, scope, place, feed,
+                      fetch_names, block_idx, return_numpy, k):
+        """Post-process one multi-step (PT_MULTI_STEP=K) dispatch.
+
+        ``outs`` is the list of K per-substep fetch rows built by
+        :meth:`_package_multi`. When the stability guard froze the
+        scan carry early (anomaly at substep j), only ``valid``
+        substeps took effect — the frozen tail is replayed host-side
+        through the plain K=1 path, so the post-anomaly trajectory
+        (gated params, halved loss scale) is bit-identical to
+        sequential execution and every batch is consumed exactly
+        once. Returns the LAST substep's row so run() callers see the
+        usual single-step shape; all K rows stay on
+        ``last_multi_fetches``."""
+        rec = self._last_multi or {"k": k, "valid": k}
+        valid = max(1, min(int(rec.get("valid", k)), k))
+        rows = list(outs) if isinstance(outs, list) else [outs]
+        if valid < k:
+            self.counters["multistep_replays"] += (k - valid)
+            for j in range(valid, k):
+                sub = {n: v[j] for n, v in feed.items()}
+                rows[j] = self.run(program, scope, place, sub,
+                                   fetch_names, block_idx=block_idx,
+                                   return_numpy=return_numpy)
+        self.last_multi_fetches = rows
+        return rows[-1] if rows else rows
+
+    def run_multi(self, program, scope: Scope, place, feeds,
+                  fetch_names, block_idx: int = 0,
+                  return_numpy: bool = True,
+                  use_program_cache: bool = True) -> List[Any]:
+        """Run K training steps as ONE dispatched executable.
+
+        ``feeds`` is a FeedSlab (reader/prefetcher.py) or a list of K
+        per-step feed dicts — the latter is stacked here. Returns the
+        K per-substep fetch rows (docs/ASYNC_DISPATCH.md,
+        "Multi-step dispatch"); ``run()`` itself returns only the
+        last row."""
+        from ..reader.prefetcher import FeedSlab
+        if not isinstance(feeds, FeedSlab):
+            feeds = list(feeds)
+            if len(feeds) == 1:
+                out = self.run(program, scope, place, feeds[0],
+                               fetch_names, block_idx=block_idx,
+                               return_numpy=return_numpy,
+                               use_program_cache=use_program_cache)
+                self.last_multi_fetches = [out]
+                return [out]
+            feeds = FeedSlab.stack(feeds)
+        out = self.run(program, scope, place, feeds, fetch_names,
+                       block_idx=block_idx, return_numpy=return_numpy,
+                       use_program_cache=use_program_cache)
+        if int(getattr(feeds, "multi_step", 1) or 1) == 1:
+            self.last_multi_fetches = [out]
+        return self.last_multi_fetches
 
     def _dispatch(self, program, scope, traced, arrays, donated_params,
                   const_params, return_numpy, updated_vars=None,
@@ -1740,7 +2020,15 @@ class Engine:
         RNG split and persistable writebacks stay jax.Array futures and
         the nan-flag host sync moves to the materialization point."""
         rng_key = _get_rng_state(scope, program)
-        step_key, next_state = jax.random.split(rng_key)
+        multi_k = int(getattr(traced, "multi_step", 1) or 1)
+        if multi_k > 1:
+            # multi-step (PT_MULTI_STEP): the scanned executable splits
+            # the rng PER SUBSTEP on device — bit-identical to K
+            # sequential host splits — so it takes the RAW state and
+            # returns the carried state in ms_info["rng_state"]
+            step_key, next_state = rng_key, None
+        else:
+            step_key, next_state = jax.random.split(rng_key)
         t0 = time.perf_counter() if FLAGS.benchmark else None
         _d0 = time.perf_counter() if obs is not None else None
         from .. import profiler as _profiler
@@ -1748,10 +2036,10 @@ class Engine:
             if _profiler.profiling_active():
                 with _profiler.RecordEvent(
                         f"engine_step(program={program.fingerprint[0]})"):
-                    fetches, updated, nan_flags = traced.fn(
+                    res = traced.fn(
                         donated_params, const_params, arrays, step_key)
             else:
-                fetches, updated, nan_flags = traced.fn(
+                res = traced.fn(
                     donated_params, const_params, arrays, step_key)
         except Exception as exc:
             # RESOURCE_EXHAUSTED here = compile/alloc OOM: capture who
@@ -1763,7 +2051,13 @@ class Engine:
             # lands in fetch_ms (sync) or the materialization point
             obs["phases"]["dispatch_ms"] = (time.perf_counter()
                                             - _d0) * 1e3
-        _set_rng_state(scope, next_state)
+        if multi_k > 1:
+            fetches, updated, nan_flags, ms_info = res
+            _set_rng_state(scope, ms_info["rng_state"])
+        else:
+            fetches, updated, nan_flags = res
+            ms_info = None
+            _set_rng_state(scope, next_state)
         comm_stats = getattr(traced, "comm_stats", None)
         if comm_stats:
             c = self.counters
@@ -1812,8 +2106,8 @@ class Engine:
                 ctl = self._stability = StabilityGuard()
             action = ctl.after_step(
                 self, program, scope, traced, arrays, fetches,
-                updated, rng_key, async_defer, obs=obs,
-                reexec=_guard_reexec)
+                updated, rng_key, async_defer and multi_k == 1,
+                obs=obs, reexec=_guard_reexec)
             self.counters["guard_overhead_ms"] += (
                 time.perf_counter() - _g0) * 1e3
             if _obs.telemetry_active():
@@ -1849,6 +2143,37 @@ class Engine:
             # step, not inside it)
             ctl.after_step(self, program, scope, traced, updated,
                            obs=obs)
+        if multi_k > 1:
+            # executed-substep count: guard-off slabs run all K by
+            # construction (no sync); guard-on pays ONE scalar sync per
+            # slab — amortized 1/K vs the per-step verdict sync of K=1
+            valid = multi_k
+            if guard_plan is not None and ms_info is not None:
+                valid = int(np.asarray(ms_info["valid"]))
+                valid = max(1, min(valid, multi_k))
+            self._last_multi = {"k": multi_k, "valid": valid}
+            c = self.counters
+            c["multistep_dispatches"] += 1
+            c["multistep_substeps"] += valid
+            if valid < multi_k:
+                c["multistep_early_exits"] += 1
+            if _obs.telemetry_active():
+                _obs.gauge(
+                    "pt_multistep_k",
+                    "substeps fused per dispatched executable "
+                    "(PT_MULTI_STEP)").set(multi_k)
+                _obs.counter(
+                    "pt_multistep_dispatches_total",
+                    "multi-step slab dispatches").inc(1)
+                _obs.counter(
+                    "pt_multistep_substeps_total",
+                    "training substeps executed inside multi-step "
+                    "slabs").inc(valid)
+                if valid < multi_k:
+                    _obs.counter(
+                        "pt_multistep_early_exits_total",
+                        "slabs cut short by a guard verdict "
+                        "(carry freeze)").inc(1)
         rec = None
         if traced.nan_check_labels:
             if async_defer:
@@ -1871,6 +2196,10 @@ class Engine:
             jax.block_until_ready(fetches)
             print(f"[FLAGS_benchmark] step {time.perf_counter() - t0:.6f}s "
                   f"program={program.fingerprint}")
+        if multi_k > 1:
+            return self._package_multi(traced, fetches, rec, program,
+                                       async_defer, return_numpy,
+                                       obs, arrays, multi_k)
 
         out = []
         if async_defer:
@@ -1910,6 +2239,55 @@ class Engine:
                                          - _f0) * 1e3
             self._obs_finish(obs, arrays)
         return out
+
+    def _package_multi(self, traced, fetches, rec, program,
+                       async_defer, return_numpy, obs, arrays, k):
+        """Package one multi-step dispatch's stacked fetches into K
+        per-substep rows. Async: each row holds lazy FetchHandles over
+        device-side row slices, so per-substep losses materialize
+        individually without a slab-wide sync; sync: one host
+        transfer per stacked fetch, then row views."""
+        rows = []
+        if async_defer:
+            from .async_dispatch import FetchHandle
+            tctx = _obs_tracing.current_context() \
+                if obs is not None else None
+            for j in range(k):
+                row = []
+                for n, v in zip(traced.fetch_names, fetches):
+                    h = FetchHandle(v[j], traced.fetch_lods.get(n),
+                                    rec, f"{n}[{j}]",
+                                    program.fingerprint, tctx=tctx)
+                    if obs is not None:
+                        _obs_memory.track_fetch_handle(h)
+                    row.append(h)
+                rows.append(row)
+            if obs is not None:
+                obs["pending_fetches"] = len(self._pending)
+                obs["phases"]["fetch_ms"] = 0.0  # deferred to handles
+                self._obs_finish(obs, arrays)
+            return rows
+        _f0 = time.perf_counter() if obs is not None else None
+        try:
+            hosts = [np.asarray(v) for v in fetches]
+        except Exception as exc:
+            _obs_memory.oom_postmortem(exc, where="fetch")
+            raise
+        for j in range(k):
+            row = []
+            for n, v, hv in zip(traced.fetch_names, fetches, hosts):
+                lod = traced.fetch_lods.get(n)
+                if return_numpy and not lod:
+                    row.append(hv[j])
+                else:
+                    row.append(LoDTensor(v[j], lod or []))
+            rows.append(row)
+        if obs is not None:
+            obs["pending_fetches"] = len(self._pending)
+            obs["phases"]["fetch_ms"] = (time.perf_counter()
+                                         - _f0) * 1e3
+            self._obs_finish(obs, arrays)
+        return rows
 
     def synchronize(self):
         """Materialization barrier for FLAGS.async_dispatch: drain every

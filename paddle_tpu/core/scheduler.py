@@ -369,7 +369,8 @@ def scheduler_gate(program, block_idx: int = 0,
                    mesh=None, iterations: int = 1, feed_lods=None,
                    integrity_plan=None,
                    updated_names: Optional[Sequence[str]] = None,
-                   check_partition: bool = False
+                   check_partition: bool = False,
+                   multi_step: int = 1
                    ) -> Tuple[bool, str]:
     """The island-path gate as ONE shared predicate: could the op
     scheduler take this (program, runtime state)?
@@ -394,6 +395,9 @@ def scheduler_gate(program, block_idx: int = 0,
     if int(iterations) != 1:
         return False, ("num_iteration_per_run > 1 compiles one "
                        "scanned whole-block executable")
+    if int(multi_step) != 1:
+        return False, ("PT_MULTI_STEP > 1 compiles one scanned "
+                       "whole-block executable")
     if feed_lods:
         return False, "LoD feeds take the whole-block path"
     if check_partition:

@@ -340,7 +340,8 @@ def attribute(engine, program, scope, feed, fetch_names,
     if stats:
         rep["cost"] = {k: stats.get(k)
                        for k in ("flops", "bytes_accessed",
-                                 "temp_bytes", "argument_bytes")
+                                 "temp_bytes", "argument_bytes",
+                                 "trip_count")
                        if stats.get(k) is not None}
         peak_bytes = (stats.get("temp_bytes") or 0.0) + \
             (stats.get("argument_bytes") or 0.0)
@@ -396,7 +397,13 @@ def attribute(engine, program, scope, feed, fetch_names,
         # chip; host wall seconds otherwise (labeled, upper-bounds the
         # true step time so this MFU is a lower bound)
         basis_ms = dev_ms or host_ms
-        mfu = mfu_estimate(stats["flops"], (basis_ms or 0.0) / 1e3)
+        # scanned executables (num_iteration_per_run / PT_MULTI_STEP)
+        # count the scan BODY once in cost_analysis; the measured span
+        # covers the whole dispatch, so body FLOPs scale by the trip
+        # count or the scanned path reports impossibly low MFU
+        trip = float(stats.get("trip_count") or 1.0)
+        mfu = mfu_estimate(stats["flops"] * trip,
+                           (basis_ms or 0.0) / 1e3)
         if mfu is not None:
             rep["mfu_estimate"] = round(mfu, 4)
             rep["mfu_basis"] = "device" if dev_ms else "host_wall"

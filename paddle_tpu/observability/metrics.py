@@ -548,6 +548,22 @@ def _install_standard_families(reg: MetricsRegistry) -> None:
     reg.counter("pt_oom_postmortems_total",
                 "RESOURCE_EXHAUSTED exceptions that produced a memory "
                 "postmortem (deduped: one per exception chain)")
+    # multi-step dispatch (PT_MULTI_STEP, core/engine.py;
+    # docs/ASYNC_DISPATCH.md "Multi-step dispatch")
+    reg.gauge("pt_multistep_k",
+              "substeps fused per dispatched executable "
+              "(PT_MULTI_STEP): the scan trip count of the multi-step "
+              "driver, 1 when slab mode is off")
+    reg.counter("pt_multistep_dispatches_total",
+                "multi-step slab dispatches (each amortizes one "
+                "host dispatch over K training substeps)")
+    reg.counter("pt_multistep_substeps_total",
+                "training substeps executed inside multi-step slabs "
+                "(= dispatches x K when no slab exited early)")
+    reg.counter("pt_multistep_early_exits_total",
+                "slabs cut short by a stability-guard verdict: the "
+                "scan carry froze at the anomalous substep and the "
+                "host replayed the tail through the K=1 path")
     # cross-path lowering conformance (analysis/conformance.py,
     # docs/STATIC_ANALYSIS.md)
     reg.counter("pt_conformance_checks_total",

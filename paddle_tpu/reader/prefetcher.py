@@ -21,7 +21,47 @@ import jax
 
 from ..core.scope import LoDTensor
 
-__all__ = ["DeviceFeedPrefetcher"]
+__all__ = ["DeviceFeedPrefetcher", "FeedSlab"]
+
+
+class FeedSlab(dict):
+    """K stacked feed batches dispatched as ONE multi-step executable.
+
+    A plain feed dict whose values carry a leading K axis and whose
+    ``multi_step`` attribute tells ``Engine.run`` to take the
+    PT_MULTI_STEP scan path (docs/ASYNC_DISPATCH.md, "Multi-step
+    dispatch"). Built by :meth:`stack` or by the prefetcher's slab
+    mode below.
+    """
+
+    multi_step = 1
+
+    @classmethod
+    def stack(cls, feeds) -> "FeedSlab":
+        """Stack K same-signature feed dicts into one slab (leading K
+        axis per value). LoD batches are ragged and cannot stack —
+        callers fall back to per-batch dispatch for those."""
+        feeds = list(feeds)
+        if not feeds:
+            raise ValueError("FeedSlab.stack needs at least one feed")
+        import jax.numpy as jnp
+        slab = cls()
+        for name in feeds[0]:
+            vals = []
+            for f in feeds:
+                v = f[name]
+                if isinstance(v, LoDTensor):
+                    if v.lod():
+                        raise ValueError(
+                            f"feed {name!r} carries LoD offsets; "
+                            f"ragged batches cannot ride a stacked "
+                            f"multi-step slab")
+                    v = v.array
+                vals.append(v if isinstance(v, jax.Array)
+                            else np.asarray(v))
+            slab[name] = jnp.stack(vals)
+        slab.multi_step = len(feeds)
+        return slab
 
 
 class _Err:
@@ -53,12 +93,19 @@ class DeviceFeedPrefetcher:
     swallowed.
     """
 
-    def __init__(self, reader, place=None, depth: Optional[int] = None):
+    def __init__(self, reader, place=None, depth: Optional[int] = None,
+                 multi_step: Optional[int] = None):
+        from ..tuning import knobs
         if depth is None:
-            from ..tuning import knobs
             depth = max(1, int(knobs.value("prefetch_depth")))
         if depth < 1:
             raise ValueError(f"depth must be >= 1, got {depth}")
+        if multi_step is None:
+            # slab mode (PT_MULTI_STEP, tuning/knobs.py): group K
+            # batches into one stacked FeedSlab per queue slot so the
+            # engine dispatches K substeps per executable
+            multi_step = int(knobs.value("multi_step_k"))
+        self._multi_step = max(1, int(multi_step))
         self._reader = reader
         self._place = place
         self._depth = depth
@@ -127,14 +174,36 @@ class DeviceFeedPrefetcher:
             self._consumed = 0
         stop = object()
 
+        k = self._multi_step
+
         def _fill():
             try:
+                group = []
                 for feed in src:
                     # count at pull time: the source's cursor advanced
                     # the moment the fill thread took this batch
                     with self._lock:
                         self._produced += 1
-                    q.put(self._to_device(feed, dev))
+                    if k <= 1:
+                        q.put(self._to_device(feed, dev))
+                        continue
+                    if any(isinstance(v, LoDTensor) and v.lod()
+                           for v in feed.values()):
+                        # ragged batch: cannot ride a stacked slab —
+                        # flush the open group IN ORDER and fall back
+                        # to per-batch dispatch
+                        for g in group:
+                            q.put(g)
+                        group = []
+                        q.put(self._to_device(feed, dev))
+                        continue
+                    group.append(self._to_device(feed, dev))
+                    if len(group) == k:
+                        q.put(FeedSlab.stack(group))
+                        group = []
+                # short tail (< K batches left): plain K=1 steps
+                for g in group:
+                    q.put(g)
                 q.put(stop)
             except BaseException as e:   # propagate, never truncate
                 q.put(_Err(e))
@@ -148,5 +217,10 @@ class DeviceFeedPrefetcher:
             if item is stop:
                 return
             with self._lock:
-                self._consumed += 1
+                # a slab hands K source batches to the consumer at
+                # once — count them all so the state_dict rewind stays
+                # exact in BATCH units (slab-atomic: a kill before
+                # this yield replays the whole slab, exactly-once)
+                self._consumed += int(getattr(item, "multi_step", 1)
+                                      or 1)
             yield item

@@ -68,6 +68,79 @@ def _variants_enabled() -> bool:
         "1", "true", "yes", "on")
 
 
+def _objective_mode() -> str:
+    """``wall`` (default) or ``attribution`` (PT_TUNE_OBJECTIVE): wall
+    scores trials by fetch-fenced step ms alone; attribution adds
+    bounded per-knob waste penalties from the PR 10/12/14 telemetry so
+    credit lands on the knob that owns the waste (docs/TUNING.md)."""
+    mode = os.environ.get("PT_TUNE_OBJECTIVE", "").strip().lower()
+    return mode if mode in ("wall", "attribution") else "wall"
+
+
+def _gauge_sum(name: str) -> Optional[float]:
+    """Sum of a gauge family's sample values, None when never set."""
+    try:
+        from ..observability import metrics
+        fam = metrics.default_registry().get(name)
+        samples = fam.collect().samples
+        if not samples:
+            return None
+        return float(sum(v for _labels, v in samples))
+    except Exception:
+        return None
+
+
+def _attr_signals(engine, c0: Dict[str, float], steps: int
+                  ) -> Dict[str, float]:
+    """Per-knob credit signals measured over one trial: engine-counter
+    deltas (vs the pre-trial snapshot ``c0``) normalized per step, plus
+    attribution gauges. A knob with no live signal contributes nothing
+    — the attribution objective then degrades to pure wall time."""
+    c = engine.counters
+    steps = max(1, int(steps))
+    sig: Dict[str, float] = {}
+    # sched_lanes <- pt_step_lane_idle_seconds: lanes idling inside the
+    # scheduler's phase windows
+    lane = float(c.get("lane_idle_ms", 0.0)) - \
+        float(c0.get("lane_idle_ms", 0.0))
+    if lane > 0:
+        sig["lane_idle_ms"] = lane / steps
+    # allreduce_bucket_mb <- comm-overlap fraction (only meaningful
+    # when the trial actually moved collective bytes)
+    if float(c.get("collective_bytes", 0.0)) > \
+            float(c0.get("collective_bytes", 0.0)):
+        sig["comm_overlap_frac"] = float(
+            c.get("comm_overlap_frac", 0.0))
+    # GEMM/kernel knobs <- measured per-island device seconds
+    isl = _gauge_sum("pt_island_device_seconds")
+    if isl:
+        sig["island_device_ms"] = isl * 1e3
+    # multi_step_k <- host-phase share: the fraction of substeps that
+    # paid a host dispatch round-trip (1.0 at K=1, 1/K in slab mode)
+    sub = float(c.get("multistep_substeps", 0.0)) - \
+        float(c0.get("multistep_substeps", 0.0))
+    disp = float(c.get("multistep_dispatches", 0.0)) - \
+        float(c0.get("multistep_dispatches", 0.0))
+    if sub > 0:
+        sig["host_share"] = max(0.0, min(1.0, disp / sub))
+    return sig
+
+
+def _attr_score(wall_ms: float, sig: Dict[str, float]) -> float:
+    """wall ms + bounded per-knob waste penalties (>= 0 each, capped
+    at half the wall so no single signal can dominate the measured
+    time). With every signal absent this IS the wall objective."""
+    cap = wall_ms * 0.5
+    s = wall_ms
+    s += min(sig.get("lane_idle_ms", 0.0), cap)
+    if "comm_overlap_frac" in sig:
+        s += min((1.0 - sig["comm_overlap_frac"]) * wall_ms * 0.25,
+                 cap)
+    s += min(sig.get("island_device_ms", 0.0) * 0.25, cap)
+    s += min(sig.get("host_share", 0.0) * wall_ms * 0.25, cap)
+    return s
+
+
 # ---------------------------------------------------------------------------
 # scope snapshot / restore
 # ---------------------------------------------------------------------------
@@ -125,8 +198,12 @@ def search_config(engine, program, scope, place, feed, fetch_names,
                   on_trial=None):
     """Scope-snapshotted knob search on the live program.
 
-    Returns (best_config, trials). The scope and all knob state are
-    exactly as before the call, whatever happened inside.
+    Returns (best_config, trials, start_config, deciding_budget,
+    wall_record). The scope and all knob state are exactly as before
+    the call, whatever happened inside. Under
+    ``PT_TUNE_OBJECTIVE=attribution`` trial SCORES carry per-knob
+    waste penalties while ``wall_record`` keeps the raw fetch-fenced
+    wall ms per (config digest, budget).
     """
     from ..observability import metrics, tracing
     space = knobs.search_space(include_lossy)
@@ -153,6 +230,11 @@ def search_config(engine, program, scope, place, feed, fetch_names,
         _obs_memory = None
     trials_c = metrics.counter("pt_tuning_trials_total")
     trial_h = metrics.histogram("pt_tuning_trial_seconds")
+    mode = _objective_mode()
+    # pure fetch-fenced wall ms per (config digest, budget) — under
+    # the attribution objective the SCORE carries penalties, so the
+    # adoption fall-back in autotune_for_run needs the raw wall too
+    wall_rec: Dict[Any, float] = {}
 
     def objective(config: Dict[str, Any], budget: int) -> float:
         t0 = time.time()
@@ -161,17 +243,26 @@ def search_config(engine, program, scope, place, feed, fetch_names,
         # in the scope, so this restore makes trials comparable AND
         # keeps the search off the training trajectory
         restore_scope(scope, scope_snap)
+        c0 = {k: float(engine.counters.get(k, 0.0))
+              for k in ("lane_idle_ms", "collective_bytes",
+                        "multistep_substeps", "multistep_dispatches")}
         with knobs.applied(config):
             ms = _step_ms(engine, program, scope, place, feed,
                           fetch_names, budget)
+        wall_rec[(knobs.config_digest(config), budget)] = ms
+        score = ms
+        if mode == "attribution":
+            score = _attr_score(
+                ms, _attr_signals(engine, c0, budget + 1))
         dur_ms = (time.perf_counter() - tp0) * 1e3
         trials_c.inc()
         trial_h.observe(dur_ms / 1e3)
         tracing.record_span(
             "tuning.trial", t0, dur_ms, kind="tuning",
             ann={"budget": budget, "step_ms": round(ms, 3),
+                 "score": round(score, 3), "objective": mode,
                  "config": knobs.config_digest(config)})
-        return ms
+        return score
 
     state.set_search_in_progress(True)
     try:
@@ -184,7 +275,7 @@ def search_config(engine, program, scope, place, feed, fetch_names,
         restore_scope(scope, scope_snap)
         if _obs_memory is not None:
             _obs_memory.note_host_bytes("tuning_snapshot", 0)
-    return best, trials, start, budgets[-1]
+    return best, trials, start, budgets[-1], wall_rec
 
 
 # ---------------------------------------------------------------------------
@@ -234,19 +325,33 @@ def autotune_for_run(engine, program, scope, place, feed,
                 "path": cache.path_for(key)}
     t0 = time.time()
     tp0 = time.perf_counter()
-    best, trials, start_cfg, deciding = search_config(
+    best, trials, start_cfg, deciding, wall_rec = search_config(
         engine, program, scope, place, feed, fetch_names)
+    mode = _objective_mode()
+    if mode == "attribution" and best != start_cfg:
+        # attribution hard floor: the penalties guide the SEARCH, the
+        # wall decides ADOPTION — a winner whose raw wall regressed
+        # against the start config is discarded, so the attribution
+        # objective can never adopt a config worse than the wall-time
+        # objective would have kept
+        bw = wall_rec.get((knobs.config_digest(best), deciding))
+        sw = wall_rec.get((knobs.config_digest(start_cfg), deciding))
+        if bw is not None and sw is not None and bw > sw:
+            best = dict(start_cfg)
 
-    def _score_at(cfg):
-        # the config's score at the DECIDING budget (every comparison
-        # the search made happened there; lower budgets are screening)
+    def _wall_at(cfg):
+        # the config's wall ms at the DECIDING budget (every adoption
+        # comparison happened there; lower budgets are screening)
+        w = wall_rec.get((knobs.config_digest(cfg), deciding))
+        if w is not None:
+            return w
         for t in trials:
             if t.budget == deciding and t.config == cfg:
                 return t.score
         return None
 
-    best_ms = _score_at(best)
-    default_ms = _score_at(start_cfg)
+    best_ms = _wall_at(best)
+    default_ms = _wall_at(start_cfg)
     # winner != start only on a STRICT measured improvement
     # (search.coordinate_descent), so this delta is <= 0 by
     # construction; winner == start reports exactly 0.0
@@ -264,7 +369,8 @@ def autotune_for_run(engine, program, scope, place, feed,
                        trials=len(trials),
                        kernel_variants=kernel_variants,
                        extras={"default_ms": default_ms,
-                               "delta_ms": delta_ms})
+                               "delta_ms": delta_ms,
+                               "objective": mode})
     _apply_entry(best, "search")
     _register_variants(kernel_variants)
     metrics.counter("pt_tuning_searches_total").inc()

@@ -169,11 +169,19 @@ _def("ghost_every", "env", "PT_GHOST_EVERY", int, 10, (5, 10, 20),
 _def("ghost_keep", "env", "PT_GHOST_KEEP", int, 2, (2,),
      help="ghost-snapshot ring depth; single-candidate (memory "
           "budget, not a latency axis)")
+_def("multi_step_k", "env", "PT_MULTI_STEP", int, 1, (1, 2, 4),
+     trace_affecting=True,
+     help="training substeps fused into ONE dispatched executable "
+          "(core/engine.py multi-step scan driver + prefetcher slab "
+          "mode, docs/ASYNC_DISPATCH.md); amortizes the host dispatch "
+          "cost over K batches — bit-identical to K sequential steps "
+          "when anomaly-free, so lossless")
 _def("compiler_options", "env", "PT_COMPILER_OPTIONS", str, "", ("",),
      trace_affecting=True,
      help="backend compiler k=v options baked into the compiled step "
-          "(core/engine.py _compiler_options); single-candidate until "
-          "per-backend option sets are curated")
+          "(core/engine.py _compiler_options); candidates are curated "
+          "per backend and filled in lazily by search_space() — CPU "
+          "keeps the single empty candidate (not searched)")
 _def("recompute", "env", "PT_RECOMPUTE", str, "", ("",),
      trace_affecting=True,
      help="op types re-derived at the fwd/bwd boundary (core/engine.py "
@@ -239,6 +247,45 @@ def allow_lossy() -> bool:
         "1", "true", "yes", "on")
 
 
+# curated per-backend compiler_options candidate sets: every entry is a
+# scheduling/fusion toggle (trace-affecting, value-preserving) — never a
+# precision or fast-math knob, so the lossless search may explore them.
+# The empty string (backend defaults) is always candidate 0.
+_COMPILER_OPTION_SETS: Dict[str, Tuple[str, ...]] = {
+    "tpu": (
+        "",
+        "xla_tpu_enable_latency_hiding_scheduler=true",
+        "xla_tpu_enable_latency_hiding_scheduler=true,"
+        "xla_tpu_enable_async_collective_fusion=true",
+    ),
+    "gpu": (
+        "",
+        "xla_gpu_enable_latency_hiding_scheduler=true",
+        "xla_gpu_enable_while_loop_double_buffering=true",
+    ),
+}
+
+
+def _refresh_compiler_candidates() -> None:
+    """Fill compiler_options candidates for the LIVE backend, once.
+
+    Deferred to search time because importing this catalog must not
+    initialize a jax backend; on backends with no curated set (cpu)
+    the knob keeps its single empty candidate and is not searched.
+    """
+    k = _KNOBS["compiler_options"]
+    if len(k.candidates) > 1:
+        return
+    try:
+        import jax
+        backend = jax.default_backend()
+    except Exception:
+        return
+    cands = _COMPILER_OPTION_SETS.get(backend)
+    if cands:
+        k.candidates = tuple(cands)
+
+
 def search_space(include_lossy: Optional[bool] = None
                  ) -> List[Tuple[str, Tuple]]:
     """(knob name, candidate values) for every searchable knob.
@@ -247,6 +294,7 @@ def search_space(include_lossy: Optional[bool] = None
     key audit), not search axes. Lossy knobs are excluded unless
     ``PT_TUNE_ALLOW_LOSSY=1`` (or ``include_lossy=True``).
     """
+    _refresh_compiler_candidates()
     lossy_ok = allow_lossy() if include_lossy is None else include_lossy
     return [(k.name, k.candidates) for k in _KNOBS.values()
             if len(k.candidates) > 1 and (lossy_ok or not k.lossy)]

@@ -37,7 +37,7 @@ from paddle_tpu.tuning import cache, driver, knobs, search, state
 
 _ENV_KEYS = ("PT_TUNING_CACHE_DIR", "PT_TUNE_BUDGETS", "PT_TUNE_ROUNDS",
              "PT_TUNE_SEED", "PT_TUNE_KNOBS", "PT_TUNE_VARIANTS",
-             "PT_TUNE_ALLOW_LOSSY")
+             "PT_TUNE_ALLOW_LOSSY", "PT_TUNE_OBJECTIVE")
 
 
 @pytest.fixture(autouse=True)
@@ -384,6 +384,37 @@ def test_second_engine_run_hits_cache_with_zero_trials(tmp_path):
     assert dict(state.applied_config()) == applied_cfg
     assert [p for p in os.listdir(tmp_path)
             if p.endswith(".json")] == entries
+
+
+def test_attribution_objective_no_worse_and_replays(tmp_path):
+    """PT_TUNE_OBJECTIVE=attribution (docs/TUNING.md): per-knob credit
+    penalties re-rank trials but the wall-adoption gate keeps the
+    adopted config no worse than the wall objective would have kept —
+    with lossless knobs the trajectory stays bit-identical, the entry
+    records which objective produced it, and the second run replays
+    from the cache with zero trials."""
+    import json
+
+    _cheap_search_env(tmp_path)
+    l0, p0, _ = _train(autotune=False)
+    state.clear_applied()
+    os.environ["PT_TUNE_OBJECTIVE"] = "attribution"
+    l1, p1, eng = _train(autotune=True)
+    assert eng.counters["tuning_searches"] == 1
+    assert eng.counters["tuning_trials"] > 0
+    assert l0 == l1
+    for n in p0:
+        np.testing.assert_array_equal(p0[n], p1[n])
+    entries = [p for p in os.listdir(tmp_path) if p.endswith(".json")]
+    assert len(entries) == 1
+    with open(os.path.join(str(tmp_path), entries[0])) as f:
+        rec = json.load(f)
+    assert rec["objective"] == "attribution"
+    state.clear_applied()
+    _, _, eng2 = _train(autotune=True)
+    assert eng2.counters["tuning_cache_hits"] == 1
+    assert eng2.counters["tuning_searches"] == 0
+    assert eng2.counters["tuning_trials"] == 0
 
 
 def test_autotune_reports_tuning_metrics(tmp_path):

@@ -82,6 +82,9 @@ REQUIRED_FAMILIES = (
     # cross-path lowering conformance (docs/STATIC_ANALYSIS.md)
     "pt_conformance_checks_total", "pt_conformance_divergences_total",
     "pt_conformance_verify_seconds",
+    # multi-step dispatch (PT_MULTI_STEP, docs/ASYNC_DISPATCH.md)
+    "pt_multistep_k", "pt_multistep_dispatches_total",
+    "pt_multistep_substeps_total", "pt_multistep_early_exits_total",
 )
 
 

@@ -13,6 +13,7 @@ CLI::
         [--batch N] [--steps N] [--threshold-ms X] [--telemetry]
         [--compare-telemetry] [--compare-scheduler] [--compare-guard]
         [--compare-tuned] [--compare-memory] [--compare-integrity]
+        [--compare-multistep] [--multistep-k K]
 
 exits non-zero when measured host overhead exceeds ``--threshold-ms``
 (the CI regression gate). ``overhead_report()`` is imported by bench.py
@@ -151,6 +152,27 @@ def mesh_report(mesh):
     return mesh, line
 
 
+def multistep_report(ms):
+    """(dict, '#'-line) for the bench JSON tail from a multi-step
+    dispatch A/B probe result ({k, sync_ms_k1, amortized_ms_per_step,
+    counters...}); (None, None) when the probe did not run or errored
+    before measuring."""
+    if not ms or "amortized_ms_per_step" not in ms:
+        return (ms or None), None
+    off, on = ms["sync_ms_k1"], ms["amortized_ms_per_step"]
+    pct = (1 - on / off) * 100 if off else 0.0
+    c = ms.get("counters", {})
+    line = (f"# multistep: sync {off:.2f} -> amortized {on:.2f} "
+            f"ms/step at K={ms.get('k')} ({pct:+.0f}% vs K=1); "
+            f"host share {ms.get('host_share_before', 1.0):.2f} -> "
+            f"{ms.get('host_share_after') or 0.0:.2f} "
+            f"dispatches/substep; dispatches="
+            f"{c.get('multistep_dispatches', 0)} substeps="
+            f"{c.get('multistep_substeps', 0)} early_exits="
+            f"{c.get('multistep_early_exits', 0)}")
+    return ms, line
+
+
 def _build_model(batch, strategy=None):
     import paddle_tpu as fluid
     from paddle_tpu import layers
@@ -284,6 +306,17 @@ def main(argv=None):
                         "a data-only MeshSpec over every host device "
                         "(bit-identical math, GSPMD-partitioned); "
                         "--threshold-ms gates the mesh-on sync DELTA")
+    p.add_argument("--compare-multistep", action="store_true",
+                   help="A/B multi-step dispatch (PT_MULTI_STEP, "
+                        "docs/ASYNC_DISPATCH.md): stack K copies of "
+                        "the batch into one FeedSlab and dispatch the "
+                        "K-substep scanned executable; --threshold-ms "
+                        "gates the amortized-per-substep-minus-K=1 "
+                        "sync DELTA (negative = the fused dispatch "
+                        "amortizes the tunnel RTT as promised)")
+    p.add_argument("--multistep-k", type=int, default=4,
+                   help="substeps per fused dispatch for "
+                        "--compare-multistep (default 4)")
     p.add_argument("--compare-memory", action="store_true",
                    help="A/B the HBM memory-observatory census "
                         "(docs/MEMORY.md): measure with the census "
@@ -396,6 +429,51 @@ def main(argv=None):
                 r["integrity_delta_ms"] = r_i["sync_ms"] - r["sync_ms"]
             finally:
                 set_flags({"FLAGS_integrity_sentinel": False})
+        if args.compare_multistep:
+            # A/B multi-step dispatch on a FRESH engine/model (the
+            # K=1 numbers above stay uncontaminated; PT_MULTI_STEP is
+            # part of the trace cache key so the slab compiles its own
+            # scanned executable)
+            import jax
+            from paddle_tpu.reader.prefetcher import FeedSlab
+            k = max(1, args.multistep_k)
+            eng8, prog8, scope8, feed8, fetch8 = \
+                _build_model(args.batch)
+            with fluid.scope_guard(scope8):
+                def _np8(o):
+                    return np.asarray(
+                        o.array if hasattr(o, "array") else o)
+                b8 = {kk: jax.device_put(np.asarray(v))
+                      for kk, v in feed8.items()}
+                slab = FeedSlab.stack([b8] * k)
+                for _ in range(3):
+                    rows = eng8.run_multi(prog8, scope8, None, slab,
+                                          fetch8, return_numpy=False)
+                float(_np8(rows[-1][0]))
+                ts8 = []
+                for _ in range(7):
+                    t0 = time.perf_counter()
+                    rows = eng8.run_multi(prog8, scope8, None, slab,
+                                          fetch8, return_numpy=False)
+                    float(_np8(rows[-1][0]))
+                    ts8.append(time.perf_counter() - t0)
+                slab_ms = sorted(ts8)[len(ts8) // 2] * 1e3
+                d8 = eng8.counters["multistep_dispatches"]
+                s8 = eng8.counters["multistep_substeps"]
+                r["multistep_on"] = {
+                    "k": k,
+                    "sync_ms_k1": r["sync_ms"],
+                    "slab_ms": slab_ms,
+                    "amortized_ms_per_step": slab_ms / k,
+                    "host_share_before": 1.0,
+                    "host_share_after":
+                        round(d8 / s8, 3) if s8 else None,
+                    "counters": {
+                        "multistep_dispatches": d8,
+                        "multistep_substeps": s8,
+                        "multistep_early_exits":
+                            eng8.counters["multistep_early_exits"]}}
+                r["multistep_delta_ms"] = slab_ms / k - r["sync_ms"]
         if args.compare_tuned:
             # autotune a FRESH engine/model, then measure with the
             # winner applied; knob + applied state restored after, so
@@ -534,6 +612,10 @@ def main(argv=None):
                      r["integrity_on"]["integrity_mismatches"]})
             if line:
                 print(line)
+        if "multistep_on" in r:
+            _, line = multistep_report(r["multistep_on"])
+            if line:
+                print(line)
         if "tuning" in r:
             _, line = tuning_report(r["tuning"])
             if line:
@@ -579,6 +661,12 @@ def main(argv=None):
         bad.append(
             f"integrity-sentinel sync delta "
             f"{r['integrity_delta_ms']:.2f} ms > threshold "
+            f"{args.threshold_ms:.1f} ms")
+    if args.threshold_ms is not None and "multistep_delta_ms" in r \
+            and r["multistep_delta_ms"] > args.threshold_ms:
+        bad.append(
+            f"multistep amortized-vs-K=1 sync delta "
+            f"{r['multistep_delta_ms']:.2f} ms > threshold "
             f"{args.threshold_ms:.1f} ms")
     if args.threshold_ms is not None and "tuned_delta_ms" in r and \
             r["tuned_delta_ms"] > args.threshold_ms:
