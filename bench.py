@@ -685,6 +685,62 @@ def _probe_conformance(prog, fetch, batch):
     return out
 
 
+def _probe_serving():
+    """Continuous-batching serving probe for the serving JSON tail
+    (docs/SERVING.md): export the book LM, warm every declared
+    (batch, bucket) signature, then push a burst of mixed-length
+    requests through the engine. The acceptance numbers are
+    ``occupancy_mean > 1`` (requests actually share decode steps),
+    ``parity_ok`` (tokens bit-identical to the solo baseline) and
+    ``kv_pages_leaked == 0``; tools/serve_bench.py runs the same
+    engine against a Poisson arrival process with a p99 CI gate."""
+    out = {}
+    try:
+        import tempfile
+        import paddle_tpu as fluid
+        from paddle_tpu.inference.serving import (
+            BucketSpec, ServingEngine, build_book_lm,
+            export_serving_model, load_serving_model,
+            reference_generate)
+        d = os.path.join(tempfile.mkdtemp(prefix="bench_serve_"),
+                         "model")
+        fluid.framework.unique_name.reset()
+        prefill, decode, startup, meta = build_book_lm(
+            vocab=64, hidden=16, num_layers=2, max_len=64)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        bk = BucketSpec(batch=4, prefill_lens=(8,), cache_lens=(24,))
+        export_serving_model(d, exe, prefill, decode, meta,
+                             buckets=bk)
+        model = load_serving_model(d)
+        t0 = time.perf_counter()
+        out["warmup_signatures"] = model.warmup()
+        out["warmup_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+        rng = np.random.RandomState(0)
+        prompts = [list(rng.randint(1, 64, size=rng.randint(2, 8)))
+                   for _ in range(8)]
+        eng = ServingEngine(model)
+        t0 = time.perf_counter()
+        reqs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+        while eng.pending():
+            eng.step()
+        dt = time.perf_counter() - t0
+        occ = eng.occupancy_history or [0]
+        out["requests"] = len(reqs)
+        out["completed"] = sum(1 for r in reqs if r.status == "ok")
+        out["tokens_per_sec"] = round(
+            sum(len(r.tokens) for r in reqs) / dt, 1)
+        out["occupancy_mean"] = round(sum(occ) / len(occ), 2)
+        out["occupancy_max"] = max(occ)
+        out["kv_pages_leaked"] = eng.kv.pages_in_use
+        out["parity_ok"] = all(
+            r.tokens == reference_generate(model, p, 6)
+            for r, p in zip(reqs[:3], prompts[:3]))
+    except Exception as exc:   # accounting only; never fail the bench
+        out["error"] = f"{type(exc).__name__}: {exc}"[:200]
+    return out
+
+
 def bench_transformer(batch=BATCH, seq=None, measure_ckpt=False):
     import paddle_tpu as fluid
     from paddle_tpu import models
@@ -766,6 +822,9 @@ def bench_transformer(batch=BATCH, seq=None, measure_ckpt=False):
             # parallelism JSON tail (docs/PARALLELISM.md)
             stats["parallelism"] = _probe_parallelism(
                 eng, main_prog, scope, feed, [cost.name], sync_ms)
+            # continuous-batching serving engine probe for the
+            # serving JSON tail (docs/SERVING.md)
+            stats["serving"] = _probe_serving()
     return sps * batch * s_trg, sps, traj, sync_ms, stats
 
 

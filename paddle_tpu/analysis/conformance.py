@@ -534,6 +534,33 @@ def extract_trace(program, path: str, block_idx: int = 0,
         tr.record("multi_step", False, (),
                   note="eager execution has no compiled step to scan")
 
+    # serving export (inference/serving, docs/SERVING.md): only the
+    # engine whole-block trace can be frozen into the bucketed
+    # prefill/decode executables + AOT StableHLO artifacts the
+    # continuous-batching engine dispatches (declared in
+    # analysis/support_matrix.py)
+    if path == "engine":
+        tr.record("serving", True,
+                  ("export=trace_step-whole-block",
+                   "signatures=bucketed-batch-seq",
+                   "artifact=stablehlo-aot",
+                   "sharding=meshspec-speclayout"),
+                  note="frozen program exports through the predictor's "
+                       "trace_step + __aot__ path with fixed bucketed "
+                       "signatures (inference/serving/export.py)")
+    elif path == "scheduler":
+        tr.record("serving", False, (),
+                  note="island dispatch has no single serialized "
+                       "executable to export")
+    elif path == "transpiled":
+        tr.record("serving", False, (),
+                  note="serving shards inside one traced executable "
+                       "(MeshSpec/SpecLayout); no explicit-collective "
+                       "program is emitted")
+    else:  # dygraph
+        tr.record("serving", False, (),
+                  note="no Program to freeze, no trace to serialize")
+
     # cache keying + tier-2 verifier coverage
     tr.record("cache_key", True, _cache_key_content(path))
     tr.record("tier2_verifier", True, _tier2_content(path))

@@ -47,6 +47,7 @@ FEATURES: Tuple[str, ...] = (
     "cache_key",                 # which knobs key the compiled artifact
     "tier2_verifier",            # runtime re-verification coverage
     "multi_step",                # PT_MULTI_STEP K-substep scan driver
+    "serving",                   # frozen-program serving export
 )
 
 SUPPORTED = "supported"
@@ -253,6 +254,31 @@ def default_matrix() -> SupportMatrix:
         "multi_step", "dygraph", UNSUPPORTED,
         "eager per-op execution has no compiled step to scan; K "
         "substeps are simply K eager steps (dygraph/parallel.py).")
+
+    # -- serving export (inference/serving, docs/SERVING.md): only the
+    #    engine whole-block trace can be frozen into the bucketed
+    #    prefill/decode executables the continuous-batching engine
+    #    dispatches.
+    m.declare(
+        "serving", "scheduler", UNSUPPORTED,
+        "serving.export freezes a program via trace_step's whole-"
+        "block path with fixed bucketed signatures; island dispatch "
+        "has no single serialized executable to export, and the "
+        "engine gates the scheduler off for inference programs "
+        "anyway (inference/serving/export.py).")
+    m.declare(
+        "serving", "transpiled", UNSUPPORTED,
+        "transpiled programs are process-level SPMD training "
+        "programs with explicit c_* collective ops; serving shards "
+        "through MeshSpec/SpecLayout inside one traced executable "
+        "instead, so there is nothing for the transpiler to emit "
+        "(inference/serving/export.py).")
+    m.declare(
+        "serving", "dygraph", UNSUPPORTED,
+        "the serving contract is a FROZEN Program with stable feed/"
+        "fetch signatures and AOT StableHLO artifacts; eager dygraph "
+        "has no Program to freeze and no trace to serialize "
+        "(inference/serving/export.py).")
 
     assert not m.validate()
     return m

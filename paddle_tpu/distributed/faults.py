@@ -37,7 +37,12 @@ Engine step loop:
 * ``data_dup_step`` — re-feed the previous step's batch at step N (a
   reader that replayed a batch after a botched resume) — the
   exactly-once accounting case chaos runs check against the resume
-  cursors.
+  cursors;
+* ``serve_kill_decode`` — the serving engine's model runner dies at
+  decode dispatch N (limited to ``serve_kill_attempts`` firings): the
+  killed-worker-mid-generation case the continuous-batching engine
+  (inference/serving, docs/SERVING.md) must contain to the in-flight
+  batch while continuing to serve queued and new requests.
 
 Determinism: one ``random.Random(seed)`` stream, consumed in hook-call
 order. Two processes running the same plan over the same operation
@@ -71,7 +76,8 @@ _active: Optional["FaultPlan"] = None
 _FLOAT_KEYS = ("connect_refuse", "drop", "truncate", "delay",
                "delay_s", "nan", "grad_spike", "spike_mag")
 _INT_KEYS = ("seed", "kill_at_step", "kill_attempts", "bitflip_step",
-             "bitflip_bit", "data_dup_step")
+             "bitflip_bit", "data_dup_step", "serve_kill_decode",
+             "serve_kill_attempts")
 _STR_KEYS = ("bitflip_param",)
 
 
@@ -88,7 +94,9 @@ class FaultPlan:
                  bitflip_step: Optional[int] = None,
                  bitflip_bit: int = 21,
                  bitflip_param: Optional[str] = None,
-                 data_dup_step: Optional[int] = None):
+                 data_dup_step: Optional[int] = None,
+                 serve_kill_decode: Optional[int] = None,
+                 serve_kill_attempts: int = 1):
         self.seed = int(seed)
         self.connect_refuse = float(connect_refuse)
         self.drop = float(drop)
@@ -108,6 +116,9 @@ class FaultPlan:
         self.bitflip_param = bitflip_param
         self.data_dup_step = (None if data_dup_step is None
                               else int(data_dup_step))
+        self.serve_kill_decode = (None if serve_kill_decode is None
+                                  else int(serve_kill_decode))
+        self.serve_kill_attempts = int(serve_kill_attempts)
         self._bitflip_done = False
         self._last_feed = None  # previous step's feed, for data_dup
         self._rng = random.Random(self.seed)
@@ -115,7 +126,7 @@ class FaultPlan:
         self.counts: Dict[str, int] = {
             "connect_refuse": 0, "drop": 0, "truncate": 0,
             "delay": 0, "kill": 0, "nan": 0, "grad_spike": 0,
-            "bitflip": 0, "data_dup": 0}
+            "bitflip": 0, "data_dup": 0, "serve_kill": 0}
 
     # -- construction -------------------------------------------------------
 
@@ -312,6 +323,27 @@ class FaultPlan:
             self._bitflip_done = True
             self._count("bitflip")
             return
+
+    # -- serving hook (inference/serving, docs/SERVING.md) ------------------
+
+    def on_serve_decode(self, decode_step: int) -> bool:
+        """True when the serving runner should die mid-decode (the
+        killed-worker-during-generation chaos case): fires at decode
+        dispatch index ``serve_kill_decode``, at most
+        ``serve_kill_attempts`` times. Deterministic — consumes no rng
+        draws. Unlike ``on_step`` this does NOT exit the process: the
+        serving engine is the supervisor here, and the contract under
+        test is that only the in-flight batch fails while the engine
+        keeps serving (breaker-guarded)."""
+        if self.serve_kill_decode is None:
+            return False
+        with self._lock:
+            if (int(decode_step) >= self.serve_kill_decode
+                    and self.counts["serve_kill"]
+                    < self.serve_kill_attempts):
+                self.counts["serve_kill"] += 1
+                return True
+        return False
 
     # -- step hook (engine / worker loops) ----------------------------------
 

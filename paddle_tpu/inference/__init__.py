@@ -170,7 +170,9 @@ class AnalysisPredictor:
         self._input_lods: Dict[str, list] = {}
         self._outputs: Dict[str, object] = {}
         self._compiled = {}          # sig -> callable
+        self._param_store = {}       # sig -> (d_params, c_params)
         self._aot_dir = os.path.join(config.model_dir(), "__aot__")
+        _obs_memory().track_predictor(self)
 
     # -- ZeroCopy contract --------------------------------------------------
     def get_input_names(self) -> List[str]:
@@ -211,7 +213,26 @@ class AnalysisPredictor:
         return result
 
     def clone(self) -> "AnalysisPredictor":
-        return AnalysisPredictor(self._config)
+        """A predictor sharing this one's loaded (read-only) weights and
+        AOT artifacts, with its own feed/fetch buffers and compile
+        cache — the reference contract (analysis_predictor.h Clone):
+        cheap per-thread handles over one set of persistables, NOT a
+        second load of the model from disk."""
+        twin = AnalysisPredictor.__new__(AnalysisPredictor)
+        twin._config = self._config
+        twin._scope = self._scope          # read-only persistables
+        twin._place = self._place
+        twin._program = self._program
+        twin._feed_names = list(self._feed_names)
+        twin._fetch_names = list(self._fetch_names)
+        twin._inputs = {}
+        twin._input_lods = {}
+        twin._outputs = {}
+        twin._compiled = {}
+        twin._param_store = {}
+        twin._aot_dir = self._aot_dir
+        _obs_memory().track_predictor(twin)
+        return twin
 
     # -- compile / AOT ------------------------------------------------------
     def _sig_of(self, feeds, lods):
@@ -278,9 +299,17 @@ class AnalysisPredictor:
 
         d_params = self._param_arrays(donated)
         c_params = self._param_arrays(const)
+        # held per-signature on the predictor so the HBM observatory can
+        # attribute these device buffers to owner "predictor" instead of
+        # reporting them as orphans (observability/memory.py census)
+        self._param_store[sig] = (d_params, c_params)
 
         def call(feed_arrays):
-            arrs = {n: jnp.asarray(np.asarray(a))
+            # device arrays pass through untouched (the serving engine
+            # feeds jnp buffers); host arrays take the canonical
+            # np->jnp copy
+            arrs = {n: a if isinstance(a, jax.Array)
+                    else jnp.asarray(np.asarray(a))
                     for n, a in feed_arrays.items()}
             fetches, updated, _ = fn(dict(d_params), c_params, arrs,
                                      key)
@@ -319,9 +348,18 @@ class AnalysisPredictor:
             import json
             with open(path + ".meta", "w") as f:
                 json.dump(meta, f)
-        except Exception:
-            # AOT is an optimization; never fail inference over it
-            pass
+        except Exception as exc:
+            # AOT is an optimization; never fail inference over it —
+            # but a silently-broken export path is undiagnosable, so
+            # say what went wrong (once per process per artifact dir)
+            if self._aot_dir not in _AOT_SAVE_WARNED:
+                _AOT_SAVE_WARNED.add(self._aot_dir)
+                import warnings
+                warnings.warn(
+                    f"AOT export to {path!r} failed "
+                    f"({type(exc).__name__}: {exc}); inference "
+                    "continues via the freshly-traced executable but "
+                    "new processes will retrace", stacklevel=2)
 
     def _load_aot(self, path):
         from jax import export as jax_export
@@ -335,6 +373,15 @@ class AnalysisPredictor:
             return exp.call(donated, const, feeds, key)
 
         return fn, meta["donated"], meta["const"]
+
+
+# dirs whose AOT-save failure has already been reported (warn once)
+_AOT_SAVE_WARNED = set()
+
+
+def _obs_memory():
+    from ..observability import memory as _mem
+    return _mem
 
 
 def _scope_val(scope, name):

@@ -1,0 +1,35 @@
+"""TPU serving engine: continuous-batching inference on exported
+programs (docs/SERVING.md).
+
+Four pieces, one pipeline:
+
+* **export** — freeze a Program pair (prefill + decode) into
+  inference-only traced steps with fixed bucketed batch/sequence
+  signatures (``export_serving_model`` / ``FrozenServingModel``);
+* **kv_cache** — paged HBM key/value store, census-attributed to owner
+  ``kv_cache`` in the PR 12 observatory (``PagedKVCache``);
+* **scheduler** — continuous-batching admission/prefill/decode loop
+  with deadlines, priorities, quotas and preemption
+  (``ServingEngine``);
+* **server** — multi-tenant RPC front-end on the hardened framing with
+  graceful SIGTERM drain (``ServeServer``).
+"""
+from .export import (BucketSpec, FrozenServingModel, bucket_for,
+                     build_book_lm, export_serving_model,
+                     load_serving_model, reference_generate,
+                     resolve_serving_mesh)
+from .kv_cache import PagedKVCache
+from .scheduler import (Request, RunnerKilled, ServingEngine,
+                        TenantQuota, STATUS_DEADLINE, STATUS_FAILED,
+                        STATUS_OK, STATUS_QUEUE_FULL, STATUS_QUOTA)
+from .server import ServeServer, generate, serve_rpc
+
+__all__ = [
+    "BucketSpec", "bucket_for", "build_book_lm",
+    "export_serving_model", "load_serving_model",
+    "FrozenServingModel", "resolve_serving_mesh",
+    "reference_generate", "PagedKVCache", "ServingEngine", "Request",
+    "TenantQuota", "RunnerKilled", "ServeServer", "generate",
+    "serve_rpc", "STATUS_OK", "STATUS_DEADLINE", "STATUS_QUOTA",
+    "STATUS_FAILED", "STATUS_QUEUE_FULL",
+]

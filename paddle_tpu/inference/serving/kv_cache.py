@@ -1,0 +1,219 @@
+"""Paged KV-cache for the serving engine (docs/SERVING.md).
+
+The decode phase of autoregressive generation reads every previously
+computed key/value row once per step; keeping a dense per-request
+``[max_len, kv_dim]`` buffer wastes HBM proportional to the LONGEST
+request in flight. The paged cache (the vLLM PagedAttention layout)
+instead carves two slabs — one for keys, one for values — into
+fixed-size pages and hands sequences pages on demand:
+
+* slab: ``[num_layers, num_pages * page_size, kv_dim]`` per k/v — ONE
+  jax array each, so the HBM observatory census sees exactly two
+  buffers for the whole cache;
+* page table: host-side ``seq_id -> [page_id, ...]``; token ``t`` of a
+  sequence lives at flat slot ``pages[t // page_size] * page_size +
+  t % page_size``;
+* page 0 is the *scratch page*: never allocated, it absorbs scatter
+  writes from dead batch rows so every dispatch keeps a fixed shape
+  (no per-length retrace), and its (finite, stale) contents are
+  masked to exactly ``-1e30`` before softmax so they cannot perturb
+  live rows — the bit-identity argument in docs/SERVING.md.
+
+Reads (``gather``) build the dense ``[L, B, S, kv_dim]`` cache feed of
+a decode batch with one ``jnp.take``; writes (``append``/``write_rows``)
+are one scatter per dispatch. Both are jitted with bucketed shapes, so
+a steady-state engine never retraces here.
+
+The cache registers itself with the PR 12 HBM observatory as a
+first-class owner (``kv_cache`` in the census, watermark dumps, and
+leak sentinel — observability/memory.py track_kv_cache); eviction for
+memory pressure is the scheduler's call (it picks the victim), the
+cache only exposes ``free``/``can_allocate``.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["PagedKVCache"]
+
+
+# jit caches per input shape: a handful of (batch, bucket) shapes in a
+# steady-state engine, each traced once at warmup
+@jax.jit
+def _gather(store, idx):
+    return jnp.take(store, idx, axis=1)
+
+
+@jax.jit
+def _scatter(store, slots, vals):
+    return store.at[:, slots, :].set(vals)
+
+
+class PagedKVCache:
+    """Fixed-size HBM pages for the serving engine's per-sequence
+    key/value history."""
+
+    def __init__(self, num_layers: int, kv_dim: int, num_pages: int,
+                 page_size: int = 16, dtype=jnp.float32):
+        if num_pages < 2:
+            raise ValueError("need >= 2 pages (page 0 is scratch)")
+        self.num_layers = int(num_layers)
+        self.kv_dim = int(kv_dim)
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        slots = self.num_pages * self.page_size
+        self._k = jnp.zeros((self.num_layers, slots, self.kv_dim),
+                            dtype)
+        self._v = jnp.zeros((self.num_layers, slots, self.kv_dim),
+                            dtype)
+        # page 0 is the scratch sink for dead-row scatter writes
+        self._free: List[int] = list(range(1, self.num_pages))
+        self._tables: Dict[int, List[int]] = {}
+        self._lens: Dict[int, int] = {}
+        from ...observability import memory as _obs_memory
+        _obs_memory.track_kv_cache(self)
+
+    # -- accounting ---------------------------------------------------------
+
+    @property
+    def pages_in_use(self) -> int:
+        return (self.num_pages - 1) - len(self._free)
+
+    @property
+    def pages_free(self) -> int:
+        return len(self._free)
+
+    def pages_needed(self, n_tokens: int) -> int:
+        return max(1, -(-int(n_tokens) // self.page_size))
+
+    def can_allocate(self, n_tokens: int) -> bool:
+        return self.pages_needed(n_tokens) <= len(self._free)
+
+    def seq_len(self, seq_id: int) -> int:
+        return self._lens.get(seq_id, 0)
+
+    def live_seqs(self) -> List[int]:
+        return list(self._tables)
+
+    # -- allocation ---------------------------------------------------------
+
+    def allocate(self, seq_id: int, n_tokens: int) -> bool:
+        """Reserve pages for ``n_tokens`` total capacity up front (the
+        scheduler admits a request only when its whole prompt +
+        max_new_tokens budget fits, so decode can never fail an
+        allocation mid-flight). False when the free list is short —
+        the scheduler then evicts or keeps the request queued."""
+        if seq_id in self._tables:
+            raise ValueError(f"seq {seq_id} already allocated")
+        need = self.pages_needed(n_tokens)
+        if need > len(self._free):
+            return False
+        self._tables[seq_id] = [self._free.pop() for _ in range(need)]
+        self._lens[seq_id] = 0
+        return True
+
+    def free(self, seq_id: int) -> int:
+        """Return a retired/evicted sequence's pages to the free list;
+        returns how many pages were released. Slab contents are left
+        stale — the scratch-masking contract makes them harmless, and
+        zeroing would cost a scatter per retirement."""
+        pages = self._tables.pop(seq_id, None)
+        self._lens.pop(seq_id, None)
+        if not pages:
+            return 0
+        self._free.extend(pages)
+        return len(pages)
+
+    # -- slot math ----------------------------------------------------------
+
+    def _slot(self, seq_id: int, t: int) -> int:
+        pages = self._tables[seq_id]
+        return pages[t // self.page_size] * self.page_size \
+            + t % self.page_size
+
+    def slot_matrix(self, seq_ids: List[Optional[int]],
+                    width: int) -> np.ndarray:
+        """``[B, width]`` int32 flat-slot indices for a batch gather:
+        row b column t is sequence b's slot for token t, or 0 (the
+        scratch page) past the sequence's length / for None rows."""
+        out = np.zeros((len(seq_ids), width), np.int32)
+        for b, sid in enumerate(seq_ids):
+            if sid is None or sid not in self._tables:
+                continue
+            for t in range(min(self._lens[sid], width)):
+                out[b, t] = self._slot(sid, t)
+        return out
+
+    # -- device ops ---------------------------------------------------------
+
+    def gather(self, seq_ids: List[Optional[int]], width: int
+               ) -> Tuple[jax.Array, jax.Array]:
+        """Dense ``([L, B, width, kv_dim], [L, B, width, kv_dim])``
+        (keys, values) cache feeds for a decode batch."""
+        idx = jnp.asarray(self.slot_matrix(seq_ids, width))
+        return _gather(self._k, idx), _gather(self._v, idx)
+
+    def append(self, seq_ids: List[Optional[int]], k_new, v_new) -> None:
+        """Write one new token's k/v per live row and advance lengths.
+        ``k_new``/``v_new``: ``[L, B, kv_dim]`` (dead rows carry
+        garbage; their writes land on the scratch page)."""
+        slots = np.zeros((len(seq_ids),), np.int32)
+        for b, sid in enumerate(seq_ids):
+            if sid is None or sid not in self._tables:
+                continue
+            t = self._lens[sid]
+            cap = len(self._tables[sid]) * self.page_size
+            if t >= cap:
+                raise RuntimeError(
+                    f"seq {sid} overflowed its {cap}-slot reservation")
+            slots[b] = self._slot(sid, t)
+        sl = jnp.asarray(slots)
+        self._k = _scatter(self._k, sl, jnp.asarray(k_new))
+        self._v = _scatter(self._v, sl, jnp.asarray(v_new))
+        for sid in seq_ids:
+            if sid is not None and sid in self._lens:
+                self._lens[sid] += 1
+
+    def write_rows(self, seq_ids: List[Optional[int]], k_rows, v_rows,
+                   lens: List[int]) -> None:
+        """Prefill bulk write: ``k_rows``/``v_rows`` ``[L, B, S,
+        kv_dim]``; row b's first ``lens[b]`` tokens go to sequence b's
+        slots, the padded tail to scratch. Sets each sequence's length
+        to ``lens[b]``."""
+        L, B, S, D = k_rows.shape
+        idx = np.zeros((B, S), np.int32)
+        for b, sid in enumerate(seq_ids):
+            if sid is None or sid not in self._tables:
+                continue
+            for t in range(min(int(lens[b]), S)):
+                idx[b, t] = self._slot(sid, t)
+        flat = jnp.asarray(idx.reshape(-1))
+        self._k = _scatter(self._k, flat,
+                           jnp.reshape(jnp.asarray(k_rows),
+                                       (L, B * S, D)))
+        self._v = _scatter(self._v, flat,
+                           jnp.reshape(jnp.asarray(v_rows),
+                                       (L, B * S, D)))
+        for b, sid in enumerate(seq_ids):
+            if sid is not None and sid in self._lens:
+                self._lens[sid] = int(lens[b])
+
+    # -- observatory contract (observability/memory.py) ---------------------
+
+    def _census_arrays(self):
+        """(label, array) pairs the HBM census attributes to owner
+        ``kv_cache``."""
+        return [("k_pages", self._k), ("v_pages", self._v)]
+
+    def stats(self) -> dict:
+        return {"num_pages": self.num_pages,
+                "page_size": self.page_size,
+                "pages_in_use": self.pages_in_use,
+                "pages_free": self.pages_free,
+                "live_seqs": len(self._tables),
+                "slab_bytes": int(self._k.nbytes + self._v.nbytes)}

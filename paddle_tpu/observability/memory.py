@@ -61,7 +61,8 @@ from . import recorder as _recorder
 
 __all__ = [
     "track_scope", "track_ghost_ring", "track_snapshot",
-    "track_prefetcher", "track_fetch_handle", "note_host_bytes",
+    "track_prefetcher", "track_fetch_handle", "track_kv_cache",
+    "track_predictor", "note_host_bytes",
     "census", "census_active", "census_enabled", "enable", "step_tick",
     "stats", "reset", "LeakSentinel", "leak_sentinel",
     "check_watermark", "device_limit_bytes", "set_island_attribution",
@@ -115,6 +116,8 @@ _GHOST_RINGS: "weakref.WeakSet" = weakref.WeakSet()
 _SNAPSHOTS: "weakref.WeakSet" = weakref.WeakSet()
 _PREFETCHERS: "weakref.WeakSet" = weakref.WeakSet()
 _FETCH_HANDLES: "weakref.WeakSet" = weakref.WeakSet()
+_KV_CACHES: "weakref.WeakSet" = weakref.WeakSet()
+_PREDICTORS: "weakref.WeakSet" = weakref.WeakSet()
 # host-side (non-HBM) byte claims, e.g. tuning trial snapshots: kept
 # out of the live_arrays reconciliation, reported separately
 _HOST_BYTES: Dict[str, int] = {}
@@ -155,6 +158,22 @@ def track_prefetcher(prefetcher) -> None:
 def track_fetch_handle(handle) -> None:
     """Tag an async FetchHandle's live payload as ``pending_fetch``."""
     _track(_FETCH_HANDLES, handle)
+
+
+def track_kv_cache(cache) -> None:
+    """Tag a serving PagedKVCache's page slabs as owner ``kv_cache``.
+    The cache exposes ``_census_arrays() -> [(label, array)]``
+    (inference/serving/kv_cache.py); pages show up in the census,
+    watermark dumps, and the leak sentinel like any first-class
+    owner."""
+    _track(_KV_CACHES, cache)
+
+
+def track_predictor(pred) -> None:
+    """Tag an AnalysisPredictor's device-resident parameters
+    (``d_params``/``c_params`` per compiled signature) as owner
+    ``predictor`` so inference buffers stop reporting as orphans."""
+    _track(_PREDICTORS, pred)
 
 
 def note_host_bytes(owner: str, nbytes: int) -> None:
@@ -235,6 +254,24 @@ def _iter_owned() -> Iterator[Tuple[str, str, Any]]:
     for h in list(_FETCH_HANDLES):
         yield "pending_fetch", str(getattr(h, "_name", "?")), \
             getattr(h, "_value", None)
+    for kv in list(_KV_CACHES):
+        try:
+            entries = list(kv._census_arrays())
+        except Exception:
+            continue
+        for label, a in entries:
+            yield "kv_cache", str(label), a
+    for pred in list(_PREDICTORS):
+        store = getattr(pred, "_param_store", None) or {}
+        for si, entry in enumerate(list(store.values())):
+            try:
+                d_params, c_params = entry
+            except Exception:
+                continue
+            for n, a in dict(d_params).items():
+                yield "predictor", f"sig{si}:{n}", a
+            for n, a in dict(c_params).items():
+                yield "predictor", f"sig{si}:{n}", a
     for eng in list(getattr(_metrics, "_ENGINES", ()) or ()):
         for p in list(getattr(eng, "_pending", ()) or ()):
             yield "pending_step", "nan_flags", getattr(p, "_nan_flags", None)
@@ -772,5 +809,5 @@ def reset() -> None:
     with _LOCK:
         _HOST_BYTES.clear()
         for ws in (_SCOPES, _GHOST_RINGS, _SNAPSHOTS, _PREFETCHERS,
-                   _FETCH_HANDLES):
+                   _FETCH_HANDLES, _KV_CACHES, _PREDICTORS):
             ws.clear()

@@ -577,6 +577,35 @@ def _install_standard_families(reg: MetricsRegistry) -> None:
               "wall time of the last conformance verification "
               "(trace extraction + pairwise diff; runs pre-compile, "
               "so it must stay cheap)")
+    # serving engine (inference/serving/, docs/SERVING.md)
+    reg.gauge("pt_serve_queue_depth",
+              "requests waiting in the serving admission queue "
+              "(admitted-but-unscheduled + queued)")
+    reg.gauge("pt_serve_batch_occupancy",
+              "live sequences in the last dispatched serving batch, "
+              "labeled {phase} (prefill / decode); continuous "
+              "batching holds this near the bucket size under load")
+    reg.histogram("pt_serve_request_seconds",
+                  "end-to-end request latency, submit to completion; "
+                  "p50/p99 come from the bucket counts")
+    reg.counter("pt_serve_tokens_total",
+                "tokens generated by the serving engine, labeled "
+                "{tenant}")
+    reg.gauge("pt_serve_tokens_per_second",
+              "decode throughput over the engine's last metrics "
+              "window (generated tokens / wall seconds)")
+    reg.gauge("pt_serve_kv_pages_in_use",
+              "KV-cache pages currently allocated to live sequences "
+              "(free-list size is total minus this)")
+    reg.counter("pt_serve_kv_evictions_total",
+                "sequences preempted (pages reclaimed, request "
+                "re-queued for recompute) under KV memory pressure")
+    reg.counter("pt_serve_rejections_total",
+                "requests rejected at admission, labeled {reason} "
+                "(quota / queue_full / too_long)")
+    reg.counter("pt_serve_requests_total",
+                "serving requests retired, labeled {status} "
+                "(ok / deadline_expired / quota_exceeded / failed)")
     reg.register_collector(_engine_families)
     reg.register_collector(_rpc_families)
 

@@ -92,6 +92,66 @@ def test_predictor_aot_reuse_skips_retrace(tmp_path, monkeypatch):
     np.testing.assert_allclose(out2, out1, rtol=1e-5, atol=1e-6)
 
 
+def test_predictor_aot_corrupt_artifact_falls_back(tmp_path):
+    """A truncated/garbage AOT artifact must not take the predictor
+    down: it warns, retraces, and serves the same numbers."""
+    model_dir, xs, ref = _train_and_save(tmp_path)
+    config = AnalysisConfig(model_dir)
+    config.disable_gpu()
+    p1 = create_paddle_predictor(config)
+    p1.run([PaddleTensor(xs, "x")])
+    aot_dir = os.path.join(model_dir, "__aot__")
+    for f in os.listdir(aot_dir):
+        if f.endswith(".stablehlo"):
+            with open(os.path.join(aot_dir, f), "wb") as fh:
+                fh.write(b"not stablehlo")
+    p2 = create_paddle_predictor(config)
+    with pytest.warns(UserWarning, match="ignoring AOT artifact"):
+        out = p2.run([PaddleTensor(xs, "x")])[0].data
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_save_aot_failure_warns_once(tmp_path, monkeypatch):
+    """A broken AOT export path degrades loudly (one warning per
+    artifact dir), never silently, and never fails inference."""
+    import paddle_tpu.inference as inf_mod
+    from jax import export as jax_export
+    model_dir, xs, ref = _train_and_save(tmp_path)
+    monkeypatch.setattr(
+        jax_export, "export",
+        lambda *a, **k: (_ for _ in ()).throw(RuntimeError("disk")))
+    inf_mod._AOT_SAVE_WARNED.clear()
+    config = AnalysisConfig(model_dir)
+    config.disable_gpu()
+    pred = create_paddle_predictor(config)
+    with pytest.warns(UserWarning, match="AOT export .* failed"):
+        out = pred.run([PaddleTensor(xs, "x")])[0].data
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    # second failing signature in the same dir: warned already, quiet
+    import warnings as _warnings
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")
+        pred.run([PaddleTensor(xs[:4], "x")])
+
+
+def test_predictor_clone_shares_loaded_weights(tmp_path):
+    """clone() hands out a per-thread handle over the SAME loaded
+    persistables — no re-read of the model dir (the reference Clone
+    contract). Prove it by deleting the dir before cloning."""
+    import shutil
+    model_dir, xs, ref = _train_and_save(tmp_path)
+    config = AnalysisConfig(model_dir)
+    config.disable_gpu()
+    p1 = create_paddle_predictor(config)
+    out1 = p1.run([PaddleTensor(xs, "x")])[0].data
+    shutil.rmtree(model_dir)
+    twin = p1.clone()
+    assert twin._scope is p1._scope
+    out2 = twin.run([PaddleTensor(xs, "x")])[0].data
+    np.testing.assert_allclose(out2, out1, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(out2, ref, rtol=1e-5, atol=1e-6)
+
+
 def test_predictor_batch_size_change_recompiles(tmp_path):
     model_dir, xs, _ = _train_and_save(tmp_path)
     config = AnalysisConfig(model_dir)

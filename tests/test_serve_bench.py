@@ -1,0 +1,45 @@
+"""tools/serve_bench.py: the serving latency/throughput bench and its
+CI latency gate (docs/SERVING.md acceptance — the bench runs in CI and
+``--threshold`` gates on p99)."""
+import json
+import os
+import subprocess
+import sys
+import unittest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "serve_bench.py")
+
+
+class TestServeBench(unittest.TestCase):
+    def _run(self, *extra):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        return subprocess.run(
+            [sys.executable, TOOL, "--requests", "8", "--rate", "500",
+             "--max-new", "4", "--json", *extra],
+            capture_output=True, text=True, env=env, timeout=600)
+
+    def test_bench_reports_and_passes_loose_gate(self):
+        r = self._run("--threshold", "600000")
+        self.assertEqual(r.returncode, 0, r.stderr[-2000:])
+        row = json.loads([l for l in r.stdout.splitlines()
+                          if l.startswith("{")][0])
+        self.assertEqual(row["completed"], 8)
+        self.assertEqual(row["kv_pages_leaked"], 0)
+        self.assertGreater(row["tokens_per_sec"], 0)
+        self.assertGreater(row["p99_ms"], 0)
+        self.assertGreaterEqual(row["p99_ms"], row["p50_ms"])
+        # Poisson arrivals at 500 rps against multi-ms decode steps
+        # MUST overlap — occupancy above 1 is the continuous-batching
+        # acceptance signal
+        self.assertGreater(row["occupancy_mean"], 1.0)
+
+    def test_threshold_gate_fails_closed(self):
+        r = self._run("--threshold", "0.001")
+        self.assertEqual(r.returncode, 3, r.stdout + r.stderr[-500:])
+        self.assertIn("exceeds threshold", r.stderr)
+
+
+if __name__ == "__main__":
+    unittest.main()

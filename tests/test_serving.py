@@ -1,0 +1,327 @@
+"""Serving engine: continuous batching, paged KV-cache, multi-tenant
+scheduling (paddle_tpu/inference/serving/, docs/SERVING.md).
+
+Acceptance pins:
+(a) continuous batching — concurrent requests share decode steps with
+    batch occupancy > 1;
+(b) parity — a request's tokens are BIT-IDENTICAL to running it alone
+    through the predictors (reference_generate);
+(c) KV pages are census-attributed to owner ``kv_cache`` while live and
+    freed at retirement;
+(d) deadline-expired and over-quota requests reject with DISTINCT
+    statuses;
+(e) a fault-injected runner death mid-decode fails only the in-flight
+    requests; the engine (and a fresh submission) keeps serving.
+"""
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.distributed import faults
+from paddle_tpu.distributed.faults import FaultPlan
+from paddle_tpu.distributed.resilience import endpoint_health
+from paddle_tpu.inference.serving import (
+    BucketSpec, PagedKVCache, ServeServer, ServingEngine, TenantQuota,
+    build_book_lm, export_serving_model, generate, load_serving_model,
+    reference_generate, serve_rpc, STATUS_DEADLINE, STATUS_FAILED,
+    STATUS_OK, STATUS_QUOTA)
+from paddle_tpu.observability import memory as obs_memory
+from paddle_tpu.observability import metrics as obs_metrics
+
+BATCH = 3
+MAX_NEW = 5
+PROMPTS = [[1, 2, 3], [4, 5], [6, 7, 8, 9]]
+
+
+@pytest.fixture(scope="module")
+def served(tmp_path_factory):
+    """Build + export the book LM once; every test loads from the same
+    artifact (and therefore shares the predictors' AOT cache)."""
+    fluid.framework.unique_name.reset()
+    d = str(tmp_path_factory.mktemp("serve") / "model")
+    prefill, decode, startup, meta = build_book_lm(
+        vocab=29, hidden=8, num_layers=2, max_len=64)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    bk = BucketSpec(batch=BATCH, prefill_lens=(8,), cache_lens=(24,))
+    export_serving_model(d, exe, prefill, decode, meta, buckets=bk)
+    model = load_serving_model(d)
+    assert model.warmup() == 2
+    return d, model
+
+
+def _run(eng, max_steps=200):
+    steps = 0
+    while eng.pending() and steps < max_steps:
+        eng.step()
+        steps += 1
+    assert not eng.pending(), "engine did not drain"
+    return steps
+
+
+def _refs(model):
+    return [reference_generate(model, p, MAX_NEW) for p in PROMPTS]
+
+
+def test_export_artifacts(served):
+    d, model = served
+    assert sorted(os.listdir(d)) == ["decode", "prefill",
+                                     "serving.json"]
+    # the export wrote AOT StableHLO artifacts the predictors serve
+    # from on the next load (warmup in the fixture compiled them)
+    for sub in ("prefill", "decode"):
+        aot = os.path.join(d, sub, "__aot__")
+        assert any(f.endswith(".stablehlo") for f in os.listdir(aot))
+
+
+def test_continuous_batching_parity(served):
+    """(a) + (b): three requests run concurrently; each one's tokens
+    are bit-identical to its solo run."""
+    _, model = served
+    eng = ServingEngine(model)
+    reqs = [eng.submit(p, max_new_tokens=MAX_NEW) for p in PROMPTS]
+    _run(eng)
+    assert max(eng.occupancy_history) > 1      # batched decode steps
+    for r, ref in zip(reqs, _refs(model)):
+        assert r.status == STATUS_OK
+        assert r.tokens == ref                  # exact int equality
+
+
+def test_join_at_step_granularity(served):
+    """A request submitted mid-decode JOINS the running batch without
+    disturbing the first request's tokens."""
+    _, model = served
+    eng = ServingEngine(model)
+    r1 = eng.submit(PROMPTS[0], max_new_tokens=MAX_NEW)
+    eng.step()                                  # admit + prefill + 1 decode
+    eng.step()
+    solo_steps = len(eng.occupancy_history)
+    assert solo_steps >= 1 and max(eng.occupancy_history) == 1
+    r2 = eng.submit(PROMPTS[2], max_new_tokens=MAX_NEW)
+    _run(eng)
+    refs = _refs(model)
+    assert r1.tokens == refs[0] and r2.tokens == refs[2]
+    assert max(eng.occupancy_history) == 2      # they shared steps
+
+
+def test_kv_pages_census_attributed_and_freed(served):
+    """(c): live pages show up as owner ``kv_cache`` (k/v slab labels),
+    census coverage counts them, and retirement frees every page."""
+    _, model = served
+    eng = ServingEngine(model)
+    eng.submit(PROMPTS[0], max_new_tokens=MAX_NEW)
+    eng.step()                                  # prefill happened
+    assert eng.kv.pages_in_use > 0
+    c = obs_memory.census(top_n=64)
+    kv = c["owners"].get("kv_cache")
+    assert kv is not None and kv["count"] >= 2 and kv["bytes"] > 0
+    labels = {b["label"] for b in c["top_buffers"]
+              if b["owner"] == "kv_cache"}
+    assert {"k_pages", "v_pages"} <= labels
+    # predictor params are first-class too (satellite: no orphans)
+    assert c["owners"].get("predictor", {}).get("count", 0) > 0
+    _run(eng)
+    assert eng.kv.pages_in_use == 0
+    assert eng.kv.live_seqs() == []
+
+
+def test_deadline_and_quota_distinct_statuses(served):
+    """(d): over-budget submissions reject ``quota_exceeded`` at
+    admission; expired ones retire ``deadline_expired`` — distinct
+    statuses, distinct rejection-counter reasons."""
+    _, model = served
+    quota = TenantQuota(max_concurrent=4, token_budget=9)
+    eng = ServingEngine(model, quotas={"t0": quota})
+    rej = obs_metrics.counter("pt_serve_rejections_total")
+    quota_before = rej.get(reason="quota")
+    # budget 9 < 3 + 7: rejected before touching the queue
+    r_quota = eng.submit(PROMPTS[0], max_new_tokens=7, tenant="t0")
+    assert r_quota.status == STATUS_QUOTA
+    assert r_quota.done.is_set() and r_quota.tokens == []
+    assert rej.get(reason="quota") == quota_before + 1
+    # deadline already passed when the scheduler first sees it
+    r_dead = eng.submit(PROMPTS[1], max_new_tokens=MAX_NEW,
+                        deadline_s=-0.01)
+    eng.step()
+    assert r_dead.status == STATUS_DEADLINE
+    assert r_dead.status != r_quota.status
+    # within budget + alive deadline still serves fine
+    r_ok = eng.submit(PROMPTS[1], max_new_tokens=MAX_NEW, tenant="t0",
+                      deadline_s=60.0)
+    _run(eng)
+    assert r_ok.status == STATUS_OK
+    assert r_ok.tokens == _refs(model)[1]
+
+
+def test_concurrency_limit_queues_not_rejects(served):
+    """max_concurrent is backpressure: the excess request WAITS and
+    still completes (contrast with the quota hard-reject above)."""
+    _, model = served
+    eng = ServingEngine(model,
+                        quotas={"t1": TenantQuota(max_concurrent=1)})
+    r1 = eng.submit(PROMPTS[0], max_new_tokens=MAX_NEW, tenant="t1")
+    r2 = eng.submit(PROMPTS[1], max_new_tokens=MAX_NEW, tenant="t1")
+    eng.step()
+    assert max(eng.occupancy_history) == 1      # r2 not admitted yet
+    _run(eng)
+    refs = _refs(model)
+    assert (r1.status, r2.status) == (STATUS_OK, STATUS_OK)
+    assert r1.tokens == refs[0] and r2.tokens == refs[1]
+
+
+def test_preemption_under_memory_pressure(served):
+    """A higher-priority arrival evicts a lower-priority running
+    request when pages run out; the victim recomputes later and still
+    produces bit-identical tokens."""
+    _, model = served
+    # room for exactly one request: budget 8 tokens = 2 pages of 4
+    kv = PagedKVCache(model.num_layers, model.hidden, num_pages=3,
+                      page_size=4)
+    eng = ServingEngine(model, kv=kv)
+    ev = obs_metrics.counter("pt_serve_kv_evictions_total")
+    ev_before = ev.get()
+    lo = eng.submit(PROMPTS[0], max_new_tokens=MAX_NEW, priority=0)
+    eng.step()                                  # lo running
+    hi = eng.submit(PROMPTS[1], max_new_tokens=MAX_NEW, priority=5)
+    _run(eng)
+    assert ev.get() == ev_before + 1
+    assert lo.preemptions == 1
+    refs = _refs(model)
+    assert hi.status == STATUS_OK and hi.tokens == refs[1]
+    assert lo.status == STATUS_OK and lo.tokens == refs[0]
+    assert kv.pages_in_use == 0
+
+
+def test_fault_kill_mid_decode_contained(served):
+    """(e): PT_FAULT_PLAN's ``serve_kill_decode`` kills the runner at a
+    decode dispatch. Only the in-flight batch fails; pages free; the
+    breaker records the failure; the SAME engine then serves a fresh
+    request to bit-identical completion."""
+    _, model = served
+    eng = ServingEngine(model)
+    reqs_total = obs_metrics.counter("pt_serve_requests_total")
+    failed_before = reqs_total.get(status=STATUS_FAILED)
+    br = endpoint_health.get("serve:runner")
+    with faults.scoped(FaultPlan(serve_kill_decode=1,
+                                 serve_kill_attempts=1)):
+        r1 = eng.submit(PROMPTS[0], max_new_tokens=MAX_NEW)
+        r2 = eng.submit(PROMPTS[1], max_new_tokens=MAX_NEW)
+        _run(eng)
+    assert r1.status == STATUS_FAILED and r2.status == STATUS_FAILED
+    assert reqs_total.get(status=STATUS_FAILED) == failed_before + 2
+    assert eng.kv.pages_in_use == 0             # no leak on failure
+    assert br.state in ("closed", "open")       # recorded, not crashed
+    # the engine keeps serving: a new request completes with parity
+    r3 = eng.submit(PROMPTS[2], max_new_tokens=MAX_NEW)
+    _run(eng)
+    assert r3.status == STATUS_OK
+    assert r3.tokens == _refs(model)[2]
+
+
+def test_fault_plan_env_spec_roundtrip():
+    plan = FaultPlan.from_spec("serve_kill_decode=3,"
+                               "serve_kill_attempts=2")
+    assert plan.serve_kill_decode == 3
+    assert plan.on_serve_decode(2) is False
+    assert plan.on_serve_decode(3) is True
+    assert plan.on_serve_decode(3) is True      # second attempt
+    assert plan.on_serve_decode(9) is False     # attempts exhausted
+
+
+def _free_port():
+    import socket
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_server_multi_tenant_end_to_end(served):
+    """RPC front-end: per-tenant generation over the hardened framing,
+    stats introspection, quota rejection with a distinct status, and
+    graceful drain."""
+    _, model = served
+    eng = ServingEngine(
+        model, quotas={"paid": TenantQuota(max_concurrent=4),
+                       "free": TenantQuota(token_budget=9)})
+    ep = f"127.0.0.1:{_free_port()}"
+    srv = ServeServer(ep, eng).start()
+    try:
+        out = generate(ep, PROMPTS[0], max_new_tokens=MAX_NEW,
+                       tenant="paid", timeout=60.0)
+        assert out["status"] == STATUS_OK
+        assert out["tokens"] == _refs(model)[0]
+        over = generate(ep, PROMPTS[0], max_new_tokens=7,
+                        tenant="free", timeout=60.0)
+        assert over["status"] == STATUS_QUOTA and over["tokens"] == []
+        st = serve_rpc(ep, {"t": "stats"}, timeout=10.0)
+        assert st["pending"] == 0
+        assert st["kv"]["pages_in_use"] == 0
+    finally:
+        assert srv.shutdown() is True
+    # post-drain the engine rejects new work instead of hanging it
+    late = eng.submit(PROMPTS[0], max_new_tokens=2)
+    assert late.status is not None and late.done.is_set()
+
+
+def test_server_sigterm_graceful_drain(served):
+    """SIGTERM finishes in-flight work, then stops accepting."""
+    _, model = served
+    eng = ServingEngine(model)
+    ep = f"127.0.0.1:{_free_port()}"
+    srv = ServeServer(ep, eng).start()
+    prev = signal.getsignal(signal.SIGTERM)
+    try:
+        assert srv.install_signal_handlers()
+        results = {}
+
+        def client():
+            results["out"] = generate(
+                ep, PROMPTS[1], max_new_tokens=MAX_NEW, timeout=60.0)
+
+        t = threading.Thread(target=client)
+        t.start()
+        while not eng.pending():                # request is in flight
+            time.sleep(0.002)
+        os.kill(os.getpid(), signal.SIGTERM)
+        t.join(timeout=60.0)
+        assert results["out"]["status"] == STATUS_OK
+        assert results["out"]["tokens"] == _refs(model)[1]
+        for _ in range(500):
+            if srv._stop.is_set():
+                break
+            time.sleep(0.01)
+        assert srv._stop.is_set()
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+        srv.shutdown()
+
+
+def test_tracing_spans_cover_request_lifecycle(served):
+    """PR 10 trace ids follow one request admission -> prefill ->
+    decode steps -> completion."""
+    _, model = served
+    from paddle_tpu.observability import tracing
+    obs_metrics.enable_telemetry(True)
+    tracing.clear_spans()
+    try:
+        eng = ServingEngine(model)
+        req = eng.submit(PROMPTS[0], max_new_tokens=MAX_NEW)
+        _run(eng)
+        assert req.status == STATUS_OK
+        spans = [s for s in tracing.spans_snapshot()
+                 if s.get("trace") == req.trace]
+        names = [s["name"] for s in spans]
+        assert "serve.admission" in names
+        assert "serve.prefill" in names
+        assert names.count("serve.decode_step") == MAX_NEW - 1
+        assert "serve.complete" in names
+    finally:
+        obs_metrics.enable_telemetry(False)
+        tracing.clear_spans()

@@ -85,6 +85,12 @@ REQUIRED_FAMILIES = (
     # multi-step dispatch (PT_MULTI_STEP, docs/ASYNC_DISPATCH.md)
     "pt_multistep_k", "pt_multistep_dispatches_total",
     "pt_multistep_substeps_total", "pt_multistep_early_exits_total",
+    # serving engine (inference/serving/, docs/SERVING.md)
+    "pt_serve_queue_depth", "pt_serve_batch_occupancy",
+    "pt_serve_request_seconds", "pt_serve_tokens_total",
+    "pt_serve_tokens_per_second", "pt_serve_kv_pages_in_use",
+    "pt_serve_kv_evictions_total", "pt_serve_rejections_total",
+    "pt_serve_requests_total",
 )
 
 

@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Serving-engine latency/throughput benchmark with a CI latency gate
+(docs/SERVING.md).
+
+Drives the continuous-batching engine with a Poisson arrival process
+(seeded — the workload is reproducible) of mixed-length requests and
+reports the numbers that matter for a serving SLO:
+
+* ``p50_ms`` / ``p99_ms`` — end-to-end request latency percentiles
+  (submit to completion, queueing included);
+* ``tokens_per_sec`` — generated-token throughput over the makespan;
+* ``occupancy_mean`` / ``occupancy_max`` — decode-batch utilisation
+  (continuous batching earns its keep when mean > 1);
+* ``rejected`` — admissions the scheduler refused.
+
+``--threshold <ms>`` turns the run into a gate: exit code 3 when
+``p99_ms`` exceeds it (the same exit-code convention as
+``lint_program --check-conformance``), so CI pins serving latency the
+way it pins conformance.
+
+Usage:
+  python tools/serve_bench.py --requests 24 --rate 200 --json
+  python tools/serve_bench.py --threshold 5000        # CI gate
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def build_model(args):
+    import paddle_tpu as fluid
+    from paddle_tpu.inference.serving import (
+        BucketSpec, build_book_lm, export_serving_model,
+        load_serving_model)
+    d = args.model_dir or os.path.join(
+        tempfile.mkdtemp(prefix="serve_bench_"), "model")
+    bk = BucketSpec(batch=args.batch,
+                    prefill_lens=(args.prefill_bucket,),
+                    cache_lens=(args.cache_bucket,))
+    if not os.path.exists(os.path.join(d, "serving.json")):
+        fluid.framework.unique_name.reset()
+        prefill, decode, startup, meta = build_book_lm(
+            vocab=args.vocab, hidden=args.hidden,
+            num_layers=args.layers, max_len=2 * args.cache_bucket)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        export_serving_model(d, exe, prefill, decode, meta, buckets=bk)
+    model = load_serving_model(d, buckets=bk)
+    t0 = time.perf_counter()
+    n_sigs = model.warmup()
+    return model, n_sigs, (time.perf_counter() - t0) * 1e3
+
+
+def run_bench(args):
+    import numpy as np
+    from paddle_tpu.inference.serving import ServingEngine
+
+    model, n_sigs, warmup_ms = build_model(args)
+    eng = ServingEngine(model, max_queue=4 * args.requests)
+    rng = np.random.RandomState(args.seed)
+    # mixed workload: prompts 2..prefill_bucket, decode lengths sized
+    # to fit the declared cache bucket
+    prompts = [list(rng.randint(1, args.vocab,
+                                size=rng.randint(2, args.prefill_bucket + 1)))
+               for _ in range(args.requests)]
+    max_news = [int(rng.randint(2, args.max_new + 1))
+                for _ in range(args.requests)]
+    gaps = rng.exponential(1.0 / args.rate, size=args.requests)
+
+    stop = threading.Event()
+    loop = threading.Thread(target=eng.serve_loop, args=(stop,),
+                            daemon=True)
+    t_start = time.perf_counter()
+    loop.start()
+    reqs = []
+    for p, mn, gap in zip(prompts, max_news, gaps):
+        time.sleep(gap)
+        reqs.append(eng.submit(p, max_new_tokens=mn,
+                               tenant=f"t{len(reqs) % args.tenants}"))
+    for r in reqs:
+        r.done.wait(timeout=args.timeout_s)
+    makespan = time.perf_counter() - t_start
+    stop.set()
+    loop.join(timeout=5.0)
+
+    ok = [r for r in reqs if r.status == "ok"]
+    lat_ms = sorted((r.finished_at - r.submitted_at) * 1e3
+                    for r in ok) or [float("nan")]
+    occ = eng.occupancy_history or [0]
+
+    def pct(p):
+        return lat_ms[min(len(lat_ms) - 1,
+                          int(round(p / 100.0 * (len(lat_ms) - 1))))]
+
+    return {
+        "requests": args.requests,
+        "completed": len(ok),
+        "rejected": sum(1 for r in reqs
+                        if r.status not in (None, "ok")),
+        "rate_rps": args.rate,
+        "warmup_signatures": n_sigs,
+        "warmup_ms": round(warmup_ms, 1),
+        "p50_ms": round(pct(50), 2),
+        "p99_ms": round(pct(99), 2),
+        "tokens_per_sec": round(
+            sum(len(r.tokens) for r in ok) / makespan, 1),
+        "occupancy_mean": round(sum(occ) / len(occ), 2),
+        "occupancy_max": max(occ),
+        "decode_steps": len(eng.occupancy_history),
+        "kv_pages_leaked": eng.kv.pages_in_use,
+        "makespan_s": round(makespan, 2),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--requests", type=int, default=24)
+    ap.add_argument("--rate", type=float, default=100.0,
+                    help="Poisson arrival rate, requests/sec")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--prefill-bucket", type=int, default=8)
+    ap.add_argument("--cache-bucket", type=int, default=32)
+    ap.add_argument("--max-new", type=int, default=8)
+    ap.add_argument("--tenants", type=int, default=2)
+    ap.add_argument("--vocab", type=int, default=64)
+    ap.add_argument("--hidden", type=int, default=16)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--model-dir", default=None,
+                    help="reuse/serve an existing export (default: "
+                    "fresh temp dir)")
+    ap.add_argument("--timeout-s", type=float, default=120.0)
+    ap.add_argument("--threshold", type=float, default=None,
+                    help="CI gate: exit 3 when p99_ms exceeds this")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable single-line output")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    out = run_bench(args)
+    if args.json:
+        print(json.dumps(out))
+    else:
+        for k, v in out.items():
+            print(f"{k:>20}: {v}")
+
+    if out["kv_pages_leaked"]:
+        print(f"FAIL: {out['kv_pages_leaked']} KV pages leaked",
+              file=sys.stderr)
+        return 2
+    if out["completed"] != out["requests"]:
+        print(f"FAIL: {out['requests'] - out['completed']} requests "
+              "did not complete", file=sys.stderr)
+        return 2
+    if args.threshold is not None and out["p99_ms"] > args.threshold:
+        print(f"FAIL: p99 {out['p99_ms']}ms exceeds threshold "
+              f"{args.threshold}ms", file=sys.stderr)
+        return 3
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
