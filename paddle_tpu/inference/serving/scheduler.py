@@ -35,7 +35,9 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, List, Optional
+import warnings
+from collections import deque
+from typing import Deque, Dict, List, Optional
 
 import numpy as np
 
@@ -113,7 +115,9 @@ class TenantQuota:
     """Per-tenant admission policy: ``max_concurrent`` in-flight
     requests (excess waits in the queue — backpressure, not an error)
     and a hard ``token_budget`` (prompt + max_new_tokens charged at
-    submit; exhaustion REJECTS with ``quota_exceeded``)."""
+    submit; exhaustion REJECTS with ``quota_exceeded``). Requests that
+    end in any non-``ok`` terminal status — deadline-expired, runner
+    failure — are refunded, so only completed work consumes budget."""
 
     def __init__(self, max_concurrent: int = 8,
                  token_budget: Optional[int] = None):
@@ -152,7 +156,10 @@ class ServingEngine:
         self._running: List[Request] = []    # decoding
         self._draining = False
         self._decode_dispatches = 0
-        self.occupancy_history: List[int] = []
+        # bounded: the stats RPC reads a short tail and serve_bench a
+        # whole run's worth; unbounded growth would leak on a
+        # long-running server
+        self.occupancy_history: Deque[int] = deque(maxlen=4096)
         self._win_tokens = 0
         self._win_t0 = clock()
         from ...observability import metrics as _m
@@ -172,7 +179,13 @@ class ServingEngine:
         req = Request(prompt, max_new_tokens, tenant, priority,
                       None if deadline_s is None else now + deadline_s,
                       now, trace=trace)
-        if req.total_budget > self.model.buckets.max_context:
+        # bucket_for raises past the largest declared signature, so
+        # admission must reject BOTH overlong prompts (prefill bucket)
+        # and overlong total budgets (cache bucket) up front — an
+        # accepted request must never make a phase raise mid-step
+        bk = self.model.buckets
+        if len(req.prompt) > bk.prefill_lens[-1] or \
+                req.total_budget > bk.max_context:
             return self._reject(req, STATUS_QUEUE_FULL, "too_long")
         with self._lock:
             if self._draining or len(self._queue) >= self.max_queue:
@@ -207,6 +220,13 @@ class ServingEngine:
         req.status = status
         req.finished_at = self.clock()
         req.state = _DONE
+        if status != STATUS_OK:
+            # the budget charged at submit bought no completed work —
+            # refund it so a failing/expiring tenant isn't permanently
+            # locked out of its token_budget
+            with self._lock:
+                q = self._quota(req.tenant)
+                q.used_tokens = max(0, q.used_tokens - req.total_budget)
         wall = req.finished_at - req.submitted_at
         m = self._m
         m.counter("pt_serve_requests_total").inc(1.0, status=status)
@@ -261,15 +281,18 @@ class ServingEngine:
         did = False
         while True:
             with self._lock:
-                if not self._queue:
-                    return did
                 order = sorted(
                     self._queue,
                     key=lambda r: (-r.priority, r.submitted_at))
-                req = order[0]
-                if self._concurrency(req.tenant) >= \
-                        self._quota(req.tenant).max_concurrent:
-                    return did   # backpressure, stays queued
+                # SKIP (not stall on) requests whose tenant is at its
+                # concurrency cap: one saturated tenant backpressures
+                # only itself, never other tenants' queued work
+                req = next(
+                    (r for r in order
+                     if self._concurrency(r.tenant) <
+                     self._quota(r.tenant).max_concurrent), None)
+            if req is None:
+                return did       # empty, or every tenant at its cap
             if not self.kv.can_allocate(req.total_budget) and \
                     not self._preempt_for(req):
                 return did       # memory pressure, stays queued
@@ -493,7 +516,23 @@ class ServingEngine:
 
     def serve_loop(self, stop: threading.Event,
                    idle_sleep: float = 0.002) -> None:
-        """Run ``step()`` until ``stop`` is set; sleeps when idle."""
+        """Run ``step()`` until ``stop`` is set; sleeps when idle.
+
+        A ``step()`` exception must not silently kill this thread —
+        every in-flight and queued request would hang forever on
+        ``done.wait()``. Admission validates everything the phases
+        assume, so this is a last-resort containment: warn, back off,
+        keep serving."""
         while not stop.is_set():
-            if not self.step():
+            try:
+                did = self.step()
+            except Exception:
+                import traceback
+                warnings.warn(
+                    "ServingEngine.step() raised; engine continues:\n"
+                    + traceback.format_exc(), RuntimeWarning)
+                self._m.counter("pt_serve_step_errors_total").inc()
+                stop.wait(max(idle_sleep, 0.05))
+                continue
+            if not did:
                 stop.wait(idle_sleep)

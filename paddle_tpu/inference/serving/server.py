@@ -130,16 +130,20 @@ class ServeServer:
                 t = msg.get("t") if isinstance(msg, dict) else None
                 tctx = msg.pop("tctx", None) \
                     if isinstance(msg, dict) else None
-                with _obs_tracing.server_span(tctx, f"serve.{t}",
-                                              endpoint=self.endpoint):
-                    self._dispatch(conn, t, msg, tctx)
+                # the error reply must go out while conn is still open
+                # — outside this block the socket is closed and the
+                # client would only ever see a dropped connection
+                try:
+                    with _obs_tracing.server_span(
+                            tctx, f"serve.{t}", endpoint=self.endpoint):
+                        self._dispatch(conn, t, msg, tctx)
+                except (ConnectionError, OSError):
+                    raise
+                except Exception as exc:
+                    _send_msg(conn,
+                              {"err": f"{type(exc).__name__}: {exc}"})
         except (ConnectionError, OSError):
             pass
-        except Exception as exc:
-            try:
-                _send_msg(conn, {"err": f"{type(exc).__name__}: {exc}"})
-            except OSError:
-                pass
 
     def _dispatch(self, conn: socket.socket, t, msg,
                   tctx: Optional[dict]) -> None:
@@ -165,7 +169,7 @@ class ServeServer:
                 "pending": eng.pending(),
                 "draining": eng._draining,
                 "kv": eng.kv.stats(),
-                "occupancy": list(eng.occupancy_history[-16:]),
+                "occupancy": list(eng.occupancy_history)[-16:],
             })
         elif t == "drain":
             _send_msg(conn, {"drained": self.shutdown()})

@@ -606,6 +606,10 @@ def _install_standard_families(reg: MetricsRegistry) -> None:
     reg.counter("pt_serve_requests_total",
                 "serving requests retired, labeled {status} "
                 "(ok / deadline_expired / quota_exceeded / failed)")
+    reg.counter("pt_serve_step_errors_total",
+                "unexpected ServingEngine.step() exceptions contained "
+                "by serve_loop (should stay 0; nonzero means a "
+                "scheduler invariant broke)")
     reg.register_collector(_engine_families)
     reg.register_collector(_rpc_families)
 

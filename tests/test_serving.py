@@ -29,7 +29,7 @@ from paddle_tpu.inference.serving import (
     BucketSpec, PagedKVCache, ServeServer, ServingEngine, TenantQuota,
     build_book_lm, export_serving_model, generate, load_serving_model,
     reference_generate, serve_rpc, STATUS_DEADLINE, STATUS_FAILED,
-    STATUS_OK, STATUS_QUOTA)
+    STATUS_OK, STATUS_QUEUE_FULL, STATUS_QUOTA)
 from paddle_tpu.observability import memory as obs_memory
 from paddle_tpu.observability import metrics as obs_metrics
 
@@ -158,6 +158,76 @@ def test_deadline_and_quota_distinct_statuses(served):
     assert r_ok.tokens == _refs(model)[1]
 
 
+def test_overlong_prompt_rejected_not_crash(served):
+    """A prompt longer than the largest prefill bucket rejects at
+    submit (``too_long``) — admitting it would make ``bucket_for``
+    raise inside ``step()``, killing the serve loop and hanging every
+    other request."""
+    _, model = served
+    eng = ServingEngine(model)
+    rej = obs_metrics.counter("pt_serve_rejections_total")
+    before = rej.get(reason="too_long")
+    # prompt 9 > prefill_lens[-1] = 8, yet budget 11 <= cache_lens[-1]
+    # = 24: the total-budget check alone would have admitted it
+    r = eng.submit(list(range(1, 10)), max_new_tokens=2)
+    assert r.status == STATUS_QUEUE_FULL and r.done.is_set()
+    assert rej.get(reason="too_long") == before + 1
+    assert eng.kv.pages_in_use == 0             # no pages leaked
+    ok = eng.submit(PROMPTS[0], max_new_tokens=MAX_NEW)
+    _run(eng)
+    assert ok.status == STATUS_OK
+    assert ok.tokens == _refs(model)[0]
+
+
+def test_quota_refund_on_non_ok_retirement(served):
+    """The token budget charged at submit is refunded when a request
+    ends non-``ok`` — expired work must not permanently consume a
+    tenant's ``token_budget``."""
+    _, model = served
+    quota = TenantQuota(max_concurrent=4, token_budget=8)
+    eng = ServingEngine(model, quotas={"t2": quota})
+    # budget 8 holds exactly one PROMPTS[1] request (2 + 5 = 7)
+    dead = eng.submit(PROMPTS[1], max_new_tokens=MAX_NEW, tenant="t2",
+                      deadline_s=-0.01)
+    assert quota.used_tokens == 7
+    eng.step()
+    assert dead.status == STATUS_DEADLINE
+    assert quota.used_tokens == 0               # refunded
+    # without the refund this second submit would reject quota_exceeded
+    ok = eng.submit(PROMPTS[1], max_new_tokens=MAX_NEW, tenant="t2")
+    _run(eng)
+    assert ok.status == STATUS_OK
+    assert ok.tokens == _refs(model)[1]
+    assert quota.used_tokens == 7               # completed work charges
+
+
+def test_saturated_tenant_does_not_block_others(served):
+    """Admission SKIPS a tenant at its concurrency cap instead of
+    stalling the whole queue on it: another tenant's request joins the
+    very same batch."""
+    _, model = served
+    eng = ServingEngine(model,
+                        quotas={"t1": TenantQuota(max_concurrent=1)})
+    r1 = eng.submit(PROMPTS[0], max_new_tokens=MAX_NEW, tenant="t1")
+    r2 = eng.submit(PROMPTS[1], max_new_tokens=MAX_NEW, tenant="t1")
+    r3 = eng.submit(PROMPTS[2], max_new_tokens=MAX_NEW, tenant="other")
+    eng.step()      # r1 admits, r2 capped (skipped), r3 admits behind it
+    assert max(eng.occupancy_history) == 2      # r1 + r3 share the batch
+    _run(eng)
+    refs = _refs(model)
+    assert [r.status for r in (r1, r2, r3)] == [STATUS_OK] * 3
+    assert r1.tokens == refs[0] and r2.tokens == refs[1] \
+        and r3.tokens == refs[2]
+
+
+def test_occupancy_history_bounded(served):
+    """The per-dispatch occupancy ring must not grow without bound on a
+    long-running server."""
+    _, model = served
+    eng = ServingEngine(model)
+    assert eng.occupancy_history.maxlen is not None
+
+
 def test_concurrency_limit_queues_not_rejects(served):
     """max_concurrent is backpressure: the excess request WAITS and
     still completes (contrast with the quota hard-reject above)."""
@@ -268,6 +338,27 @@ def test_server_multi_tenant_end_to_end(served):
     # post-drain the engine rejects new work instead of hanging it
     late = eng.submit(PROMPTS[0], max_new_tokens=2)
     assert late.status is not None and late.done.is_set()
+
+
+def test_malformed_request_gets_error_reply(served):
+    """A handler error reaches the client as an ``{"err"}`` frame while
+    the connection is still open — not a silently dropped socket that
+    looks like a transport failure."""
+    _, model = served
+    eng = ServingEngine(model)
+    ep = f"127.0.0.1:{_free_port()}"
+    srv = ServeServer(ep, eng).start()
+    try:
+        out = serve_rpc(ep, {"t": "gen"}, timeout=10.0)  # no "prompt"
+        assert isinstance(out, dict) and "err" in out
+        assert "KeyError" in out["err"]
+        # the handler pool is intact: a valid request still round-trips
+        ok = generate(ep, PROMPTS[0], max_new_tokens=MAX_NEW,
+                      timeout=60.0)
+        assert ok["status"] == STATUS_OK
+        assert ok["tokens"] == _refs(model)[0]
+    finally:
+        srv.shutdown()
 
 
 def test_server_sigterm_graceful_drain(served):

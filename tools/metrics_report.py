@@ -90,7 +90,7 @@ REQUIRED_FAMILIES = (
     "pt_serve_request_seconds", "pt_serve_tokens_total",
     "pt_serve_tokens_per_second", "pt_serve_kv_pages_in_use",
     "pt_serve_kv_evictions_total", "pt_serve_rejections_total",
-    "pt_serve_requests_total",
+    "pt_serve_requests_total", "pt_serve_step_errors_total",
 )
 
 
