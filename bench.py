@@ -604,6 +604,79 @@ def _probe_parallelism(eng, prog, scope, feed, fetch, sync_ms):
     return out
 
 
+def _probe_pipeline(batch):
+    """MPMD pipeline probe (docs/PARALLELISM.md) for the pipeline JSON
+    tail: auto-cut a compact forward model into 2 stages (no manual
+    cut_vars — parallel/auto_cut.py), run the interleaved 1F1B
+    schedule, and report the slot table's measured bubble fraction
+    against the analytic gpipe fill/drain bubble at the same
+    microbatch count, the static per-stage HBM estimates, and the
+    predicted-vs-measured step time (predicted = per-device busy time
+    inflated by the measured bubble — how honest the schedule model is
+    about the step it just dispatched)."""
+    out = {}
+    try:
+        import paddle_tpu as fluid
+        from paddle_tpu.core.scope import Scope
+        from paddle_tpu.parallel.mpmd_pipeline import MPMDPipelineEngine
+
+        fluid.framework.unique_name.reset()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("bx", [64], dtype="float32")
+            y = fluid.layers.data("by", [1], dtype="int64")
+            h = fluid.layers.fc(x, size=128, act="relu")
+            h = fluid.layers.fc(h, size=128, act="relu")
+            h = fluid.layers.fc(h, size=128, act="relu")
+            pred = fluid.layers.fc(h, size=10, act="softmax")
+            loss = fluid.layers.mean(
+                fluid.layers.cross_entropy(input=pred, label=y))
+        n_micro = 4
+        b = max(n_micro, (min(batch, 32) // n_micro) * n_micro)
+        rng = np.random.RandomState(0)
+        feed = {"bx": rng.rand(b, 64).astype(np.float32),
+                "by": rng.randint(0, 10, (b, 1)).astype(np.int64)}
+        scope = Scope()
+        with fluid.scope_guard(scope):
+            fluid.Executor().run(startup)
+            eng = MPMDPipelineEngine(main, loss.name, None, n_stages=2,
+                                     num_microbatches=n_micro)
+            eng.run(scope, feed)      # warmup: trace both stages
+            ts = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                eng.run(scope, feed)
+                ts.append((time.perf_counter() - t0) * 1e3)
+        st = eng.last_stats or {}
+        measured_ms = sorted(ts)[len(ts) // 2]
+        busy_ms = sum(s["dur_ms"] for s in st.get("spans") or ())
+        bub = float(st.get("bubble_frac") or 0.0)
+        nd = max(1, int(st.get("n_devices") or 1))
+        predicted = (busy_ms / nd) / (1.0 - bub) if bub < 1.0 else None
+        out.update({
+            "n_stages": st.get("n_stages"),
+            "n_devices": nd,
+            "schedule": st.get("schedule"),
+            "micro_batches": st.get("micro_batches"),
+            "cut_vars": list(eng.cut_vars),
+            "bubble_frac": bub,
+            "bubble_frac_gpipe": st.get("bubble_frac_gpipe"),
+            "pipeline_fill_frac": round(
+                float(st.get("pipeline_fill_frac") or 0.0), 4),
+            "stage_hbm_bytes": st.get("stage_hbm_bytes"),
+            "activation_exchange_bytes":
+                st.get("activation_exchange_bytes"),
+            "step_ms": round(measured_ms, 3),
+            "predicted_step_ms":
+                round(predicted, 3) if predicted is not None else None,
+            "predicted_vs_measured_ratio":
+                round(predicted / measured_ms, 4)
+                if predicted is not None and measured_ms > 0 else None})
+    except Exception as exc:   # accounting only; never fail the bench
+        out["error"] = f"{type(exc).__name__}: {exc}"[:200]
+    return out
+
+
 def _probe_analysis(eng, prog, scope, feed, fetch, stats, batch):
     """Program-verifier calibration probe (docs/STATIC_ANALYSIS.md) on
     the already-built transformer: the liveness-based static HBM plan
@@ -822,6 +895,9 @@ def bench_transformer(batch=BATCH, seq=None, measure_ckpt=False):
             # parallelism JSON tail (docs/PARALLELISM.md)
             stats["parallelism"] = _probe_parallelism(
                 eng, main_prog, scope, feed, [cost.name], sync_ms)
+            # auto-cut 1F1B pipeline schedule accounting for the
+            # pipeline JSON tail (docs/PARALLELISM.md)
+            stats["pipeline"] = _probe_pipeline(batch)
             # continuous-batching serving engine probe for the
             # serving JSON tail (docs/SERVING.md)
             stats["serving"] = _probe_serving()

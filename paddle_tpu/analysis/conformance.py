@@ -561,6 +561,33 @@ def extract_trace(program, path: str, block_idx: int = 0,
         tr.record("serving", False, (),
                   note="no Program to freeze, no trace to serialize")
 
+    # pipeline parallelism (pp mesh axis, docs/PARALLELISM.md): only
+    # the engine path hosts the stage-cut engines — SPMD GPipe over
+    # the pp axis and MPMD 1F1B per-stage dispatch, both fed by the
+    # automatic cutter (declared in analysis/support_matrix.py)
+    if path == "engine":
+        tr.record("pipeline", True,
+                  ("cutter=auto-cost-model",
+                   "schedule=1f1b-interleaved",
+                   "axis=pp-outermost",
+                   "hazards=cross-stage-verified"),
+                  note="propose_cuts synthesizes the stage boundary, "
+                       "verify_stage_partition + the 1F1B slot-table "
+                       "verifier gate engine construction "
+                       "(parallel/auto_cut.py, analysis/races.py)")
+    elif path == "scheduler":
+        tr.record("pipeline", False, (),
+                  note="island lanes dispatch one whole program per "
+                       "step; no cross-lane handoff channel exists "
+                       "(core/scheduler.py scheduler_gate)")
+    elif path == "transpiled":
+        tr.record("pipeline", False, (),
+                  note="no transpiler pass splits a block into stage "
+                       "programs or emits send/recv pairs")
+    else:  # dygraph
+        tr.record("pipeline", False, (),
+                  note="no Program to cut, no schedule to verify")
+
     # cache keying + tier-2 verifier coverage
     tr.record("cache_key", True, _cache_key_content(path))
     tr.record("tier2_verifier", True, _tier2_content(path))

@@ -1,5 +1,5 @@
-"""Cost-driven SPMD placement search over the named (data, fsdp, tp)
-mesh.
+"""Cost-driven SPMD placement search over the named
+(data, fsdp, tp, pp) mesh.
 
 ROADMAP item 1's "single biggest unlock": enumerate how the device
 count factorizes onto the MeshSpec axes, score every candidate with
@@ -17,7 +17,17 @@ each candidate picks a gradient REDUCTION strategy, flat (one joint
 all-reduce over the combined data-parallel extent, paid at the
 slowest member axis) or hierarchical (reduce-scatter over the inner
 fsdp axis, all-reduce of the 1/|fsdp| shard over the outer data axis,
-all-gather back over fsdp). Constants are deliberately coarse — the
+all-gather back over fsdp). The fourth axis is the PIPELINE: a
+``pp > 1`` candidate is admitted only when the static cutter
+(``parallel/auto_cut.propose_cuts``) actually finds a balanced
+``pp``-stage cutting; its compute is inflated by the schedule bubble,
+its handoff bytes ride the (cheap, point-to-point) pp axis, and its
+per-device resident state scales by the LARGEST stage's parameter
+share — which is how a pipeline candidate can satisfy an HBM limit
+that FSDP alone cannot: FSDP's all-gather-on-use must materialize
+each full weight transiently, so its per-device floor never drops
+below the largest parameter, while a pipeline stage simply never
+hosts the other stages' weights. Constants are deliberately coarse — the
 model's job is *ranking* candidates, and ``calibrate`` folds a
 measured step time back into the predictions when the observability
 layer has one (the same honesty contract as ``cost_model``).
@@ -51,10 +61,22 @@ _MATMUL_GRADS = tuple(t + "_grad" for t in _MATMUL_TYPES)
 
 # ranking constants: assumed dense-unit peak and per-axis link
 # bandwidth (bytes/s) with the hierarchical outer-slow/inner-fast
-# shape; PT_PLACEMENT_BW_GBPS="data=25,fsdp=90,tp=90" overrides
+# shape; PT_PLACEMENT_BW_GBPS="data=25,fsdp=90,tp=90,pp=25" overrides.
+# pp is outermost (mesh.py ordering): stage handoffs are point-to-point
+# and tolerate the slow hop, so they price at the DCN-class rate.
 _DEF_PEAK_FLOPS = 1.0e14
-_DEF_BW_GBPS = {"data": 25.0, "fsdp": 90.0, "tp": 90.0}
+_DEF_BW_GBPS = {"data": 25.0, "fsdp": 90.0, "tp": 90.0, "pp": 25.0}
 _COLL_LAT_S = 2.0e-6  # fixed per-collective issue latency
+
+
+def _pp_micro() -> int:
+    """Micro-batch count the scorer assumes for the pipeline bubble
+    ((pp-1)/(M+pp-1) idle fraction) — PT_PIPELINE_MICRO overrides."""
+    try:
+        v = int(os.environ.get("PT_PIPELINE_MICRO", "8"))
+        return v if v > 0 else 8
+    except ValueError:
+        return 8
 
 
 def axis_bandwidths() -> Dict[str, float]:
@@ -105,37 +127,44 @@ def program_stats(program, block_idx: int = 0,
             if not r.op_type.endswith("_grad"):
                 mm_out_bytes += r.bytes_out
     param_bytes = 0
+    max_param_bytes = 0
     for p in program.all_parameters():
         try:
             numel = int(np.prod([abs(int(d)) for d in p.shape])) \
                 if p.shape else 1
-            param_bytes += numel * np.dtype(
-                dtype_to_np(p.dtype)).itemsize
+            b = numel * np.dtype(dtype_to_np(p.dtype)).itemsize
+            param_bytes += b
+            max_param_bytes = max(max_param_bytes, b)
         except Exception:
             continue
     plan = plan_memory(program, block_idx, dynamic_dim=dynamic_dim,
                        label="placement")
     return {"total_flops": total_flops, "mm_flops": mm_flops,
             "mm_out_bytes": mm_out_bytes, "param_bytes": param_bytes,
-            "grad_bytes": param_bytes, "memplan": plan}
+            "grad_bytes": param_bytes,
+            "max_param_bytes": max_param_bytes, "memplan": plan}
 
 
 # ---------------------------------------------------------------------------
 # candidate enumeration
 # ---------------------------------------------------------------------------
 
-def _factorizations(n: int) -> List[Tuple[int, int, int]]:
-    """Every ordered (data, fsdp, tp) with data*fsdp*tp == n,
+def _factorizations(n: int) -> List[Tuple[int, int, int, int]]:
+    """Every ordered (data, fsdp, tp, pp) with product == n,
     deterministically sorted."""
     out = []
     for d in range(1, n + 1):
         if n % d:
             continue
-        rest = n // d
-        for f in range(1, rest + 1):
-            if rest % f:
+        r1 = n // d
+        for f in range(1, r1 + 1):
+            if r1 % f:
                 continue
-            out.append((d, f, rest // f))
+            r2 = r1 // f
+            for t in range(1, r2 + 1):
+                if r2 % t:
+                    continue
+                out.append((d, f, t, r2 // t))
     return sorted(out)
 
 
@@ -143,18 +172,22 @@ def enumerate_candidates(n_devices: int, budget: int = 64,
                          pins: Optional[Dict[str, int]] = None
                          ) -> List[Tuple["MeshSpec", str]]:
     """(MeshSpec, reduction) candidates for ``n_devices``. ``pins``
-    fixes axis sizes (the PT_MESH_FSDP / PT_MESH_TP knobs; 0 = free).
-    Both reduction strategies are enumerated only where they differ
-    (data > 1 AND fsdp > 1); ``budget`` caps the list AFTER the
-    deterministic sort, so a budget cut is reproducible."""
+    fixes axis sizes (the PT_MESH_FSDP / PT_MESH_TP / PT_MESH_PP
+    knobs; 0 = free). Both reduction strategies are enumerated only
+    where they differ (data > 1 AND fsdp > 1); ``budget`` caps the
+    list AFTER the deterministic sort, so a budget cut is
+    reproducible. Whether a ``pp > 1`` candidate is actually
+    EXECUTABLE (the program admits a balanced pp-stage cutting) is
+    the searcher's job — enumeration is program-free."""
     from ..parallel.mesh import MeshSpec
     pins = pins or {}
     cands: List[Tuple[MeshSpec, str]] = []
-    for d, f, t in _factorizations(max(1, int(n_devices))):
+    for d, f, t, p in _factorizations(max(1, int(n_devices))):
         if any(int(pins.get(a, 0)) > 0 and v != int(pins[a])
-               for a, v in (("data", d), ("fsdp", f), ("tp", t))):
+               for a, v in (("data", d), ("fsdp", f), ("tp", t),
+                            ("pp", p))):
             continue
-        spec = MeshSpec(data=d, fsdp=f, tp=t)
+        spec = MeshSpec(data=d, fsdp=f, tp=t, pp=p)
         if d > 1 and f > 1:
             cands.append((spec, "flat"))
             cands.append((spec, "hierarchical"))
@@ -169,48 +202,76 @@ def enumerate_candidates(n_devices: int, budget: int = 64,
 # scoring
 # ---------------------------------------------------------------------------
 
-def candidate_hbm_bytes(plan, spec) -> int:
+def candidate_hbm_bytes(plan, spec, stage_frac: Optional[float] = None,
+                        gather_bytes: int = 0) -> int:
     """Per-device HBM estimate for a candidate: resident state
-    (params + optimizer moments) shards over the fsdp*tp extent,
-    feeds and transients shard over the batch (data*fsdp) extent,
-    overheads stay whole. Coarse by design — it gates candidates
-    against ``configured_limit_bytes()``, it does not bill them."""
+    (params + optimizer moments) shards over the fsdp*tp extent —
+    and, under a pipeline axis, scales by the largest stage's share
+    ``stage_frac`` (default the uniform 1/pp) since a stage never
+    hosts the other stages' weights; feeds and transients shard over
+    the batch (data*fsdp) extent — and transients ALSO scale by the
+    stage share, since a stage only materializes the intermediates of
+    its own layers; overheads stay whole.
+    ``gather_bytes`` is the FSDP all-gather-on-use working set (the
+    largest full weight plus its grad reduce-scatter buffer) — a floor
+    no fsdp extent can shard away, which is exactly what a pipeline
+    candidate escapes. Coarse by design — it gates candidates against
+    ``configured_limit_bytes()``, it does not bill them."""
     shard = max(1, spec.fsdp * spec.tp)
     batch = max(1, spec.data * spec.fsdp)
+    pp = max(1, int(getattr(spec, "pp", 1)))
+    frac = stage_frac if stage_frac is not None else 1.0 / pp
     extra = sum(v for k, v in plan.overheads.items()
                 if k != "ckpt_snapshot")
-    return int(plan.resident_bytes / shard + plan.feed_bytes / batch +
-               plan.transient_peak_bytes / batch + extra)
+    gather = gather_bytes if spec.fsdp > 1 else 0
+    return int(plan.resident_bytes * frac / shard +
+               plan.feed_bytes / batch +
+               plan.transient_peak_bytes * frac / batch +
+               gather + extra)
 
 
 def score_candidate(spec, reduction: str, stats: Dict[str, Any],
                     bw: Optional[Dict[str, float]] = None,
-                    peak_flops: Optional[float] = None
-                    ) -> Dict[str, Any]:
+                    peak_flops: Optional[float] = None,
+                    cut_plan=None) -> Dict[str, Any]:
     """Static step-cost prediction for one (MeshSpec, reduction).
 
-    Compute: matmul FLOPs divide by the full mesh (batch axes + tp);
-    everything else only by the batch axes. Communication, per device:
+    Compute: matmul FLOPs divide by the full mesh (batch axes + tp,
+    and pp — each stage runs 1/pp of the layers), then inflate by the
+    pipeline bubble 1/(1 - (pp-1)/(M+pp-1)) = (M+pp-1)/M for the
+    assumed micro-batch count M (``PT_PIPELINE_MICRO``); everything
+    else only by the batch axes (and pp). Communication, per device:
 
     * grad reduction over the data-parallel extent of the 1/tp grad
       shard — flat (one joint ring all-reduce, 2N(n-1)/n bytes, paid
       on the slowest member axis) or hierarchical (reduce-scatter over
       fsdp + all-reduce of the 1/fsdp shard over data + all-gather);
+      under pp each device only reduces its own stage's grads (the
+      1/pp share);
     * FSDP all-gather-on-use: each weight gathered over fsdp in the
       forward and again in the backward;
     * tp activation exchange: the matmul output activations
       all-reduced over tp (the Megatron row-split reduction), batch-
-      sharded over (data, fsdp).
+      sharded over (data, fsdp);
+    * pp activation handoff: each boundary's crossing activations
+      (``cut_plan.activation_bytes`` when the searcher supplies the
+      synthesized cutting) cross once forward and once backward
+      (cotangents), batch-sharded over (data, fsdp), point-to-point
+      on the pp axis.
     """
     bw = bw or axis_bandwidths()
     peak = peak_flops or _peak_flops()
     d, f, t = int(spec.data), int(spec.fsdp), int(spec.tp)
+    pp = max(1, int(getattr(spec, "pp", 1)))
     mm = stats["mm_flops"]
     other = max(0, stats["total_flops"] - mm)
-    compute_s = (mm / (d * f * t) + other / (d * f)) / peak
+    compute_s = (mm / (d * f * t * pp) + other / (d * f * pp)) / peak
+    if pp > 1:
+        M = _pp_micro()
+        compute_s *= (M + pp - 1) / float(M)
 
-    g = stats["grad_bytes"] / t
-    per_axis = {"data": 0.0, "fsdp": 0.0, "tp": 0.0}
+    g = stats["grad_bytes"] / t / pp
+    per_axis = {"data": 0.0, "fsdp": 0.0, "tp": 0.0, "pp": 0.0}
     ncoll = 0
     if d > 1 or f > 1:
         if reduction == "hierarchical" and f > 1:
@@ -232,11 +293,23 @@ def score_candidate(spec, reduction: str, stats: Dict[str, Any],
         per_axis["tp"] += 2.0 * (stats["mm_out_bytes"] / (d * f)) * \
             (t - 1) / t
         ncoll += 2
+    if pp > 1:
+        act = cut_plan.activation_bytes if cut_plan is not None \
+            else stats["mm_out_bytes"] / max(1, pp)
+        per_axis["pp"] += 2.0 * act / (d * f)
+        ncoll += 2
     comm_s = sum(per_axis[a] / bw[a] for a in per_axis) + \
         ncoll * _COLL_LAT_S
 
     plan = stats["memplan"]
-    hbm = candidate_hbm_bytes(plan, spec)
+    stage_frac = None
+    if pp > 1 and cut_plan is not None:
+        tot = sum(cut_plan.stage_param_bytes)
+        stage_frac = (max(cut_plan.stage_param_bytes) / tot
+                      if tot > 0 else 1.0 / pp)
+    hbm = candidate_hbm_bytes(
+        plan, spec, stage_frac=stage_frac,
+        gather_bytes=2 * int(stats.get("max_param_bytes", 0)))
     limit = configured_limit_bytes()
     return {"predicted_ms": (compute_s + comm_s) * 1.0e3,
             "compute_ms": compute_s * 1.0e3,
@@ -276,7 +349,8 @@ class PlacementPlan:
 
     @property
     def multi_axis(self) -> bool:
-        return self.spec.fsdp > 1 or self.spec.tp > 1
+        return self.spec.fsdp > 1 or self.spec.tp > 1 or \
+            self.spec.pp > 1
 
     def to_dict(self) -> Dict[str, Any]:
         return {"mesh": self.spec.to_dict(),
@@ -312,7 +386,8 @@ class PlacementPlan:
 
 def _env_pins() -> Dict[str, int]:
     pins: Dict[str, int] = {}
-    for axis, env in (("fsdp", "PT_MESH_FSDP"), ("tp", "PT_MESH_TP")):
+    for axis, env in (("fsdp", "PT_MESH_FSDP"), ("tp", "PT_MESH_TP"),
+                      ("pp", "PT_MESH_PP")):
         raw = os.environ.get(env, "")
         try:
             v = int(raw)
@@ -358,13 +433,31 @@ def search_placement(program, n_devices: Optional[int] = None,
         if m > 0 and base["predicted_ms"] > 0:
             cal = m / base["predicted_ms"]
 
+    # pp candidates are admitted only when the program actually cuts
+    # into that many balanced stages (parallel/auto_cut) — one cut
+    # synthesis per distinct pp extent, memoized
+    cut_cache: Dict[int, Any] = {}
+
+    def _cuts_for(p: int):
+        if p not in cut_cache:
+            try:
+                from ..parallel.auto_cut import propose_cuts
+                cut_cache[p] = propose_cuts(
+                    program, "", p, block_idx,
+                    dynamic_dim=max(1, dynamic_dim), uniform=False)
+            except Exception:
+                cut_cache[p] = None
+        return cut_cache[p]
+
     pins = _env_pins()
     raw_axes = os.environ.get("PT_MESH_AXES", "")
     if raw_axes.strip():
         # a full hand-pinned mesh short-circuits the search
         spec = MeshSpec.from_string(raw_axes)
         red = "hierarchical" if spec.fsdp > 1 else "flat"
-        sc = score_candidate(spec, red, stats, bw, peak)
+        sc = score_candidate(spec, red, stats, bw, peak,
+                             cut_plan=_cuts_for(spec.pp)
+                             if spec.pp > 1 else None)
         return PlacementPlan(
             spec, red, sc["predicted_ms"] * cal,
             base["predicted_ms"] * cal, sc["per_axis_bytes"],
@@ -374,14 +467,19 @@ def search_placement(program, n_devices: Optional[int] = None,
     best_key = None
     trials = 0
     for spec, red in enumerate_candidates(n, budget, pins):
-        sc = score_candidate(spec, red, stats, bw, peak)
+        cp = None
+        if spec.pp > 1:
+            cp = _cuts_for(spec.pp)
+            if cp is None:
+                continue  # program admits no pp-stage cutting
+        sc = score_candidate(spec, red, stats, bw, peak, cut_plan=cp)
         trials += 1
         if not sc["hbm_feasible"]:
             continue
-        n_axes = sum(1 for v in (spec.data, spec.fsdp, spec.tp)
-                     if v > 1)
+        n_axes = sum(1 for v in (spec.data, spec.fsdp, spec.tp,
+                                 spec.pp) if v > 1)
         key = (sc["predicted_ms"], n_axes,
-               -spec.data, -spec.fsdp, -spec.tp, red)
+               -spec.data, -spec.fsdp, -spec.tp, -spec.pp, red)
         if best_key is None or key < best_key:
             best_key = key
             best = (spec, red, sc)

@@ -39,7 +39,8 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from .diagnostics import Diagnostic, Severity
 
-__all__ = ["verify_partition", "donation_plan", "ENGINE_STATE_RE"]
+__all__ = ["verify_partition", "donation_plan", "ENGINE_STATE_RE",
+           "verify_stage_partition", "verify_pipeline_schedule"]
 
 # engine-managed in-trace state: fully-enclosed upper-case @NAME@ vars
 # (core/engine.py RNG_STATE_VAR, stability/guard.py @GUARD_*@ /
@@ -314,6 +315,174 @@ def _bucket_plan_diags(ctx) -> List[Diagnostic]:
                 f"c_allreduce_fused bucket — their updates silently "
                 f"skip the ring on this rank and desync replicas",
                 block_idx=block_idx, var_names=tuple(missing)))
+    return diags
+
+
+def verify_stage_partition(program, cut_vars, block_idx: int = 0,
+                           stacked: bool = False,
+                           label: Optional[str] = None
+                           ) -> List[Diagnostic]:
+    """Cross-stage hazards of a pipeline cutting (category
+    ``pipeline-race``): the pipeline engines split one block at
+    ``cut_vars`` and run the stages on different devices under a
+    micro-batch schedule, so hazards the single-program executor could
+    never exhibit become possible:
+
+    * activation-handoff WRITE-WRITE — a value that crosses a stage
+      boundary is (re)written by a second stage: the consumer may
+      observe either producer depending on dispatch order;
+    * consumed-before-produced (RW) — a stage reads a value whose only
+      producer is a LATER stage: the schedule moves activations
+      strictly forward, so the read can never be satisfied;
+    * stacked-param update aliasing — a param read by several stages.
+      With ``stacked=True`` (the SPMD engine, which stacks per-stage
+      param slabs into one leading-``pp``-dim array) two slab rows
+      alias ONE scope var and the per-stage updates silently diverge
+      from the single-device semantics: ERROR.  The MPMD engine sums
+      the per-stage grads and updates once, so there it is only a
+      replication-cost WARNING.
+
+    Same re-derivation stance as ``verify_partition``: the stage
+    read/write sets come from the op slots via
+    ``parallel/auto_cut.stage_partition``, not from any engine
+    bookkeeping.
+    """
+    from ..parallel.auto_cut import stage_partition
+    diags: List[Diagnostic] = []
+    try:
+        part = stage_partition(program, cut_vars, block_idx)
+    except ValueError as e:
+        return [Diagnostic(
+            Severity.ERROR, "pipeline-race",
+            f"invalid stage cutting: {e}", block_idx=block_idx,
+            var_names=tuple(cut_vars), program_label=label)]
+    produced_by: Dict[str, int] = {}
+    for s, w in enumerate(part.stage_writes):
+        for n in w:
+            produced_by.setdefault(n, s)
+    # 1. activation-handoff WW: any name written by 2+ stages that some
+    # OTHER stage reads (a purely stage-internal rewrite is the normal
+    # in-stage dataflow the def-use pass already covers)
+    for name in sorted(set().union(*part.stage_writes)
+                       if part.stage_writes else ()):
+        writers = [s for s, w in enumerate(part.stage_writes)
+                   if name in w]
+        if len(writers) < 2:
+            continue
+        readers = [s for s, r in enumerate(part.stage_reads)
+                   if name in r and s not in writers]
+        if readers or name in part.cut_vars:
+            diags.append(Diagnostic(
+                Severity.ERROR, "pipeline-race",
+                f"activation-handoff write-write hazard: stages "
+                f"{writers} all write {name!r} which stage(s) "
+                f"{readers or writers} consume across the boundary — "
+                f"the handoff value depends on stage dispatch order",
+                block_idx=block_idx, var_names=(name,),
+                program_label=label))
+    # 2. consumed-before-produced: reader stage strictly before the
+    # producing stage (params/feeds have no producer — skipped)
+    for s, reads in enumerate(part.stage_reads):
+        for name in sorted(reads - part.stage_writes[s]):
+            src = produced_by.get(name)
+            if src is not None and src > s:
+                diags.append(Diagnostic(
+                    Severity.ERROR, "pipeline-race",
+                    f"consumed-before-produced hazard: stage {s} "
+                    f"reads {name!r} but its only producer is stage "
+                    f"{src} — activations flow strictly forward, so "
+                    f"no schedule can satisfy this read",
+                    block_idx=block_idx, var_names=(name,),
+                    program_label=label))
+    # 3. stacked-param aliasing
+    tied = part.tied_params()
+    if tied:
+        sev = Severity.ERROR if stacked else Severity.WARNING
+        what = ("the SPMD engine stacks per-stage param slabs, so two "
+                "slab rows alias one scope var and the per-stage "
+                "updates silently diverge" if stacked else
+                "the MPMD engine replicates it per stage and sums the "
+                "grads — correct, but the memory cost is per-stage")
+        diags.append(Diagnostic(
+            sev, "pipeline-race",
+            f"{len(tied)} param(s) read by more than one stage "
+            f"({', '.join(tied[:5])}{'...' if len(tied) > 5 else ''})"
+            f" — {what}",
+            block_idx=block_idx, var_names=tuple(tied[:8]),
+            program_label=label))
+    return diags
+
+
+def verify_pipeline_schedule(events, n_stages: int, n_micro: int,
+                             label: Optional[str] = None
+                             ) -> List[Diagnostic]:
+    """Prove a pipeline slot table (``core/scheduler.pipeline_schedule``
+    events ``(tick, device, kind, stage, micro)``) safe before anything
+    dispatches: every F/B event exactly once, every event's pipeline
+    dependencies strictly earlier (F(s,m) after F(s-1,m); B(s,m) after
+    F(s,m) and after B(s+1,m) — the activation/cotangent handoffs),
+    and no device double-booked in a tick.  A violated edge is exactly
+    a cross-stage read-before-write on the handoff buffer, so the
+    diagnostics use the same ``pipeline-race`` category as
+    ``verify_stage_partition``.
+    """
+    diags: List[Diagnostic] = []
+    slot: Dict[Tuple[str, int, int], int] = {}
+    busy: Dict[Tuple[int, int], Tuple[str, int, int]] = {}
+    for tick, dev, kind, s, m in events:
+        k = (kind, s, m)
+        if k in slot:
+            diags.append(Diagnostic(
+                Severity.ERROR, "pipeline-race",
+                f"duplicate event {kind}(stage={s}, micro={m}) at "
+                f"ticks {slot[k]} and {tick} — the micro-batch would "
+                f"be computed twice (grads double-counted)",
+                program_label=label))
+            continue
+        slot[k] = tick
+        prev = busy.get((tick, dev))
+        if prev is not None:
+            diags.append(Diagnostic(
+                Severity.ERROR, "pipeline-race",
+                f"device {dev} double-booked at tick {tick}: "
+                f"{prev[0]}(stage={prev[1]}, micro={prev[2]}) and "
+                f"{kind}(stage={s}, micro={m})",
+                program_label=label))
+        busy[(tick, dev)] = k
+    expect = [(kind, s, m) for kind in ("F", "B")
+              for s in range(n_stages) for m in range(n_micro)]
+    missing = [k for k in expect if k not in slot]
+    if missing:
+        k0 = missing[0]
+        diags.append(Diagnostic(
+            Severity.ERROR, "pipeline-race",
+            f"{len(missing)} event(s) missing from the schedule "
+            f"(first: {k0[0]}(stage={k0[1]}, micro={k0[2]})) — the "
+            f"step would silently drop micro-batch work",
+            program_label=label))
+    last = n_stages - 1
+    for (kind, s, m), t in sorted(slot.items()):
+        deps = []
+        if kind == "F" and s > 0:
+            deps.append(("F", s - 1, m))
+        if kind == "B":
+            deps.append(("F", s, m))
+            if s < last:
+                deps.append(("B", s + 1, m))
+        for d in deps:
+            td = slot.get(d)
+            if td is None:
+                continue  # reported as missing above
+            if td >= t:
+                diags.append(Diagnostic(
+                    Severity.ERROR, "pipeline-race",
+                    f"handoff read-before-write: {kind}(stage={s}, "
+                    f"micro={m}) at tick {t} consumes the output of "
+                    f"{d[0]}(stage={d[1]}, micro={d[2]}) scheduled at "
+                    f"tick {td} — the "
+                    f"{'activation' if d[0] == 'F' else 'cotangent'} "
+                    f"buffer is read before it is produced",
+                    program_label=label))
     return diags
 
 

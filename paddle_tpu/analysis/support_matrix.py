@@ -48,6 +48,7 @@ FEATURES: Tuple[str, ...] = (
     "tier2_verifier",            # runtime re-verification coverage
     "multi_step",                # PT_MULTI_STEP K-substep scan driver
     "serving",                   # frozen-program serving export
+    "pipeline",                  # pp mesh axis: stage cutting + 1F1B
 )
 
 SUPPORTED = "supported"
@@ -279,6 +280,32 @@ def default_matrix() -> SupportMatrix:
         "fetch signatures and AOT StableHLO artifacts; eager dygraph "
         "has no Program to freeze and no trace to serialize "
         "(inference/serving/export.py).")
+
+    # -- pipeline parallelism (pp mesh axis, docs/PARALLELISM.md): the
+    #    engine path carries it through the dedicated pipeline engines
+    #    (SPMD GPipe over the pp axis, MPMD 1F1B per-stage dispatch),
+    #    both fed by the same automatic stage cutter.  No other path
+    #    can host a cut program.
+    m.declare(
+        "pipeline", "scheduler", UNSUPPORTED,
+        "island lanes dispatch ONE whole program per step and have no "
+        "cross-lane handoff channel, so a stage-cut program cannot "
+        "ride them; the engine also gates islands on `mesh is None` "
+        "while a pp>1 mesh is exactly what pipeline needs "
+        "(core/scheduler.py scheduler_gate; parallel/pipeline.py).")
+    m.declare(
+        "pipeline", "transpiled", UNSUPPORTED,
+        "the transpiler emits process-level SPMD programs with "
+        "explicit c_* collective ops; it has no pass that splits a "
+        "block at cut activations into per-rank stage programs or "
+        "emits the send/recv pairs a 1F1B schedule needs "
+        "(transpiler/collective.py).")
+    m.declare(
+        "pipeline", "dygraph", UNSUPPORTED,
+        "stage cutting is a static Program transform "
+        "(parallel/auto_cut.py propose_cuts walks block ops); eager "
+        "dygraph has no Program to cut and no schedule to verify "
+        "(dygraph/parallel.py).")
 
     assert not m.validate()
     return m

@@ -1420,6 +1420,8 @@ class Engine:
                 os.environ.get("PT_MESH_AXES", ""),
                 os.environ.get("PT_MESH_FSDP", ""),
                 os.environ.get("PT_MESH_TP", ""),
+                os.environ.get("PT_MESH_PP", ""),
+                os.environ.get("PT_PIPELINE_MICRO", ""),
                 # multi-step scan driver (docs/ASYNC_DISPATCH.md): K is
                 # also an explicit key component where the slab arrives,
                 # but the env knob arms the prefetcher's slab mode, so a

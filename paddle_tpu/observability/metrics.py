@@ -524,7 +524,24 @@ def _install_standard_families(reg: MetricsRegistry) -> None:
               "placement plan")
     reg.gauge("pt_placement_collective_bytes",
               "predicted per-device collective bytes per step of the "
-              "chosen plan, labeled {axis} (data / fsdp / tp)")
+              "chosen plan, labeled {axis} (data / fsdp / tp / pp)")
+    # pipeline engines (parallel/pipeline.py, parallel/mpmd_pipeline.py;
+    # docs/PARALLELISM.md)
+    reg.counter("pt_pipeline_steps_total",
+                "pipeline training steps, labeled {schedule} "
+                "(gpipe-spmd / 1f1b / gpipe)")
+    reg.gauge("pt_pipeline_stages",
+              "pipeline stage count of the last pipelined step")
+    reg.gauge("pt_pipeline_bubble_frac",
+              "measured schedule bubble fraction of the last "
+              "pipelined step (idle device-slots / total slots)")
+    reg.counter("pt_pipeline_activation_exchange_bytes_total",
+                "bytes handed across stage boundaries (activations "
+                "forward + cotangents backward)")
+    reg.gauge("pt_pipeline_stage_hbm_peak_bytes",
+              "static per-stage HBM estimate from the synthesized "
+              "cut plan, labeled {stage} (max over stages when "
+              "unlabeled)")
     # HBM memory observatory (observability/memory.py, docs/MEMORY.md)
     reg.gauge("pt_hbm_owner_bytes",
               "owner-attributed live HBM bytes from the buffer census, "

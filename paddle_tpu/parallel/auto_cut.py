@@ -1,0 +1,432 @@
+"""Automatic pipeline stage cutting: cost-model-balanced cut selection.
+
+The reference's PipelineOptimizer (python optimizer.py:2664) trusts the
+user to name ``cut_list`` variables; here the cuts are SYNTHESIZED. The
+static per-op cost model (``analysis/cost_model.program_cost``) supplies
+per-op FLOPs, declared var shapes supply per-stage parameter bytes, and
+a balanced-partition DP picks the ``n_stages - 1`` boundaries that
+minimize the maximum per-stage weight (FLOPs share + parameter-byte
+share — the two terms the memplan's HBM gate and the schedule's
+critical path respectively care about). No tracing, no compilation.
+
+Two boundary regimes, matching the two pipeline engines
+(docs/PARALLELISM.md):
+
+* ``uniform=True`` (SPMD ``parallel/pipeline.py``): a boundary is a
+  candidate only when exactly ONE live value crosses it (the tick loop
+  carries a single activation buffer) and every chosen cut shares one
+  (shape, dtype) — the engine's uniform-stage contract;
+* ``uniform=False`` (MPMD ``parallel/mpmd_pipeline.py``): any boundary
+  whose preceding op produces a live crossing value qualifies; multiple
+  crossing activations (skip connections, encoder memory) ride the
+  per-stage activation dicts.
+
+``validate_cuts`` is the static checker behind ``tools/lint_program.py
+--check-placement``: produced-before-consumed ordering, dead cuts,
+per-stage SpecLayout coverage, and tied (multi-stage) params that the
+SPMD engine would silently replicate. ``stage_partition`` is the shared
+substrate the cross-stage race verifier (``analysis/races.py``) reuses.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+__all__ = ["CutPlan", "propose_cuts", "validate_cuts",
+           "stage_partition", "StagePartition"]
+
+
+def _forward_ops(block):
+    return [op for op in block.ops if op.type not in ("feed", "fetch")]
+
+
+def _reads(op):
+    out = []
+    for slot in op.input_slots():
+        out.extend(n for n in op.input(slot) if n)
+    return out
+
+
+def _writes(op):
+    out = []
+    for slot in op.output_slots():
+        out.extend(n for n in op.output(slot) if n)
+    return out
+
+
+def _var_bytes(block, name: str, dynamic_dim: int) -> int:
+    from ..analysis.cost_model import _shape_of, _numel, _itemsize
+    return _numel(_shape_of(block, name, dynamic_dim)) * \
+        _itemsize(block, name)
+
+
+def _var_sig(block, name: str, dynamic_dim: int):
+    from ..analysis.cost_model import _shape_of
+    v = block._find_var_recursive(name)
+    dtype = getattr(v, "dtype", None) if v is not None else None
+    return (_shape_of(block, name, dynamic_dim), dtype)
+
+
+class StagePartition:
+    """Static stage decomposition of a forward block at cut_vars."""
+
+    __slots__ = ("cut_vars", "bounds", "stages", "stage_reads",
+                 "stage_writes", "crossing", "param_names")
+
+    def __init__(self, cut_vars, bounds, stages, stage_reads,
+                 stage_writes, crossing, param_names):
+        self.cut_vars = list(cut_vars)
+        self.bounds = list(bounds)
+        self.stages = stages
+        self.stage_reads = stage_reads
+        self.stage_writes = stage_writes
+        self.crossing = crossing
+        self.param_names = param_names
+
+    @property
+    def n_stages(self) -> int:
+        return len(self.stages)
+
+    def tied_params(self) -> List[str]:
+        """Params read by more than one stage — the SPMD engine
+        replicates these on every pp device."""
+        seen: Dict[str, int] = {}
+        tied = []
+        for s, reads in enumerate(self.stage_reads):
+            for n in reads & self.param_names:
+                if n in seen and seen[n] != s and n not in tied:
+                    tied.append(n)
+                seen.setdefault(n, s)
+        return sorted(tied)
+
+
+def _producer_map(ops) -> Dict[str, int]:
+    prod: Dict[str, int] = {}
+    for i, op in enumerate(ops):
+        for n in _writes(op):
+            prod.setdefault(n, i)
+    return prod
+
+
+def _crossing_at(ops, prod, b: int) -> Tuple[str, ...]:
+    """Values produced before boundary b and read at-or-after it —
+    feeds and params are never produced by an op, so they never
+    cross."""
+    live = set()
+    for op in ops[b:]:
+        for n in _reads(op):
+            p = prod.get(n)
+            if p is not None and p < b:
+                live.add(n)
+    return tuple(sorted(live))
+
+
+def stage_partition(program, cut_vars: Sequence[str],
+                    block_idx: int = 0) -> StagePartition:
+    """Split the forward block at cut_vars (producer-index + 1, the
+    same rule both pipeline engines apply) and collect per-stage
+    read/write sets plus the per-boundary crossing activation sets."""
+    block = program.block(block_idx)
+    ops = _forward_ops(block)
+    prod = _producer_map(ops)
+    cuts = []
+    for v in cut_vars:
+        if v not in prod:
+            raise ValueError(f"cut var {v!r} is produced by no op")
+        cuts.append(prod[v] + 1)
+    bounds = [0] + cuts + [len(ops)]
+    stages = [ops[a:b] for a, b in zip(bounds[:-1], bounds[1:])]
+    stage_reads, stage_writes = [], []
+    for st in stages:
+        r: Set[str] = set()
+        w: Set[str] = set()
+        for op in st:
+            r.update(_reads(op))
+            w.update(_writes(op))
+        stage_reads.append(r)
+        stage_writes.append(w)
+    crossing = [_crossing_at(ops, prod, b) for b in cuts]
+    params = {p.name for p in program.all_parameters()}
+    return StagePartition(cut_vars, bounds, stages, stage_reads,
+                          stage_writes, crossing, params)
+
+
+class CutPlan:
+    """A synthesized stage cutting plus its static balance report."""
+
+    __slots__ = ("cut_vars", "n_stages", "bounds", "stage_flops",
+                 "stage_param_bytes", "stage_hbm_bytes",
+                 "activation_bytes", "balance", "uniform", "crossing")
+
+    def __init__(self, cut_vars, n_stages, bounds, stage_flops,
+                 stage_param_bytes, stage_hbm_bytes, activation_bytes,
+                 balance, uniform, crossing):
+        self.cut_vars = list(cut_vars)
+        self.n_stages = int(n_stages)
+        self.bounds = list(bounds)
+        self.stage_flops = list(stage_flops)
+        self.stage_param_bytes = list(stage_param_bytes)
+        self.stage_hbm_bytes = list(stage_hbm_bytes)
+        self.activation_bytes = int(activation_bytes)
+        self.balance = float(balance)
+        self.uniform = bool(uniform)
+        self.crossing = [tuple(c) for c in crossing]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"cut_vars": list(self.cut_vars),
+                "n_stages": self.n_stages,
+                "stage_flops": list(self.stage_flops),
+                "stage_param_bytes": list(self.stage_param_bytes),
+                "stage_hbm_bytes": list(self.stage_hbm_bytes),
+                "activation_bytes": self.activation_bytes,
+                "balance": round(self.balance, 4),
+                "uniform": self.uniform}
+
+    def __repr__(self):
+        return (f"CutPlan(stages={self.n_stages}, "
+                f"cuts={self.cut_vars!r}, "
+                f"balance={self.balance:.3f})")
+
+
+def _stage_weights(bounds, flops, pbytes):
+    """Per-stage (flops share + param-byte share) — both normalized so
+    neither unit dominates the balance objective."""
+    tot_f = max(1, sum(flops))
+    tot_p = max(1, sum(pbytes))
+    out = []
+    for a, b in zip(bounds[:-1], bounds[1:]):
+        out.append(sum(flops[a:b]) / tot_f + sum(pbytes[a:b]) / tot_p)
+    return out
+
+
+def _balanced_cuts(cand_pos: List[int], k: int, n_ops: int,
+                   flops, pbytes) -> Optional[List[int]]:
+    """Choose k boundary positions from cand_pos minimizing the max
+    per-stage weight (classic bounded-partition DP over the candidate
+    list; candidate counts are tens, so O(k·|C|²) is nothing)."""
+    if k == 0:
+        return []
+    C = sorted(cand_pos)
+    if len(C) < k:
+        return None
+    tot_f = max(1, sum(flops))
+    tot_p = max(1, sum(pbytes))
+    pref_f = np.concatenate([[0], np.cumsum(flops)])
+    pref_p = np.concatenate([[0], np.cumsum(pbytes)])
+
+    def w(a, b):
+        return (pref_f[b] - pref_f[a]) / tot_f + \
+            (pref_p[b] - pref_p[a]) / tot_p
+
+    nc = len(C)
+    INF = float("inf")
+    # dp[j][i]: best max-weight of the first (j+1) stages when cut j
+    # (0-based) sits at candidate i
+    dp = [[INF] * nc for _ in range(k)]
+    back = [[-1] * nc for _ in range(k)]
+    for i in range(nc):
+        dp[0][i] = w(0, C[i])
+    for j in range(1, k):
+        for i in range(nc):
+            for h in range(i):
+                if C[h] >= C[i]:
+                    continue
+                v = max(dp[j - 1][h], w(C[h], C[i]))
+                if v < dp[j][i]:
+                    dp[j][i] = v
+                    back[j][i] = h
+    best, best_i = INF, -1
+    for i in range(nc):
+        if dp[k - 1][i] == INF:
+            continue
+        v = max(dp[k - 1][i], w(C[i], n_ops))
+        if v < best:
+            best, best_i = v, i
+    if best_i < 0:
+        return None
+    sel = []
+    i = best_i
+    for j in range(k - 1, -1, -1):
+        sel.append(C[i])
+        i = back[j][i]
+    return sorted(sel)
+
+
+def propose_cuts(program, loss_name: str, n_stages: int,
+                 block_idx: int = 0, dynamic_dim: int = 8,
+                 uniform: bool = True) -> CutPlan:
+    """Synthesize cut_vars for an ``n_stages``-stage pipeline.
+
+    Raises ValueError when the program offers no valid cutting (fewer
+    candidate boundaries than cuts) — the caller falls back to fewer
+    stages or no pipeline rather than a broken one.
+    """
+    from ..analysis.cost_model import program_cost
+    n_stages = int(n_stages)
+    if n_stages < 2:
+        raise ValueError(f"propose_cuts: n_stages={n_stages} < 2")
+    block = program.block(block_idx)
+    ops = _forward_ops(block)
+    if len(ops) < n_stages:
+        raise ValueError(
+            f"propose_cuts: {len(ops)} ops cannot make {n_stages} "
+            f"stages")
+    prod = _producer_map(ops)
+    # per-op flops aligned with the filtered op list
+    cost = program_cost(program, block_idx, dynamic_dim)
+    cost_by_idx = {r.op_idx: r for r in cost.rows}
+    flops, out_bytes = [], []
+    fi = 0
+    for op_idx, op in enumerate(block.ops):
+        if op.type in ("feed", "fetch"):
+            continue
+        row = cost_by_idx.get(op_idx)
+        flops.append(row.flops if row else 0)
+        out_bytes.append(row.bytes_out if row else 0)
+        fi += 1
+    # param bytes attributed to the first op that reads the param
+    params = {p.name for p in program.all_parameters()}
+    pbytes = [0] * len(ops)
+    seen: Set[str] = set()
+    for i, op in enumerate(ops):
+        for n in _reads(op):
+            if n in params and n not in seen:
+                seen.add(n)
+                pbytes[i] += _var_bytes(block, n, dynamic_dim)
+
+    # candidate boundaries + the cut var each one would use: the
+    # crossing value produced by ops[b-1] (the producer-index+1 rule
+    # maps that var back to exactly this boundary)
+    cands: Dict[int, str] = {}
+    for b in range(1, len(ops)):
+        crossing = _crossing_at(ops, prod, b)
+        if not crossing:
+            continue
+        if uniform and len(crossing) != 1:
+            continue
+        here = [n for n in crossing if prod[n] == b - 1]
+        if not here:
+            continue
+        cands[b] = sorted(here)[0]
+
+    def _plan_for(positions) -> Optional[List[int]]:
+        return _balanced_cuts(positions, n_stages - 1, len(ops),
+                              flops, pbytes)
+
+    sel = None
+    if uniform:
+        # SPMD: every chosen cut must share one (shape, dtype) so the
+        # single activation buffer fits each handoff
+        groups: Dict[Any, List[int]] = {}
+        for b, v in cands.items():
+            groups.setdefault(_var_sig(block, v, dynamic_dim),
+                              []).append(b)
+        best_sel, best_w = None, float("inf")
+        for sig, positions in groups.items():
+            if sig[0] is None or len(positions) < n_stages - 1:
+                continue
+            s = _plan_for(positions)
+            if s is None:
+                continue
+            wmax = max(_stage_weights([0] + s + [len(ops)],
+                                      flops, pbytes))
+            if wmax < best_w:
+                best_sel, best_w = s, wmax
+        sel = best_sel
+    else:
+        sel = _plan_for(list(cands))
+    if sel is None:
+        raise ValueError(
+            f"propose_cuts: no valid {n_stages}-stage cutting "
+            f"({len(cands)} candidate boundaries, "
+            f"uniform={uniform}) — use fewer stages or the "
+            f"{'MPMD engine (uniform=False)' if uniform else 'SPMD'} "
+            f"path")
+    cut_vars = [cands[b] for b in sel]
+    bounds = [0] + sel + [len(ops)]
+    stage_flops = [int(sum(flops[a:b]))
+                   for a, b in zip(bounds[:-1], bounds[1:])]
+    stage_pb = [int(sum(pbytes[a:b]))
+                for a, b in zip(bounds[:-1], bounds[1:])]
+    # static per-stage HBM estimate: resident params + the largest
+    # transient the stage materializes + the handoff activations it
+    # stashes (one per in-flight micro-batch is schedule-dependent;
+    # this reports the single-micro floor the placement search scales)
+    act_bytes_at = [sum(_var_bytes(block, n, dynamic_dim)
+                        for n in _crossing_at(ops, prod, b))
+                    for b in sel]
+    stage_hbm = []
+    for si, (a, b) in enumerate(zip(bounds[:-1], bounds[1:])):
+        peak_t = max(out_bytes[a:b] or [0])
+        edge = (act_bytes_at[si - 1] if si > 0 else 0) + \
+            (act_bytes_at[si] if si < len(sel) else 0)
+        stage_hbm.append(int(stage_pb[si] + peak_t + edge))
+    weights = _stage_weights(bounds, flops, pbytes)
+    mean_w = sum(weights) / len(weights)
+    balance = max(weights) / mean_w if mean_w > 0 else 1.0
+    return CutPlan(cut_vars, n_stages, bounds, stage_flops, stage_pb,
+                   stage_hbm, sum(act_bytes_at), balance, uniform,
+                   [_crossing_at(ops, prod, b) for b in sel])
+
+
+def validate_cuts(program, cut_vars: Sequence[str],
+                  block_idx: int = 0, rules=None,
+                  mesh_spec=None) -> List[str]:
+    """Static validation of a proposed cutting; returns problem strings
+    (empty = clean). Checks: every cut var produced (and produced
+    before its consumers — boundary order strictly increasing), every
+    cut actually consumed downstream, per-stage SpecLayout coverage
+    (with ``rules``: no stage param matched by two disagreeing specs),
+    and tied params the SPMD engine would silently replicate."""
+    problems: List[str] = []
+    block = program.block(block_idx)
+    ops = _forward_ops(block)
+    prod = _producer_map(ops)
+    positions = []
+    for v in cut_vars:
+        if v not in prod:
+            problems.append(
+                f"cut var {v!r} is produced by no forward op")
+            continue
+        positions.append(prod[v] + 1)
+    if problems:
+        return problems
+    if positions != sorted(positions) or \
+            len(set(positions)) != len(positions):
+        problems.append(
+            f"cut vars {list(cut_vars)} are not produced in strictly "
+            f"increasing order (boundaries {positions}) — a later cut "
+            f"would be consumed before it is produced")
+        return problems
+    part = stage_partition(program, cut_vars, block_idx)
+    for i, v in enumerate(cut_vars):
+        b = part.bounds[i + 1]
+        read_after = any(v in _reads(op) for op in ops[b:])
+        if not read_after:
+            problems.append(
+                f"cut var {v!r} is never consumed after its boundary "
+                f"— the stage handoff would carry a dead value")
+    tied = part.tied_params()
+    if tied:
+        preview = ", ".join(tied[:5])
+        problems.append(
+            f"{len(tied)} param(s) are read by more than one stage "
+            f"({preview}{'...' if len(tied) > 5 else ''}) — the SPMD "
+            f"engine replicates these on every pp device (use the "
+            f"MPMD engine or accept the memory cost explicitly)")
+    if rules is not None:
+        for s, reads in enumerate(part.stage_reads):
+            for n in sorted(reads & part.param_names):
+                specs = rules.matching_specs(n)
+                if len(specs) > 1:
+                    problems.append(
+                        f"stage {s} param {n!r} matches "
+                        f"{len(specs)} disagreeing sharding rules: "
+                        f"{specs}")
+    if mesh_spec is not None and \
+            getattr(mesh_spec, "pp", 1) not in (1, part.n_stages):
+        problems.append(
+            f"mesh pp={mesh_spec.pp} disagrees with the "
+            f"{part.n_stages}-stage cutting")
+    return problems

@@ -86,21 +86,32 @@ class MeshSpec:
       XLA all-gathers each weight where used and reduce-scatters its
       grad;
     * ``tp`` — tensor (Megatron) parallelism: weight matrices split
-      column/row-wise, activations exchange over the axis.
+      column/row-wise, activations exchange over the axis;
+    * ``pp`` — pipeline parallelism: the program is cut into
+      ``pp`` stages (automatically, via the cost model — see
+      :mod:`paddle_tpu.parallel.auto_cut`), stage-exclusive params and
+      optimizer state live only on their stage's slice of the axis, and
+      activations hand off point-to-point between neighbours. Execution
+      goes through the pipeline engines (``parallel/pipeline.py`` SPMD,
+      ``parallel/mpmd_pipeline.py``), not the generic SPMD step — see
+      docs/PARALLELISM.md for the engine-selection rule.
 
     ``build()`` materializes a ``jax.sharding.Mesh`` whose axis ORDER is
-    (data, fsdp, tp) — outer to inner, so tp lands on the
-    fastest-varying (nearest-neighbour ICI) device dimension. Axes of
-    size 1 are dropped from the mesh entirely, which keeps a
+    (pp, data, fsdp, tp) — outer to inner: pp handoffs are
+    point-to-point (lowest bandwidth need, outermost), while tp lands on
+    the fastest-varying (nearest-neighbour ICI) device dimension. Axes
+    of size 1 are dropped from the mesh entirely, which keeps a
     ``MeshSpec(data=N)`` mesh byte-identical in behaviour to the
     long-standing single-axis data-parallel path. ``-1`` on exactly one
     axis means "rest of the devices" (resolved by :func:`make_mesh`).
     """
 
-    AXES = ("data", "fsdp", "tp")
-    __slots__ = ("data", "fsdp", "tp")
+    AXES = ("pp", "data", "fsdp", "tp")
+    __slots__ = ("pp", "data", "fsdp", "tp")
 
-    def __init__(self, data: int = 1, fsdp: int = 1, tp: int = 1):
+    def __init__(self, data: int = 1, fsdp: int = 1, tp: int = 1,
+                 pp: int = 1):
+        self.pp = int(pp)
         self.data = int(data)
         self.fsdp = int(fsdp)
         self.tp = int(tp)
@@ -115,7 +126,7 @@ class MeshSpec:
 
     @property
     def size(self) -> int:
-        return self.data * self.fsdp * self.tp
+        return self.pp * self.data * self.fsdp * self.tp
 
     def axis_shapes(self) -> Dict[str, int]:
         """Ordered {axis: size} with size-1 axes dropped (a trivial
@@ -156,8 +167,10 @@ class MeshSpec:
         return cls(**out)
 
     def __repr__(self):
-        return (f"MeshSpec(data={self.data}, fsdp={self.fsdp}, "
-                f"tp={self.tp})")
+        body = (f"data={self.data}, fsdp={self.fsdp}, tp={self.tp}")
+        if self.pp != 1:
+            body += f", pp={self.pp}"
+        return f"MeshSpec({body})"
 
     def __eq__(self, other):
         return isinstance(other, MeshSpec) and \

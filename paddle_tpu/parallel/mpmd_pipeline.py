@@ -70,24 +70,62 @@ class MPMDPipelineEngine:
     """Host-scheduled heterogeneous pipeline over per-stage executables.
 
     program: FORWARD program (up to the loss); cut_vars split it into
-    n_stages = len(cut_vars)+1 sections. optimizer_program: the update
-    ops (PipelineOptimizer.opt_program). devices: one per stage (cycled
-    when shorter; on a single chip all stages share it — the MPMD
-    structure still holds, only the overlap disappears)."""
+    n_stages = len(cut_vars)+1 sections — or ``cut_vars=None`` to
+    synthesize balanced cuts from the static cost model
+    (parallel/auto_cut.py; pass ``n_stages``). optimizer_program: the
+    update ops (PipelineOptimizer.opt_program). devices: one per stage
+    (cycled when shorter, which makes n_stages > len(devices) the
+    Megatron-style interleaved layout — device d hosts model chunks
+    d, d+D, ...; on a single chip all stages share it — the MPMD
+    structure still holds, only the overlap disappears).
 
-    def __init__(self, program, loss_name: str, cut_vars: Sequence[str],
+    ``schedule`` picks the micro-batch dispatch order
+    (core/scheduler.pipeline_schedule): "1f1b" (default) drains each
+    backward as soon as it is ready, capping the activation stash at
+    the pipeline depth; "gpipe" is the legacy fill/drain, kept for the
+    A/B in tools/step_overhead_bench.py --compare-pipeline. Both
+    execute the same F/B events with the same fold_in keys, so the
+    loss is schedule-invariant; ``last_stats`` reports the measured
+    bubble fraction of whichever schedule ran."""
+
+    def __init__(self, program, loss_name: str,
+                 cut_vars: Optional[Sequence[str]] = None,
                  optimizer_program=None, devices=None,
-                 num_microbatches: int = 4):
+                 num_microbatches: int = 4, n_stages: int = None,
+                 schedule: str = "1f1b"):
         self.program = program
         self.loss_name = loss_name
+        self.cut_plan = None
+        if cut_vars is None:
+            if n_stages is None:
+                raise ValueError(
+                    "MPMDPipelineEngine: automatic cutting needs "
+                    "n_stages=")
+            from .auto_cut import propose_cuts
+            self.cut_plan = propose_cuts(program, loss_name,
+                                         n_stages, uniform=False)
+            cut_vars = self.cut_plan.cut_vars
         self.cut_vars = list(cut_vars)
-        self.n_stages = len(cut_vars) + 1
+        self.n_stages = len(self.cut_vars) + 1
         self.n_micro = num_microbatches
+        self.schedule = schedule
+        self.last_stats: Dict[str, object] = {}
         self._opt_program = optimizer_program
         devs = list(devices) if devices else jax.devices()
+        self.n_devices = min(len(devs), self.n_stages)
         self.stage_devices = [devs[s % len(devs)]
                               for s in range(self.n_stages)]
         self._built = False
+        # cross-stage hazard proof on the cutting itself (the slot
+        # table is verified separately per step in _verify_schedule)
+        from ..analysis.races import verify_stage_partition
+        errs = [d for d in verify_stage_partition(
+            self.program, self.cut_vars, label="pipeline-mpmd")
+            if d.is_error]
+        if errs:
+            raise ValueError(
+                "MPMDPipelineEngine: unsafe stage cutting: "
+                + "; ".join(d.message for d in errs))
 
     # -- program analysis ---------------------------------------------------
     def _split(self):
@@ -255,42 +293,60 @@ class MPMDPipelineEngine:
                   for s in range(self.n_stages)}
         last = self.n_stages - 1
 
-        # ---- forward fill: stash each stage's inputs per microbatch --
-        stash = [[None] * n_micro for _ in range(self.n_stages)]
+        # ---- schedule-driven dispatch: interleaved 1F1B (or the
+        # gpipe fill/drain baseline). Every schedule runs the SAME
+        # F/B events with the same fold_in keys — only the order (and
+        # therefore the stash cap and bubble) differs. The slot table
+        # is statically verified against the F/B dependence DAG
+        # (analysis/races.verify_pipeline_schedule) before anything
+        # dispatches.
+        import time
+        from ..core.scheduler import pipeline_schedule
+        sched = pipeline_schedule(self.n_stages, n_micro,
+                                  self.n_devices, kind=self.schedule)
+        self._verify_schedule(sched)
+        t_step = time.perf_counter()
+        spans: List[dict] = []
+        dispatch_ms = 0.0
+        xfer_bytes = 0
+        stash: Dict[tuple, tuple] = {}
+        stash_live = stash_peak = 0
+        acts: List[Dict[str, jax.Array]] = [dict()
+                                            for _ in range(n_micro)]
+        cot_acts: List[Dict[str, jax.Array]] = [dict()
+                                                for _ in range(n_micro)]
         losses = [None] * n_micro
-        for m in range(n_micro):
-            mkey = jax.random.fold_in(key, m)
-            acts: Dict[str, jax.Array] = {}
-            for s in range(self.n_stages):
-                dev = self.stage_devices[s]
-                a_in = {n: jax.device_put(acts[n], dev)
+        g_params = [None] * self.n_stages
+        inv = 1.0 / n_micro
+        for tick, dev_idx, kind, s, m in sched["events"]:
+            dev = self.stage_devices[s]
+            t0 = time.perf_counter()
+            if kind == "F":
+                a_in = {n: jax.device_put(acts[m][n], dev)
                         for n in self._s_ain[s]}
                 f_in = {n: jax.device_put(micro[m][n], dev)
                         for n in self._s_fin[s]}
-                skey = jax.random.fold_in(mkey, s)
-                stash[s][m] = (a_in, f_in, skey)
+                skey = jax.random.fold_in(jax.random.fold_in(key, m), s)
+                stash[(s, m)] = (a_in, f_in, skey)
+                stash_live += 1
+                stash_peak = max(stash_peak, stash_live)
+                xfer_bytes += sum(int(getattr(v, "nbytes", 0))
+                                  for v in a_in.values())
                 outs = self._fwd[s](params[s], a_in, f_in, skey)
-                acts.update(outs)
-            losses[m] = acts[self.loss_name]
-
-        # ---- backward drain: accumulate param grads ------------------
-        g_params = [None] * self.n_stages
-        inv = 1.0 / n_micro
-        for m in range(n_micro):
-            # activation cotangents flowing backwards; every entry of
-            # s_aout[s] is consumed by SOME later stage (that is how
-            # s_aout is defined), so by the time stage s runs its
-            # backward all its output cotangents exist — a skip
-            # connection consumed by several stages accumulates by
-            # addition below, matching sum-of-uses vjp semantics
-            cot_acts: Dict[str, jax.Array] = {}
-            for s in range(last, -1, -1):
-                a_in, f_in, skey = stash[s][m]
-                dev = self.stage_devices[s]
+                acts[m].update(outs)
+                if s == last:
+                    losses[m] = outs[self.loss_name]
+            else:
                 # reverse queue transfer: cotangents produced on the
-                # consumer stage's device hop back to stage s
-                cot_full = {n: jax.device_put(cot_acts[n], dev)
+                # consumer stage's device hop back to stage s; a skip
+                # connection consumed by several stages accumulates by
+                # addition below, matching sum-of-uses vjp semantics
+                a_in, f_in, skey = stash.pop((s, m))
+                stash_live -= 1
+                cot_full = {n: jax.device_put(cot_acts[m][n], dev)
                             for n in self._s_aout[s]}
+                xfer_bytes += sum(int(getattr(v, "nbytes", 0))
+                                  for v in cot_full.values())
                 if s == last:
                     cot_full[self.loss_name] = jnp.asarray(
                         inv, dtype=losses[m].dtype)
@@ -302,10 +358,19 @@ class MPMDPipelineEngine:
                     g_params[s] = jax.tree_util.tree_map(
                         jnp.add, g_params[s], dp)
                 for n, v in da.items():
-                    if n in cot_acts:
-                        cot_acts[n] = cot_acts[n] + v
+                    if n in cot_acts[m]:
+                        cot_acts[m][n] = cot_acts[m][n] + v
                     else:
-                        cot_acts[n] = v
+                        cot_acts[m][n] = v
+            t1 = time.perf_counter()
+            dispatch_ms += (t1 - t0) * 1e3
+            spans.append({"tick": tick, "device": dev_idx,
+                          "kind": kind, "stage": s, "micro_batch": m,
+                          "t0_ms": round((t0 - t_step) * 1e3, 3),
+                          "dur_ms": round((t1 - t0) * 1e3, 3)})
+        window_ms = (time.perf_counter() - t_step) * 1e3
+        self._record_stats(sched, spans, dispatch_ms, window_ms,
+                           stash_peak, xfer_bytes)
 
         # ---- optimizer update per stage ------------------------------
         if self._opt_groups is not None:
@@ -348,6 +413,70 @@ class MPMDPipelineEngine:
                             scope.var(n).set_value(out_env[n])
         loss = float(np.mean([np.asarray(l) for l in losses]))
         return loss
+
+    # -- schedule verification & stats ---------------------------------------
+    def _verify_schedule(self, sched):
+        """Statically prove the slot table safe before dispatching:
+        every F/B event must respect the pipeline dependence DAG and
+        no device may run two events in one tick (analysis/races)."""
+        from ..analysis.races import verify_pipeline_schedule
+        diags = verify_pipeline_schedule(
+            sched["events"], self.n_stages, self.n_micro,
+            label=f"mpmd-{self.schedule}")
+        errors = [d for d in diags if d.severity.value >= 2]
+        if errors:
+            raise RuntimeError(
+                "MPMDPipelineEngine: unsafe schedule: "
+                + "; ".join(d.message for d in errors))
+
+    def _record_stats(self, sched, spans, dispatch_ms, window_ms,
+                      stash_peak, xfer_bytes):
+        from ..core.scheduler import gpipe_bubble_fraction
+        self.last_stats = {
+            "schedule": self.schedule,
+            "n_stages": self.n_stages,
+            "n_devices": self.n_devices,
+            "micro_batches": self.n_micro,
+            "n_chunks": sched["n_chunks"],
+            # measured from the slot table the step actually executed
+            "bubble_frac": sched["bubble_frac"],
+            # analytic fill/drain bubble at the same microbatch count,
+            # for the --compare-pipeline A/B without a second run
+            "bubble_frac_gpipe": gpipe_bubble_fraction(
+                self.n_stages, self.n_micro),
+            "stash_peak": stash_peak,
+            "activation_exchange_bytes": int(xfer_bytes),
+            "pipeline_fill_frac": (dispatch_ms / window_ms
+                                   if window_ms > 0 else 0.0),
+            "spans": spans,
+        }
+        if self.cut_plan is not None:
+            self.last_stats["stage_hbm_bytes"] = list(
+                self.cut_plan.stage_hbm_bytes)
+        self._emit_metrics()
+
+    def _emit_metrics(self):
+        try:
+            from ..observability import metrics as M
+        except Exception:
+            return
+        st = self.last_stats
+        M.counter("pt_pipeline_steps_total",
+                  "pipeline training steps").inc(
+            1, schedule=str(st["schedule"]))
+        M.gauge("pt_pipeline_stages", "pipeline stage count").set(
+            st["n_stages"], schedule=str(st["schedule"]))
+        M.gauge("pt_pipeline_bubble_frac",
+                "measured pipeline bubble fraction").set(
+            float(st["bubble_frac"]), schedule=str(st["schedule"]))
+        M.counter("pt_pipeline_activation_exchange_bytes_total",
+                  "bytes moved across stage boundaries").inc(
+            int(st["activation_exchange_bytes"]),
+            schedule=str(st["schedule"]))
+        for s, b in enumerate(st.get("stage_hbm_bytes", ())):
+            M.gauge("pt_pipeline_stage_hbm_peak_bytes",
+                    "static per-stage HBM estimate").set(
+                int(b), stage=str(s))
 
 
 def _scope_val(scope: Scope, name, none_ok=False):

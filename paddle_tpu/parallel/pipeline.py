@@ -70,20 +70,60 @@ _SCALAR_SLOTS = frozenset({"LearningRate", "Beta1Pow", "Beta2Pow"})
 
 
 class PipelineEngine:
-    """Compile + run a GPipe step for (program, loss, cut_vars)."""
+    """Compile + run a GPipe step for (program, loss, cut_vars).
 
-    def __init__(self, program, loss_name: str, cut_vars: Sequence[str],
+    ``cut_vars=None`` synthesizes the cuts from the static cost model
+    (parallel/auto_cut.py) — ``n_stages`` then comes from the mesh's
+    pp-axis extent (or the explicit ``n_stages`` argument). The mesh
+    may carry MORE axes than pp: feeds batch-shard over a "data" axis
+    and compute replicates over any others (tp within a stage is the
+    MPMD/SPMD-layout engines' job), so a full MeshSpec(data, tp, pp)
+    placement runs as pipeline × data-parallel."""
+
+    def __init__(self, program, loss_name: str,
+                 cut_vars: Optional[Sequence[str]] = None,
                  optimizer_program=None, mesh: Mesh = None,
-                 pp_axis: str = "pp", num_microbatches: int = 4):
+                 pp_axis: str = "pp", num_microbatches: int = 4,
+                 n_stages: int = None):
         self.program = program
         self.loss_name = loss_name
-        self.cut_vars = list(cut_vars)
         self.mesh = mesh
         self.pp_axis = pp_axis
-        self.n_stages = len(cut_vars) + 1
+        self.cut_plan = None
+        if cut_vars is None:
+            if n_stages is None:
+                if mesh is None or pp_axis not in mesh.shape:
+                    raise ValueError(
+                        "PipelineEngine: automatic cutting needs "
+                        "n_stages= or a mesh with a pp axis")
+                n_stages = int(mesh.shape[pp_axis])
+            from .auto_cut import propose_cuts
+            self.cut_plan = propose_cuts(program, loss_name,
+                                         n_stages, uniform=True)
+            cut_vars = self.cut_plan.cut_vars
+        self.cut_vars = list(cut_vars)
+        self.n_stages = len(self.cut_vars) + 1
+        if mesh is not None and pp_axis in mesh.shape and \
+                int(mesh.shape[pp_axis]) != self.n_stages:
+            raise ValueError(
+                f"PipelineEngine: mesh {pp_axis}="
+                f"{mesh.shape[pp_axis]} != n_stages={self.n_stages}")
         self.n_micro = num_microbatches
+        self.last_stats: Dict[str, object] = {}
         self._step_fn = None
         self._opt_program = optimizer_program
+        # statically prove the cutting free of cross-stage hazards
+        # (handoff WW, consumed-before-produced) before anything
+        # compiles; tied params are safe here — _plan_stacking keeps
+        # them replicated with a warning — so stacked=False
+        from ..analysis.races import verify_stage_partition
+        errs = [d for d in verify_stage_partition(
+            self.program, self.cut_vars, label="pipeline-spmd")
+            if d.is_error]
+        if errs:
+            raise ValueError(
+                "PipelineEngine: unsafe stage cutting: "
+                + "; ".join(d.message for d in errs))
 
     # -- program splitting --------------------------------------------------
     def _split(self):
@@ -242,7 +282,58 @@ class PipelineEngine:
         loss, self._stacked, self._params, self._opt_state = \
             self._step_fn(self._stacked, self._params, self._opt_state,
                           micro)
+        self._record_stats(micro)
         return float(np.asarray(loss))
+
+    def _record_stats(self, micro):
+        """Static schedule accounting for observability: the SPMD tick
+        loop IS the GPipe fill/drain, so its bubble is the analytic
+        (S-1)/(M+S-1); activation-exchange bytes count every ppermute
+        tick's buffer."""
+        from ..core.scheduler import gpipe_bubble_fraction
+        from .auto_cut import _var_bytes
+        S, M = self.n_stages, self.n_micro
+        block = self.program.block(0)
+        micro_b = 0
+        for a in micro.values():
+            if a.ndim >= 2:
+                micro_b = int(a.shape[1])
+                break
+        act_bytes = sum(_var_bytes(block, v, max(1, micro_b))
+                        for v in self.cut_vars)
+        ticks = M + S - 2  # ppermute fires every tick but the last
+        self.last_stats = {
+            "schedule": "gpipe-spmd",
+            "n_stages": S, "micro_batches": M,
+            "bubble_frac": round(gpipe_bubble_fraction(S, M), 6),
+            "activation_exchange_bytes": int(act_bytes * max(0, ticks)),
+            "stage_hbm_bytes": (list(self.cut_plan.stage_hbm_bytes)
+                                if self.cut_plan else []),
+        }
+        self._emit_metrics()
+
+    def _emit_metrics(self):
+        try:
+            from ..observability import metrics as M
+            M.counter("pt_pipeline_steps_total",
+                      "pipeline training steps").inc()
+            M.gauge("pt_pipeline_stages",
+                    "pipeline stage count").set(self.n_stages)
+            M.gauge("pt_pipeline_bubble_frac",
+                    "pipeline schedule bubble fraction").set(
+                float(self.last_stats.get("bubble_frac", 0.0)))
+            M.counter(
+                "pt_pipeline_activation_exchange_bytes_total",
+                "bytes handed between pipeline stages").inc(
+                int(self.last_stats.get(
+                    "activation_exchange_bytes", 0)))
+            hbm = self.last_stats.get("stage_hbm_bytes") or []
+            if hbm:
+                M.gauge("pt_pipeline_stage_hbm_peak_bytes",
+                        "max static per-stage HBM estimate").set(
+                    float(max(hbm)))
+        except Exception:
+            pass
 
     def sync_to_scope(self, scope: Scope):
         for n, v in {**self._params, **self._opt_state}.items():
@@ -353,12 +444,25 @@ class PipelineEngine:
             return env[self.cut_vars[s]], jnp.zeros((), jnp.float32)
 
         slots = self._stacked_slots
+        # extra mesh axes beyond pp: feeds batch-shard over "data"/"dp",
+        # compute replicates over the rest (e.g. tp) — the psum'd loss
+        # divides their extent back out
+        mesh_axis_names = tuple(self.mesh.axis_names) \
+            if self.mesh is not None else (axis,)
+        data_axis = next((a for a in mesh_axis_names
+                          if a in ("data", "dp")), None)
+        non_pp = 1
+        if self.mesh is not None:
+            for a in mesh_axis_names:
+                if a != axis:
+                    non_pp *= int(self.mesh.shape[a])
 
         def per_device(stacked_local, params, micro_feeds):
-            """shard_map body over pp axis. stacked_local: "p{j}" ->
+            """shard_map body over the mesh. stacked_local: "p{j}" ->
             [1, ...] this device's stage slice of slot j. micro_feeds:
-            name -> [M, ...] (replicated). Returns mean loss (psum'd
-            from last stage)."""
+            name -> [M, B_local, ...] (batch-sharded over the data
+            axis when present, replicated otherwise). Returns mean
+            loss (psum'd from the last stage over every axis)."""
             # bind the local slice to every member name: branch s (the
             # only one executed on device s) reads its own stage's param
             local = {}
@@ -393,17 +497,21 @@ class PipelineEngine:
                 total_loss = total_loss + loss
                 if t != T - 1:
                     act = lax.ppermute(out, axis, perm)
-            # only last stage accumulated loss; share it
-            total_loss = lax.psum(total_loss, axis)
-            return total_loss / n_micro
+            # only last stage accumulated loss; psum over EVERY axis
+            # (pp shares it off the last stage; data sums the
+            # shard-means; replicated axes contribute identical
+            # copies), then divide the non-pp extents back out
+            total_loss = lax.psum(total_loss, mesh_axis_names)
+            return total_loss / (n_micro * non_pp)
 
         mesh = self.mesh
         repl = P()
         ax_spec = P(axis)
+        feed_spec = P(None, data_axis) if data_axis else repl
 
         smapped = shard_map(
             per_device, mesh=mesh,
-            in_specs=(ax_spec, repl, repl), out_specs=repl,
+            in_specs=(ax_spec, repl, feed_spec), out_specs=repl,
             check_vma=False)
 
         def loss_fn(stacked, params, state, micro_feeds):
@@ -497,9 +605,10 @@ class PipelineEngine:
         if mesh is not None:
             sh = NamedSharding(mesh, ax_spec)
             rsh = NamedSharding(mesh, repl)
+            fsh = NamedSharding(mesh, feed_spec)
             self._step_fn = jax.jit(
                 step, donate_argnums=(0, 1, 2),
-                in_shardings=(sh, rsh, rsh, rsh),
+                in_shardings=(sh, rsh, rsh, fsh),
                 out_shardings=(rsh, sh, rsh, rsh))
             stacked0 = jax.device_put(stacked0, sh) if stacked0 else {}
         else:

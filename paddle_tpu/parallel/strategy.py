@@ -36,9 +36,11 @@ __all__ = ["ShardingRules", "DistributedStrategy", "P", "SpecLayout",
            "activation_sharding_scope", "activation_scope",
            "constrain_activation", "KNOWN_AXES"]
 
-# every axis name a rule set may mention: the long-standing dp/mp/sp/
-# pp/ep vocabulary plus the named multi-axis mesh (MeshSpec) axes
-KNOWN_AXES = ("dp", "mp", "sp", "pp", "ep", "data", "fsdp", "tp")
+# every axis name a rule set may mention: the long-standing dp/mp/sp/ep
+# vocabulary plus the named multi-axis mesh (MeshSpec) axes — "pp" is
+# the MeshSpec pipeline axis (parallel/mesh.py), stacked over by the
+# pipeline engines rather than named in per-dim sharding rules
+KNOWN_AXES = ("dp", "mp", "sp", "ep", "data", "fsdp", "tp", "pp")
 
 
 class ShardingRules:
@@ -120,9 +122,14 @@ _ACC_RE = re.compile(r"^(?P<param>.+\.[wb]_\d+)_[A-Za-z0-9_]+_\d+$")
 class DistributedStrategy:
     """Mesh + rules + feed layout: everything the engine needs to compile a
     program SPMD. Axis names: "dp" (data), "mp" (tensor/model), "sp"
-    (sequence), "pp" (pipeline, handled by PipelineOptimizer) — plus the
-    named multi-axis mesh vocabulary "data"/"fsdp"/"tp" (MeshSpec /
-    SpecLayout, docs/PARALLELISM.md)."""
+    (sequence) — plus the named multi-axis mesh vocabulary
+    "data"/"fsdp"/"tp"/"pp" (MeshSpec / SpecLayout,
+    docs/PARALLELISM.md). "pp" is a first-class MeshSpec axis: the
+    placement search sizes it (analysis/placement.py) and the pipeline
+    engines (parallel/pipeline.py, parallel/mpmd_pipeline.py) execute
+    it — the generic SPMD step never shards anything over pp, so
+    ``from_mesh_spec`` compiles rules for the (data, fsdp, tp)
+    sub-mesh."""
 
     def __init__(self, axes: Dict[str, int] = None, rules: ShardingRules
                  = None, devices=None, feed_rules: ShardingRules = None,
@@ -144,7 +151,19 @@ class DistributedStrategy:
                        devices=None) -> "DistributedStrategy":
         """Strategy for a named data/fsdp/tp mesh: the SpecLayout table
         (default layout when None) supplies param + feed + activation
-        rules sized to the axes the spec actually has."""
+        rules sized to the axes the spec actually has. A spec with
+        ``pp > 1`` compiles for its (data, fsdp, tp) sub-mesh — stage
+        execution lives in the pipeline engines, not the SPMD step —
+        with a warning so a silently-ignored pp request is visible."""
+        if spec.pp != 1:
+            import warnings as _w
+            _w.warn(
+                f"DistributedStrategy.from_mesh_spec: {spec!r} has a "
+                f"pipeline axis; the generic SPMD step executes only "
+                f"the (data, fsdp, tp) sub-mesh — run pp through "
+                f"PipelineEngine/MPMDPipelineEngine "
+                f"(docs/PARALLELISM.md)", stacklevel=2)
+            spec = MeshSpec(data=spec.data, fsdp=spec.fsdp, tp=spec.tp)
         if layout is None:
             layout = SpecLayout(fsdp=spec.fsdp != 1, tp=spec.tp != 1)
         shapes = spec.axis_shapes() or {"data": 1}
@@ -252,16 +271,23 @@ class SpecLayout:
     path — the bit-identity contract tests/test_mesh_spmd.py pins).
     """
 
-    __slots__ = ("data_axis", "fsdp_axis", "tp_axis", "fsdp", "tp",
+    __slots__ = ("data_axis", "fsdp_axis", "tp_axis", "pp_axis",
+                 "fsdp", "tp",
                  "extra_param_rules", "extra_activation_rules")
 
     def __init__(self, data_axis: str = "data", fsdp_axis: str = "fsdp",
                  tp_axis: str = "tp", fsdp: bool = True, tp: bool = True,
                  extra_param_rules: Sequence[Tuple[str, P]] = (),
-                 extra_activation_rules: Sequence[Tuple[str, P]] = ()):
+                 extra_activation_rules: Sequence[Tuple[str, P]] = (),
+                 pp_axis: str = "pp"):
         self.data_axis = data_axis
         self.fsdp_axis = fsdp_axis
         self.tp_axis = tp_axis
+        # the pipeline axis is never named in per-dim rules: the SPMD
+        # pipeline engine stacks stage-exclusive params over it
+        # (parallel/pipeline.py _plan_stacking) and SpecLayout only
+        # carries its NAME so cut validation / stacking agree on it
+        self.pp_axis = pp_axis
         self.fsdp = bool(fsdp)
         self.tp = bool(tp)
         self.extra_param_rules = tuple(extra_param_rules)
@@ -339,14 +365,15 @@ class SpecLayout:
 
     def to_dict(self) -> Dict[str, object]:
         return {"data_axis": self.data_axis, "fsdp_axis": self.fsdp_axis,
-                "tp_axis": self.tp_axis, "fsdp": self.fsdp,
-                "tp": self.tp}
+                "tp_axis": self.tp_axis, "pp_axis": self.pp_axis,
+                "fsdp": self.fsdp, "tp": self.tp}
 
     @classmethod
     def from_dict(cls, d: Dict[str, object]) -> "SpecLayout":
         return cls(data_axis=str(d.get("data_axis", "data")),
                    fsdp_axis=str(d.get("fsdp_axis", "fsdp")),
                    tp_axis=str(d.get("tp_axis", "tp")),
+                   pp_axis=str(d.get("pp_axis", "pp")),
                    fsdp=bool(d.get("fsdp", True)),
                    tp=bool(d.get("tp", True)))
 

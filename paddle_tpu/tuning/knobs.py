@@ -201,6 +201,17 @@ _def("mesh_tp", "env", "PT_MESH_TP", int, 0, (0,),
      trace_affecting=True,
      help="pin the tensor-parallel axis size in the placement search "
           "(0 = free); single-candidate like mesh_fsdp")
+_def("mesh_pp", "env", "PT_MESH_PP", int, 0, (0,),
+     trace_affecting=True,
+     help="pin the pipeline axis size in the placement search "
+          "(0 = free); single-candidate like mesh_fsdp — a pp>1 plan "
+          "routes execution through the stage-cut pipeline engines "
+          "(docs/PARALLELISM.md)")
+_def("pipeline_micro", "env", "PT_PIPELINE_MICRO", int, 8, (8,),
+     trace_affecting=True,
+     help="micro-batch count M the placement cost model uses for the "
+          "pp bubble term (M+pp-1)/M (analysis/placement.py); a "
+          "different M can flip the chosen plan, so trace-affecting")
 _def("placement_auto", "env", "PT_PLACEMENT_AUTO", bool, False,
      (False,), trace_affecting=True,
      help="arm cost-driven automatic SPMD placement: Engine.run "

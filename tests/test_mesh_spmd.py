@@ -255,7 +255,7 @@ def test_placement_beats_pure_data_parallel():
     assert plan.predicted_ms < plan.baseline_ms, plan.to_dict()
     assert plan.spec.size == 8
     # the per-axis collective-bytes breakdown only names live axes
-    assert all(k in ("data", "fsdp", "tp")
+    assert all(k in ("data", "fsdp", "tp", "pp")
                for k in plan.per_axis_bytes)
 
 

@@ -225,15 +225,19 @@ def _parser():
                                         "shuffled_collectives",
                                         "island_conflict",
                                         "donated_read",
+                                        "cross_stage_hazard",
                                         "dropped_bucket",
                                         "skipped_guard",
                                         "missing_shard_hint"],
                    help="corrupt the program before linting "
                         "(island_conflict / donated_read corrupt the "
                         "scheduler partition and need --check-races; "
-                        "dropped_bucket / skipped_guard / "
-                        "missing_shard_hint corrupt one path's lowering "
-                        "trace and need --check-conformance)")
+                        "cross_stage_hazard makes a later pipeline "
+                        "stage rewrite a handoff activation and needs "
+                        "--check-placement; dropped_bucket / "
+                        "skipped_guard / missing_shard_hint corrupt "
+                        "one path's lowering trace and need "
+                        "--check-conformance)")
     p.add_argument("--shards", type=int, default=1,
                    help="transpile the model into N data-parallel shard "
                         "programs and also check collective ordering")
@@ -459,16 +463,23 @@ def _check_cost(program, batch: int, label="") -> int:
 
 
 def _check_placement(model: str, batch: int, n_shards: int = 2,
-                     label="") -> int:
+                     inject=None, label="") -> int:
     """Multi-axis layout lint (docs/PARALLELISM.md).
 
-    Two invariants: (1) the SpecLayout table must give every trainable
-    parameter exactly ONE PartitionSpec — zero matches means the
-    parameter silently replicates under FSDP (an HBM regression), two
-    distinct matches means first-match-wins is hiding a rule-set
+    Three invariants: (1) the SpecLayout table must give every
+    trainable parameter exactly ONE PartitionSpec — zero matches means
+    the parameter silently replicates under FSDP (an HBM regression),
+    two distinct matches means first-match-wins is hiding a rule-set
     ambiguity; (2) the collective sequence must be identical across
     transpiled shard programs (reuses check_collective_ordering —
-    layout-induced divergence hangs every rank on hardware)."""
+    layout-induced divergence hangs every rank on hardware); (3) the
+    pipeline axis must be executable: the synthesized cutting
+    validates clean (every cut produced before consumed, consumed
+    after its boundary, no tied param silently replicated, per-stage
+    SpecLayout coverage) and the cross-stage race verifier + the 1F1B
+    slot-table verifier find no hazard. ``--inject
+    cross_stage_hazard`` makes a later stage rewrite a handoff
+    activation — the WW hazard the verifier must catch."""
     from paddle_tpu.analysis import (check_collective_ordering,
                                      format_report, has_errors)
     from paddle_tpu.parallel.mesh import MeshSpec
@@ -507,7 +518,64 @@ def _check_placement(model: str, batch: int, n_shards: int = 2,
               f"consistent across {n_shards} shards")
     if has_errors(diags):
         rc = EXIT_ERRORS
+
+    rc = max(rc, _check_pipeline_cuts(model, rules, batch,
+                                      inject=inject, label=label))
     return rc
+
+
+def _check_pipeline_cuts(model: str, rules, batch: int,
+                         inject=None, label="") -> int:
+    """Pipeline leg of --check-placement: synthesize a 2-stage cutting
+    (no manual cut_vars — the same path the engines take), validate it
+    statically, and prove it free of cross-stage hazards; also verify
+    the 1F1B slot table the MPMD engine would execute. Works on the
+    FORWARD program (up to the loss, no optimizer ops) — the only
+    shape the pipeline engines accept."""
+    program, _, _, loss = build_model(model, optimize=False)
+    from paddle_tpu.analysis import format_report, has_errors
+    from paddle_tpu.analysis.races import (verify_pipeline_schedule,
+                                           verify_stage_partition)
+    from paddle_tpu.core.scheduler import pipeline_schedule
+    from paddle_tpu.parallel.auto_cut import propose_cuts, validate_cuts
+    from paddle_tpu.parallel.mesh import MeshSpec
+
+    n_stages = 2
+    try:
+        plan = propose_cuts(program, loss.name, n_stages,
+                            dynamic_dim=batch, uniform=False)
+    except ValueError as e:
+        print(f"check-placement {label}: pipeline lint skipped — {e}")
+        return EXIT_CLEAN
+    if inject == "cross_stage_hazard":
+        # a later stage rewrites the handoff activation: the WW hazard
+        # a cutter/engine regression could produce. Program surgery on
+        # the lint copy only.
+        block = program.global_block()
+        fwd = [op for op in block.ops
+               if op.type not in ("feed", "fetch")]
+        victim = fwd[-1]
+        slot = victim.output_slots()[0]
+        victim._outputs[slot] = [plan.cut_vars[0]]
+        program._bump_version()
+        print(f"injected: op '{victim.type}' (last forward op) now "
+              f"rewrites handoff activation '{plan.cut_vars[0]}'")
+    problems = validate_cuts(program, plan.cut_vars,
+                             rules=rules,
+                             mesh_spec=MeshSpec(pp=n_stages))
+    for pr in problems:
+        print(f"  cut-validation: ERROR {pr}")
+    diags = verify_stage_partition(program, plan.cut_vars, label=label)
+    sched = pipeline_schedule(n_stages, 4, n_stages, kind="1f1b")
+    diags += verify_pipeline_schedule(sched["events"], n_stages, 4,
+                                      label=label)
+    print(format_report(
+        diags, header=f"check-placement {label}: pipeline cuts "
+                      f"{plan.cut_vars} (balance {plan.balance:.3f}), "
+                      f"1f1b bubble {sched['bubble_frac']:.4f}"))
+    if problems or has_errors(diags):
+        return EXIT_ERRORS
+    return EXIT_CLEAN
 
 
 def _check_conformance(model: str, batch: int, inject=None,
@@ -588,6 +656,11 @@ def main(argv: Optional[List[str]] = None) -> int:
               "corrupts the scheduler partition and requires "
               "--check-races", file=sys.stderr)
         return EXIT_USAGE
+    if ns.inject == "cross_stage_hazard" and not ns.check_placement:
+        print("lint_program: --inject cross_stage_hazard corrupts a "
+              "pipeline stage cutting and requires --check-placement",
+              file=sys.stderr)
+        return EXIT_USAGE
     from paddle_tpu.analysis.conformance import DRIFT_KINDS
     if ns.inject in DRIFT_KINDS and not ns.check_conformance:
         print("lint_program: --inject dropped_bucket/skipped_guard/"
@@ -645,9 +718,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print("lint_program: --check-placement requires "
                       "--model", file=sys.stderr)
                 return EXIT_USAGE
+            inj_p = ns.inject if ns.inject == "cross_stage_hazard" \
+                else None
             rc = max(rc, _check_placement(ns.model, ns.batch,
                                           max(2, ns.shards),
-                                          label=label))
+                                          inject=inj_p, label=label))
         if ns.check_conformance:
             inj = ns.inject if ns.inject in DRIFT_KINDS else None
             rc = max(rc, _check_conformance(ns.model, ns.batch,

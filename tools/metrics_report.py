@@ -79,6 +79,11 @@ REQUIRED_FAMILIES = (
     "pt_placement_searches_total", "pt_placement_cache_hits_total",
     "pt_placement_search_seconds", "pt_placement_predicted_ms",
     "pt_placement_collective_bytes",
+    # pipeline engines: pp axis + 1F1B schedule (docs/PARALLELISM.md)
+    "pt_pipeline_steps_total", "pt_pipeline_stages",
+    "pt_pipeline_bubble_frac",
+    "pt_pipeline_activation_exchange_bytes_total",
+    "pt_pipeline_stage_hbm_peak_bytes",
     # cross-path lowering conformance (docs/STATIC_ANALYSIS.md)
     "pt_conformance_checks_total", "pt_conformance_divergences_total",
     "pt_conformance_verify_seconds",

@@ -13,7 +13,7 @@ CLI::
         [--batch N] [--steps N] [--threshold-ms X] [--telemetry]
         [--compare-telemetry] [--compare-scheduler] [--compare-guard]
         [--compare-tuned] [--compare-memory] [--compare-integrity]
-        [--compare-multistep] [--multistep-k K]
+        [--compare-multistep] [--multistep-k K] [--compare-pipeline]
 
 exits non-zero when measured host overhead exceeds ``--threshold-ms``
 (the CI regression gate). ``overhead_report()`` is imported by bench.py
@@ -150,6 +150,24 @@ def mesh_report(mesh):
     line = (f"# mesh_spmd: sync {off:.2f} -> {on:.2f} ms/step "
             f"(delta {on - off:+.3f} ms) over mesh {mesh.get('mesh')}")
     return mesh, line
+
+
+def pipeline_report(pl):
+    """(dict, '#'-line) for the bench JSON tail from a pipeline
+    schedule A/B probe result ({sync_ms_gpipe, sync_ms_1f1b, ...});
+    (None, None) when the probe did not run or errored before
+    measuring."""
+    if not pl or "sync_ms_1f1b" not in pl:
+        return (pl or None), None
+    g, f = pl["sync_ms_gpipe"], pl["sync_ms_1f1b"]
+    bg = pl.get("gpipe", {}).get("bubble_frac")
+    bf = pl.get("1f1b", {}).get("bubble_frac")
+    bub = (f"; bubble {bg:.3f} -> {bf:.3f}"
+           if bg is not None and bf is not None else "")
+    line = (f"# pipeline_1f1b: sync {g:.2f} (gpipe) -> {f:.2f} ms/step "
+            f"(delta {f - g:+.3f} ms) at M={pl.get('micro_batches')} "
+            f"S={pl.get('n_stages')}{bub}")
+    return pl, line
 
 
 def multistep_report(ms):
@@ -306,6 +324,16 @@ def main(argv=None):
                         "a data-only MeshSpec over every host device "
                         "(bit-identical math, GSPMD-partitioned); "
                         "--threshold-ms gates the mesh-on sync DELTA")
+    p.add_argument("--compare-pipeline", action="store_true",
+                   help="A/B the MPMD pipeline schedules "
+                        "(docs/PARALLELISM.md): auto-cut a fresh "
+                        "2-stage model (parallel/auto_cut.py, no "
+                        "manual cut_vars) and run the SAME program "
+                        "under the gpipe fill/drain baseline and the "
+                        "interleaved 1F1B schedule; --threshold-ms "
+                        "gates the 1F1B-minus-gpipe sync DELTA "
+                        "(<= 0 expected: 1F1B only reorders "
+                        "micro-batches, it must not be slower)")
     p.add_argument("--compare-multistep", action="store_true",
                    help="A/B multi-step dispatch (PT_MULTI_STEP, "
                         "docs/ASYNC_DISPATCH.md): stack K copies of "
@@ -540,6 +568,66 @@ def main(argv=None):
                         "steps_per_sec")},
                     "mesh": {"data": n}}
                 r["mesh_delta_ms"] = r_x["sync_ms"] - r["sync_ms"]
+        if args.compare_pipeline:
+            # A/B the two schedules on a FRESH auto-cut 2-stage model:
+            # both runs execute the identical per-stage executables on
+            # the identical micro-batches, so any delta is pure
+            # schedule (dispatch order + stash pressure)
+            import paddle_tpu as fluid
+            from paddle_tpu.core.scope import Scope
+            from paddle_tpu.parallel.mpmd_pipeline import \
+                MPMDPipelineEngine
+
+            def _pipe_model():
+                fluid.framework.unique_name.reset()
+                main, startup = fluid.Program(), fluid.Program()
+                with fluid.program_guard(main, startup):
+                    from paddle_tpu import layers
+                    x = layers.data("px", [64], dtype="float32")
+                    y = layers.data("py", [1], dtype="int64")
+                    h = layers.fc(x, size=128, act="relu")
+                    h = layers.fc(h, size=128, act="relu")
+                    h = layers.fc(h, size=128, act="relu")
+                    pred = layers.fc(h, size=10, act="softmax")
+                    loss = layers.mean(
+                        layers.cross_entropy(input=pred, label=y))
+                return main, startup, loss
+
+            rng = np.random.RandomState(0)
+            n_micro = 4
+            b = max(n_micro, (args.batch // n_micro) * n_micro)
+            feed_p = {"px": rng.rand(b, 64).astype(np.float32),
+                      "py": rng.randint(0, 10, (b, 1)).astype(np.int64)}
+            pl = {}
+            for kind in ("gpipe", "1f1b"):
+                main_p, startup_p, loss_p = _pipe_model()
+                scope_p = Scope()
+                with fluid.scope_guard(scope_p):
+                    fluid.Executor().run(startup_p)
+                    eng_p = MPMDPipelineEngine(
+                        main_p, loss_p.name, None, n_stages=2,
+                        num_microbatches=n_micro, schedule=kind)
+                    for _ in range(2):
+                        eng_p.run(scope_p, feed_p)
+                    ts = []
+                    for _ in range(max(5, args.steps // 4)):
+                        t0 = time.perf_counter()
+                        eng_p.run(scope_p, feed_p)
+                        ts.append(time.perf_counter() - t0)
+                st = eng_p.last_stats or {}
+                pl[kind] = {
+                    "sync_ms": sorted(ts)[len(ts) // 2] * 1e3,
+                    "bubble_frac": st.get("bubble_frac"),
+                    "stash_peak": st.get("stash_peak"),
+                    "cut_vars": list(eng_p.cut_vars)}
+            r["pipeline_ab"] = {
+                "micro_batches": n_micro,
+                "n_stages": 2,
+                "gpipe": pl["gpipe"], "1f1b": pl["1f1b"],
+                "sync_ms_gpipe": pl["gpipe"]["sync_ms"],
+                "sync_ms_1f1b": pl["1f1b"]["sync_ms"]}
+            r["pipeline_delta_ms"] = (pl["1f1b"]["sync_ms"]
+                                      - pl["gpipe"]["sync_ms"])
         if args.compare_memory:
             # A/B the live-buffer census on a FRESH engine/model; the
             # census-off numbers above stay uncontaminated, and the
@@ -620,6 +708,10 @@ def main(argv=None):
             _, line = tuning_report(r["tuning"])
             if line:
                 print(line)
+        if "pipeline_ab" in r:
+            _, line = pipeline_report(r["pipeline_ab"])
+            if line:
+                print(line)
         if "mesh_on" in r and "sync_ms" in r.get("mesh_on", {}):
             _, line = mesh_report(
                 {"sync_ms_off": r["sync_ms"],
@@ -673,6 +765,12 @@ def main(argv=None):
         bad.append(
             f"tuned-vs-default sync delta "
             f"{r['tuned_delta_ms']:.3f} ms > threshold "
+            f"{args.threshold_ms:.1f} ms")
+    if args.threshold_ms is not None and "pipeline_delta_ms" in r \
+            and r["pipeline_delta_ms"] > args.threshold_ms:
+        bad.append(
+            f"pipeline 1F1B-vs-gpipe delta "
+            f"{r['pipeline_delta_ms']:.1f} ms > threshold "
             f"{args.threshold_ms:.1f} ms")
     if args.threshold_ms is not None and "memory_delta_ms" in r and \
             r["memory_delta_ms"] > args.threshold_ms:
