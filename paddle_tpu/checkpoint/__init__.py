@@ -11,8 +11,8 @@ readable either way).
 """
 from .manager import CheckpointManager, SaveHandle  # noqa: F401
 from .manifest import (  # noqa: F401
-    CheckpointCorrupt, is_checkpoint_dir, list_steps, read_latest,
-    step_dir_name,
+    CheckpointCorrupt, is_checkpoint_dir, list_steps, manifest_topology,
+    read_latest, step_dir_name, topology_entry,
 )
 from .snapshot import (  # noqa: F401
     Snapshot, SnapshotEntry, persistable_names, snapshot_scope,
@@ -27,7 +27,7 @@ __all__ = [
     "CheckpointManager", "SaveHandle", "CheckpointCorrupt",
     "Snapshot", "SnapshotEntry", "snapshot_scope", "persistable_names",
     "is_checkpoint_dir", "list_steps", "read_latest", "step_dir_name",
-    "atomic_write",
+    "manifest_topology", "topology_entry", "atomic_write",
     "TRAIN_STATE_VERSION", "TrainState", "read_train_state",
     "register_reader", "registered_readers", "unregister_reader",
 ]

@@ -79,7 +79,8 @@ class CheckpointManager:
                  process_count: int = 1, engine=None,
                  keep_last_k: Optional[int] = None,
                  keep_every_n: Optional[int] = None,
-                 commit_timeout: float = 300.0):
+                 commit_timeout: float = 300.0,
+                 mesh_spec=None, n_devices: Optional[int] = None):
         self.root = str(root)
         self.process_index = int(process_index)
         self.process_count = int(process_count)
@@ -87,6 +88,14 @@ class CheckpointManager:
         self.keep_last_k = keep_last_k
         self.keep_every_n = keep_every_n
         self.commit_timeout = commit_timeout
+        # saved-topology identity (docs/CHECKPOINTING.md "topology"):
+        # mesh_spec = the MeshSpec the run was placed on (or
+        # strategy.spec); n_devices defaults to the live jax device
+        # count at first save. Elastic restore compares these against
+        # the manifest's recorded section (distributed/elastic.py).
+        self.mesh_spec = mesh_spec
+        self.n_devices = int(n_devices) if n_devices else None
+        self._topology_cache: Optional[dict] = None
         self._queue: "queue.Queue" = queue.Queue()
         self._worker: Optional[threading.Thread] = None
         self._handles: List[SaveHandle] = []
@@ -100,8 +109,25 @@ class CheckpointManager:
         # TrainState of the last restore (None = legacy tensor-only
         # checkpoint or nothing restored yet) — docs/RESILIENCE.md
         self.restored_train_state = None
+        # summary of the last ELASTIC restore (topology mismatch taken
+        # through replan/reshard/redistribute), or None — holds the
+        # saved/current topologies, the re-derived placement plan and
+        # strategy, and the reshard wall time (docs/RESILIENCE.md
+        # "Elastic topology")
+        self.elastic_resume_info = None
 
     # -- save ---------------------------------------------------------------
+
+    def _topology(self) -> dict:
+        """This fleet's topology in manifest form, cached after the
+        first save (the device count cannot change within one
+        incarnation — a changed count is a NEW incarnation restoring
+        elastically)."""
+        if self._topology_cache is None:
+            from ..distributed import elastic as _elastic
+            self._topology_cache = _elastic.current_topology(
+                self.process_count, self.n_devices, self.mesh_spec)
+        return self._topology_cache
 
     def save(self, step: int, scope=None, program=None,
              vars: Optional[Sequence[str]] = None,
@@ -182,7 +208,8 @@ class CheckpointManager:
             wr.write_process_shard(tmp_dir, snapshot, handle.step,
                                    self.process_index,
                                    self.process_count,
-                                   train_state=train_state)
+                                   train_state=train_state,
+                                   topology=self._topology())
             if self.process_index == 0:
                 committed = wr.commit_step(
                     self.root, handle.step, self.process_count,
@@ -282,7 +309,8 @@ class CheckpointManager:
                 program=None, vars: Optional[Sequence[str]] = None,
                 place=None, verify: bool = True, strict: bool = True,
                 include_rng: bool = True,
-                apply_train_state: bool = True) -> int:
+                apply_train_state: bool = True,
+                elastic: Optional[bool] = None) -> int:
         """Load a committed checkpoint into ``scope``. ``step=None``
         follows LATEST, falling back (with a warning) to the newest
         complete step when the pointer is stale/dangling — the
@@ -293,7 +321,22 @@ class CheckpointManager:
         ``apply_train_state`` is on, it is re-applied here (reader
         cursors, guard scalars — train_state.py) and kept on
         ``self.restored_train_state``; legacy tensor-only checkpoints
-        leave it None."""
+        leave it None.
+
+        **Elastic restore** (docs/RESILIENCE.md "Elastic topology"):
+        when the manifest's recorded topology disagrees with this
+        fleet, a non-elastic restore raises ``EnforceNotMet`` naming
+        both topologies — silently assembling ZeRO-1 moments sharded
+        for a different world size is the one corruption the format
+        cannot detect after the fact. With ``elastic=True`` (default:
+        the ``PT_ELASTIC_RESUME`` env set by a shrinking supervisor)
+        the restore instead re-runs the placement search for the new
+        device count, reassembles every tensor globally through the
+        writer's shard-index metadata (resharding is a property of the
+        format), redistributes reader cursors across the new worker
+        count (``TrainState.redistribute``), and re-arms the integrity
+        sentinel for the new bucketing; the outcome is summarized on
+        ``self.elastic_resume_info``."""
         t0 = time.perf_counter()
         if scope is None:
             from ..core.scope import global_scope
@@ -313,6 +356,50 @@ class CheckpointManager:
             persistable_names(program) if program is not None else None)
         from ..core.engine import RNG_STATE_VAR
         man = wr._manifest_for_step(self.root, step)
+        from ..distributed import elastic as _elastic
+        if elastic is None:
+            elastic = _elastic.elastic_enabled()
+        mismatch = _elastic.detect_mismatch(
+            man, self.process_count, self.n_devices, self.mesh_spec)
+        self.elastic_resume_info = None
+        if mismatch is not None and not elastic:
+            # Only state that is coupled to the writing world size is
+            # hazardous to restore elsewhere: per-worker reader cursors
+            # (train_state) and a placed mesh layout. A meshless
+            # tensors-only checkpoint restores on any world size by
+            # shard-index assembly — the format property — so that
+            # case warns instead of raising.
+            hazardous = bool(man.get("train_state")) or bool(
+                mismatch.saved.get("mesh")
+                or mismatch.current.get("mesh"))
+            if hazardous:
+                from ..core.enforce import EnforceNotMet
+                raise EnforceNotMet(
+                    f"checkpoint step {int(step)} under {self.root!r} "
+                    f"was written by a different topology: "
+                    f"{mismatch.describe()}. Restoring it "
+                    f"non-elastically would silently assemble ZeRO-1 "
+                    f"optimizer moments sharded for the saved world "
+                    f"size. Relaunch at the saved topology, or opt "
+                    f"into elastic restore (restore(..., elastic=True) "
+                    f"or {_elastic.ELASTIC_ENV}=1) to re-place and "
+                    f"reshard onto this fleet (docs/RESILIENCE.md).")
+            warnings.warn(
+                f"checkpoint step {int(step)} was written by a "
+                f"different topology ({mismatch.describe()}); it "
+                f"carries no mesh or train_state, so tensors restore "
+                f"by shard-index assembly", stacklevel=2)
+            mismatch = None
+        new_plan = new_strategy = None
+        if mismatch is not None and program is not None:
+            try:
+                new_plan, new_strategy = _elastic.replan(
+                    program, self.n_devices)
+            except Exception as exc:
+                warnings.warn(
+                    f"elastic restore: re-placement for the new "
+                    f"topology failed ({exc}); restoring onto the "
+                    f"default single-mesh layout", stacklevel=2)
         if names is not None and include_rng:
             if RNG_STATE_VAR in man["tensors"] and \
                     RNG_STATE_VAR not in names:
@@ -337,10 +424,13 @@ class CheckpointManager:
             _restore(scope, name, arr, lod, place)
         # a restore is a LEGITIMATE out-of-band parameter write: tell
         # the integrity sentinel to rebuild its continuity shadow
-        # instead of raising a false anomaly (docs/RESILIENCE.md)
+        # instead of raising a false anomaly (docs/RESILIENCE.md). An
+        # ELASTIC restore also drops the sentinel's bucket layout: the
+        # new mesh re-buckets the fingerprint plan, and a stale
+        # per-bucket shadow would raise a false integrity_mismatch.
         try:
             from ..stability.integrity import invalidate_shadow
-            invalidate_shadow(scope)
+            invalidate_shadow(scope, drop_layout=mismatch is not None)
         except Exception:
             pass
         self.restored_train_state = None
@@ -348,10 +438,39 @@ class CheckpointManager:
         if ts_sec is not None:
             from .train_state import TrainState
             ts = TrainState.from_dict(ts_sec)
+            if mismatch is not None:
+                # cursors were captured by the SAVED worker set; remap
+                # them deterministically onto this one (exactly-once:
+                # every cursor survives, orphans namespaced "<r>@<o>")
+                ts = ts.redistribute(self.process_count)
             self.restored_train_state = ts
             if apply_train_state:
                 ts.apply(scope=scope,
                          process_index=self.process_index)
+        if mismatch is not None:
+            dt = time.perf_counter() - t0
+            cur = mismatch.current
+            _obs.counter(
+                "pt_elastic_resumes_total",
+                "checkpoint restores taken through the elastic "
+                "topology path (docs/RESILIENCE.md)").inc(1.0)
+            _obs.histogram(
+                "pt_elastic_reshard_seconds",
+                "wall time of elastic restores: replan + global "
+                "reassembly + cursor redistribution").observe(dt)
+            _obs.gauge(
+                "pt_elastic_world_size",
+                "device world size after the last elastic "
+                "resume").set(float(cur.get("n_devices")
+                                    or cur.get("world_size") or 1))
+            self.elastic_resume_info = {
+                "step": int(step),
+                "saved": mismatch.saved,
+                "current": mismatch.current,
+                "plan": new_plan,
+                "strategy": new_strategy,
+                "reshard_seconds": dt,
+            }
         if _obs.telemetry_active():
             _obs.histogram("pt_ckpt_restore_seconds").observe(
                 time.perf_counter() - t0)

@@ -60,7 +60,8 @@ def process_manifest_name(process_index: int) -> str:
 
 def build_manifest(step: int, process_index: Optional[int],
                    process_count: int, tensors: Dict[str, dict],
-                   train_state: Optional[dict] = None) -> dict:
+                   train_state: Optional[dict] = None,
+                   topology: Optional[dict] = None) -> dict:
     m = {
         "format_version": FORMAT_VERSION,
         "framework": "paddle_tpu",
@@ -75,7 +76,33 @@ def build_manifest(step: int, process_index: Optional[int],
     # tensors-only (docs/CHECKPOINTING.md)
     if train_state is not None:
         m["train_state"] = train_state
+    # saved topology (world size / device count / mesh factorization)
+    # is likewise an OPTIONAL section: elastic restore
+    # (distributed/elastic.py) compares it against the restoring fleet;
+    # legacy checkpoints without it restore with no topology check
+    if topology is not None:
+        m["topology"] = topology
     return m
+
+
+def topology_entry(world_size: int, n_devices: Optional[int] = None,
+                   mesh: Optional[Dict[str, int]] = None) -> dict:
+    """The manifest ``topology`` section: the writing fleet's process
+    count, device count, and (when known) the MeshSpec factorization
+    the run was placed on — enough for elastic restore to decide
+    whether the restoring fleet matches."""
+    t = {"world_size": int(world_size)}
+    if n_devices is not None:
+        t["n_devices"] = int(n_devices)
+    if mesh is not None:
+        t["mesh"] = {str(a): int(n) for a, n in mesh.items()}
+    return t
+
+
+def manifest_topology(manifest: dict) -> Optional[dict]:
+    """The saved ``topology`` section, or None for legacy checkpoints."""
+    t = manifest.get("topology")
+    return dict(t) if isinstance(t, dict) else None
 
 
 def tensor_entry(global_shape, dtype: str, lod, sharding: str,
@@ -155,7 +182,20 @@ def merge_manifests(manifests: List[dict]) -> dict:
                 prev["sharding"] = "sharded"
     from .train_state import merge_train_state
     ts = merge_train_state([m.get("train_state") for m in manifests])
-    return build_manifest(step, None, count, tensors, train_state=ts)
+    topo = None
+    for m in manifests:
+        t = m.get("topology")
+        if t is None:
+            continue
+        if topo is None:
+            topo = t
+        elif t.get("world_size") != topo.get("world_size"):
+            raise CheckpointCorrupt(
+                f"process manifests disagree on saved topology "
+                f"world_size ({t.get('world_size')} vs "
+                f"{topo.get('world_size')})")
+    return build_manifest(step, None, count, tensors, train_state=ts,
+                          topology=topo)
 
 
 # ---------------------------------------------------------------------------

@@ -247,6 +247,64 @@ class TrainState:
                 "cursors_pending": sorted(set(cursors) - set(applied)),
                 "autotune_token_match": token_match}
 
+    # -- elastic redistribution -----------------------------------------
+    def redistribute(self, new_count: int) -> "TrainState":
+        """Deterministically remap the per-worker reader cursors onto
+        ``new_count`` workers (elastic resume, docs/RESILIENCE.md
+        "Elastic topology"). The rule:
+
+        * a surviving rank ``p`` (``p < new_count``) keeps its own
+          saved cursors and host RNG, byte-for-byte;
+        * an orphaned rank ``o`` (``o >= new_count``) parks each of
+          its cursors on rank ``o % new_count`` under the namespaced
+          key ``"<reader>@<o>"`` — never overriding the adopter's own
+          cursor, never silently dropping one. The data layer decides
+          how to drain the adopted partition (re-register the orphan
+          stream under that name, or leave it parked); the
+          exactly-once guarantee holds because every cursor survives
+          exactly once. Orphan host RNG is dropped (the orphan's
+          process is gone; its RNG stream has no consumer);
+        * on regrow (``new_count`` exceeds the saved worker set) the
+          new ranks start cursor-less with a warning — they are fresh
+          partitions, flagged by ``ckpt_inspect --train-state``.
+
+        Global scalars (step, loss scale, guard EMA, autotune token)
+        pass through unchanged. Returns a NEW TrainState; ``self`` is
+        not mutated. The mapping is a pure function of
+        (saved workers, new_count), which is what makes an elastic
+        resume bit-identical to a fresh launch at the new world size
+        from the same checkpoint."""
+        new_count = int(new_count)
+        if new_count < 1:
+            raise ValueError(f"redistribute: new_count={new_count} < 1")
+        old_pids = sorted(int(p) for p in self.workers)
+        workers: Dict[str, dict] = {}
+        for pid in old_pids:
+            w = self.workers[str(pid)] or {}
+            if pid < new_count:
+                tgt = workers.setdefault(str(pid), {"readers": {}})
+                tgt["readers"].update(w.get("readers") or {})
+                if w.get("host_rng") is not None:
+                    tgt["host_rng"] = w["host_rng"]
+                continue
+            tgt = workers.setdefault(str(pid % new_count),
+                                     {"readers": {}})
+            for name, cur in sorted((w.get("readers") or {}).items()):
+                tgt["readers"][f"{name}@{pid}"] = cur
+        if new_count > (max(old_pids) + 1 if old_pids else 0):
+            warnings.warn(
+                f"TrainState.redistribute: growing to {new_count} "
+                f"workers but the checkpoint has cursors for "
+                f"{len(old_pids)}; new ranks start their data "
+                f"partitions from scratch", stacklevel=2)
+        return TrainState(global_step=self.global_step,
+                          workers=workers,
+                          loss_scale=self.loss_scale,
+                          loss_scale_good=self.loss_scale_good,
+                          guard_ema=self.guard_ema,
+                          autotune_token=self.autotune_token,
+                          version=self.version)
+
     # -- (de)serialization ----------------------------------------------
     def to_dict(self) -> dict:
         return {

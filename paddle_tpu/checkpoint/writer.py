@@ -103,7 +103,8 @@ def _decode_payload(payload: bytes, dtype: str) -> np.ndarray:
 
 def write_process_shard(tmp_dir: str, snapshot: Snapshot, step: int,
                         process_index: int, process_count: int,
-                        train_state: Optional[dict] = None) -> dict:
+                        train_state: Optional[dict] = None,
+                        topology: Optional[dict] = None) -> dict:
     """Serialize this process's shards + manifest into ``tmp_dir``.
     Returns the process manifest dict. The D2H happens here (np.asarray
     on the snapshot's device copies) — on the writer thread, off the
@@ -137,7 +138,8 @@ def write_process_shard(tmp_dir: str, snapshot: Snapshot, step: int,
         os.fsync(f.fileno())
     proc_manifest = mf.build_manifest(step, process_index,
                                       process_count, tensors,
-                                      train_state=train_state)
+                                      train_state=train_state,
+                                      topology=topology)
     mf.write_manifest(
         os.path.join(tmp_dir, mf.process_manifest_name(process_index)),
         proc_manifest)

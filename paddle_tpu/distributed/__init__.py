@@ -9,6 +9,7 @@ fault-injection plan the transport honours; ``resilience`` holds the
 retry policy, circuit breaker, trainer-liveness registry, heartbeat
 beacon, and step watchdog.
 """
+from . import elastic  # noqa: F401
 from . import faults  # noqa: F401
 from . import launch  # noqa: F401
 from . import resilience  # noqa: F401

@@ -1,0 +1,210 @@
+"""Elastic topology resilience: survive device/host loss by re-placing
+and resharding onto the surviving fleet (docs/RESILIENCE.md "Elastic
+topology").
+
+Every other resilience path (supervised restart, exactly-once resume,
+integrity rollback) assumes the SAME world size comes back. This module
+closes the remaining gap: when a chip, host, or slice is permanently
+gone, the run continues on whatever survived instead of dying with
+``--max-restarts`` exhausted against a device that will never return.
+
+The pieces, in the order they fire:
+
+1. **Detection** — ``launch.supervise(elastic=True)`` sees a worker die
+   (first-failure teardown, or the injected
+   ``PT_FAULT_PLAN=...,device_loss_step=N`` permanent loss, exit code
+   ``faults.DEVICE_LOSS_EXIT_CODE``) and relaunches with the SURVIVING
+   rank count, exporting ``PT_ELASTIC_RESUME=1`` to the new gang.
+2. **Topology mismatch** — ``CheckpointManager.restore`` compares the
+   manifest's saved ``topology`` section (world size / device count /
+   MeshSpec) against the restoring fleet (:func:`detect_mismatch`).
+   Non-elastic restores fail loudly (``EnforceNotMet``) so mis-sharded
+   ZeRO-1 moments are never silently assembled; elastic restores take
+   the path below. Checkpoints with no mesh and no train_state carry
+   nothing world-size-coupled and keep restoring anywhere (warning
+   only) — the format's any-world assembly property.
+3. **Re-placement** — :func:`replan` re-runs the cost-driven placement
+   search (analysis/placement.py) constrained to the new device count.
+   The tuning-cache fingerprint includes ``n_devices``, so the new
+   topology is a fresh cache entry: mesh factorization, ZeRO-1
+   ``update_shard_axes`` extents, and pp cuts (auto_cut.propose_cuts)
+   are all re-derived and persisted under the new key.
+4. **Reshard** — dense params, optimizer moments, and per-stage state
+   restore through the ``writer.py`` assemble path: every shard records
+   its global index range, so ``read_step`` reassembles the global
+   tensor and the engine re-places it under the new strategy. Elastic
+   resharding is a property of the checkpoint FORMAT, not a conversion
+   tool.
+5. **Cursor redistribution** — ``TrainState.redistribute`` maps reader
+   cursors onto the new worker count (:func:`redistribute_train_state`)
+   with the exactly-once drain-or-replay guarantee intact: surviving
+   ranks keep their own cursors; an orphaned rank ``o`` parks its
+   cursors on rank ``o % new_count`` under ``"<reader>@<o>"`` so no
+   cursor is silently dropped.
+6. **Sentinel re-arm** — the integrity sentinel's shadow is invalidated
+   AND its bucket layout dropped (``invalidate_shadow(drop_layout=True)``)
+   so the per-bucket fingerprint plan rebuilds for the new bucketing
+   and an elastic resume never raises a false ``integrity_mismatch``.
+
+Determinism contract: the redistribution rule and the placement search
+are both deterministic functions of (checkpoint, new topology), so the
+stitched loss trajectory on the shrunk fleet is bit-identical to a
+fresh run launched at that world size from the same checkpoint — the
+property ``tools/chaos_report.py``'s elastic probe asserts.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+__all__ = ["ELASTIC_ENV", "elastic_enabled", "saved_topology",
+           "current_topology", "TopologyMismatch", "detect_mismatch",
+           "replan", "redistribute_train_state"]
+
+# exported by launch.supervise to a shrunk gang: workers' maybe_restore
+# defaults to the elastic path instead of failing loudly on the
+# topology mismatch
+ELASTIC_ENV = "PT_ELASTIC_RESUME"
+
+
+def elastic_enabled() -> bool:
+    """True when the supervisor (or the user) opted this process into
+    elastic restore via ``PT_ELASTIC_RESUME``."""
+    return os.environ.get(ELASTIC_ENV, "").strip() not in ("", "0")
+
+
+def _device_count() -> int:
+    try:
+        import jax
+        return len(jax.devices())
+    except Exception:
+        return 1
+
+
+def mesh_string(mesh: Optional[dict]) -> str:
+    """Human-readable name for a topology's mesh dict (``"data=2,tp=2"``,
+    or ``"unplaced"`` when the run never recorded one)."""
+    if not mesh:
+        return "unplaced"
+    from ..parallel.mesh import MeshSpec
+    return MeshSpec.from_dict(mesh).to_string()
+
+
+def saved_topology(manifest: dict) -> Optional[dict]:
+    """The checkpoint's recorded topology section, or None for a legacy
+    checkpoint (pre-topology manifests carry no section and restore
+    with no topology check)."""
+    from ..checkpoint.manifest import manifest_topology
+    return manifest_topology(manifest)
+
+
+def current_topology(process_count: int = 1,
+                     n_devices: Optional[int] = None,
+                     mesh_spec=None) -> dict:
+    """The restoring/writing fleet's topology in manifest form."""
+    from ..checkpoint.manifest import topology_entry
+    nd = int(n_devices) if n_devices else _device_count()
+    mesh = mesh_spec.to_dict() if mesh_spec is not None else None
+    return topology_entry(int(process_count), nd, mesh)
+
+
+def _topo_str(t: dict) -> str:
+    return (f"world_size={t.get('world_size')} "
+            f"n_devices={t.get('n_devices')} "
+            f"mesh={mesh_string(t.get('mesh'))}")
+
+
+class TopologyMismatch:
+    """A saved-vs-current topology disagreement: which fleet wrote the
+    checkpoint, which fleet is restoring it, and whether the world
+    shrank or grew."""
+
+    def __init__(self, saved: dict, current: dict):
+        self.saved = dict(saved)
+        self.current = dict(current)
+
+    @property
+    def saved_world(self) -> int:
+        return int(self.saved.get("world_size") or 1)
+
+    @property
+    def current_world(self) -> int:
+        return int(self.current.get("world_size") or 1)
+
+    @property
+    def shrunk(self) -> bool:
+        return (self.current_world < self.saved_world
+                or (self.current.get("n_devices") or 0)
+                < (self.saved.get("n_devices") or 0))
+
+    def describe(self) -> str:
+        kind = ("shrink" if self.shrunk else
+                "grow" if (self.current_world > self.saved_world
+                           or (self.current.get("n_devices") or 0)
+                           > (self.saved.get("n_devices") or 0))
+                else "re-factorization")
+        return (f"saved [{_topo_str(self.saved)}] vs "
+                f"current [{_topo_str(self.current)}] ({kind})")
+
+    def __repr__(self):
+        return f"TopologyMismatch({self.describe()})"
+
+
+def detect_mismatch(manifest: dict, process_count: int = 1,
+                    n_devices: Optional[int] = None,
+                    mesh_spec=None) -> Optional[TopologyMismatch]:
+    """Compare the manifest's saved topology against the restoring
+    fleet. Returns None when they match, or when the checkpoint is
+    legacy (no recorded topology — nothing to compare, restore
+    proceeds exactly as before this module existed)."""
+    saved = saved_topology(manifest)
+    if saved is None:
+        return None
+    cur = current_topology(process_count, n_devices, mesh_spec)
+    if int(saved.get("world_size") or 1) != cur["world_size"]:
+        return TopologyMismatch(saved, cur)
+    s_nd, c_nd = saved.get("n_devices"), cur.get("n_devices")
+    if s_nd is not None and c_nd is not None and int(s_nd) != int(c_nd):
+        return TopologyMismatch(saved, cur)
+    s_mesh, c_mesh = saved.get("mesh"), cur.get("mesh")
+    if s_mesh and c_mesh:
+        from ..parallel.mesh import MeshSpec
+        if MeshSpec.from_dict(s_mesh) != MeshSpec.from_dict(c_mesh):
+            return TopologyMismatch(saved, cur)
+    return None
+
+
+def replan(program, n_devices: Optional[int] = None,
+           use_cache: bool = True, measured=None) -> Tuple:
+    """Re-run the placement search for the new device count and
+    materialize the strategy. Returns ``(plan, strategy)`` —
+    ``strategy`` is None for a single-device plan (the engine's plain
+    jit path).
+
+    The tuning-cache key already fingerprints ``n_devices``
+    (``placement:<program-fp>:<n>``), so the new topology is a fresh
+    entry: the mesh factorization, ZeRO-1 ``update_shard_axes``
+    extents, and pp cuts are re-derived once and replayed on every
+    subsequent restart at this world size."""
+    from ..analysis import placement
+    plan = placement.plan_for_program(program, n_devices,
+                                      use_cache=use_cache,
+                                      measured=measured)
+    strategy = placement.strategy_for_plan(plan)
+    if strategy is not None:
+        from ..parallel.comm_scheduler import update_shard_extent
+        extent = update_shard_extent(strategy.mesh, strategy.data_axis)
+        import logging
+        logging.getLogger(__name__).info(
+            "elastic replan: n_devices=%s mesh=%s zero1_extent=%d",
+            plan.n_devices, plan.spec.to_string(), extent)
+    return plan, strategy
+
+
+def redistribute_train_state(train_state, new_count: int):
+    """Deterministically remap a saved TrainState's per-worker reader
+    cursors onto ``new_count`` workers (see
+    ``TrainState.redistribute``). Returns a NEW TrainState; global
+    scalars (step, loss scale, guard EMA, autotune token) pass through
+    unchanged."""
+    return train_state.redistribute(new_count)

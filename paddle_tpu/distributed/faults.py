@@ -34,6 +34,13 @@ Engine step loop:
   silent-corruption case the integrity sentinel
   (``FLAGS_integrity_sentinel``, docs/RESILIENCE.md) must detect,
   attribute and roll back;
+* ``device_loss_step`` — the process calls
+  ``os._exit(DEVICE_LOSS_EXIT_CODE)`` when the engine dispatches step N
+  (limited to ``device_loss_attempts`` firings, default 1): unlike a
+  plain preemption the device is PERMANENTLY gone, so the launch.py
+  supervisor must not relaunch the old world size — it shrinks to the
+  surviving device set and the workers resume elastically
+  (distributed/elastic.py, docs/RESILIENCE.md "Elastic topology");
 * ``data_dup_step`` — re-feed the previous step's batch at step N (a
   reader that replayed a batch after a botched resume) — the
   exactly-once accounting case chaos runs check against the resume
@@ -63,12 +70,17 @@ import time
 from typing import Dict, Optional
 
 __all__ = ["FaultPlan", "install", "current", "uninstall", "scoped",
-           "KILL_EXIT_CODE"]
+           "KILL_EXIT_CODE", "DEVICE_LOSS_EXIT_CODE"]
 
 # distinctive exit code for an injected self-kill, so the launch.py
 # supervisor (and humans reading logs) can tell an injected preemption
 # from a real crash
 KILL_EXIT_CODE = 43
+
+# distinctive exit code for an injected PERMANENT device/host loss: the
+# supervisor must not retry the old world size — it drops the lost rank
+# and relaunches the surviving set (elastic shrink, docs/RESILIENCE.md)
+DEVICE_LOSS_EXIT_CODE = 44
 
 _lock = threading.Lock()
 _active: Optional["FaultPlan"] = None
@@ -77,7 +89,8 @@ _FLOAT_KEYS = ("connect_refuse", "drop", "truncate", "delay",
                "delay_s", "nan", "grad_spike", "spike_mag")
 _INT_KEYS = ("seed", "kill_at_step", "kill_attempts", "bitflip_step",
              "bitflip_bit", "data_dup_step", "serve_kill_decode",
-             "serve_kill_attempts")
+             "serve_kill_attempts", "device_loss_step",
+             "device_loss_attempts")
 _STR_KEYS = ("bitflip_param",)
 
 
@@ -96,7 +109,9 @@ class FaultPlan:
                  bitflip_param: Optional[str] = None,
                  data_dup_step: Optional[int] = None,
                  serve_kill_decode: Optional[int] = None,
-                 serve_kill_attempts: int = 1):
+                 serve_kill_attempts: int = 1,
+                 device_loss_step: Optional[int] = None,
+                 device_loss_attempts: int = 1):
         self.seed = int(seed)
         self.connect_refuse = float(connect_refuse)
         self.drop = float(drop)
@@ -119,6 +134,9 @@ class FaultPlan:
         self.serve_kill_decode = (None if serve_kill_decode is None
                                   else int(serve_kill_decode))
         self.serve_kill_attempts = int(serve_kill_attempts)
+        self.device_loss_step = (None if device_loss_step is None
+                                 else int(device_loss_step))
+        self.device_loss_attempts = int(device_loss_attempts)
         self._bitflip_done = False
         self._last_feed = None  # previous step's feed, for data_dup
         self._rng = random.Random(self.seed)
@@ -126,7 +144,8 @@ class FaultPlan:
         self.counts: Dict[str, int] = {
             "connect_refuse": 0, "drop": 0, "truncate": 0,
             "delay": 0, "kill": 0, "nan": 0, "grad_spike": 0,
-            "bitflip": 0, "data_dup": 0, "serve_kill": 0}
+            "bitflip": 0, "data_dup": 0, "serve_kill": 0,
+            "device_loss": 0}
 
     # -- construction -------------------------------------------------------
 
@@ -351,10 +370,26 @@ class FaultPlan:
         return (self.kill_at_step is not None
                 and self.restart_attempt < self.kill_attempts)
 
+    def device_loss_armed(self) -> bool:
+        return (self.device_loss_step is not None
+                and self.restart_attempt < self.device_loss_attempts)
+
     def on_step(self, step: int) -> None:
-        """Self-kill at the configured step — the injected preemption.
-        ``os._exit`` (not sys.exit): a real preemption gives no chance
-        to run atexit hooks or flush queues."""
+        """Self-kill at the configured step — the injected preemption
+        (``kill_at_step``) or permanent device loss
+        (``device_loss_step``). ``os._exit`` (not sys.exit): a real
+        preemption gives no chance to run atexit hooks or flush
+        queues."""
+        if self.device_loss_armed() and step >= self.device_loss_step:
+            self._count("device_loss")
+            try:
+                from ..observability import recorder as _rec
+                _rec.dump("injected_fault", extra={
+                    "fault": f"device_loss_step={self.device_loss_step}",
+                    "killed_at": int(step)})
+            except Exception:
+                pass
+            os._exit(DEVICE_LOSS_EXIT_CODE)
         if self.kill_armed() and step >= self.kill_at_step:
             self._count("kill")
             # flight postmortem inline — os._exit skips atexit, so this

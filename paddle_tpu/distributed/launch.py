@@ -199,6 +199,21 @@ def launch(script_args, nproc=1, ips=None, started_port=None,
     return codes
 
 
+def _latest_ckpt_step(ckpt_dir):
+    """Newest committed checkpoint step under ``ckpt_dir`` (the
+    supervisor's view of training progress between incarnations), or
+    None when unknown. Import is lazy: the supervisor stays light
+    unless crash-loop step tracking is requested."""
+    if not ckpt_dir:
+        return None
+    try:
+        from ..checkpoint import manifest as _mf
+        steps = _mf.list_steps(ckpt_dir)
+        return steps[-1] if steps else _mf.read_latest(ckpt_dir)
+    except Exception:
+        return None
+
+
 def _restart_backoff_s(attempt, base_s, cap_s):
     """Exponential backoff with full jitter in [0.5x, 1x]: a crashing
     gang must not hammer a shared checkpoint store / cluster scheduler
@@ -215,7 +230,9 @@ def _restart_backoff_s(attempt, base_s, cap_s):
 def supervise(script_args, max_restarts=0, nproc=1, ips=None,
               started_port=None, backend=None, log_dir=None,
               extra_env=None, grace_s=DEFAULT_GRACE_S,
-              backoff_base_s=0.5, backoff_cap_s=15.0):
+              backoff_base_s=0.5, backoff_cap_s=15.0,
+              elastic=False, min_nproc=1, ckpt_dir=None,
+              attempt_log=None):
     """Elastic supervisor: relaunch a failed gang up to
     ``max_restarts`` times. Returns ``(exit_code, restarts_used)`` —
     exit_code is 0 when some incarnation finished clean, else the
@@ -232,30 +249,124 @@ def supervise(script_args, max_restarts=0, nproc=1, ips=None,
     ``backoff_cap_s``; 0 disables), and when ``started_port`` pins the
     port range, each incarnation shifts to a fresh range
     (``started_port + attempt * nproc``) so a dying worker's socket
-    lingering in TIME_WAIT cannot make every restart fail on bind."""
+    lingering in TIME_WAIT cannot make every restart fail on bind.
+
+    **Elastic topology** (docs/RESILIENCE.md "Elastic topology"):
+    with ``elastic=True`` — or whenever a worker exits with
+    ``faults.DEVICE_LOSS_EXIT_CODE``, which declares its device
+    PERMANENTLY gone — a failed gang is relaunched with the SURVIVING
+    rank count (never below ``min_nproc``) instead of retrying the
+    dead world size. The shrunk incarnation gets ``PT_ELASTIC_RESUME=1``
+    so ``CheckpointManager.maybe_restore`` takes the elastic path:
+    re-place, reshard, redistribute cursors. Shrinking applies to
+    ``--nproc`` gangs; with ``--ips`` the host list is operator-owned,
+    so the supervisor aborts with the failing code instead of guessing
+    which host to drop.
+
+    **Crash-loop detection**: ``PT_CRASH_LOOP_N`` (default 3)
+    consecutive failures each faster than ``PT_CRASH_LOOP_WINDOW_S``
+    (default 5s) after launch AND at the same checkpoint step
+    (``ckpt_dir`` names the store to read it from; unknown steps
+    compare equal) mean restarts cannot help — the supervisor aborts
+    with a postmortem pointer instead of burning the remaining budget.
+    In elastic mode a crash loop first tries one shrink (maybe a
+    half-dead device keeps killing its rank); only a crash loop at
+    ``min_nproc`` aborts.
+
+    ``attempt_log``, when a list, receives one dict per incarnation
+    ``{attempt, nproc, codes, first_fail, step, duration_s, shrunk}`` —
+    the accounting ``tools/chaos_report.py``'s elastic probe audits."""
     attempt = 0
+    loop_n = int(os.environ.get("PT_CRASH_LOOP_N", "3"))
+    loop_window_s = float(os.environ.get("PT_CRASH_LOOP_WINDOW_S",
+                                         "5.0"))
+    fast_fails = 0           # consecutive immediate same-step failures
+    last_fail_step = None
+    elastic_now = bool(elastic)
     while True:
         env = dict(extra_env or {})
         env["PADDLE_RESTART_ATTEMPT"] = str(attempt)
+        if elastic_now and attempt:
+            env["PT_ELASTIC_RESUME"] = "1"
         port = started_port
         if port is not None and attempt:
             # fresh range per incarnation; ips-mode endpoints must be
             # identical on every host, so the shift is deterministic
             port = started_port + attempt * max(
                 1, nproc if not ips else 1)
+        t_launch = time.monotonic()
         codes, first_fail = _run_once(
             script_args, nproc=nproc, ips=ips,
             started_port=port, backend=backend,
             log_dir=log_dir, extra_env=env, grace_s=grace_s)
+        duration = time.monotonic() - t_launch
+        step = _latest_ckpt_step(ckpt_dir)
+        shrunk = False
+        if attempt_log is not None:
+            attempt_log.append({
+                "attempt": attempt, "nproc": len(codes),
+                "codes": list(codes), "first_fail": first_fail,
+                "step": step, "duration_s": duration,
+                "shrunk": False})
         if first_fail == 0:
             return 0, attempt
         if attempt >= max_restarts:
+            return first_fail, attempt
+
+        # positive exit codes are ranks that died on their own; the
+        # negative ones were torn down by the supervisor and survive
+        # a shrink (their state is in the checkpoint either way)
+        from .faults import DEVICE_LOSS_EXIT_CODE
+        lost = [r for r, c in enumerate(codes)
+                if c is not None and c > 0]
+        device_lost = first_fail == DEVICE_LOSS_EXIT_CODE
+        if device_lost:
+            elastic_now = True
+
+        # crash-loop accounting BEFORE deciding the next world size:
+        # an immediate failure at an unchanged step means the restart
+        # did nothing but burn budget
+        immediate = duration < loop_window_s
+        same_step = (attempt > 0 and step == last_fail_step)
+        fast_fails = fast_fails + 1 if (immediate and
+                                        (attempt == 0 or same_step)) \
+            else (1 if immediate else 0)
+        last_fail_step = step
+        looping = fast_fails >= loop_n
+
+        can_shrink = (not ips and len(lost) >= 1
+                      and nproc - len(lost) >= min_nproc)
+        if (elastic_now and can_shrink
+                and (device_lost or looping or elastic)):
+            new_nproc = nproc - len(lost)
+            print(f"paddle_tpu.distributed.launch: elastic shrink — "
+                  f"rank(s) {lost} lost "
+                  f"(exit {first_fail}"
+                  f"{', device loss' if device_lost else ''}); "
+                  f"relaunching with {new_nproc} of {nproc} workers",
+                  file=sys.stderr, flush=True)
+            nproc = new_nproc
+            shrunk = True
+            fast_fails = 0   # the world changed; give it a fresh look
+            if attempt_log is not None:
+                attempt_log[-1]["shrunk"] = True
+        elif looping:
+            print(f"paddle_tpu.distributed.launch: crash loop — "
+                  f"{fast_fails} consecutive failures within "
+                  f"{loop_window_s:.1f}s of launch at checkpoint step "
+                  f"{step}; aborting with {max_restarts - attempt} "
+                  f"restarts unspent. Postmortem: flight-recorder "
+                  f"dumps (PT_FLIGHT_DIR) and "
+                  f"{log_dir or '--log_dir'}/workerlog.* "
+                  f"(docs/RESILIENCE.md)",
+                  file=sys.stderr, flush=True)
             return first_fail, attempt
         attempt += 1
         delay = _restart_backoff_s(attempt, backoff_base_s,
                                    backoff_cap_s)
         print(f"paddle_tpu.distributed.launch: gang failed "
               f"(exit {first_fail}); restart {attempt}/{max_restarts}"
+              f"{f' at world size {nproc}' if shrunk else ''}"
               f" in {delay:.2f}s",
               file=sys.stderr, flush=True)
         if delay:
@@ -296,6 +407,19 @@ def main(argv=None):
     ap.add_argument("--restart-backoff-cap", type=float, default=15.0,
                     dest="backoff_cap_s",
                     help="ceiling seconds for the restart backoff")
+    ap.add_argument("--elastic", action="store_true",
+                    help="relaunch a failed gang with the SURVIVING "
+                         "rank count instead of the dead world size; "
+                         "workers resume via the elastic restore path "
+                         "(docs/RESILIENCE.md 'Elastic topology')")
+    ap.add_argument("--min-nproc", "--min_nproc", type=int, default=1,
+                    dest="min_nproc",
+                    help="never shrink the gang below this many ranks")
+    ap.add_argument("--ckpt-dir", "--ckpt_dir", default=None,
+                    dest="ckpt_dir",
+                    help="checkpoint store the workers save into; lets "
+                         "the crash-loop detector compare the global "
+                         "step across restarts")
     ap.add_argument("script", help="training script")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
@@ -304,7 +428,8 @@ def main(argv=None):
         nproc=args.nproc, ips=args.ips, started_port=args.started_port,
         backend=args.backend, log_dir=args.log_dir,
         grace_s=args.grace_s, backoff_base_s=args.backoff_base_s,
-        backoff_cap_s=args.backoff_cap_s)
+        backoff_cap_s=args.backoff_cap_s, elastic=args.elastic,
+        min_nproc=args.min_nproc, ckpt_dir=args.ckpt_dir)
     sys.exit(code)
 
 

@@ -458,6 +458,17 @@ def _install_standard_families(reg: MetricsRegistry) -> None:
                 "captured or applied on save/restore")
     reg.gauge("pt_resume_resumed_step",
               "global step the last TrainState restore resumed at")
+    # elastic topology resume (distributed/elastic.py;
+    # docs/RESILIENCE.md "Elastic topology")
+    reg.counter("pt_elastic_resumes_total",
+                "checkpoint restores taken through the elastic "
+                "topology path (saved-vs-current mismatch -> replan + "
+                "reshard + cursor redistribution)")
+    reg.histogram("pt_elastic_reshard_seconds",
+                  "wall time of elastic restores: placement re-search "
+                  "+ global tensor reassembly + cursor redistribution")
+    reg.gauge("pt_elastic_world_size",
+              "device world size after the last elastic resume")
     # custom-kernel registry (FLAGS_use_custom_kernels; docs/KERNELS.md)
     reg.counter("pt_kernel_dispatch_total",
                 "trace-time kernel-registry decisions, labeled "

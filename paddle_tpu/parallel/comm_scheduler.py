@@ -362,6 +362,20 @@ def update_shard_axes(mesh, data_axis: str) -> Tuple[str, ...]:
     return tuple(out)
 
 
+def update_shard_extent(mesh, data_axis: str) -> int:
+    """Joint extent of the ZeRO-1 shard axes: the number of ways the
+    sharded weight update splits optimizer state (product of the
+    ``update_shard_axes`` sizes; 1 = unsharded). Elastic restore
+    (distributed/elastic.py) re-derives this for the new mesh so a
+    world-size change re-shards moments instead of replaying the old
+    extent."""
+    shape = getattr(mesh, "shape", {}) or {}
+    n = 1
+    for a in update_shard_axes(mesh, data_axis):
+        n *= int(shape[a])
+    return n
+
+
 def sharded_update_spec(name: str, shape, mesh, data_axis: str):
     """PartitionSpec for `name` under the cross-replica sharded weight
     update: optimizer accumulators and AMP master weights shard dim 0

@@ -149,6 +149,17 @@ class MeshSpec:
     def from_dict(cls, d: Dict[str, int]) -> "MeshSpec":
         return cls(**{a: int(d.get(a, 1)) for a in cls.AXES})
 
+    def to_string(self) -> str:
+        """Inverse of :meth:`from_string`: the compact PT_MESH_AXES
+        form with size-1 axes dropped (``"data=2,fsdp=2"``); a fully
+        trivial spec renders as ``"data=1"`` so the string is never
+        empty. Used by elastic restore and ``ckpt_inspect`` to name
+        saved topologies."""
+        shapes = self.axis_shapes()
+        if not shapes:
+            return "data=1"
+        return ",".join(f"{a}={n}" for a, n in shapes.items())
+
     @classmethod
     def from_string(cls, s: str) -> "MeshSpec":
         """Parse the PT_MESH_AXES form: ``"data=4,fsdp=2,tp=1"``."""

@@ -144,6 +144,10 @@ class DistributedStrategy:
         names = self.mesh.axis_names
         self.data_axis = next((a for a in ("dp", "data") if a in names),
                               names[0])
+        # MeshSpec this strategy was derived from, when built through
+        # from_mesh_spec — lets checkpointing record the saved topology
+        # without the caller threading the spec separately
+        self.spec: Optional["MeshSpec"] = None
 
     @classmethod
     def from_mesh_spec(cls, spec: MeshSpec,
@@ -155,6 +159,7 @@ class DistributedStrategy:
         ``pp > 1`` compiles for its (data, fsdp, tp) sub-mesh — stage
         execution lives in the pipeline engines, not the SPMD step —
         with a warning so a silently-ignored pp request is visible."""
+        orig_spec = spec
         if spec.pp != 1:
             import warnings as _w
             _w.warn(
@@ -167,10 +172,12 @@ class DistributedStrategy:
         if layout is None:
             layout = SpecLayout(fsdp=spec.fsdp != 1, tp=spec.tp != 1)
         shapes = spec.axis_shapes() or {"data": 1}
-        return cls(axes=shapes, rules=layout.param_rules(spec),
-                   feed_rules=layout.feed_rules(spec),
-                   activation_rules=layout.activation_rules(spec),
-                   devices=devices)
+        strat = cls(axes=shapes, rules=layout.param_rules(spec),
+                    feed_rules=layout.feed_rules(spec),
+                    activation_rules=layout.activation_rules(spec),
+                    devices=devices)
+        strat.spec = orig_spec
+        return strat
 
     def param_spec(self, name: str, shape) -> Optional[P]:
         spec = self.rules.spec_for(name, shape, self.mesh)

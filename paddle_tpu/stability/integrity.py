@@ -202,14 +202,34 @@ def ensure_state(scope, plan: IntegrityPlan) -> None:
     _seed(INTEGRITY_AGREE_VAR, jnp.zeros((), jnp.float32))
 
 
-def invalidate_shadow(scope) -> None:
+def invalidate_shadow(scope, drop_layout: bool = False) -> None:
     """Reset the continuity shadow (step counter -> 0) after a
     LEGITIMATE out-of-band parameter write — a checkpoint restore, a
     deliberate host-side ``set_value``. The next traced step rebuilds
-    the shadow without raising a false ``integrity`` anomaly."""
+    the shadow without raising a false ``integrity`` anomaly.
+
+    ``drop_layout=True`` (elastic restore, docs/RESILIENCE.md "Elastic
+    topology") additionally clears the per-bucket state vars: the new
+    topology re-buckets the fingerprint plan, and ``ensure_state``
+    re-seeds everything for the new bucket count the moment the next
+    program builds its plan — so an elastic resume never compares
+    fingerprints across bucketings."""
     v = scope.find_var(INTEGRITY_STEP_VAR)
     if v is not None and v.is_initialized():
         v.set_value(np.zeros((), np.int32))
+    if drop_layout:
+        # un-initialize by re-seeding the CK var to a zero-length
+        # vector: its shape can never equal any plan's (nbuckets,), so
+        # the next ensure_state takes the `fresh` path and rebuilds
+        # the whole per-bucket family for the new layout
+        for name in (INTEGRITY_CK_VAR, INTEGRITY_SUM_VAR,
+                     INTEGRITY_BAD_VAR, INTEGRITY_DRIFT_VAR):
+            vv = scope.find_var(name)
+            if vv is not None and vv.is_initialized():
+                vv.set_value(np.zeros((0,), np.int32
+                                      if name in (INTEGRITY_CK_VAR,
+                                                  INTEGRITY_BAD_VAR)
+                                      else np.float32))
 
 
 # ---------------------------------------------------------------------------

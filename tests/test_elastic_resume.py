@@ -410,6 +410,375 @@ def test_train_state_survives_in_manifest_and_lints_clean(tmp_path):
     assert "train_state: v1 global_step=1" in proc.stdout
 
 
+# ---------------------------------------------------------------------------
+# elastic topology: saved-vs-current mismatch, cross-factorization
+# round-trips, cursor redistribution, supervisor shrink / crash loop
+# (distributed/elastic.py, docs/RESILIENCE.md "Elastic topology")
+# ---------------------------------------------------------------------------
+
+def _train_and_save(ckpt, mesh_spec, n_devices, steps=3):
+    """Train a few Adam steps and save with the given claimed topology;
+    returns {name: array} of every persistable at save time."""
+    from paddle_tpu.checkpoint import CheckpointManager
+    main, startup, loss = _build()
+    scope = Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for i in range(steps):
+            exe.run(main, feed=_batch(i), fetch_list=[loss.name])
+        with CheckpointManager(ckpt, mesh_spec=mesh_spec,
+                               n_devices=n_devices) as m:
+            m.save(steps, scope=scope, program=main, sync=True,
+                   train_state=True)
+        return main, {
+            n: np.asarray(scope.find_var(n).get_value()).copy()
+            for n in (v.name for v in main.list_vars()
+                      if getattr(v, "persistable", False))
+            if scope.find_var(n) is not None
+            and scope.find_var(n).is_initialized()}
+
+
+def test_topology_mismatch_fails_loudly_without_elastic(tmp_path):
+    """Satellite guard: a checkpoint written by a different topology
+    must NOT silently assemble under a non-elastic restore — the error
+    names both topologies; elastic=True (or PT_ELASTIC_RESUME=1) opts
+    into re-place + reshard."""
+    from paddle_tpu.checkpoint import CheckpointManager
+    from paddle_tpu.core.enforce import EnforceNotMet
+    from paddle_tpu.parallel.mesh import MeshSpec
+
+    ckpt = str(tmp_path / "ckpt")
+    main, saved = _train_and_save(
+        ckpt, MeshSpec(data=2, fsdp=2), n_devices=4)
+
+    main2, _, _ = _build()
+    scope2 = Scope()
+    with fluid.scope_guard(scope2):
+        with CheckpointManager(ckpt) as m:  # claims 1 device
+            with pytest.raises(EnforceNotMet) as ei:
+                m.restore(scope=scope2, program=main2)
+    import jax
+    live = jax.device_count()
+    msg = str(ei.value)
+    # the error must NAME both topologies, not just reject
+    assert "data=2,fsdp=2" in msg
+    assert "n_devices=4" in msg and f"n_devices={live}" in msg
+    assert "PT_ELASTIC_RESUME" in msg
+
+    # same manager, elastic opt-in: restore succeeds and assembles the
+    # exact saved values onto the 1-device fleet
+    scope3 = Scope()
+    with fluid.scope_guard(scope3):
+        main3, startup3, _ = _build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup3)
+        with CheckpointManager(ckpt) as m2:
+            step = m2.restore(scope=scope3, program=main3,
+                              elastic=True)
+            info = m2.elastic_resume_info
+        assert step == 3
+        assert info is not None
+        assert info["saved"]["n_devices"] == 4
+        assert info["current"]["n_devices"] == live
+        for n, want in saved.items():
+            got = np.asarray(scope3.find_var(n).get_value())
+            np.testing.assert_array_equal(got, want)
+
+
+def test_meshless_tensoronly_restore_crosses_world_size(tmp_path):
+    """The fail-loud check guards world-size-coupled state (cursors,
+    mesh layouts). A checkpoint with NO mesh and NO train_state is the
+    plain format-property case — two writer processes, any-world
+    restore by shard-index assembly — and must keep restoring
+    non-elastically with only a warning."""
+    from paddle_tpu.checkpoint import CheckpointManager
+    from paddle_tpu.checkpoint.snapshot import Snapshot, SnapshotEntry
+
+    root = str(tmp_path / "ck")
+    full = np.arange(24, dtype=np.float32).reshape(6, 4)
+    m1 = CheckpointManager(root, process_index=1, process_count=2)
+    m1.save(1, snapshot=Snapshot([SnapshotEntry(
+        "w", (6, 4), "float32", [], [([[3, 6], [0, 4]], full[3:])])]),
+        sync=True)
+    m0 = CheckpointManager(root, process_index=0, process_count=2,
+                           commit_timeout=10)
+    m0.save(1, snapshot=Snapshot([SnapshotEntry(
+        "w", (6, 4), "float32", [], [([[0, 3], [0, 4]], full[:3])])]),
+        sync=True)
+    m0.close(), m1.close()
+
+    sc = Scope()
+    with CheckpointManager(root) as m:  # world_size 1, no mesh
+        with pytest.warns(UserWarning, match="shard-index assembly"):
+            assert m.restore(scope=sc, vars=["w"],
+                             include_rng=False) == 1
+        assert m.elastic_resume_info is None
+    np.testing.assert_array_equal(
+        np.asarray(sc.find_var("w").get_value()), full)
+
+
+def test_topology_mismatch_env_optin(tmp_path, monkeypatch):
+    """PT_ELASTIC_RESUME=1 — the env the shrinking supervisor sets —
+    is equivalent to restore(elastic=True)."""
+    from paddle_tpu.checkpoint import CheckpointManager
+    from paddle_tpu.parallel.mesh import MeshSpec
+
+    ckpt = str(tmp_path / "ckpt")
+    _, saved = _train_and_save(ckpt, MeshSpec(data=2), n_devices=2)
+    monkeypatch.setenv("PT_ELASTIC_RESUME", "1")
+    main2, startup2, _ = _build()
+    scope2 = Scope()
+    with fluid.scope_guard(scope2):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup2)
+        with CheckpointManager(ckpt) as m:
+            assert m.maybe_restore(scope=scope2, program=main2) == 3
+            assert m.elastic_resume_info is not None
+    np.testing.assert_array_equal(
+        np.asarray(scope2.find_var("rw1").get_value()), saved["rw1"])
+
+
+@pytest.mark.parametrize("target_spec,target_devices", [
+    ("data=4", 4), ("fsdp=4", 4), ("data=1", 1),
+], ids=["onto_data4", "onto_fsdp4", "onto_single_device"])
+def test_cross_factorization_roundtrip(tmp_path, target_spec,
+                                       target_devices):
+    """Checkpoints written under data2_fsdp2_tp2 restore bit-equal onto
+    ANY factorization of any world size — resharding is a property of
+    the format (writer shard-index metadata), not of the saving mesh.
+    Covers dense params AND Adam moments / beta-pow accumulators."""
+    from paddle_tpu.checkpoint import CheckpointManager
+    from paddle_tpu.parallel.mesh import MeshSpec
+
+    ckpt = str(tmp_path / "ckpt")
+    _, saved = _train_and_save(
+        ckpt, MeshSpec(data=2, fsdp=2, tp=2), n_devices=8)
+    assert any("moment" in n for n in saved), \
+        "Adam moments must be in the checkpoint for this to prove " \
+        "optimizer-state resharding"
+
+    main2, startup2, _ = _build()
+    scope2 = Scope()
+    with fluid.scope_guard(scope2):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup2)
+        with CheckpointManager(
+                ckpt, mesh_spec=MeshSpec.from_string(target_spec),
+                n_devices=target_devices) as m:
+            assert m.restore(scope=scope2, program=main2,
+                             elastic=True) == 3
+            info = m.elastic_resume_info
+    assert info is not None
+    assert MeshSpec.from_dict(info["saved"]["mesh"]) == \
+        MeshSpec(data=2, fsdp=2, tp=2)
+    for n, want in saved.items():
+        got = np.asarray(scope2.find_var(n).get_value())
+        np.testing.assert_array_equal(got, want)
+
+
+def test_pp_cut_checkpoint_restores_elastically(tmp_path):
+    """A checkpoint claiming a pp=2 cut restores onto a single device
+    through the same elastic path."""
+    from paddle_tpu.checkpoint import CheckpointManager
+    from paddle_tpu.parallel.mesh import MeshSpec
+
+    ckpt = str(tmp_path / "ckpt")
+    _, saved = _train_and_save(
+        ckpt, MeshSpec(data=2, pp=2), n_devices=4)
+    main2, startup2, _ = _build()
+    scope2 = Scope()
+    with fluid.scope_guard(scope2):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup2)
+        with CheckpointManager(ckpt) as m:
+            assert m.restore(scope=scope2, program=main2,
+                             elastic=True) == 3
+            assert MeshSpec.from_dict(
+                m.elastic_resume_info["saved"]["mesh"]) == \
+                MeshSpec(data=2, pp=2)
+    for n, want in saved.items():
+        np.testing.assert_array_equal(
+            np.asarray(scope2.find_var(n).get_value()), want)
+
+
+def test_cursor_redistribution_is_exactly_once():
+    """TrainState.redistribute: a deterministic pure function of
+    (saved workers, new count) — survivors keep their own cursors
+    byte-for-byte, orphans park namespaced on rank ``o % new_count``,
+    nothing dropped, nothing overridden."""
+    from paddle_tpu.checkpoint import TrainState
+
+    ts = TrainState(global_step=7, workers={
+        str(p): {"readers": {"train": {"offset": 10 + p}},
+                 "host_rng": ["MT19937", [p], 0, 0, 0.0]}
+        for p in range(4)})
+    small = ts.redistribute(2)
+    assert sorted(small.workers) == ["0", "1"]
+    assert small.workers["0"]["readers"] == {
+        "train": {"offset": 10}, "train@2": {"offset": 12}}
+    assert small.workers["1"]["readers"] == {
+        "train": {"offset": 11}, "train@3": {"offset": 13}}
+    # survivors keep their host RNG; orphans' RNG is dropped (a parked
+    # cursor can be drained later, an RNG stream cannot be split)
+    assert small.workers["0"]["host_rng"] == ["MT19937", [0], 0, 0, 0.0]
+    total = sum(len(w["readers"]) for w in small.workers.values())
+    assert total == 4  # exactly-once: every saved cursor survives
+    # a second shrink keeps all four too; an already-parked orphan
+    # cursor chains its namespace ("train@3@1" = worker 3's cursor,
+    # parked on worker 1, now parked on worker 0) so provenance is
+    # kept and keys can never collide
+    one = small.redistribute(1)
+    assert sorted(one.workers["0"]["readers"]) == [
+        "train", "train@1", "train@2", "train@3@1"]
+
+    with pytest.warns(UserWarning, match="grow"):
+        grown = ts.redistribute(6)
+    assert sorted(grown.workers) == ["0", "1", "2", "3"]  # no invented
+    assert grown.global_step == 7
+
+
+def test_multiprocess_manifest_redistributes_on_shrink(tmp_path):
+    """Integration: a 2-process checkpoint (rank 1 contributes only
+    its train_state entry) restored by a 1-process elastic fleet
+    delivers rank 0's cursor live and parks rank 1's namespaced."""
+    from paddle_tpu.checkpoint import (CheckpointManager,
+                                       register_reader,
+                                       unregister_reader)
+    from paddle_tpu.parallel.mesh import MeshSpec
+
+    ckpt = str(tmp_path / "ckpt")
+    main, startup, loss = _build()
+    rdr = _pipeline()
+    register_reader("train", rdr)
+    try:
+        scope = Scope()
+        with fluid.scope_guard(scope):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            exe.run(main, feed=_batch(0), fetch_list=[loss.name])
+            # rank 1 writes first (its shard is manifest-only), then
+            # rank 0 — whose save also runs the commit barrier
+            with CheckpointManager(ckpt, process_index=1,
+                                   process_count=2,
+                                   mesh_spec=MeshSpec(data=2),
+                                   n_devices=2) as m1:
+                m1.save(1, scope=scope, vars=[], include_rng=False,
+                        sync=True, train_state=True)
+            with CheckpointManager(ckpt, process_index=0,
+                                   process_count=2,
+                                   mesh_spec=MeshSpec(data=2),
+                                   n_devices=2) as m0:
+                m0.save(1, scope=scope, program=main, sync=True,
+                        train_state=True)
+    finally:
+        unregister_reader("train")
+
+    main2, startup2, _ = _build()
+    rdr2 = _pipeline()
+    register_reader("train", rdr2)
+    try:
+        scope2 = Scope()
+        with fluid.scope_guard(scope2):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup2)
+            with CheckpointManager(ckpt) as m:
+                assert m.restore(scope=scope2, program=main2,
+                                 elastic=True) == 1
+                ts = m.restored_train_state
+        assert sorted(ts.workers) == ["0"]
+        assert sorted(ts.workers["0"]["readers"]) == [
+            "train", "train@1"]
+    finally:
+        unregister_reader("train")
+
+
+def test_supervise_crash_loop_aborts_early(tmp_path, monkeypatch,
+                                           capfd):
+    """Satellite guard: N immediate consecutive failures at the same
+    checkpoint step abort with a postmortem pointer instead of burning
+    the whole --max-restarts budget."""
+    from paddle_tpu.distributed import launch as pt_launch
+
+    script = tmp_path / "always_dies.py"
+    script.write_text("import sys\nsys.exit(1)\n")
+    monkeypatch.setenv("PT_CRASH_LOOP_N", "2")
+    code, used = pt_launch.supervise(
+        [str(script)], max_restarts=8, nproc=1, backend="cpu",
+        backoff_base_s=0.0)
+    assert code == 1
+    assert used < 8, "crash loop must not burn the restart budget"
+    err = capfd.readouterr().err
+    assert "crash loop" in err
+    assert "workerlog" in err  # the postmortem pointer
+
+
+def test_supervise_elastic_shrink_on_device_loss(tmp_path, capfd):
+    """A worker exiting DEVICE_LOSS_EXIT_CODE (its device is
+    PERMANENTLY gone) makes the supervisor relaunch with the surviving
+    rank count and PT_ELASTIC_RESUME=1 — even without --elastic."""
+    from paddle_tpu.distributed import launch as pt_launch
+    from paddle_tpu.distributed.faults import DEVICE_LOSS_EXIT_CODE
+
+    out = tmp_path / "out"
+    out.mkdir()
+    script = tmp_path / "lossy.py"
+    script.write_text(
+        "import os, sys\n"
+        "rank = int(os.environ.get('PADDLE_TRAINER_ID', '0'))\n"
+        "attempt = int(os.environ.get('PADDLE_RESTART_ATTEMPT', '0'))\n"
+        "if attempt == 0 and rank == 1:\n"
+        f"    sys.exit({DEVICE_LOSS_EXIT_CODE})\n"
+        "if attempt >= 1:\n"
+        "    with open(os.path.join(sys.argv[1],\n"
+        "              f'env_{rank}.txt'), 'w') as f:\n"
+        "        f.write(os.environ.get('PT_ELASTIC_RESUME', '-') +\n"
+        "                ' ' + os.environ['PADDLE_TRAINERS_NUM'])\n"
+        "sys.exit(0)\n")
+    attempt_log = []
+    code, used = pt_launch.supervise(
+        [str(script), str(out)], max_restarts=3, nproc=2,
+        backend="cpu", backoff_base_s=0.0, min_nproc=1,
+        attempt_log=attempt_log)
+    assert code == 0 and used == 1
+    assert [a["nproc"] for a in attempt_log] == [2, 1]
+    assert attempt_log[0]["shrunk"] is True
+    assert attempt_log[0]["first_fail"] == DEVICE_LOSS_EXIT_CODE
+    # the surviving incarnation saw the elastic env at world size 1
+    assert (out / "env_0.txt").read_text() == "1 1"
+    assert "elastic shrink" in capfd.readouterr().err
+
+
+def test_elastic_restore_rearms_integrity_sentinel(tmp_path):
+    """An elastic restore must drop the sentinel's bucket layout so the
+    re-bucketed fingerprint plan is rebuilt — never a false
+    integrity_mismatch on the first post-resume verdict."""
+    from paddle_tpu.checkpoint import CheckpointManager
+    from paddle_tpu.parallel.mesh import MeshSpec
+
+    ckpt = str(tmp_path / "ckpt")
+    _train_and_save(ckpt, MeshSpec(data=2), n_devices=2)
+
+    fluid.set_flags({"FLAGS_integrity_sentinel": True})
+    try:
+        main2, startup2, loss2 = _build()
+        scope2 = Scope()
+        with fluid.scope_guard(scope2):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup2)
+            # arm the sentinel's shadow on the PRE-restore params
+            exe.run(main2, feed=_batch(0), fetch_list=[loss2.name])
+            with CheckpointManager(ckpt) as m:
+                m.restore(scope=scope2, program=main2, elastic=True)
+            # post-restore steps must not raise / count a mismatch
+            for i in range(4):
+                exe.run(main2, feed=_batch(i), fetch_list=[loss2.name])
+            assert exe._engine.counters.get(
+                "integrity_mismatches", 0) == 0
+    finally:
+        fluid.set_flags({"FLAGS_integrity_sentinel": False})
+
+
 def test_partial_checkpoint_fails_loudly(tmp_path):
     ckpt = str(tmp_path / "ckpt3")
     main, startup, loss = _build()

@@ -50,6 +50,22 @@ honest ``{injected, detected, recovered, missed}`` accounting: a
 bitflip must be detected and rolled back; a duplicated batch is a
 LEGITIMATE update twice and is correctly not flagged (missed=1 —
 that's the data-pipeline cursor's job, not the sentinel's).
+
+``device_loss_step`` fault plans additionally run the ELASTIC probe
+(docs/RESILIENCE.md "Elastic topology"): a 2-rank checkpointing gang
+under ``launch.supervise(elastic=True)`` where rank 1's device
+permanently burns out mid-run (exit ``DEVICE_LOSS_EXIT_CODE``). The
+supervisor must shrink to the surviving rank instead of retrying the
+dead world size, the shrunk incarnation must resume through the
+elastic restore path (re-place / reshard / redistribute cursors), and
+its stitched loss trajectory must be BIT-IDENTICAL to a fresh
+single-rank run launched from the same checkpoint step — the
+``elastic`` section reports honest ``{injected, detected,
+resumed_elastic, bit_identical_vs_fresh}`` accounting and all four
+gate ``survived``.
+
+  python tools/chaos_report.py --steps 12 \
+      --fault "seed=7,device_loss_step=6"       # elastic topology
 """
 from __future__ import annotations
 
@@ -262,6 +278,131 @@ def _sentinel_worker() -> None:
                       "integrity_checks", "integrity_mismatches",
                       "integrity_rollbacks", "integrity_aborts")}}
     print("CHAOS_STATS " + json.dumps(stats), flush=True)
+
+
+class _CursorStream:
+    """Deterministic batch source speaking the train_state cursor
+    protocol: batch ``i`` is a pure function of ``(seed, i)``, so a
+    restored ``offset`` resumes bit-identically with no history
+    replay — exactly the contract docs/RESILIENCE.md asks of real
+    readers."""
+
+    def __init__(self, seed: int):
+        self.seed = int(seed)
+        self.offset = 0
+
+    def next_batch(self):
+        import numpy as np
+        r = np.random.RandomState(
+            (self.seed * 100003 + self.offset) % (2 ** 31))
+        bx = r.rand(16, 4).astype(np.float32)
+        w_true = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+        by = bx @ w_true + 0.25
+        self.offset += 1
+        return bx, by
+
+    def state_dict(self):
+        return {"seed": self.seed, "offset": self.offset}
+
+    def load_state_dict(self, state):
+        self.seed = int(state.get("seed", self.seed))
+        self.offset = int(state["offset"])
+
+
+def _elastic_worker() -> None:
+    """One rank of the elastic-topology probe: local SGD on the same
+    4-feature regression, a CheckpointManager writing ``train_state``
+    every step, and a rank-gated device-loss fault plan. Spawned by
+    ``launch.supervise`` from ``_elastic_probe`` — and re-spawned at
+    the SURVIVING world size after the supervisor's elastic shrink
+    (``PT_ELASTIC_RESUME=1``), where ``maybe_restore`` takes the
+    elastic path. With ``CHAOS_VERIFY_STEP`` set the worker instead
+    restores exactly that step (elastically) and replays the remaining
+    steps WITHOUT saving: the fresh same-world-size run the probe
+    compares loss trajectories against bit-for-bit."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.pop("XLA_FLAGS", None)
+    sys.path.insert(0, REPO)
+
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu import layers
+    from paddle_tpu.checkpoint import CheckpointManager, register_reader
+    from paddle_tpu.distributed import faults
+
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    steps = int(os.environ.get("CHAOS_STEPS", str(DEFAULT_STEPS)))
+    ckpt_dir = os.environ["CHAOS_CKPT_DIR"]
+    fault_rank = int(os.environ.get("CHAOS_FAULT_RANK", "-1"))
+    verify_step = os.environ.get("CHAOS_VERIFY_STEP")
+
+    if rank != fault_rank:
+        # the fault plan rides the gang-wide env; only the designated
+        # victim's device "burns out"
+        faults.uninstall()
+
+    fluid.framework.unique_name.reset()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", [4], dtype="float32")
+        y = layers.data("y", [1], dtype="float32")
+        pred = layers.fc(x, 1, param_attr=fluid.ParamAttr(name="w"),
+                         bias_attr=fluid.ParamAttr(name="b"))
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.SGDOptimizer(0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    stream = _CursorStream(seed=11 + rank)
+    register_reader("train", stream)
+    # short commit barrier: when a rank dies mid-step, the survivors'
+    # in-flight save must fail fast instead of stalling teardown
+    manager = CheckpointManager(ckpt_dir, process_index=rank,
+                                process_count=world,
+                                commit_timeout=20.0)
+
+    start = 0
+    if verify_step is not None:
+        start = manager.restore(step=int(verify_step),
+                                scope=fluid.global_scope(),
+                                vars=["w", "b"], elastic=True)
+    else:
+        restored = manager.maybe_restore(scope=fluid.global_scope(),
+                                         vars=["w", "b"])
+        if restored is not None:
+            start = int(restored)
+            print(f"CHAOS_RESUMED {start}", flush=True)
+            info = manager.elastic_resume_info
+            if info is not None:
+                print("CHAOS_ELASTIC " + json.dumps({
+                    "step": info["step"],
+                    "saved_world": info["saved"].get("world_size"),
+                    "world": info["current"].get("world_size"),
+                    "reshard_seconds": info["reshard_seconds"],
+                }), flush=True)
+
+    losses = []
+    for step in range(start + 1, steps + 1):
+        bx, by = stream.next_batch()
+        out = exe.run(main, feed={"x": bx, "y": by},
+                      fetch_list=[loss.name])
+        losses.append(float(np.asarray(out[0]).reshape(-1)[0]))
+        if verify_step is None:
+            # rank 0 owns the (replicated) tensors and the engine RNG
+            # state; other ranks contribute only their train_state
+            # worker entry — the shard layout a real data-parallel
+            # gang writes (every rank writing its own RNG var would
+            # over-cover it in the merged manifest)
+            manager.save(step, scope=fluid.global_scope(),
+                         vars=["w", "b"] if rank == 0 else [],
+                         include_rng=(rank == 0),
+                         sync=True, train_state=True)
+    if verify_step is None:
+        manager.close()
+    print("CHAOS_LOSSES " + json.dumps(
+        {"start": start, "losses": losses}), flush=True)
 
 
 # ---------------------------------------------------------------------------
@@ -481,6 +622,12 @@ def run_job(steps=DEFAULT_STEPS, fault_spec=None, max_restarts=1,
                 for rc in codes if rc == 43)
     if kills:
         agg["faults"]["kill"] = agg["faults"].get("kill", 0) + kills
+    # likewise a device-loss victim (faults.DEVICE_LOSS_EXIT_CODE == 44)
+    dlost = sum(1 for codes in trainer_codes.values()
+                for rc in codes if rc == 44)
+    if dlost:
+        agg["faults"]["device_loss"] = (
+            agg["faults"].get("device_loss", 0) + dlost)
     # final loss is taken from trainer 0 (never fault-injected) so the
     # clean-vs-faulted comparison measures the CLUSTER's recovery, not
     # the noise of the killed process
@@ -570,6 +717,116 @@ def _sentinel_probe(steps: int, fault_spec: str,
     return rep
 
 
+def _elastic_probe(steps: int, fault_spec: str,
+                   timeout_s=JOB_TIMEOUT_S) -> dict:
+    """Elastic-topology probe (docs/RESILIENCE.md "Elastic topology"):
+    drive ``launch.supervise(nproc=2, elastic=True)`` over the elastic
+    worker with ``fault_spec`` armed on rank 1, then audit the
+    supervisor's attempt log and the surviving rank's markers for
+    honest ``{injected, detected, resumed_elastic}`` accounting.
+    Acceptance is a FRESH single-rank process restoring the same
+    checkpoint step (elastically, no saving) and replaying the exact
+    float-for-float loss trajectory the shrunk fleet produced."""
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from paddle_tpu.distributed import launch as pt_launch
+
+    import shutil
+    ckpt = tempfile.mkdtemp(prefix="chaos_elastic_ckpt_")
+    log_dir = tempfile.mkdtemp(prefix="chaos_elastic_log_")
+    attempt_log = []
+    try:
+        extra = {
+            "JAX_PLATFORMS": "cpu",
+            "CHAOS_STEPS": str(steps),
+            "CHAOS_CKPT_DIR": ckpt,
+            "CHAOS_FAULT_RANK": "1",    # rank 1's device burns out
+            "PT_FAULT_PLAN": fault_spec,
+        }
+        code, restarts = pt_launch.supervise(
+            [os.path.abspath(__file__), "--role", "elastic"],
+            max_restarts=2, nproc=2, backend="cpu", log_dir=log_dir,
+            extra_env=extra, grace_s=5.0, backoff_base_s=0.0,
+            elastic=True, min_nproc=1, ckpt_dir=ckpt,
+            attempt_log=attempt_log)
+
+        # the surviving rank's (appended) workerlog carries the
+        # continuation's markers; keep the LAST of each
+        resumed_at = None
+        elastic_marker = None
+        cont = None
+        try:
+            with open(os.path.join(log_dir, "workerlog.0")) as f:
+                for line in f:
+                    if line.startswith("CHAOS_RESUMED "):
+                        resumed_at = int(line.split()[1])
+                    elif line.startswith("CHAOS_ELASTIC "):
+                        elastic_marker = json.loads(
+                            line[len("CHAOS_ELASTIC "):])
+                    elif line.startswith("CHAOS_LOSSES "):
+                        cont = json.loads(
+                            line[len("CHAOS_LOSSES "):])
+        except OSError:
+            pass
+
+        from paddle_tpu.distributed.faults import DEVICE_LOSS_EXIT_CODE
+        injected = sum(1 for a in attempt_log
+                       for c in a["codes"]
+                       if c == DEVICE_LOSS_EXIT_CODE)
+        detected = sum(1 for a in attempt_log if a.get("shrunk"))
+        resumed_elastic = bool(
+            elastic_marker is not None and cont is not None
+            and resumed_at is not None
+            and cont["start"] == resumed_at)
+
+        verify = None
+        if resumed_elastic:
+            env = dict(os.environ)
+            for k in ("XLA_FLAGS", "PT_FAULT_PLAN",
+                      "PADDLE_RESTART_ATTEMPT", "PT_ELASTIC_RESUME"):
+                env.pop(k, None)
+            env.update({
+                "JAX_PLATFORMS": "cpu",
+                "PADDLE_TRAINER_ID": "0",
+                "PADDLE_TRAINERS_NUM": "1",
+                "CHAOS_STEPS": str(steps),
+                "CHAOS_CKPT_DIR": ckpt,
+                "CHAOS_VERIFY_STEP": str(resumed_at),
+            })
+            p = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__),
+                 "--role", "elastic"],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True)
+            try:
+                out, _ = p.communicate(timeout=timeout_s)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, _ = p.communicate()
+            for line in out.splitlines():
+                if line.startswith("CHAOS_LOSSES "):
+                    verify = json.loads(line[len("CHAOS_LOSSES "):])
+        bit_identical = bool(
+            cont is not None and verify is not None
+            and len(cont["losses"]) > 0
+            and cont["losses"] == verify["losses"])
+        return {
+            "injected": injected,
+            "detected": detected,
+            "resumed_elastic": resumed_elastic,
+            "resumed_at_step": resumed_at,
+            "world_sizes": [a["nproc"] for a in attempt_log],
+            "restarts": restarts,
+            "stitched_steps": len(cont["losses"]) if cont else 0,
+            "bit_identical_vs_fresh": bit_identical,
+            "completed": code == 0,
+        }
+    finally:
+        shutil.rmtree(ckpt, ignore_errors=True)
+        shutil.rmtree(log_dir, ignore_errors=True)
+
+
 def chaos_report(steps=DEFAULT_STEPS, fault_spec=DEFAULT_FAULT,
                  max_restarts=1,
                  stability_policy=DEFAULT_STABILITY_POLICY) -> dict:
@@ -617,6 +874,18 @@ def chaos_report(steps=DEFAULT_STEPS, fault_spec=DEFAULT_FAULT,
             rep["survived"] = bool(
                 rep["survived"] and probe["completed"]
                 and probe["missed"] == 0 and probe["injected"] > 0)
+    # device-loss chaos: elastic-topology probe — one rank of a
+    # supervised gang permanently loses its device; the fleet must
+    # shrink, resume elastically, and match a fresh same-world-size
+    # run bit-for-bit (docs/RESILIENCE.md "Elastic topology")
+    if "device_loss" in (fault_spec or ""):
+        eprobe = _elastic_probe(steps, fault_spec)
+        rep["elastic"] = eprobe
+        rep["survived"] = bool(
+            rep["survived"] and eprobe["completed"]
+            and eprobe["injected"] > 0 and eprobe["detected"] > 0
+            and eprobe["resumed_elastic"]
+            and eprobe["bit_identical_vs_fresh"])
     return rep
 
 
@@ -635,13 +904,18 @@ def chaos_report_line(steps=DEFAULT_STEPS, fault_spec=DEFAULT_FAULT,
         i = rep["integrity"]
         line += (f" integrity={i['detected']}/{i['injected']} "
                  f"recovered={i['recovered']} missed={i['missed']}")
+    if "elastic" in rep:
+        e = rep["elastic"]
+        line += (f" elastic={e['detected']}/{e['injected']} "
+                 f"worlds={e['world_sizes']} "
+                 f"bit_identical={e['bit_identical_vs_fresh']}")
     return rep, line
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--role", choices=["pserver", "trainer",
-                                       "sentinel"],
+                                       "sentinel", "elastic"],
                     help=argparse.SUPPRESS)
     ap.add_argument("--steps", type=int, default=DEFAULT_STEPS)
     ap.add_argument("--fault", default=DEFAULT_FAULT,
@@ -654,6 +928,9 @@ def main(argv=None):
     args = ap.parse_args(argv)
     if args.role == "sentinel":
         _sentinel_worker()
+        return
+    if args.role == "elastic":
+        _elastic_worker()
         return
     if args.role:
         _worker(args.role)

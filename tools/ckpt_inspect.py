@@ -10,11 +10,14 @@ incomplete shard coverage. Same exit-code convention as
 sanity check on a copied/rsynced checkpoint directory.
 
 ``--train-state`` additionally prints and lints the manifest's
-``train_state`` section (checkpoint/train_state.py): a checkpoint
-missing the section is merely noted as legacy (tensors-only restore),
-but a section whose ``global_step`` disagrees with the step directory
-it lives in, or a worker entry with no reader cursors at all, is a
-resume hazard and exits non-zero.
+``train_state`` section (checkpoint/train_state.py) plus the saved
+``topology`` section (world size / device count / mesh — what elastic
+restore compares against, docs/RESILIENCE.md "Elastic topology"): a
+checkpoint missing either section is merely noted as legacy
+(tensors-only restore / no world-size check), but a section whose
+``global_step`` disagrees with the step directory it lives in, or a
+worker entry with no reader cursors at all, is a resume hazard and
+exits non-zero.
 
 Usage:
   python tools/ckpt_inspect.py /path/to/ckpt
@@ -81,10 +84,26 @@ def _print_tensors(root: str, step: int) -> None:
               f"shards={len(t['shards'])} {_fmt_bytes(nbytes)}")
 
 
+def _mesh_str(mesh) -> str:
+    if not mesh:
+        return "unplaced"
+    axes = [(a, int(n)) for a, n in mesh.items() if int(n) != 1]
+    return ",".join(f"{a}={n}" for a, n in sorted(axes)) or "data=1"
+
+
 def _check_train_state(root: str, step: int) -> List[str]:
-    """Print the train_state section for ``step`` and return lint
-    problems (empty for a clean or legacy checkpoint)."""
+    """Print the train_state section (and the saved topology it rode
+    in with) for ``step``; return lint problems (empty for a clean or
+    legacy checkpoint)."""
     man = wr._manifest_for_step(root, step)
+    topo = mf.manifest_topology(man)
+    if topo:
+        print(f"    topology: world_size={topo.get('world_size')} "
+              f"n_devices={topo.get('n_devices')} "
+              f"mesh={_mesh_str(topo.get('mesh'))}")
+    else:
+        print("    topology: (none — pre-elastic checkpoint; restore "
+              "performs no world-size check)")
     sec = man.get("train_state")
     if not sec:
         print("    train_state: (none — legacy checkpoint, restores "

@@ -75,6 +75,9 @@ REQUIRED_FAMILIES = (
     "pt_integrity_rollbacks_total", "pt_integrity_drift",
     "pt_resume_restores_total", "pt_resume_replayed_batches_total",
     "pt_resume_cursor_stale_total", "pt_resume_resumed_step",
+    # elastic topology resume (docs/RESILIENCE.md "Elastic topology")
+    "pt_elastic_resumes_total", "pt_elastic_reshard_seconds",
+    "pt_elastic_world_size",
     # multi-axis placement search (docs/PARALLELISM.md)
     "pt_placement_searches_total", "pt_placement_cache_hits_total",
     "pt_placement_search_seconds", "pt_placement_predicted_ms",
